@@ -1,0 +1,61 @@
+"""apex_tpu — a TPU-native re-design of NVIDIA Apex (reference: tanghl1994/apex).
+
+Apex is a mixed-precision + fused-kernel + data-parallel utility library layered
+on PyTorch/CUDA (reference: apex/__init__.py). apex_tpu provides the same
+capability surface layered on JAX/XLA/Pallas:
+
+- ``apex_tpu.amp``            — opt-level cast policies (O0..O3) + dynamic loss
+  scaling with master weights (reference: apex/amp/).
+- ``apex_tpu.optimizers``     — fused whole-model optimizers (FusedAdam,
+  FusedLAMB, FusedSGD, FusedNovoGrad, FusedAdagrad) built on a flat-superbuffer
+  multi-tensor harness (reference: apex/optimizers/ + csrc/multi_tensor_*).
+- ``apex_tpu.normalization``  — FusedLayerNorm / FusedRMSNorm backed by Pallas
+  kernels with fp32 accumulation (reference: apex/normalization/).
+- ``apex_tpu.parallel``       — DistributedDataParallel-shaped data parallelism
+  over ICI collectives, SyncBatchNorm via Welford psum, LARC
+  (reference: apex/parallel/).
+- ``apex_tpu.transformer``    — Megatron-style tensor/pipeline/sequence
+  parallelism on a jax.sharding.Mesh (reference: apex/transformer/).
+- ``apex_tpu.contrib``        — fused cross-entropy, multihead attention, flash
+  attention, distributed (ZeRO-style) optimizers, sparsity, etc.
+  (reference: apex/contrib/).
+
+Unlike the reference, everything here is functional and jit-first: policies are
+dtype rules applied at trace time (not monkey-patches), the loss scaler is a
+pytree carried in the train state, and comm is XLA collectives over a named-axis
+mesh (not NCCL).
+"""
+
+from importlib import import_module as _import_module
+
+__version__ = "0.1.0"
+
+_SUBMODULES = (
+    "amp",
+    "comm",
+    "contrib",
+    "fp16_utils",
+    "kernels",
+    "models",
+    "multi_tensor_apply",
+    "normalization",
+    "optimizers",
+    "parallel",
+    "reparameterization",
+    "transformer",
+    "utils",
+)
+
+__all__ = list(_SUBMODULES)
+
+
+def __getattr__(name):
+    if name in _SUBMODULES:
+        mod = _import_module(f"{__name__}.{name}")
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_SUBMODULES))

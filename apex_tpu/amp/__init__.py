@@ -1,0 +1,273 @@
+"""apex_tpu.amp — mixed precision with apex's API shape on a functional core.
+
+Reference surface (apex/amp/frontend.py, handle.py, _process_optimizer.py):
+
+- ``amp.initialize(model, optimizer, opt_level=..., ...)``
+- ``with amp.scale_loss(loss, optimizer) as scaled: scaled.backward()``
+- ``amp.state_dict()`` / ``amp.load_state_dict()``
+- ``amp.master_params(optimizer)``
+
+TPU mapping: the imperative pieces survive as thin facades; the real engine is
+:func:`make_train_step`, which builds ONE jitted step implementing apex's
+observable order of operations (apex/amp/_process_optimizer.py —
+post_backward_with_master_weights + wrapped step):
+
+    scaled loss → grads → unscale into fp32 master grads (+found_inf)
+    → lax.cond(found_inf): skip (scale halves, optimizer state does NOT
+      advance) / apply update to master weights
+    → master→model half copy → scaler schedule update
+
+bf16 is the default half dtype (BASELINE.json), fp16 selectable.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from . import lists  # noqa: F401
+from .policy import Policy, default_is_norm_param, opt_levels, resolve_policy
+from .scaler import (LossScaler, ScalerState, init_scaler, scale_loss as
+                     _scale_loss_fn, unscale, unscale_with_stashed,
+                     update_scale)
+
+__all__ = [
+    "Policy", "LossScaler", "ScalerState", "opt_levels", "resolve_policy",
+    "initialize", "scale_loss", "master_params", "state_dict",
+    "load_state_dict", "init_scaler", "unscale", "unscale_with_stashed",
+    "update_scale", "make_train_step", "AmpState",
+]
+
+# Global registry mirroring apex/amp/_amp_state.py — class AmpState: frontends
+# register scalers here so module-level state_dict()/scale_loss() work.
+class _AmpState:
+    def __init__(self):
+        self.loss_scalers = []
+        self.opt_properties = None
+        self.verbosity = 1
+
+
+_amp_state = _AmpState()
+
+
+def maybe_print(msg, verbosity_level=1):
+    """apex/amp/_amp_state.py — maybe_print."""
+    if _amp_state.verbosity >= verbosity_level:
+        print(msg)
+
+
+# ------------------------------------------------------------------ imperative
+class _InitializedModel(NamedTuple):
+    """Return bundle of :func:`initialize` — the policy-applied model pieces."""
+
+    apply_fn: Callable
+    params: Any
+    policy: Policy
+
+    def __call__(self, *args, **kwargs):
+        return self.apply_fn(*args, **kwargs)
+
+
+def initialize(model, optimizers=None, opt_level="O1", enabled=True,
+               num_losses=1, verbosity=1, min_loss_scale=None,
+               max_loss_scale=2.0 ** 24, **overrides):
+    """apex/amp/frontend.py — initialize, reshaped for functional models.
+
+    ``model`` is ``(apply_fn, params)`` (or a flax Module bound later by the
+    caller); ``optimizers`` an optax GradientTransformation (or list). Returns
+    ``(initialized_model, optimizers)`` where the model bundle carries the
+    resolved Policy and policy-cast params, and per-loss LossScalers are
+    registered for :func:`scale_loss` / :func:`state_dict`.
+    """
+    _amp_state.verbosity = verbosity
+    policy = resolve_policy(opt_level=opt_level, enabled=enabled, **overrides)
+    _amp_state.opt_properties = policy
+    _amp_state.loss_scalers = [
+        LossScaler(policy.loss_scale, min_loss_scale=min_loss_scale,
+                   max_loss_scale=max_loss_scale)
+        for _ in range(num_losses)
+    ]
+
+    if isinstance(model, tuple) and len(model) == 2:
+        apply_fn, params = model
+    else:
+        apply_fn, params = model, None
+
+    if params is not None:
+        params = policy.cast_params(params)
+
+    def policy_apply(p, *args, **kwargs):
+        args = policy.cast_to_compute(args)
+        return apply_fn(p, *args, **kwargs)
+
+    bundle = _InitializedModel(policy_apply if apply_fn is not None else None,
+                               params, policy)
+    if optimizers is None:
+        return bundle
+    return bundle, optimizers
+
+
+@contextlib.contextmanager
+def scale_loss(loss, optimizer=None, loss_id=0, model=None,
+               delay_unscale=False):
+    """apex/amp/handle.py — scale_loss context manager (imperative facade).
+
+    Yields the scaled loss; user differentiates it however they like and later
+    calls ``scaler.unscale``/``update_scale`` — or, preferably, uses
+    :func:`make_train_step` which does all of this inside jit.
+    """
+    if not _amp_state.loss_scalers:
+        _amp_state.loss_scalers = [LossScaler("dynamic")]
+    scaler = _amp_state.loss_scalers[loss_id]
+    yield scaler.scale_loss(jnp.asarray(loss))
+    if not delay_unscale:
+        scaler.update_scale()
+
+
+def master_params(optimizer_or_state):
+    """apex/amp/frontend.py — master_params: the fp32 master pytree."""
+    if isinstance(optimizer_or_state, AmpState):
+        return (optimizer_or_state.master_params
+                if optimizer_or_state.master_params is not None
+                else optimizer_or_state.params)
+    if hasattr(optimizer_or_state, "init") and hasattr(optimizer_or_state,
+                                                       "update"):
+        raise TypeError(
+            "master_params expects the AmpState train state (or a params "
+            "pytree), not an optax GradientTransformation — unlike apex, the "
+            "optimizer object holds no parameters here.")
+    return optimizer_or_state
+
+
+def state_dict():
+    """Serialize all registered loss scalers (frontend.py — state_dict)."""
+    return {f"loss_scaler{i}": s.state_dict()
+            for i, s in enumerate(_amp_state.loss_scalers)}
+
+
+def load_state_dict(sd):
+    for i, s in enumerate(_amp_state.loss_scalers):
+        key = f"loss_scaler{i}"
+        if key in sd:
+            s.load_state_dict(sd[key])
+
+
+# ------------------------------------------------------------------ functional
+@jax.tree_util.register_pytree_node_class
+class AmpState:
+    """Train-state pytree: model params (+ optional fp32 masters), optimizer
+    state, and the loss-scaler state — everything one jitted step touches."""
+
+    def __init__(self, params, master_params, opt_state, scaler):
+        self.params = params
+        self.master_params = master_params
+        self.opt_state = opt_state
+        self.scaler = scaler
+
+    def tree_flatten(self):
+        return (self.params, self.master_params, self.opt_state,
+                self.scaler), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    def replace(self, **kw):
+        vals = dict(params=self.params, master_params=self.master_params,
+                    opt_state=self.opt_state, scaler=self.scaler)
+        vals.update(kw)
+        return AmpState(**vals)
+
+
+def make_train_step(loss_fn: Callable, optimizer, policy: Policy,
+                    has_aux: bool = False,
+                    is_norm_param: Optional[Callable] = None):
+    """Build ``(init_fn, step_fn)`` implementing the apex iteration (§4.2 of
+    the survey) as one jitted function.
+
+    ``loss_fn(params, batch) -> loss`` (params arrive in the policy's model
+    dtype). ``optimizer`` is an optax GradientTransformation whose update runs
+    on fp32 master weights when the policy asks for them.
+
+    Skip-on-overflow matches apex: the optimizer state does NOT advance on a
+    skipped step (apex/amp/_process_optimizer.py skips ``optimizer.step``
+    entirely), and the loss scale halves via the scaler schedule.
+    """
+
+    def init_fn(params):
+        params32 = jax.tree_util.tree_map(
+            lambda x: jnp.asarray(x, jnp.float32)
+            if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) else x,
+            params)
+        model_params = policy.cast_params(params32, is_norm_param)
+        masters = params32 if policy.wants_master_weights else None
+        opt_params = masters if masters is not None else model_params
+        opt_state = optimizer.init(opt_params)
+        scaler = init_scaler(policy.loss_scale)
+        return AmpState(model_params, masters, opt_state, scaler)
+
+    def step_fn(state: AmpState, batch):
+        scaler = state.scaler
+        if policy.compute_dtype != jnp.float32:
+            # O1's patched-call-site input casts / O2-O3's patched forward
+            # (apex/amp/_initialize.py — patch_forward): floating inputs enter
+            # the model in the compute dtype; int leaves untouched.
+            batch = policy.cast_to_compute(batch)
+
+        def scaled_loss_fn(p):
+            out = loss_fn(p, batch)
+            if has_aux:
+                loss, aux = out
+            else:
+                loss, aux = out, None
+            return _scale_loss_fn(loss, scaler), (loss, aux)
+
+        grads, (loss, aux) = jax.grad(scaled_loss_fn, has_aux=True)(
+            state.params)
+        use_masters = state.master_params is not None
+        cur = state.master_params if use_masters else state.params
+        # Master-weight runs unscale into fp32 master grads; without masters
+        # (O0/O1/O3) grads stay in each param's own dtype so the optimizer
+        # state dtypes match what optimizer.init saw (apex O3 is pure-half).
+        unscaled, found_inf = unscale(grads, scaler, jnp.float32)
+        if use_masters:
+            master_grads = unscaled
+        else:
+            master_grads = jax.tree_util.tree_map(
+                lambda g, p: jnp.asarray(g, jnp.asarray(p).dtype),
+                unscaled, cur)
+
+        def do_step(_):
+            updates, new_opt = optimizer.update(master_grads, state.opt_state,
+                                                cur)
+            import optax
+            new_masters = optax.apply_updates(cur, updates)
+            return new_masters, new_opt
+
+        def skip_step(_):
+            return cur, state.opt_state
+
+        new_cur, new_opt_state = jax.lax.cond(found_inf, skip_step, do_step,
+                                              operand=None)
+
+        # master→model half copy (apex _master_params_to_model_params /
+        # multi_tensor_scale after step). Norm params may be fp32 in the
+        # model pytree; tree_map preserves each leaf's dtype.
+        new_params = jax.tree_util.tree_map(
+            lambda m, p: jnp.asarray(m, jnp.asarray(p).dtype),
+            new_cur, state.params)
+        new_masters = new_cur if use_masters else None
+
+        new_scaler = update_scale(scaler, found_inf)
+        new_state = AmpState(new_params, new_masters, new_opt_state,
+                             new_scaler)
+        metrics = {"loss": loss, "found_inf": found_inf,
+                   "loss_scale": scaler.loss_scale}
+        if has_aux:
+            metrics["aux"] = aux
+        return new_state, metrics
+
+    return init_fn, step_fn

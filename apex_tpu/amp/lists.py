@@ -1,0 +1,75 @@
+"""O1 cast-policy op tables — parity with apex/amp/lists/.
+
+Reference: apex/amp/lists/torch_overrides.py — FP16_FUNCS, FP32_FUNCS, CASTS,
+SEQUENCE_CASTS (plus tensor_overrides.py / functional_overrides.py which repeat
+the classification for Tensor methods and torch.nn.functional).
+
+Apex uses these tables to decide, per patched call site, whether an op runs in
+half (tensor-core ops), fp32 (reductions / loss / numerically touchy ops), or
+with promoted operand dtypes. On TPU there is no call-site patching — modules
+consult :func:`compute_dtype_for` at trace time — but the *classification* is
+the behavioral spec and is preserved verbatim where the op exists in JAX.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# Ops that benefit from half (MXU) math — apex FP16_FUNCS.
+FP16_FUNCS = frozenset({
+    "conv1d", "conv2d", "conv3d", "conv_transpose1d", "conv_transpose2d",
+    "conv_transpose3d", "conv_tbc", "prelu",
+    "addmm", "addmv", "addr", "matmul", "mm", "mv", "bmm", "baddbmm",
+    "addbmm", "chain_matmul", "linear", "dot", "einsum",
+    "dot_general", "conv_general_dilated",  # jax-native spellings
+})
+
+# Ops kept in fp32 for range/precision — apex FP32_FUNCS (+ functional/loss
+# entries from functional_overrides.py).
+FP32_FUNCS = frozenset({
+    "acos", "asin", "cosh", "erfinv", "exp", "expm1", "log", "log10", "log2",
+    "log1p", "reciprocal", "rsqrt", "sinh", "tan", "pow",
+    "softmax", "log_softmax", "cumprod", "cumsum", "dist", "mean", "norm",
+    "prod", "std", "sum", "var", "renorm", "logsumexp",
+    "cross_entropy", "nll_loss", "l1_loss", "mse_loss", "smooth_l1_loss",
+    "kl_div", "binary_cross_entropy", "binary_cross_entropy_with_logits",
+    "poisson_nll_loss", "cosine_embedding_loss", "hinge_embedding_loss",
+    "margin_ranking_loss", "multilabel_margin_loss", "soft_margin_loss",
+    "triplet_margin_loss", "ctc_loss",
+    "layer_norm", "group_norm", "instance_norm", "batch_norm",
+    "gelu",  # kept half in some vintages; fp32 is the safe classification
+})
+
+# Ops whose operands are promoted to the widest input dtype — apex CASTS.
+CASTS = frozenset({
+    "addcdiv", "addcmul", "atan2", "cross", "bilinear",
+    "add", "div", "mul", "sub", "eq", "ne", "lt", "le", "gt", "ge",
+    "equal", "cat", "stack", "index_put",
+})
+
+# Sequence-of-tensors variants promoted elementwise — apex SEQUENCE_CASTS.
+SEQUENCE_CASTS = frozenset({"cat", "stack", "concatenate"})
+
+
+def compute_dtype_for(op_name: str, half_dtype=jnp.bfloat16):
+    """Return the compute dtype O1 policy assigns to ``op_name``.
+
+    None means "no opinion" (run in operand dtype / promote per CASTS).
+    """
+    if op_name in FP16_FUNCS:
+        return jnp.dtype(half_dtype)
+    if op_name in FP32_FUNCS:
+        return jnp.dtype(jnp.float32)
+    return None
+
+
+def promote_dtype(*dtypes):
+    """Widest-input promotion used for CASTS entries (apex utils.type_string
+    ordering: fp16 < fp32 < fp64)."""
+    result = None
+    for d in dtypes:
+        d = jnp.dtype(d)
+        if not jnp.issubdtype(d, jnp.floating):
+            continue
+        result = d if result is None else jnp.promote_types(result, d)
+    return result

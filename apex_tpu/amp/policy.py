@@ -1,0 +1,298 @@
+"""Opt-level cast policies — the TPU equivalent of apex's amp frontend.
+
+Reference semantics: apex/amp/frontend.py — ``initialize``, ``class
+Properties``, ``class O0/O1/O2/O3``, ``opt_levels`` dict. Apex resolves an
+opt-level string into a ``Properties`` bundle (cast_model_type,
+patch_torch_functions, keep_batchnorm_fp32, master_weights, loss_scale), lets
+explicit kwargs override table entries, and prints a banner with the resolved
+options.
+
+The TPU design keeps the *table and resolution rules* bit-identical but swaps
+the mechanism: instead of monkey-patching torch call sites (O1) or rewriting
+module dtypes in place (O2/O3), a frozen :class:`Policy` drives dtype decisions
+at trace time — ``cast_to_compute`` for inputs, ``cast_params`` for parameter
+pytrees (honouring keep_batchnorm_fp32 via path predicates), and the op
+classification tables in :mod:`apex_tpu.amp.lists` for O1-style per-op policy.
+
+The TPU-native half dtype is bfloat16 (see BASELINE.json: "O1/O2 cast policies
+… target XLA bf16"); float16 remains selectable so the dynamic loss scaler's
+overflow path stays exercised by tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Callable, Optional, Union
+
+import jax.numpy as jnp
+
+logger = logging.getLogger("apex_tpu.amp")
+
+# Sentinel mirroring apex's use of None for "leave to defaults".
+_MISSING = object()
+
+DTypeLike = Any
+
+
+def _canon_dtype(d):
+    if d is None:
+        return None
+    return jnp.dtype(d)
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """Resolved amp properties. Mirrors apex/amp/frontend.py — class Properties.
+
+    Fields keep apex's names and meanings:
+
+    - ``enabled``: master switch (apex ``amp.initialize(enabled=False)`` makes
+      everything a no-op).
+    - ``opt_level``: "O0" | "O1" | "O2" | "O3".
+    - ``cast_model_type``: dtype params/inputs are cast to (O2/O3), or None.
+    - ``patch_torch_functions``: O1-style per-op cast policy. On TPU this
+      selects the op-table-driven compute dtype rules in
+      :mod:`apex_tpu.amp.lists` instead of runtime monkey-patching.
+    - ``keep_batchnorm_fp32``: keep norm-layer params/stats in fp32 when the
+      model itself is cast (O2).
+    - ``master_weights``: maintain an fp32 master copy of params; optimizer
+      steps read/write the master copy and mirror back to the model dtype.
+    - ``loss_scale``: float for static scaling, or the string "dynamic".
+    """
+
+    enabled: bool = True
+    opt_level: str = "O1"
+    cast_model_type: Optional[DTypeLike] = None
+    patch_torch_functions: bool = False
+    keep_batchnorm_fp32: Optional[bool] = None
+    master_weights: Optional[bool] = None
+    loss_scale: Union[float, str] = 1.0
+    # TPU extension: which dtype "half" means. bf16 is the TPU default; fp16
+    # keeps scaler-overflow semantics testable.
+    half_dtype: DTypeLike = jnp.bfloat16
+
+    # ------------------------------------------------------------------ dtypes
+    @property
+    def compute_dtype(self):
+        """Dtype matmul/conv compute should run in under this policy."""
+        if not self.enabled:
+            return jnp.float32
+        if self.cast_model_type is not None:
+            return _canon_dtype(self.cast_model_type)
+        if self.patch_torch_functions:  # O1: half compute for FP16_FUNCS ops
+            return _canon_dtype(self.half_dtype)
+        return jnp.float32
+
+    @property
+    def param_dtype(self):
+        """Dtype model ("working") parameters are stored in."""
+        if self.enabled and self.cast_model_type is not None:
+            return _canon_dtype(self.cast_model_type)
+        return jnp.float32
+
+    @property
+    def wants_master_weights(self) -> bool:
+        if not self.enabled:
+            return False
+        if self.master_weights is None:
+            return False
+        return bool(self.master_weights)
+
+    @property
+    def keep_bn_fp32(self) -> bool:
+        if self.keep_batchnorm_fp32 is None:
+            # apex default: True whenever the model is cast to half (O2);
+            # irrelevant otherwise.
+            return self.param_dtype != jnp.float32
+        return bool(self.keep_batchnorm_fp32)
+
+    # ------------------------------------------------------------- tree casts
+    def cast_to_compute(self, tree):
+        """Cast floating leaves of ``tree`` to the compute dtype.
+
+        Equivalent of apex's patched-forward input cast
+        (apex/amp/_initialize.py — patch_forward closure).
+        """
+        return _cast_floating(tree, self.compute_dtype)
+
+    def cast_params(self, params, is_norm_param: Optional[Callable] = None):
+        """Cast a parameter pytree to ``param_dtype``, keeping norm params fp32
+        when ``keep_batchnorm_fp32`` applies.
+
+        ``is_norm_param(path_tuple) -> bool`` identifies batch/layer-norm
+        parameters; defaults to name matching on the path (flax convention:
+        modules named ``bn*`` / ``*norm*`` / params ``scale``/``bias`` owned by
+        them).
+        """
+        import jax
+
+        target = self.param_dtype
+        if target == jnp.float32:
+            return _cast_floating(params, jnp.float32)
+        pred = is_norm_param if is_norm_param is not None else default_is_norm_param
+        flat = jax.tree_util.tree_flatten_with_path(params)[0]
+        treedef = jax.tree_util.tree_structure(params)
+        leaves = []
+        for path, leaf in flat:
+            if not _is_float(leaf):
+                leaves.append(leaf)
+            elif self.keep_bn_fp32 and pred(_path_names(path)):
+                leaves.append(jnp.asarray(leaf, jnp.float32))
+            else:
+                leaves.append(jnp.asarray(leaf, target))
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    # ------------------------------------------------------------------ repr
+    def banner(self) -> str:
+        """The resolved-options banner apex prints from frontend.initialize."""
+        lines = [
+            "Selected optimization level {}".format(self.opt_level),
+            "Defaults for this optimization level are:",
+        ]
+        for k in ("enabled", "cast_model_type", "patch_torch_functions",
+                  "keep_batchnorm_fp32", "master_weights", "loss_scale"):
+            lines.append("{:28} : {}".format(k, getattr(self, k)))
+        return "\n".join(lines)
+
+
+def _is_float(x):
+    try:
+        return jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+    except (TypeError, ValueError):
+        return False
+
+
+def _cast_floating(tree, dtype):
+    import jax
+
+    def cast(x):
+        if _is_float(x):
+            return jnp.asarray(x, dtype)
+        return x
+
+    return jax.tree_util.tree_map(cast, tree)
+
+
+def _path_names(path):
+    names = []
+    for p in path:
+        key = getattr(p, "key", None)
+        if key is None:
+            key = getattr(p, "name", None)
+        if key is None:
+            key = str(p)
+        names.append(str(key))
+    return tuple(names)
+
+
+_NORM_TOKENS = ("bn", "batchnorm", "batch_norm", "batch_stats", "norm", "ln")
+
+
+def default_is_norm_param(path_names) -> bool:
+    """Heuristic path predicate for keep_batchnorm_fp32.
+
+    Apex identifies BN modules by class (_initialize.py / fp16util.py —
+    BN_convert_float checks ``isinstance(module, _BatchNorm)``); in a pytree
+    world we go by path segment names. Users with exotic naming pass their own
+    predicate to :meth:`Policy.cast_params`.
+    """
+    return any(
+        tok in seg.lower() for seg in path_names for tok in _NORM_TOKENS
+    )
+
+
+# --------------------------------------------------------------------- tables
+# Mirrors apex/amp/frontend.py — opt_levels = {"O0": O0(), ...}. Values are the
+# per-level Properties defaults; None means "no opinion" exactly as in apex.
+
+_O0 = dict(cast_model_type=jnp.float32, patch_torch_functions=False,
+           keep_batchnorm_fp32=None, master_weights=False, loss_scale=1.0)
+_O1 = dict(cast_model_type=None, patch_torch_functions=True,
+           keep_batchnorm_fp32=None, master_weights=None, loss_scale="dynamic")
+_O2 = dict(cast_model_type="half", patch_torch_functions=False,
+           keep_batchnorm_fp32=True, master_weights=True, loss_scale="dynamic")
+_O3 = dict(cast_model_type="half", patch_torch_functions=False,
+           keep_batchnorm_fp32=False, master_weights=False, loss_scale=1.0)
+
+opt_levels = {"O0": _O0, "O1": _O1, "O2": _O2, "O3": _O3}
+
+_LEVEL_DOC = {
+    "O0": "Pure FP32 training.",
+    "O1": "Insert automatic casts around ops (op-table policy).",
+    "O2": "Half training with FP32 batchnorm and FP32 master weights.",
+    "O3": "Pure half training.",
+}
+
+
+def resolve_policy(
+    opt_level: str = "O1",
+    enabled: bool = True,
+    cast_model_type=_MISSING,
+    patch_torch_functions=_MISSING,
+    keep_batchnorm_fp32=_MISSING,
+    master_weights=_MISSING,
+    loss_scale=_MISSING,
+    half_dtype=jnp.bfloat16,
+    verbose: bool = True,
+) -> Policy:
+    """Resolve an opt level + kwarg overrides into a frozen Policy.
+
+    Mirrors apex/amp/frontend.py — initialize's validation + override merge:
+    unknown opt levels raise, explicit kwargs beat table defaults, and the
+    resolved options are logged as a banner.
+    """
+    if opt_level not in opt_levels:
+        raise ValueError(
+            "Unexpected optimization level {}. Options are 'O0', 'O1', 'O2', "
+            "'O3'. Note that in `O0`, `O1`, etc., the prefix O is the letter "
+            "O, not the number zero.".format(opt_level)
+        )
+    opts = dict(opt_levels[opt_level])
+
+    # keep_batchnorm_fp32 may arrive as the strings "True"/"False" (apex
+    # accepts those from argparse: frontend.py — check_option_consistency).
+    if isinstance(keep_batchnorm_fp32, str) and keep_batchnorm_fp32 is not _MISSING:
+        if keep_batchnorm_fp32 not in ("True", "False"):
+            raise ValueError(
+                "keep_batchnorm_fp32 must be True, False, 'True' or 'False', "
+                "got {}".format(keep_batchnorm_fp32)
+            )
+        keep_batchnorm_fp32 = keep_batchnorm_fp32 == "True"
+
+    overrides = dict(
+        cast_model_type=cast_model_type,
+        patch_torch_functions=patch_torch_functions,
+        keep_batchnorm_fp32=keep_batchnorm_fp32,
+        master_weights=master_weights,
+        loss_scale=loss_scale,
+    )
+    for k, v in overrides.items():
+        if v is not _MISSING:
+            opts[k] = v
+
+    cmt = opts["cast_model_type"]
+    if isinstance(cmt, str) and cmt == "half":
+        cmt = half_dtype
+    cmt = _canon_dtype(cmt)
+    # apex stores float32 for O0 but treats it as "no cast"; we normalise to
+    # None for no-op casting while keeping param_dtype fp32 either way.
+    cmt_field = None if (cmt is not None and cmt == jnp.float32) else cmt
+
+    ls = opts["loss_scale"]
+    if isinstance(ls, str) and ls != "dynamic":
+        ls = float(ls)
+
+    policy = Policy(
+        enabled=enabled,
+        opt_level=opt_level,
+        cast_model_type=cmt_field,
+        patch_torch_functions=bool(opts["patch_torch_functions"]),
+        keep_batchnorm_fp32=opts["keep_batchnorm_fp32"],
+        master_weights=opts["master_weights"],
+        loss_scale=ls,
+        half_dtype=_canon_dtype(half_dtype),
+    )
+    if verbose:
+        logger.info("%s\n%s", _LEVEL_DOC[opt_level], policy.banner())
+    return policy

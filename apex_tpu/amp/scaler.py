@@ -1,0 +1,213 @@
+"""Dynamic loss scaling — TPU equivalent of apex/amp/scaler.py — class LossScaler.
+
+Reference semantics (apex/amp/scaler.py):
+
+- ``LossScaler("dynamic")`` starts at ``min(max_loss_scale, 2**16)``, doubles
+  after ``scale_window`` (2000) consecutive overflow-free steps, halves on
+  overflow (clamped to ``min_loss_scale``), and resets the clean-step counter
+  in both cases (``update_scale``).
+- ``unscale`` multiplies grads by ``1/scale`` into master grads while checking
+  for inf/nan (csrc/multi_tensor_scale_kernel.cu writes a ``noop``/found_inf
+  flag); on overflow the step is skipped AND optimizer state must not advance.
+- ``unscale_with_stashed`` fuses unscale with accumulation onto stashed master
+  grads (csrc/multi_tensor_axpby_kernel.cu).
+
+TPU design: the scaler is a pytree (:class:`ScalerState`) carried in the train
+state so the whole update lives inside one jitted step; ``found_inf`` is a
+scalar bool computed alongside the unscale (XLA fuses the reduction into the
+scale elementwise pass — the multi_tensor launch-batching the CUDA harness
+exists for is free here). A stateful :class:`LossScaler` facade preserves the
+apex object API (``loss_scale()``, ``update_scale()``, ``unscale``) for
+imperative use and tests.
+"""
+
+from __future__ import annotations
+
+from typing import Union
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class ScalerState:
+    """Pytree loss-scaler state. Static config lives in pytree_node=False fields."""
+
+    loss_scale: jnp.ndarray          # f32 scalar, current scale
+    unskipped: jnp.ndarray           # i32 scalar, consecutive clean steps
+    steps: jnp.ndarray               # i32 scalar, total update_scale calls
+    overflows: jnp.ndarray           # i32 scalar, total overflows seen
+    dynamic: bool = struct.field(pytree_node=False, default=True)
+    scale_factor: float = struct.field(pytree_node=False, default=2.0)
+    scale_window: int = struct.field(pytree_node=False, default=2000)
+    min_loss_scale: float = struct.field(pytree_node=False, default=0.0)
+    max_loss_scale: float = struct.field(pytree_node=False, default=2.0 ** 24)
+
+
+def init_scaler(
+    loss_scale: Union[float, str] = "dynamic",
+    init_scale: float = 2.0 ** 16,
+    scale_factor: float = 2.0,
+    scale_window: int = 2000,
+    min_loss_scale: float = None,
+    max_loss_scale: float = 2.0 ** 24,
+) -> ScalerState:
+    """Build a ScalerState. Mirrors LossScaler.__init__ defaults."""
+    dynamic = isinstance(loss_scale, str) and loss_scale == "dynamic"
+    if dynamic:
+        scale = min(max_loss_scale, init_scale)
+    else:
+        scale = float(loss_scale)
+    return ScalerState(
+        loss_scale=jnp.float32(scale),
+        unskipped=jnp.int32(0),
+        steps=jnp.int32(0),
+        overflows=jnp.int32(0),
+        dynamic=dynamic,
+        scale_factor=float(scale_factor),
+        scale_window=int(scale_window),
+        min_loss_scale=0.0 if min_loss_scale is None else float(min_loss_scale),
+        max_loss_scale=float(max_loss_scale),
+    )
+
+
+def scale_loss(loss, state: ScalerState):
+    """loss * scale, in the loss's dtype. Mirrors handle.py — scale_loss entry."""
+    return loss * jnp.asarray(state.loss_scale, loss.dtype)
+
+
+def _tree_found_inf(tree):
+    leaves = [l for l in jax.tree_util.tree_leaves(tree)
+              if jnp.issubdtype(jnp.asarray(l).dtype, jnp.floating)]
+    if not leaves:
+        return jnp.bool_(False)
+    flags = [jnp.logical_not(jnp.all(jnp.isfinite(l))) for l in leaves]
+    return jnp.any(jnp.stack(flags))
+
+
+def unscale(grads, state: ScalerState, out_dtype=jnp.float32):
+    """grads * (1/scale) cast to ``out_dtype`` master grads, plus found_inf.
+
+    Equivalent of scaler.py — unscale → amp_C.multi_tensor_scale with the
+    overflow flag (``noop`` tensor) folded into the same pass.
+    """
+    inv = (1.0 / state.loss_scale).astype(jnp.float32)
+
+    def one(g):
+        if jnp.issubdtype(jnp.asarray(g).dtype, jnp.floating):
+            return (jnp.asarray(g, jnp.float32) * inv).astype(out_dtype)
+        return g
+
+    found = _tree_found_inf(grads)
+    return jax.tree_util.tree_map(one, grads), found
+
+
+def unscale_with_stashed(new_grads, stashed, state: ScalerState,
+                         out_dtype=jnp.float32):
+    """out = new/scale + stashed — grad accumulation across iterations.
+
+    Equivalent of scaler.py — unscale_with_stashed →
+    amp_C.multi_tensor_axpby(a=1/scale, b=1).
+    """
+    inv = (1.0 / state.loss_scale).astype(jnp.float32)
+
+    def one(g, s):
+        g32 = jnp.asarray(g, jnp.float32)
+        return (g32 * inv + jnp.asarray(s, jnp.float32)).astype(out_dtype)
+
+    found = jnp.logical_or(_tree_found_inf(new_grads), _tree_found_inf(stashed))
+    return jax.tree_util.tree_map(one, new_grads, stashed), found
+
+
+def update_scale(state: ScalerState, found_inf) -> ScalerState:
+    """Post-step schedule. Mirrors scaler.py — update_scale exactly:
+
+    overflow: scale = max(scale/factor, min_scale); unskipped = 0
+    clean:    unskipped += 1
+    then:     if unskipped == window: scale = min(scale*factor, max_scale);
+              unskipped = 0
+    (static scalers never change scale but still count.)
+    """
+    found_inf = jnp.asarray(found_inf, jnp.bool_)
+    if state.dynamic:
+        dropped = jnp.maximum(
+            state.loss_scale / state.scale_factor,
+            jnp.float32(state.min_loss_scale) if state.min_loss_scale
+            else jnp.float32(jnp.finfo(jnp.float32).tiny),
+        )
+        scale = jnp.where(found_inf, dropped, state.loss_scale)
+        unskipped = jnp.where(found_inf, 0, state.unskipped + 1)
+        grow = unskipped >= state.scale_window
+        scale = jnp.where(
+            grow,
+            jnp.minimum(scale * state.scale_factor,
+                        jnp.float32(state.max_loss_scale)),
+            scale,
+        )
+        unskipped = jnp.where(grow, 0, unskipped)
+    else:
+        scale = state.loss_scale
+        unskipped = jnp.where(found_inf, 0, state.unskipped + 1)
+    return state.replace(
+        loss_scale=scale,
+        unskipped=jnp.asarray(unskipped, jnp.int32),
+        steps=state.steps + 1,
+        overflows=state.overflows + jnp.asarray(found_inf, jnp.int32),
+    )
+
+
+class LossScaler:
+    """Stateful facade with apex's object API (apex/amp/scaler.py — LossScaler).
+
+    Tests and user code read ``loss_scale()``; ``update_scale()`` consumes the
+    overflow flag recorded by the last ``unscale``/``unscale_with_stashed``.
+    """
+
+    def __init__(self, loss_scale="dynamic", init_scale=2.0 ** 16,
+                 scale_factor=2.0, scale_window=2000,
+                 min_loss_scale=None, max_loss_scale=2.0 ** 24):
+        self._state = init_scaler(loss_scale, init_scale, scale_factor,
+                                  scale_window, min_loss_scale, max_loss_scale)
+        self._has_overflow = False
+        self.dynamic = self._state.dynamic
+
+    def loss_scale(self):
+        return float(self._state.loss_scale)
+
+    def scale_loss(self, loss):
+        return scale_loss(loss, self._state)
+
+    def unscale(self, grads, out_dtype=jnp.float32):
+        out, found = unscale(grads, self._state, out_dtype)
+        self._has_overflow = bool(found)
+        return out
+
+    def unscale_with_stashed(self, new_grads, stashed, out_dtype=jnp.float32):
+        out, found = unscale_with_stashed(new_grads, stashed, self._state,
+                                          out_dtype)
+        self._has_overflow = bool(found)
+        return out
+
+    def update_scale(self):
+        self._state = update_scale(self._state, jnp.bool_(self._has_overflow))
+        had = self._has_overflow
+        self._has_overflow = False
+        return had
+
+    # -- checkpointing (apex/amp/frontend.py — state_dict serializes scalers)
+    def state_dict(self):
+        return {
+            "loss_scale": float(self._state.loss_scale),
+            "unskipped": int(self._state.unskipped),
+            "steps": int(self._state.steps),
+            "overflows": int(self._state.overflows),
+        }
+
+    def load_state_dict(self, sd):
+        self._state = self._state.replace(
+            loss_scale=jnp.float32(sd["loss_scale"]),
+            unskipped=jnp.int32(sd["unskipped"]),
+            steps=jnp.int32(sd.get("steps", 0)),
+            overflows=jnp.int32(sd.get("overflows", 0)),
+        )

@@ -1,0 +1,1 @@
+from .pytree import flatten, unflatten, flatten_tree, unflatten_tree  # noqa: F401

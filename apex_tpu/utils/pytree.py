@@ -1,0 +1,62 @@
+"""Tensor-list flatten/unflatten — parity with apex_C.
+
+Reference: csrc/flatten_unflatten.cpp — ``flatten`` / ``unflatten`` (thin wraps
+of torch::utils::flatten_dense_tensors), used by apex DDP to coalesce gradient
+buckets into one contiguous buffer per allreduce
+(apex/parallel/distributed.py — flat_dist_call).
+
+On TPU a "contiguous comm buffer" is just a concatenated 1-D array; XLA owns
+layout. The same helpers double as the superbuffer builder for the fused
+multi-tensor optimizer harness (csrc/multi_tensor_apply.cuh equivalent in
+apex_tpu.multi_tensor_apply).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def flatten(tensors: Sequence[jnp.ndarray]) -> jnp.ndarray:
+    """Concatenate a list of arrays into one 1-D buffer (apex_C.flatten)."""
+    if not tensors:
+        return jnp.zeros((0,), jnp.float32)
+    return jnp.concatenate([jnp.ravel(t) for t in tensors])
+
+
+def unflatten(flat: jnp.ndarray, like: Sequence[jnp.ndarray]) -> List[jnp.ndarray]:
+    """Split a flat buffer back into arrays shaped like ``like``
+    (apex_C.unflatten)."""
+    outs = []
+    offset = 0
+    for t in like:
+        n = int(np.prod(t.shape)) if t.ndim else 1
+        outs.append(jnp.reshape(flat[offset:offset + n], t.shape)
+                    .astype(jnp.asarray(t).dtype))
+        offset += n
+    return outs
+
+
+def flatten_tree(tree: Any) -> Tuple[jnp.ndarray, Any]:
+    """Flatten a whole pytree into (flat_buffer, spec) — the superbuffer used
+    by the fused optimizer harness. ``spec`` round-trips via
+    :func:`unflatten_tree`."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    shapes = [jnp.shape(l) for l in leaves]
+    dtypes = [jnp.asarray(l).dtype for l in leaves]
+    flat = flatten([jnp.asarray(l) for l in leaves]) if leaves else jnp.zeros((0,))
+    return flat, (treedef, shapes, dtypes)
+
+
+def unflatten_tree(flat: jnp.ndarray, spec) -> Any:
+    treedef, shapes, dtypes = spec
+    outs = []
+    offset = 0
+    for shape, dtype in zip(shapes, dtypes):
+        n = int(np.prod(shape)) if shape else 1
+        outs.append(jnp.reshape(flat[offset:offset + n], shape).astype(dtype))
+        offset += n
+    return jax.tree_util.tree_unflatten(treedef, outs)

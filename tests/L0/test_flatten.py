@@ -1,0 +1,32 @@
+"""apex_C flatten/unflatten parity (csrc/flatten_unflatten.cpp)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.utils import flatten, unflatten, flatten_tree, unflatten_tree
+
+
+def test_flatten_roundtrip():
+    ts = [jnp.arange(6, dtype=jnp.float32).reshape(2, 3),
+          jnp.ones((4,), jnp.float32) * 7,
+          jnp.zeros((1, 1, 2), jnp.float32)]
+    flat = flatten(ts)
+    assert flat.shape == (12,)
+    back = unflatten(flat, ts)
+    for a, b in zip(ts, back):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_flatten_empty():
+    assert flatten([]).shape == (0,)
+
+
+def test_tree_roundtrip_mixed_dtypes():
+    tree = {"a": jnp.ones((2, 2), jnp.bfloat16),
+            "b": {"c": jnp.arange(3, dtype=jnp.float32)}}
+    flat, spec = flatten_tree(tree)
+    back = unflatten_tree(flat, spec)
+    assert back["a"].dtype == jnp.bfloat16
+    assert back["b"]["c"].dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(back["b"]["c"]),
+                               np.asarray(tree["b"]["c"]))

@@ -1,0 +1,109 @@
+"""Policy/opt-level tests — mirrors tests/L0/run_amp/test_basic_casts.py and
+the frontend option-resolution behavior (apex/amp/frontend.py)."""
+
+import jax.numpy as jnp
+import pytest
+
+from apex_tpu.amp import resolve_policy
+from apex_tpu.amp.policy import opt_levels
+
+
+def test_opt_level_tables_match_apex():
+    assert set(opt_levels) == {"O0", "O1", "O2", "O3"}
+    assert opt_levels["O0"]["loss_scale"] == 1.0
+    assert opt_levels["O1"]["loss_scale"] == "dynamic"
+    assert opt_levels["O2"]["loss_scale"] == "dynamic"
+    assert opt_levels["O3"]["loss_scale"] == 1.0
+    assert opt_levels["O2"]["master_weights"] is True
+    assert opt_levels["O2"]["keep_batchnorm_fp32"] is True
+    assert opt_levels["O3"]["keep_batchnorm_fp32"] is False
+    assert opt_levels["O1"]["patch_torch_functions"] is True
+
+
+def test_bad_opt_level_raises():
+    with pytest.raises(ValueError):
+        resolve_policy("O4")
+    with pytest.raises(ValueError):
+        resolve_policy("02")  # zero, not the letter — apex's classic footgun
+
+
+@pytest.mark.parametrize("half", [jnp.bfloat16, jnp.float16])
+def test_o2_dtypes(half):
+    p = resolve_policy("O2", half_dtype=half, verbose=False)
+    assert p.param_dtype == jnp.dtype(half)
+    assert p.compute_dtype == jnp.dtype(half)
+    assert p.wants_master_weights
+    assert p.keep_bn_fp32
+    assert p.loss_scale == "dynamic"
+
+
+def test_o0_is_fp32_noop():
+    p = resolve_policy("O0", verbose=False)
+    assert p.param_dtype == jnp.float32
+    assert p.compute_dtype == jnp.float32
+    assert not p.wants_master_weights
+    assert p.loss_scale == 1.0
+
+
+def test_o1_compute_half_params_fp32():
+    p = resolve_policy("O1", verbose=False)
+    assert p.param_dtype == jnp.float32
+    assert p.compute_dtype == jnp.bfloat16
+    assert p.patch_torch_functions
+
+
+def test_kwarg_overrides_beat_table():
+    p = resolve_policy("O2", loss_scale=128.0, master_weights=False,
+                       keep_batchnorm_fp32="False", verbose=False)
+    assert p.loss_scale == 128.0
+    assert not p.wants_master_weights
+    assert not p.keep_bn_fp32
+    with pytest.raises(ValueError):
+        resolve_policy("O2", keep_batchnorm_fp32="nope", verbose=False)
+
+
+def test_cast_params_keeps_norms_fp32():
+    p = resolve_policy("O2", half_dtype=jnp.bfloat16, verbose=False)
+    params = {
+        "conv1": {"kernel": jnp.ones((3, 3), jnp.float32)},
+        "bn1": {"scale": jnp.ones((3,), jnp.float32),
+                "bias": jnp.zeros((3,), jnp.float32)},
+        "dense": {"kernel": jnp.ones((4, 4), jnp.float32)},
+    }
+    out = p.cast_params(params)
+    assert out["conv1"]["kernel"].dtype == jnp.bfloat16
+    assert out["dense"]["kernel"].dtype == jnp.bfloat16
+    assert out["bn1"]["scale"].dtype == jnp.float32
+    assert out["bn1"]["bias"].dtype == jnp.float32
+
+
+def test_cast_params_o3_casts_everything():
+    p = resolve_policy("O3", half_dtype=jnp.bfloat16, verbose=False)
+    params = {"bn": {"scale": jnp.ones((3,), jnp.float32)}}
+    out = p.cast_params(params)
+    assert out["bn"]["scale"].dtype == jnp.bfloat16
+
+
+def test_cast_to_compute_skips_non_float():
+    p = resolve_policy("O2", verbose=False)
+    tree = {"x": jnp.ones((2,), jnp.float32), "idx": jnp.arange(3)}
+    out = p.cast_to_compute(tree)
+    assert out["x"].dtype == jnp.bfloat16
+    assert out["idx"].dtype == jnp.int32
+
+
+def test_banner_mentions_resolved_options():
+    p = resolve_policy("O2", verbose=False)
+    b = p.banner()
+    assert "O2" in b and "master_weights" in b and "loss_scale" in b
+
+
+def test_o1_op_tables():
+    from apex_tpu.amp import lists
+
+    assert lists.compute_dtype_for("matmul") == jnp.bfloat16
+    assert lists.compute_dtype_for("conv2d") == jnp.bfloat16
+    assert lists.compute_dtype_for("softmax") == jnp.float32
+    assert lists.compute_dtype_for("mse_loss") == jnp.float32
+    assert lists.compute_dtype_for("add") is None
+    assert lists.promote_dtype(jnp.float16, jnp.float32) == jnp.float32

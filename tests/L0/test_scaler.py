@@ -1,0 +1,139 @@
+"""Loss-scaler schedule tests — the semantics apex tests observe via
+``loss_scaler.loss_scale()`` (apex/amp/scaler.py — update_scale)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from apex_tpu.amp import (LossScaler, init_scaler, unscale,
+                          unscale_with_stashed, update_scale)
+from apex_tpu.amp.scaler import scale_loss
+
+
+def test_dynamic_init_scale():
+    s = LossScaler("dynamic")
+    assert s.loss_scale() == 2.0 ** 16
+    assert s.dynamic
+
+
+def test_static_scale_never_moves():
+    s = LossScaler(128.0)
+    for _ in range(5):
+        s._has_overflow = False
+        s.update_scale()
+    assert s.loss_scale() == 128.0
+    s._has_overflow = True
+    s.update_scale()
+    assert s.loss_scale() == 128.0
+
+
+def test_overflow_halves_and_resets():
+    s = LossScaler("dynamic")
+    s._has_overflow = True
+    s.update_scale()
+    assert s.loss_scale() == 2.0 ** 15
+    assert int(s._state.unskipped) == 0
+
+
+def test_growth_after_scale_window():
+    state = init_scaler("dynamic", init_scale=2.0 ** 8, scale_window=10)
+    clean = jnp.bool_(False)
+    for _ in range(9):
+        state = update_scale(state, clean)
+        assert float(state.loss_scale) == 2.0 ** 8
+    state = update_scale(state, clean)
+    assert float(state.loss_scale) == 2.0 ** 9
+    assert int(state.unskipped) == 0
+
+
+def test_overflow_resets_growth_counter():
+    state = init_scaler("dynamic", init_scale=2.0 ** 8, scale_window=4)
+    for _ in range(3):
+        state = update_scale(state, jnp.bool_(False))
+    state = update_scale(state, jnp.bool_(True))   # overflow at step 4
+    assert float(state.loss_scale) == 2.0 ** 7
+    for _ in range(3):
+        state = update_scale(state, jnp.bool_(False))
+    assert float(state.loss_scale) == 2.0 ** 7     # window not yet re-filled
+    state = update_scale(state, jnp.bool_(False))
+    assert float(state.loss_scale) == 2.0 ** 8
+
+
+def test_max_loss_scale_clamp():
+    state = init_scaler("dynamic", init_scale=2.0 ** 24, scale_window=1,
+                        max_loss_scale=2.0 ** 24)
+    state = update_scale(state, jnp.bool_(False))
+    assert float(state.loss_scale) == 2.0 ** 24
+
+
+def test_min_loss_scale_clamp():
+    state = init_scaler("dynamic", init_scale=4.0, min_loss_scale=2.0)
+    state = update_scale(state, jnp.bool_(True))
+    assert float(state.loss_scale) == 2.0
+    state = update_scale(state, jnp.bool_(True))
+    assert float(state.loss_scale) == 2.0
+
+
+def test_unscale_and_found_inf():
+    state = init_scaler(8.0)
+    grads = {"w": jnp.asarray([8.0, 16.0], jnp.float16)}
+    out, found = unscale(grads, state)
+    assert not bool(found)
+    assert out["w"].dtype == jnp.float32
+    assert jnp.allclose(out["w"], jnp.asarray([1.0, 2.0]))
+
+    bad = {"w": jnp.asarray([jnp.inf, 1.0], jnp.float16)}
+    _, found = unscale(bad, state)
+    assert bool(found)
+    nan = {"w": jnp.asarray([jnp.nan, 1.0], jnp.float32)}
+    _, found = unscale(nan, state)
+    assert bool(found)
+
+
+def test_unscale_with_stashed_accumulates():
+    state = init_scaler(4.0)
+    new = {"w": jnp.asarray([4.0], jnp.float16)}
+    stash = {"w": jnp.asarray([10.0], jnp.float32)}
+    out, found = unscale_with_stashed(new, stash, state)
+    assert not bool(found)
+    assert jnp.allclose(out["w"], jnp.asarray([11.0]))
+
+
+def test_scale_loss_dtype_preserved():
+    state = init_scaler(1024.0)
+    loss16 = jnp.float16(2.0)
+    out = scale_loss(loss16, state)
+    assert out.dtype == jnp.float16
+    loss32 = jnp.float32(2.0)
+    assert scale_loss(loss32, state) == 2048.0
+
+
+def test_update_scale_is_jittable():
+    state = init_scaler("dynamic", scale_window=2)
+    step = jax.jit(update_scale)
+    state = step(state, jnp.bool_(False))
+    state = step(state, jnp.bool_(False))
+    assert float(state.loss_scale) == 2.0 ** 17
+
+
+def test_state_dict_roundtrip():
+    s = LossScaler("dynamic")
+    s._has_overflow = True
+    s.update_scale()
+    sd = s.state_dict()
+    s2 = LossScaler("dynamic")
+    s2.load_state_dict(sd)
+    assert s2.loss_scale() == s.loss_scale()
+    assert s2.state_dict() == sd
+
+
+def test_module_state_dict():
+    import apex_tpu.amp as amp
+
+    amp.initialize((None, None), opt_level="O2", num_losses=2, verbose=False,
+                   verbosity=0)
+    sd = amp.state_dict()
+    assert set(sd) == {"loss_scaler0", "loss_scaler1"}
+    sd["loss_scaler0"]["loss_scale"] = 42.0
+    amp.load_state_dict(sd)
+    assert amp._amp_state.loss_scalers[0].loss_scale() == 42.0

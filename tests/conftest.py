@@ -1,0 +1,29 @@
+"""Test-wide environment: hermetic multi-device CPU backend.
+
+Mirrors the reference's strategy of faking multi-node as multi-process
+single-node (SURVEY §5.2) — but better: XLA's host-platform device-count flag
+gives 8 virtual devices in ONE process, so every collective/mesh test runs
+with no hardware (tests/distributed/ equivalents run here hermetically).
+
+Must run before jax initializes its backends, hence module-level in conftest.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    import jax
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return devs
