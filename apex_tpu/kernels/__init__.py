@@ -1,0 +1,24 @@
+"""apex_tpu.kernels — the Pallas (Mosaic) kernel tier.
+
+TPU-native equivalents of the reference's csrc/ CUDA kernels (SURVEY §3.2).
+Every kernel:
+
+- accumulates in fp32 regardless of I/O dtype (matching apex's kernels);
+- has a pure-jnp reference implementation used both as the CPU/interpret
+  fallback and as the oracle in tests (the reference's test strategy:
+  fused-vs-composed-eager comparison, tests/L0/run_fused_layer_norm/);
+- auto-falls back to the jnp path off-TPU so the suite runs hermetically
+  (the reference's "usable as pure-Python when exts missing" property).
+"""
+
+import jax
+
+
+def on_tpu() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+from .layer_norm import (  # noqa: E402,F401
+    layer_norm, rms_norm, layer_norm_reference, rms_norm_reference)
+from .multi_tensor import (  # noqa: E402,F401
+    fused_scale, fused_axpby, fused_l2norm, fused_adam_step, fused_sgd_step)

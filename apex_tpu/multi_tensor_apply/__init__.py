@@ -1,0 +1,102 @@
+"""Tensor-list frontend over the superbuffer kernels — parity with
+apex/multi_tensor_apply/multi_tensor_apply.py — class MultiTensorApply and the
+``multi_tensor_applier`` instance, plus list-level ops mirroring the amp_C
+entry points.
+
+Apex usage: ``multi_tensor_applier(amp_C.multi_tensor_scale, overflow_buf,
+[grads, out], scale)``. Functionally we can't write through output lists, so
+each op RETURNS the new list(s); the overflow flag is returned rather than
+written into a noop buffer.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax.numpy as jnp
+
+from ..kernels import multi_tensor as _k
+from ..utils.pytree import flatten, unflatten
+
+__all__ = [
+    "MultiTensorApply", "multi_tensor_applier", "multi_tensor_scale",
+    "multi_tensor_axpby", "multi_tensor_l2norm", "multi_tensor_adam",
+    "multi_tensor_sgd", "available",
+]
+
+available = True  # apex checks multi_tensor_applier.available
+
+
+class MultiTensorApply:
+    """apex/multi_tensor_apply/multi_tensor_apply.py — class MultiTensorApply.
+
+    chunk_size is accepted for API parity; chunking is the Pallas grid's job.
+    """
+
+    available = True
+
+    def __init__(self, chunk_size: int = 2048 * 32):
+        self.chunk_size = chunk_size
+
+    def __call__(self, op, noop_flag_buffer, tensor_lists, *args, **kwargs):
+        return op(noop_flag_buffer, tensor_lists, *args, **kwargs)
+
+
+multi_tensor_applier = MultiTensorApply()
+
+
+def multi_tensor_scale(tensors: Sequence[jnp.ndarray], scale,
+                       interpret: bool = False):
+    """amp_C.multi_tensor_scale over a tensor list → (scaled list, found_inf)."""
+    flat = flatten(list(tensors))
+    out, found = _k.fused_scale(flat, scale, interpret=interpret)
+    return unflatten(out, list(tensors)), found
+
+
+def multi_tensor_axpby(xs: Sequence[jnp.ndarray], ys: Sequence[jnp.ndarray],
+                       a, b, interpret: bool = False):
+    """amp_C.multi_tensor_axpby → (a*x+b*y list, found_inf)."""
+    fx, fy = flatten(list(xs)), flatten(list(ys))
+    out, found = _k.fused_axpby(fx, fy, a, b, interpret=interpret)
+    return unflatten(out, list(xs)), found
+
+
+def multi_tensor_l2norm(tensors: Sequence[jnp.ndarray],
+                        per_tensor: bool = False, interpret: bool = False):
+    """amp_C.multi_tensor_l2norm → global norm (and per-tensor norms when
+    requested, as FusedLAMB's stage-1 does)."""
+    norms: List[jnp.ndarray] = []
+    if per_tensor:
+        norms = [_k.fused_l2norm(jnp.ravel(t), interpret=interpret)
+                 for t in tensors]
+        total = jnp.sqrt(sum(n * n for n in norms))
+        return total, norms
+    flat = flatten(list(tensors))
+    return _k.fused_l2norm(flat, interpret=interpret)
+
+
+def multi_tensor_adam(params, exp_avgs, exp_avg_sqs, grads, *, lr, beta1,
+                      beta2, eps, step, weight_decay=0.0, adam_w_mode=True,
+                      interpret: bool = False):
+    """amp_C.multi_tensor_adam over tensor lists → (params, m, v) lists."""
+    fp, fm = flatten(list(params)), flatten(list(exp_avgs))
+    fv, fg = flatten(list(exp_avg_sqs)), flatten(list(grads))
+    p, m, v = _k.fused_adam_step(
+        fp, fm, fv, fg, lr=lr, beta1=beta1, beta2=beta2, eps=eps,
+        weight_decay=weight_decay, step=step, adam_w_mode=adam_w_mode,
+        interpret=interpret)
+    return (unflatten(p, list(params)), unflatten(m, list(exp_avgs)),
+            unflatten(v, list(exp_avg_sqs)))
+
+
+def multi_tensor_sgd(params, momentum_bufs, grads, *, lr, momentum=0.0,
+                     dampening=0.0, weight_decay=0.0, nesterov=False,
+                     wd_after_momentum=False, interpret: bool = False):
+    """amp_C.multi_tensor_sgd over tensor lists → (params, buf) lists."""
+    fp, fb = flatten(list(params)), flatten(list(momentum_bufs))
+    fg = flatten(list(grads))
+    p, buf = _k.fused_sgd_step(
+        fp, fb, fg, lr=lr, momentum=momentum, dampening=dampening,
+        weight_decay=weight_decay, nesterov=nesterov,
+        wd_after_momentum=wd_after_momentum, interpret=interpret)
+    return unflatten(p, list(params)), unflatten(buf, list(momentum_bufs))

@@ -1,0 +1,120 @@
+"""Fused LN/RMSNorm kernel tests — mirrors
+tests/L0/run_fused_layer_norm/test_fused_layer_norm.py: fused op vs composed
+reference (torch.nn.LayerNorm oracle where available) with dtype-dependent
+tolerances; Pallas path exercised via interpret=True on CPU."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.kernels import (layer_norm, layer_norm_reference, rms_norm,
+                              rms_norm_reference)
+
+SHAPES = [(4, 256), (3, 5, 384), (16, 128)]
+
+
+def _rand(shape, dtype, seed=0):
+    rng = np.random.RandomState(seed)
+    return jnp.asarray(rng.randn(*shape), dtype)
+
+
+@pytest.mark.parametrize("shape", SHAPES)
+@pytest.mark.parametrize("dtype,tol", [(jnp.float32, 1e-5),
+                                       (jnp.bfloat16, 1e-2)])
+def test_layer_norm_forward_vs_reference(shape, dtype, tol):
+    h = shape[-1]
+    x = _rand(shape, dtype)
+    w = _rand((h,), dtype, 1) * 0.5 + 1.0
+    b = _rand((h,), dtype, 2) * 0.1
+    out = layer_norm(x, w, b, interpret=True)
+    ref = layer_norm_reference(x, w, b)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               atol=tol, rtol=tol)
+
+
+def test_layer_norm_vs_torch_oracle():
+    import torch
+
+    h = 256
+    x = _rand((8, h), jnp.float32)
+    w = _rand((h,), jnp.float32, 1)
+    b = _rand((h,), jnp.float32, 2)
+    out = layer_norm(x, w, b, interpret=True)
+    tx = torch.tensor(np.asarray(x))
+    tln = torch.nn.LayerNorm(h)
+    with torch.no_grad():
+        tln.weight.copy_(torch.tensor(np.asarray(w)))
+        tln.bias.copy_(torch.tensor(np.asarray(b)))
+    ref = tln(tx).detach().numpy()
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("rms", [False, True])
+def test_grads_match_reference(rms):
+    h = 256
+    x = _rand((6, h), jnp.float32)
+    w = _rand((h,), jnp.float32, 1) * 0.3 + 1.0
+    b = _rand((h,), jnp.float32, 2) * 0.2
+
+    if rms:
+        def fused(x, w):
+            return jnp.sum(rms_norm(x, w, interpret=True) ** 2)
+
+        def ref(x, w):
+            return jnp.sum(rms_norm_reference(x, w) ** 2)
+
+        args = (x, w)
+    else:
+        def fused(x, w, b):
+            return jnp.sum(layer_norm(x, w, b, interpret=True) ** 2)
+
+        def ref(x, w, b):
+            return jnp.sum(layer_norm_reference(x, w, b) ** 2)
+
+        args = (x, w, b)
+
+    g_fused = jax.grad(fused, argnums=tuple(range(len(args))))(*args)
+    g_ref = jax.grad(ref, argnums=tuple(range(len(args))))(*args)
+    for gf, gr in zip(g_fused, g_ref):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   atol=2e-4, rtol=2e-4)
+
+
+def test_non_affine_variant():
+    x = _rand((4, 128), jnp.float32)
+    out = layer_norm(x, interpret=True)
+    ref = layer_norm_reference(x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+    g = jax.grad(lambda x: jnp.sum(layer_norm(x, interpret=True) ** 2))(x)
+    gr = jax.grad(lambda x: jnp.sum(layer_norm_reference(x) ** 2))(x)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(gr), atol=2e-4)
+
+
+def test_unaligned_hidden_falls_back():
+    # H=100 not lane-aligned: jnp fallback path must be numerically identical
+    x = _rand((4, 100), jnp.float32)
+    w = jnp.ones((100,), jnp.float32)
+    b = jnp.zeros((100,), jnp.float32)
+    out = layer_norm(x, w, b)
+    ref = layer_norm_reference(x, w, b)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+
+def test_rms_norm_forward():
+    x = _rand((5, 384), jnp.bfloat16)
+    w = _rand((384,), jnp.bfloat16, 1) * 0.2 + 1.0
+    out = rms_norm(x, w, interpret=True)
+    ref = rms_norm_reference(x, w)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32), atol=1e-2,
+                               rtol=1e-2)
+
+
+def test_odd_row_counts_padded_correctly():
+    # 7 rows: exercises row padding/slicing
+    x = _rand((7, 128), jnp.float32)
+    out = layer_norm(x, interpret=True)
+    ref = layer_norm_reference(x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)

@@ -1,0 +1,164 @@
+"""Multi-tensor harness tests — mirrors tests/L0/run_optimizers/
+test_fused_optimizer.py's oracle pattern: fused whole-model update vs
+torch.optim reference, per-step allclose over many iterations."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.kernels.multi_tensor import (fused_adam_step, fused_axpby,
+                                           fused_l2norm, fused_scale,
+                                           fused_sgd_step)
+from apex_tpu.multi_tensor_apply import (multi_tensor_adam,
+                                         multi_tensor_applier,
+                                         multi_tensor_l2norm,
+                                         multi_tensor_scale,
+                                         MultiTensorApply)
+
+
+def _flat(n, seed=0, dtype=jnp.float32):
+    rng = np.random.RandomState(seed)
+    return jnp.asarray(rng.randn(n), dtype)
+
+
+@pytest.mark.parametrize("n", [5, 128, 1000, 4096])
+def test_scale(n):
+    x = _flat(n)
+    out, found = fused_scale(x, 0.5, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x) * 0.5,
+                               rtol=1e-6)
+    assert not bool(found)
+
+
+def test_scale_found_inf():
+    x = _flat(300).at[123].set(jnp.inf)
+    _, found = fused_scale(x, 1.0, interpret=True)
+    assert bool(found)
+    x = _flat(300).at[0].set(jnp.nan)
+    _, found = fused_scale(x, 1.0, interpret=True)
+    assert bool(found)
+
+
+def test_axpby():
+    x, y = _flat(500, 0), _flat(500, 1)
+    out, found = fused_axpby(x, y, 2.0, -1.0, interpret=True)
+    np.testing.assert_allclose(np.asarray(out),
+                               2 * np.asarray(x) - np.asarray(y), rtol=1e-6)
+    assert not bool(found)
+    _, found = fused_axpby(x.at[7].set(jnp.inf), y, 1.0, 1.0, interpret=True)
+    assert bool(found)
+
+
+@pytest.mark.parametrize("n", [7, 1024, 5000])
+def test_l2norm(n):
+    x = _flat(n)
+    out = fused_l2norm(x, interpret=True)
+    np.testing.assert_allclose(float(out), float(np.linalg.norm(np.asarray(x))),
+                               rtol=1e-5)
+
+
+@pytest.mark.parametrize("adam_w", [False, True])
+def test_adam_vs_torch(adam_w):
+    import torch
+
+    n = 1000
+    rng = np.random.RandomState(3)
+    p0 = rng.randn(n).astype(np.float32)
+    lr, b1, b2, eps, wd = 1e-2, 0.9, 0.999, 1e-8, 0.01
+
+    tp = torch.nn.Parameter(torch.tensor(p0.copy()))
+    topt = (torch.optim.AdamW([tp], lr=lr, betas=(b1, b2), eps=eps,
+                              weight_decay=wd)
+            if adam_w else
+            torch.optim.Adam([tp], lr=lr, betas=(b1, b2), eps=eps,
+                             weight_decay=wd))
+
+    p = jnp.asarray(p0)
+    m = jnp.zeros((n,), jnp.float32)
+    v = jnp.zeros((n,), jnp.float32)
+
+    for step in range(1, 6):
+        g = rng.randn(n).astype(np.float32)
+        tp.grad = torch.tensor(g.copy())
+        topt.step()
+        p, m, v = fused_adam_step(p, m, v, jnp.asarray(g), lr=lr, beta1=b1,
+                                  beta2=b2, eps=eps, weight_decay=wd,
+                                  step=step, adam_w_mode=adam_w,
+                                  interpret=True)
+        # fp32 op-ordering noise vs torch (apex allows the same class of
+        # tolerance in run_optimizers/test_fused_optimizer.py)
+        np.testing.assert_allclose(np.asarray(p), tp.detach().numpy(),
+                                   atol=1e-5, rtol=1e-3)
+
+
+@pytest.mark.parametrize("momentum,nesterov,wd", [(0.0, False, 0.0),
+                                                  (0.9, False, 1e-4),
+                                                  (0.9, True, 1e-4)])
+def test_sgd_vs_torch(momentum, nesterov, wd):
+    import torch
+
+    n = 512
+    rng = np.random.RandomState(5)
+    p0 = rng.randn(n).astype(np.float32)
+    lr = 0.1
+
+    tp = torch.nn.Parameter(torch.tensor(p0.copy()))
+    topt = torch.optim.SGD([tp], lr=lr, momentum=momentum, nesterov=nesterov,
+                           weight_decay=wd)
+    p = jnp.asarray(p0)
+    buf = jnp.zeros((n,), jnp.float32)
+    for _ in range(5):
+        g = rng.randn(n).astype(np.float32)
+        tp.grad = torch.tensor(g.copy())
+        topt.step()
+        p, buf = fused_sgd_step(p, buf, jnp.asarray(g), lr=lr,
+                                momentum=momentum, weight_decay=wd,
+                                nesterov=nesterov, interpret=True)
+        np.testing.assert_allclose(np.asarray(p), tp.detach().numpy(),
+                                   atol=1e-6, rtol=1e-5)
+
+
+def test_tensor_list_frontend():
+    ts = [_flat(10, 0), _flat(300, 1).reshape(20, 15), _flat(7, 2)]
+    out, found = multi_tensor_scale(ts, 2.0, interpret=True)
+    assert not bool(found)
+    for o, t in zip(out, ts):
+        assert o.shape == t.shape
+        np.testing.assert_allclose(np.asarray(o), 2 * np.asarray(t),
+                                   rtol=1e-6)
+    total = multi_tensor_l2norm(ts, interpret=True)
+    expect = np.linalg.norm(np.concatenate([np.asarray(t).ravel()
+                                            for t in ts]))
+    np.testing.assert_allclose(float(total), float(expect), rtol=1e-5)
+    total2, per = multi_tensor_l2norm(ts, per_tensor=True, interpret=True)
+    np.testing.assert_allclose(float(total2), float(expect), rtol=1e-5)
+    assert len(per) == 3
+
+
+def test_applier_shim_signature():
+    # apex calling convention: applier(op, noop_buf, tensor_lists, *args)
+    applier = MultiTensorApply(2048)
+
+    def op(noop, lists, scale):
+        return multi_tensor_scale(lists[0], scale, interpret=True)
+
+    out, found = applier(op, None, [[_flat(16)]], 3.0)
+    assert not bool(found)
+    assert multi_tensor_applier.available
+
+
+def test_adam_list_frontend():
+    ps = [_flat(33, 0), _flat(65, 1)]
+    ms = [jnp.zeros_like(p) for p in ps]
+    vs = [jnp.zeros_like(p) for p in ps]
+    gs = [_flat(33, 2), _flat(65, 3)]
+    newp, newm, newv = multi_tensor_adam(
+        ps, ms, vs, gs, lr=1e-3, beta1=0.9, beta2=0.999, eps=1e-8, step=1,
+        interpret=True)
+    assert [p.shape for p in newp] == [p.shape for p in ps]
+    # single-step oracle: p - lr * g/(|g| + eps) after bias correction
+    g = np.asarray(gs[0])
+    mhat = g  # m/(1-b1) with m=(1-b1)g
+    vhat = g * g
+    expect = np.asarray(ps[0]) - 1e-3 * mhat / (np.sqrt(vhat) + 1e-8)
+    np.testing.assert_allclose(np.asarray(newp[0]), expect, atol=1e-6)
