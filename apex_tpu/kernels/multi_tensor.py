@@ -205,7 +205,7 @@ def _adam_kernel(sc_ref, p_ref, m_ref, v_ref, g_ref,
 
 def fused_adam_step(flat_p, flat_m, flat_v, flat_g, *, lr, beta1, beta2, eps,
                     weight_decay, step, adam_w_mode=True, inv_scale=1.0,
-                    interpret: bool = False):
+                    bias_correction=True, interpret: bool = False):
     """One whole-model Adam/AdamW step — amp_C.multi_tensor_adam
     (csrc/multi_tensor_adam.cu — AdamFunctor; bias correction via step count,
     adam_w selects decoupled decay).
@@ -216,11 +216,15 @@ def fused_adam_step(flat_p, flat_m, flat_v, flat_g, *, lr, beta1, beta2, eps,
     step = jnp.asarray(step, jnp.float32)
     b1 = jnp.asarray(beta1, jnp.float32)
     b2 = jnp.asarray(beta2, jnp.float32)
+    if bias_correction:
+        bc1, bc2 = 1.0 - b1 ** step, 1.0 - b2 ** step
+    else:  # apex FusedAdam(bias_correction=False)
+        bc1 = bc2 = jnp.float32(1.0)
     scalars = jnp.stack([
         jnp.asarray(lr, jnp.float32), b1, b2,
         jnp.asarray(eps, jnp.float32),
         jnp.asarray(weight_decay, jnp.float32),
-        1.0 - b1 ** step, 1.0 - b2 ** step,
+        bc1, bc2,
         jnp.asarray(inv_scale, jnp.float32),
     ]).reshape(1, 8)
     if not _use_pallas(interpret):

@@ -1,0 +1,24 @@
+"""apex_tpu.optimizers — fused optimizers (reference: apex/optimizers/).
+
+Each optimizer exists in two shapes:
+- a lowercase optax ``GradientTransformation`` factory (``fused_adam(...)``)
+  for functional training loops (composes with apex_tpu.amp.make_train_step);
+- an apex-shaped stateful class (``FusedAdam``) mirroring the reference
+  constructor signature for recipe parity.
+"""
+
+from .fused_adam import FusedAdam, FusedAdamState, fused_adam  # noqa: F401
+from .fused_adagrad import (FusedAdagrad, FusedAdagradState,  # noqa: F401
+                            fused_adagrad)
+from .fused_lamb import FusedLAMB, FusedLAMBState, fused_lamb  # noqa: F401
+from .fused_novograd import (FusedNovoGrad, FusedNovoGradState,  # noqa: F401
+                             fused_novograd)
+from .fused_sgd import FusedSGD, FusedSGDState, fused_sgd  # noqa: F401
+
+__all__ = [
+    "FusedAdam", "fused_adam", "FusedAdamState",
+    "FusedSGD", "fused_sgd", "FusedSGDState",
+    "FusedLAMB", "fused_lamb", "FusedLAMBState",
+    "FusedNovoGrad", "fused_novograd", "FusedNovoGradState",
+    "FusedAdagrad", "fused_adagrad", "FusedAdagradState",
+]

@@ -1,0 +1,72 @@
+"""FusedAdagrad — parity with apex/optimizers/fused_adagrad.py.
+
+Reference semantics (csrc/multi_tensor_adagrad.cu — AdagradFunctor):
+  h += g^2 ; p -= lr * g / (sqrt(h) + eps)
+with ``adagrad_w_mode`` selecting decoupled weight decay (mode 1) vs L2 into
+the grad (mode 0, apex default False → L2).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from .fused_adam import ScalarOrSchedule, _lr_at
+
+
+class FusedAdagradState(NamedTuple):
+    count: jnp.ndarray
+    sum: Any   # per-tensor fp32 accumulator pytree
+
+
+def fused_adagrad(learning_rate: ScalarOrSchedule = 1e-2, eps: float = 1e-10,
+                  weight_decay: float = 0.0,
+                  adagrad_w_mode: bool = False) -> optax.GradientTransformation:
+
+    def init_fn(params):
+        acc = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        return FusedAdagradState(count=jnp.zeros((), jnp.int32), sum=acc)
+
+    def update_fn(updates, state, params=None):
+        if params is None:
+            raise ValueError("fused_adagrad requires params")
+        count = state.count + 1
+        lr = _lr_at(learning_rate, count)
+
+        def one(p, g, h):
+            p32 = p.astype(jnp.float32)
+            g32 = g.astype(jnp.float32)
+            if not adagrad_w_mode:
+                g32 = g32 + weight_decay * p32
+            h_new = h + g32 * g32
+            upd = g32 / (jnp.sqrt(h_new) + eps)
+            if adagrad_w_mode:
+                upd = upd + weight_decay * p32
+            return (-lr * upd).astype(p.dtype), h_new
+
+        out = jax.tree_util.tree_map(one, params, updates, state.sum)
+        pick = lambda i: jax.tree_util.tree_map(  # noqa: E731
+            lambda t: t[i], out, is_leaf=lambda t: isinstance(t, tuple))
+        return pick(0), FusedAdagradState(count=count, sum=pick(1))
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+class FusedAdagrad:
+    """apex-shaped stateful wrapper."""
+
+    def __init__(self, params, lr=1e-2, eps=1e-10, weight_decay=0.0,
+                 set_grad_none=True, adagrad_w_mode=False):
+        self.transform = fused_adagrad(lr, eps, weight_decay, adagrad_w_mode)
+        self.state = self.transform.init(params)
+        self.params = params
+
+    def step(self, grads, params=None):
+        params = self.params if params is None else params
+        updates, self.state = self.transform.update(grads, self.state, params)
+        self.params = optax.apply_updates(params, updates)
+        return self.params

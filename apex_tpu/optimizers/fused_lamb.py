@@ -1,0 +1,122 @@
+"""FusedLAMB — parity with apex/optimizers/fused_lamb.py — class FusedLAMB.
+
+Reference semantics (csrc/multi_tensor_lamb.cu — LAMBStage1Functor,
+LAMBStage2Functor, driven by FusedLAMB.step):
+
+1. global grad norm via multi_tensor_l2norm over every grad;
+2. if global_norm > max_grad_norm: all grads divided by
+   global_norm / max_grad_norm (clipped_global_grad_norm);
+3. stage 1 per tensor: Adam-style moments (grad_averaging selects the
+   (1-beta1) factor), bias correction, update = mhat/(sqrt(vhat)+eps) + wd*p;
+4. stage 2 per tensor: trust ratio = ||p|| / ||update|| when both norms are
+   nonzero else 1.0; when weight_decay == 0 the ratio is forced to 1.0
+   unless ``use_nvlamb`` (matching the kernel's NVLAMB switch);
+5. p -= lr * ratio * update.
+
+Per-tensor trust ratios make a flat superbuffer awkward; the tree-level
+formulation below keeps the exact math, with the l2norm reductions running
+through the fused kernel. XLA fuses the per-tensor elementwise chains, so the
+launch-count motivation for the CUDA two-stage kernel does not apply.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Any
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..kernels.multi_tensor import fused_l2norm
+from .fused_adam import ScalarOrSchedule, _lr_at
+
+
+class FusedLAMBState(NamedTuple):
+    count: jnp.ndarray
+    m: Any   # per-tensor fp32 pytree
+    v: Any
+
+
+def fused_lamb(learning_rate: ScalarOrSchedule = 1e-3,
+               beta1: float = 0.9, beta2: float = 0.999, eps: float = 1e-6,
+               weight_decay: float = 0.01, bias_correction: bool = True,
+               grad_averaging: bool = True, max_grad_norm: float = 1.0,
+               use_nvlamb: bool = False) -> optax.GradientTransformation:
+    """Optax-compatible fused LAMB (apex FusedLAMB defaults)."""
+
+    def init_fn(params):
+        zeros = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        return FusedLAMBState(count=jnp.zeros((), jnp.int32), m=zeros,
+                              v=jax.tree_util.tree_map(jnp.copy, zeros))
+
+    def update_fn(updates, state, params=None):
+        if params is None:
+            raise ValueError("fused_lamb requires params")
+        count = state.count + 1
+        countf = count.astype(jnp.float32)
+        lr = _lr_at(learning_rate, count)
+
+        g32 = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), updates)
+        # (1)+(2) global-norm clip, exactly the kernel's formulation
+        global_sq = sum(jnp.sum(g * g)
+                        for g in jax.tree_util.tree_leaves(g32))
+        global_norm = jnp.sqrt(global_sq)
+        clip = jnp.where(global_norm > max_grad_norm,
+                         global_norm / max_grad_norm, 1.0)
+        beta1_grad = (1.0 - beta1) if grad_averaging else 1.0
+        if bias_correction:
+            bc1 = 1.0 - beta1 ** countf
+            bc2 = 1.0 - beta2 ** countf
+        else:
+            bc1 = bc2 = 1.0
+
+        def one(p, g, m, v):
+            p32 = p.astype(jnp.float32)
+            g = g / clip
+            m_new = beta1 * m + beta1_grad * g
+            v_new = beta2 * v + (1.0 - beta2) * g * g
+            update = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
+            update = update + weight_decay * p32
+            w_norm = fused_l2norm(jnp.ravel(p32))
+            u_norm = fused_l2norm(jnp.ravel(update))
+            ratio = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm,
+                              1.0)
+            if weight_decay == 0.0 and not use_nvlamb:
+                ratio = 1.0  # kernel skips trust ratio for undecayed params
+            delta = (-lr * ratio * update).astype(p.dtype)
+            return delta, m_new, v_new
+
+        out = jax.tree_util.tree_map(one, params, g32, state.m, state.v)
+        delta = jax.tree_util.tree_map(lambda t: t[0], out,
+                                       is_leaf=lambda t: isinstance(t, tuple))
+        m_new = jax.tree_util.tree_map(lambda t: t[1], out,
+                                       is_leaf=lambda t: isinstance(t, tuple))
+        v_new = jax.tree_util.tree_map(lambda t: t[2], out,
+                                       is_leaf=lambda t: isinstance(t, tuple))
+        return delta, FusedLAMBState(count=count, m=m_new, v=v_new)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+class FusedLAMB:
+    """apex-shaped stateful wrapper (apex/optimizers/fused_lamb.py)."""
+
+    def __init__(self, params, lr=1e-3, bias_correction=True,
+                 betas=(0.9, 0.999), eps=1e-6, weight_decay=0.01,
+                 amsgrad=False, adam_w_mode=True, grad_averaging=True,
+                 set_grad_none=True, max_grad_norm=1.0, use_nvlamb=False):
+        if amsgrad:
+            raise RuntimeError("FusedLAMB does not support the AMSGrad "
+                               "variant.")
+        self.transform = fused_lamb(lr, betas[0], betas[1], eps, weight_decay,
+                                    bias_correction, grad_averaging,
+                                    max_grad_norm, use_nvlamb)
+        self.state = self.transform.init(params)
+        self.params = params
+
+    def step(self, grads, params=None):
+        params = self.params if params is None else params
+        updates, self.state = self.transform.update(grads, self.state, params)
+        self.params = optax.apply_updates(params, updates)
+        return self.params

@@ -1,0 +1,99 @@
+"""FusedNovoGrad — parity with apex/optimizers/fused_novograd.py.
+
+Reference semantics (csrc/multi_tensor_novograd.cu + FusedNovoGrad.step):
+NovoGrad keeps a per-TENSOR scalar second moment (the squared L2 norm of the
+layer's grad), not a per-element one:
+
+  first step:   v_t = ||g||^2            (init_zero=False default)
+  later:        v_t = b2*v + (1-b2)*||g||^2
+  m_t = b1*m + (1-b1 if grad_averaging else 1) * (g/(sqrt(v_t)+eps) + wd*p)
+  p  -= lr * m_t            (bias correction optional, reg_inside_moment on)
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from .fused_adam import ScalarOrSchedule, _lr_at
+
+
+class FusedNovoGradState(NamedTuple):
+    count: jnp.ndarray
+    m: Any          # per-tensor fp32 pytree
+    v: Any          # per-tensor scalar fp32 pytree
+
+
+def fused_novograd(learning_rate: ScalarOrSchedule = 1e-3, beta1: float = 0.95,
+                   beta2: float = 0.98, eps: float = 1e-8,
+                   weight_decay: float = 0.0, grad_averaging: bool = True,
+                   init_zero: bool = False,
+                   bias_correction: bool = False) -> optax.GradientTransformation:
+
+    def init_fn(params):
+        m = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        v = jax.tree_util.tree_map(lambda p: jnp.zeros((), jnp.float32),
+                                   params)
+        return FusedNovoGradState(count=jnp.zeros((), jnp.int32), m=m, v=v)
+
+    def update_fn(updates, state, params=None):
+        if params is None:
+            raise ValueError("fused_novograd requires params")
+        count = state.count + 1
+        countf = count.astype(jnp.float32)
+        lr = _lr_at(learning_rate, count)
+        beta1_grad = (1.0 - beta1) if grad_averaging else 1.0
+        if bias_correction:
+            bc1 = 1.0 - beta1 ** countf
+            bc2 = 1.0 - beta2 ** countf
+        else:
+            bc1 = bc2 = 1.0
+
+        def one(p, g, m, v):
+            p32 = p.astype(jnp.float32)
+            g32 = g.astype(jnp.float32)
+            norm_sq = jnp.sum(g32 * g32)
+            v_new = jnp.where(
+                (count == 1) & (not init_zero),
+                norm_sq, beta2 * v + (1.0 - beta2) * norm_sq)
+            denom = jnp.sqrt(v_new / bc2) + eps
+            m_new = beta1 * m + beta1_grad * (g32 / denom +
+                                              weight_decay * p32)
+            delta = (-lr * m_new / bc1).astype(p.dtype)
+            return delta, m_new, v_new
+
+        out = jax.tree_util.tree_map(one, params, updates, state.m, state.v)
+        pick = lambda i: jax.tree_util.tree_map(  # noqa: E731
+            lambda t: t[i], out, is_leaf=lambda t: isinstance(t, tuple))
+        return pick(0), FusedNovoGradState(count=count, m=pick(1), v=pick(2))
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+class FusedNovoGrad:
+    """apex-shaped stateful wrapper."""
+
+    def __init__(self, params, lr=1e-3, bias_correction=False,
+                 betas=(0.95, 0.98), eps=1e-8, weight_decay=0.0,
+                 grad_averaging=True, init_zero=False, set_grad_none=True,
+                 amsgrad=False, reg_inside_moment=True, norm_type=2):
+        if amsgrad:
+            raise RuntimeError("FusedNovoGrad does not support the AMSGrad "
+                               "variant.")
+        if norm_type != 2:
+            raise ValueError("FusedNovoGrad only supports the L2 norm")
+        self.transform = fused_novograd(lr, betas[0], betas[1], eps,
+                                        weight_decay, grad_averaging,
+                                        init_zero, bias_correction)
+        self.state = self.transform.init(params)
+        self.params = params
+
+    def step(self, grads, params=None):
+        params = self.params if params is None else params
+        updates, self.state = self.transform.update(grads, self.state, params)
+        self.params = optax.apply_updates(params, updates)
+        return self.params

@@ -1,0 +1,71 @@
+"""FusedSGD — parity with apex/optimizers/fused_sgd.py — class FusedSGD.
+
+Reference semantics: torch.optim.SGD formula (momentum, dampening, nesterov,
+L2 weight_decay) executed for the whole model via
+multi_tensor_applier(amp_C.multi_tensor_sgd); ``wd_after_momentum`` variant
+exposed; momentum buffers fp32.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..kernels.multi_tensor import fused_sgd_step
+from .fused_adam import ScalarOrSchedule, _flat32, _lr_at, _unflatten_like
+
+
+class FusedSGDState(NamedTuple):
+    count: jnp.ndarray
+    momentum_buf: jnp.ndarray  # flat fp32
+
+
+def fused_sgd(learning_rate: ScalarOrSchedule, momentum: float = 0.0,
+              dampening: float = 0.0, weight_decay: float = 0.0,
+              nesterov: bool = False,
+              wd_after_momentum: bool = False) -> optax.GradientTransformation:
+    if nesterov and (momentum <= 0 or dampening != 0):
+        raise ValueError("Nesterov momentum requires a momentum and zero "
+                         "dampening")  # torch/apex validation
+
+    def init_fn(params):
+        n = sum(x.size for x in jax.tree_util.tree_leaves(params))
+        return FusedSGDState(count=jnp.zeros((), jnp.int32),
+                             momentum_buf=jnp.zeros((n,), jnp.float32))
+
+    def update_fn(updates, state, params=None):
+        if params is None:
+            raise ValueError("fused_sgd requires params")
+        count = state.count + 1
+        flat_p = _flat32(params)
+        flat_g = _flat32(updates)
+        lr = _lr_at(learning_rate, count)
+        new_p, new_buf = fused_sgd_step(
+            flat_p, state.momentum_buf, flat_g, lr=lr, momentum=momentum,
+            dampening=dampening, weight_decay=weight_decay, nesterov=nesterov,
+            wd_after_momentum=wd_after_momentum)
+        delta = _unflatten_like(new_p - flat_p, params)
+        return delta, FusedSGDState(count=count, momentum_buf=new_buf)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+class FusedSGD:
+    """apex-shaped stateful wrapper (apex/optimizers/fused_sgd.py)."""
+
+    def __init__(self, params, lr, momentum=0.0, dampening=0.0,
+                 weight_decay=0.0, nesterov=False, wd_after_momentum=False,
+                 materialize_master_grads=True, set_grad_none=False):
+        self.transform = fused_sgd(lr, momentum, dampening, weight_decay,
+                                   nesterov, wd_after_momentum)
+        self.state = self.transform.init(params)
+        self.params = params
+
+    def step(self, grads, params=None):
+        params = self.params if params is None else params
+        updates, self.state = self.transform.update(grads, self.state, params)
+        self.params = optax.apply_updates(params, updates)
+        return self.params

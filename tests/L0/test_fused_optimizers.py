@@ -1,0 +1,216 @@
+"""Fused optimizer tests — mirrors tests/L0/run_optimizers/
+test_fused_optimizer.py (FusedAdam vs torch.optim.Adam param-wise allclose
+across iterations) and test_lamb.py (vs an in-test reference NVLAMB impl)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from apex_tpu.optimizers import (FusedAdam, fused_adagrad, fused_adam,
+                                 fused_lamb, fused_novograd, fused_sgd)
+
+
+def _params(seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "layer1": {"kernel": jnp.asarray(rng.randn(8, 16), jnp.float32),
+                   "bias": jnp.asarray(rng.randn(16), jnp.float32)},
+        "layer2": {"kernel": jnp.asarray(rng.randn(16, 4), jnp.float32)},
+    }
+
+
+def _grads_like(params, seed):
+    rng = np.random.RandomState(seed)
+    return jax.tree_util.tree_map(
+        lambda p: jnp.asarray(rng.randn(*p.shape), jnp.float32), params)
+
+
+def _torch_mirror(params):
+    import torch
+
+    leaves, _ = jax.tree_util.tree_flatten(params)
+    return [torch.nn.Parameter(torch.tensor(np.asarray(l))) for l in leaves]
+
+
+def _assert_tree_close(params, torch_params, atol=1e-5, rtol=1e-3):
+    leaves = jax.tree_util.tree_leaves(params)
+    for leaf, tp in zip(leaves, torch_params):
+        np.testing.assert_allclose(np.asarray(leaf), tp.detach().numpy(),
+                                   atol=atol, rtol=rtol)
+
+
+@pytest.mark.parametrize("adam_w,wd", [(False, 0.0), (False, 0.01),
+                                       (True, 0.01)])
+def test_fused_adam_vs_torch(adam_w, wd):
+    import torch
+
+    params = _params()
+    tparams = _torch_mirror(params)
+    lr, betas, eps = 1e-2, (0.9, 0.999), 1e-8
+    topt = (torch.optim.AdamW(tparams, lr=lr, betas=betas, eps=eps,
+                              weight_decay=wd) if adam_w else
+            torch.optim.Adam(tparams, lr=lr, betas=betas, eps=eps,
+                             weight_decay=wd))
+    opt = fused_adam(lr, betas[0], betas[1], eps, wd, adam_w_mode=adam_w)
+    state = opt.init(params)
+    update = jax.jit(opt.update)
+    for i in range(10):
+        grads = _grads_like(params, 100 + i)
+        for tp, g in zip(tparams, jax.tree_util.tree_leaves(grads)):
+            tp.grad = torch.tensor(np.asarray(g))
+        topt.step()
+        updates, state = update(grads, state, params)
+        params = optax.apply_updates(params, updates)
+        _assert_tree_close(params, tparams)
+
+
+def test_fused_sgd_vs_torch():
+    import torch
+
+    params = _params(1)
+    tparams = _torch_mirror(params)
+    topt = torch.optim.SGD(tparams, lr=0.05, momentum=0.9, weight_decay=1e-4)
+    opt = fused_sgd(0.05, momentum=0.9, weight_decay=1e-4)
+    state = opt.init(params)
+    update = jax.jit(opt.update)
+    for i in range(8):
+        grads = _grads_like(params, 200 + i)
+        for tp, g in zip(tparams, jax.tree_util.tree_leaves(grads)):
+            tp.grad = torch.tensor(np.asarray(g))
+        topt.step()
+        updates, state = update(grads, state, params)
+        params = optax.apply_updates(params, updates)
+        _assert_tree_close(params, tparams)
+
+
+def _reference_lamb_step(p, g, m, v, step, lr, b1, b2, eps, wd,
+                         max_grad_norm, global_norm, use_nvlamb=False):
+    """In-test NVLAMB reference (the pattern of apex tests/L0/run_optimizers/
+    test_lamb.py, which defines RefLAMB in the test file)."""
+    clip = global_norm / max_grad_norm if global_norm > max_grad_norm else 1.0
+    g = g / clip
+    m = b1 * m + (1 - b1) * g
+    v = b2 * v + (1 - b2) * g * g
+    mhat = m / (1 - b1 ** step)
+    vhat = v / (1 - b2 ** step)
+    upd = mhat / (np.sqrt(vhat) + eps) + wd * p
+    w_norm = np.linalg.norm(p)
+    u_norm = np.linalg.norm(upd)
+    ratio = w_norm / u_norm if (w_norm > 0 and u_norm > 0) else 1.0
+    if wd == 0.0 and not use_nvlamb:
+        ratio = 1.0
+    return p - lr * ratio * upd, m, v
+
+
+def test_fused_lamb_vs_reference():
+    n = 64
+    rng = np.random.RandomState(7)
+    p0 = rng.randn(n).astype(np.float32)
+    lr, b1, b2, eps, wd, mgn = 1e-2, 0.9, 0.999, 1e-6, 0.01, 1.0
+
+    params = {"w": jnp.asarray(p0)}
+    opt = fused_lamb(lr, b1, b2, eps, wd, max_grad_norm=mgn)
+    state = opt.init(params)
+    update = jax.jit(opt.update)
+
+    ref_p, ref_m, ref_v = p0.copy(), np.zeros(n, np.float32), np.zeros(n, np.float32)
+    for step in range(1, 6):
+        g = rng.randn(n).astype(np.float32)
+        gn = np.linalg.norm(g)
+        ref_p, ref_m, ref_v = _reference_lamb_step(
+            ref_p, g, ref_m, ref_v, step, lr, b1, b2, eps, wd, mgn, gn)
+        updates, state = update({"w": jnp.asarray(g)}, state, params)
+        params = optax.apply_updates(params, updates)
+        np.testing.assert_allclose(np.asarray(params["w"]), ref_p, atol=1e-5,
+                                   rtol=1e-4)
+
+
+def test_lamb_no_decay_trust_ratio_one():
+    # wd=0, use_nvlamb=False → ratio forced to 1 → reduces to clipped Adam
+    params = {"w": jnp.ones((16,), jnp.float32)}
+    opt = fused_lamb(0.1, weight_decay=0.0, max_grad_norm=1e9)
+    state = opt.init(params)
+    g = {"w": jnp.full((16,), 0.5, jnp.float32)}
+    updates, state = opt.update(g, state, params)
+    newp = optax.apply_updates(params, updates)
+    # adam first step: mhat = g, vhat = g*g → upd = sign(g)/(1+eps-ish)
+    expect = 1.0 - 0.1 * (0.5 / (0.5 + 1e-6))
+    np.testing.assert_allclose(np.asarray(newp["w"]),
+                               np.full(16, expect, np.float32), rtol=1e-4)
+
+
+def test_fused_novograd_first_step_norm_init():
+    params = {"w": jnp.ones((8,), jnp.float32)}
+    opt = fused_novograd(0.1, beta1=0.95, beta2=0.98, weight_decay=0.0,
+                         grad_averaging=True)
+    state = opt.init(params)
+    g = np.full(8, 2.0, np.float32)
+    updates, state = opt.update({"w": jnp.asarray(g)}, state, params)
+    # first step: v = ||g||^2, m = (1-b1)*g/(||g||+eps), p -= lr*m
+    gnorm = np.linalg.norm(g)
+    expect_m = 0.05 * g / (gnorm + 1e-8)
+    newp = optax.apply_updates(params, updates)
+    np.testing.assert_allclose(np.asarray(newp["w"]), 1.0 - 0.1 * expect_m,
+                               rtol=1e-5)
+    np.testing.assert_allclose(float(state.v["w"]), gnorm ** 2, rtol=1e-5)
+
+
+def test_fused_adagrad_vs_torch():
+    import torch
+
+    params = _params(2)
+    tparams = _torch_mirror(params)
+    topt = torch.optim.Adagrad(tparams, lr=0.05, eps=1e-10,
+                               weight_decay=1e-4)
+    opt = fused_adagrad(0.05, eps=1e-10, weight_decay=1e-4)
+    state = opt.init(params)
+    for i in range(6):
+        grads = _grads_like(params, 300 + i)
+        for tp, g in zip(tparams, jax.tree_util.tree_leaves(grads)):
+            tp.grad = torch.tensor(np.asarray(g))
+        topt.step()
+        updates, state = opt.update(grads, state, params)
+        params = optax.apply_updates(params, updates)
+        _assert_tree_close(params, tparams, atol=1e-5, rtol=1e-3)
+
+
+def test_fused_adam_class_api():
+    params = _params(3)
+    opt = FusedAdam(params, lr=1e-3)
+    grads = _grads_like(params, 42)
+    newp = opt.step(grads)
+    assert jax.tree_util.tree_structure(newp) == \
+        jax.tree_util.tree_structure(params)
+    with pytest.raises(RuntimeError):
+        FusedAdam(params, amsgrad=True)
+    sd = opt.state_dict()
+    opt2 = FusedAdam(params, lr=1e-3)
+    opt2.load_state_dict(sd)
+    assert int(opt2.state.count) == 1
+
+
+def test_fused_adam_with_amp_train_step():
+    """FusedAdam composes with the amp O2 master-weight step."""
+    from apex_tpu.amp import make_train_step, resolve_policy, init_scaler
+
+    def loss_fn(params, batch):
+        x, y = batch
+        pred = x @ params["w"].astype(x.dtype)
+        return jnp.mean((pred.astype(jnp.float32) - y) ** 2)
+
+    policy = resolve_policy("O2", half_dtype=jnp.float16, verbose=False)
+    init_fn, step_fn = make_train_step(loss_fn, fused_adam(1e-2), policy)
+    state = init_fn({"w": jnp.ones((4, 2), jnp.float32)})
+    state = state.replace(scaler=init_scaler("dynamic", init_scale=128.0))
+    step = jax.jit(step_fn)
+    x = jnp.ones((8, 4), jnp.float32)
+    y = jnp.zeros((8, 2), jnp.float32)
+    prev = float("inf")
+    for _ in range(10):
+        state, m = step(state, (x, y))
+        assert not bool(m["found_inf"])
+        cur = float(m["loss"])
+        assert cur <= prev + 1e-3
+        prev = cur
