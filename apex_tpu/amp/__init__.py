@@ -159,17 +159,21 @@ def load_state_dict(sd):
 @jax.tree_util.register_pytree_node_class
 class AmpState:
     """Train-state pytree: model params (+ optional fp32 masters), optimizer
-    state, and the loss-scaler state — everything one jitted step touches."""
+    state, the loss-scaler state, and any mutable model state (flax
+    collections like BatchNorm's batch_stats) — everything one jitted step
+    touches."""
 
-    def __init__(self, params, master_params, opt_state, scaler):
+    def __init__(self, params, master_params, opt_state, scaler,
+                 model_state=None):
         self.params = params
         self.master_params = master_params
         self.opt_state = opt_state
         self.scaler = scaler
+        self.model_state = model_state
 
     def tree_flatten(self):
         return (self.params, self.master_params, self.opt_state,
-                self.scaler), None
+                self.scaler, self.model_state), None
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -177,14 +181,18 @@ class AmpState:
 
     def replace(self, **kw):
         vals = dict(params=self.params, master_params=self.master_params,
-                    opt_state=self.opt_state, scaler=self.scaler)
+                    opt_state=self.opt_state, scaler=self.scaler,
+                    model_state=self.model_state)
         vals.update(kw)
         return AmpState(**vals)
 
 
 def make_train_step(loss_fn: Callable, optimizer, policy: Policy,
                     has_aux: bool = False,
-                    is_norm_param: Optional[Callable] = None):
+                    is_norm_param: Optional[Callable] = None,
+                    with_model_state: bool = False,
+                    grad_average_axis: Optional[str] = None,
+                    gradient_predivide_factor: float = 1.0):
     """Build ``(init_fn, step_fn)`` implementing the apex iteration (§4.2 of
     the survey) as one jitted function.
 
@@ -192,12 +200,27 @@ def make_train_step(loss_fn: Callable, optimizer, policy: Policy,
     dtype). ``optimizer`` is an optax GradientTransformation whose update runs
     on fp32 master weights when the policy asks for them.
 
+    With ``with_model_state=True`` the loss_fn signature becomes
+    ``loss_fn(params, model_state, batch) -> (loss, new_model_state)`` (or
+    ``(loss, (new_model_state, aux))`` under has_aux) — the functional home
+    for flax mutable collections such as BatchNorm batch_stats, and
+    ``init_fn(params, model_state)`` stores it on the AmpState.
+
+    ``grad_average_axis`` names a mesh axis to mean-reduce gradients over —
+    the apex DDP composition point (apex/parallel/distributed.py averages
+    grads over the world inside its allreduce hooks; here it is one psum
+    under shard_map/pmap). ``gradient_predivide_factor`` mirrors apex DDP's
+    option of the same name: grads are divided by the factor BEFORE the
+    sum and by world/factor after, trading overflow headroom in half-precision
+    sums. Overflow detection runs on the *reduced* grads, so any rank's inf
+    skips the step on all ranks, same as NCCL allreduce propagating infs.
+
     Skip-on-overflow matches apex: the optimizer state does NOT advance on a
     skipped step (apex/amp/_process_optimizer.py skips ``optimizer.step``
     entirely), and the loss scale halves via the scaler schedule.
     """
 
-    def init_fn(params):
+    def init_fn(params, model_state=None):
         params32 = jax.tree_util.tree_map(
             lambda x: jnp.asarray(x, jnp.float32)
             if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) else x,
@@ -207,7 +230,7 @@ def make_train_step(loss_fn: Callable, optimizer, policy: Policy,
         opt_params = masters if masters is not None else model_params
         opt_state = optimizer.init(opt_params)
         scaler = init_scaler(policy.loss_scale)
-        return AmpState(model_params, masters, opt_state, scaler)
+        return AmpState(model_params, masters, opt_state, scaler, model_state)
 
     def step_fn(state: AmpState, batch):
         scaler = state.scaler
@@ -218,15 +241,33 @@ def make_train_step(loss_fn: Callable, optimizer, policy: Policy,
             batch = policy.cast_to_compute(batch)
 
         def scaled_loss_fn(p):
-            out = loss_fn(p, batch)
-            if has_aux:
-                loss, aux = out
+            if with_model_state:
+                out = loss_fn(p, state.model_state, batch)
+                if has_aux:
+                    loss, (mstate, aux) = out
+                else:
+                    loss, mstate = out
+                    aux = None
             else:
-                loss, aux = out, None
-            return _scale_loss_fn(loss, scaler), (loss, aux)
+                out = loss_fn(p, batch)
+                if has_aux:
+                    loss, aux = out
+                else:
+                    loss, aux = out, None
+                mstate = None
+            return _scale_loss_fn(loss, scaler), (loss, aux, mstate)
 
-        grads, (loss, aux) = jax.grad(scaled_loss_fn, has_aux=True)(
-            state.params)
+        grads, (loss, aux, new_model_state) = jax.grad(
+            scaled_loss_fn, has_aux=True)(state.params)
+        if grad_average_axis is not None:
+            # apex DDP's flat-bucket allreduce-mean, as one psum over the
+            # named axis; XLA's latency-hiding scheduler overlaps it with the
+            # remaining backward the way apex overlaps NCCL with autograd.
+            world = jax.lax.psum(1, grad_average_axis)
+            pre = gradient_predivide_factor
+            grads = jax.tree_util.tree_map(
+                lambda g: jax.lax.psum(g / pre, grad_average_axis)
+                * (pre / world), grads)
         use_masters = state.master_params is not None
         cur = state.master_params if use_masters else state.params
         # Master-weight runs unscale into fp32 master grads; without masters
@@ -263,7 +304,7 @@ def make_train_step(loss_fn: Callable, optimizer, policy: Policy,
 
         new_scaler = update_scale(scaler, found_inf)
         new_state = AmpState(new_params, new_masters, new_opt_state,
-                             new_scaler)
+                             new_scaler, new_model_state)
         metrics = {"loss": loss, "found_inf": found_inf,
                    "loss_scale": scaler.loss_scale}
         if has_aux:

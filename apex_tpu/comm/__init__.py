@@ -1,0 +1,166 @@
+"""apex_tpu.comm — the distributed communication backend.
+
+The reference's comm backend is NCCL reached through ``torch.distributed``
+(apex/parallel/distributed.py — flat_dist_call calls dist.all_reduce;
+apex/transformer uses dist.all_gather / reduce_scatter / batch_isend_irecv;
+contrib adds raw NCCL + CUDA IPC). On TPU none of that exists or is needed:
+the fabric is ICI (intra-slice) + DCN (cross-slice), and the collectives are
+XLA ops emitted from ``jax.lax`` primitives under ``shard_map``/``pjit`` on a
+``jax.sharding.Mesh``.
+
+This module is the single place upper layers get their mesh and collectives
+from, so nothing else in the framework calls raw ``jax.lax`` comm ops or
+constructs meshes ad-hoc (SURVEY §3.4's "thin comm module" design). Axis
+conventions:
+
+- ``data``  — data parallel; outermost, so multi-slice layouts put it on DCN.
+- ``model`` — tensor/sequence parallel (Megatron TP group); innermost → ICI.
+- ``pipe``  — pipeline stages, between the two.
+- ``expert``— reserved extension point (the reference has no EP; SURVEY §3.3).
+
+Process bootstrap: `jax.distributed.initialize` (multi-host), not
+WORLD_SIZE/RANK env bootstrap (reference: apex/parallel/multiproc.py).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = [
+    "AXIS_DATA", "AXIS_MODEL", "AXIS_PIPE", "AXIS_EXPERT",
+    "make_mesh", "default_mesh", "get_mesh", "set_mesh", "axis_size",
+    "all_reduce", "all_reduce_max", "all_gather", "reduce_scatter",
+    "ppermute", "broadcast_from", "axis_index", "initialize_distributed",
+]
+
+AXIS_DATA = "data"
+AXIS_MODEL = "model"
+AXIS_PIPE = "pipe"
+AXIS_EXPERT = "expert"
+
+_MESH: Optional[Mesh] = None
+
+
+def initialize_distributed(**kwargs):
+    """Multi-host bootstrap. TPU equivalent of the reference's
+    ``torch.distributed.init_process_group("nccl", init_method="env://")``
+    (examples/imagenet/main_amp.py — args.distributed block): on TPU pods the
+    coordinator/process ids come from the runtime, so this is one call."""
+    jax.distributed.initialize(**kwargs)
+
+
+def make_mesh(axes: Dict[str, int], devices: Optional[Sequence] = None) -> Mesh:
+    """Build a Mesh from ``{axis_name: size}`` in the given axis order.
+
+    Axis order is physical: earlier axes change slowest across the device
+    list, so callers should order axes outermost-first (``data`` before
+    ``model``) to keep TP collectives on ICI neighbours — the TPU analogue of
+    apex putting NCCL rings inside a node.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    names = tuple(axes)
+    sizes = tuple(int(axes[n]) for n in names)
+    need = int(np.prod(sizes)) if sizes else 1
+    if need > len(devices):
+        raise ValueError(
+            f"mesh {dict(axes)} needs {need} devices, have {len(devices)}")
+    arr = np.asarray(devices[:need], dtype=object).reshape(sizes)
+    return Mesh(arr, names)
+
+
+def default_mesh() -> Mesh:
+    """All local devices on a single ``data`` axis — what plain apex DDP
+    (pure data parallelism) corresponds to."""
+    return make_mesh({AXIS_DATA: len(jax.devices())})
+
+
+def set_mesh(mesh: Mesh) -> Mesh:
+    """Install the process-global mesh (parallel_state-style registry;
+    reference: apex/transformer/parallel_state.py keeps module globals)."""
+    global _MESH
+    _MESH = mesh
+    return mesh
+
+
+def get_mesh() -> Mesh:
+    global _MESH
+    if _MESH is None:
+        _MESH = default_mesh()
+    return _MESH
+
+
+def axis_size(axis_name: str, mesh: Optional[Mesh] = None) -> int:
+    mesh = mesh if mesh is not None else get_mesh()
+    return int(mesh.shape.get(axis_name, 1))
+
+
+# ----------------------------------------------------------------- collectives
+# Thin wrappers so upper layers never touch jax.lax comm primitives directly.
+# All of these are only meaningful inside shard_map/pmap with the named axis
+# bound; under plain jit they raise NameError from XLA, matching the reference
+# where dist.all_reduce without init_process_group raises.
+
+def all_reduce(x, axis_name: str, op: str = "sum"):
+    """dist.all_reduce equivalent. op: sum|mean|max|min."""
+    if op == "sum":
+        return jax.lax.psum(x, axis_name)
+    if op == "mean":
+        return jax.lax.pmean(x, axis_name)
+    if op == "max":
+        return jax.lax.pmax(x, axis_name)
+    if op == "min":
+        return jax.lax.pmin(x, axis_name)
+    raise ValueError(f"unknown reduce op {op!r}")
+
+
+def all_reduce_max(x, axis_name: str):
+    return jax.lax.pmax(x, axis_name)
+
+
+def all_gather(x, axis_name: str, axis: int = 0, tiled: bool = True):
+    """dist.all_gather equivalent (concatenate along ``axis``)."""
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name: str, axis: int = 0):
+    """dist.reduce_scatter equivalent (sum + scatter along ``axis``)."""
+    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=axis,
+                                tiled=True)
+
+
+def ppermute(x, axis_name: str, perm):
+    """Point-to-point collective permute — the TPU stand-in for every
+    send/recv pattern in the reference (pipeline p2p_communication._communicate
+    and the halo exchanges of contrib peer_memory/nccl_p2p)."""
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def axis_index(axis_name: str):
+    """This shard's coordinate along the axis (dist.get_rank equivalent)."""
+    return jax.lax.axis_index(axis_name)
+
+
+def broadcast_from(x, axis_name: str, src: int = 0):
+    """dist.broadcast equivalent: every member gets src's value. Apex DDP
+    broadcasts params from rank 0 at init (distributed.py — __init__'s
+    flat_dist_call(dist.broadcast)); under SPMD initialization is already
+    replicated, so this exists for API parity and odd cases."""
+    n = jax.lax.psum(1, axis_name)
+    perm = [(src, i) for i in range(n)]
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def replicated_sharding(mesh: Optional[Mesh] = None) -> NamedSharding:
+    mesh = mesh if mesh is not None else get_mesh()
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def data_sharding(mesh: Optional[Mesh] = None,
+                  axis: str = AXIS_DATA) -> NamedSharding:
+    """Batch-dim sharding over the data axis."""
+    mesh = mesh if mesh is not None else get_mesh()
+    return NamedSharding(mesh, PartitionSpec(axis))

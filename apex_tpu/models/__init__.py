@@ -1,0 +1,16 @@
+"""Model zoo for the framework's recipes and benchmarks.
+
+The reference has no model zoo of its own (it borrows torchvision resnets in
+examples/imagenet/main_amp.py and BERT from NVIDIA DeepLearningExamples); a
+standalone TPU framework must ship the models its recipes run, so they live
+here.
+"""
+
+from .resnet import (  # noqa: F401
+    BasicBlock, Bottleneck, ResNet, ResNet18, ResNet34, ResNet50, ResNet101,
+    ResNet152, create_model)
+
+__all__ = [
+    "BasicBlock", "Bottleneck", "ResNet", "ResNet18", "ResNet34", "ResNet50",
+    "ResNet101", "ResNet152", "create_model",
+]

@@ -1,0 +1,155 @@
+"""Flax ResNet family — the benchmark workload of the reference recipes.
+
+The reference's canonical example (examples/imagenet/main_amp.py — main) pulls
+``torchvision.models.resnet{18,50}`` and wraps them with amp + apex DDP. A
+TPU-native framework needs its own model zoo for those recipes, so this module
+provides ResNet v1.5 (stride-2 in the 3x3 conv of the bottleneck, matching
+torchvision and the NVIDIA ResNet50 v1.5 benchmark definition) in flax.linen.
+
+TPU-first design decisions:
+- NHWC layout throughout (flax default) — channels-last is the native TPU conv
+  layout; the reference's NCHW is a CUDA convention we deliberately do not copy.
+- ``dtype`` (compute) and ``param_dtype`` (storage) are plumbed separately so
+  the amp Policy can run bf16 compute with fp32 params (O1) or bf16 params with
+  fp32 batchnorm (O2, keep_batchnorm_fp32 — norms get ``norm_dtype``).
+- The norm layer is injectable (``norm_cls``) so
+  apex_tpu.parallel.SyncBatchNorm (stat-psum over a mesh axis) slots in the
+  same way apex's ``convert_syncbn_model`` rewrites nn.BatchNorm2d modules
+  (reference: apex/parallel/__init__.py — convert_syncbn_model).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BasicBlock(nn.Module):
+    """ResNet basic block (two 3x3 convs) — resnet18/34."""
+
+    filters: int
+    strides: Tuple[int, int] = (1, 1)
+    conv: ModuleDef = nn.Conv
+    norm: ModuleDef = nn.BatchNorm
+    act: Callable = nn.relu
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), self.strides, padding=[(1, 1), (1, 1)],
+                      name="conv1")(x)
+        y = self.norm(name="bn1")(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3), padding=[(1, 1), (1, 1)],
+                      name="conv2")(y)
+        y = self.norm(scale_init=nn.initializers.zeros, name="bn2")(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters, (1, 1), self.strides,
+                                 name="downsample_conv")(residual)
+            residual = self.norm(name="downsample_bn")(residual)
+        return self.act(residual + y)
+
+
+class Bottleneck(nn.Module):
+    """ResNet v1.5 bottleneck (stride on the 3x3) — resnet50/101/152."""
+
+    filters: int
+    strides: Tuple[int, int] = (1, 1)
+    conv: ModuleDef = nn.Conv
+    norm: ModuleDef = nn.BatchNorm
+    act: Callable = nn.relu
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1), name="conv1")(x)
+        y = self.norm(name="bn1")(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3), self.strides,
+                      padding=[(1, 1), (1, 1)], name="conv2")(y)
+        y = self.norm(name="bn2")(y)
+        y = self.act(y)
+        y = self.conv(self.filters * 4, (1, 1), name="conv3")(y)
+        # zero-init the last BN gamma: standard "bag of tricks" residual
+        # zero-gamma, same as NVIDIA's recipe default
+        y = self.norm(scale_init=nn.initializers.zeros, name="bn3")(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters * 4, (1, 1), self.strides,
+                                 name="downsample_conv")(residual)
+            residual = self.norm(name="downsample_bn")(residual)
+        return self.act(residual + y)
+
+
+class ResNet(nn.Module):
+    """ResNet v1.5, NHWC, flax.linen.
+
+    ``norm_cls(use_running_average=..., dtype=..., param_dtype=...)`` —
+    anything BatchNorm-shaped works, including
+    apex_tpu.parallel.SyncBatchNorm bound to a mesh axis.
+    """
+
+    stage_sizes: Sequence[int]
+    block_cls: ModuleDef
+    num_classes: int = 1000
+    num_filters: int = 64
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    norm_dtype: Optional[Any] = jnp.float32
+    norm_cls: Optional[ModuleDef] = None
+    act: Callable = nn.relu
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype,
+                                 param_dtype=self.param_dtype)
+        norm_dtype = self.norm_dtype if self.norm_dtype is not None else self.dtype
+        base_norm = self.norm_cls if self.norm_cls is not None else nn.BatchNorm
+        norm = functools.partial(
+            base_norm, use_running_average=not train, momentum=0.9,
+            epsilon=1e-5, dtype=norm_dtype, param_dtype=jnp.float32)
+
+        x = conv(self.num_filters, (7, 7), (2, 2),
+                 padding=[(3, 3), (3, 3)], name="conv_init")(x)
+        x = norm(name="bn_init")(x)
+        x = self.act(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+        for i, block_size in enumerate(self.stage_sizes):
+            for j in range(block_size):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = self.block_cls(self.num_filters * 2 ** i, strides=strides,
+                                   conv=conv, norm=norm, act=self.act,
+                                   name=f"stage{i + 1}_block{j}")(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype,
+                     param_dtype=self.param_dtype, name="fc")(x)
+        return x.astype(jnp.float32)
+
+
+ResNet18 = functools.partial(ResNet, stage_sizes=[2, 2, 2, 2],
+                             block_cls=BasicBlock)
+ResNet34 = functools.partial(ResNet, stage_sizes=[3, 4, 6, 3],
+                             block_cls=BasicBlock)
+ResNet50 = functools.partial(ResNet, stage_sizes=[3, 4, 6, 3],
+                             block_cls=Bottleneck)
+ResNet101 = functools.partial(ResNet, stage_sizes=[3, 4, 23, 3],
+                              block_cls=Bottleneck)
+ResNet152 = functools.partial(ResNet, stage_sizes=[3, 8, 36, 3],
+                              block_cls=Bottleneck)
+
+_ZOO = {"resnet18": ResNet18, "resnet34": ResNet34, "resnet50": ResNet50,
+        "resnet101": ResNet101, "resnet152": ResNet152}
+
+
+def create_model(name: str, **kwargs) -> ResNet:
+    """By-name constructor mirroring the reference recipe's
+    ``models.__dict__[args.arch]()`` (examples/imagenet/main_amp.py — main)."""
+    try:
+        return _ZOO[name.lower()](**kwargs)
+    except KeyError:
+        raise ValueError(
+            f"unknown arch {name!r}; available: {sorted(_ZOO)}") from None

@@ -1,0 +1,22 @@
+"""apex_tpu.parallel — data parallelism utilities (reference: apex/parallel/).
+
+- :class:`DistributedDataParallel` / :func:`average_gradients` /
+  :class:`Reducer` — gradient averaging over a mesh axis (flat-bucket NCCL
+  allreduce in the reference, one psum under XLA here).
+- :class:`SyncBatchNorm` + :func:`convert_syncbn_model` +
+  :func:`create_syncbn_process_group` — cross-device batch norm statistics.
+- :func:`larc` / :class:`LARC` — layer-wise adaptive rate control.
+- ``multiproc`` — launcher parity shim (single process drives all chips).
+"""
+
+from .distributed import (  # noqa: F401
+    DistributedDataParallel, Reducer, average_gradients)
+from .LARC import LARC, larc, larc_transform  # noqa: F401
+from .sync_batchnorm import (  # noqa: F401
+    SyncBatchNorm, convert_syncbn_model, create_syncbn_process_group)
+
+__all__ = [
+    "DistributedDataParallel", "Reducer", "average_gradients",
+    "LARC", "larc", "larc_transform",
+    "SyncBatchNorm", "convert_syncbn_model", "create_syncbn_process_group",
+]

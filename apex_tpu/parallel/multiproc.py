@@ -1,0 +1,33 @@
+"""Multi-process launcher parity shim.
+
+Reference: apex/parallel/multiproc.py — main: a pre-torchrun launcher that
+spawned one training process per GPU with WORLD_SIZE/RANK env vars.
+
+On TPU there is nothing to launch: a single Python process drives every local
+chip through the runtime, and multi-host jobs get one process per host started
+by the cluster scheduler, bootstrapped with ``jax.distributed.initialize()``
+(see apex_tpu.comm.initialize_distributed). This module exists so
+``python -m apex_tpu.parallel.multiproc script.py`` keeps working: it execs
+the script once, which is the correct process topology for a TPU host.
+"""
+
+from __future__ import annotations
+
+import runpy
+import sys
+
+
+def main():
+    if len(sys.argv) < 2:
+        print("usage: python -m apex_tpu.parallel.multiproc <script> [args...]",
+              file=sys.stderr)
+        return 1
+    sys.argv = sys.argv[1:]
+    print("apex_tpu.parallel.multiproc: TPU hosts run one process for all "
+          "local chips; executing the script directly.", file=sys.stderr)
+    runpy.run_path(sys.argv[0], run_name="__main__")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

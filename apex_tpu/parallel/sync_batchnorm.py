@@ -1,0 +1,157 @@
+"""SyncBatchNorm — cross-device batch norm via stat psum over a mesh axis.
+
+Reference: apex/parallel/optimized_sync_batchnorm.py +
+optimized_sync_batchnorm_kernel.py — SyncBatchnormFunction. The CUDA path
+computes per-GPU Welford (mean, var, count) with the ``syncbn`` extension,
+all_gathers the per-rank stats, combines them (welford_parallel), then
+normalizes; backward all_reduces (sum_dy, sum_dy_xmu).
+
+TPU mapping: the per-device moment computation is one fused XLA reduction, the
+cross-rank Welford combine collapses to ``psum`` of (sum, sum-of-squares,
+count) over the named axis — algebraically identical to Welford combination
+with equal-count shards and numerically done in fp32. Backward needs no custom
+kernel at all: the psums sit inside the autodiff graph, so XLA derives exactly
+apex's batchnorm_backward allreduce pattern (the transpose of psum is psum).
+
+Process groups (apex/parallel/__init__.py — create_syncbn_process_group's
+``group_size``) map to ``axis_index_groups``: stats sync within fixed-size
+subgroups of the axis.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+Dtype = Any
+
+
+def create_syncbn_process_group(axis_size: int, group_size: int):
+    """Partition an axis of ``axis_size`` devices into contiguous groups of
+    ``group_size`` — returns axis_index_groups for :class:`SyncBatchNorm`.
+
+    Mirrors apex/parallel/__init__.py — create_syncbn_process_group (which
+    builds torch.distributed new_group()s of group_size ranks each).
+    """
+    if group_size <= 0 or axis_size % group_size != 0:
+        raise ValueError(
+            f"group_size {group_size} must evenly divide axis size {axis_size}")
+    return [list(range(i, i + group_size))
+            for i in range(0, axis_size, group_size)]
+
+
+class SyncBatchNorm(nn.Module):
+    """Drop-in for flax ``nn.BatchNorm`` that reduces batch statistics over
+    ``axis_name`` (reference: apex/parallel/SyncBatchNorm).
+
+    With ``axis_name=None`` (or when called outside shard_map/pmap traces via
+    ``use_running_average=True``) it behaves as plain BatchNorm, matching
+    apex's fallback when torch.distributed isn't initialized.
+    """
+
+    use_running_average: Optional[bool] = None
+    axis: int = -1
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: Optional[Dtype] = None
+    param_dtype: Dtype = jnp.float32
+    use_bias: bool = True
+    use_scale: bool = True
+    bias_init: Any = nn.initializers.zeros
+    scale_init: Any = nn.initializers.ones
+    axis_name: Optional[str] = None
+    axis_index_groups: Optional[Sequence[Sequence[int]]] = None
+
+    @nn.compact
+    def __call__(self, x, use_running_average: Optional[bool] = None):
+        use_running_average = nn.merge_param(
+            "use_running_average", self.use_running_average,
+            use_running_average)
+        feature_axis = self.axis % x.ndim
+        reduction_axes = tuple(i for i in range(x.ndim) if i != feature_axis)
+        feature_shape = (x.shape[feature_axis],)
+
+        ra_mean = self.variable("batch_stats", "mean",
+                                lambda: jnp.zeros(feature_shape, jnp.float32))
+        ra_var = self.variable("batch_stats", "var",
+                               lambda: jnp.ones(feature_shape, jnp.float32))
+
+        if use_running_average:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            x32 = x.astype(jnp.float32)
+            # local moments in fp32 (csrc/welford.cu — welford_mean_var
+            # accumulates in accscalar_t=float)
+            mean = jnp.mean(x32, axis=reduction_axes)
+            mean2 = jnp.mean(jnp.square(x32), axis=reduction_axes)
+            # During module init there is no bound mesh axis to reduce over
+            # (apex likewise skips comm when torch.distributed isn't up).
+            if self.axis_name is not None and not self.is_initializing():
+                # welford_parallel: combine per-rank (mean, var, n). Equal
+                # shard counts ⇒ combination = mean of moments.
+                mean = jax.lax.pmean(
+                    mean, self.axis_name,
+                    axis_index_groups=self.axis_index_groups)
+                mean2 = jax.lax.pmean(
+                    mean2, self.axis_name,
+                    axis_index_groups=self.axis_index_groups)
+            var = mean2 - jnp.square(mean)
+
+            if not self.is_initializing():
+                # biased var for normalization, unbiased for running stats —
+                # apex matches torch.nn.BatchNorm semantics here
+                n = x32.size // x32.shape[feature_axis]
+                if self.axis_name is not None:
+                    group = (len(self.axis_index_groups[0])
+                             if self.axis_index_groups else None)
+                    world = group if group is not None else jax.lax.psum(
+                        1, self.axis_name)
+                    n = n * world
+                unbiased = var * (n / max(n - 1, 1))
+                m = self.momentum
+                ra_mean.value = m * ra_mean.value + (1 - m) * mean
+                ra_var.value = m * ra_var.value + (1 - m) * unbiased
+
+        y = (x.astype(jnp.float32)
+             - mean.reshape([-1 if i == feature_axis else 1
+                             for i in range(x.ndim)]))
+        y = y * jax.lax.rsqrt(
+            var + self.epsilon).reshape([-1 if i == feature_axis else 1
+                                         for i in range(x.ndim)])
+        if self.use_scale:
+            scale = self.param("scale", self.scale_init, feature_shape,
+                               self.param_dtype)
+            y = y * scale.astype(jnp.float32).reshape(
+                [-1 if i == feature_axis else 1 for i in range(x.ndim)])
+        if self.use_bias:
+            bias = self.param("bias", self.bias_init, feature_shape,
+                              self.param_dtype)
+            y = y + bias.astype(jnp.float32).reshape(
+                [-1 if i == feature_axis else 1 for i in range(x.ndim)])
+        out_dtype = self.dtype if self.dtype is not None else x.dtype
+        return y.astype(out_dtype)
+
+
+def convert_syncbn_model(module, axis_name: str = "data",
+                         process_group: Optional[Sequence[Sequence[int]]] = None):
+    """apex/parallel/__init__.py — convert_syncbn_model: swap BatchNorm for
+    SyncBatchNorm throughout a model.
+
+    Apex walks module children and replaces ``nn.BatchNorm2d`` instances; flax
+    modules are frozen dataclasses configured up-front, so conversion means
+    rebinding the model's injectable ``norm_cls`` field (the pattern our model
+    zoo uses — apex_tpu/models/resnet.py). Models without a ``norm_cls`` field
+    must be constructed with SyncBatchNorm directly.
+    """
+    import functools
+
+    bound = functools.partial(SyncBatchNorm, axis_name=axis_name,
+                              axis_index_groups=process_group)
+    if hasattr(module, "norm_cls"):
+        return module.replace(norm_cls=bound)
+    raise TypeError(
+        f"{type(module).__name__} has no injectable 'norm_cls' field; "
+        "construct it with norm_cls=apex_tpu.parallel.SyncBatchNorm instead")
