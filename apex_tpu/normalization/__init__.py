@@ -1,0 +1,113 @@
+"""apex_tpu.normalization — FusedLayerNorm / FusedRMSNorm modules.
+
+Mirrors the reference's ``apex/normalization/fused_layer_norm.py``
+(FusedLayerNorm, FusedRMSNorm, MixedFusedLayerNorm, MixedFusedRMSNorm) as flax
+modules over the Pallas kernels in apex_tpu.kernels.layer_norm. The reference
+falls back to ``F.layer_norm`` when its CUDA ext is missing; here the kernel
+itself falls back to the jnp reference path off the TPU-aligned hot path, so
+the module API is unconditional.
+
+"Mixed" in apex means fp32 params with fp16 I/O (MixedFusedLayerNorm casts
+inputs to param dtype); here that is the natural flax split of ``dtype``
+(compute) vs ``param_dtype`` (storage), with stats always fp32 in-kernel.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Union
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from apex_tpu.kernels.layer_norm import layer_norm, rms_norm
+
+__all__ = ["FusedLayerNorm", "FusedRMSNorm", "MixedFusedLayerNorm",
+           "MixedFusedRMSNorm", "fused_layer_norm", "fused_rms_norm"]
+
+
+def _norm_shape(normalized_shape) -> Sequence[int]:
+    if isinstance(normalized_shape, int):
+        return (normalized_shape,)
+    return tuple(normalized_shape)
+
+
+def fused_layer_norm(x, weight=None, bias=None, eps: float = 1e-5):
+    """Functional fused LayerNorm (reference: fused_layer_norm_cuda.forward)."""
+    return layer_norm(x, weight, bias, eps=eps)
+
+
+def fused_rms_norm(x, weight=None, eps: float = 1e-5):
+    """Functional fused RMSNorm (reference: rms_forward_affine)."""
+    return rms_norm(x, weight, eps=eps)
+
+
+class FusedLayerNorm(nn.Module):
+    """LayerNorm over the trailing ``normalized_shape`` dims.
+
+    Reference: apex/normalization/fused_layer_norm.py — class FusedLayerNorm
+    (elementwise_affine selects the affine/no-affine kernel pair).
+    """
+
+    normalized_shape: Union[int, Sequence[int]]
+    eps: float = 1e-5
+    elementwise_affine: bool = True
+    dtype: Optional[Any] = None
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        shape = _norm_shape(self.normalized_shape)
+        hidden = 1
+        for s in shape:
+            hidden *= s
+        if self.dtype is not None:
+            x = jnp.asarray(x, self.dtype)
+        orig_shape = x.shape
+        x2 = x.reshape(x.shape[:x.ndim - len(shape)] + (hidden,))
+        if self.elementwise_affine:
+            weight = self.param("scale", nn.initializers.ones, (hidden,),
+                                self.param_dtype)
+            bias = self.param("bias", nn.initializers.zeros, (hidden,),
+                              self.param_dtype)
+        else:
+            weight = bias = None
+        y = layer_norm(x2, weight, bias, eps=self.eps)
+        return y.reshape(orig_shape)
+
+
+class FusedRMSNorm(nn.Module):
+    """RMSNorm (reference: fused_layer_norm.py — class FusedRMSNorm)."""
+
+    normalized_shape: Union[int, Sequence[int]]
+    eps: float = 1e-5
+    elementwise_affine: bool = True
+    dtype: Optional[Any] = None
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        shape = _norm_shape(self.normalized_shape)
+        hidden = 1
+        for s in shape:
+            hidden *= s
+        if self.dtype is not None:
+            x = jnp.asarray(x, self.dtype)
+        orig_shape = x.shape
+        x2 = x.reshape(x.shape[:x.ndim - len(shape)] + (hidden,))
+        if self.elementwise_affine:
+            weight = self.param("scale", nn.initializers.ones, (hidden,),
+                                self.param_dtype)
+        else:
+            weight = None
+        y = rms_norm(x2, weight, eps=self.eps)
+        return y.reshape(orig_shape)
+
+
+# apex's "Mixed" variants exist because its FusedLayerNorm requires weight
+# dtype == input dtype while MixedFusedLayerNorm allows fp32 gamma/beta with
+# half inputs (apex/normalization/fused_layer_norm.py — MixedFusedLayerNorm).
+# Here the base modules ALREADY implement that contract (param_dtype defaults
+# to fp32, stats accumulate fp32 in-kernel, I/O dtype follows the input), so
+# the Mixed names are pure API-parity aliases.
+MixedFusedLayerNorm = FusedLayerNorm
+MixedFusedRMSNorm = FusedRMSNorm

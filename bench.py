@@ -1,0 +1,83 @@
+"""Driver benchmark: ResNet-50 amp-O2 train-step throughput (img/s/chip).
+
+Mirrors the reference's north-star workload (examples/imagenet/main_amp.py:
+ResNet-50 + amp O2 + DDP; BASELINE.json — "metric") on one chip with synthetic
+data. Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "img/s/chip", "vs_baseline": N}
+
+vs_baseline is relative to the apex O2 V100 per-GPU rate (~820 img/s, NVIDIA
+DeepLearningExamples ResNet50v1.5 README — see BASELINE.md; the driver's bar
+is >=0.9 on real v5e hardware).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex_tpu import amp
+from apex_tpu.amp.policy import resolve_policy
+from apex_tpu.models.resnet import create_model
+
+V100_O2_IMG_PER_SEC = 820.0
+
+BATCH = int(os.environ.get("BENCH_BATCH", "64"))
+IMAGE = int(os.environ.get("BENCH_IMAGE", "224"))
+WARMUP = int(os.environ.get("BENCH_WARMUP", "2"))
+STEPS = int(os.environ.get("BENCH_STEPS", "10"))
+
+
+def main():
+    model = create_model("resnet50", num_classes=1000, dtype=jnp.bfloat16)
+    rng = jax.random.PRNGKey(0)
+    x_init = jnp.ones((BATCH, IMAGE, IMAGE, 3), jnp.float32)
+    variables = model.init(rng, x_init, train=True)
+    params, batch_stats = variables["params"], variables.get("batch_stats", {})
+
+    policy = resolve_policy(opt_level="O2", loss_scale="dynamic")
+    optimizer = optax.sgd(optax.constant_schedule(0.1), momentum=0.9)
+
+    def loss_fn(p, model_state, batch):
+        images, labels = batch
+        logits, updated = model.apply(
+            {"params": p, "batch_stats": model_state}, images, train=True,
+            mutable=["batch_stats"])
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            jnp.asarray(logits, jnp.float32), labels).mean()
+        return loss, updated["batch_stats"]
+
+    init_fn, step_fn = amp.make_train_step(loss_fn, optimizer, policy,
+                                           with_model_state=True)
+    state = init_fn(params, batch_stats)
+    jit_step = jax.jit(step_fn, donate_argnums=(0,))
+
+    images = jax.random.normal(rng, (BATCH, IMAGE, IMAGE, 3), jnp.float32)
+    labels = jax.random.randint(rng, (BATCH,), 0, 1000)
+    batch = (images, labels)
+
+    for _ in range(WARMUP):
+        state, _ = jit_step(state, batch)
+    jax.block_until_ready(jax.tree_util.tree_leaves(state.params)[0])
+
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        state, metrics = jit_step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    img_per_sec = BATCH * STEPS / dt
+    print(json.dumps({
+        "metric": "resnet50_amp_o2_train_img_per_sec_per_chip",
+        "value": round(img_per_sec, 2),
+        "unit": "img/s/chip",
+        "vs_baseline": round(img_per_sec / V100_O2_IMG_PER_SEC, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

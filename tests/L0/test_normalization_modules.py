@@ -1,0 +1,93 @@
+"""apex_tpu.normalization module tests.
+
+Mirror of the reference's tests/L0/run_fused_layer_norm/test_fused_layer_norm.py
+strategy: compare the fused module against a composed fp32 reference
+(flax LayerNorm / hand jnp) with dtype-dependent tolerances.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.normalization import (FusedLayerNorm, FusedRMSNorm,
+                                    MixedFusedLayerNorm)
+
+
+def _ref_ln(x, scale, bias, eps=1e-5):
+    x32 = x.astype(np.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = x32.var(-1, keepdims=True)
+    y = (x32 - mu) / np.sqrt(var + eps)
+    return y * scale + bias
+
+
+@pytest.mark.parametrize("hidden", [128, 96])
+def test_fused_layer_norm_module(hidden):
+    m = FusedLayerNorm(normalized_shape=hidden)
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 16, hidden), jnp.float32)
+    variables = m.init(jax.random.PRNGKey(1), x)
+    y = m.apply(variables, x)
+    scale = np.asarray(variables["params"]["scale"])
+    bias = np.asarray(variables["params"]["bias"])
+    np.testing.assert_allclose(np.asarray(y), _ref_ln(np.asarray(x), scale,
+                                                      bias),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_fused_layer_norm_no_affine():
+    m = FusedLayerNorm(normalized_shape=64, elementwise_affine=False)
+    x = jax.random.normal(jax.random.PRNGKey(0), (8, 64), jnp.float32)
+    variables = m.init(jax.random.PRNGKey(1), x)
+    assert "params" not in variables or not variables["params"]
+    y = m.apply(variables, x)
+    np.testing.assert_allclose(np.asarray(y),
+                               _ref_ln(np.asarray(x), 1.0, 0.0),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_fused_rms_norm_module():
+    m = FusedRMSNorm(normalized_shape=128)
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 128), jnp.float32)
+    variables = m.init(jax.random.PRNGKey(1), x)
+    y = m.apply(variables, x)
+    x32 = np.asarray(x, np.float32)
+    ref = x32 / np.sqrt((x32 ** 2).mean(-1, keepdims=True) + 1e-5)
+    np.testing.assert_allclose(np.asarray(y), ref, rtol=2e-5, atol=2e-5)
+
+
+def test_mixed_fused_layer_norm_bf16_io():
+    """Mixed = half I/O, fp32 params + stats (reference: MixedFusedLayerNorm)."""
+    m = MixedFusedLayerNorm(normalized_shape=128, dtype=jnp.bfloat16)
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 128), jnp.float32)
+    variables = m.init(jax.random.PRNGKey(1), x)
+    assert variables["params"]["scale"].dtype == jnp.float32
+    y = m.apply(variables, x)
+    ref = _ref_ln(np.asarray(x), 1.0, 0.0)
+    np.testing.assert_allclose(np.asarray(y, np.float32), ref,
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_multidim_normalized_shape():
+    m = FusedLayerNorm(normalized_shape=(4, 32))
+    x = jax.random.normal(jax.random.PRNGKey(0), (6, 4, 32), jnp.float32)
+    variables = m.init(jax.random.PRNGKey(1), x)
+    y = m.apply(variables, x)
+    assert y.shape == x.shape
+    flat = np.asarray(x).reshape(6, 128)
+    scale = np.asarray(variables["params"]["scale"])
+    bias = np.asarray(variables["params"]["bias"])
+    ref = _ref_ln(flat, scale, bias).reshape(6, 4, 32)
+    np.testing.assert_allclose(np.asarray(y), ref, rtol=2e-5, atol=2e-5)
+
+
+def test_grad_flows():
+    m = FusedLayerNorm(normalized_shape=128)
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 128), jnp.float32)
+    variables = m.init(jax.random.PRNGKey(1), x)
+
+    def loss(v, x):
+        return jnp.sum(m.apply(v, x) ** 2)
+
+    g = jax.grad(loss)(variables, x)
+    assert np.isfinite(np.asarray(g["params"]["scale"])).all()
