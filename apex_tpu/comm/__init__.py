@@ -32,7 +32,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 __all__ = [
     "AXIS_DATA", "AXIS_MODEL", "AXIS_PIPE", "AXIS_EXPERT",
-    "make_mesh", "default_mesh", "get_mesh", "set_mesh", "axis_size",
+    "make_mesh", "default_mesh", "get_mesh", "set_mesh", "reset_mesh",
+    "axis_size",
     "all_reduce", "all_reduce_max", "all_gather", "reduce_scatter",
     "ppermute", "broadcast_from", "axis_index", "initialize_distributed",
 ]
@@ -91,6 +92,13 @@ def get_mesh() -> Mesh:
     if _MESH is None:
         _MESH = default_mesh()
     return _MESH
+
+
+def reset_mesh() -> None:
+    """Drop the installed mesh (parallel_state.destroy_model_parallel path);
+    the next get_mesh() lazily rebuilds the data-only default."""
+    global _MESH
+    _MESH = None
 
 
 def axis_size(axis_name: str, mesh: Optional[Mesh] = None) -> int:

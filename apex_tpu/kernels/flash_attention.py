@@ -1,0 +1,407 @@
+"""Blockwise (flash) attention Pallas kernels with custom VJP.
+
+TPU-native equivalent of the reference's fused attention extensions:
+- ``fast_multihead_attn`` (apex/contrib/csrc/multihead_attn/*.cu —
+  self_multihead_attn_forward/backward: strided-batched QKV GEMMs + fused
+  softmax) and
+- ``fmhalib`` (apex/contrib/csrc/fmha/fmha_api.cpp — varlen packed
+  flash-MHA for seqlen ≤ 512).
+
+Design (SURVEY §6 long-context note): the kernel is blockwise over KV with
+an online-softmax running (m, l) state, so a later ring-attention/context-
+parallel extension only has to rotate KV blocks between chips (ppermute)
+around the same inner kernel. Numerics follow the reference kernels: bf16/
+fp16 I/O allowed, all accumulation in fp32, logsumexp saved for backward.
+
+Layout: [batch, heads, seq, head_dim] (q, k, v). ``segment_ids`` gives the
+varlen/packed-sequence masking of fmhalib (tokens attend only within their
+segment). Unaligned shapes fall back to the jnp reference path, which XLA
+fuses acceptably — the Pallas path is the transformer hot path
+(seq % block == 0).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["flash_attention", "mha_reference"]
+
+_NEG_INF = -1e30
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+
+
+# --------------------------------------------------------------- jnp reference
+def mha_reference(q, k, v, *, causal: bool = False, scale: float = 1.0,
+                  segment_ids: Optional[jnp.ndarray] = None,
+                  mask: Optional[jnp.ndarray] = None):
+    """fp32-math reference (the oracle the reference's tests use a torch
+    softmax composition for)."""
+    out_dtype = q.dtype
+    q32, k32, v32 = (jnp.asarray(t, jnp.float32) for t in (q, k, v))
+    s = jnp.einsum("bhqd,bhkd->bhqk", q32, k32) * scale
+    sq, sk = s.shape[-2], s.shape[-1]
+    if causal:
+        s = jnp.where(jnp.tril(jnp.ones((sq, sk), bool)), s, _NEG_INF)
+    if segment_ids is not None:
+        seg_mask = segment_ids[:, None, :, None] == \
+            segment_ids[:, None, None, :]
+        s = jnp.where(seg_mask, s, _NEG_INF)
+    if mask is not None:
+        s = jnp.where(mask, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.asarray(jnp.einsum("bhqk,bhkd->bhqd", p, v32), out_dtype)
+
+
+# -------------------------------------------------------------- forward kernel
+def _fwd_kernel(q_ref, k_ref, v_ref, segq_ref, segk_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, *, scale, causal, block_q, block_k,
+                have_segs):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # causal: whole block above the diagonal → skip
+    run = True
+    if causal:
+        run = (qi * block_q + block_q - 1) >= (ki * block_k)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)          # [bq, d]
+        k = k_ref[0].astype(jnp.float32)          # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        if have_segs:
+            segq = segq_ref[0, 0, pl.ds(qi * block_q, block_q)]   # [bq]
+            segk = segk_ref[0, 0, pl.ds(ki * block_k, block_k)]   # [bk]
+            s = jnp.where(segq[:, None] == segk[None, :], s, _NEG_INF)
+
+        m_prev = m_ref[:, :1]                     # [bq, 1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                    # [bq, bk]
+        alpha = jnp.exp(m_prev - m_new)           # [bq, 1]
+        l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        l = l_ref[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        lse = m_ref[:, :1] + jnp.log(l_safe)
+        lse_ref[0, 0, pl.ds(qi * block_q, block_q)] = lse[:, 0]
+
+
+# ------------------------------------------------------------- backward kernels
+def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                     segq_ref, segk_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
+                     scale, causal, block_q, block_k, have_segs):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    run = True
+    if causal:
+        run = (qi * block_q + block_q - 1) >= (ki * block_k)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        if have_segs:
+            segq = segq_ref[0, 0, pl.ds(qi * block_q, block_q)]
+            segk = segk_ref[0, 0, pl.ds(ki * block_k, block_k)]
+            s = jnp.where(segq[:, None] == segk[None, :], s, _NEG_INF)
+        lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q)]
+        p = jnp.exp(s - lse[:, None])                 # [bq, bk]
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q)]
+        ds = p * (dp - delta[:, None]) * scale
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   segq_ref, segk_ref, dq_ref, dq_acc, *, scale, causal,
+                   block_q, block_k, have_segs):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    run = True
+    if causal:
+        run = (qi * block_q + block_q - 1) >= (ki * block_k)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        if have_segs:
+            segq = segq_ref[0, 0, pl.ds(qi * block_q, block_q)]
+            segk = segk_ref[0, 0, pl.ds(ki * block_k, block_k)]
+            s = jnp.where(segq[:, None] == segk[None, :], s, _NEG_INF)
+        lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q)]
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q)]
+        ds = p * (dp - delta[:, None]) * scale
+        dq_acc[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+# ------------------------------------------------------------------- dispatch
+def _flatten(q):
+    b, h, s, d = q.shape
+    return q.reshape(b * h, s, d)
+
+
+def _seg_flat(segment_ids, h):
+    # [b, s] -> [b*h, s]
+    return jnp.repeat(segment_ids, h, axis=0)
+
+
+def _pallas_ok(sq, sk, d, bq, bk):
+    # bk is the lane dim of the [bq, bk] score tile → multiple of 128;
+    # bq is the sublane dim → multiple of 8.
+    return (sq % bq == 0 and sk % bk == 0 and d % 8 == 0
+            and bq % 8 == 0 and bk % 128 == 0)
+
+
+def _fwd_pallas(q3, k3, v3, segq, segk, scale, causal, bq, bk, interpret):
+    bh, sq, d = q3.shape
+    sk = k3.shape[1]
+    have_segs = segq is not None
+    if not have_segs:
+        segq = jnp.zeros((bh, sq), jnp.int32)
+        segk = jnp.zeros((bh, sk), jnp.int32)
+    segq = segq.reshape(bh, 1, sq)
+    segk = segk.reshape(bh, 1, sk)
+    grid = (bh, sq // bq, sk // bk)
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               block_q=bq, block_k=bk, have_segs=have_segs)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, 1, sq), lambda b, i, j: (b, 0, 0)),
+            pl.BlockSpec((1, 1, sk), lambda b, i, j: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, sq), lambda b, i, j: (b, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
+            jax.ShapeDtypeStruct((bh, 1, sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3, segq, segk)
+    return o, lse
+
+
+def _bwd_pallas(q3, k3, v3, do3, o3, lse, segq, segk, scale, causal, bq, bk,
+                interpret):
+    bh, sq, d = q3.shape
+    sk = k3.shape[1]
+    have_segs = segq is not None
+    if not have_segs:
+        segq = jnp.zeros((bh, sq), jnp.int32)
+        segk = jnp.zeros((bh, sk), jnp.int32)
+    segq = segq.reshape(bh, 1, sq)
+    segk = segk.reshape(bh, 1, sk)
+    delta = jnp.sum(jnp.asarray(do3, jnp.float32) *
+                    jnp.asarray(o3, jnp.float32), axis=-1,
+                    keepdims=True).reshape(bh, 1, sq)
+
+    dkdv = pl.pallas_call(
+        functools.partial(_bwd_dkdv_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk, have_segs=have_segs),
+        grid=(bh, sk // bk, sq // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),   # q
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),   # k
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),   # v
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),   # do
+            pl.BlockSpec((1, 1, sq), lambda b, j, i: (b, 0, 0)),   # lse
+            pl.BlockSpec((1, 1, sq), lambda b, j, i: (b, 0, 0)),   # delta
+            pl.BlockSpec((1, 1, sq), lambda b, j, i: (b, 0, 0)),   # segq
+            pl.BlockSpec((1, 1, sk), lambda b, j, i: (b, 0, 0)),   # segk
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k3.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v3.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse, delta, segq, segk)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk, have_segs=have_segs),
+        grid=(bh, sq // bq, sk // bk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),   # q
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),   # k
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),   # v
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),   # do
+            pl.BlockSpec((1, 1, sq), lambda b, i, j: (b, 0, 0)),   # lse
+            pl.BlockSpec((1, 1, sq), lambda b, i, j: (b, 0, 0)),   # delta
+            pl.BlockSpec((1, 1, sq), lambda b, i, j: (b, 0, 0)),   # segq
+            pl.BlockSpec((1, 1, sk), lambda b, i, j: (b, 0, 0)),   # segk
+        ],
+        out_specs=[pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((bh, sq, d), q3.dtype)],
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse, delta, segq, segk)[0]
+
+    return dq, dkdv[0], dkdv[1]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, segment_ids, causal, scale, block_q, block_k, interpret):
+    out, _ = _flash_fwd(q, k, v, segment_ids, causal, scale, block_q,
+                        block_k, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, segment_ids, causal, scale, block_q, block_k,
+               interpret):
+    b, h, sq, d = q.shape
+    q3, k3, v3 = _flatten(q), _flatten(k), _flatten(v)
+    segq = segk = None
+    if segment_ids is not None:
+        segq = _seg_flat(segment_ids, h)
+        segk = segq
+    o3, lse = _fwd_pallas(q3, k3, v3, segq, segk, scale, causal, block_q,
+                          block_k, interpret)
+    out = o3.reshape(b, h, sq, d)
+    return out, (q3, k3, v3, o3, lse, segq, segk, b, h)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q3, k3, v3, o3, lse, segq, segk, b, h = res
+    do3 = _flatten(g)
+    dq3, dk3, dv3 = _bwd_pallas(q3, k3, v3, do3, o3, lse, segq, segk, scale,
+                                causal, block_q, block_k, interpret)
+    sq, d = q3.shape[1], q3.shape[2]
+    sk = k3.shape[1]
+    dq = dq3.reshape(b, h, sq, d)
+    dk = dk3.reshape(b, h, sk, d)
+    dv = dv3.reshape(b, h, sk, d)
+    return dq, dk, dv, None
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = False,
+                    scale: Optional[float] = None,
+                    segment_ids: Optional[jnp.ndarray] = None,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K,
+                    interpret: bool = False):
+    """Fused attention. q,k,v: [batch, heads, seq, head_dim].
+
+    ``segment_ids``: [batch, seq] int — varlen packing (fmhalib parity);
+    tokens attend only within equal segment ids. ``scale`` defaults to
+    1/sqrt(head_dim) (the reference kernels bake the same default).
+    """
+    d = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    sq, sk = q.shape[2], k.shape[2]
+    bq = min(block_q, sq)
+    bk = min(block_k, sk)
+    if not _pallas_ok(sq, sk, d, bq, bk):
+        return mha_reference(q, k, v, causal=causal, scale=scale,
+                             segment_ids=segment_ids)
+    if jax.default_backend() == "cpu":
+        interpret = True  # pallas-TPU lowering needs a TPU; CPU interprets
+    return _flash(q, k, v, segment_ids, causal, scale, bq, bk, interpret)

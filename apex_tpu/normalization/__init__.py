@@ -65,10 +65,12 @@ class FusedLayerNorm(nn.Module):
         orig_shape = x.shape
         x2 = x.reshape(x.shape[:x.ndim - len(shape)] + (hidden,))
         if self.elementwise_affine:
-            weight = self.param("scale", nn.initializers.ones, (hidden,),
-                                self.param_dtype)
-            bias = self.param("bias", nn.initializers.zeros, (hidden,),
-                              self.param_dtype)
+            # params keep apex's weight shape (= normalized_shape, matching
+            # apex FusedLayerNorm state_dicts); the kernel sees them flat.
+            weight = self.param("scale", nn.initializers.ones, shape,
+                                self.param_dtype).reshape(hidden)
+            bias = self.param("bias", nn.initializers.zeros, shape,
+                              self.param_dtype).reshape(hidden)
         else:
             weight = bias = None
         y = layer_norm(x2, weight, bias, eps=self.eps)
@@ -95,8 +97,8 @@ class FusedRMSNorm(nn.Module):
         orig_shape = x.shape
         x2 = x.reshape(x.shape[:x.ndim - len(shape)] + (hidden,))
         if self.elementwise_affine:
-            weight = self.param("scale", nn.initializers.ones, (hidden,),
-                                self.param_dtype)
+            weight = self.param("scale", nn.initializers.ones, shape,
+                                self.param_dtype).reshape(hidden)
         else:
             weight = None
         y = rms_norm(x2, weight, eps=self.eps)

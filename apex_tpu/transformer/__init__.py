@@ -1,0 +1,19 @@
+"""apex_tpu.transformer — Megatron-style model parallelism on a TPU mesh.
+
+Reference: apex/transformer/ (parallel_state, tensor_parallel,
+pipeline_parallel, functional). The process-group bookkeeping becomes a
+jax.sharding.Mesh with named axes; TP mappings become differentiable
+collectives (shard_map) or sharding constraints (pjit); PP becomes
+collective-permute pipelining over the ``pipe`` axis.
+"""
+
+from . import enums  # noqa: F401
+from . import functional  # noqa: F401
+from . import parallel_state  # noqa: F401
+from . import pipeline_parallel  # noqa: F401
+from . import tensor_parallel  # noqa: F401
+from .enums import AttnMaskType, AttnType, LayerType, ModelType  # noqa: F401
+
+__all__ = ["parallel_state", "tensor_parallel", "pipeline_parallel",
+           "functional", "enums", "AttnMaskType", "AttnType", "LayerType",
+           "ModelType"]

@@ -1,0 +1,7 @@
+"""Reference: apex/transformer/functional/ (fused_softmax)."""
+
+from .fused_softmax import (FusedScaleMaskSoftmax, scaled_masked_softmax,
+                            scaled_upper_triang_masked_softmax)
+
+__all__ = ["FusedScaleMaskSoftmax", "scaled_masked_softmax",
+           "scaled_upper_triang_masked_softmax"]

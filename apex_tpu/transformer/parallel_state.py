@@ -1,0 +1,183 @@
+"""Model-parallel state: mesh construction + rank/size accessors.
+
+Reference: apex/transformer/parallel_state.py — initialize_model_parallel
+builds torch.distributed process groups for data/tensor/pipeline/embedding
+parallelism from (tp, pp, vpp) sizes and keeps them in module globals with
+get_*_group/_rank/_world_size accessors.
+
+TPU design: there are no communicator objects to build — a
+``jax.sharding.Mesh`` with named axes IS the group structure, and XLA derives
+every "group" (the set of devices varying along one axis) from the axis name.
+So initialize_model_parallel constructs one mesh with axes
+``('data', 'pipe', 'model')`` (outermost-first: DP rides DCN across slices,
+TP stays on ICI neighbours — the analogue of apex nesting NCCL TP groups
+inside a node) and installs it via apex_tpu.comm.set_mesh. The accessors keep
+the reference's names so Megatron-style callers port unchanged; "rank in
+group" accessors are trace-time values (``jax.lax.axis_index``) when called
+inside shard_map, and host-side lookups otherwise.
+
+Virtual pipeline (interleaved 1F1B) carries no group state — it is a loop
+structure over model chunks (see pipeline_parallel.schedules) — so vpp here
+is just a recorded size, exactly like the reference's
+``_VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE`` global.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+
+from apex_tpu import comm
+from apex_tpu.comm import AXIS_DATA, AXIS_MODEL, AXIS_PIPE
+
+__all__ = [
+    "initialize_model_parallel", "model_parallel_is_initialized",
+    "destroy_model_parallel", "get_mesh",
+    "get_tensor_model_parallel_axis", "get_pipeline_model_parallel_axis",
+    "get_data_parallel_axis",
+    "get_tensor_model_parallel_world_size", "get_tensor_model_parallel_rank",
+    "get_pipeline_model_parallel_world_size",
+    "get_pipeline_model_parallel_rank",
+    "get_data_parallel_world_size", "get_data_parallel_rank",
+    "get_virtual_pipeline_model_parallel_world_size",
+    "get_virtual_pipeline_model_parallel_rank",
+    "set_virtual_pipeline_model_parallel_rank",
+    "is_pipeline_first_stage", "is_pipeline_last_stage",
+]
+
+_INITIALIZED = False
+_VPP_WORLD: Optional[int] = None
+_VPP_RANK: Optional[int] = None
+
+
+def initialize_model_parallel(
+        tensor_model_parallel_size_: int = 1,
+        pipeline_model_parallel_size_: int = 1,
+        virtual_pipeline_model_parallel_size_: Optional[int] = None,
+        *,
+        devices: Optional[Sequence] = None,
+        **_ignored):
+    """Build and install the global mesh.
+
+    Mirrors the reference signature (parallel_state.py —
+    initialize_model_parallel(tensor_model_parallel_size_,
+    pipeline_model_parallel_size_, virtual_pipeline_model_parallel_size_)).
+    Data-parallel size is derived: world // (tp * pp), reference behavior.
+    """
+    global _INITIALIZED, _VPP_WORLD, _VPP_RANK
+    devices = list(devices if devices is not None else jax.devices())
+    tp = int(tensor_model_parallel_size_)
+    pp = int(pipeline_model_parallel_size_)
+    world = len(devices)
+    if world % (tp * pp) != 0:
+        raise RuntimeError(
+            f"world size {world} not divisible by tp({tp}) * pp({pp})")
+    dp = world // (tp * pp)
+    mesh = comm.make_mesh({AXIS_DATA: dp, AXIS_PIPE: pp, AXIS_MODEL: tp},
+                          devices=devices)
+    comm.set_mesh(mesh)
+    _INITIALIZED = True
+    _VPP_WORLD = (int(virtual_pipeline_model_parallel_size_)
+                  if virtual_pipeline_model_parallel_size_ else None)
+    _VPP_RANK = 0 if _VPP_WORLD else None
+    return mesh
+
+
+def model_parallel_is_initialized() -> bool:
+    return _INITIALIZED
+
+
+def destroy_model_parallel():
+    """Reference: parallel_state.destroy_model_parallel resets globals."""
+    global _INITIALIZED, _VPP_WORLD, _VPP_RANK
+    comm.reset_mesh()
+    _INITIALIZED = False
+    _VPP_WORLD = None
+    _VPP_RANK = None
+
+
+def get_mesh():
+    return comm.get_mesh()
+
+
+# ------------------------------------------------------------------ axis names
+def get_tensor_model_parallel_axis() -> str:
+    return AXIS_MODEL
+
+
+def get_pipeline_model_parallel_axis() -> str:
+    return AXIS_PIPE
+
+
+def get_data_parallel_axis() -> str:
+    return AXIS_DATA
+
+
+# ------------------------------------------------------------------ sizes/ranks
+def _axis_size(name: str) -> int:
+    return comm.axis_size(name)
+
+
+def _axis_rank(name: str):
+    """Inside shard_map/pmap: the trace-time index along ``name``. Outside a
+    trace there is no meaningful per-device rank in a single-controller
+    runtime; return 0 (reference ranks are per-process because torch is
+    multi-controller)."""
+    try:
+        return jax.lax.axis_index(name)
+    except NameError:
+        return 0
+
+
+def get_tensor_model_parallel_world_size() -> int:
+    return _axis_size(AXIS_MODEL)
+
+
+def get_tensor_model_parallel_rank():
+    return _axis_rank(AXIS_MODEL)
+
+
+def get_pipeline_model_parallel_world_size() -> int:
+    return _axis_size(AXIS_PIPE)
+
+
+def get_pipeline_model_parallel_rank():
+    return _axis_rank(AXIS_PIPE)
+
+
+def get_data_parallel_world_size() -> int:
+    return _axis_size(AXIS_DATA)
+
+
+def get_data_parallel_rank():
+    return _axis_rank(AXIS_DATA)
+
+
+def get_virtual_pipeline_model_parallel_world_size():
+    return _VPP_WORLD
+
+
+def get_virtual_pipeline_model_parallel_rank():
+    return _VPP_RANK
+
+
+def set_virtual_pipeline_model_parallel_rank(rank):
+    global _VPP_RANK
+    _VPP_RANK = rank
+
+
+def is_pipeline_first_stage(ignore_virtual: bool = False):
+    """Trace-time predicate inside shard_map (jnp bool), host bool outside."""
+    if not ignore_virtual and _VPP_WORLD and (_VPP_RANK or 0) != 0:
+        return False
+    r = get_pipeline_model_parallel_rank()
+    return r == 0
+
+
+def is_pipeline_last_stage(ignore_virtual: bool = False):
+    if (not ignore_virtual and _VPP_WORLD
+            and (_VPP_RANK or 0) != _VPP_WORLD - 1):
+        return False
+    r = get_pipeline_model_parallel_rank()
+    return r == get_pipeline_model_parallel_world_size() - 1

@@ -1,0 +1,22 @@
+"""Pipeline parallelism (reference: apex/transformer/pipeline_parallel/)."""
+
+from .microbatches import build_num_microbatches_calculator
+from .p2p_communication import (send_backward, send_backward_recv_forward,
+                                send_forward, send_forward_recv_backward,
+                                shift_left, shift_right)
+from .schedules import (forward_backward_no_pipelining,
+                        forward_backward_pipelining_with_interleaving,
+                        forward_backward_pipelining_without_interleaving,
+                        get_forward_backward_func, make_pipeline_loss_fn,
+                        pipeline_apply)
+
+__all__ = [
+    "build_num_microbatches_calculator",
+    "send_forward", "send_backward", "send_forward_recv_backward",
+    "send_backward_recv_forward", "shift_right", "shift_left",
+    "pipeline_apply", "make_pipeline_loss_fn",
+    "forward_backward_no_pipelining",
+    "forward_backward_pipelining_without_interleaving",
+    "forward_backward_pipelining_with_interleaving",
+    "get_forward_backward_func",
+]

@@ -1,0 +1,97 @@
+"""Microbatch calculators.
+
+Reference: apex/transformer/pipeline_parallel/microbatches.py —
+build_num_microbatches_calculator, ConstantNumMicroBatches,
+RampupBatchsizeNumMicroBatches. Pure bookkeeping; ported semantics, no torch.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+__all__ = ["build_num_microbatches_calculator", "ConstantNumMicroBatches",
+           "RampupBatchsizeNumMicroBatches"]
+
+
+class ConstantNumMicroBatches:
+    def __init__(self, global_batch_size: int, micro_batch_size: int,
+                 data_parallel_size: int):
+        micro_times_dp = micro_batch_size * data_parallel_size
+        if global_batch_size % micro_times_dp != 0:
+            raise ValueError(
+                f"global batch {global_batch_size} not divisible by "
+                f"micro_batch*dp ({micro_times_dp})")
+        self.num_micro_batches = global_batch_size // micro_times_dp
+        self.current_global_batch_size = global_batch_size
+        self.micro_batch_size = micro_batch_size
+
+    def get(self):
+        return self.num_micro_batches
+
+    def get_current_global_batch_size(self):
+        return self.current_global_batch_size
+
+    def update(self, consumed_samples, consistency_check=True):
+        pass
+
+
+class RampupBatchsizeNumMicroBatches:
+    """Linear global-batch ramp: start → global over ramp_samples
+    (reference: RampupBatchsizeNumMicroBatches.update)."""
+
+    def __init__(self, start_batch_size: int, batch_size_increment: int,
+                 ramup_samples: int, global_batch_size: int,
+                 micro_batch_size: int, data_parallel_size: int):
+        self.micro_batch_size = micro_batch_size
+        self.data_parallel_size = data_parallel_size
+        self.start_batch_size = start_batch_size
+        self.batch_size_increment = batch_size_increment
+        self.ramup_samples = ramup_samples
+        self.global_batch_size = global_batch_size
+        self.micro_batch_times_data_parallel_size = (
+            micro_batch_size * data_parallel_size)
+        diff = global_batch_size - start_batch_size
+        if diff % batch_size_increment != 0:
+            raise ValueError("ramp range not divisible by increment")
+        num_increments = diff // batch_size_increment
+        self.rampup_samples_per_increment = (
+            self.ramup_samples / num_increments if num_increments else 0)
+        self.update(0, False)
+
+    def get(self):
+        return self.num_micro_batches
+
+    def get_current_global_batch_size(self):
+        return self.current_global_batch_size
+
+    def update(self, consumed_samples, consistency_check=True):
+        if consumed_samples > self.ramup_samples:
+            self.current_global_batch_size = self.global_batch_size
+        else:
+            steps = int(consumed_samples / self.rampup_samples_per_increment) \
+                if self.rampup_samples_per_increment else 0
+            self.current_global_batch_size = min(
+                self.global_batch_size,
+                self.start_batch_size + steps * self.batch_size_increment)
+        if consistency_check and (self.current_global_batch_size %
+                                  self.micro_batch_times_data_parallel_size):
+            raise ValueError("current global batch not divisible by micro*dp")
+        self.num_micro_batches = (self.current_global_batch_size //
+                                  self.micro_batch_times_data_parallel_size)
+
+
+def build_num_microbatches_calculator(
+        rank: int = 0,
+        rampup_batch_size: Optional[Sequence[int]] = None,
+        global_batch_size: int = 1,
+        micro_batch_size: int = 1,
+        data_parallel_size: int = 1):
+    if rampup_batch_size is None:
+        return ConstantNumMicroBatches(global_batch_size, micro_batch_size,
+                                       data_parallel_size)
+    start, incr, samples = (int(rampup_batch_size[0]),
+                            int(rampup_batch_size[1]),
+                            int(rampup_batch_size[2]))
+    return RampupBatchsizeNumMicroBatches(
+        start, incr, samples, global_batch_size, micro_batch_size,
+        data_parallel_size)

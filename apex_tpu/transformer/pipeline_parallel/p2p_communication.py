@@ -1,0 +1,68 @@
+"""Stage-to-stage activation transfer.
+
+Reference: apex/transformer/pipeline_parallel/p2p_communication.py —
+_communicate builds torch.distributed batched isend/irecv between pipeline
+ranks, with shape pre-exchange; send_forward / recv_forward / send_backward /
+recv_backward / combined variants wrap it.
+
+TPU design: there is no user-level P2P — the primitive is
+``jax.lax.ppermute`` over the ``pipe`` mesh axis (a collective-permute rides
+ICI directly). Because XLA programs are SPMD, "send to next stage" and
+"receive from previous stage" are ONE op executed by all ranks, so the
+send/recv split of the reference collapses: ``send_forward`` IS
+``recv_forward`` on the other end. Shapes are static under jit, so the
+reference's tensor-shape pre-exchange has no equivalent. These wrappers exist
+so schedule code and ported Megatron code keep their vocabulary; the real
+schedule (schedules.py) calls them inside shard_map.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from apex_tpu.comm import AXIS_PIPE, axis_size
+
+__all__ = ["send_forward", "send_backward", "send_forward_recv_backward",
+           "send_backward_recv_forward", "shift_right", "shift_left"]
+
+
+def _ring_perm(n: int, step: int):
+    return [(i, (i + step) % n) for i in range(n)]
+
+
+def shift_right(x, axis_name: str = AXIS_PIPE, n: Optional[int] = None):
+    """Move each stage's value to the NEXT stage (forward activations).
+    Stage 0 receives stage n-1's value (callers mask it or feed fresh
+    microbatches there)."""
+    n = n if n is not None else axis_size(axis_name)
+    return jax.lax.ppermute(x, axis_name, _ring_perm(n, +1))
+
+
+def shift_left(x, axis_name: str = AXIS_PIPE, n: Optional[int] = None):
+    """Move each stage's value to the PREVIOUS stage (backward grads)."""
+    n = n if n is not None else axis_size(axis_name)
+    return jax.lax.ppermute(x, axis_name, _ring_perm(n, -1))
+
+
+# Reference-vocabulary aliases. In SPMD one collective is both sides.
+def send_forward(output_tensor, axis_name: str = AXIS_PIPE):
+    """= recv_forward on the next stage."""
+    return shift_right(output_tensor, axis_name)
+
+
+def send_backward(input_tensor_grad, axis_name: str = AXIS_PIPE):
+    """= recv_backward on the previous stage."""
+    return shift_left(input_tensor_grad, axis_name)
+
+
+def send_forward_recv_backward(output_tensor, axis_name: str = AXIS_PIPE):
+    """In SPMD both directions are independent collectives; autodiff of
+    shift_right already produces the shift_left of grads, so the fused
+    send/recv pairs of the reference are only needed as vocabulary."""
+    return shift_right(output_tensor, axis_name)
+
+
+def send_backward_recv_forward(input_tensor_grad, axis_name: str = AXIS_PIPE):
+    return shift_left(input_tensor_grad, axis_name)

@@ -1,0 +1,223 @@
+"""Pipeline-parallel schedules.
+
+Reference: apex/transformer/pipeline_parallel/schedules/ —
+forward_backward_no_pipelining, forward_backward_pipelining_without_
+interleaving (1F1B: warmup/steady/cooldown over torch.distributed P2P),
+forward_backward_pipelining_with_interleaving (virtual stages), selected by
+get_forward_backward_func.
+
+TPU design — collective-permute pipelining. The reference hand-schedules
+1F1B because torch autograd is eager and NCCL P2P must be interleaved by
+hand. Under XLA the whole pipeline is ONE program: microbatches flow through
+stages via ``ppermute`` over the ``pipe`` axis inside ``lax.scan``, and the
+BACKWARD schedule is derived by autodiff (the transpose of a ppermute scan is
+the reversed-perm scan — exactly the cooldown/steady/warmup mirror), with
+XLA's latency-hiding scheduler overlapping the permutes with compute. Memory
+behavior matches GPipe fill-drain; wrap ``stage_fn`` in ``jax.checkpoint``
+(tensor_parallel.random.checkpoint) to get the activation-memory profile the
+reference gets from its schedule.
+
+Interleaving (virtual pipeline): each device holds ``v`` model chunks;
+logical stage ``s = chunk * pp + rank`` (the reference's round-robin model
+split). The carry holds ``v`` in-flight buffers; each tick applies every
+local chunk and rotates, promoting a buffer to the next chunk when it wraps
+past the last device.
+
+The stage functions here are FUNCTIONAL: ``stage_fn(chunk_params, x) -> y``
+with identical activation shapes at every boundary (the reference has the
+same constraint — tensor_shape is fixed in its _communicate).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.comm import AXIS_PIPE
+
+__all__ = ["pipeline_apply", "make_pipeline_loss_fn",
+           "forward_backward_no_pipelining",
+           "forward_backward_pipelining_without_interleaving",
+           "forward_backward_pipelining_with_interleaving",
+           "get_forward_backward_func"]
+
+
+def _chunk(tree, c):
+    return jax.tree_util.tree_map(lambda l: l[c], tree)
+
+
+def _pipe_scan(stage_fn, local_chunks, microbatches, *, axis_name: str,
+               num_stages: int, num_chunks: int):
+    """Run the rotation; returns per-tick last-chunk outputs [T, ...] (the
+    finished-microbatch stream on the last stage) and T."""
+    rank = jax.lax.axis_index(axis_name)
+    M = microbatches.shape[0]
+    L = num_stages * num_chunks
+    T = M + L - 1
+
+    x0 = jnp.zeros_like(microbatches[0])
+    bufs0 = jnp.stack([x0] * num_chunks)  # [v, ...] in-flight buffers
+
+    def tick(bufs, t):
+        # stage 0 (device 0, chunk 0) consumes the microbatch stream at
+        # compute time; drain ticks re-feed the last microbatch harmlessly
+        # (those copies never reach the last logical stage within T ticks).
+        fresh = microbatches[jnp.clip(t, 0, M - 1)]
+        x0 = jnp.where(rank == 0, fresh, bufs[0])
+        xs = bufs.at[0].set(x0)
+        ys = jnp.stack([
+            stage_fn(_chunk(local_chunks, c) if num_chunks > 1
+                     else local_chunks, xs[c])
+            for c in range(num_chunks)])
+        shifted = jax.lax.ppermute(
+            ys, axis_name, [(i, (i + 1) % num_stages)
+                            for i in range(num_stages)])
+        # device 0: buffer c+1 is promoted from chunk c leaving the last
+        # device (roll); its buffer 0 slot is dead — overwritten by the
+        # stream next tick. other devices: same chunk, previous device.
+        bufs_next = jnp.where(rank == 0, jnp.roll(shifted, 1, axis=0),
+                              shifted)
+        return bufs_next, ys[num_chunks - 1]
+
+    _, outs = jax.lax.scan(tick, bufs0, jnp.arange(T))
+    return outs, T
+
+
+def pipeline_apply(stage_fn: Callable, local_chunks, microbatches, *,
+                   axis_name: str = AXIS_PIPE, num_stages: int,
+                   num_chunks: int = 1, broadcast: bool = True):
+    """Forward the microbatch stream [M, ...] through the pipeline; returns
+    outputs [M, ...]. Valid natively on the last stage; with ``broadcast``
+    the outputs are psum-replicated to every stage (zeros elsewhere + psum).
+    """
+    rank = jax.lax.axis_index(axis_name)
+    M = microbatches.shape[0]
+    L = num_stages * num_chunks
+    outs, _ = _pipe_scan(stage_fn, local_chunks, microbatches,
+                         axis_name=axis_name, num_stages=num_stages,
+                         num_chunks=num_chunks)
+    outs = outs[L - 1:]  # microbatch m finishes at tick m + L - 1
+    if broadcast:
+        is_last = (rank == num_stages - 1)
+        masked = jnp.where(is_last, outs, jnp.zeros_like(outs))
+        # value-only broadcast: psum under stop_gradient so the transpose
+        # doesn't multiply the (replicated) cotangent by num_stages; the
+        # grad path stays the local masked term.
+        outs = masked + jax.lax.stop_gradient(
+            jax.lax.psum(masked, axis_name) - masked)
+    return outs
+
+
+def make_pipeline_loss_fn(stage_fn: Callable, loss_fn: Callable, *,
+                          axis_name: str = AXIS_PIPE, num_stages: int,
+                          num_chunks: int = 1):
+    """Build ``fn(local_chunks, (microbatches, targets)) -> scalar loss``.
+
+    This is the composition point with apex_tpu.amp.make_train_step: the
+    pipelined model becomes an ordinary loss function whose params are the
+    stage-local chunk stack (shard params [L, ...] over the pipe axis with
+    in_spec P('pipe') and they arrive here as [v, ...]).
+
+    ``loss_fn(output, target) -> scalar`` (per-microbatch mean).
+    """
+
+    def fn(local_chunks, batch):
+        microbatches, targets = batch
+        rank = jax.lax.axis_index(axis_name)
+        M = microbatches.shape[0]
+        L = num_stages * num_chunks
+        outs, T = _pipe_scan(stage_fn, local_chunks, microbatches,
+                             axis_name=axis_name, num_stages=num_stages,
+                             num_chunks=num_chunks)
+
+        def per_tick(t):
+            m = jnp.clip(t - (L - 1), 0, M - 1)
+            l = loss_fn(outs[t], targets[m])
+            valid = (t >= L - 1) & (rank == num_stages - 1)
+            return jnp.where(valid, l, 0.0)
+
+        total = jnp.sum(jax.vmap(per_tick)(jnp.arange(T)))
+        # replicate the scalar across stages so every rank's train step sees
+        # the same loss (grads for other stages' params flow via ppermute's
+        # transpose regardless). The psum is value-only (stop_gradient):
+        # under check_rep=False its transpose would psum the replicated
+        # cotangent and scale every grad by num_stages.
+        total = total + jax.lax.stop_gradient(
+            jax.lax.psum(total, axis_name) - total)
+        return total / M
+
+    return fn
+
+
+# ------------------------------------------------------- reference-shaped API
+def forward_backward_no_pipelining(loss_fn, params, microbatches, targets,
+                                   grad: bool = True):
+    """Grad accumulation over microbatches, no pipe axis (reference:
+    schedules/fwd_bwd_no_pipelining.py). ``loss_fn(params, mb, tgt)``."""
+
+    def body(carry, mt):
+        mb, tgt = mt
+        if grad:
+            l, g = jax.value_and_grad(loss_fn)(params, mb, tgt)
+        else:
+            l, g = loss_fn(params, mb, tgt), None
+        loss_acc, grad_acc = carry
+        if grad:
+            grad_acc = jax.tree_util.tree_map(jnp.add, grad_acc, g)
+        return (loss_acc + l, grad_acc), None
+
+    M = microbatches.shape[0]
+    zero_g = jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, jnp.result_type(p)), params)
+    (loss, grads), _ = jax.lax.scan(body, (0.0, zero_g),
+                                    (microbatches, targets))
+    if grad:
+        grads = jax.tree_util.tree_map(lambda g: g / M, grads)
+        return loss / M, grads
+    return loss / M
+
+
+def forward_backward_pipelining_without_interleaving(
+        stage_fn, loss_fn, local_params, microbatches, targets, *,
+        axis_name: str = AXIS_PIPE, num_stages: int, grad: bool = True):
+    """1F1B-equivalent (reference: schedules/fwd_bwd_pipelining_without_
+    interleaving.py). Must run inside shard_map with the pipe axis bound."""
+    pl = make_pipeline_loss_fn(stage_fn, loss_fn, axis_name=axis_name,
+                               num_stages=num_stages, num_chunks=1)
+    if grad:
+        return jax.value_and_grad(pl)(local_params, (microbatches, targets))
+    return pl(local_params, (microbatches, targets))
+
+
+def forward_backward_pipelining_with_interleaving(
+        stage_fn, loss_fn, local_chunks, microbatches, targets, *,
+        axis_name: str = AXIS_PIPE, num_stages: int, num_chunks: int,
+        grad: bool = True):
+    """Interleaved virtual-pipeline schedule (reference:
+    schedules/fwd_bwd_pipelining_with_interleaving.py)."""
+    pl = make_pipeline_loss_fn(stage_fn, loss_fn, axis_name=axis_name,
+                               num_stages=num_stages, num_chunks=num_chunks)
+    if grad:
+        return jax.value_and_grad(pl)(local_chunks, (microbatches, targets))
+    return pl(local_chunks, (microbatches, targets))
+
+
+def get_forward_backward_func(
+        virtual_pipeline_model_parallel_size: Optional[int] = None,
+        pipeline_model_parallel_size: int = 1):
+    """Reference: schedules/__init__.py — get_forward_backward_func picks the
+    schedule from (vpp, pp)."""
+    if pipeline_model_parallel_size > 1:
+        if virtual_pipeline_model_parallel_size is not None \
+                and virtual_pipeline_model_parallel_size > 1:
+            return functools.partial(
+                forward_backward_pipelining_with_interleaving,
+                num_stages=pipeline_model_parallel_size,
+                num_chunks=virtual_pipeline_model_parallel_size)
+        return functools.partial(
+            forward_backward_pipelining_without_interleaving,
+            num_stages=pipeline_model_parallel_size)
+    return forward_backward_no_pipelining

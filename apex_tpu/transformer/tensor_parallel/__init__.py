@@ -1,0 +1,37 @@
+"""Tensor parallelism (reference: apex/transformer/tensor_parallel/)."""
+
+from .cross_entropy import vocab_parallel_cross_entropy
+from .layers import (ColumnParallelLinear, RowParallelLinear,
+                     VocabParallelEmbedding,
+                     linear_with_grad_accumulation_and_async_allreduce)
+from .mappings import (
+    copy_to_tensor_model_parallel_region,
+    gather_from_sequence_parallel_region,
+    gather_from_tensor_model_parallel_region,
+    reduce_from_tensor_model_parallel_region,
+    reduce_scatter_to_sequence_parallel_region,
+    scatter_to_sequence_parallel_region,
+    scatter_to_tensor_model_parallel_region,
+)
+from .random import (RNGStatesTracker, checkpoint, get_cuda_rng_tracker,
+                     get_rng_tracker, model_parallel_cuda_manual_seed,
+                     model_parallel_manual_seed)
+from .utils import (VocabUtility, divide, ensure_divisibility,
+                    split_tensor_along_last_dim)
+
+__all__ = [
+    "ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding",
+    "linear_with_grad_accumulation_and_async_allreduce",
+    "vocab_parallel_cross_entropy",
+    "copy_to_tensor_model_parallel_region",
+    "reduce_from_tensor_model_parallel_region",
+    "scatter_to_tensor_model_parallel_region",
+    "gather_from_tensor_model_parallel_region",
+    "scatter_to_sequence_parallel_region",
+    "reduce_scatter_to_sequence_parallel_region",
+    "gather_from_sequence_parallel_region",
+    "VocabUtility", "divide", "ensure_divisibility",
+    "split_tensor_along_last_dim",
+    "RNGStatesTracker", "get_rng_tracker", "model_parallel_manual_seed",
+    "checkpoint", "get_cuda_rng_tracker", "model_parallel_cuda_manual_seed",
+]

@@ -1,0 +1,230 @@
+"""Megatron-style tensor-parallel layers.
+
+Reference: apex/transformer/tensor_parallel/layers.py — ColumnParallelLinear,
+RowParallelLinear, VocabParallelEmbedding,
+linear_with_grad_accumulation_and_async_allreduce.
+
+TPU design: flax modules that hold the SHARD-LOCAL parameter (out//tp or
+in//tp) and are meant to run inside shard_map over the ``model`` axis; the
+differentiable collectives come from mappings.py. Under plain pjit/GSPMD the
+same math needs only PartitionSpec annotations — each module exposes its
+sharding via ``kernel_partition_spec()`` for that path. The reference's async
+allreduce-overlapped-with-wgrad trick (linear_with_grad_accumulation_and_
+async_allreduce) is XLA's latency-hiding scheduler's job here; the function
+exists for API parity and simply does the math.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec
+
+from apex_tpu import comm
+from apex_tpu.comm import AXIS_MODEL
+from .mappings import (
+    copy_to_tensor_model_parallel_region,
+    gather_from_sequence_parallel_region,
+    gather_from_tensor_model_parallel_region,
+    reduce_from_tensor_model_parallel_region,
+    reduce_scatter_to_sequence_parallel_region,
+)
+from .utils import divide
+
+__all__ = ["ColumnParallelLinear", "RowParallelLinear",
+           "VocabParallelEmbedding",
+           "linear_with_grad_accumulation_and_async_allreduce"]
+
+
+def _maybe_axis_index(axis_name: str):
+    """Rank along ``axis_name`` when bound (inside shard_map), else 0."""
+    try:
+        return jax.lax.axis_index(axis_name)
+    except NameError:
+        return 0
+
+
+def _sharded_init(init: Callable, axis_name: str):
+    """Fold the TP rank into the rng so shards draw independent weights —
+    the reference initializes the full weight and scatters
+    (layers.py — _initialize_affine_weight_gpu uses the TP rng tracker)."""
+
+    def wrapped(key, shape, dtype):
+        idx = _maybe_axis_index(axis_name)
+        key = jax.random.fold_in(key, idx) if not isinstance(idx, int) else key
+        return init(key, shape, dtype)
+
+    return wrapped
+
+
+class ColumnParallelLinear(nn.Module):
+    """Y = XA + b with A split column-wise: A = [A_1 .. A_p].
+
+    Reference: tensor_parallel/layers.py — class ColumnParallelLinear.
+    Input is replicated over the TP group (or sequence-sharded when
+    ``sequence_parallel_enabled``); output is the local column block unless
+    ``gather_output``.
+    """
+
+    input_size: int
+    output_size: int
+    use_bias: bool = True
+    gather_output: bool = False
+    sequence_parallel_enabled: bool = False
+    axis_name: str = AXIS_MODEL
+    world_size: Optional[int] = None
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    kernel_init: Callable = nn.initializers.lecun_normal()
+    bias_init: Callable = nn.initializers.zeros
+
+    def _world(self) -> int:
+        return (self.world_size if self.world_size is not None
+                else comm.axis_size(self.axis_name))
+
+    @nn.compact
+    def __call__(self, x):
+        world = self._world()
+        out_local = divide(self.output_size, world)
+        kernel = self.param("kernel",
+                            _sharded_init(self.kernel_init, self.axis_name),
+                            (self.input_size, out_local), self.param_dtype)
+        if self.sequence_parallel_enabled and world > 1:
+            # SP: activations arrive sequence-sharded; the all-gather here is
+            # the fwd half of the split TP all-reduce (mappings — SP pair).
+            x = gather_from_sequence_parallel_region(x, self.axis_name, 0)
+        elif world > 1:
+            x = copy_to_tensor_model_parallel_region(x, self.axis_name)
+        y = jnp.dot(jnp.asarray(x, self.dtype),
+                    jnp.asarray(kernel, self.dtype))
+        if self.use_bias:
+            bias = self.param("bias", self.bias_init, (out_local,),
+                              self.param_dtype)
+            y = y + jnp.asarray(bias, self.dtype)
+        if self.gather_output and world > 1:
+            y = gather_from_tensor_model_parallel_region(y, self.axis_name, -1)
+        return y
+
+    def kernel_partition_spec(self) -> PartitionSpec:
+        return PartitionSpec(None, self.axis_name)
+
+
+class RowParallelLinear(nn.Module):
+    """Y = XA + b with A split row-wise; local matmuls partial-summed by an
+    all-reduce (or reduce-scatter under SP).
+
+    Reference: tensor_parallel/layers.py — class RowParallelLinear. Bias is
+    added AFTER the reduction (on the full sum), as the reference does.
+    """
+
+    input_size: int
+    output_size: int
+    use_bias: bool = True
+    input_is_parallel: bool = True
+    sequence_parallel_enabled: bool = False
+    axis_name: str = AXIS_MODEL
+    world_size: Optional[int] = None
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    kernel_init: Callable = nn.initializers.lecun_normal()
+    bias_init: Callable = nn.initializers.zeros
+
+    def _world(self) -> int:
+        return (self.world_size if self.world_size is not None
+                else comm.axis_size(self.axis_name))
+
+    @nn.compact
+    def __call__(self, x):
+        world = self._world()
+        in_local = divide(self.input_size, world)
+        kernel = self.param("kernel",
+                            _sharded_init(self.kernel_init, self.axis_name),
+                            (in_local, self.output_size), self.param_dtype)
+        if not self.input_is_parallel and world > 1:
+            from .mappings import scatter_to_tensor_model_parallel_region
+            x = scatter_to_tensor_model_parallel_region(x, self.axis_name, -1)
+        y = jnp.dot(jnp.asarray(x, self.dtype),
+                    jnp.asarray(kernel, self.dtype))
+        if world > 1:
+            if self.sequence_parallel_enabled:
+                y = reduce_scatter_to_sequence_parallel_region(
+                    y, self.axis_name, 0)
+            else:
+                y = reduce_from_tensor_model_parallel_region(y, self.axis_name)
+        if self.use_bias:
+            bias = self.param("bias", self.bias_init, (self.output_size,),
+                              self.param_dtype)
+            y = y + jnp.asarray(bias, self.dtype)
+        return y
+
+    def kernel_partition_spec(self) -> PartitionSpec:
+        return PartitionSpec(self.axis_name, None)
+
+
+class VocabParallelEmbedding(nn.Module):
+    """Embedding with the vocab dim sharded over the TP group.
+
+    Reference: tensor_parallel/layers.py — class VocabParallelEmbedding:
+    mask ids outside the local [first, last) range, look up with the offset
+    subtracted, zero the masked rows, all-reduce the partial embeddings.
+    """
+
+    num_embeddings: int
+    embedding_dim: int
+    axis_name: str = AXIS_MODEL
+    world_size: Optional[int] = None
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    embedding_init: Callable = nn.initializers.normal(stddev=0.02)
+
+    def _world(self) -> int:
+        return (self.world_size if self.world_size is not None
+                else comm.axis_size(self.axis_name))
+
+    @nn.compact
+    def __call__(self, ids):
+        world = self._world()
+        vocab_local = divide(self.num_embeddings, world)
+        table = self.param("embedding",
+                           _sharded_init(self.embedding_init, self.axis_name),
+                           (vocab_local, self.embedding_dim),
+                           self.param_dtype)
+        table = jnp.asarray(table, self.dtype)
+        if world == 1:
+            return jnp.take(table, ids, axis=0)
+        rank = _maybe_axis_index(self.axis_name)
+        first = rank * vocab_local
+        local = ids - first
+        in_range = (local >= 0) & (local < vocab_local)
+        safe = jnp.where(in_range, local, 0)
+        out = jnp.take(table, safe, axis=0)
+        out = jnp.where(in_range[..., None], out, jnp.zeros_like(out))
+        return reduce_from_tensor_model_parallel_region(out, self.axis_name)
+
+    def kernel_partition_spec(self) -> PartitionSpec:
+        return PartitionSpec(self.axis_name, None)
+
+
+def linear_with_grad_accumulation_and_async_allreduce(
+        x, weight, bias=None, gradient_accumulation_fusion: bool = False,
+        async_grad_allreduce: bool = False,
+        sequence_parallel_enabled: bool = False,
+        axis_name: str = AXIS_MODEL):
+    """API-parity shim (reference: layers.py —
+    linear_with_grad_accumulation_and_async_allreduce / class
+    LinearWithGradAccumulationAndAsyncCommunication). On TPU the
+    wgrad/allreduce overlap and the fp32 grad accumulation are XLA's
+    latency-hiding scheduler's and donation's job; the semantics reduce to:
+    gather under SP, matmul, and — when async_grad_allreduce — the identity-
+    fwd/psum-bwd mapping on the input."""
+    if sequence_parallel_enabled:
+        x = gather_from_sequence_parallel_region(x, axis_name, 0)
+    elif async_grad_allreduce:
+        x = copy_to_tensor_model_parallel_region(x, axis_name)
+    y = jnp.dot(x, weight)
+    if bias is not None:
+        y = y + bias
+    return y

@@ -1,0 +1,176 @@
+"""Differentiable TP collective "mappings".
+
+Reference: apex/transformer/tensor_parallel/mappings.py — the four Megatron
+autograd pairs (_CopyToModelParallelRegion, _ReduceFromModelParallelRegion,
+_ScatterToModelParallelRegion, _GatherFromModelParallelRegion) plus the
+sequence-parallel pair (reduce_scatter_to_sequence_parallel_region /
+gather_from_sequence_parallel_region, vintage >=2022).
+
+TPU design: each pair is a jax.custom_vjp whose forward/backward are the dual
+collectives over the named ``model`` axis; they are meaningful inside
+shard_map (where the axis is bound) — under plain pjit/GSPMD these mappings
+collapse into sharding constraints and are not needed, which is the idiomatic
+default path (SURVEY §3.3). All functions take the values shard-local.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.comm import AXIS_MODEL
+
+__all__ = [
+    "copy_to_tensor_model_parallel_region",
+    "reduce_from_tensor_model_parallel_region",
+    "scatter_to_tensor_model_parallel_region",
+    "gather_from_tensor_model_parallel_region",
+    "scatter_to_sequence_parallel_region",
+    "reduce_scatter_to_sequence_parallel_region",
+    "gather_from_sequence_parallel_region",
+]
+
+
+# --------------------------------------------------------- identity fwd / psum bwd
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def copy_to_tensor_model_parallel_region(x, axis_name: str = AXIS_MODEL):
+    """f: identity; df: all-reduce. Placed where a replicated activation
+    enters a column-parallel matmul (reference — _CopyToModelParallelRegion).
+    """
+    return x
+
+
+def _copy_fwd(x, axis_name):
+    return x, None
+
+
+def _copy_bwd(axis_name, _, g):
+    return (jax.lax.psum(g, axis_name),)
+
+
+copy_to_tensor_model_parallel_region.defvjp(_copy_fwd, _copy_bwd)
+
+
+# --------------------------------------------------------- psum fwd / identity bwd
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def reduce_from_tensor_model_parallel_region(x, axis_name: str = AXIS_MODEL):
+    """f: all-reduce; df: identity. Output of a row-parallel matmul
+    (reference — _ReduceFromModelParallelRegion)."""
+    return jax.lax.psum(x, axis_name)
+
+
+def _reduce_fwd(x, axis_name):
+    return jax.lax.psum(x, axis_name), None
+
+
+def _reduce_bwd(axis_name, _, g):
+    return (g,)
+
+
+reduce_from_tensor_model_parallel_region.defvjp(_reduce_fwd, _reduce_bwd)
+
+
+# --------------------------------------------------------- split fwd / gather bwd
+def _local_slice(x, axis_name, axis):
+    rank = jax.lax.axis_index(axis_name)
+    world = jax.lax.psum(1, axis_name)
+    chunk = x.shape[axis] // world
+    return jax.lax.dynamic_slice_in_dim(x, rank * chunk, chunk, axis=axis)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def scatter_to_tensor_model_parallel_region(x, axis_name: str = AXIS_MODEL,
+                                            axis: int = -1):
+    """f: keep own last-dim slice; df: all-gather
+    (reference — _ScatterToModelParallelRegion)."""
+    return _local_slice(x, axis_name, axis if axis >= 0 else x.ndim + axis)
+
+
+def _scatter_fwd(x, axis_name, axis):
+    a = axis if axis >= 0 else x.ndim + axis
+    return _local_slice(x, axis_name, a), None
+
+
+def _scatter_bwd(axis_name, axis, _, g):
+    a = axis if axis >= 0 else g.ndim + axis
+    return (jax.lax.all_gather(g, axis_name, axis=a, tiled=True),)
+
+
+scatter_to_tensor_model_parallel_region.defvjp(_scatter_fwd, _scatter_bwd)
+
+
+# --------------------------------------------------------- gather fwd / split bwd
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def gather_from_tensor_model_parallel_region(x, axis_name: str = AXIS_MODEL,
+                                             axis: int = -1):
+    """f: all-gather along ``axis``; df: keep own slice
+    (reference — _GatherFromModelParallelRegion)."""
+    a = axis if axis >= 0 else x.ndim + axis
+    return jax.lax.all_gather(x, axis_name, axis=a, tiled=True)
+
+
+def _gather_fwd(x, axis_name, axis):
+    a = axis if axis >= 0 else x.ndim + axis
+    return jax.lax.all_gather(x, axis_name, axis=a, tiled=True), None
+
+
+def _gather_bwd(axis_name, axis, _, g):
+    a = axis if axis >= 0 else g.ndim + axis
+    return (_local_slice(g, axis_name, a),)
+
+
+gather_from_tensor_model_parallel_region.defvjp(_gather_fwd, _gather_bwd)
+
+
+# ------------------------------------------------------------ sequence parallel
+def scatter_to_sequence_parallel_region(x, axis_name: str = AXIS_MODEL,
+                                        axis: int = 0):
+    """Split along the sequence dim over the TP group (embedding output →
+    SP region). bwd: all-gather. Same pair as scatter_to_…(axis=seq)."""
+    return scatter_to_tensor_model_parallel_region(x, axis_name, axis)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def reduce_scatter_to_sequence_parallel_region(x, axis_name: str = AXIS_MODEL,
+                                               axis: int = 0):
+    """f: reduce-scatter along sequence dim; df: all-gather. This is the SP
+    split of the TP all-reduce (reference mappings.py —
+    _ReduceScatterToSequenceParallelRegion); fwd+bwd together cost the same
+    bytes as one all-reduce, the Megatron-SP trick."""
+    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=axis,
+                                tiled=True)
+
+
+def _rs_fwd(x, axis_name, axis):
+    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=axis,
+                                tiled=True), None
+
+
+def _rs_bwd(axis_name, axis, _, g):
+    return (jax.lax.all_gather(g, axis_name, axis=axis, tiled=True),)
+
+
+reduce_scatter_to_sequence_parallel_region.defvjp(_rs_fwd, _rs_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def gather_from_sequence_parallel_region(x, axis_name: str = AXIS_MODEL,
+                                         axis: int = 0):
+    """f: all-gather along sequence dim; df: reduce-scatter (reference —
+    _GatherFromSequenceParallelRegion with tensor_parallel_output_grad=True).
+    """
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=True)
+
+
+def _gs_fwd(x, axis_name, axis):
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=True), None
+
+
+def _gs_bwd(axis_name, axis, _, g):
+    return (jax.lax.psum_scatter(g, axis_name, scatter_dimension=axis,
+                                 tiled=True),)
+
+
+gather_from_sequence_parallel_region.defvjp(_gs_fwd, _gs_bwd)

@@ -1,0 +1,103 @@
+"""TP-aware RNG + activation checkpointing.
+
+Reference: apex/transformer/tensor_parallel/random.py — class
+CudaRNGStatesTracker (named CUDA RNG streams; the 'model-parallel-rng' stream
+is seeded differently per TP rank so dropout masks differ across TP shards),
+``model_parallel_cuda_manual_seed``, and ``checkpoint`` (activation
+checkpointing that snapshots/restores both RNG streams so recompute replays
+identical dropout).
+
+TPU design: JAX PRNG is functional — keys are values, not device state — so
+the whole "fork and restore RNG state" problem the reference solves
+disappears: ``jax.checkpoint`` replays dropout bit-identically because the
+key is an argument. What remains worth keeping is the *naming* structure:
+a tracker mapping stream names to keys, with the model-parallel stream
+offset by TP rank (reference offsets seed by
+``get_tensor_model_parallel_rank() * 2718``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.comm import AXIS_MODEL
+
+__all__ = ["RNGStatesTracker", "get_rng_tracker",
+           "model_parallel_manual_seed", "checkpoint",
+           "get_cuda_rng_tracker", "model_parallel_cuda_manual_seed"]
+
+_MODEL_PARALLEL_RNG = "model-parallel-rng"
+_DEFAULT_RNG = "default-rng"
+
+
+class RNGStatesTracker:
+    """Named PRNG streams (reference: CudaRNGStatesTracker). ``add`` seeds a
+    stream; ``fork`` yields its key and advances the stream so successive
+    forks draw fresh randomness, mirroring how the reference's fork leaves
+    the stream advanced after the region."""
+
+    def __init__(self):
+        self._keys: Dict[str, jax.Array] = {}
+
+    def reset(self):
+        self._keys.clear()
+
+    def get_states(self):
+        return dict(self._keys)
+
+    def set_states(self, states):
+        self._keys = dict(states)
+
+    def add(self, name: str, seed: int):
+        if name in self._keys:
+            raise RuntimeError(f"rng stream {name} already initialized")
+        self._keys[name] = jax.random.PRNGKey(seed)
+
+    @contextlib.contextmanager
+    def fork(self, name: str = _MODEL_PARALLEL_RNG):
+        if name not in self._keys:
+            raise RuntimeError(f"rng stream {name} is not initialized")
+        key, nxt = jax.random.split(self._keys[name])
+        self._keys[name] = nxt
+        yield key
+
+
+_TRACKER = RNGStatesTracker()
+
+
+def get_rng_tracker() -> RNGStatesTracker:
+    return _TRACKER
+
+
+def model_parallel_manual_seed(seed: int, tp_rank=None):
+    """Seed both streams (reference: model_parallel_cuda_manual_seed):
+    default stream = seed; model-parallel stream = seed + 2718 + tp_rank.
+    ``tp_rank`` may be a traced axis_index inside shard_map; fold_in keeps
+    that functional."""
+    if tp_rank is None:
+        try:
+            tp_rank = jax.lax.axis_index(AXIS_MODEL)
+        except NameError:
+            tp_rank = 0
+    _TRACKER.reset()
+    _TRACKER.add(_DEFAULT_RNG, seed)
+    key = jax.random.fold_in(jax.random.PRNGKey(seed + 2718),
+                             jnp.asarray(tp_rank, jnp.uint32))
+    _TRACKER._keys[_MODEL_PARALLEL_RNG] = key
+
+
+def checkpoint(fn, *args, **kwargs):
+    """Activation checkpointing (reference: tensor_parallel/random.py —
+    checkpoint / class CheckpointFunction). ``jax.checkpoint`` recomputes the
+    forward during backward; dropout replay is automatic since keys are
+    arguments — no RNG snapshotting needed."""
+    return jax.checkpoint(fn)(*args, **kwargs)
+
+
+# Reference-named aliases so Megatron-style code ports unchanged.
+get_cuda_rng_tracker = get_rng_tracker
+model_parallel_cuda_manual_seed = model_parallel_manual_seed

@@ -1,0 +1,47 @@
+"""TP helpers. Reference: apex/transformer/utils.py (divide,
+ensure_divisibility) and apex/transformer/tensor_parallel/utils.py
+(split_tensor_along_last_dim, class VocabUtility)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["ensure_divisibility", "divide", "split_tensor_along_last_dim",
+           "VocabUtility"]
+
+
+def ensure_divisibility(numerator: int, denominator: int) -> None:
+    if numerator % denominator != 0:
+        raise ValueError(f"{numerator} is not divisible by {denominator}")
+
+
+def divide(numerator: int, denominator: int) -> int:
+    ensure_divisibility(numerator, denominator)
+    return numerator // denominator
+
+
+def split_tensor_along_last_dim(tensor, num_partitions: int):
+    """Reference: tensor_parallel/utils.py — split_tensor_along_last_dim.
+    (jnp.split copies under jit either way; the reference's
+    contiguous_split_chunks flag has no XLA meaning.)"""
+    last = tensor.shape[-1]
+    divide(last, num_partitions)
+    return jnp.split(tensor, num_partitions, axis=-1)
+
+
+class VocabUtility:
+    """Reference: tensor_parallel/utils.py — class VocabUtility: the
+    [first, last) vocab slice owned by a TP rank."""
+
+    @staticmethod
+    def vocab_range_from_per_partition_vocab_size(per_partition_vocab_size,
+                                                  rank, world_size=None):
+        first = rank * per_partition_vocab_size
+        return first, first + per_partition_vocab_size
+
+    @staticmethod
+    def vocab_range_from_global_vocab_size(global_vocab_size, rank,
+                                           world_size):
+        per = divide(global_vocab_size, world_size)
+        return VocabUtility.vocab_range_from_per_partition_vocab_size(
+            per, rank, world_size)

@@ -1,0 +1,52 @@
+"""Mirrors tests/L0/run_transformer/test_parallel_state.py of the reference:
+initialize with (tp, pp), check group sizes, destroy."""
+
+import numpy as np
+import pytest
+
+from apex_tpu import comm
+from apex_tpu.transformer import parallel_state
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    parallel_state.destroy_model_parallel()
+
+
+def test_initialize_shapes(eight_devices):
+    mesh = parallel_state.initialize_model_parallel(2, 2,
+                                                    devices=eight_devices)
+    assert parallel_state.model_parallel_is_initialized()
+    assert parallel_state.get_tensor_model_parallel_world_size() == 2
+    assert parallel_state.get_pipeline_model_parallel_world_size() == 2
+    assert parallel_state.get_data_parallel_world_size() == 2
+    assert dict(mesh.shape) == {"data": 2, "pipe": 2, "model": 2}
+    # model must be the innermost (fastest-varying) axis → ICI neighbours
+    assert tuple(mesh.axis_names) == ("data", "pipe", "model")
+
+
+def test_indivisible_world_raises(eight_devices):
+    with pytest.raises(RuntimeError):
+        parallel_state.initialize_model_parallel(3, 1,
+                                                 devices=eight_devices)
+
+
+def test_virtual_pipeline_bookkeeping(eight_devices):
+    parallel_state.initialize_model_parallel(
+        1, 2, virtual_pipeline_model_parallel_size_=2,
+        devices=eight_devices)
+    assert parallel_state.get_virtual_pipeline_model_parallel_world_size() == 2
+    assert parallel_state.get_virtual_pipeline_model_parallel_rank() == 0
+    parallel_state.set_virtual_pipeline_model_parallel_rank(1)
+    assert parallel_state.get_virtual_pipeline_model_parallel_rank() == 1
+    # vpp rank 1 is not the first virtual stage
+    assert not parallel_state.is_pipeline_first_stage()
+
+
+def test_destroy(eight_devices):
+    parallel_state.initialize_model_parallel(2, 1, devices=eight_devices)
+    parallel_state.destroy_model_parallel()
+    assert not parallel_state.model_parallel_is_initialized()
+    # after destroy the default data-only mesh comes back
+    assert comm.axis_size("model") == 1

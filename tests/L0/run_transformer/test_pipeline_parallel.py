@@ -1,0 +1,174 @@
+"""Pipeline-parallel tests vs a sequential single-device reference.
+
+Mirrors the reference's tests/L0/run_transformer/
+test_pipeline_parallel_fwd_bwd.py, which runs a toy model under each
+schedule and compares loss/grads against no-pipelining.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from apex_tpu.transformer import pipeline_parallel as pp
+
+D = 8      # activation width (constant across stages, like the reference)
+M = 6      # microbatches
+PP = 4     # pipeline stages
+
+
+def stage_fn(w, x):
+    return jnp.tanh(x @ w)
+
+
+def loss_fn(y, t):
+    return jnp.mean((y - t) ** 2)
+
+
+def _ref_loss(ws, microbatches, targets):
+    """Sequential reference: run every microbatch through all stages."""
+    def one(mb, t):
+        h = mb
+        for i in range(ws.shape[0]):
+            h = stage_fn(ws[i], h)
+        return loss_fn(h, t)
+    losses = [one(microbatches[m], targets[m]) for m in range(M)]
+    return sum(losses) / M
+
+
+@pytest.fixture()
+def pipe_mesh(eight_devices):
+    return Mesh(np.array(eight_devices[:PP]), ("pipe",))
+
+
+def _data():
+    k = jax.random.PRNGKey(0)
+    ws = jax.random.normal(k, (PP, D, D)) * 0.5
+    mb = jax.random.normal(jax.random.PRNGKey(1), (M, 4, D))
+    tg = jax.random.normal(jax.random.PRNGKey(2), (M, 4, D))
+    return ws, mb, tg
+
+
+def test_pipeline_apply_matches_sequential(pipe_mesh):
+    ws, mb, _ = _data()
+
+    @functools.partial(shard_map, mesh=pipe_mesh,
+                       in_specs=(P("pipe"), P()), out_specs=P(),
+                       check_rep=False)
+    def run(ws_local, mb):
+        w = ws_local[0]  # [1, D, D] local slice
+        return pp.pipeline_apply(stage_fn, w, mb, num_stages=PP)
+
+    out = run(ws, mb)
+    h = mb
+    for i in range(PP):
+        h = stage_fn(ws[i], h)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(h),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_loss_and_grads_match_sequential(pipe_mesh):
+    ws, mb, tg = _data()
+
+    pl = pp.make_pipeline_loss_fn(stage_fn, loss_fn, num_stages=PP)
+
+    @functools.partial(shard_map, mesh=pipe_mesh,
+                       in_specs=(P("pipe"), P(), P()),
+                       out_specs=(P(), P("pipe")), check_rep=False)
+    def run(ws_local, mb, tg):
+        w = ws_local[0]
+        l, g = jax.value_and_grad(pl)(w, (mb, tg))
+        return l, g[None]
+
+    loss, grads = run(ws, mb, tg)
+    ref_loss, ref_grads = jax.value_and_grad(_ref_loss)(ws, mb, tg)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(grads), np.asarray(ref_grads),
+                               rtol=1e-4, atol=1e-5)
+
+
+
+def test_interleaved_pipeline(eight_devices):
+    """2 devices × 2 chunks = 4 logical stages; chunk c on rank r is logical
+    stage c*pp + r, so the stacked order is row r*v+c = stage c*pp+r."""
+    pp_size, v = 2, 2
+    mesh = Mesh(np.array(eight_devices[:pp_size]), ("pipe",))
+    ws, mb, tg = _data()  # ws: [4, D, D] in logical-stage order
+
+    # reorder: local row (r*v + c) must hold stage (c*pp + r)
+    order = [c * pp_size + r for r in range(pp_size) for c in range(v)]
+    ws_stacked = ws[jnp.asarray(order)]
+
+    pl = pp.make_pipeline_loss_fn(stage_fn, loss_fn, num_stages=pp_size,
+                                  num_chunks=v)
+
+    @functools.partial(shard_map, mesh=mesh,
+                       in_specs=(P("pipe"), P(), P()),
+                       out_specs=(P(), P("pipe")), check_rep=False)
+    def run(ws_local, mb, tg):
+        l, g = jax.value_and_grad(pl)(ws_local, (mb, tg))
+        return l, g
+
+    loss, grads = run(ws_stacked, mb, tg)
+    ref_loss, ref_grads = jax.value_and_grad(_ref_loss)(ws, mb, tg)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    inv = np.argsort(order)
+    np.testing.assert_allclose(np.asarray(grads)[inv],
+                               np.asarray(ref_grads), rtol=1e-4, atol=1e-5)
+
+
+def test_no_pipelining_grad_accumulation():
+    ws, mb, tg = _data()
+
+    def full_loss(ws, mb1, tg1):
+        h = mb1
+        for i in range(PP):
+            h = stage_fn(ws[i], h)
+        return loss_fn(h, tg1)
+
+    loss, grads = pp.forward_backward_no_pipelining(full_loss, ws, mb, tg)
+    ref_loss, ref_grads = jax.value_and_grad(_ref_loss)(ws, mb, tg)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(grads), np.asarray(ref_grads),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_shift_ring(eight_devices):
+    mesh = Mesh(np.array(eight_devices[:4]), ("pipe",))
+
+    @functools.partial(shard_map, mesh=mesh, in_specs=(P("pipe"),),
+                       out_specs=P("pipe"), check_rep=False)
+    def shift(x):
+        return pp.shift_right(x, n=4)
+
+    x = jnp.arange(4.0)[:, None]
+    out = shift(x)
+    np.testing.assert_allclose(np.asarray(out)[:, 0], [3.0, 0.0, 1.0, 2.0])
+
+
+def test_microbatch_calculators():
+    c = pp.build_num_microbatches_calculator(
+        global_batch_size=32, micro_batch_size=2, data_parallel_size=4)
+    assert c.get() == 4
+    r = pp.build_num_microbatches_calculator(
+        rampup_batch_size=[8, 8, 100], global_batch_size=32,
+        micro_batch_size=2, data_parallel_size=2)
+    assert r.get() == 2  # start 8 / (2*2)
+    r.update(200)
+    assert r.get() == 8  # ramped to 32
+    with pytest.raises(ValueError):
+        pp.build_num_microbatches_calculator(
+            global_batch_size=30, micro_batch_size=4, data_parallel_size=2)
+
+
+def test_get_forward_backward_func():
+    f = pp.get_forward_backward_func(None, 1)
+    assert f is pp.forward_backward_no_pipelining
+    f = pp.get_forward_backward_func(None, 4)
+    assert f.func is pp.forward_backward_pipelining_without_interleaving
+    f = pp.get_forward_backward_func(2, 4)
+    assert f.func is pp.forward_backward_pipelining_with_interleaving

@@ -10,16 +10,19 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from apex_tpu.kernels.layer_norm import layer_norm_reference
 from apex_tpu.normalization import (FusedLayerNorm, FusedRMSNorm,
                                     MixedFusedLayerNorm)
 
 
 def _ref_ln(x, scale, bias, eps=1e-5):
-    x32 = x.astype(np.float32)
-    mu = x32.mean(-1, keepdims=True)
-    var = x32.var(-1, keepdims=True)
-    y = (x32 - mu) / np.sqrt(var + eps)
-    return y * scale + bias
+    # shared oracle (same one tests/L0/test_fused_layer_norm.py uses)
+    x32 = jnp.asarray(np.asarray(x), jnp.float32)
+    w = None if np.isscalar(scale) and scale == 1.0 \
+        else jnp.asarray(np.asarray(scale, np.float32).reshape(-1))
+    b = None if np.isscalar(bias) and bias == 0.0 \
+        else jnp.asarray(np.asarray(bias, np.float32).reshape(-1))
+    return np.asarray(layer_norm_reference(x32, w, b, eps=eps))
 
 
 @pytest.mark.parametrize("hidden", [128, 96])

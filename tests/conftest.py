@@ -10,11 +10,20 @@ Must run before jax initializes its backends, hence module-level in conftest.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force (not setdefault): the driver environment pins JAX_PLATFORMS=axon (the
+# one real TPU); the test suite must be hermetic CPU with 8 virtual devices.
+# The axon sitecustomize imports jax at interpreter start, so jax has already
+# captured JAX_PLATFORMS=axon — update the live config too (backends are still
+# uninitialized when conftest runs, so this takes effect).
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
