@@ -1,0 +1,26 @@
+"""apex_tpu.contrib — production kernel grab-bag (reference: apex/contrib/).
+
+Each subpackage mirrors one reference contrib package. Where the reference
+gates on "extension built?" (SkipTest on ImportError), the TPU equivalents
+are always available — the Pallas kernels fall back to jnp paths off the
+aligned hot path.
+"""
+
+from . import bottleneck  # noqa: F401
+from . import clip_grad  # noqa: F401
+from . import fmha  # noqa: F401
+from . import focal_loss  # noqa: F401
+from . import group_norm  # noqa: F401
+from . import groupbn  # noqa: F401
+from . import index_mul_2d  # noqa: F401
+from . import layer_norm  # noqa: F401
+from . import multihead_attn  # noqa: F401
+from . import optimizers  # noqa: F401
+from . import peer_memory  # noqa: F401
+from . import sparsity  # noqa: F401
+from . import transducer  # noqa: F401
+from . import xentropy  # noqa: F401
+
+__all__ = ["bottleneck", "clip_grad", "fmha", "focal_loss", "group_norm",
+           "groupbn", "index_mul_2d", "layer_norm", "multihead_attn",
+           "optimizers", "peer_memory", "sparsity", "transducer", "xentropy"]

@@ -1,0 +1,93 @@
+"""ResNet bottleneck blocks, incl. spatially-parallel (halo) convolution.
+
+Reference: apex/contrib/bottleneck/bottleneck.py — class Bottleneck (cuDNN
+v8 fused conv+scale+relu graphs, N16) and class SpatialBottleneck (H-dim
+sharded conv with peer-memory halo exchange; halo_exchangers.py). TPU:
+XLA fuses conv+bn+relu on its own, so Bottleneck is a plain flax block kept
+for API parity; SpatialBottleneck shards H over a mesh axis and calls
+halo_exchange_1d around each 3x3 conv — the ppermute ride on ICI replaces
+the IPC peer writes.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from apex_tpu.contrib.peer_memory import halo_exchange_1d
+
+__all__ = ["Bottleneck", "SpatialBottleneck"]
+
+
+class Bottleneck(nn.Module):
+    """ResNet v1.5 bottleneck, NHWC (reference: bottleneck.py — Bottleneck;
+    the fused conv_bias_relu epilogues are XLA fusions here)."""
+
+    in_channels: int
+    bottleneck_channels: int
+    out_channels: int
+    stride: int = 1
+    dtype: Any = jnp.float32
+    norm: Callable = nn.BatchNorm
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = functools.partial(self.norm, use_running_average=not train,
+                                 momentum=0.9, epsilon=1e-5)
+        residual = x
+        y = conv(self.bottleneck_channels, (1, 1), name="conv1")(x)
+        y = nn.relu(norm(name="bn1")(y))
+        y = conv(self.bottleneck_channels, (3, 3),
+                 (self.stride, self.stride), padding=[(1, 1), (1, 1)],
+                 name="conv2")(y)
+        y = nn.relu(norm(name="bn2")(y))
+        y = conv(self.out_channels, (1, 1), name="conv3")(y)
+        y = norm(name="bn3")(y)
+        if residual.shape != y.shape:
+            residual = conv(self.out_channels, (1, 1),
+                            (self.stride, self.stride),
+                            name="downsample_conv")(residual)
+            residual = norm(name="downsample_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class SpatialBottleneck(nn.Module):
+    """Bottleneck with the H dimension sharded over ``axis_name``
+    (reference: SpatialBottleneck + HaloExchangerPeer). Runs inside
+    shard_map; each rank holds H/world rows and exchanges 1-row halos
+    around the 3x3 conv."""
+
+    in_channels: int
+    bottleneck_channels: int
+    out_channels: int
+    stride: int = 1
+    axis_name: str = "data"
+    dtype: Any = jnp.float32
+    norm: Callable = nn.BatchNorm
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = functools.partial(self.norm, use_running_average=not train,
+                                 momentum=0.9, epsilon=1e-5)
+        residual = x
+        y = conv(self.bottleneck_channels, (1, 1), name="conv1")(x)
+        y = nn.relu(norm(name="bn1")(y))
+        # 3x3 with halo: pad H by the neighbours' rows, then VALID-conv in H
+        y = halo_exchange_1d(y, 1, self.axis_name, dim=1)
+        y = conv(self.bottleneck_channels, (3, 3),
+                 (self.stride, self.stride),
+                 padding=[(0, 0), (1, 1)], name="conv2")(y)
+        y = nn.relu(norm(name="bn2")(y))
+        y = conv(self.out_channels, (1, 1), name="conv3")(y)
+        y = norm(name="bn3")(y)
+        if residual.shape != y.shape:
+            residual = conv(self.out_channels, (1, 1),
+                            (self.stride, self.stride),
+                            name="downsample_conv")(residual)
+            residual = norm(name="downsample_bn")(residual)
+        return nn.relu(y + residual)

@@ -1,0 +1,39 @@
+"""clip_grad_norm_ drop-in.
+
+Reference: apex/contrib/clip_grad/clip_grad.py — clip_grad_norm_ (uses
+multi_tensor_l2norm + multi_tensor_scale to do the whole model in two
+launches). Here: one fused global-norm over the flattened pytree + one fused
+scale — same two-pass semantics, jit-friendly (returns the clipped tree
+functionally instead of mutating .grad).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.utils.pytree import flatten_tree, unflatten_tree
+from apex_tpu.kernels.multi_tensor import fused_l2norm, fused_scale
+
+__all__ = ["clip_grad_norm_", "clip_grad_norm"]
+
+
+def clip_grad_norm(grads, max_norm: float, norm_type: float = 2.0):
+    """Returns (clipped_grads, total_norm). norm_type=2 uses the fused
+    l2norm kernel; other norms use jnp (reference falls back to a python
+    loop identically)."""
+    flat, spec = flatten_tree(grads)
+    if norm_type == 2.0:
+        total_norm = fused_l2norm(flat)
+    else:
+        x32 = jnp.asarray(flat, jnp.float32)
+        total_norm = jnp.sum(jnp.abs(x32) ** norm_type) ** (1.0 / norm_type)
+    clip_coef = max_norm / (total_norm + 1e-6)
+    coef = jnp.minimum(clip_coef, 1.0)
+    clipped, _ = fused_scale(flat, coef)
+    return unflatten_tree(clipped, spec), total_norm
+
+
+# reference-named alias (the underscore name mutates in torch; here it
+# returns, like every jax transform)
+clip_grad_norm_ = clip_grad_norm

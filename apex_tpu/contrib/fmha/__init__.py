@@ -1,0 +1,39 @@
+"""Varlen packed flash-MHA (MLPerf BERT).
+
+Reference: apex/contrib/fmha/fmha.py — class FMHAFun (fmhalib.fwd/bwd):
+packed QKV [total_tokens, 3, heads, d] with cu_seqlens delimiting sequences,
+max seqlen ≤ 512. TPU: the same flash kernel with segment ids — cu_seqlens
+converts to a per-token segment id; no separate kernel needed (the SURVEY
+§3.2 N12 mapping).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.kernels.flash_attention import flash_attention
+
+__all__ = ["fmha", "cu_seqlens_to_segment_ids"]
+
+
+def cu_seqlens_to_segment_ids(cu_seqlens, total: int):
+    """[n+1] cumulative lengths -> [total] segment ids (0..n-1)."""
+    positions = jnp.arange(total)
+    # segment of token t = number of boundaries <= t
+    return jnp.searchsorted(cu_seqlens[1:-1], positions, side="right") \
+        if cu_seqlens.shape[0] > 2 else jnp.zeros((total,), jnp.int32)
+
+
+def fmha(qkv, cu_seqlens, *, heads: int, causal: bool = False):
+    """qkv: [total, 3, heads, d] packed (reference layout). Returns
+    [total, heads, d]."""
+    total, three, h, d = qkv.shape
+    assert three == 3 and h == heads
+    seg = cu_seqlens_to_segment_ids(jnp.asarray(cu_seqlens), total)
+    q = qkv[:, 0].transpose(1, 0, 2)[None]   # [1, H, total, d]
+    k = qkv[:, 1].transpose(1, 0, 2)[None]
+    v = qkv[:, 2].transpose(1, 0, 2)[None]
+    out = flash_attention(q, k, v, causal=causal,
+                          segment_ids=seg[None, :])
+    return out[0].transpose(1, 0, 2)         # [total, H, d]

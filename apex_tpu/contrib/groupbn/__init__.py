@@ -1,0 +1,49 @@
+"""Group (multi-device) BatchNorm, NHWC, with fused add+ReLU.
+
+Reference: apex/contrib/groupbn/batch_norm.py — class BatchNorm2d_NHWC
+(``bnp`` cuDNN NHWC kernels with fused residual-add+ReLU and cross-GPU
+"group" stat exchange, N14/N22). TPU mapping (SURVEY §3.2): SyncBatchNorm's
+Welford-psum covers the stat exchange; this module adds the fused
+add+ReLU epilogue the MLPerf ResNet blocks use.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm
+
+__all__ = ["BatchNorm2d_NHWC"]
+
+
+class BatchNorm2d_NHWC(nn.Module):
+    """BN over NHWC with optional fused residual add + ReLU
+    (reference: bn_addrelu path selected by fuse_relu/bn_group kwargs).
+    ``bn_group`` > 1 syncs stats over ``axis_name`` (the reference's
+    group-of-GPUs semantic; here the mesh axis defines the group)."""
+
+    num_features: int
+    fuse_relu: bool = False
+    bn_group: int = 1
+    axis_name: Optional[str] = None
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: Any = None
+    use_running_average: Optional[bool] = None
+
+    @nn.compact
+    def __call__(self, x, z=None, use_running_average: Optional[bool] = None):
+        axis = self.axis_name if self.bn_group > 1 else None
+        y = SyncBatchNorm(
+            use_running_average=self.use_running_average
+            if use_running_average is None else use_running_average,
+            momentum=self.momentum, epsilon=self.epsilon, dtype=self.dtype,
+            axis_name=axis, name="bn")(x)
+        if z is not None:
+            y = y + jnp.asarray(z, y.dtype)   # fused residual add
+        if self.fuse_relu:
+            y = jnp.maximum(y, 0)
+        return y

@@ -1,0 +1,20 @@
+"""Fused gather-multiply.
+
+Reference: apex/contrib/index_mul_2d/index_mul_2d.py — index_mul_2d
+(apex/contrib/csrc/index_mul_2d/index_mul_2d_cuda.cu): out = in1[idx] * in2
+fwd, with fused scatter-accumulate bwd. XLA fuses gather×mul and its
+transpose (scatter-add) natively, so this is the API with jnp internals —
+exactly the §3.2 mapping table's note for N20.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["index_mul_2d"]
+
+
+def index_mul_2d(in1, in2, idx):
+    """out[i, :] = in1[idx[i], :] * in2[i, :]. Differentiable (autodiff
+    produces the fused scatter-add the CUDA bwd kernel hand-writes)."""
+    return jnp.take(in1, idx, axis=0) * in2

@@ -1,0 +1,11 @@
+"""'Fast layer norm' (persistent LN to 64k hidden).
+
+Reference: apex/contrib/layer_norm/layer_norm.py — class FastLayerNorm
+(fast_layer_norm.ln_fwd/ln_bwd). The SURVEY §3.2 N13 mapping folds this into
+the one Pallas LN kernel (row-blocked over hidden), so FastLayerNorm is the
+FusedLayerNorm module under the contrib name.
+"""
+
+from apex_tpu.normalization import FusedLayerNorm as FastLayerNorm
+
+__all__ = ["FastLayerNorm"]

@@ -1,0 +1,206 @@
+"""Distributed (ZeRO-style) fused optimizers.
+
+Reference: apex/contrib/optimizers/distributed_fused_adam.py — class
+DistributedFusedAdam (~3000 LoC: grads reduce-scattered in buckets across the
+DP group, each rank owns a shard of the fp32 optimizer state + master params,
+params all-gathered after the step, with pipelined overlap) and
+distributed_fused_lamb.py — class DistributedFusedLAMB (MLPerf BERT).
+
+TPU design: the whole mechanism collapses to three collectives on the flat
+superbuffer under shard_map over the ``data`` axis —
+``psum_scatter(grads)`` → shard-local fused update on 1/world of the (m, v)
+state → ``all_gather(updates)`` — which IS ZeRO-1/2 semantics; the
+reference's bucketing/pipelining machinery exists to overlap NCCL with
+backward, which XLA's scheduler does on its own. Outside shard_map (axis
+unbound) they degrade to the single-process fused optimizers.
+
+LAMB's per-tensor trust ratios are applied after the gather (they need whole
+tensors); the state (m, v) stays fully sharded, matching the reference's
+"each rank owns a state shard" memory profile.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex_tpu import comm
+from apex_tpu.comm import AXIS_DATA
+from apex_tpu.kernels.multi_tensor import fused_adam_step
+from apex_tpu.optimizers.fused_adam import (_flat32, _lr_at, _unflatten_like)
+
+__all__ = ["distributed_fused_adam", "distributed_fused_lamb",
+           "DistributedFusedAdam", "DistributedFusedLAMB"]
+
+ScalarOrSchedule = Union[float, Callable[[jnp.ndarray], Any]]
+
+
+class DistAdamState(NamedTuple):
+    count: jnp.ndarray
+    m_shard: jnp.ndarray   # fp32, [padded_n / world]
+    v_shard: jnp.ndarray
+
+
+def _axis_bound(axis_name):
+    try:
+        jax.lax.axis_index(axis_name)
+        return True
+    except NameError:
+        return False
+
+
+def _padded(n, world):
+    return ((n + world - 1) // world) * world
+
+
+def distributed_fused_adam(
+        learning_rate: ScalarOrSchedule = 1e-3, beta1: float = 0.9,
+        beta2: float = 0.999, eps: float = 1e-8, weight_decay: float = 0.0,
+        adam_w_mode: bool = True, bias_correction: bool = True,
+        axis_name: str = AXIS_DATA,
+        world_size: Optional[int] = None) -> optax.GradientTransformation:
+    """ZeRO-sharded fused Adam over ``axis_name``. The shard size comes
+    from the installed mesh (comm.axis_size) or an explicit ``world_size``,
+    so init (outside shard_map) and update (inside) agree; grads are
+    per-rank local (the transformation does the mean-reduce-scatter itself,
+    like the reference does its own reductions)."""
+
+    def _world():
+        return world_size if world_size is not None \
+            else comm.axis_size(axis_name)
+
+    def init_fn(params):
+        n = sum(x.size for x in jax.tree_util.tree_leaves(params))
+        world = _world()
+        shard = _padded(n, world) // world
+        return DistAdamState(count=jnp.zeros((), jnp.int32),
+                             m_shard=jnp.zeros((shard,), jnp.float32),
+                             v_shard=jnp.zeros((shard,), jnp.float32))
+
+    def update_fn(updates, state, params=None):
+        if params is None:
+            raise ValueError("distributed_fused_adam requires params")
+        world = _world()
+        bound = _axis_bound(axis_name)
+        if bound:
+            # trace-time axis size is authoritative; a mismatch against the
+            # shard-sized state (init used comm.axis_size/world_size) means
+            # the mesh changed between init and update — fail loud.
+            traced_world = jax.lax.psum(1, axis_name)
+            if isinstance(traced_world, int) and traced_world != world:
+                raise ValueError(
+                    f"axis {axis_name!r} has size {traced_world} under "
+                    f"shard_map but optimizer state was initialized for "
+                    f"world {world}")
+        elif world > 1:
+            raise RuntimeError(
+                f"distributed_fused_adam(world_size={world}) must run "
+                f"inside shard_map/pmap with axis {axis_name!r} bound; the "
+                f"shard-sized state cannot be updated unsharded")
+        count = state.count + 1
+        flat_p = _flat32(params)
+        flat_g = _flat32(updates)
+        n = flat_p.shape[0]
+        pn = _padded(n, world)
+        pad = pn - n
+        flat_p = jnp.pad(flat_p, (0, pad))
+        flat_g = jnp.pad(flat_g, (0, pad))
+        if bound and world > 1:
+            # ZeRO: mean-reduce-scatter grads; slice own param shard
+            g_shard = jax.lax.psum_scatter(flat_g, axis_name,
+                                           scatter_dimension=0,
+                                           tiled=True) / world
+            rank = jax.lax.axis_index(axis_name)
+            shard = pn // world
+            p_shard = jax.lax.dynamic_slice_in_dim(flat_p, rank * shard,
+                                                   shard)
+        else:
+            g_shard, p_shard = flat_g, flat_p
+        lr = _lr_at(learning_rate, count)
+        new_p, new_m, new_v = fused_adam_step(
+            p_shard, state.m_shard, state.v_shard, g_shard, lr=lr,
+            beta1=beta1, beta2=beta2, eps=eps, weight_decay=weight_decay,
+            step=count, adam_w_mode=adam_w_mode,
+            bias_correction=bias_correction)
+        delta_shard = new_p - p_shard
+        if bound and world > 1:
+            delta = jax.lax.all_gather(delta_shard, axis_name, axis=0,
+                                       tiled=True)
+        else:
+            delta = delta_shard
+        delta = delta[:n]
+        new_updates = _unflatten_like(delta, params)
+        return new_updates, DistAdamState(count, new_m, new_v)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def distributed_fused_lamb(
+        learning_rate: ScalarOrSchedule = 1e-3, beta1: float = 0.9,
+        beta2: float = 0.999, eps: float = 1e-6, weight_decay: float = 0.01,
+        max_coeff: float = 10.0, min_coeff: float = 0.01,
+        axis_name: str = AXIS_DATA) -> optax.GradientTransformation:
+    """ZeRO-sharded LAMB (reference: DistributedFusedLAMB). Sharded Adam-ish
+    moment update; trust ratio per tensor applied post-gather, matching
+    NVLAMB stage-2 (multi_tensor_lamb's per-chunk ratio application)."""
+
+    base = distributed_fused_adam(
+        learning_rate=1.0,  # lr applied inside trust-ratio stage
+        beta1=beta1, beta2=beta2, eps=eps, weight_decay=weight_decay,
+        adam_w_mode=True, bias_correction=True, axis_name=axis_name)
+
+    def init_fn(params):
+        return base.init(params)
+
+    def update_fn(updates, state, params=None):
+        raw_updates, new_state = base.update(updates, state, params)
+        lr = _lr_at(learning_rate, new_state.count)
+
+        def per_tensor(u, p):
+            p32 = jnp.asarray(p, jnp.float32)
+            u32 = jnp.asarray(u, jnp.float32)
+            w_norm = jnp.sqrt(jnp.sum(p32 * p32))
+            u_norm = jnp.sqrt(jnp.sum(u32 * u32))
+            ratio = jnp.where(
+                (w_norm > 0) & (u_norm > 0),
+                jnp.clip(w_norm / u_norm, min_coeff, max_coeff), 1.0)
+            return (lr * ratio * u32).astype(jnp.asarray(u).dtype)
+
+        scaled = jax.tree_util.tree_map(per_tensor, raw_updates, params)
+        return scaled, new_state
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+class DistributedFusedAdam:
+    """Class-shaped wrapper mirroring the reference constructor; holds the
+    optax transformation plus step/init helpers."""
+
+    def __init__(self, params, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
+                 weight_decay=0.0, adam_w_mode=True, bias_correction=True,
+                 axis_name: str = AXIS_DATA, **_ignored):
+        self.tx = distributed_fused_adam(
+            lr, betas[0], betas[1], eps, weight_decay, adam_w_mode,
+            bias_correction, axis_name)
+        self.state = self.tx.init(params)
+
+    def step(self, grads, params):
+        upd, self.state = self.tx.update(grads, self.state, params)
+        return optax.apply_updates(params, upd)
+
+
+class DistributedFusedLAMB:
+    def __init__(self, params, lr=1e-3, betas=(0.9, 0.999), eps=1e-6,
+                 weight_decay=0.01, max_coeff=10.0, min_coeff=0.01,
+                 axis_name: str = AXIS_DATA, **_ignored):
+        self.tx = distributed_fused_lamb(
+            lr, betas[0], betas[1], eps, weight_decay, max_coeff, min_coeff,
+            axis_name)
+        self.state = self.tx.init(params)
+
+    def step(self, grads, params):
+        upd, self.state = self.tx.update(grads, self.state, params)
+        return optax.apply_updates(params, upd)

@@ -1,0 +1,64 @@
+"""Halo exchange for spatial parallelism.
+
+Reference: apex/contrib/peer_memory/peer_memory.py — class PeerMemoryPool
+(CUDA IPC buffers) + class PeerHaloExchanger1d (direct P2P stores of halo
+rows, N17). On TPU there are no user-managed peer buffers — XLA owns all
+memory and ``ppermute`` IS the direct chip-to-chip write over ICI (SURVEY
+§3.2 N17 mapping) — so the pool is not needed and the exchanger is a
+function. The reference's ``nccl_p2p`` fallback (N18) is the same call.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.comm import AXIS_DATA
+
+__all__ = ["halo_exchange_1d", "PeerHaloExchanger1d"]
+
+
+def halo_exchange_1d(x, halo: int, axis_name: str, *, dim: int = 1,
+                     wrap: bool = False):
+    """Exchange ``halo`` rows along spatial ``dim`` with both mesh-axis
+    neighbours; returns x padded to size + 2*halo along ``dim``.
+
+    Matches PeerHaloExchanger1d semantics: each rank sends its top rows to
+    the previous rank's bottom halo and its bottom rows to the next rank's
+    top halo; edge ranks get zeros unless ``wrap``.
+    """
+    try:
+        world = jax.lax.psum(1, axis_name)
+    except NameError as e:
+        raise RuntimeError("halo_exchange_1d must run under shard_map with "
+                           f"axis {axis_name!r} bound") from e
+    rank = jax.lax.axis_index(axis_name)
+
+    top = jax.lax.slice_in_dim(x, 0, halo, axis=dim)
+    bot = jax.lax.slice_in_dim(x, x.shape[dim] - halo, x.shape[dim],
+                               axis=dim)
+    perm_fwd = [(i, (i + 1) % world) for i in range(world)]
+    perm_bwd = [(i, (i - 1) % world) for i in range(world)]
+    from_prev = jax.lax.ppermute(bot, axis_name, perm_fwd)   # prev's bottom
+    from_next = jax.lax.ppermute(top, axis_name, perm_bwd)   # next's top
+    if not wrap:
+        zero = jnp.zeros_like(from_prev)
+        from_prev = jnp.where(rank == 0, zero, from_prev)
+        from_next = jnp.where(rank == world - 1, zero, from_next)
+    return jnp.concatenate([from_prev, x, from_next], axis=dim)
+
+
+class PeerHaloExchanger1d:
+    """Reference ctor: PeerHaloExchanger1d(ranks, rank_in_group, pool,
+    half_halo). Pool is meaningless on TPU; kept kwargs ignored."""
+
+    def __init__(self, axis_name: str = AXIS_DATA, half_halo: int = 1,
+                 **_ignored):
+        self.axis_name = axis_name
+        self.half_halo = half_halo
+
+    def __call__(self, x, dim: int = 1, wrap: bool = False):
+        return halo_exchange_1d(x, self.half_halo, self.axis_name, dim=dim,
+                                wrap=wrap)

@@ -1,0 +1,171 @@
+"""ASP — automatic 2:4 structured sparsity.
+
+Reference: apex/contrib/sparsity/asp.py — class ASP
+(init_model_for_pruning / init_optimizer_for_pruning /
+compute_sparse_masks / prune_trained_model) and sparse_masklib.py —
+create_mask (m-of-n magnitude masks), plus permutation_search_kernels
+(channel permutation preserving accuracy, N15).
+
+TPU design: masks are pytrees applied functionally — instead of
+monkey-patching optimizer.step (torch), ``apply_masks`` multiplies params
+after each update (compose with optax via ``masked_update``). The mask math
+(2:4 by magnitude along the input dim) is identical; the permutation search
+is the greedy column-permutation from the reference's kernels, in jnp
+(CPU-ok per SURVEY §3.2 N15).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+__all__ = ["create_mask", "compute_sparse_masks", "apply_masks",
+           "masked_update", "permutation_search", "ASP"]
+
+
+def create_mask(w, pattern: str = "m4n2_1d"):
+    """2:4 (n of m) magnitude mask along the last dim (reference:
+    sparse_masklib.create_mask; default pattern m4n2_1d). Returns bool mask
+    with True = keep."""
+    if pattern not in ("m4n2_1d", "m4n2"):
+        raise ValueError(f"unsupported pattern {pattern!r}")
+    m, n = 4, 2
+    orig = w.shape
+    last = orig[-1]
+    if last % m:
+        return jnp.ones(orig, bool)  # unprunable shape → dense (reference
+        # skips layers whose dims don't fit the pattern)
+    g = jnp.abs(jnp.asarray(w, jnp.float32)).reshape(-1, m)
+    # keep exactly the top-n of each group of m; the index-scaled epsilon
+    # breaks ties deterministically like the reference kernels do
+    idx = jnp.argsort(jnp.argsort(-g - jnp.arange(m) * 1e-12, axis=-1),
+                      axis=-1)
+    mask = idx < n
+    return mask.reshape(orig)
+
+
+def _prunable(path_names, leaf) -> bool:
+    shape = jnp.shape(leaf)
+    if len(shape) < 2:
+        return False
+    name = path_names[-1] if path_names else ""
+    return name in ("kernel", "embedding", "w", "weight") \
+        and shape[-1] % 4 == 0
+
+
+def _path_names(path):
+    names = []
+    for p in path:
+        if hasattr(p, "key"):
+            names.append(str(p.key))
+        elif hasattr(p, "name"):
+            names.append(str(p.name))
+        else:
+            names.append(str(p))
+    return names
+
+
+def compute_sparse_masks(params, allowed_layer_names: Optional[Callable] =
+                         None, pattern: str = "m4n2_1d"):
+    """Masks for every prunable weight (reference:
+    ASP.compute_sparse_masks). ``allowed_layer_names(path_names, leaf)``
+    overrides the default kernel/embedding rule."""
+    pred = allowed_layer_names or _prunable
+
+    def one(path, leaf):
+        if pred(_path_names(path), leaf):
+            return create_mask(leaf, pattern)
+        return jnp.ones(jnp.shape(leaf), bool)
+
+    return jax.tree_util.tree_map_with_path(one, params)
+
+
+def apply_masks(params, masks):
+    return jax.tree_util.tree_map(
+        lambda p, m: jnp.where(m, p, jnp.zeros_like(p)), params, masks)
+
+
+def masked_update(masks) -> optax.GradientTransformation:
+    """Optax component zeroing masked updates — the functional equivalent of
+    the reference's patched optimizer.step re-applying masks after the
+    update (ASP.init_optimizer_for_pruning)."""
+
+    def init_fn(params):
+        return optax.EmptyState()
+
+    def update_fn(updates, state, params=None):
+        return jax.tree_util.tree_map(
+            lambda u, m: jnp.where(m, u, jnp.zeros_like(u)), updates, masks
+        ), state
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def permutation_search(w, n_iter: int = 100, seed: int = 0):
+    """Greedy input-channel permutation maximizing retained magnitude under
+    2:4 (reference: permutation_search_kernels — channel swaps accepted when
+    they increase the kept-magnitude sum). Returns (perm, gain)."""
+    w = np.abs(np.asarray(w, np.float32))
+    if w.ndim != 2 or w.shape[1] % 4:
+        return np.arange(w.shape[-1]), 0.0
+    cols = w.shape[1]
+    rng = np.random.default_rng(seed)
+    perm = np.arange(cols)
+
+    def kept(mat):
+        g = mat.reshape(mat.shape[0], -1, 4)
+        top = np.sort(g, axis=-1)[:, :, 2:]
+        return float(top.sum())
+
+    best = kept(w[:, perm])
+    base = best
+    for _ in range(n_iter):
+        i, j = rng.integers(0, cols, 2)
+        if i == j:
+            continue
+        cand = perm.copy()
+        cand[[i, j]] = cand[[j, i]]
+        score = kept(w[:, cand])
+        if score > best:
+            best, perm = score, cand
+    return perm, best - base
+
+
+class ASP:
+    """Stateful facade mirroring the reference classmethod API."""
+
+    _masks = None
+    _pattern = "m4n2_1d"
+
+    @classmethod
+    def init_model_for_pruning(cls, params, mask_calculator: str = "m4n2_1d",
+                               **_ignored):
+        cls._pattern = mask_calculator
+        cls._masks = compute_sparse_masks(params, pattern=mask_calculator)
+        return cls._masks
+
+    @classmethod
+    def init_optimizer_for_pruning(cls, optimizer:
+                                   optax.GradientTransformation):
+        if cls._masks is None:
+            raise RuntimeError("call init_model_for_pruning first")
+        return optax.chain(optimizer, masked_update(cls._masks))
+
+    @classmethod
+    def compute_sparse_masks(cls, params):
+        cls._masks = compute_sparse_masks(params, pattern=cls._pattern)
+        return cls._masks
+
+    @classmethod
+    def prune_trained_model(cls, params, optimizer:
+                            optax.GradientTransformation):
+        """One-shot recipe (reference: ASP.prune_trained_model): compute
+        masks, apply to params, wrap optimizer."""
+        masks = compute_sparse_masks(params)
+        cls._masks = masks
+        return apply_masks(params, masks), \
+            optax.chain(optimizer, masked_update(masks))

@@ -1,0 +1,39 @@
+"""Fused softmax cross-entropy with label smoothing.
+
+Reference: apex/contrib/xentropy/softmax_xentropy.py — class
+SoftmaxCrossEntropyLoss (calls xentropy_cuda.forward/backward). The kernel
+lives in apex_tpu.kernels.xentropy; this wrapper keeps the reference's
+call shape (padding index, half-to-float option).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from apex_tpu.kernels.xentropy import softmax_cross_entropy_loss
+
+__all__ = ["SoftmaxCrossEntropyLoss", "softmax_cross_entropy_loss"]
+
+
+class SoftmaxCrossEntropyLoss:
+    """Callable matching the reference autograd Function's apply signature:
+    ``SoftmaxCrossEntropyLoss.apply(logits, labels, smoothing, padding_idx,
+    half_to_float)``."""
+
+    @staticmethod
+    def apply(logits, labels, smoothing: float = 0.0, padding_idx: int = 0,
+              half_to_float: bool = False):
+        losses = softmax_cross_entropy_loss(logits, labels,
+                                            smoothing=smoothing)
+        if padding_idx is not None:
+            # reference zeroes losses at padded positions (labels == padding
+            # treated as ignore when padding_idx >= 0 in caller recipes)
+            losses = jnp.where(labels == padding_idx,
+                               jnp.zeros_like(losses), losses) \
+                if padding_idx >= 0 else losses
+        if half_to_float:
+            losses = jnp.asarray(losses, jnp.float32)
+        return losses
+
+    def __call__(self, logits, labels, smoothing: float = 0.0):
+        return softmax_cross_entropy_loss(logits, labels, smoothing=smoothing)

@@ -1,0 +1,163 @@
+"""Fused softmax cross-entropy Pallas kernel with label smoothing.
+
+TPU-native equivalent of the reference's ``xentropy_cuda`` extension
+(apex/contrib/csrc/xentropy/xentropy_kernel.cu —
+cunn_SoftMaxXEntropyForward/Backward). Semantics preserved:
+
+- forward computes per-row loss and saves only (losses, max_log_sum_exp)
+  for backward ("bprop-in-fprop" memory shape: no softmax tensor saved);
+- label smoothing folded into both passes (in-place smoothing in the
+  reference);
+- half I/O with fp32 math.
+
+Rows are blocked over a 1-D grid with the full vocab row in VMEM per block
+(same layout choice as the LN kernel); unaligned vocab falls back to the jnp
+path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+__all__ = ["softmax_cross_entropy_loss", "xent_reference"]
+
+
+def xent_reference(logits, labels, smoothing: float = 0.0):
+    """fp32 composed reference (the reference tests compare against
+    F.log_softmax + nll with manual smoothing)."""
+    lg = jnp.asarray(logits, jnp.float32)
+    logp = jax.nn.log_softmax(lg, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    if smoothing > 0.0:
+        mean_logp = jnp.mean(logp, axis=-1)
+        return (1.0 - smoothing) * nll - smoothing * mean_logp
+    return nll
+
+
+def _fwd_kernel(lg_ref, lb_ref, loss_ref, mlse_ref, *, smoothing, block_rows):
+    i = pl.program_id(0)
+    lg = lg_ref[:].astype(jnp.float32)              # [br, V]
+    labels = lb_ref[0, 0, pl.ds(i * block_rows, block_rows)]   # [br]
+    m = jnp.max(lg, axis=-1, keepdims=True)
+    lse = jnp.log(jnp.sum(jnp.exp(lg - m), axis=-1, keepdims=True)) + m
+    # gather-by-label as a masked reduction (Mosaic has no 1-slice gather)
+    cols = jax.lax.broadcasted_iota(jnp.int32, lg.shape, 1)
+    onehot_logit = jnp.sum(
+        jnp.where(cols == labels[:, None], lg, 0.0), axis=-1, keepdims=True)
+    nll = (lse - onehot_logit)[:, 0]
+    if smoothing > 0.0:
+        mean_logp = jnp.mean(lg - lse, axis=-1)
+        loss = (1.0 - smoothing) * nll - smoothing * mean_logp
+    else:
+        loss = nll
+    loss_ref[0, 0, pl.ds(i * block_rows, block_rows)] = loss
+    mlse_ref[0, 0, pl.ds(i * block_rows, block_rows)] = lse[:, 0]
+
+
+def _bwd_kernel(lg_ref, lb_ref, mlse_ref, g_ref, out_ref, *, smoothing,
+                block_rows):
+    i = pl.program_id(0)
+    lg = lg_ref[:].astype(jnp.float32)              # [br, V]
+    labels = lb_ref[0, 0, pl.ds(i * block_rows, block_rows)]
+    lse = mlse_ref[0, 0, pl.ds(i * block_rows, block_rows)]
+    g = g_ref[0, 0, pl.ds(i * block_rows, block_rows)]
+    V = lg.shape[-1]
+    softmax = jnp.exp(lg - lse[:, None])
+    cols = jax.lax.broadcasted_iota(jnp.int32, softmax.shape, 1)
+    onehot = (cols == labels[:, None]).astype(jnp.float32)
+    if smoothing > 0.0:
+        target = (1.0 - smoothing) * onehot + smoothing / V
+    else:
+        target = onehot
+    out_ref[:] = ((softmax - target) * g[:, None]).astype(out_ref.dtype)
+
+
+def _rows3(x, n):
+    return x.reshape(1, 1, n)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _xent(logits, labels, smoothing, interpret):
+    loss, _ = _xent_fwd(logits, labels, smoothing, interpret)
+    return loss
+
+
+def _block_rows(n):
+    b = 128 if n % 128 == 0 else 8
+    return b
+
+
+def _xent_fwd(logits, labels, smoothing, interpret):
+    n, v = logits.shape
+    br = _block_rows(n)
+    kernel = functools.partial(_fwd_kernel, smoothing=smoothing,
+                               block_rows=br)
+    loss, mlse = pl.pallas_call(
+        kernel,
+        grid=(n // br,),
+        in_specs=[
+            pl.BlockSpec((br, v), lambda i: (i, 0)),
+            pl.BlockSpec((1, 1, n), lambda i: (0, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, n), lambda i: (0, 0, 0)),
+            pl.BlockSpec((1, 1, n), lambda i: (0, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, 1, n), jnp.float32),
+            jax.ShapeDtypeStruct((1, 1, n), jnp.float32),
+        ],
+        interpret=interpret,
+    )(logits, _rows3(labels, n))
+    return loss.reshape(n), (logits, labels, mlse)
+
+
+def _xent_bwd(smoothing, interpret, res, g):
+    logits, labels, mlse = res
+    n, v = logits.shape
+    br = _block_rows(n)
+    kernel = functools.partial(_bwd_kernel, smoothing=smoothing,
+                               block_rows=br)
+    dlogits = pl.pallas_call(
+        kernel,
+        grid=(n // br,),
+        in_specs=[
+            pl.BlockSpec((br, v), lambda i: (i, 0)),
+            pl.BlockSpec((1, 1, n), lambda i: (0, 0, 0)),
+            pl.BlockSpec((1, 1, n), lambda i: (0, 0, 0)),
+            pl.BlockSpec((1, 1, n), lambda i: (0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((br, v), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, v), logits.dtype),
+        interpret=interpret,
+    )(logits, _rows3(labels, n), mlse, _rows3(g.astype(jnp.float32), n))
+    return dlogits, None
+
+
+_xent.defvjp(_xent_fwd, _xent_bwd)
+
+
+def softmax_cross_entropy_loss(logits, labels, smoothing: float = 0.0,
+                               interpret: bool = False):
+    """Per-example fused CE. logits: [..., V] (half ok), labels: [...] int.
+
+    Reference: apex/contrib/xentropy/softmax_xentropy.py —
+    SoftmaxCrossEntropyLoss(logits, labels, smoothing).
+    """
+    shape = logits.shape[:-1]
+    v = logits.shape[-1]
+    n = 1
+    for s in shape:
+        n *= s
+    lg2 = logits.reshape(n, v)
+    lb = labels.reshape(n)
+    aligned = v % 128 == 0 and (n % 128 == 0 or n % 8 == 0)
+    if not aligned:
+        return xent_reference(logits, labels, smoothing)
+    if jax.default_backend() == "cpu":
+        interpret = True
+    return _xent(lg2, lb, smoothing, interpret).reshape(shape)

@@ -1,0 +1,286 @@
+"""Contrib tier tests: clip_grad, focal_loss, index_mul_2d, group_norm,
+sparsity, transducer, fmha, multihead_attn (mirrors apex/contrib/test/)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import optax
+
+
+# -------------------------------------------------------------- clip_grad
+def test_clip_grad_norm_matches_optax():
+    from apex_tpu.contrib.clip_grad import clip_grad_norm
+    grads = {"a": jnp.full((64,), 3.0), "b": {"c": jnp.full((32, 4), -2.0)}}
+    clipped, norm = clip_grad_norm(grads, max_norm=1.0)
+    flat = np.concatenate([np.full(64, 3.0), np.full(128, -2.0)])
+    ref_norm = np.linalg.norm(flat)
+    np.testing.assert_allclose(float(norm), ref_norm, rtol=1e-5)
+    cflat = np.concatenate([np.asarray(clipped["a"]),
+                            np.asarray(clipped["b"]["c"]).ravel()])
+    np.testing.assert_allclose(np.linalg.norm(cflat), 1.0, rtol=1e-4)
+    # no-op when under the bound
+    small = {"a": jnp.full((8,), 1e-3)}
+    out, _ = clip_grad_norm(small, max_norm=1.0)
+    np.testing.assert_allclose(np.asarray(out["a"]), 1e-3, rtol=1e-5)
+
+
+# ------------------------------------------------------------- focal_loss
+def test_focal_loss_matches_autodiff():
+    from apex_tpu.contrib.focal_loss import focal_loss
+
+    def manual(lg, t, alpha=0.25, gamma=2.0):
+        p = jax.nn.sigmoid(lg)
+        ce = -(t * jnp.log(p) + (1 - t) * jnp.log1p(-p))
+        pt = p * t + (1 - p) * (1 - t)
+        at = alpha * t + (1 - alpha) * (1 - t)
+        return at * (1 - pt) ** gamma * ce
+
+    lg = jax.random.normal(jax.random.PRNGKey(0), (16, 8)) * 2
+    t = jax.random.bernoulli(jax.random.PRNGKey(1),
+                             0.3, (16, 8)).astype(jnp.float32)
+    np.testing.assert_allclose(np.asarray(focal_loss(lg, t)),
+                               np.asarray(manual(lg, t)), rtol=1e-5,
+                               atol=1e-6)
+    g = jax.grad(lambda x: jnp.sum(focal_loss(x, t)))(lg)
+    gr = jax.grad(lambda x: jnp.sum(manual(x, t)))(lg)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(gr), rtol=1e-4,
+                               atol=1e-5)
+
+
+# ----------------------------------------------------------- index_mul_2d
+def test_index_mul_2d():
+    from apex_tpu.contrib.index_mul_2d import index_mul_2d
+    in1 = jax.random.normal(jax.random.PRNGKey(0), (10, 4))
+    in2 = jax.random.normal(jax.random.PRNGKey(1), (6, 4))
+    idx = jnp.array([0, 3, 3, 9, 1, 5])
+    out = index_mul_2d(in1, in2, idx)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(in1)[np.asarray(idx)] *
+                               np.asarray(in2), rtol=1e-6)
+    # grads flow to both inputs (scatter-add into in1)
+    g1 = jax.grad(lambda a: jnp.sum(index_mul_2d(a, in2, idx)))(in1)
+    assert np.asarray(g1)[3].sum() != 0  # duplicated index accumulated
+    np.testing.assert_allclose(np.asarray(g1)[3],
+                               (np.asarray(in2)[1] + np.asarray(in2)[2]),
+                               rtol=1e-6)
+
+
+# ------------------------------------------------------------- group_norm
+def test_group_norm_nhwc_matches_flax():
+    from apex_tpu.contrib.group_norm import GroupNorm
+    import flax.linen as nn
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 8, 32))
+    m = GroupNorm(num_groups=4, num_channels=32)
+    v = m.init(jax.random.PRNGKey(1), x)
+    y = m.apply(v, x)
+    ref_m = nn.GroupNorm(num_groups=4)
+    ref_v = ref_m.init(jax.random.PRNGKey(1), x)
+    ref = ref_m.apply(ref_v, x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_group_norm_silu():
+    from apex_tpu.contrib.group_norm import group_norm_nhwc
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 4, 8))
+    y = group_norm_nhwc(x, 2, act="silu")
+    base = group_norm_nhwc(x, 2)
+    np.testing.assert_allclose(np.asarray(y),
+                               np.asarray(base) /
+                               (1 + np.exp(-np.asarray(base))),
+                               rtol=1e-4, atol=1e-5)
+
+
+# --------------------------------------------------------------- sparsity
+def test_asp_mask_2of4():
+    from apex_tpu.contrib.sparsity import create_mask, apply_masks, \
+        compute_sparse_masks
+    w = jax.random.normal(jax.random.PRNGKey(0), (16, 64))
+    mask = create_mask(w)
+    m = np.asarray(mask).reshape(-1, 4)
+    assert (m.sum(-1) == 2).all()  # exactly 2 of every 4 kept
+    # kept entries are the 2 largest |w| in each group
+    g = np.abs(np.asarray(w)).reshape(-1, 4)
+    for row, keep in zip(g, m):
+        kept = row[keep]
+        dropped = row[~keep]
+        assert kept.min() >= dropped.max() - 1e-7
+    params = {"dense": {"kernel": w, "bias": jnp.zeros((64,))}}
+    masks = compute_sparse_masks(params)
+    assert np.asarray(masks["dense"]["bias"]).all()  # bias not pruned
+    pruned = apply_masks(params, masks)
+    assert (np.asarray(pruned["dense"]["kernel"]) == 0).mean() == 0.5
+
+
+def test_asp_masked_optimizer_keeps_sparsity():
+    from apex_tpu.contrib.sparsity import ASP
+    w = jax.random.normal(jax.random.PRNGKey(0), (8, 16))
+    params = {"kernel": w}
+    pruned, tx = ASP.prune_trained_model(params, optax.sgd(0.1))
+    state = tx.init(pruned)
+    grads = {"kernel": jnp.ones_like(w)}
+    upd, state = tx.update(grads, state, pruned)
+    new_p = optax.apply_updates(pruned, upd)
+    zeros_before = np.asarray(pruned["kernel"]) == 0
+    assert (np.asarray(new_p["kernel"])[zeros_before] == 0).all()
+
+
+def test_permutation_search_improves_or_equal():
+    from apex_tpu.contrib.sparsity import permutation_search
+    w = jax.random.normal(jax.random.PRNGKey(5), (32, 16))
+    perm, gain = permutation_search(w, n_iter=200)
+    assert sorted(perm.tolist()) == list(range(16))
+    assert gain >= 0.0
+
+
+# ------------------------------------------------------------- transducer
+def test_transducer_joint():
+    from apex_tpu.contrib.transducer import transducer_joint
+    f = jax.random.normal(jax.random.PRNGKey(0), (2, 5, 8))
+    g = jax.random.normal(jax.random.PRNGKey(1), (2, 3, 8))
+    out = transducer_joint(f, g, relu=True)
+    ref = np.maximum(np.asarray(f)[:, :, None] + np.asarray(g)[:, None], 0)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-6)
+
+
+def _rnnt_ref(log_probs, labels, T, U, blank=0):
+    """O(TU) numpy dynamic program."""
+    lp = np.asarray(log_probs, np.float64)
+    alpha = np.full((T, U + 1), -np.inf)
+    alpha[0, 0] = 0.0
+    for t in range(T):
+        for u in range(U + 1):
+            if t == 0 and u == 0:
+                continue
+            cands = []
+            if t > 0:
+                cands.append(alpha[t - 1, u] + lp[t - 1, u, blank])
+            if u > 0:
+                cands.append(alpha[t, u - 1] + lp[t, u - 1, labels[u - 1]])
+            alpha[t, u] = np.logaddexp.reduce(cands) if cands else -np.inf
+    return -(alpha[T - 1, U] + lp[T - 1, U, blank])
+
+
+def test_transducer_loss_matches_dp():
+    from apex_tpu.contrib.transducer import transducer_loss
+    B, T, U, V = 2, 6, 3, 5
+    lp = jax.nn.log_softmax(
+        jax.random.normal(jax.random.PRNGKey(0), (B, T, U + 1, V)), -1)
+    labels = jax.random.randint(jax.random.PRNGKey(1), (B, U), 1, V)
+    f_len = jnp.array([T, T - 2])
+    y_len = jnp.array([U, U - 1])
+    loss = transducer_loss(lp, labels, f_len, y_len)
+    for b in range(B):
+        ref = _rnnt_ref(np.asarray(lp[b]), np.asarray(labels[b]),
+                        int(f_len[b]), int(y_len[b]))
+        np.testing.assert_allclose(float(loss[b]), ref, rtol=1e-4)
+
+
+def test_transducer_loss_grad_finite():
+    from apex_tpu.contrib.transducer import transducer_loss
+    B, T, U, V = 1, 4, 2, 4
+    x = jax.random.normal(jax.random.PRNGKey(0), (B, T, U + 1, V))
+    labels = jnp.ones((B, U), jnp.int32)
+    g = jax.grad(lambda x: jnp.sum(transducer_loss(
+        jax.nn.log_softmax(x, -1), labels, jnp.array([T]),
+        jnp.array([U]))))(x)
+    assert np.isfinite(np.asarray(g)).all()
+
+
+# ------------------------------------------------------------------- fmha
+def test_fmha_packed_matches_padded():
+    from apex_tpu.contrib.fmha import fmha
+    H, D = 2, 64
+    lens = [128, 128]  # two packed sequences
+    total = sum(lens)
+    qkv = jax.random.normal(jax.random.PRNGKey(0), (total, 3, H, D))
+    cu = jnp.array([0, 128, 256], jnp.int32)
+    out = fmha(qkv, cu, heads=H)
+    assert out.shape == (total, H, D)
+    # per-sequence check vs reference attention
+    from apex_tpu.kernels.flash_attention import mha_reference
+    for start, ln in ((0, 128), (128, 128)):
+        q = qkv[start:start + ln, 0].transpose(1, 0, 2)[None]
+        k = qkv[start:start + ln, 1].transpose(1, 0, 2)[None]
+        v = qkv[start:start + ln, 2].transpose(1, 0, 2)[None]
+        ref = mha_reference(q, k, v, scale=1.0 / D ** 0.5)[0] \
+            .transpose(1, 0, 2)
+        np.testing.assert_allclose(np.asarray(out[start:start + ln]),
+                                   np.asarray(ref), rtol=1e-4, atol=1e-4)
+
+
+# --------------------------------------------------------- multihead_attn
+def test_self_multihead_attn_matches_manual():
+    from apex_tpu.contrib.multihead_attn import SelfMultiheadAttn
+    S, B, E, H = 128, 2, 64, 4
+    m = SelfMultiheadAttn(embed_dim=E, num_heads=H, use_bias=False)
+    x = jax.random.normal(jax.random.PRNGKey(0), (S, B, E))
+    v = m.init(jax.random.PRNGKey(1), x)
+    y = m.apply(v, x, mask_future_timesteps=True, is_training=False)
+    assert y.shape == (S, B, E)
+
+    # manual reference from the same weights
+    wqkv = np.asarray(v["params"]["qkv_proj"]["kernel"])
+    wout = np.asarray(v["params"]["out_proj"]["kernel"])
+    xx = np.asarray(x)
+    qkv = xx @ wqkv
+    q, k, vv = np.split(qkv, 3, axis=-1)
+
+    def heads(t):
+        return t.reshape(S, B, H, E // H).transpose(1, 2, 0, 3)
+
+    qh, kh, vh = heads(q), heads(k), heads(vv)
+    s = np.einsum("bhqd,bhkd->bhqk", qh, kh) / np.sqrt(E // H)
+    mask = np.triu(np.ones((S, S), bool), 1)
+    s = np.where(mask, -1e30, s)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    o = np.einsum("bhqk,bhkd->bhqd", p, vh)
+    o = o.transpose(2, 0, 1, 3).reshape(S, B, E)
+    ref = o @ wout
+    np.testing.assert_allclose(np.asarray(y), ref, rtol=2e-3, atol=2e-3)
+
+
+def test_self_attn_norm_add_residual():
+    from apex_tpu.contrib.multihead_attn import SelfMultiheadAttn
+    S, B, E = 128, 1, 64
+    m = SelfMultiheadAttn(embed_dim=E, num_heads=4, include_norm_add=True)
+    x = jax.random.normal(jax.random.PRNGKey(0), (S, B, E)) * 100
+    v = m.init(jax.random.PRNGKey(1), x)
+    y = m.apply(v, x, is_training=False)
+    # with huge input, residual dominates → output ≈ x (pre-LN keeps attn
+    # contribution O(1))
+    corr = np.corrcoef(np.asarray(y).ravel(), np.asarray(x).ravel())[0, 1]
+    assert corr > 0.99
+
+
+def test_encdec_attn_shapes():
+    from apex_tpu.contrib.multihead_attn import EncdecMultiheadAttn
+    m = EncdecMultiheadAttn(embed_dim=64, num_heads=4)
+    q = jax.random.normal(jax.random.PRNGKey(0), (128, 2, 64))
+    kv = jax.random.normal(jax.random.PRNGKey(1), (256, 2, 64))
+    v = m.init(jax.random.PRNGKey(2), q, kv)
+    y = m.apply(v, q, kv, is_training=False)
+    assert y.shape == (128, 2, 64)
+
+
+def test_self_attn_prob_dropout_path():
+    """Dropout is applied to the softmax probabilities (reference
+    semantics), so a dropout run differs from deterministic but keeps
+    row-stochastic structure in expectation."""
+    from apex_tpu.contrib.multihead_attn import SelfMultiheadAttn
+    S, B, E = 128, 1, 64
+    m = SelfMultiheadAttn(embed_dim=E, num_heads=4, dropout=0.5)
+    x = jax.random.normal(jax.random.PRNGKey(0), (S, B, E))
+    v = m.init(jax.random.PRNGKey(1), x)
+    det = m.apply(v, x, is_training=False)
+    drop = m.apply(v, x, is_training=True,
+                   rngs={"dropout": jax.random.PRNGKey(2)})
+    assert not np.allclose(np.asarray(det), np.asarray(drop))
+    drop2 = m.apply(v, x, is_training=True,
+                    rngs={"dropout": jax.random.PRNGKey(2)})
+    np.testing.assert_allclose(np.asarray(drop), np.asarray(drop2))
