@@ -31,7 +31,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 __all__ = [
-    "AXIS_DATA", "AXIS_MODEL", "AXIS_PIPE", "AXIS_EXPERT",
+    "AXIS_DATA", "AXIS_MODEL", "AXIS_PIPE", "AXIS_EXPERT", "AXIS_CONTEXT",
     "make_mesh", "default_mesh", "get_mesh", "set_mesh", "reset_mesh",
     "axis_size",
     "all_reduce", "all_reduce_max", "all_gather", "reduce_scatter",
@@ -42,6 +42,7 @@ AXIS_DATA = "data"
 AXIS_MODEL = "model"
 AXIS_PIPE = "pipe"
 AXIS_EXPERT = "expert"
+AXIS_CONTEXT = "context"  # sequence/context parallel (ring attention)
 
 _MESH: Optional[Mesh] = None
 

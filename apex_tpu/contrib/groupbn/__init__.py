@@ -36,6 +36,10 @@ class BatchNorm2d_NHWC(nn.Module):
 
     @nn.compact
     def __call__(self, x, z=None, use_running_average: Optional[bool] = None):
+        if self.bn_group > 1 and self.axis_name is None:
+            raise ValueError(
+                "bn_group > 1 requires axis_name (the mesh axis defining "
+                "the sync group); otherwise stats would silently stay local")
         axis = self.axis_name if self.bn_group > 1 else None
         y = SyncBatchNorm(
             use_running_average=self.use_running_average

@@ -30,7 +30,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["flash_attention", "mha_reference"]
+__all__ = ["flash_attention", "mha_reference", "attn_chunk_fwd",
+           "attn_chunk_bwd"]
 
 _NEG_INF = -1e30
 DEFAULT_BLOCK_Q = 128
@@ -231,6 +232,47 @@ def _seg_flat(segment_ids, h):
     return jnp.repeat(segment_ids, h, axis=0)
 
 
+def _has_vma(x):
+    """True when ``x`` is varying over shard_map manual axes. Pallas
+    interpret mode (the CPU test path) cannot lower such inputs — its
+    internal dynamic_slice grid indexing mixes unvaried loop constants with
+    varying operands and trips check_vma — so dispatch falls back to the
+    jnp reference there. Real-TPU Mosaic lowering is unaffected."""
+    try:
+        return bool(jax.typeof(x).vma)
+    except (AttributeError, TypeError):
+        return False
+
+
+def _match_vma(x, like):
+    """Cast a freshly-created constant to the varying-manual-axes of ``like``
+    so it can mix with per-shard data inside shard_map(check_vma=True)."""
+    try:
+        vma = jax.typeof(like).vma
+        cur = jax.typeof(x).vma
+        missing = tuple(sorted(set(vma) - set(cur)))
+        if missing:
+            if hasattr(jax.lax, "pcast"):
+                return jax.lax.pcast(x, missing, to="varying")
+            return jax.lax.pvary(x, missing)
+    except (AttributeError, TypeError):
+        pass
+    return x
+
+
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct carrying the vma (varying-manual-axes) of ``like``,
+    so pallas_call outputs type-check inside shard_map(check_vma=True) —
+    the ring/Ulysses context-parallel wrappers call these kernels there."""
+    try:
+        vma = jax.typeof(like).vma
+        if vma:
+            return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except (AttributeError, TypeError):
+        pass
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _pallas_ok(sq, sk, d, bq, bk):
     # bk is the lane dim of the [bq, bk] score tile → multiple of 128;
     # bq is the sublane dim → multiple of 8.
@@ -243,8 +285,8 @@ def _fwd_pallas(q3, k3, v3, segq, segk, scale, causal, bq, bk, interpret):
     sk = k3.shape[1]
     have_segs = segq is not None
     if not have_segs:
-        segq = jnp.zeros((bh, sq), jnp.int32)
-        segk = jnp.zeros((bh, sk), jnp.int32)
+        segq = _match_vma(jnp.zeros((bh, sq), jnp.int32), q3)
+        segk = _match_vma(jnp.zeros((bh, sk), jnp.int32), q3)
     segq = segq.reshape(bh, 1, sq)
     segk = segk.reshape(bh, 1, sk)
     grid = (bh, sq // bq, sk // bk)
@@ -265,8 +307,8 @@ def _fwd_pallas(q3, k3, v3, segq, segk, scale, causal, bq, bk, interpret):
             pl.BlockSpec((1, 1, sq), lambda b, i, j: (b, 0, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
-            jax.ShapeDtypeStruct((bh, 1, sq), jnp.float32),
+            _sds((bh, sq, d), q3.dtype, q3),
+            _sds((bh, 1, sq), jnp.float32, q3),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, d), jnp.float32),
@@ -278,19 +320,17 @@ def _fwd_pallas(q3, k3, v3, segq, segk, scale, causal, bq, bk, interpret):
     return o, lse
 
 
-def _bwd_pallas(q3, k3, v3, do3, o3, lse, segq, segk, scale, causal, bq, bk,
+def _bwd_pallas(q3, k3, v3, do3, lse, delta, segq, segk, scale, causal, bq, bk,
                 interpret):
+    """delta: [bh, 1, sq] fp32 = sum(do * o, -1); lse: [bh, 1, sq] fp32."""
     bh, sq, d = q3.shape
     sk = k3.shape[1]
     have_segs = segq is not None
     if not have_segs:
-        segq = jnp.zeros((bh, sq), jnp.int32)
-        segk = jnp.zeros((bh, sk), jnp.int32)
+        segq = _match_vma(jnp.zeros((bh, sq), jnp.int32), q3)
+        segk = _match_vma(jnp.zeros((bh, sk), jnp.int32), q3)
     segq = segq.reshape(bh, 1, sq)
     segk = segk.reshape(bh, 1, sk)
-    delta = jnp.sum(jnp.asarray(do3, jnp.float32) *
-                    jnp.asarray(o3, jnp.float32), axis=-1,
-                    keepdims=True).reshape(bh, 1, sq)
 
     dkdv = pl.pallas_call(
         functools.partial(_bwd_dkdv_kernel, scale=scale, causal=causal,
@@ -311,8 +351,8 @@ def _bwd_pallas(q3, k3, v3, do3, o3, lse, segq, segk, scale, causal, bq, bk,
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sk, d), k3.dtype),
-            jax.ShapeDtypeStruct((bh, sk, d), v3.dtype),
+            _sds((bh, sk, d), k3.dtype, q3),
+            _sds((bh, sk, d), v3.dtype, q3),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, d), jnp.float32),
@@ -336,12 +376,91 @@ def _bwd_pallas(q3, k3, v3, do3, o3, lse, segq, segk, scale, causal, bq, bk,
             pl.BlockSpec((1, 1, sk), lambda b, i, j: (b, 0, 0)),   # segk
         ],
         out_specs=[pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0))],
-        out_shape=[jax.ShapeDtypeStruct((bh, sq, d), q3.dtype)],
+        out_shape=[_sds((bh, sq, d), q3.dtype, q3)],
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
     )(q3, k3, v3, do3, lse, delta, segq, segk)[0]
 
     return dq, dkdv[0], dkdv[1]
+
+
+# ------------------------------------------------- chunk API (ring attention)
+def _ref_chunk_fwd(q3, k3, v3, scale, causal):
+    """jnp chunk forward returning (o fp32-normalized, lse fp32)."""
+    q32, k32, v32 = (jnp.asarray(t, jnp.float32) for t in (q3, k3, v3))
+    s = jnp.einsum("bqd,bkd->bqk", q32, k32) * scale
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        s = jnp.where(jnp.tril(jnp.ones((sq, sk), bool)), s, _NEG_INF)
+    m = jnp.max(s, axis=-1)
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o = jnp.einsum("bqk,bkd->bqd", p, v32) / l_safe[..., None]
+    lse = m + jnp.log(l_safe)
+    return o, lse
+
+
+def _ref_chunk_bwd(q3, k3, v3, do3, lse, delta, scale, causal):
+    """jnp chunk backward given fwd residuals (lse [bh,s], delta=sum(do*o))."""
+    q32, k32, v32 = (jnp.asarray(t, jnp.float32) for t in (q3, k3, v3))
+    do32 = jnp.asarray(do3, jnp.float32)
+    s = jnp.einsum("bqd,bkd->bqk", q32, k32) * scale
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        s = jnp.where(jnp.tril(jnp.ones((sq, sk), bool)), s, _NEG_INF)
+    p = jnp.exp(s - lse[..., None])
+    dv = jnp.einsum("bqk,bqd->bkd", p, do32)
+    dp = jnp.einsum("bqd,bkd->bqk", do32, v32)
+    ds = p * (dp - delta[..., None]) * scale
+    dq = jnp.einsum("bqk,bkd->bqd", ds, k32)
+    dk = jnp.einsum("bqk,bqd->bkd", ds, q32)
+    return dq, dk, dv
+
+
+def attn_chunk_fwd(q3, k3, v3, *, scale, causal,
+                   block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                   interpret=False):
+    """One attention block: [bh, sq, d] x [bh, sk, d] -> (o fp32, lse fp32).
+
+    The building block ring attention rotates KV around (SURVEY §6: the
+    kernel is blockwise over KV precisely so context parallelism can reuse
+    it). Output is softmax-normalized *within the chunk*; ``lse`` lets the
+    caller re-weight when combining chunks (o, lse) -> global softmax.
+    """
+    sq, sk, d = q3.shape[1], k3.shape[1], q3.shape[2]
+    bq, bk = min(block_q, sq), min(block_k, sk)
+    if jax.default_backend() == "cpu":
+        interpret = True
+    if not _pallas_ok(sq, sk, d, bq, bk) or (interpret and _has_vma(q3)):
+        return _ref_chunk_fwd(q3, k3, v3, scale, causal)
+    o3, lse = _fwd_pallas(q3, k3, v3, None, None, scale, causal, bq, bk,
+                          interpret)
+    return jnp.asarray(o3, jnp.float32), lse[:, 0, :]
+
+
+def attn_chunk_bwd(q3, k3, v3, do3, lse, delta, *, scale, causal,
+                   block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                   interpret=False):
+    """Chunk backward given residuals; returns fp32 (dq, dk, dv)."""
+    sq, sk, d = q3.shape[1], k3.shape[1], q3.shape[2]
+    bq, bk = min(block_q, sq), min(block_k, sk)
+    if jax.default_backend() == "cpu":
+        interpret = True
+    if not _pallas_ok(sq, sk, d, bq, bk) or (interpret and _has_vma(q3)):
+        return _ref_chunk_bwd(q3, k3, v3, do3, lse, delta, scale, causal)
+    # _bwd_pallas recomputes p from lse and reads delta directly; o3 itself
+    # is not needed once delta is in hand, so pass delta through.
+    bh = q3.shape[0]
+    lse3 = lse.reshape(bh, 1, sq)
+    q32 = jnp.asarray(q3, jnp.float32)
+    k32 = jnp.asarray(k3, jnp.float32)
+    v32 = jnp.asarray(v3, jnp.float32)
+    do32 = jnp.asarray(do3, jnp.float32)
+    dq, dk, dv = _bwd_pallas(q32, k32, v32, do32, lse3,
+                             delta.reshape(bh, 1, sq), None, None,
+                             scale, causal, bq, bk, interpret)
+    return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
@@ -368,8 +487,12 @@ def _flash_fwd(q, k, v, segment_ids, causal, scale, block_q, block_k,
 def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
     q3, k3, v3, o3, lse, segq, segk, b, h = res
     do3 = _flatten(g)
-    dq3, dk3, dv3 = _bwd_pallas(q3, k3, v3, do3, o3, lse, segq, segk, scale,
-                                causal, block_q, block_k, interpret)
+    bh, sq = q3.shape[0], q3.shape[1]
+    delta = jnp.sum(jnp.asarray(do3, jnp.float32) *
+                    jnp.asarray(o3, jnp.float32), axis=-1,
+                    keepdims=True).reshape(bh, 1, sq)
+    dq3, dk3, dv3 = _bwd_pallas(q3, k3, v3, do3, lse, delta, segq, segk,
+                                scale, causal, block_q, block_k, interpret)
     sq, d = q3.shape[1], q3.shape[2]
     sk = k3.shape[1]
     dq = dq3.reshape(b, h, sq, d)
@@ -399,9 +522,9 @@ def flash_attention(q, k, v, *, causal: bool = False,
     sq, sk = q.shape[2], k.shape[2]
     bq = min(block_q, sq)
     bk = min(block_k, sk)
-    if not _pallas_ok(sq, sk, d, bq, bk):
-        return mha_reference(q, k, v, causal=causal, scale=scale,
-                             segment_ids=segment_ids)
     if jax.default_backend() == "cpu":
         interpret = True  # pallas-TPU lowering needs a TPU; CPU interprets
+    if not _pallas_ok(sq, sk, d, bq, bk) or (interpret and _has_vma(q)):
+        return mha_reference(q, k, v, causal=causal, scale=scale,
+                             segment_ids=segment_ids)
     return _flash(q, k, v, segment_ids, causal, scale, bq, bk, interpret)

@@ -7,13 +7,16 @@ collectives (shard_map) or sharding constraints (pjit); PP becomes
 collective-permute pipelining over the ``pipe`` axis.
 """
 
+from . import context_parallel  # noqa: F401
 from . import enums  # noqa: F401
 from . import functional  # noqa: F401
 from . import parallel_state  # noqa: F401
 from . import pipeline_parallel  # noqa: F401
 from . import tensor_parallel  # noqa: F401
+from .context_parallel import ring_attention, ulysses_attention  # noqa: F401
 from .enums import AttnMaskType, AttnType, LayerType, ModelType  # noqa: F401
 
 __all__ = ["parallel_state", "tensor_parallel", "pipeline_parallel",
-           "functional", "enums", "AttnMaskType", "AttnType", "LayerType",
-           "ModelType"]
+           "functional", "enums", "context_parallel", "AttnMaskType",
+           "AttnType", "LayerType", "ModelType", "ring_attention",
+           "ulysses_attention"]

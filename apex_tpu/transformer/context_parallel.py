@@ -1,0 +1,222 @@
+"""Context parallelism: ring attention + Ulysses (all-to-all) attention.
+
+The reference has NO context parallelism (SURVEY §3.3: "CP / ring attention /
+Ulysses — absent from apex"); its only long-sequence mechanisms are Megatron
+sequence parallelism and fused attention kernels. On TPU, long-context
+distribution is first-class, so this module supplies both standard schemes
+on top of the blockwise flash kernel (apex_tpu/kernels/flash_attention.py),
+which was written chunkwise-over-KV precisely so these slot in:
+
+- :func:`ring_attention` — sequence sharded over a ``context`` mesh axis;
+  KV chunks rotate around the ring via ``jax.lax.ppermute`` while each
+  device's Q stays put, combining per-chunk (o, logsumexp) partial softmaxes
+  into the exact global softmax. Memory per chip is O(seq/n); the rotation
+  rides ICI neighbour links. Backward rotates (k, v, dk, dv) together so
+  gradients arrive home after exactly n hops.
+- :func:`ulysses_attention` — all-to-all head scatter: seq-sharded activations
+  are transposed to head-sharded via ``lax.all_to_all``, full-sequence flash
+  attention runs locally on heads/n heads, and a second all-to-all restores
+  sequence sharding. Cheaper collectives for moderate sequence lengths;
+  requires num_heads % axis_size == 0.
+
+Both are exact (not approximations) and differentiable; both must be called
+inside ``shard_map`` with the sequence dimension sharded over ``axis_name``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.comm import AXIS_CONTEXT
+from apex_tpu.kernels.flash_attention import (attn_chunk_bwd, attn_chunk_fwd,
+                                              flash_attention)
+
+__all__ = ["ring_attention", "ulysses_attention", "AXIS_CONTEXT"]
+
+_NEG_INF = -1e30
+
+
+def _axis_size(axis_name):
+    # Static under shard_map: psum of a literal 1 over the axis.
+    return lax.psum(1, axis_name)
+
+
+def _pvary(x, axis_name):
+    """Mark a constant as device-varying over ``axis_name`` so it types
+    consistently with per-shard data in cond/switch/loop carries."""
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, (axis_name,), to="varying")
+    return lax.pvary(x, (axis_name,))
+
+
+def _flat(x):
+    b, h, s, d = x.shape
+    return x.reshape(b * h, s, d)
+
+
+def _combine(o_run, lse_run, o_t, lse_t):
+    """Merge two normalized partial-softmax results (o, lse) exactly."""
+    lse_new = jnp.logaddexp(lse_run, lse_t)
+    w1 = jnp.exp(lse_run - lse_new)[..., None]
+    w2 = jnp.exp(lse_t - lse_new)[..., None]
+    return o_run * w1 + o_t * w2, lse_new
+
+
+def _rotate(tree, axis_name, n):
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    return jax.tree.map(lambda x: lax.ppermute(x, axis_name, perm), tree)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring(q, k, v, axis_name, causal, scale):
+    out, _ = _ring_fwd(q, k, v, axis_name, causal, scale)
+    return out
+
+
+def _chunk_cases(q3, k3, v3, causal, scale, kv_idx, my_idx, axis_name):
+    """(o, lse) for one ring step, dispatching on the chunk relation.
+
+    With contiguous sequence chunks, chunk j is entirely *before* chunk i in
+    global positions when j < i → unmasked; j == i → local causal mask;
+    j > i → fully masked out (skip). Non-causal always takes the full path.
+    """
+    if not causal:
+        return attn_chunk_fwd(q3, k3, v3, scale=scale, causal=False)
+    bh, s, d = q3.shape
+
+    def full(_):
+        return attn_chunk_fwd(q3, k3, v3, scale=scale, causal=False)
+
+    def diag(_):
+        return attn_chunk_fwd(q3, k3, v3, scale=scale, causal=True)
+
+    def skip(_):
+        return (_pvary(jnp.zeros((bh, s, d), jnp.float32), axis_name),
+                _pvary(jnp.full((bh, s), _NEG_INF, jnp.float32), axis_name))
+
+    branch = jnp.where(kv_idx < my_idx, 0, jnp.where(kv_idx == my_idx, 1, 2))
+    return lax.switch(branch, [full, diag, skip], None)
+
+
+def _ring_fwd(q, k, v, axis_name, causal, scale):
+    n = _axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    b, h, s, d = q.shape
+    q3, k3, v3 = _flat(q), _flat(k), _flat(v)
+
+    def step(t, carry):
+        o_run, lse_run, k_cur, v_cur = carry
+        kv_idx = (idx - t) % n
+        o_t, lse_t = _chunk_cases(q3, k_cur, v_cur, causal, scale, kv_idx,
+                                  idx, axis_name)
+        o_run, lse_run = _combine(o_run, lse_run, o_t, lse_t)
+        k_cur, v_cur = _rotate((k_cur, v_cur), axis_name, n)
+        return o_run, lse_run, k_cur, v_cur
+
+    # Constant-initialized carries are "replicated" over the axis while the
+    # loop body makes them device-varying; align the types.
+    o0 = _pvary(jnp.zeros((b * h, s, d), jnp.float32), axis_name)
+    lse0 = _pvary(jnp.full((b * h, s), _NEG_INF, jnp.float32), axis_name)
+    o3, lse, _, _ = lax.fori_loop(0, n, step, (o0, lse0, k3, v3))
+    out = o3.astype(q.dtype).reshape(b, h, s, d)
+    return out, (q3, k3, v3, o3, lse)
+
+
+def _ring_bwd(axis_name, causal, scale, res, g):
+    q3, k3, v3, o3, lse = res
+    b, h = g.shape[0], g.shape[1]
+    n = _axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    do3 = _flat(g)
+    delta = jnp.sum(jnp.asarray(do3, jnp.float32) * o3, axis=-1)  # [bh, s]
+
+    def bwd_cases(k_cur, v_cur, kv_idx):
+        if not causal:
+            return attn_chunk_bwd(q3, k_cur, v_cur, do3, lse, delta,
+                                  scale=scale, causal=False)
+
+        def full(_):
+            return attn_chunk_bwd(q3, k_cur, v_cur, do3, lse, delta,
+                                  scale=scale, causal=False)
+
+        def diag(_):
+            return attn_chunk_bwd(q3, k_cur, v_cur, do3, lse, delta,
+                                  scale=scale, causal=True)
+
+        def skip(_):
+            return (_pvary(jnp.zeros(q3.shape, jnp.float32), axis_name),
+                    _pvary(jnp.zeros(k_cur.shape, jnp.float32), axis_name),
+                    _pvary(jnp.zeros(v_cur.shape, jnp.float32), axis_name))
+
+        branch = jnp.where(kv_idx < idx, 0, jnp.where(kv_idx == idx, 1, 2))
+        return lax.switch(branch, [full, diag, skip], None)
+
+    def step(t, carry):
+        dq, k_cur, v_cur, dk_acc, dv_acc = carry
+        kv_idx = (idx - t) % n
+        dq_t, dk_t, dv_t = bwd_cases(k_cur, v_cur, kv_idx)
+        dq = dq + dq_t
+        dk_acc = dk_acc + dk_t
+        dv_acc = dv_acc + dv_t
+        # dk/dv rotate WITH their kv chunk: after n hops they are home.
+        k_cur, v_cur, dk_acc, dv_acc = _rotate(
+            (k_cur, v_cur, dk_acc, dv_acc), axis_name, n)
+        return dq, k_cur, v_cur, dk_acc, dv_acc
+
+    dq0 = _pvary(jnp.zeros(q3.shape, jnp.float32), axis_name)
+    dk0 = _pvary(jnp.zeros(k3.shape, jnp.float32), axis_name)
+    dv0 = _pvary(jnp.zeros(v3.shape, jnp.float32), axis_name)
+    dq, _, _, dk, dv = lax.fori_loop(0, n, step, (dq0, k3, v3, dk0, dv0))
+
+    s, d = q3.shape[1], q3.shape[2]
+    return (dq.astype(q3.dtype).reshape(b, h, s, d),
+            dk.astype(k3.dtype).reshape(b, h, k3.shape[1], d),
+            dv.astype(v3.dtype).reshape(b, h, v3.shape[1], d))
+
+
+_ring.defvjp(_ring_fwd, _ring_bwd)
+
+
+def ring_attention(q, k, v, *, axis_name: str = AXIS_CONTEXT,
+                   causal: bool = False, scale: Optional[float] = None):
+    """Exact ring attention over a context-parallel mesh axis.
+
+    q, k, v: [batch, heads, local_seq, head_dim], sequence sharded
+    contiguously over ``axis_name`` (shard i holds global positions
+    [i*local_seq, (i+1)*local_seq)). Must be called inside shard_map.
+    """
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    return _ring(q, k, v, axis_name, causal, float(scale))
+
+
+def ulysses_attention(q, k, v, *, axis_name: str = AXIS_CONTEXT,
+                      causal: bool = False, scale: Optional[float] = None,
+                      segment_ids: Optional[jnp.ndarray] = None):
+    """All-to-all (DeepSpeed-Ulysses-style) sequence-parallel attention.
+
+    Seq-sharded [b, h, s/n, d] → head-sharded [b, h/n, s, d] via
+    ``lax.all_to_all``, full-sequence flash attention locally, then the
+    inverse all-to-all. Differentiable end-to-end (all_to_all transposes to
+    itself); requires heads % axis_size == 0.
+    """
+    n = _axis_size(axis_name)
+    h = q.shape[1]
+    if h % n != 0:
+        raise ValueError(f"heads ({h}) not divisible by axis size ({n})")
+    qh, kh, vh = (lax.all_to_all(t, axis_name, split_axis=1, concat_axis=2,
+                                 tiled=True) for t in (q, k, v))
+    if segment_ids is not None and segment_ids.shape[1] != qh.shape[2]:
+        # seq-sharded [b, s/n] like q — gather to the full sequence the
+        # post-all_to_all attention runs over.
+        segment_ids = lax.all_gather(segment_ids, axis_name, axis=1,
+                                     tiled=True)
+    out = flash_attention(qh, kh, vh, causal=causal, scale=scale,
+                          segment_ids=segment_ids)
+    return lax.all_to_all(out, axis_name, split_axis=2, concat_axis=1,
+                          tiled=True)
