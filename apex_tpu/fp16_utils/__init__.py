@@ -1,0 +1,33 @@
+"""apex_tpu.fp16_utils — legacy manual mixed-precision API.
+
+TPU equivalent of apex/fp16_utils/ (reference: fp16util.py, loss_scaler.py,
+fp16_optimizer.py — the pre-amp API kept for backward compatibility). New code
+should use apex_tpu.amp; this tier exists for apex API parity.
+"""
+
+from apex_tpu.fp16_utils.fp16util import (
+    BN_convert_float,
+    clip_grad_norm,
+    convert_network,
+    master_params_to_model_params,
+    model_grads_to_master_grads,
+    network_to_half,
+    prep_param_lists,
+    to_python_float,
+)
+from apex_tpu.fp16_utils.loss_scaler import DynamicLossScaler, LossScaler
+from apex_tpu.fp16_utils.fp16_optimizer import FP16_Optimizer
+
+__all__ = [
+    "BN_convert_float",
+    "DynamicLossScaler",
+    "FP16_Optimizer",
+    "LossScaler",
+    "clip_grad_norm",
+    "convert_network",
+    "master_params_to_model_params",
+    "model_grads_to_master_grads",
+    "network_to_half",
+    "prep_param_lists",
+    "to_python_float",
+]

@@ -1,0 +1,130 @@
+"""FP16_Optimizer — TPU equivalent of apex/fp16_utils/fp16_optimizer.py.
+
+Reference (fp16_optimizer.py — class FP16_Optimizer): the pre-amp manual
+mixed-precision wrapper. It owns fp32 master copies of the (half) model
+params, scales the loss, and on ``step``:
+
+  1. check grads for inf/nan (DynamicLossScaler.has_overflow)
+  2. overflow → update_scale, SKIP (optimizer state must not advance)
+  3. else: model grads → fp32 master grads, ÷ scale, optional global-norm clip
+  4. inner optimizer steps the masters
+  5. masters copied back into the model's half params
+
+TPU design: wraps an optax ``GradientTransformation`` instead of a torch
+optimizer; params/grads are pytrees. The overflow-gated step runs under jit
+with ``lax.cond``-free ``tree_map(where)`` select so the whole thing is one
+compiled program; the Python-level scaler bookkeeping (scale schedule) stays
+host-side exactly like apex's.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex_tpu.fp16_utils.fp16util import (
+    clip_grad_norm,
+    master_params_to_model_params,
+    model_grads_to_master_grads,
+)
+from apex_tpu.fp16_utils.loss_scaler import DynamicLossScaler, LossScaler
+
+
+class FP16_Optimizer:
+    """Manual master-weight mixed precision (fp16_optimizer.py — FP16_Optimizer)."""
+
+    def __init__(
+        self,
+        optimizer: optax.GradientTransformation,
+        params: Any,
+        static_loss_scale: float = 1.0,
+        dynamic_loss_scale: bool = False,
+        dynamic_loss_args: Optional[dict] = None,
+        verbose: bool = False,
+    ):
+        self.optimizer = optimizer
+        self.verbose = verbose
+        if dynamic_loss_scale:
+            self.loss_scaler = DynamicLossScaler(**(dynamic_loss_args or {}))
+        else:
+            self.loss_scaler = LossScaler(static_loss_scale)
+
+        # fp32 masters + inner optimizer state live here (apex: param_groups
+        # rewritten to point at masters; optimizer state keyed on them).
+        self.fp32_params = jax.tree_util.tree_map(
+            lambda p: jnp.asarray(p, jnp.float32), params)
+        self.opt_state = optimizer.init(self.fp32_params)
+        self.overflow = False
+
+    # -- loss scaling ------------------------------------------------------
+    def scale_loss(self, loss):
+        """Scaled loss for the caller to differentiate.
+
+        apex's ``backward(loss)`` calls ``loss*scale .backward()``; in jax the
+        caller owns autodiff, so the analogue is
+        ``grads = grad(lambda p: opt.scale_loss(loss_fn(p)))(params)``.
+        """
+        return loss * jnp.asarray(self.loss_scaler.loss_scale,
+                                  jnp.asarray(loss).dtype)
+
+    @property
+    def loss_scale(self) -> float:
+        return self.loss_scaler.loss_scale
+
+    # -- step --------------------------------------------------------------
+    def step(self, model_grads: Any, model_params: Any,
+             max_grad_norm: Optional[float] = None) -> Any:
+        """Returns updated model params (same dtypes as ``model_params``).
+
+        Mirrors fp16_optimizer.py — step: overflow check happens on the raw
+        model grads (pre-unscale), matching apex's has_overflow placement.
+        """
+        self.overflow = self.loss_scaler.has_overflow(model_grads)
+        self.loss_scaler.update_scale(self.overflow)
+        if self.overflow:
+            if self.verbose:
+                print(f"OVERFLOW! Skipping step. Reducing loss scale to "
+                      f"{self.loss_scaler.loss_scale}")
+            return model_params
+
+        master_grads = model_grads_to_master_grads(model_grads)
+        inv = 1.0 / self.loss_scaler.loss_scale
+        master_grads = jax.tree_util.tree_map(
+            lambda g: g * jnp.float32(inv), master_grads)
+        if max_grad_norm is not None:
+            master_grads, _ = clip_grad_norm(master_grads, max_grad_norm)
+
+        updates, self.opt_state = self.optimizer.update(
+            master_grads, self.opt_state, self.fp32_params)
+        self.fp32_params = optax.apply_updates(self.fp32_params, updates)
+        return master_params_to_model_params(self.fp32_params, model_params)
+
+    def clip_master_grads(self, grads: Any, max_norm: float):
+        """fp16_optimizer.py — clip_master_grads (exposed for manual loops)."""
+        return clip_grad_norm(grads, max_norm)
+
+    # -- checkpointing -----------------------------------------------------
+    def state_dict(self) -> dict:
+        sd = {
+            "loss_scale": self.loss_scaler.loss_scale,
+            "overflow": self.overflow,
+            "fp32_params": self.fp32_params,
+            "opt_state": self.opt_state,
+        }
+        if isinstance(self.loss_scaler, DynamicLossScaler):
+            sd["cur_iter"] = self.loss_scaler.cur_iter
+            sd["last_overflow_iter"] = self.loss_scaler.last_overflow_iter
+        return sd
+
+    def load_state_dict(self, sd: dict) -> None:
+        self.loss_scaler.cur_scale = float(sd["loss_scale"])
+        self.overflow = bool(sd["overflow"])
+        self.fp32_params = sd["fp32_params"]
+        self.opt_state = sd["opt_state"]
+        if isinstance(self.loss_scaler, DynamicLossScaler):
+            self.loss_scaler.cur_iter = int(sd.get("cur_iter", 0))
+            self.loss_scaler.last_overflow_iter = int(
+                sd.get("last_overflow_iter", -1))

@@ -1,0 +1,163 @@
+"""fp16/bf16 conversion helpers — TPU equivalent of apex/fp16_utils/fp16util.py.
+
+Reference symbols mirrored (apex/fp16_utils/fp16util.py — network_to_half,
+BN_convert_float, prep_param_lists, model_grads_to_master_grads,
+master_params_to_model_params, to_python_float, clip_grad_norm):
+
+- apex converts ``nn.Module`` trees in place, keeping BatchNorm modules fp32
+  for numeric safety. Here the model is a param pytree, so conversion is a
+  ``tree_map`` with a path predicate standing in for the module-type check.
+- ``prep_param_lists`` pairs the (half) model params with fp32 master copies;
+  ``model_grads_to_master_grads`` / ``master_params_to_model_params`` are the
+  two copies in apex's manual mixed-precision loop (csrc-free pure ops here —
+  XLA fuses the casts into adjacent work).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# Module-path fragments treated as "BatchNorm" for keep-fp32 purposes —
+# the pytree analogue of apex's ``isinstance(module, _BatchNorm)`` check.
+_BN_PATH_FRAGMENTS = ("batchnorm", "batch_norm", "bn", "syncbatchnorm")
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        key = getattr(p, "key", None)
+        if key is None:
+            key = getattr(p, "idx", None)
+        parts.append(str(key))
+    return "/".join(parts).lower()
+
+
+def is_batchnorm_path(path) -> bool:
+    """True when a pytree path addresses a batch-norm parameter."""
+    s = _path_str(path)
+    return any(frag in s for frag in _BN_PATH_FRAGMENTS)
+
+
+def network_to_half(
+    params: Any,
+    dtype: jnp.dtype = jnp.bfloat16,
+    keep_fp32: Optional[Callable[[Any], bool]] = is_batchnorm_path,
+) -> Any:
+    """Cast a param pytree to half precision, keeping BN params fp32.
+
+    Mirrors fp16util.py — network_to_half + BN_convert_float: apex wraps the
+    model in ``nn.Sequential(tofp16(), convert_module'd model)``; functionally
+    that is exactly "cast every non-BN floating leaf". ``dtype`` defaults to
+    bf16, the TPU-native half type (fp16 accepted for scaler tests).
+    """
+
+    def cast(path, leaf):
+        leaf = jnp.asarray(leaf)
+        if not jnp.issubdtype(leaf.dtype, jnp.floating):
+            return leaf
+        if keep_fp32 is not None and keep_fp32(path):
+            return leaf.astype(jnp.float32)
+        return leaf.astype(dtype)
+
+    return jax.tree_util.tree_map_with_path(cast, params)
+
+
+def convert_network(params: Any, dtype: jnp.dtype = jnp.bfloat16) -> Any:
+    """Alias with apex's name (fp16util.py — convert_network)."""
+    return network_to_half(params, dtype=dtype)
+
+
+def BN_convert_float(params: Any) -> Any:
+    """Force batch-norm params back to fp32 (fp16util.py — BN_convert_float).
+
+    Apex applies it to a module tree after ``.half()``; the pytree analogue
+    re-casts every BN-path leaf of an already-halved tree.
+    """
+
+    def cast(path, leaf):
+        leaf = jnp.asarray(leaf)
+        if jnp.issubdtype(leaf.dtype, jnp.floating) and is_batchnorm_path(path):
+            return leaf.astype(jnp.float32)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(cast, params)
+
+
+def prep_param_lists(params: Any,
+                     flat_master: bool = False) -> Tuple[Any, Any]:
+    """(model_params, fp32 master copies).
+
+    fp16util.py — prep_param_lists: with ``flat_master=True`` apex flattens all
+    masters into one contiguous fp32 buffer (_flatten_dense_tensors). Here the
+    flat variant returns (params, (flat_fp32_vector, unravel_fn)) via pytree
+    ravel — same memory layout win, jax-native mechanism.
+    """
+    if flat_master:
+        from apex_tpu.utils.pytree import flatten_tree  # apex_C.flatten parity
+
+        flat, spec = flatten_tree(
+            jax.tree_util.tree_map(lambda p: jnp.asarray(p, jnp.float32),
+                                   params))
+        return params, (flat, spec)
+    master = jax.tree_util.tree_map(
+        lambda p: jnp.asarray(p, jnp.float32), params)
+    return params, master
+
+
+def model_grads_to_master_grads(model_grads: Any, flat: bool = False) -> Any:
+    """Cast (half) model grads to fp32 master grads.
+
+    fp16util.py — model_grads_to_master_grads.
+    """
+    master = jax.tree_util.tree_map(
+        lambda g: jnp.asarray(g, jnp.float32), model_grads)
+    if flat:
+        from apex_tpu.utils.pytree import flatten_tree
+
+        return flatten_tree(master)[0]
+    return master
+
+
+def master_params_to_model_params(master_params: Any,
+                                  model_params: Any) -> Any:
+    """Copy fp32 masters back into the model's dtypes (shape-preserving).
+
+    fp16util.py — master_params_to_model_params.
+    """
+    return jax.tree_util.tree_map(
+        lambda m, p: jnp.asarray(m, jnp.asarray(p).dtype),
+        master_params, model_params)
+
+
+def to_python_float(t) -> float:
+    """fp16util.py — to_python_float (``t.item()`` with list fallback)."""
+    arr = jnp.asarray(t)
+    return float(arr.reshape(()))
+
+
+def clip_grad_norm(grads: Any, max_norm: float,
+                   norm_type: float = 2.0) -> Tuple[Any, jnp.ndarray]:
+    """Global-norm clip over a grad pytree; returns (clipped, total_norm).
+
+    fp16util.py — clip_grad_norm (re-export of torch's): computes the global
+    norm in fp32 and scales every grad by ``max_norm / (norm + 1e-6)`` when
+    over. The fp32 accumulation is the part that matters for parity.
+    """
+    leaves = jax.tree_util.tree_leaves(grads)
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack(
+            [jnp.max(jnp.abs(jnp.asarray(l, jnp.float32))) for l in leaves]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(jnp.asarray(l, jnp.float32)) ** norm_type)
+             for l in leaves])) ** (1.0 / norm_type)
+    clip = jnp.minimum(1.0, max_norm / (total + 1e-6))
+
+    def scale(g):
+        return (jnp.asarray(g, jnp.float32) * clip).astype(
+            jnp.asarray(g).dtype)
+
+    return jax.tree_util.tree_map(scale, grads), total

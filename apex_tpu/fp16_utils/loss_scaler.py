@@ -1,0 +1,82 @@
+"""Legacy loss scalers — TPU equivalent of apex/fp16_utils/loss_scaler.py.
+
+Reference symbols (loss_scaler.py — class LossScaler, class DynamicLossScaler):
+the pre-amp manual API. ``LossScaler`` is a fixed scale with no overflow
+tracking; ``DynamicLossScaler`` starts high (2**32 in apex's legacy default),
+halves on overflow, doubles after ``scale_window`` clean iterations.
+
+These are thin shims over the shared scaler math in apex_tpu.amp.scaler (the
+modern path); kept as distinct classes because apex's two APIs differ:
+legacy exposes ``scale`` (attr) / ``has_overflow(params)`` / ``update_scale
+(overflow)``, amp's exposes ``loss_scale()`` / implicit overflow tracking.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _has_inf_or_nan(x) -> bool:
+    """loss_scaler.py — DynamicLossScaler._has_inf_or_nan (per-tensor check)."""
+    arr = jnp.asarray(x)
+    if not jnp.issubdtype(arr.dtype, jnp.floating):
+        return False
+    return bool(jnp.logical_not(jnp.all(jnp.isfinite(arr))))
+
+
+class LossScaler:
+    """Static scale. loss_scaler.py — class LossScaler."""
+
+    def __init__(self, scale: float = 1.0):
+        self.cur_scale = float(scale)
+
+    @property
+    def loss_scale(self) -> float:
+        return self.cur_scale
+
+    # apex's legacy API takes the param/grad list; static scaler never overflows
+    def has_overflow(self, params) -> bool:
+        return False
+
+    def update_scale(self, overflow: bool) -> None:
+        pass
+
+    def scale_gradient(self, grads):
+        return jax.tree_util.tree_map(
+            lambda g: g * jnp.asarray(self.cur_scale, jnp.asarray(g).dtype),
+            grads)
+
+    def backward(self, loss):
+        """Return the scaled loss (caller differentiates it)."""
+        return loss * jnp.asarray(self.cur_scale, jnp.asarray(loss).dtype)
+
+
+class DynamicLossScaler(LossScaler):
+    """loss_scaler.py — class DynamicLossScaler.
+
+    Legacy schedule: ``scale_factor`` 2.0, ``scale_window`` 1000 (the legacy
+    default; amp's LossScaler uses 2000), init 2**32.
+    """
+
+    def __init__(self, init_scale: float = 2.0 ** 32,
+                 scale_factor: float = 2.0, scale_window: int = 1000):
+        super().__init__(init_scale)
+        self.scale_factor = float(scale_factor)
+        self.scale_window = int(scale_window)
+        self.last_overflow_iter = -1
+        self.cur_iter = 0
+
+    def has_overflow(self, grads) -> bool:
+        for leaf in jax.tree_util.tree_leaves(grads):
+            if _has_inf_or_nan(leaf):
+                return True
+        return False
+
+    def update_scale(self, overflow: bool) -> None:
+        if overflow:
+            self.cur_scale = max(self.cur_scale / self.scale_factor, 1.0)
+            self.last_overflow_iter = self.cur_iter
+        elif (self.cur_iter - self.last_overflow_iter) % self.scale_window == 0:
+            self.cur_scale *= self.scale_factor
+        self.cur_iter += 1

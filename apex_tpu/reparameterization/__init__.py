@@ -1,0 +1,174 @@
+"""apex_tpu.reparameterization — weight-norm reparameterization.
+
+TPU equivalent of apex/reparameterization/ (reference:
+reparameterization.py — class Reparameterization; weight_norm.py — class
+WeightNorm). Apex's version exists because torch's weight_norm was not
+fp16-safe: the norm must be computed in fp32 even when weights are fp16.
+
+Functional design: instead of monkey-patching module attributes, a
+reparameterized weight is stored as ``(v, g)`` and materialized by
+:func:`compute_weight` inside the forward pass — the natural jax shape of
+apex's pre-forward hook. :class:`WeightNormDense` is a flax layer using it;
+:func:`apply_weight_norm` / :func:`remove_weight_norm` convert existing param
+trees, mirroring apex's ``apply_weight_norm(module)`` API.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "Reparameterization",
+    "WeightNorm",
+    "WeightNormDense",
+    "apply_weight_norm",
+    "compute_weight",
+    "remove_weight_norm",
+]
+
+
+def _norm_except(v: jnp.ndarray, dim: int) -> jnp.ndarray:
+    """L2 norm over every axis but ``dim``, fp32 accumulation.
+
+    weight_norm.py — WeightNorm.compute_weight computes
+    ``norm(v.view(v.size(dim), -1), dim=1)`` in fp32 (the fp16-safety fix the
+    apex fork exists for).
+    """
+    v32 = jnp.asarray(v, jnp.float32)
+    axes = tuple(i for i in range(v.ndim) if i != dim % v.ndim)
+    return jnp.sqrt(jnp.sum(v32 * v32, axis=axes, keepdims=True))
+
+
+def compute_weight(v: jnp.ndarray, g: jnp.ndarray, dim: int = 0) -> jnp.ndarray:
+    """w = g * v / ||v||, norms taken per-slice along ``dim`` in fp32.
+
+    weight_norm.py — WeightNorm.compute_weight.
+    """
+    norm = _norm_except(v, dim)
+    g32 = jnp.asarray(g, jnp.float32)
+    shape = [1] * v.ndim
+    shape[dim % v.ndim] = v.shape[dim % v.ndim]
+    w = g32.reshape(shape) * jnp.asarray(v, jnp.float32) / norm
+    return w.astype(jnp.asarray(v).dtype)
+
+
+class Reparameterization:
+    """Base reparameterization (reparameterization.py — Reparameterization).
+
+    Subclasses define ``compute_weight(*params)`` and
+    ``reparameterize(weight) -> params``. Stateless here — params live in the
+    user's pytree.
+    """
+
+    dim: int = 0
+
+    @staticmethod
+    def compute_weight(*params):
+        raise NotImplementedError
+
+    @staticmethod
+    def reparameterize(weight):
+        raise NotImplementedError
+
+
+class WeightNorm(Reparameterization):
+    """weight_norm.py — class WeightNorm, functional form."""
+
+    def __init__(self, dim: int = 0):
+        self.dim = dim
+
+    def compute_weight(self, v, g):  # type: ignore[override]
+        return compute_weight(v, g, self.dim)
+
+    def reparameterize(self, weight) -> Tuple[jnp.ndarray, jnp.ndarray]:  # type: ignore[override]
+        norm = _norm_except(weight, self.dim)
+        g = norm.reshape((weight.shape[self.dim % weight.ndim],))
+        return jnp.asarray(weight), g.astype(jnp.asarray(weight).dtype)
+
+
+def apply_weight_norm(params: Any, names: Optional[Sequence[str]] = None,
+                      dim: int = 0) -> Any:
+    """Split selected kernels into (v, g) pairs in a param pytree.
+
+    apex: ``apply_weight_norm(module, name='weight')`` installs hooks. Here:
+    every dict key named in ``names`` (default: 'kernel'/'weight') is replaced
+    by ``{name}_v`` / ``{name}_g`` entries.
+    """
+    names = tuple(names or ("kernel", "weight"))
+    wn = WeightNorm(dim)
+
+    def walk(node):
+        if isinstance(node, dict):
+            out = {}
+            for k, sub in node.items():
+                if k in names and isinstance(sub, jax.Array):
+                    v, g = wn.reparameterize(sub)
+                    out[f"{k}_v"], out[f"{k}_g"] = v, g
+                else:
+                    out[k] = walk(sub)
+            return out
+        return node
+
+    return walk(jax.tree_util.tree_map(jnp.asarray, params))
+
+
+def remove_weight_norm(params: Any, names: Optional[Sequence[str]] = None,
+                       dim: int = 0) -> Any:
+    """Materialize (v, g) pairs back into plain kernels (apex:
+    remove_weight_norm)."""
+    names = tuple(names or ("kernel", "weight"))
+
+    def walk(node):
+        if isinstance(node, dict):
+            out = {}
+            done = set()
+            for k in node:
+                if k.endswith("_v") and k[:-2] in names and f"{k[:-2]}_g" in node:
+                    base = k[:-2]
+                    out[base] = compute_weight(node[k], node[f"{base}_g"], dim)
+                    done.add(f"{base}_g")
+                elif k not in done and not (
+                        k.endswith("_g") and k[:-2] in names
+                        and f"{k[:-2]}_v" in node):
+                    out[k] = walk(node[k])
+            return out
+        return node
+
+    return walk(params)
+
+
+class WeightNormDense(nn.Module):
+    """Dense layer with weight-norm reparameterized kernel.
+
+    The flax-native way to *use* the reparameterization (apex users wrap
+    ``nn.Linear`` with ``apply_weight_norm``).
+    """
+
+    features: int
+    use_bias: bool = True
+    dim: int = 1  # norm per output feature (kernel is [in, out])
+    dtype: Any = None
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        in_features = x.shape[-1]
+        v = self.param("kernel_v", nn.initializers.lecun_normal(),
+                       (in_features, self.features), self.param_dtype)
+        g = self.param("kernel_g",
+                       lambda key, shape, dtype: jnp.ones(shape, dtype),
+                       (self.features,), self.param_dtype)
+        kernel = compute_weight(v, g, dim=self.dim)
+        if self.dtype is not None:
+            kernel = kernel.astype(self.dtype)
+            x = x.astype(self.dtype)
+        y = x @ kernel
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros,
+                              (self.features,), self.param_dtype)
+            y = y + jnp.asarray(bias, y.dtype)
+        return y

@@ -1,0 +1,165 @@
+"""fp16_utils tier tests — mirrors apex tests/L0 coverage of the legacy API.
+
+Oracle strategy per SURVEY §5.1: fused/converted paths compared against
+composed fp32 references (optax on fp32 params), dtype-dependent tolerances.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from apex_tpu.fp16_utils import (
+    BN_convert_float,
+    DynamicLossScaler,
+    FP16_Optimizer,
+    LossScaler,
+    clip_grad_norm,
+    master_params_to_model_params,
+    model_grads_to_master_grads,
+    network_to_half,
+    prep_param_lists,
+    to_python_float,
+)
+
+
+def _params():
+    return {
+        "dense": {"kernel": jnp.ones((4, 3), jnp.float32),
+                  "bias": jnp.zeros((3,), jnp.float32)},
+        "bn": {"scale": jnp.ones((3,), jnp.float32),
+               "bias": jnp.zeros((3,), jnp.float32)},
+    }
+
+
+class TestConversion:
+    def test_network_to_half_keeps_bn_fp32(self):
+        half = network_to_half(_params())
+        assert half["dense"]["kernel"].dtype == jnp.bfloat16
+        assert half["bn"]["scale"].dtype == jnp.float32
+
+    def test_network_to_half_fp16(self):
+        half = network_to_half(_params(), dtype=jnp.float16)
+        assert half["dense"]["kernel"].dtype == jnp.float16
+
+    def test_bn_convert_float(self):
+        all_half = jax.tree_util.tree_map(
+            lambda p: p.astype(jnp.bfloat16), _params())
+        fixed = BN_convert_float(all_half)
+        assert fixed["bn"]["scale"].dtype == jnp.float32
+        assert fixed["dense"]["kernel"].dtype == jnp.bfloat16
+
+    def test_prep_param_lists(self):
+        model, master = prep_param_lists(network_to_half(_params()))
+        assert master["dense"]["kernel"].dtype == jnp.float32
+        np.testing.assert_allclose(
+            np.asarray(master["dense"]["kernel"]),
+            np.asarray(model["dense"]["kernel"], np.float32))
+
+    def test_prep_param_lists_flat_master(self):
+        model, (flat, spec) = prep_param_lists(_params(), flat_master=True)
+        assert flat.ndim == 1 and flat.dtype == jnp.float32
+        assert flat.size == sum(p.size for p in
+                                jax.tree_util.tree_leaves(_params()))
+
+    def test_grad_copies_roundtrip(self):
+        model = network_to_half(_params())
+        grads = jax.tree_util.tree_map(
+            lambda p: jnp.full_like(p, 0.5), model)
+        master_g = model_grads_to_master_grads(grads)
+        assert master_g["dense"]["kernel"].dtype == jnp.float32
+        back = master_params_to_model_params(master_g, model)
+        assert back["dense"]["kernel"].dtype == jnp.bfloat16
+
+    def test_to_python_float(self):
+        assert to_python_float(jnp.float32(3.5)) == 3.5
+
+    def test_clip_grad_norm(self):
+        grads = {"a": jnp.full((10,), 3.0), "b": jnp.full((10,), 4.0)}
+        clipped, total = clip_grad_norm(grads, max_norm=1.0)
+        np.testing.assert_allclose(float(total), np.sqrt(90 + 160), rtol=1e-6)
+        new_total = float(jnp.sqrt(sum(
+            jnp.sum(g ** 2) for g in jax.tree_util.tree_leaves(clipped))))
+        np.testing.assert_allclose(new_total, 1.0, rtol=1e-4)
+
+
+class TestLegacyScalers:
+    def test_static_never_overflows(self):
+        s = LossScaler(128.0)
+        assert s.loss_scale == 128.0
+        assert not s.has_overflow({"g": jnp.array([jnp.inf])})
+        s.update_scale(True)
+        assert s.loss_scale == 128.0
+
+    def test_dynamic_halves_on_overflow(self):
+        s = DynamicLossScaler(init_scale=2.0 ** 15)
+        assert s.has_overflow({"g": jnp.array([jnp.nan, 1.0])})
+        s.update_scale(True)
+        assert s.loss_scale == 2.0 ** 14
+
+    def test_dynamic_grows_after_window(self):
+        s = DynamicLossScaler(init_scale=4.0, scale_window=10)
+        s.update_scale(True)  # → 2.0, iter 0 overflowed
+        for _ in range(10):
+            s.update_scale(False)
+        assert s.loss_scale == 4.0
+
+
+class TestFP16Optimizer:
+    def _loss_fn(self, params, x):
+        y = x @ params["w"] + params["b"]
+        return jnp.sum(y ** 2)
+
+    def test_matches_fp32_sgd(self):
+        """FP16_Optimizer on bf16 params tracks plain fp32 SGD (the apex L1
+        convergence-parity bar, scaled down)."""
+        key = jax.random.PRNGKey(0)
+        w = jax.random.normal(key, (8, 4), jnp.float32) * 0.1
+        ref = {"w": w, "b": jnp.zeros((4,))}
+        model = network_to_half(ref, keep_fp32=None)
+        x = jax.random.normal(jax.random.PRNGKey(1), (16, 8))
+
+        opt = FP16_Optimizer(optax.sgd(1e-2), model,
+                             static_loss_scale=128.0)
+        ref_opt = optax.sgd(1e-2)
+        ref_state = ref_opt.init(ref)
+
+        for _ in range(5):
+            grads = jax.grad(
+                lambda p: opt.scale_loss(
+                    self._loss_fn(jax.tree_util.tree_map(
+                        lambda t: t.astype(jnp.float32), p), x)))(model)
+            model = opt.step(grads, model)
+
+            ref_grads = jax.grad(lambda p: self._loss_fn(p, x))(ref)
+            updates, ref_state = ref_opt.update(ref_grads, ref_state, ref)
+            ref = optax.apply_updates(ref, updates)
+
+        np.testing.assert_allclose(
+            np.asarray(opt.fp32_params["w"]), np.asarray(ref["w"]),
+            atol=2e-2)  # bf16 grad quantization
+
+    def test_overflow_skips_step(self):
+        params = {"w": jnp.ones((2, 2), jnp.float16)}
+        opt = FP16_Optimizer(optax.sgd(0.1), params,
+                             dynamic_loss_scale=True,
+                             dynamic_loss_args={"init_scale": 2.0 ** 10})
+        before = np.asarray(opt.fp32_params["w"]).copy()
+        bad = {"w": jnp.full((2, 2), jnp.inf, jnp.float16)}
+        out = opt.step(bad, params)
+        assert opt.overflow
+        assert opt.loss_scale == 2.0 ** 9
+        np.testing.assert_array_equal(np.asarray(opt.fp32_params["w"]), before)
+        np.testing.assert_array_equal(np.asarray(out["w"]),
+                                      np.asarray(params["w"]))
+
+    def test_state_dict_roundtrip(self):
+        params = {"w": jnp.ones((2,), jnp.bfloat16)}
+        opt = FP16_Optimizer(optax.sgd(0.1), params, dynamic_loss_scale=True)
+        opt.step({"w": jnp.full((2,), jnp.inf, jnp.bfloat16)}, params)
+        sd = opt.state_dict()
+        opt2 = FP16_Optimizer(optax.sgd(0.1), params, dynamic_loss_scale=True)
+        opt2.load_state_dict(sd)
+        assert opt2.loss_scale == opt.loss_scale
+        assert opt2.overflow == opt.overflow
