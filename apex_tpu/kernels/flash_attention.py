@@ -321,8 +321,13 @@ def _fwd_pallas(q3, k3, v3, segq, segk, scale, causal, bq, bk, interpret):
 
 
 def _bwd_pallas(q3, k3, v3, do3, lse, delta, segq, segk, scale, causal, bq, bk,
-                interpret):
-    """delta: [bh, 1, sq] fp32 = sum(do * o, -1); lse: [bh, 1, sq] fp32."""
+                interpret, out_dtype=None):
+    """delta: [bh, 1, sq] fp32 = sum(do * o, -1); lse: [bh, 1, sq] fp32.
+
+    ``out_dtype`` overrides the gradient dtypes (default: match inputs);
+    ring attention passes fp32 so cross-chunk accumulation stays exact while
+    the kernels still stream bf16 inputs (they upcast per-tile internally).
+    """
     bh, sq, d = q3.shape
     sk = k3.shape[1]
     have_segs = segq is not None
@@ -351,8 +356,8 @@ def _bwd_pallas(q3, k3, v3, do3, lse, delta, segq, segk, scale, causal, bq, bk,
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
-            _sds((bh, sk, d), k3.dtype, q3),
-            _sds((bh, sk, d), v3.dtype, q3),
+            _sds((bh, sk, d), out_dtype or k3.dtype, q3),
+            _sds((bh, sk, d), out_dtype or v3.dtype, q3),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, d), jnp.float32),
@@ -376,7 +381,7 @@ def _bwd_pallas(q3, k3, v3, do3, lse, delta, segq, segk, scale, causal, bq, bk,
             pl.BlockSpec((1, 1, sk), lambda b, i, j: (b, 0, 0)),   # segk
         ],
         out_specs=[pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0))],
-        out_shape=[_sds((bh, sq, d), q3.dtype, q3)],
+        out_shape=[_sds((bh, sq, d), out_dtype or q3.dtype, q3)],
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
     )(q3, k3, v3, do3, lse, delta, segq, segk)[0]
@@ -450,16 +455,15 @@ def attn_chunk_bwd(q3, k3, v3, do3, lse, delta, *, scale, causal,
     if not _pallas_ok(sq, sk, d, bq, bk) or (interpret and _has_vma(q3)):
         return _ref_chunk_bwd(q3, k3, v3, do3, lse, delta, scale, causal)
     # _bwd_pallas recomputes p from lse and reads delta directly; o3 itself
-    # is not needed once delta is in hand, so pass delta through.
+    # is not needed once delta is in hand, so pass delta through. Inputs keep
+    # their storage dtype (the kernels upcast per-tile); only the outputs are
+    # forced fp32 for exact cross-chunk accumulation in the ring.
     bh = q3.shape[0]
     lse3 = lse.reshape(bh, 1, sq)
-    q32 = jnp.asarray(q3, jnp.float32)
-    k32 = jnp.asarray(k3, jnp.float32)
-    v32 = jnp.asarray(v3, jnp.float32)
-    do32 = jnp.asarray(do3, jnp.float32)
-    dq, dk, dv = _bwd_pallas(q32, k32, v32, do32, lse3,
+    dq, dk, dv = _bwd_pallas(q3, k3, v3, do3, lse3,
                              delta.reshape(bh, 1, sq), None, None,
-                             scale, causal, bq, bk, interpret)
+                             scale, causal, bq, bk, interpret,
+                             out_dtype=jnp.float32)
     return dq, dk, dv
 
 

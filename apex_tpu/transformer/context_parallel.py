@@ -33,7 +33,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from apex_tpu.comm import AXIS_CONTEXT
-from apex_tpu.kernels.flash_attention import (attn_chunk_bwd, attn_chunk_fwd,
+from apex_tpu.kernels.flash_attention import (_flatten as _flat, _match_vma,
+                                              attn_chunk_bwd, attn_chunk_fwd,
                                               flash_attention)
 
 __all__ = ["ring_attention", "ulysses_attention", "AXIS_CONTEXT"]
@@ -46,17 +47,14 @@ def _axis_size(axis_name):
     return lax.psum(1, axis_name)
 
 
-def _pvary(x, axis_name):
-    """Mark a constant as device-varying over ``axis_name`` so it types
-    consistently with per-shard data in cond/switch/loop carries."""
-    if hasattr(lax, "pcast"):
-        return lax.pcast(x, (axis_name,), to="varying")
-    return lax.pvary(x, (axis_name,))
-
-
-def _flat(x):
-    b, h, s, d = x.shape
-    return x.reshape(b * h, s, d)
+def _vary_like(x, *likes):
+    """Give a freshly-created constant the union of the varying-manual-axes
+    of ``likes`` so it types consistently with per-shard data in cond/switch/
+    loop carries — q/k may vary over MORE than the ring axis (e.g. a 'data'
+    axis in a DP+CP shard_map)."""
+    for like in likes:
+        x = _match_vma(x, like)
+    return x
 
 
 def _combine(o_run, lse_run, o_t, lse_t):
@@ -78,7 +76,7 @@ def _ring(q, k, v, axis_name, causal, scale):
     return out
 
 
-def _chunk_cases(q3, k3, v3, causal, scale, kv_idx, my_idx, axis_name):
+def _chunk_cases(q3, k3, v3, causal, scale, kv_idx, my_idx):
     """(o, lse) for one ring step, dispatching on the chunk relation.
 
     With contiguous sequence chunks, chunk j is entirely *before* chunk i in
@@ -96,8 +94,8 @@ def _chunk_cases(q3, k3, v3, causal, scale, kv_idx, my_idx, axis_name):
         return attn_chunk_fwd(q3, k3, v3, scale=scale, causal=True)
 
     def skip(_):
-        return (_pvary(jnp.zeros((bh, s, d), jnp.float32), axis_name),
-                _pvary(jnp.full((bh, s), _NEG_INF, jnp.float32), axis_name))
+        return (_vary_like(jnp.zeros((bh, s, d), jnp.float32), q3, k3),
+                _vary_like(jnp.full((bh, s), _NEG_INF, jnp.float32), q3, k3))
 
     branch = jnp.where(kv_idx < my_idx, 0, jnp.where(kv_idx == my_idx, 1, 2))
     return lax.switch(branch, [full, diag, skip], None)
@@ -109,20 +107,26 @@ def _ring_fwd(q, k, v, axis_name, causal, scale):
     b, h, s, d = q.shape
     q3, k3, v3 = _flat(q), _flat(k), _flat(v)
 
+    def compute(t, o_run, lse_run, k_cur, v_cur):
+        kv_idx = (idx - t) % n
+        o_t, lse_t = _chunk_cases(q3, k_cur, v_cur, causal, scale, kv_idx, idx)
+        return _combine(o_run, lse_run, o_t, lse_t)
+
     def step(t, carry):
         o_run, lse_run, k_cur, v_cur = carry
-        kv_idx = (idx - t) % n
-        o_t, lse_t = _chunk_cases(q3, k_cur, v_cur, causal, scale, kv_idx,
-                                  idx, axis_name)
-        o_run, lse_run = _combine(o_run, lse_run, o_t, lse_t)
+        o_run, lse_run = compute(t, o_run, lse_run, k_cur, v_cur)
         k_cur, v_cur = _rotate((k_cur, v_cur), axis_name, n)
         return o_run, lse_run, k_cur, v_cur
 
-    # Constant-initialized carries are "replicated" over the axis while the
-    # loop body makes them device-varying; align the types.
-    o0 = _pvary(jnp.zeros((b * h, s, d), jnp.float32), axis_name)
-    lse0 = _pvary(jnp.full((b * h, s), _NEG_INF, jnp.float32), axis_name)
-    o3, lse, _, _ = lax.fori_loop(0, n, step, (o0, lse0, k3, v3))
+    # Constant-initialized carries are "replicated" over the mesh while the
+    # loop body makes them device-varying; align the types. The final chunk
+    # is computed OUTSIDE the loop so its KV rotation (whose result nobody
+    # reads) never hits the ICI ring.
+    o0 = _vary_like(jnp.zeros((b * h, s, d), jnp.float32), q3, k3)
+    lse0 = _vary_like(jnp.full((b * h, s), _NEG_INF, jnp.float32), q3, k3)
+    o_run, lse_run, k_last, v_last = lax.fori_loop(
+        0, n - 1, step, (o0, lse0, k3, v3))
+    o3, lse = compute(n - 1, o_run, lse_run, k_last, v_last)
     out = o3.astype(q.dtype).reshape(b, h, s, d)
     return out, (q3, k3, v3, o3, lse)
 
@@ -149,29 +153,35 @@ def _ring_bwd(axis_name, causal, scale, res, g):
                                   scale=scale, causal=True)
 
         def skip(_):
-            return (_pvary(jnp.zeros(q3.shape, jnp.float32), axis_name),
-                    _pvary(jnp.zeros(k_cur.shape, jnp.float32), axis_name),
-                    _pvary(jnp.zeros(v_cur.shape, jnp.float32), axis_name))
+            return (_vary_like(jnp.zeros(q3.shape, jnp.float32), q3, k_cur),
+                    _vary_like(jnp.zeros(k_cur.shape, jnp.float32), q3, k_cur),
+                    _vary_like(jnp.zeros(v_cur.shape, jnp.float32), q3, k_cur))
 
         branch = jnp.where(kv_idx < idx, 0, jnp.where(kv_idx == idx, 1, 2))
         return lax.switch(branch, [full, diag, skip], None)
 
-    def step(t, carry):
-        dq, k_cur, v_cur, dk_acc, dv_acc = carry
+    def accumulate(t, dq, k_cur, v_cur, dk_acc, dv_acc):
         kv_idx = (idx - t) % n
         dq_t, dk_t, dv_t = bwd_cases(k_cur, v_cur, kv_idx)
-        dq = dq + dq_t
-        dk_acc = dk_acc + dk_t
-        dv_acc = dv_acc + dv_t
+        return dq + dq_t, dk_acc + dk_t, dv_acc + dv_t
+
+    def step(t, carry):
+        dq, k_cur, v_cur, dk_acc, dv_acc = carry
+        dq, dk_acc, dv_acc = accumulate(t, dq, k_cur, v_cur, dk_acc, dv_acc)
         # dk/dv rotate WITH their kv chunk: after n hops they are home.
         k_cur, v_cur, dk_acc, dv_acc = _rotate(
             (k_cur, v_cur, dk_acc, dv_acc), axis_name, n)
         return dq, k_cur, v_cur, dk_acc, dv_acc
 
-    dq0 = _pvary(jnp.zeros(q3.shape, jnp.float32), axis_name)
-    dk0 = _pvary(jnp.zeros(k3.shape, jnp.float32), axis_name)
-    dv0 = _pvary(jnp.zeros(v3.shape, jnp.float32), axis_name)
-    dq, _, _, dk, dv = lax.fori_loop(0, n, step, (dq0, k3, v3, dk0, dv0))
+    dq0 = _vary_like(jnp.zeros(q3.shape, jnp.float32), q3, k3)
+    dk0 = _vary_like(jnp.zeros(k3.shape, jnp.float32), q3, k3)
+    dv0 = _vary_like(jnp.zeros(v3.shape, jnp.float32), q3, k3)
+    # Last chunk outside the loop: only the accumulators need the final hop
+    # home — k/v would be sent around once more just to be dropped.
+    dq, k_last, v_last, dk_acc, dv_acc = lax.fori_loop(
+        0, n - 1, step, (dq0, k3, v3, dk0, dv0))
+    dq, dk_acc, dv_acc = accumulate(n - 1, dq, k_last, v_last, dk_acc, dv_acc)
+    dk, dv = _rotate((dk_acc, dv_acc), axis_name, n)
 
     s, d = q3.shape[1], q3.shape[2]
     return (dq.astype(q3.dtype).reshape(b, h, s, d),
