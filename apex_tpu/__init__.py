@@ -35,7 +35,9 @@ _SUBMODULES = (
     "comm",
     "contrib",
     "fp16_utils",
+    "fused_dense",
     "kernels",
+    "mlp",
     "models",
     "multi_tensor_apply",
     "normalization",

@@ -1,0 +1,120 @@
+"""apex_tpu.fused_dense — GEMM+bias(+GELU) fused linears.
+
+Reference: ``apex/fused_dense/fused_dense.py — class FusedDense,
+class FusedDenseGeluDense, class DenseNoBias`` over ``fused_dense_cuda``
+(``csrc/fused_dense.cpp``, ``fused_dense_cuda.cu — linear_bias_forward,
+linear_gelu_linear_forward``), which uses cublasLt epilogues to fuse the bias
+add and GELU into the GEMM.
+
+On TPU that fusion is XLA's default behavior: a ``dot_general`` followed by a
+broadcast add and ``gelu`` lowers to one fused MXU computation, and the
+backward pass similarly fuses dgelu into the wgrad/dgrad GEMMs. These classes
+therefore carry the reference's API and weight layout (torch Linear
+``(out, in)``), with fp32 accumulation forced via ``preferred_element_type``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+__all__ = ["FusedDense", "DenseNoBias", "FusedDenseGeluDense",
+           "fused_dense_function", "fused_dense_gelu_dense_function",
+           "torch_linear_init"]
+
+
+def torch_linear_init(in_features: int):
+    """uniform(-1/sqrt(in), 1/sqrt(in)) — torch Linear's reset_parameters,
+    which apex's fused_dense/mlp modules inherit."""
+    bound = 1.0 / (in_features ** 0.5)
+    init = nn.initializers.uniform(scale=2 * bound)
+
+    def shifted(key, shape, dtype):
+        return init(key, shape, dtype) - bound
+
+    return shifted
+
+
+def _linear_fp32(x, weight, bias):
+    # GEMM with fp32 accumulation + fp32 bias add; caller decides the output
+    # dtype (matches cublasLt: epilogues run on the fp32 accumulator).
+    y = jnp.dot(x, jnp.asarray(weight, x.dtype).T,
+                preferred_element_type=jnp.float32)
+    if bias is not None:
+        y = y + jnp.asarray(bias, jnp.float32)
+    return y
+
+
+def fused_dense_function(x, weight, bias=None):
+    """y = x @ W.T + b (reference: fused_dense_cuda.linear_bias_forward)."""
+    return jnp.asarray(_linear_fp32(x, weight, bias), x.dtype)
+
+
+def fused_dense_gelu_dense_function(x, weight1, bias1, weight2, bias2):
+    """Linear→GELU→Linear in one trace.
+
+    Reference: fused_dense_cuda.linear_gelu_linear_forward. GELU (exact/erf,
+    apex uses CUBLASLT_EPILOGUE_GELU) is applied to the fp32 accumulator
+    before any output-dtype conversion, as the cublasLt epilogue does.
+    """
+    h = jax.nn.gelu(_linear_fp32(x, weight1, bias1), approximate=False)
+    h = jnp.asarray(h, x.dtype)
+    return fused_dense_function(h, weight2, bias2)
+
+
+class FusedDense(nn.Module):
+    """Linear with fused bias (reference: fused_dense.py — class FusedDense)."""
+
+    in_features: int
+    out_features: int
+    use_bias: bool = True
+    dtype: Optional[Any] = None
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        if self.dtype is not None:
+            x = jnp.asarray(x, self.dtype)
+        init = torch_linear_init(self.in_features)
+        w = self.param("weight", init, (self.out_features, self.in_features),
+                       self.param_dtype)
+        b = (self.param("bias", init, (self.out_features,), self.param_dtype)
+             if self.use_bias else None)
+        return fused_dense_function(x, w, b)
+
+
+class DenseNoBias(FusedDense):
+    """Bias-free variant (reference: fused_dense.py — class DenseNoBias)."""
+
+    use_bias: bool = False
+
+
+class FusedDenseGeluDense(nn.Module):
+    """Linear+GELU+Linear block (reference: class FusedDenseGeluDense)."""
+
+    in_features: int
+    intermediate_features: int
+    out_features: int
+    dtype: Optional[Any] = None
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        if self.dtype is not None:
+            x = jnp.asarray(x, self.dtype)
+        init1 = torch_linear_init(self.in_features)
+        init2 = torch_linear_init(self.intermediate_features)
+        w1 = self.param("weight1", init1,
+                        (self.intermediate_features, self.in_features),
+                        self.param_dtype)
+        b1 = self.param("bias1", init1,
+                        (self.intermediate_features,), self.param_dtype)
+        w2 = self.param("weight2", init2,
+                        (self.out_features, self.intermediate_features),
+                        self.param_dtype)
+        b2 = self.param("bias2", init2,
+                        (self.out_features,), self.param_dtype)
+        return fused_dense_gelu_dense_function(x, w1, b1, w2, b2)
