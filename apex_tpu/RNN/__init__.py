@@ -1,0 +1,248 @@
+"""apex_tpu.RNN — fp16/bf16-friendly recurrent layers (reference: apex/RNN).
+
+The reference's ``apex/RNN/models.py — LSTM, GRU, ReLU, Tanh, mLSTM`` (built
+from ``RNNBackend.py — RNNCell, stackedRNN, bidirectionalRNN`` and
+``cells.py — mLSTMRNNCell``) exists because cuDNN's fused RNNs didn't support
+fp16 master-weight training; apex rebuilt them from cells so amp could manage
+dtypes.
+
+TPU-first redesign, not a translation:
+
+- The input projection ``x @ W_ih^T`` for ALL timesteps of a layer is hoisted
+  out of the recurrence into one large MXU GEMM (time×batch collapsed); the
+  ``lax.scan`` body carries only the unavoidable serial dependence
+  ``h @ W_hh^T`` plus elementwise gating. This is the structure cuDNN's
+  persistent RNNs hand-schedule; here XLA gets it from the trace shape.
+- Gate math runs in fp32 (``preferred_element_type``) with half I/O, the
+  property the reference's cells exist to guarantee.
+- Weight layout and parameter names are torch's (``weight_ih_l{k}``,
+  ``(4H, in)``, gate order i,f,g,o / r,z,n), so state dicts port and
+  torch-CPU is the test oracle.
+
+mLSTM follows ``cells.py — mLSTMCell``: ``m = (x W_mih) * (h W_mhh)`` feeds
+the recurrent half of otherwise-standard LSTM gates (Krause et al. 2016).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.fused_dense import _linear_fp32 as _linear32
+from apex_tpu.fused_dense import torch_linear_init
+
+__all__ = ["LSTM", "GRU", "ReLU", "Tanh", "mLSTM", "RNNBase"]
+
+
+def _lstm_step(carry, gates32):
+    h, c = carry
+    i, f, g, o = jnp.split(gates32, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c_new = f * c + i * g
+    h_new = o * jnp.tanh(c_new)
+    return h_new, c_new
+
+
+class RNNBase(nn.Module):
+    """Shared stacked/bidirectional scan machinery.
+
+    Reference: apex/RNN/RNNBackend.py — stackedRNN + bidirectionalRNN; the
+    constructor surface matches apex's model factories (which mirror
+    torch.nn.LSTM/GRU): ``(input_size, hidden_size, num_layers, bias,
+    batch_first, dropout, bidirectional)``.
+    """
+
+    input_size: int
+    hidden_size: int
+    num_layers: int = 1
+    bias: bool = True
+    batch_first: bool = False
+    dropout: float = 0.0
+    bidirectional: bool = False
+    dtype: Optional[Any] = None
+    param_dtype: Any = jnp.float32
+
+    # subclass contract
+    mode: str = "LSTM"  # LSTM | GRU | RNN_TANH | RNN_RELU | MLSTM
+
+    @property
+    def _gate_mult(self):
+        return {"LSTM": 4, "MLSTM": 4, "GRU": 3,
+                "RNN_TANH": 1, "RNN_RELU": 1}[self.mode]
+
+    @property
+    def _has_cell_state(self):
+        return self.mode in ("LSTM", "MLSTM")
+
+    def _layer_params(self, layer: int, suffix: str, in_size: int):
+        gm = self._gate_mult
+        # torch RNN reset_parameters: uniform(±1/sqrt(hidden_size)) for every
+        # weight and bias, which torch_linear_init(hidden_size) produces.
+        shifted = torch_linear_init(self.hidden_size)
+        H = self.hidden_size
+        p = {
+            "w_ih": self.param(f"weight_ih_l{layer}{suffix}", shifted,
+                               (gm * H, in_size), self.param_dtype),
+            "w_hh": self.param(f"weight_hh_l{layer}{suffix}", shifted,
+                               (gm * H, H), self.param_dtype),
+        }
+        if self.bias:
+            p["b_ih"] = self.param(f"bias_ih_l{layer}{suffix}", shifted,
+                                   (gm * H,), self.param_dtype)
+            p["b_hh"] = self.param(f"bias_hh_l{layer}{suffix}", shifted,
+                                   (gm * H,), self.param_dtype)
+        if self.mode == "MLSTM":
+            p["w_mih"] = self.param(f"weight_mih_l{layer}{suffix}", shifted,
+                                    (H, in_size), self.param_dtype)
+            p["w_mhh"] = self.param(f"weight_mhh_l{layer}{suffix}", shifted,
+                                    (H, H), self.param_dtype)
+        return p
+
+    def _scan_layer(self, x_tbf, h0, c0, p, reverse: bool):
+        """One direction of one layer. x_tbf: (T, B, in)."""
+        dtype = x_tbf.dtype
+        b_ih = p.get("b_ih")
+        b_hh = p.get("b_hh")
+        if self.mode == "MLSTM":
+            # input half of m precomputed for all t in one GEMM
+            mx = _linear32(x_tbf, p["w_mih"])  # (T, B, H) fp32
+            gx = _linear32(x_tbf, p["w_ih"], b_ih)
+
+            def step(carry, inp):
+                h, c = carry
+                mx_t, gx_t = inp
+                m = mx_t * _linear32(h, p["w_mhh"])
+                gates = gx_t + _linear32(jnp.asarray(m, dtype), p["w_hh"],
+                                         b_hh)
+                h32, c32 = _lstm_step((jnp.asarray(h, jnp.float32),
+                                       jnp.asarray(c, jnp.float32)), gates)
+                h_new = jnp.asarray(h32, dtype)
+                return (h_new, jnp.asarray(c32, dtype)), h_new
+
+            (h_n, c_n), ys = lax.scan(step, (h0, c0), (mx, gx),
+                                      reverse=reverse)
+            return ys, h_n, c_n
+
+        gx = _linear32(x_tbf, p["w_ih"], b_ih)  # (T, B, gm*H) fp32
+
+        if self.mode in ("LSTM",):
+            def step(carry, gx_t):
+                h, c = carry
+                gates = gx_t + _linear32(h, p["w_hh"], b_hh)
+                h32, c32 = _lstm_step((jnp.asarray(h, jnp.float32),
+                                       jnp.asarray(c, jnp.float32)), gates)
+                h_new = jnp.asarray(h32, dtype)
+                return (h_new, jnp.asarray(c32, dtype)), h_new
+
+            (h_n, c_n), ys = lax.scan(step, (h0, c0), gx, reverse=reverse)
+            return ys, h_n, c_n
+
+        if self.mode == "GRU":
+            def step(h, gx_t):
+                gh = _linear32(h, p["w_hh"], b_hh)
+                rx, zx, nx = jnp.split(gx_t, 3, axis=-1)
+                rh, zh, nh = jnp.split(gh, 3, axis=-1)
+                r = jax.nn.sigmoid(rx + rh)
+                z = jax.nn.sigmoid(zx + zh)
+                n = jnp.tanh(nx + r * nh)
+                h32 = (1.0 - z) * n + z * jnp.asarray(h, jnp.float32)
+                h_new = jnp.asarray(h32, dtype)
+                return h_new, h_new
+
+            h_n, ys = lax.scan(step, h0, gx, reverse=reverse)
+            return ys, h_n, None
+
+        act = jnp.tanh if self.mode == "RNN_TANH" else jax.nn.relu
+
+        def step(h, gx_t):
+            h32 = act(gx_t + _linear32(h, p["w_hh"], b_hh))
+            h_new = jnp.asarray(h32, dtype)
+            return h_new, h_new
+
+        h_n, ys = lax.scan(step, h0, gx, reverse=reverse)
+        return ys, h_n, None
+
+    @nn.compact
+    def __call__(self, x, hidden=None, deterministic: bool = True):
+        """Returns (output, h_n) or (output, (h_n, c_n)) following torch/apex.
+
+        ``x``: (T, B, F), or (B, T, F) when ``batch_first``. ``hidden``:
+        optional (h_0[, c_0]) of shape (num_layers*num_directions, B, H).
+        """
+        if self.dtype is not None:
+            x = jnp.asarray(x, self.dtype)
+        if self.batch_first:
+            x = jnp.swapaxes(x, 0, 1)
+        T, B = x.shape[0], x.shape[1]
+        H = self.hidden_size
+        ndir = 2 if self.bidirectional else 1
+
+        if hidden is None:
+            h0_all = jnp.zeros((self.num_layers * ndir, B, H), x.dtype)
+            c0_all = jnp.zeros_like(h0_all) if self._has_cell_state else None
+        elif self._has_cell_state:
+            h0_all, c0_all = hidden
+            # carry dtype must match the step output dtype or lax.scan rejects
+            # the carry; follow the compute dtype like torch's cast of hx.
+            h0_all = jnp.asarray(h0_all, x.dtype)
+            c0_all = jnp.asarray(c0_all, x.dtype)
+        else:
+            h0_all, c0_all = jnp.asarray(hidden, x.dtype), None
+
+        drop = nn.Dropout(rate=self.dropout) if self.dropout > 0 else None
+
+        y = x
+        h_ns, c_ns = [], []
+        for layer in range(self.num_layers):
+            in_size = self.input_size if layer == 0 else H * ndir
+            outs = []
+            for d in range(ndir):
+                suffix = "_reverse" if d == 1 else ""
+                p = self._layer_params(layer, suffix, in_size)
+                idx = layer * ndir + d
+                c0 = c0_all[idx] if c0_all is not None else None
+                ys, h_n, c_n = self._scan_layer(y, h0_all[idx], c0, p,
+                                                reverse=(d == 1))
+                outs.append(ys)
+                h_ns.append(h_n)
+                if c_n is not None:
+                    c_ns.append(c_n)
+            y = outs[0] if ndir == 1 else jnp.concatenate(outs, axis=-1)
+            if drop is not None and layer < self.num_layers - 1:
+                y = drop(y, deterministic=deterministic)
+
+        out = jnp.swapaxes(y, 0, 1) if self.batch_first else y
+        h_n = jnp.stack(h_ns)
+        if self._has_cell_state:
+            return out, (h_n, jnp.stack(c_ns))
+        return out, h_n
+
+
+class LSTM(RNNBase):
+    """apex/RNN/models.py — LSTM."""
+    mode: str = "LSTM"
+
+
+class GRU(RNNBase):
+    """apex/RNN/models.py — GRU."""
+    mode: str = "GRU"
+
+
+class Tanh(RNNBase):
+    """Vanilla tanh RNN (apex/RNN/models.py — Tanh)."""
+    mode: str = "RNN_TANH"
+
+
+class ReLU(RNNBase):
+    """Vanilla relu RNN (apex/RNN/models.py — ReLU)."""
+    mode: str = "RNN_RELU"
+
+
+class mLSTM(RNNBase):
+    """Multiplicative LSTM (apex/RNN/cells.py — mLSTMCell)."""
+    mode: str = "MLSTM"

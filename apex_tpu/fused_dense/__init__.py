@@ -38,7 +38,7 @@ def torch_linear_init(in_features: int):
     return shifted
 
 
-def _linear_fp32(x, weight, bias):
+def _linear_fp32(x, weight, bias=None):
     # GEMM with fp32 accumulation + fp32 bias add; caller decides the output
     # dtype (matches cublasLt: epilogues run on the fp32 accumulator).
     y = jnp.dot(x, jnp.asarray(weight, x.dtype).T,

@@ -21,8 +21,6 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from apex_tpu.fused_dense import torch_linear_init
-
 __all__ = ["MLP", "mlp_function"]
 
 _ACTIVATIONS = {
@@ -83,12 +81,16 @@ class MLP(nn.Module):
         biases = [] if self.bias else None
         for i in range(len(self.mlp_sizes) - 1):
             in_f, out_f = self.mlp_sizes[i], self.mlp_sizes[i + 1]
-            # apex initializes with torch Linear's uniform(±1/sqrt(in))
-            # (mlp.py — reset_parameters).
-            init = torch_linear_init(in_f)
-            weights.append(self.param(f"weight_{i}", init, (out_f, in_f),
-                                      self.param_dtype))
+            # apex/mlp/mlp.py — reset_parameters: weights ~ N(0,
+            # sqrt(2/(fan_in+fan_out))) (Xavier-normal), biases ~ N(0,
+            # sqrt(1/fan_out)).
+            w_std = (2.0 / (in_f + out_f)) ** 0.5
+            b_std = (1.0 / out_f) ** 0.5
+            weights.append(self.param(
+                f"weight_{i}", nn.initializers.normal(stddev=w_std),
+                (out_f, in_f), self.param_dtype))
             if self.bias:
-                biases.append(self.param(f"bias_{i}", init, (out_f,),
-                                         self.param_dtype))
+                biases.append(self.param(
+                    f"bias_{i}", nn.initializers.normal(stddev=b_std),
+                    (out_f,), self.param_dtype))
         return mlp_function(x, weights, biases, self.activation)

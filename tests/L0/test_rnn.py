@@ -1,0 +1,147 @@
+"""apex_tpu.RNN tests — torch-CPU as the numerics oracle.
+
+Mirrors the reference's strategy (apex/RNN cells were validated against
+torch.nn RNNs): copy torch's weights into the flax module (names/layouts
+match by design) and assert fwd outputs + final states allclose.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from apex_tpu.RNN import GRU, LSTM, ReLU, Tanh, mLSTM  # noqa: E402
+
+
+def _params_from_torch(mod):
+    return {name: jnp.asarray(p.detach().numpy())
+            for name, p in mod.named_parameters()}
+
+
+def _run_pair(torch_cls, jax_cls, mode_kwargs, T=7, B=3, F=10, H=8):
+    torch.manual_seed(0)
+    tm = torch_cls(F, H, **mode_kwargs)
+    params = _params_from_torch(tm)
+    jm = jax_cls(input_size=F, hidden_size=H, **mode_kwargs)
+    x = np.random.RandomState(1).randn(T, B, F).astype(np.float32)
+    if mode_kwargs.get("batch_first"):
+        x = np.transpose(x, (1, 0, 2))
+    with torch.no_grad():
+        t_out, t_hid = tm(torch.from_numpy(x))
+    j_out, j_hid = jm.apply({"params": params}, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(j_out), t_out.numpy(),
+                               rtol=1e-4, atol=1e-5)
+    if isinstance(t_hid, tuple):
+        for t_h, j_h in zip(t_hid, j_hid):
+            np.testing.assert_allclose(np.asarray(j_h), t_h.numpy(),
+                                       rtol=1e-4, atol=1e-5)
+    else:
+        np.testing.assert_allclose(np.asarray(j_hid), t_hid.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("kwargs", [
+    {},
+    {"num_layers": 2},
+    {"bidirectional": True},
+    {"num_layers": 2, "bidirectional": True, "batch_first": True},
+    {"bias": False},
+])
+def test_lstm_matches_torch(kwargs):
+    _run_pair(torch.nn.LSTM, LSTM, kwargs)
+
+
+@pytest.mark.parametrize("kwargs", [{}, {"num_layers": 2},
+                                    {"bidirectional": True}])
+def test_gru_matches_torch(kwargs):
+    _run_pair(torch.nn.GRU, GRU, kwargs)
+
+
+def test_vanilla_rnn_matches_torch():
+    _run_pair(lambda F, H, **kw: torch.nn.RNN(F, H, nonlinearity="tanh", **kw),
+              Tanh, {})
+    _run_pair(lambda F, H, **kw: torch.nn.RNN(F, H, nonlinearity="relu", **kw),
+              ReLU, {"num_layers": 2})
+
+
+def test_lstm_initial_hidden():
+    T, B, F, H = 5, 2, 6, 4
+    torch.manual_seed(2)
+    tm = torch.nn.LSTM(F, H)
+    params = _params_from_torch(tm)
+    jm = LSTM(input_size=F, hidden_size=H)
+    rs = np.random.RandomState(3)
+    x = rs.randn(T, B, F).astype(np.float32)
+    h0 = rs.randn(1, B, H).astype(np.float32)
+    c0 = rs.randn(1, B, H).astype(np.float32)
+    with torch.no_grad():
+        t_out, _ = tm(torch.from_numpy(x),
+                      (torch.from_numpy(h0), torch.from_numpy(c0)))
+    j_out, _ = jm.apply({"params": params}, jnp.asarray(x),
+                        (jnp.asarray(h0), jnp.asarray(c0)))
+    np.testing.assert_allclose(np.asarray(j_out), t_out.numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def _np_mlstm_ref(x, p, H):
+    """numpy oracle for apex/RNN/cells.py — mLSTMCell."""
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    T, B = x.shape[0], x.shape[1]
+    h = np.zeros((B, H), np.float32)
+    c = np.zeros((B, H), np.float32)
+    ys = []
+    for t in range(T):
+        m = (x[t] @ p["weight_mih_l0"].T) * (h @ p["weight_mhh_l0"].T)
+        gates = (x[t] @ p["weight_ih_l0"].T + p["bias_ih_l0"]
+                 + m @ p["weight_hh_l0"].T + p["bias_hh_l0"])
+        i, f, g, o = np.split(gates, 4, axis=-1)
+        c = sig(f) * c + sig(i) * np.tanh(g)
+        h = sig(o) * np.tanh(c)
+        ys.append(h)
+    return np.stack(ys), h, c
+
+
+def test_mlstm_matches_numpy_reference():
+    T, B, F, H = 6, 2, 5, 4
+    jm = mLSTM(input_size=F, hidden_size=H)
+    x = np.random.RandomState(4).randn(T, B, F).astype(np.float32)
+    variables = jm.init(jax.random.PRNGKey(0), jnp.asarray(x))
+    p = {k: np.asarray(v) for k, v in variables["params"].items()}
+    j_out, (j_h, j_c) = jm.apply(variables, jnp.asarray(x))
+    ref_y, ref_h, ref_c = _np_mlstm_ref(x, p, H)
+    np.testing.assert_allclose(np.asarray(j_out), ref_y, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(j_h[0]), ref_h, rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(j_c[0]), ref_c, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_bf16_io_fp32_gates():
+    jm = LSTM(input_size=8, hidden_size=8, dtype=jnp.bfloat16)
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 2, 8), jnp.float32)
+    variables = jm.init(jax.random.PRNGKey(1), x)
+    out, (h, c) = jm.apply(variables, x)
+    assert out.dtype == jnp.bfloat16 and h.dtype == jnp.bfloat16
+    # fp32 reference from the same params stays within bf16 tolerance
+    jm32 = LSTM(input_size=8, hidden_size=8)
+    out32, _ = jm32.apply(variables, x)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(out32), rtol=0.1, atol=0.05)
+
+
+def test_grad_flows():
+    jm = GRU(input_size=6, hidden_size=5, num_layers=2)
+    x = jax.random.normal(jax.random.PRNGKey(0), (5, 2, 6), jnp.float32)
+    variables = jm.init(jax.random.PRNGKey(1), x)
+
+    def loss(params):
+        out, _ = jm.apply({"params": params}, x)
+        return jnp.sum(out ** 2)
+
+    g = jax.grad(loss)(variables["params"])
+    total = sum(float(jnp.sum(jnp.abs(v))) for v in g.values())
+    assert np.isfinite(total) and total > 0
