@@ -227,6 +227,14 @@ def make_train_step(loss_fn: Callable, optimizer, policy: Policy,
             params)
         model_params = policy.cast_params(params32, is_norm_param)
         masters = params32 if policy.wants_master_weights else None
+        if masters is not None:
+            # fp32-passthrough leaves (keep_batchnorm_fp32 norm params) come
+            # out of cast_params as the *same* jax.Array as the master leaf;
+            # a donated AmpState would then hand one buffer to the runtime
+            # twice (PJRT rejects double donation). Copy to break aliasing.
+            model_params = jax.tree_util.tree_map(
+                lambda m, p: jnp.array(p, copy=True) if p is m else p,
+                masters, model_params)
         opt_params = masters if masters is not None else model_params
         opt_state = optimizer.init(opt_params)
         scaler = init_scaler(policy.loss_scale)
@@ -296,7 +304,11 @@ def make_train_step(loss_fn: Callable, optimizer, policy: Policy,
 
         # master→model half copy (apex _master_params_to_model_params /
         # multi_tensor_scale after step). Norm params may be fp32 in the
-        # model pytree; tree_map preserves each leaf's dtype.
+        # model pytree; tree_map preserves each leaf's dtype. For fp32
+        # passthrough leaves this traces to the same value as the master
+        # leaf, but as two *outputs* of the jitted step XLA materializes
+        # them into distinct buffers — so re-donating the returned state is
+        # safe (unlike init_fn's eager case, which must copy explicitly).
         new_params = jax.tree_util.tree_map(
             lambda m, p: jnp.asarray(m, jnp.asarray(p).dtype),
             new_cur, state.params)

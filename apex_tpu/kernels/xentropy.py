@@ -38,46 +38,45 @@ def xent_reference(logits, labels, smoothing: float = 0.0):
     return nll
 
 
-def _fwd_kernel(lg_ref, lb_ref, loss_ref, mlse_ref, *, smoothing, block_rows):
-    i = pl.program_id(0)
+def _fwd_kernel(lg_ref, lb_ref, loss_ref, mlse_ref, *, smoothing):
+    # per-row tensors ride the SUBLANE dim as [br, 1] blocks — lane-dim
+    # dynamic stores at non-128-aligned offsets don't lower on Mosaic
     lg = lg_ref[:].astype(jnp.float32)              # [br, V]
-    labels = lb_ref[0, 0, pl.ds(i * block_rows, block_rows)]   # [br]
+    labels = lb_ref[:, 0]                           # [br]
     m = jnp.max(lg, axis=-1, keepdims=True)
     lse = jnp.log(jnp.sum(jnp.exp(lg - m), axis=-1, keepdims=True)) + m
     # gather-by-label as a masked reduction (Mosaic has no 1-slice gather)
     cols = jax.lax.broadcasted_iota(jnp.int32, lg.shape, 1)
     onehot_logit = jnp.sum(
         jnp.where(cols == labels[:, None], lg, 0.0), axis=-1, keepdims=True)
-    nll = (lse - onehot_logit)[:, 0]
+    nll = lse - onehot_logit                        # [br, 1]
     if smoothing > 0.0:
-        mean_logp = jnp.mean(lg - lse, axis=-1)
+        mean_logp = jnp.mean(lg - lse, axis=-1, keepdims=True)
         loss = (1.0 - smoothing) * nll - smoothing * mean_logp
     else:
         loss = nll
-    loss_ref[0, 0, pl.ds(i * block_rows, block_rows)] = loss
-    mlse_ref[0, 0, pl.ds(i * block_rows, block_rows)] = lse[:, 0]
+    loss_ref[:] = loss
+    mlse_ref[:] = lse
 
 
-def _bwd_kernel(lg_ref, lb_ref, mlse_ref, g_ref, out_ref, *, smoothing,
-                block_rows):
-    i = pl.program_id(0)
+def _bwd_kernel(lg_ref, lb_ref, mlse_ref, g_ref, out_ref, *, smoothing):
     lg = lg_ref[:].astype(jnp.float32)              # [br, V]
-    labels = lb_ref[0, 0, pl.ds(i * block_rows, block_rows)]
-    lse = mlse_ref[0, 0, pl.ds(i * block_rows, block_rows)]
-    g = g_ref[0, 0, pl.ds(i * block_rows, block_rows)]
+    labels = lb_ref[:, 0]
+    lse = mlse_ref[:]                               # [br, 1]
+    g = g_ref[:]                                    # [br, 1]
     V = lg.shape[-1]
-    softmax = jnp.exp(lg - lse[:, None])
+    softmax = jnp.exp(lg - lse)
     cols = jax.lax.broadcasted_iota(jnp.int32, softmax.shape, 1)
     onehot = (cols == labels[:, None]).astype(jnp.float32)
     if smoothing > 0.0:
         target = (1.0 - smoothing) * onehot + smoothing / V
     else:
         target = onehot
-    out_ref[:] = ((softmax - target) * g[:, None]).astype(out_ref.dtype)
+    out_ref[:] = ((softmax - target) * g).astype(out_ref.dtype)
 
 
-def _rows3(x, n):
-    return x.reshape(1, 1, n)
+def _col(x, n):
+    return x.reshape(n, 1)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
@@ -94,25 +93,24 @@ def _block_rows(n):
 def _xent_fwd(logits, labels, smoothing, interpret):
     n, v = logits.shape
     br = _block_rows(n)
-    kernel = functools.partial(_fwd_kernel, smoothing=smoothing,
-                               block_rows=br)
+    kernel = functools.partial(_fwd_kernel, smoothing=smoothing)
     loss, mlse = pl.pallas_call(
         kernel,
         grid=(n // br,),
         in_specs=[
             pl.BlockSpec((br, v), lambda i: (i, 0)),
-            pl.BlockSpec((1, 1, n), lambda i: (0, 0, 0)),
+            pl.BlockSpec((br, 1), lambda i: (i, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, n), lambda i: (0, 0, 0)),
-            pl.BlockSpec((1, 1, n), lambda i: (0, 0, 0)),
+            pl.BlockSpec((br, 1), lambda i: (i, 0)),
+            pl.BlockSpec((br, 1), lambda i: (i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((1, 1, n), jnp.float32),
-            jax.ShapeDtypeStruct((1, 1, n), jnp.float32),
+            jax.ShapeDtypeStruct((n, 1), jnp.float32),
+            jax.ShapeDtypeStruct((n, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(logits, _rows3(labels, n))
+    )(logits, _col(labels, n))
     return loss.reshape(n), (logits, labels, mlse)
 
 
@@ -120,21 +118,21 @@ def _xent_bwd(smoothing, interpret, res, g):
     logits, labels, mlse = res
     n, v = logits.shape
     br = _block_rows(n)
-    kernel = functools.partial(_bwd_kernel, smoothing=smoothing,
-                               block_rows=br)
+    kernel = functools.partial(_bwd_kernel, smoothing=smoothing)
     dlogits = pl.pallas_call(
         kernel,
         grid=(n // br,),
         in_specs=[
             pl.BlockSpec((br, v), lambda i: (i, 0)),
-            pl.BlockSpec((1, 1, n), lambda i: (0, 0, 0)),
-            pl.BlockSpec((1, 1, n), lambda i: (0, 0, 0)),
-            pl.BlockSpec((1, 1, n), lambda i: (0, 0, 0)),
+            pl.BlockSpec((br, 1), lambda i: (i, 0)),
+            pl.BlockSpec((br, 1), lambda i: (i, 0)),
+            pl.BlockSpec((br, 1), lambda i: (i, 0)),
         ],
         out_specs=pl.BlockSpec((br, v), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((n, v), logits.dtype),
         interpret=interpret,
-    )(logits, _rows3(labels, n), mlse, _rows3(g.astype(jnp.float32), n))
+    )(logits, _col(labels, n), _col(mlse, n),
+      _col(g.astype(jnp.float32), n))
     return dlogits, None
 
 

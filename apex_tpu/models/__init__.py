@@ -6,11 +6,17 @@ standalone TPU framework must ship the models its recipes run, so they live
 here.
 """
 
+from .bert import (  # noqa: F401
+    BertConfig, BertForPreTraining, BertModel, create_bert)
 from .resnet import (  # noqa: F401
     BasicBlock, Bottleneck, ResNet, ResNet18, ResNet34, ResNet50, ResNet101,
     ResNet152, create_model)
+from .transformer_lm import (  # noqa: F401
+    TransformerBlock, TransformerLM, create_lm)
 
 __all__ = [
     "BasicBlock", "Bottleneck", "ResNet", "ResNet18", "ResNet34", "ResNet50",
     "ResNet101", "ResNet152", "create_model",
+    "TransformerLM", "TransformerBlock", "create_lm",
+    "BertConfig", "BertModel", "BertForPreTraining", "create_bert",
 ]

@@ -1,0 +1,207 @@
+"""BERT encoder + pretraining heads — the framework's config-4 workload.
+
+The reference drives BERT-large pretraining from NVIDIA DeepLearningExamples
+(BASELINE.json config 4: "BERT-large pretraining with FusedLAMB + amp O2");
+apex supplies FusedLAMB, FusedLayerNorm, and the fmha/xentropy kernels. This
+is the standalone TPU equivalent built from the same framework tiers:
+
+- post-LN encoder blocks (original BERT topology) with
+  :class:`apex_tpu.normalization.FusedLayerNorm`
+- attention via the Pallas flash kernel with ``segment_ids`` carrying the
+  padding mask — the varlen trick fmhalib (apex/contrib/fmha) uses for
+  MLPerf BERT, expressed as segment-blocked tiles instead of cu_seqlens
+- MLM + NSP pretraining heads; MLM loss masked by ``masked_lm_positions``
+  gather, the DeepLearningExamples formulation.
+
+bf16 compute / fp32 params is the expected amp-O2 configuration.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from apex_tpu.kernels.flash_attention import flash_attention
+from apex_tpu.normalization import FusedLayerNorm
+
+__all__ = ["BertConfig", "BertModel", "BertForPreTraining", "create_bert"]
+
+
+class BertConfig:
+    """Mirror of the HuggingFace/DeepLearningExamples bert_config.json keys."""
+
+    def __init__(self, vocab_size=30522, hidden_size=768,
+                 num_hidden_layers=12, num_attention_heads=12,
+                 intermediate_size=3072, max_position_embeddings=512,
+                 type_vocab_size=2, hidden_dropout_prob=0.1,
+                 attention_probs_dropout_prob=0.1, layer_norm_eps=1e-12):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size
+        self.max_position_embeddings = max_position_embeddings
+        self.type_vocab_size = type_vocab_size
+        self.hidden_dropout_prob = hidden_dropout_prob
+        self.attention_probs_dropout_prob = attention_probs_dropout_prob
+        self.layer_norm_eps = layer_norm_eps
+
+
+class BertLayer(nn.Module):
+    """Post-LN block: LN(x + attn(x)); LN(x + mlp(x))."""
+
+    config: BertConfig
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, attention_mask, *, train: bool):
+        cfg = self.config
+        B, S, H = x.shape
+        heads = cfg.num_attention_heads
+        d = H // heads
+        qkv = nn.Dense(3 * H, dtype=self.dtype, param_dtype=self.param_dtype,
+                       name="qkv")(x)
+        qkv = qkv.reshape(B, S, 3, heads, d)
+        q, k, v = (jnp.moveaxis(qkv[:, :, i], 1, 2) for i in range(3))
+        # padding mask as segment ids: real tokens (1) attend only among
+        # themselves; pad tokens (0) form their own segment and are dropped
+        # from the loss. This is the Pallas-native form of fmhalib's varlen
+        # packing (apex/contrib/fmha — cu_seqlens).
+        seg = attention_mask.astype(jnp.int32)
+        out = flash_attention(q, k, v, segment_ids=seg)
+        out = jnp.moveaxis(out, 1, 2).reshape(B, S, H)
+        out = nn.Dense(H, dtype=self.dtype, param_dtype=self.param_dtype,
+                       name="attn_out")(out)
+        if cfg.hidden_dropout_prob > 0.0:
+            out = nn.Dropout(rate=cfg.hidden_dropout_prob,
+                             deterministic=not train)(out)
+        x = FusedLayerNorm(normalized_shape=H, eps=cfg.layer_norm_eps,
+                           dtype=self.dtype, name="ln_attn")(x + out)
+        h = nn.Dense(cfg.intermediate_size, dtype=self.dtype,
+                     param_dtype=self.param_dtype, name="mlp_in")(x)
+        h = nn.gelu(jnp.asarray(h, jnp.float32), approximate=False)
+        h = nn.Dense(H, dtype=self.dtype, param_dtype=self.param_dtype,
+                     name="mlp_out")(jnp.asarray(h, self.dtype))
+        if cfg.hidden_dropout_prob > 0.0:
+            h = nn.Dropout(rate=cfg.hidden_dropout_prob,
+                           deterministic=not train)(h)
+        return FusedLayerNorm(normalized_shape=H, eps=cfg.layer_norm_eps,
+                              dtype=self.dtype, name="ln_mlp")(x + h)
+
+
+class BertModel(nn.Module):
+    """Embeddings + encoder + pooler.
+
+    ``__call__(input_ids, token_type_ids, attention_mask, train) ->
+    (sequence_output[B,S,H], pooled_output[B,H])``.
+    """
+
+    config: BertConfig
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    # optional externally-owned word embedding (weight tying with the MLM
+    # decoder: BertForPreTraining constructs it and shares the instance)
+    embed: Optional[nn.Module] = None
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
+                 *, train: bool = True):
+        cfg = self.config
+        B, S = input_ids.shape
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        if attention_mask is None:
+            attention_mask = jnp.ones_like(input_ids)
+        wte = self.embed if self.embed is not None else nn.Embed(
+            cfg.vocab_size, cfg.hidden_size, param_dtype=self.param_dtype,
+            name="word_embeddings")
+        tte = nn.Embed(cfg.type_vocab_size, cfg.hidden_size,
+                       param_dtype=self.param_dtype,
+                       name="token_type_embeddings")
+        wpe = self.param("position_embeddings",
+                         nn.initializers.normal(stddev=0.02),
+                         (cfg.max_position_embeddings, cfg.hidden_size),
+                         self.param_dtype)
+        x = wte(input_ids) + tte(token_type_ids) + wpe[:S][None]
+        x = FusedLayerNorm(normalized_shape=cfg.hidden_size,
+                           eps=cfg.layer_norm_eps, name="embed_ln")(x)
+        x = jnp.asarray(x, self.dtype)
+        if cfg.hidden_dropout_prob > 0.0:
+            x = nn.Dropout(rate=cfg.hidden_dropout_prob,
+                           deterministic=not train)(x)
+        for i in range(cfg.num_hidden_layers):
+            x = BertLayer(cfg, self.dtype, self.param_dtype,
+                          name=f"layer_{i}")(x, attention_mask, train=train)
+        pooled = nn.Dense(cfg.hidden_size, dtype=self.dtype,
+                          param_dtype=self.param_dtype, name="pooler")(
+                              x[:, 0])
+        pooled = jnp.tanh(pooled)
+        return x, pooled
+
+
+class BertForPreTraining(nn.Module):
+    """MLM + NSP heads over BertModel (DeepLearningExamples formulation).
+
+    ``__call__`` returns ``(mlm_logits[B, P, vocab], nsp_logits[B, 2])`` where
+    P = ``masked_lm_positions.shape[1]`` — MLM logits are computed only at the
+    masked positions (gather before the vocab GEMM: the standard trick that
+    turns a [B,S,vocab] matmul into [B,P,vocab], ~15x smaller for BERT's 15%
+    masking — essential on HBM).
+    """
+
+    config: BertConfig
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids, attention_mask,
+                 masked_lm_positions, *, train: bool = True):
+        cfg = self.config
+        # word embedding owned here so the MLM decoder can tie to it (flax
+        # module sharing: the instance is a child of this module; BertModel
+        # calls it by reference)
+        wte = nn.Embed(cfg.vocab_size, cfg.hidden_size,
+                       param_dtype=self.param_dtype, name="word_embeddings")
+        bert = BertModel(cfg, self.dtype, self.param_dtype, embed=wte,
+                         name="bert")
+        seq, pooled = bert(input_ids, token_type_ids, attention_mask,
+                           train=train)
+        B, S, H = seq.shape
+        # gather masked positions before the vocab GEMM: [B, P, H]
+        gathered = jnp.take_along_axis(
+            seq, masked_lm_positions[..., None].astype(jnp.int32), axis=1)
+        h = nn.Dense(H, dtype=self.dtype, param_dtype=self.param_dtype,
+                     name="mlm_transform")(gathered)
+        h = nn.gelu(jnp.asarray(h, jnp.float32), approximate=False)
+        h = FusedLayerNorm(normalized_shape=H, eps=cfg.layer_norm_eps,
+                           name="mlm_ln")(h)
+        # tied decoder: h @ embedding.T + bias, logits fp32 (Embed.attend is
+        # flax's shared-weight tied-decoder path)
+        mlm_logits = wte.attend(jnp.asarray(h, jnp.float32))
+        mlm_logits = jnp.asarray(mlm_logits, jnp.float32)
+        mlm_bias = self.param("mlm_bias", nn.initializers.zeros,
+                              (cfg.vocab_size,), jnp.float32)
+        mlm_logits = mlm_logits + mlm_bias
+        nsp_logits = nn.Dense(2, dtype=jnp.float32,
+                              param_dtype=self.param_dtype,
+                              name="nsp")(jnp.asarray(pooled, jnp.float32))
+        return mlm_logits, nsp_logits
+
+
+def create_bert(size: str = "base", **overrides) -> BertConfig:
+    sizes = {
+        "tiny": dict(hidden_size=128, num_hidden_layers=2,
+                     num_attention_heads=2, intermediate_size=512),
+        "base": dict(hidden_size=768, num_hidden_layers=12,
+                     num_attention_heads=12, intermediate_size=3072),
+        "large": dict(hidden_size=1024, num_hidden_layers=24,
+                      num_attention_heads=16, intermediate_size=4096),
+    }
+    if size not in sizes:
+        raise ValueError(f"unknown bert size {size!r}")
+    kw = dict(sizes[size])
+    kw.update(overrides)
+    return BertConfig(**kw)

@@ -1,0 +1,151 @@
+"""GPT-style causal transformer LM — the framework's config-3 workload.
+
+The reference has no LM of its own; its transformer pieces (FusedLayerNorm,
+fused softmax/xentropy kernels, FusedAdam) are exercised by external Megatron
+recipes (BASELINE.json config 3: "FusedLayerNorm + FusedAdam transformer LM
+(WikiText-2)"). This model is the standalone equivalent, assembled entirely
+from the framework's own fused tiers:
+
+- pre-LN blocks with :class:`apex_tpu.normalization.FusedLayerNorm`
+- attention via :func:`apex_tpu.kernels.flash_attention.flash_attention`
+  (Pallas, causal tile-skip — replaces N8/N11's fused softmax+MHA kernels)
+- MLP via :func:`apex_tpu.fused_dense.fused_dense_gelu_dense_function`'s
+  fp32-epilogue GELU semantics
+- LM loss via :mod:`apex_tpu.kernels.xentropy` in the recipes.
+
+TPU-first choices: bf16 compute with fp32 params (amp O2 shape), weights kept
+as flax Dense kernels (MXU-layout friendly), embedding output scaled and tied
+to the LM head (standard GPT weight tying — one less HBM-resident vocab
+matrix).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from apex_tpu.kernels.flash_attention import flash_attention
+from apex_tpu.normalization import FusedLayerNorm
+
+__all__ = ["TransformerLM", "TransformerBlock", "create_lm"]
+
+
+class SelfAttention(nn.Module):
+    hidden: int
+    num_heads: int
+    dropout: float = 0.0
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        B, S, H = x.shape
+        d = self.hidden // self.num_heads
+        qkv = nn.Dense(3 * self.hidden, dtype=self.dtype,
+                       param_dtype=self.param_dtype, name="qkv")(x)
+        qkv = qkv.reshape(B, S, 3, self.num_heads, d)
+        q, k, v = (jnp.moveaxis(qkv[:, :, i], 1, 2) for i in range(3))
+        out = flash_attention(q, k, v, causal=True)  # [B, h, S, d]
+        out = jnp.moveaxis(out, 1, 2).reshape(B, S, self.hidden)
+        out = nn.Dense(self.hidden, dtype=self.dtype,
+                       param_dtype=self.param_dtype, name="proj")(out)
+        if self.dropout > 0.0:
+            out = nn.Dropout(rate=self.dropout, deterministic=not train)(out)
+        return out
+
+
+class TransformerBlock(nn.Module):
+    """Pre-LN block: x + attn(LN(x)); x + mlp(LN(x))."""
+
+    hidden: int
+    num_heads: int
+    mlp_ratio: int = 4
+    dropout: float = 0.0
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        h = FusedLayerNorm(normalized_shape=self.hidden, dtype=self.dtype,
+                           name="ln_attn")(x)
+        x = x + SelfAttention(self.hidden, self.num_heads, self.dropout,
+                              self.dtype, self.param_dtype,
+                              name="attn")(h, train=train)
+        h = FusedLayerNorm(normalized_shape=self.hidden, dtype=self.dtype,
+                           name="ln_mlp")(x)
+        inner = self.mlp_ratio * self.hidden
+        h = nn.Dense(inner, dtype=self.dtype, param_dtype=self.param_dtype,
+                     name="mlp_in")(h)
+        # exact-erf GELU on the fp32 accumulator (fused_dense epilogue
+        # semantics — apex/fused_dense: CUBLASLT_EPILOGUE_GELU)
+        h = nn.gelu(jnp.asarray(h, jnp.float32), approximate=False)
+        h = nn.Dense(self.hidden, dtype=self.dtype,
+                     param_dtype=self.param_dtype,
+                     name="mlp_out")(jnp.asarray(h, self.dtype))
+        if self.dropout > 0.0:
+            h = nn.Dropout(rate=self.dropout, deterministic=not train)(h)
+        return x + h
+
+
+class TransformerLM(nn.Module):
+    """Causal LM: tied-embedding GPT with pre-LN blocks + final FusedLayerNorm.
+
+    ``__call__(tokens[B, S], train) -> logits[B, S, vocab]`` (logits fp32 —
+    loss math never runs in half, matching amp's FP32_FUNCS policy for
+    softmax/loss: apex/amp/lists/functional_overrides.py).
+    """
+
+    vocab_size: int
+    hidden: int = 512
+    num_layers: int = 6
+    num_heads: int = 8
+    max_seq_len: int = 1024
+    mlp_ratio: int = 4
+    dropout: float = 0.0
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens, *, train: bool = True):
+        B, S = tokens.shape
+        embed = nn.Embed(self.vocab_size, self.hidden,
+                         param_dtype=self.param_dtype, name="wte")
+        pos = self.param("wpe", nn.initializers.normal(stddev=0.02),
+                         (self.max_seq_len, self.hidden), self.param_dtype)
+        x = jnp.asarray(embed(tokens) + pos[:S][None], self.dtype)
+        if self.dropout > 0.0:
+            x = nn.Dropout(rate=self.dropout, deterministic=not train)(x)
+        for i in range(self.num_layers):
+            x = TransformerBlock(self.hidden, self.num_heads, self.mlp_ratio,
+                                 self.dropout, self.dtype, self.param_dtype,
+                                 name=f"block_{i}")(x, train=train)
+        x = FusedLayerNorm(normalized_shape=self.hidden, dtype=self.dtype,
+                           name="ln_f")(x)
+        # tied LM head; logits in fp32
+        logits = jnp.dot(jnp.asarray(x, jnp.float32),
+                         jnp.asarray(embed.embedding, jnp.float32).T)
+        return logits
+
+
+_LM_SIZES = {
+    # (hidden, layers, heads) — "small" is the WikiText-2 recipe default
+    "tiny": (128, 2, 4),
+    "small": (512, 6, 8),
+    "medium": (1024, 12, 16),
+    "gpt2": (768, 12, 12),
+}
+
+
+def create_lm(size: str = "small", vocab_size: int = 32768,
+              max_seq_len: int = 1024, dropout: float = 0.0,
+              dtype: Any = jnp.float32,
+              param_dtype: Any = jnp.float32) -> TransformerLM:
+    if size not in _LM_SIZES:
+        raise ValueError(f"unknown LM size {size!r}; one of {sorted(_LM_SIZES)}")
+    hidden, layers, heads = _LM_SIZES[size]
+    return TransformerLM(vocab_size=vocab_size, hidden=hidden,
+                         num_layers=layers, num_heads=heads,
+                         max_seq_len=max_seq_len, dropout=dropout,
+                         dtype=dtype, param_dtype=param_dtype)

@@ -1,0 +1,155 @@
+"""BERT pretraining recipe — BASELINE.json config 4.
+
+"BERT-large pretraining with FusedLAMB + amp O2": the apex-powered NVIDIA
+DeepLearningExamples BERT recipe (run_pretraining.py — apex.optimizers.
+FusedLAMB + amp + fused kernels), rebuilt standalone on the framework's own
+tiers: apex_tpu.models.bert (flash-attention encoder, FusedLayerNorm),
+apex_tpu.optimizers.fused_lamb (NVLAMB trust-ratio update), MLM+NSP loss via
+the fused xentropy kernel, amp O2 master weights + dynamic loss scaling.
+
+LAMB exists for exactly this workload: 64k-batch phase-1 pretraining (You et
+al. 2019). The recipe keeps DeepLearningExamples' argument names
+(--train_batch_size, --max_seq_length, --max_predictions_per_seq,
+--warmup_proportion) and the poly-decay warmup schedule.
+
+Synthetic data only in this environment (no network); batches follow the
+BERT input schema: (input_ids, token_type_ids, attention_mask,
+masked_lm_positions, masked_lm_ids, next_sentence_labels).
+"""
+
+from __future__ import annotations
+
+import os as _os
+import sys as _sys
+
+# run as a script from anywhere: put the repo root on sys.path (the reference
+# relies on `pip install apex`; this repo is used in-tree)
+_REPO_ROOT = _os.path.abspath(_os.path.join(_os.path.dirname(__file__),
+                                            _os.pardir, _os.pardir))
+if _REPO_ROOT not in _sys.path:
+    _sys.path.insert(0, _REPO_ROOT)
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from apex_tpu import amp
+from apex_tpu.kernels.xentropy import softmax_cross_entropy_loss
+from apex_tpu.models.bert import BertForPreTraining, create_bert
+from apex_tpu.optimizers import fused_lamb
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="apex_tpu BERT-LAMB pretraining")
+    p.add_argument("--bert-model", default="tiny",
+                   choices=["tiny", "base", "large"])
+    p.add_argument("--train_batch_size", type=int, default=8)
+    p.add_argument("--max_seq_length", type=int, default=128)
+    p.add_argument("--max_predictions_per_seq", type=int, default=20)
+    p.add_argument("--learning_rate", type=float, default=6e-3)
+    p.add_argument("--warmup_proportion", type=float, default=0.2843)
+    p.add_argument("--max_steps", type=int, default=30)
+    p.add_argument("--opt-level", default="O2")
+    p.add_argument("--loss-scale", default="dynamic")
+    p.add_argument("--seed", type=int, default=42)
+    return p.parse_args(argv)
+
+
+def synthetic_bert_batch(rng, batch, seq_len, n_pred, vocab):
+    ks = jax.random.split(rng, 5)
+    input_ids = jax.random.randint(ks[0], (batch, seq_len), 0, vocab)
+    lengths = jax.random.randint(ks[1], (batch,), seq_len // 2, seq_len + 1)
+    attention_mask = (jnp.arange(seq_len)[None] < lengths[:, None]) \
+        .astype(jnp.int32)
+    token_type_ids = (jnp.arange(seq_len)[None] >=
+                      (lengths // 2)[:, None]).astype(jnp.int32)
+    masked_lm_positions = jax.random.randint(ks[2], (batch, n_pred), 0,
+                                             seq_len // 2)
+    masked_lm_ids = jax.random.randint(ks[3], (batch, n_pred), 1, vocab)
+    next_sentence_labels = jax.random.randint(ks[4], (batch,), 0, 2)
+    return (input_ids, token_type_ids, attention_mask, masked_lm_positions,
+            masked_lm_ids, next_sentence_labels)
+
+
+def make_schedule(lr, max_steps, warmup_proportion):
+    """DeepLearningExamples PolyWarmUpScheduler: linear warmup, poly decay."""
+    warmup = max(1, int(max_steps * warmup_proportion))
+    return optax.join_schedules(
+        [optax.linear_schedule(0.0, lr, warmup),
+         optax.polynomial_schedule(lr, 0.0, power=1.0,
+                                   transition_steps=max_steps - warmup)],
+        [warmup])
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    policy = amp.resolve_policy(opt_level=args.opt_level,
+                                loss_scale=args.loss_scale)
+    print(policy.banner())
+
+    cfg = create_bert(args.bert_model,
+                      max_position_embeddings=args.max_seq_length)
+    model = BertForPreTraining(cfg, dtype=policy.compute_dtype)
+    rng = jax.random.PRNGKey(args.seed)
+    b0 = synthetic_bert_batch(rng, 2, args.max_seq_length,
+                              args.max_predictions_per_seq, cfg.vocab_size)
+    params = model.init(rng, *b0[:4], train=False)["params"]
+
+    schedule = make_schedule(args.learning_rate, args.max_steps,
+                             args.warmup_proportion)
+    optimizer = fused_lamb(schedule, weight_decay=0.01)
+
+    def loss_fn(p, batch):
+        (input_ids, token_type_ids, attention_mask, mlm_pos, mlm_ids,
+         nsp_labels, dropout_rng) = batch
+        mlm_logits, nsp_logits = model.apply(
+            {"params": p}, input_ids, token_type_ids, attention_mask,
+            mlm_pos, train=True, rngs={"dropout": dropout_rng})
+        # masked positions with id 0 are padding of the prediction slots
+        # (DeepLearningExamples masks them out of the mean)
+        mlm_losses = softmax_cross_entropy_loss(mlm_logits, mlm_ids)
+        valid = (mlm_ids != 0).astype(jnp.float32)
+        mlm_loss = jnp.sum(mlm_losses * valid) / jnp.maximum(
+            jnp.sum(valid), 1.0)
+        nsp_loss = softmax_cross_entropy_loss(nsp_logits, nsp_labels).mean()
+        return mlm_loss + nsp_loss
+
+    init_fn, step_fn = amp.make_train_step(loss_fn, optimizer, policy)
+    state = init_fn(params)
+    jit_step = jax.jit(step_fn, donate_argnums=(0,))
+
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(params))
+    print(f"=> BERT-{args.bert_model}, params: {n_params:,}")
+
+    t0 = None
+    seqs = 0
+    for it in range(args.max_steps):
+        rng, sub = jax.random.split(rng)
+        sub, drop = jax.random.split(sub)
+        batch = synthetic_bert_batch(sub, args.train_batch_size,
+                                     args.max_seq_length,
+                                     args.max_predictions_per_seq,
+                                     cfg.vocab_size) + (drop,)
+        state, metrics = jit_step(state, batch)
+        if it == 4:
+            metrics["loss"].block_until_ready()
+            t0 = time.perf_counter()
+            seqs = 0
+        seqs += args.train_batch_size
+        if it % 10 == 0 or it == args.max_steps - 1:
+            print(f"[{it}/{args.max_steps}] loss "
+                  f"{float(metrics['loss']):.4f} "
+                  f"loss_scale {float(metrics['loss_scale']):g}")
+    jax.tree_util.tree_leaves(state.params)[0].block_until_ready()
+    if t0 is not None and args.max_steps > 5:
+        dt = time.perf_counter() - t0
+        print(f"throughput: {seqs / dt:,.1f} sequences/s")
+
+
+if __name__ == "__main__":
+    main()

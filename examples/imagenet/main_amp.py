@@ -20,6 +20,16 @@ north-star unit.
 
 from __future__ import annotations
 
+import os as _os
+import sys as _sys
+
+# run as a script from anywhere: put the repo root on sys.path (the reference
+# relies on `pip install apex`; this repo is used in-tree)
+_REPO_ROOT = _os.path.abspath(_os.path.join(_os.path.dirname(__file__),
+                                            _os.pardir, _os.pardir))
+if _REPO_ROOT not in _sys.path:
+    _sys.path.insert(0, _REPO_ROOT)
+
 import argparse
 import functools
 import time

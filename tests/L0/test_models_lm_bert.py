@@ -1,0 +1,173 @@
+"""Transformer LM + BERT model tests (BASELINE configs 3 & 4 workloads).
+
+Strategy mirrors the reference's L0 tier: composed fp32 references for
+numerics (causality probed directly), short training runs for integration
+(loss decreases under amp O2 + fused optimizers — the L1 bar in miniature).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import amp
+from apex_tpu.kernels.xentropy import softmax_cross_entropy_loss
+from apex_tpu.models.bert import BertForPreTraining, BertModel, create_bert
+from apex_tpu.models.transformer_lm import TransformerLM, create_lm
+from apex_tpu.optimizers import fused_adam, fused_lamb
+
+VOCAB = 101
+
+
+def _tiny_lm(**kw):
+    return TransformerLM(vocab_size=VOCAB, hidden=64, num_layers=2,
+                         num_heads=4, max_seq_len=32, **kw)
+
+
+def test_lm_forward_shape_and_dtype():
+    m = _tiny_lm(dtype=jnp.bfloat16)
+    toks = jnp.zeros((2, 16), jnp.int32)
+    params = m.init(jax.random.PRNGKey(0), toks, train=False)["params"]
+    logits = m.apply({"params": params}, toks, train=False)
+    assert logits.shape == (2, 16, VOCAB)
+    assert logits.dtype == jnp.float32  # loss math never in half
+
+
+def test_lm_is_causal():
+    """Changing a future token must not change past logits."""
+    m = _tiny_lm()
+    rng = jax.random.PRNGKey(1)
+    toks = jax.random.randint(rng, (1, 16), 0, VOCAB)
+    params = m.init(jax.random.PRNGKey(0), toks, train=False)["params"]
+    base = m.apply({"params": params}, toks, train=False)
+    toks2 = toks.at[0, 10].set((toks[0, 10] + 1) % VOCAB)
+    pert = m.apply({"params": params}, toks2, train=False)
+    np.testing.assert_allclose(np.asarray(base[0, :10]),
+                               np.asarray(pert[0, :10]), rtol=1e-5,
+                               atol=1e-5)
+    assert not np.allclose(np.asarray(base[0, 10:]), np.asarray(pert[0, 10:]))
+
+
+def test_lm_tied_embeddings():
+    m = _tiny_lm()
+    toks = jnp.zeros((1, 8), jnp.int32)
+    params = m.init(jax.random.PRNGKey(0), toks, train=False)["params"]
+    # no separate lm_head weight: the wte table is the only vocab-sized param
+    vocab_params = [k for k, v in jax.tree_util.tree_leaves_with_path(params)
+                    if v.shape and VOCAB in v.shape]
+    assert len(vocab_params) == 1
+
+
+def test_lm_trains_amp_o2():
+    m = _tiny_lm(dtype=jnp.bfloat16)
+    policy = amp.resolve_policy(opt_level="O2", loss_scale="dynamic")
+    toks = jnp.zeros((4, 17), jnp.int32)
+    params = m.init(jax.random.PRNGKey(0), toks[:, :-1],
+                    train=False)["params"]
+
+    def loss_fn(p, batch):
+        logits = m.apply({"params": p}, batch[:, :-1], train=True)
+        return softmax_cross_entropy_loss(logits, batch[:, 1:]).mean()
+
+    init_fn, step_fn = amp.make_train_step(loss_fn, fused_adam(1e-3), policy)
+    state = init_fn(params)
+    jit_step = jax.jit(step_fn)
+    rng = jax.random.PRNGKey(2)
+    batch = jax.random.randint(rng, (4, 17), 0, VOCAB)
+    losses = []
+    for _ in range(8):
+        state, metrics = jit_step(state, batch)  # same batch: must overfit
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0] - 0.1, losses
+    assert np.isfinite(losses).all()
+
+
+def test_create_lm_sizes():
+    m = create_lm("tiny", vocab_size=50, max_seq_len=16)
+    assert m.hidden == 128 and m.num_layers == 2
+    with pytest.raises(ValueError):
+        create_lm("huge")
+
+
+@pytest.fixture(scope="module")
+def bert_setup():
+    cfg = create_bert("tiny", vocab_size=97, max_position_embeddings=32,
+                      hidden_dropout_prob=0.0,
+                      attention_probs_dropout_prob=0.0)
+    model = BertForPreTraining(cfg)
+    rng = jax.random.PRNGKey(0)
+    B, S, P = 2, 16, 4
+    input_ids = jax.random.randint(rng, (B, S), 0, cfg.vocab_size)
+    token_type_ids = jnp.zeros((B, S), jnp.int32)
+    attention_mask = jnp.ones((B, S), jnp.int32).at[1, 10:].set(0)
+    mlm_pos = jnp.array([[1, 3, 5, 7], [0, 2, 4, 6]], jnp.int32)
+    params = model.init(rng, input_ids, token_type_ids, attention_mask,
+                        mlm_pos, train=False)["params"]
+    return cfg, model, params, (input_ids, token_type_ids, attention_mask,
+                                mlm_pos)
+
+
+def test_bert_pretraining_shapes(bert_setup):
+    cfg, model, params, batch = bert_setup
+    mlm_logits, nsp_logits = model.apply({"params": params}, *batch,
+                                         train=False)
+    assert mlm_logits.shape == (2, 4, cfg.vocab_size)
+    assert nsp_logits.shape == (2, 2)
+    assert mlm_logits.dtype == jnp.float32
+
+
+def test_bert_mlm_decoder_is_tied(bert_setup):
+    cfg, model, params, batch = bert_setup
+    # exactly one vocab×hidden table (tied decoder), plus the mlm bias vector
+    big = [v for v in jax.tree_util.tree_leaves(params)
+           if v.ndim == 2 and cfg.vocab_size in v.shape]
+    assert len(big) == 1
+
+
+def test_bert_padding_is_ignored(bert_setup):
+    """Content of padded positions must not affect unmasked outputs."""
+    cfg, model, params, batch = bert_setup
+    input_ids, tt, mask, mlm_pos = batch
+    out1, _ = model.apply({"params": params}, input_ids, tt, mask, mlm_pos,
+                          train=False)
+    poked = input_ids.at[1, 12].set((input_ids[1, 12] + 3) % cfg.vocab_size)
+    out2, _ = model.apply({"params": params}, poked, tt, mask, mlm_pos,
+                          train=False)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_bert_trains_with_lamb(bert_setup):
+    cfg, model, params, batch = bert_setup
+    input_ids, tt, mask, mlm_pos = batch
+    mlm_ids = jax.random.randint(jax.random.PRNGKey(3), mlm_pos.shape, 1,
+                                 cfg.vocab_size)
+    nsp = jnp.array([0, 1], jnp.int32)
+    policy = amp.resolve_policy(opt_level="O2", loss_scale="dynamic")
+
+    def loss_fn(p, b):
+        ids, ttb, mb, pos, tgt, nspb = b
+        mlm_logits, nsp_logits = model.apply({"params": p}, ids, ttb, mb,
+                                             pos, train=False)
+        return (softmax_cross_entropy_loss(mlm_logits, tgt).mean()
+                + softmax_cross_entropy_loss(nsp_logits, nspb).mean())
+
+    init_fn, step_fn = amp.make_train_step(loss_fn, fused_lamb(5e-3), policy)
+    state = init_fn(params)
+    jit_step = jax.jit(step_fn)
+    full = (input_ids, tt, mask, mlm_pos, mlm_ids, nsp)
+    losses = []
+    for _ in range(6):
+        state, metrics = jit_step(state, full)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0], losses
+
+
+def test_bert_model_standalone():
+    cfg = create_bert("tiny", vocab_size=31, max_position_embeddings=16)
+    m = BertModel(cfg)
+    ids = jnp.zeros((2, 8), jnp.int32)
+    params = m.init(jax.random.PRNGKey(0), ids, train=False)["params"]
+    seq, pooled = m.apply({"params": params}, ids, train=False)
+    assert seq.shape == (2, 8, cfg.hidden_size)
+    assert pooled.shape == (2, cfg.hidden_size)
