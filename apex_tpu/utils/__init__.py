@@ -1,1 +1,3 @@
+from .checkpoint import (AsyncCheckpointer, latest_checkpoint,  # noqa: F401
+                         load_checkpoint, save_checkpoint)
 from .pytree import flatten, unflatten, flatten_tree, unflatten_tree  # noqa: F401

@@ -1,0 +1,142 @@
+"""Checkpoint / resume for amp train states.
+
+Reference surface being mirrored (SURVEY §6 — checkpoint/resume):
+
+- the documented apex pattern saves ``amp.state_dict()`` (loss scalers)
+  alongside model + optimizer state (apex/amp/README.md — "Checkpointing");
+- ``examples/imagenet/main_amp.py — --resume`` does torch.save/torch.load of
+  {model, optimizer, epoch, best_prec1}.
+
+Here the whole :class:`apex_tpu.amp.AmpState` is one pytree (params, masters,
+optimizer state, scaler — including the loss scale and unskipped counter), so
+a checkpoint is a single serialized tree plus a small metadata dict. Restore
+is shape/dtype-checked against a template state (the equivalent of loading
+into an already-constructed model/optimizer, which is how both apex and
+torch do it).
+
+Writes are atomic (tmp file + rename) so a preempted save never corrupts the
+previous checkpoint — the property orbax's async checkpointing provides on
+real pods; use orbax directly for multi-host sharded state.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Optional, Tuple
+
+import jax
+import numpy as np
+
+__all__ = ["save_checkpoint", "load_checkpoint", "latest_checkpoint",
+           "AsyncCheckpointer"]
+
+_META_KEY = "__apex_tpu_meta__"
+
+
+def save_checkpoint(path: str, state: Any, step: int = 0,
+                    extra: Optional[dict] = None) -> str:
+    """Serialize ``state`` (any pytree: AmpState, params, opt state) to
+    ``path`` (.npz). Returns the path written."""
+    flat, _ = jax.tree_util.tree_flatten(state)
+    arrays = {}
+    for i, x in enumerate(flat):
+        a = np.asarray(x)
+        if a.dtype.kind == "V" or a.dtype.name in ("bfloat16",):
+            # npz can't represent ml_dtypes (bfloat16 &c); fp32 holds every
+            # bf16 exactly and load_checkpoint casts back to the template
+            # dtype, so the round-trip is bit-faithful
+            a = a.astype(np.float32)
+        arrays[f"leaf_{i}"] = a
+    meta = {"step": int(step), "n_leaves": len(flat),
+            "extra": extra or {}}
+    arrays[_META_KEY] = np.frombuffer(
+        json.dumps(meta).encode("utf-8"), dtype=np.uint8)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+    os.replace(tmp, path)  # atomic on POSIX
+    return path
+
+
+def load_checkpoint(path: str, template: Any) -> Tuple[Any, int, dict]:
+    """Restore a pytree saved by :func:`save_checkpoint`.
+
+    ``template`` supplies the treedef and the expected shapes/dtypes (the
+    already-built state, as with torch's load_state_dict). Returns
+    ``(state, step, extra)``.
+    """
+    with np.load(path) as data:
+        meta = json.loads(bytes(data[_META_KEY].tolist()).decode("utf-8"))
+        flat_t, treedef = jax.tree_util.tree_flatten(template)
+        if meta["n_leaves"] != len(flat_t):
+            raise ValueError(
+                f"checkpoint has {meta['n_leaves']} leaves, template has "
+                f"{len(flat_t)} — wrong model/optimizer configuration")
+        flat = []
+        for i, t in enumerate(flat_t):
+            arr = data[f"leaf_{i}"]
+            t = np.asarray(t)
+            if arr.shape != t.shape:
+                raise ValueError(
+                    f"leaf {i}: checkpoint shape {arr.shape} != template "
+                    f"shape {t.shape}")
+            flat.append(jax.numpy.asarray(arr.astype(t.dtype)))
+    state = jax.tree_util.tree_unflatten(treedef, flat)
+    return state, meta["step"], meta["extra"]
+
+
+def latest_checkpoint(directory: str, prefix: str = "ckpt_") -> Optional[str]:
+    """Newest ``{prefix}{step}.npz`` in ``directory`` (by step), or None."""
+    if not os.path.isdir(directory):
+        return None
+    best, best_step = None, -1
+    for name in os.listdir(directory):
+        if name.startswith(prefix) and name.endswith(".npz"):
+            try:
+                step = int(name[len(prefix):-4])
+            except ValueError:
+                continue
+            if step > best_step:
+                best, best_step = os.path.join(directory, name), step
+    return best
+
+
+class AsyncCheckpointer:
+    """Background-thread checkpoint writer (orbax-style async save).
+
+    Device→host transfer happens on the caller's thread (cheap, and required
+    for consistency — the arrays must be snapshotted before the next step
+    mutates donated buffers); the file write happens on a worker thread so
+    the train loop never blocks on disk.
+    """
+
+    def __init__(self):
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+
+    def _write(self, path, state, step, extra):
+        try:
+            save_checkpoint(path, state, step, extra)
+        except BaseException as e:  # surfaced from wait()/next save()
+            self._error = e
+
+    def save(self, path: str, state: Any, step: int = 0,
+             extra: Optional[dict] = None):
+        host_state = jax.tree_util.tree_map(np.asarray, state)
+        self.wait()
+        self._thread = threading.Thread(
+            target=self._write, args=(path, host_state, step, extra),
+            daemon=True)
+        self._thread.start()
+
+    def wait(self):
+        """Block until the in-flight write finishes; re-raise its failure —
+        a swallowed write error would report phantom checkpoints."""
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err

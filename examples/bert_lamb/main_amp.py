@@ -148,7 +148,8 @@ def main(argv=None):
     jax.tree_util.tree_leaves(state.params)[0].block_until_ready()
     if t0 is not None and args.max_steps > 5:
         dt = time.perf_counter() - t0
-        print(f"throughput: {seqs / dt:,.1f} sequences/s")
+        print(f"throughput: "
+              f"{(seqs - args.train_batch_size) / dt:,.1f} sequences/s")
 
 
 if __name__ == "__main__":

@@ -32,6 +32,7 @@ if _REPO_ROOT not in _sys.path:
 
 import argparse
 import functools
+import os
 import time
 
 import jax
@@ -63,6 +64,10 @@ def parse_args(argv=None):
     p.add_argument("--sync_bn", action="store_true")
     p.add_argument("--prof", type=int, default=0)
     p.add_argument("--deterministic", action="store_true")
+    p.add_argument("--resume", default=None,
+                   help="checkpoint file (or dir: newest ckpt) to resume")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="save ckpt_{epoch}.npz here after each epoch")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--synthetic", action="store_true", default=True)
     p.add_argument("--data-parallel", type=int, default=1,
@@ -176,10 +181,30 @@ def main(argv=None):
         batch_sharding = None
         jit_step = jax.jit(step_fn)
 
+    start_epoch = 0
+    if args.resume:
+        # reference: main_amp.py --resume (torch.load of model+optimizer+
+        # epoch); here the whole AmpState round-trips through one file
+        from apex_tpu.utils import latest_checkpoint, load_checkpoint
+        path = args.resume
+        if os.path.isdir(path):
+            path = latest_checkpoint(path)
+            if path is None:
+                raise SystemExit(
+                    f"=> no checkpoint found in {args.resume!r}")
+        state, step, extra = load_checkpoint(path, state)
+        start_epoch = extra.get("epoch", step)
+        print(f"=> resumed from {path} (epoch {start_epoch})")
+
     print(f"=> model {args.arch}, params: "
           f"{sum(np.prod(p.shape) for p in jax.tree_util.tree_leaves(params)):,}")
 
-    for epoch in range(args.epochs):
+    ckpt = None
+    if args.checkpoint_dir:
+        from apex_tpu.utils import AsyncCheckpointer
+        os.makedirs(args.checkpoint_dir, exist_ok=True)
+        ckpt = AsyncCheckpointer()
+    for epoch in range(start_epoch, args.epochs):
         t0 = None
         imgs = 0
         for it in range(args.iters):
@@ -210,6 +235,14 @@ def main(argv=None):
         if t0 is not None and args.iters > 5:
             dt = time.perf_counter() - t0
             print(f"Epoch {epoch}: {(imgs - args.batch_size) / dt:.1f} img/s")
+        if ckpt is not None:
+            path = os.path.join(args.checkpoint_dir,
+                                f"ckpt_{epoch + 1}.npz")
+            ckpt.save(path, state, step=epoch + 1,
+                      extra={"epoch": epoch + 1})
+            print(f"=> saved {path}")
+    if ckpt is not None:
+        ckpt.wait()
     return state
 
 

@@ -126,7 +126,8 @@ def main(argv=None):
     jax.tree_util.tree_leaves(state.params)[0].block_until_ready()
     if t0 is not None and args.iters > 5:
         dt = time.perf_counter() - t0
-        print(f"throughput: {toks / dt:,.0f} tokens/s")
+        print(f"throughput: "
+              f"{(toks - args.batch_size * args.seq_len) / dt:,.0f} tokens/s")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,110 @@
+"""Checkpoint/resume tests.
+
+Mirrors the reference's tests/L0/run_amp/test_checkpointing.py: train, save
+(model + optimizer + amp scaler state), restore into a fresh setup, and
+assert the resumed trajectory matches the uninterrupted one exactly —
+including the loss-scale schedule position.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import amp
+from apex_tpu.optimizers import fused_adam
+from apex_tpu.utils import (AsyncCheckpointer, latest_checkpoint,
+                            load_checkpoint, save_checkpoint)
+
+
+def _setup(policy):
+    params = {"w": jnp.ones((8, 8)), "b": jnp.zeros((8,))}
+
+    def loss_fn(p, batch):
+        x, y = batch
+        pred = x @ jnp.asarray(p["w"], x.dtype) + jnp.asarray(p["b"], x.dtype)
+        return jnp.mean((jnp.asarray(pred, jnp.float32) - y) ** 2)
+
+    init_fn, step_fn = amp.make_train_step(loss_fn, fused_adam(1e-2), policy)
+    return params, init_fn, jax.jit(step_fn)
+
+
+def _batch(i):
+    k = jax.random.PRNGKey(i)
+    x = jax.random.normal(k, (4, 8))
+    y = jax.random.normal(jax.random.fold_in(k, 1), (4, 8))
+    return x, y
+
+
+@pytest.mark.parametrize("opt_level", ["O0", "O2"])
+def test_resume_reproduces_trajectory(tmp_path, opt_level):
+    policy = amp.resolve_policy(opt_level=opt_level, loss_scale="dynamic")
+    params, init_fn, jit_step = _setup(policy)
+
+    # uninterrupted run: 6 steps
+    state = init_fn(params)
+    for i in range(6):
+        state, m_full = jit_step(state, _batch(i))
+
+    # interrupted: 3 steps, save, restore into a FRESH state, 3 more
+    state2 = init_fn(params)
+    for i in range(3):
+        state2, _ = jit_step(state2, _batch(i))
+    path = os.path.join(tmp_path, "ckpt_3.npz")
+    save_checkpoint(path, state2, step=3, extra={"note": "mid"})
+
+    fresh = init_fn(params)
+    restored, step, extra = load_checkpoint(path, fresh)
+    assert step == 3 and extra == {"note": "mid"}
+    for i in range(3, 6):
+        restored, m_res = jit_step(restored, _batch(i))
+
+    for a, b in zip(jax.tree_util.tree_leaves(state),
+                    jax.tree_util.tree_leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert float(m_full["loss"]) == float(m_res["loss"])
+
+
+def test_scaler_state_survives_checkpoint(tmp_path):
+    """The loss-scale position (incl. unskipped counter) must round-trip —
+    apex serializes it via amp.state_dict (frontend.py — state_dict)."""
+    policy = amp.resolve_policy(opt_level="O2", loss_scale="dynamic")
+    params, init_fn, jit_step = _setup(policy)
+    state = init_fn(params)
+    for i in range(4):
+        state, _ = jit_step(state, _batch(i))
+    path = os.path.join(tmp_path, "c.npz")
+    save_checkpoint(path, state)
+    restored, _, _ = load_checkpoint(path, init_fn(params))
+    assert float(restored.scaler.loss_scale) == float(state.scaler.loss_scale)
+    assert int(restored.scaler.unskipped) == int(state.scaler.unskipped)
+
+
+def test_template_mismatch_rejected(tmp_path):
+    policy = amp.resolve_policy(opt_level="O0", loss_scale=1.0)
+    params, init_fn, jit_step = _setup(policy)
+    state = init_fn(params)
+    path = os.path.join(tmp_path, "c.npz")
+    save_checkpoint(path, state)
+    bad_params = {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,))}
+    with pytest.raises(ValueError, match="shape"):
+        load_checkpoint(path, init_fn(bad_params))
+    with pytest.raises(ValueError, match="leaves"):
+        load_checkpoint(path, {"just_w": jnp.ones((8, 8))})
+
+
+def test_latest_checkpoint_and_async(tmp_path):
+    ck = AsyncCheckpointer()
+    tree = {"a": jnp.arange(4.0)}
+    for step in (1, 5, 3):
+        ck.save(os.path.join(tmp_path, f"ckpt_{step}.npz"), tree, step=step)
+    ck.wait()
+    path = latest_checkpoint(str(tmp_path))
+    assert path.endswith("ckpt_5.npz")
+    restored, step, _ = load_checkpoint(path, tree)
+    assert step == 5
+    np.testing.assert_array_equal(np.asarray(restored["a"]),
+                                  np.arange(4.0))
+    assert latest_checkpoint(str(tmp_path) + "/nope") is None
