@@ -41,15 +41,17 @@ def save_checkpoint(path: str, state: Any, step: int = 0,
     ``path`` (.npz). Returns the path written."""
     flat, _ = jax.tree_util.tree_flatten(state)
     arrays = {}
+    dtypes = []
     for i, x in enumerate(flat):
         a = np.asarray(x)
+        dtypes.append(a.dtype.name)
         if a.dtype.kind == "V" or a.dtype.name in ("bfloat16",):
             # npz can't represent ml_dtypes (bfloat16 &c); fp32 holds every
-            # bf16 exactly and load_checkpoint casts back to the template
+            # bf16 exactly and load_checkpoint casts back to the recorded
             # dtype, so the round-trip is bit-faithful
             a = a.astype(np.float32)
         arrays[f"leaf_{i}"] = a
-    meta = {"step": int(step), "n_leaves": len(flat),
+    meta = {"step": int(step), "n_leaves": len(flat), "dtypes": dtypes,
             "extra": extra or {}}
     arrays[_META_KEY] = np.frombuffer(
         json.dumps(meta).encode("utf-8"), dtype=np.uint8)
@@ -74,6 +76,7 @@ def load_checkpoint(path: str, template: Any) -> Tuple[Any, int, dict]:
             raise ValueError(
                 f"checkpoint has {meta['n_leaves']} leaves, template has "
                 f"{len(flat_t)} — wrong model/optimizer configuration")
+        saved_dtypes = meta.get("dtypes")
         flat = []
         for i, t in enumerate(flat_t):
             arr = data[f"leaf_{i}"]
@@ -82,6 +85,12 @@ def load_checkpoint(path: str, template: Any) -> Tuple[Any, int, dict]:
                 raise ValueError(
                     f"leaf {i}: checkpoint shape {arr.shape} != template "
                     f"shape {t.shape}")
+            if saved_dtypes is not None and saved_dtypes[i] != t.dtype.name:
+                raise ValueError(
+                    f"leaf {i}: checkpoint dtype {saved_dtypes[i]} != "
+                    f"template dtype {t.dtype.name} — resuming into a "
+                    "different precision configuration would silently "
+                    "change numerics")
             flat.append(jax.numpy.asarray(arr.astype(t.dtype)))
     state = jax.tree_util.tree_unflatten(treedef, flat)
     return state, meta["step"], meta["extra"]
@@ -103,13 +112,41 @@ def latest_checkpoint(directory: str, prefix: str = "ckpt_") -> Optional[str]:
     return best
 
 
+def _snapshot(state):
+    """Host snapshot with guaranteed-copy semantics.
+
+    ``np.asarray`` on a CPU-backend jax array can return a zero-copy VIEW of
+    the XLA buffer; if the next (donating) step then reuses that buffer, a
+    lazily-serialized checkpoint would contain torn weights. Packing each
+    dtype group through :func:`host_flatten` (csrc memcpy path when built)
+    materializes a real copy in one GIL-released pass, and the per-leaf
+    arrays handed to the writer are zero-copy views into that snapshot.
+    """
+    from apex_tpu.utils.pytree import host_flatten
+
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    host = [np.asarray(x) for x in leaves]
+    copies: list = [None] * len(host)
+    groups: dict = {}
+    for i, a in enumerate(host):
+        groups.setdefault(a.dtype, []).append(i)
+    for dt, idxs in groups.items():
+        flat = host_flatten([host[i] for i in idxs])
+        off = 0
+        for i in idxs:
+            n = host[i].size
+            copies[i] = flat[off:off + n].reshape(host[i].shape)
+            off += n
+    return jax.tree_util.tree_unflatten(treedef, copies)
+
+
 class AsyncCheckpointer:
     """Background-thread checkpoint writer (orbax-style async save).
 
-    Device→host transfer happens on the caller's thread (cheap, and required
-    for consistency — the arrays must be snapshotted before the next step
-    mutates donated buffers); the file write happens on a worker thread so
-    the train loop never blocks on disk.
+    Device→host transfer + snapshot copy happen on the caller's thread
+    (required for consistency — the arrays must be copied before the next
+    step mutates donated buffers; see :func:`_snapshot`); the file write
+    happens on a worker thread so the train loop never blocks on disk.
     """
 
     def __init__(self):
@@ -124,7 +161,7 @@ class AsyncCheckpointer:
 
     def save(self, path: str, state: Any, step: int = 0,
              extra: Optional[dict] = None):
-        host_state = jax.tree_util.tree_map(np.asarray, state)
+        host_state = _snapshot(state)
         self.wait()
         self._thread = threading.Thread(
             target=self._write, args=(path, host_state, step, extra),

@@ -19,6 +19,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+try:  # native host-side memcpy path (csrc/flatten_unflatten.c, built by
+    # setup.py --cpp_ext); absent → numpy fallback, the reference's
+    # graceful-degradation contract for missing extensions
+    from apex_tpu import _C as _native
+except ImportError:
+    _native = None
+
 
 def flatten(tensors: Sequence[jnp.ndarray]) -> jnp.ndarray:
     """Concatenate a list of arrays into one 1-D buffer (apex_C.flatten)."""
@@ -38,6 +45,50 @@ def unflatten(flat: jnp.ndarray, like: Sequence[jnp.ndarray]) -> List[jnp.ndarra
                     .astype(jnp.asarray(t).dtype))
         offset += n
     return outs
+
+
+def host_flatten(arrays: Sequence[np.ndarray]) -> np.ndarray:
+    """Pack host (numpy) arrays into one contiguous byte-homogeneous buffer.
+
+    Native path: one allocation + GIL-released memcpys (apex_C.flatten
+    parity for host staging — checkpoint assembly, input batching).
+    Returns a 1-D array of the common dtype; mixed dtypes are an error
+    (same contract as torch flatten_dense_tensors).
+    """
+    arrays = [np.ascontiguousarray(a) for a in arrays]
+    if not arrays:
+        return np.zeros((0,), np.float32)
+    dtype = arrays[0].dtype
+    for a in arrays:
+        if a.dtype != dtype:
+            raise ValueError(
+                f"host_flatten: mixed dtypes {dtype} vs {a.dtype}")
+    if _native is not None:
+        buf = _native.flatten(arrays)
+        return np.frombuffer(buf, dtype=dtype)
+    return np.concatenate([a.ravel() for a in arrays]) \
+        if len(arrays) > 1 else arrays[0].ravel().copy()
+
+
+def host_unflatten_into(flat: np.ndarray,
+                        outs: Sequence[np.ndarray]) -> None:
+    """Scatter a flat host buffer back into writable arrays in place
+    (apex_C.unflatten parity, the direction apex DDP uses to copy allreduced
+    flat buckets back into per-param grads)."""
+    flat = np.ascontiguousarray(flat)
+    for o in outs:
+        if not (o.flags.c_contiguous and o.flags.writeable):
+            raise ValueError(
+                "host_unflatten_into outputs must be writable C-contiguous")
+    if _native is not None:
+        _native.unflatten_into(flat, list(outs))
+        return
+    fb = flat.reshape(-1).view(np.uint8)
+    offset = 0
+    for o in outs:
+        nb = o.nbytes
+        o.reshape(-1).view(np.uint8)[:] = fb[offset:offset + nb]
+        offset += nb
 
 
 def flatten_tree(tree: Any) -> Tuple[jnp.ndarray, Any]:

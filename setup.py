@@ -1,0 +1,41 @@
+"""Build orchestration — parity with the reference's setup.py (P40).
+
+The reference gates each native extension behind an install flag
+(``--cpp_ext``, ``--cuda_ext``, ``--xentropy``, ...; setup.py —
+ext_modules.append(CUDAExtension(...))). Here the device-side kernels are
+Pallas (no build step), so only the host-side C tier is gated:
+
+    pip install -v --no-build-isolation --config-settings --build-option=--cpp_ext ./
+    # or, in-tree:
+    python setup.py build_ext --inplace --cpp_ext
+
+Without ``--cpp_ext`` the package installs pure-Python and every native call
+site falls back (the reference's graceful-degradation contract for missing
+extensions).
+"""
+
+import sys
+
+from setuptools import Extension, find_packages, setup
+
+ext_modules = []
+
+if "--cpp_ext" in sys.argv:
+    sys.argv.remove("--cpp_ext")
+    ext_modules.append(
+        Extension(
+            "apex_tpu._C",
+            sources=["csrc/flatten_unflatten.c"],
+            extra_compile_args=["-O3"],
+        ))
+
+setup(
+    name="apex_tpu",
+    version="0.1.0",
+    description="TPU-native mixed-precision, fused-kernel, and parallelism "
+                "utilities (NVIDIA Apex capability surface on JAX/XLA/Pallas)",
+    packages=find_packages(include=["apex_tpu", "apex_tpu.*"]),
+    ext_modules=ext_modules,
+    python_requires=">=3.10",
+    install_requires=["jax", "flax", "optax", "numpy"],
+)

@@ -1,0 +1,97 @@
+"""Native host-side extension tests (csrc/flatten_unflatten.c).
+
+Mirror of the reference's graceful-degradation contract: every test of the
+native path skips when the extension isn't built (apex/contrib tests
+SkipTest on ImportError), and the Python fallback is tested unconditionally
+against the same assertions.
+"""
+
+import numpy as np
+import pytest
+
+from apex_tpu.utils import pytree
+
+try:
+    from apex_tpu import _C
+except ImportError:
+    _C = None
+
+needs_ext = pytest.mark.skipif(_C is None, reason="apex_tpu._C not built "
+                               "(python setup.py build_ext --inplace "
+                               "--cpp_ext)")
+
+
+def _arrays():
+    rs = np.random.RandomState(0)
+    return [rs.randn(7).astype(np.float32),
+            rs.randn(3, 5).astype(np.float32),
+            rs.randn(1).astype(np.float32)]
+
+
+@needs_ext
+def test_native_flatten_roundtrip():
+    arrays = _arrays()
+    flat = np.frombuffer(_C.flatten(arrays), np.float32)
+    ref = np.concatenate([a.ravel() for a in arrays])
+    np.testing.assert_array_equal(flat, ref)
+    outs = [np.zeros_like(a) for a in arrays]
+    _C.unflatten_into(flat, outs)
+    for o, a in zip(outs, arrays):
+        np.testing.assert_array_equal(o, a)
+
+
+@needs_ext
+def test_native_mixed_dtype_bytes():
+    # the C layer is dtype-agnostic (byte-level), like flatten_dense_tensors
+    # per dtype-group callers
+    a = np.arange(4, dtype=np.float32)
+    b = np.arange(4, dtype=np.int64)
+    flat = bytes(_C.flatten([a, b]))
+    assert flat == a.tobytes() + b.tobytes()
+
+
+@needs_ext
+def test_native_unflatten_overrun_rejected():
+    flat = np.zeros(4, np.float32)
+    with pytest.raises(ValueError, match="bytes"):
+        _C.unflatten_into(flat, [np.zeros(8, np.float32)])
+
+
+@needs_ext
+def test_native_rejects_non_buffer():
+    with pytest.raises(TypeError):
+        _C.flatten([object()])
+
+
+@pytest.mark.parametrize("force_fallback", [False, True])
+def test_host_flatten_parity(monkeypatch, force_fallback):
+    if force_fallback:
+        monkeypatch.setattr(pytree, "_native", None)
+    elif _C is None:
+        pytest.skip("ext not built")
+    arrays = _arrays()
+    flat = pytree.host_flatten(arrays)
+    np.testing.assert_array_equal(
+        flat, np.concatenate([a.ravel() for a in arrays]))
+    outs = [np.zeros_like(a) for a in arrays]
+    pytree.host_unflatten_into(flat, outs)
+    for o, a in zip(outs, arrays):
+        np.testing.assert_array_equal(o, a)
+
+
+def test_host_flatten_mixed_dtype_rejected():
+    with pytest.raises(ValueError, match="mixed"):
+        pytree.host_flatten([np.zeros(2, np.float32),
+                             np.zeros(2, np.float64)])
+
+
+def test_host_unflatten_requires_writable():
+    flat = np.arange(4, dtype=np.float32)
+    out = np.zeros(4, np.float32)
+    out.flags.writeable = False
+    with pytest.raises(ValueError, match="writable"):
+        pytree.host_unflatten_into(flat, [out])
+
+
+def test_host_flatten_empty():
+    assert pytree.host_flatten([]).size == 0
