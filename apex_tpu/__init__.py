@@ -44,6 +44,7 @@ _SUBMODULES = (
     "normalization",
     "optimizers",
     "parallel",
+    "pyprof",
     "reparameterization",
     "transformer",
     "utils",

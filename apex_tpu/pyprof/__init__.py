@@ -1,0 +1,143 @@
+"""apex_tpu.pyprof — profiling subsystem (reference: apex/pyprof, P42).
+
+The reference's pyprof has three stages: ``pyprof.nvtx.init()`` monkey-patches
+torch ops to emit NVTX ranges; ``pyprof/parse`` ingests nvprof/Nsight sqlite
+dumps; ``pyprof/prof`` turns them into per-kernel flop/byte reports.
+
+TPU-native mapping (SURVEY §6 — tracing):
+
+- NVTX ranges → :func:`annotate` (``jax.named_scope`` inside traced code, so
+  the scope lands in the XLA HLO and shows up in the profiler UI, plus a host
+  ``TraceAnnotation`` for eager sections).
+- nvprof capture → :func:`trace` around ``jax.profiler`` (perfetto dump).
+- the flop/byte report → :func:`cost_report`, straight from XLA's own cost
+  analysis of the compiled executable — no dump parsing, the compiler knows.
+- iteration timing (main_amp.py --prof N's role) → :class:`StepTimer`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import numpy as np
+
+__all__ = ["init", "annotate", "trace", "cost_report", "StepTimer"]
+
+_enabled = True
+
+
+def init(enabled: bool = True):
+    """Reference: pyprof.nvtx.init() — global enable switch.
+
+    Gates :func:`trace` and eager uses of :func:`annotate`. Inside jitted
+    code the switch is read at TRACE time and baked into the cached
+    executable — flip it before the first call of a jitted function (or
+    ``jax.clear_caches()``), the same way the reference requires init()
+    before the ops it patches are first invoked."""
+    global _enabled
+    _enabled = enabled
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """Named range visible in both the XLA profile (named_scope) and host
+    timeline (TraceAnnotation). Usable inside and outside jit."""
+    if not _enabled:
+        yield
+        return
+    with jax.named_scope(name), jax.profiler.TraceAnnotation(name):
+        yield
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture a profiler trace (perfetto) to ``log_dir`` — the nvprof
+    capture stage. View with tensorboard or ui.perfetto.dev."""
+    if not _enabled:
+        yield
+        return
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def cost_report(fn: Callable, *args, **kwargs) -> Dict[str, Any]:
+    """Per-executable flop/byte report from XLA's cost analysis.
+
+    The reference's pyprof/prof derives flops & bytes per kernel from
+    captured traces; XLA computes the same quantities at compile time, so the
+    report comes from ``jit(fn).lower(...).compile().cost_analysis()``.
+    Returns {'flops', 'bytes_accessed', 'arithmetic_intensity', 'raw'}.
+    """
+    compiled = jax.jit(fn).lower(*args, **kwargs).compile()
+    analyses = compiled.cost_analysis()
+    # cost_analysis: dict (newer jax) or list of per-device dicts (older)
+    raw = analyses if isinstance(analyses, dict) else (analyses or [{}])[0]
+    flops = float(raw.get("flops", 0.0))
+    if "bytes accessed" in raw:
+        # aggregate key already equals the sum of the per-operand
+        # 'bytes accessedN{}' breakdown keys — don't double count
+        in_bytes = float(raw["bytes accessed"])
+    else:
+        in_bytes = sum(float(v) for k, v in raw.items()
+                       if k.startswith("bytes accessed"))
+    report = {
+        "flops": flops,
+        "bytes_accessed": in_bytes,
+        "arithmetic_intensity": flops / in_bytes if in_bytes else 0.0,
+        "raw": dict(raw),
+    }
+    return report
+
+
+class StepTimer:
+    """Wall-clock iteration timing with warmup skip — the role of the
+    imagenet recipe's --prof flag plus its img/s accounting, reusable.
+
+    >>> timer = StepTimer(warmup=3)
+    >>> for batch in loader:
+    ...     with timer.step(items=batch_size):
+    ...         state, m = jit_step(state, batch)  # noqa
+    >>> print(timer.report())
+    """
+
+    def __init__(self, warmup: int = 3, sync: Optional[Callable] = None):
+        self.warmup = warmup
+        self.sync = sync
+        self._times: List[float] = []
+        self._items: List[int] = []
+        self._count = 0
+
+    @contextlib.contextmanager
+    def step(self, items: int = 1):
+        t0 = time.perf_counter()
+        yield
+        if self.sync is not None:
+            self.sync()
+        dt = time.perf_counter() - t0
+        self._count += 1
+        if self._count > self.warmup:
+            self._times.append(dt)
+            self._items.append(items)
+
+    @property
+    def times(self) -> np.ndarray:
+        return np.asarray(self._times)
+
+    def report(self) -> Dict[str, float]:
+        if not self._times:
+            return {"steps": 0}
+        t = self.times
+        items = float(np.sum(self._items))
+        return {
+            "steps": len(t),
+            "mean_s": float(t.mean()),
+            "p50_s": float(np.percentile(t, 50)),
+            "p90_s": float(np.percentile(t, 90)),
+            "items_per_s": items / float(t.sum()),
+        }
