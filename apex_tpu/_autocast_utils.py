@@ -1,0 +1,29 @@
+"""Autocast interop helpers — parity with apex/_autocast_utils.py (P43).
+
+The reference's ``_cast_if_autocast_enabled`` bridges apex's fused ops with
+native ``torch.cuda.amp.autocast``: when autocast is active, inputs are cast
+to the autocast dtype before entering a fused kernel that bypasses the
+dispatcher. The functional analogue delegates to the policy engine's own
+cast (`apex_tpu/amp/policy.py — _cast_floating`) so there is exactly one
+cast implementation.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def _cast_if_autocast_enabled(*args, policy=None, dtype=None):
+    """Cast floating array args to the active compute dtype.
+
+    ``policy`` (an :class:`apex_tpu.amp.Policy`) or an explicit ``dtype``
+    names the target; with neither, args pass through unchanged (autocast
+    "disabled"). Non-floating leaves are untouched, like the reference.
+    """
+    from apex_tpu.amp.policy import _cast_floating
+
+    if dtype is None and policy is not None:
+        dtype = policy.compute_dtype
+    if dtype is None or dtype == jnp.float32:
+        return args
+    return tuple(_cast_floating(a, dtype) for a in args)

@@ -99,10 +99,14 @@ class StepTimer:
     """Wall-clock iteration timing with warmup skip — the role of the
     imagenet recipe's --prof flag plus its img/s accounting, reusable.
 
+    jax dispatch is async: synchronize inside the timed block (or pass
+    ``sync=``) or you measure enqueue time, not execution time.
+
     >>> timer = StepTimer(warmup=3)
     >>> for batch in loader:
     ...     with timer.step(items=batch_size):
     ...         state, m = jit_step(state, batch)  # noqa
+    ...         m["loss"].block_until_ready()      # sync point
     >>> print(timer.report())
     """
 

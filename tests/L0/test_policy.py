@@ -107,3 +107,21 @@ def test_o1_op_tables():
     assert lists.compute_dtype_for("mse_loss") == jnp.float32
     assert lists.compute_dtype_for("add") is None
     assert lists.promote_dtype(jnp.float16, jnp.float32) == jnp.float32
+
+
+def test_cast_if_autocast_enabled():
+    """apex/_autocast_utils.py — _cast_if_autocast_enabled parity (P43)."""
+    import jax.numpy as jnp
+
+    from apex_tpu._autocast_utils import _cast_if_autocast_enabled
+    from apex_tpu.amp import resolve_policy
+
+    x = jnp.ones((2,), jnp.float32)
+    i = jnp.ones((2,), jnp.int32)
+    # disabled: pass-through
+    assert _cast_if_autocast_enabled(x, i) == (x, i)
+    pol = resolve_policy(opt_level="O2", loss_scale=1.0)
+    cx, ci = _cast_if_autocast_enabled(x, i, policy=pol)
+    assert cx.dtype == jnp.bfloat16 and ci.dtype == jnp.int32
+    cx, = _cast_if_autocast_enabled(x, dtype=jnp.float16)
+    assert cx.dtype == jnp.float16
