@@ -268,6 +268,10 @@ def make_train_step(loss_fn: Callable, optimizer, policy: Policy,
         grads, (loss, aux, new_model_state) = jax.grad(
             scaled_loss_fn, has_aux=True)(state.params)
         if grad_average_axis is not None:
+            # the reported loss is the global-batch mean, not one shard's
+            # local value (the reference recipe all-reduces its metrics:
+            # examples/imagenet/main_amp.py — reduce_tensor)
+            loss = jax.lax.pmean(loss, grad_average_axis)
             # apex DDP's flat-bucket allreduce-mean, as one psum over the
             # named axis; XLA's latency-hiding scheduler overlaps it with the
             # remaining backward the way apex overlaps NCCL with autograd.
