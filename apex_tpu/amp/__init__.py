@@ -192,7 +192,8 @@ def make_train_step(loss_fn: Callable, optimizer, policy: Policy,
                     is_norm_param: Optional[Callable] = None,
                     with_model_state: bool = False,
                     grad_average_axis: Optional[str] = None,
-                    gradient_predivide_factor: float = 1.0):
+                    gradient_predivide_factor: float = 1.0,
+                    grad_average_mask=None):
     """Build ``(init_fn, step_fn)`` implementing the apex iteration (§4.2 of
     the survey) as one jitted function.
 
@@ -214,6 +215,11 @@ def make_train_step(loss_fn: Callable, optimizer, policy: Policy,
     sum and by world/factor after, trading overflow headroom in half-precision
     sums. Overflow detection runs on the *reduced* grads, so any rank's inf
     skips the step on all ranks, same as NCCL allreduce propagating infs.
+
+    ``grad_average_mask``: optional pytree of bools matching the grads
+    structure. True (default) → allreduce-mean; False → the param is
+    sharded over ``grad_average_axis`` (expert-parallel weights, ZeRO
+    shards): its grad is scaled by 1/world but never psummed.
 
     Skip-on-overflow matches apex: the optimizer state does NOT advance on a
     skipped step (apex/amp/_process_optimizer.py skips ``optimizer.step``
@@ -277,15 +283,38 @@ def make_train_step(loss_fn: Callable, optimizer, policy: Policy,
             # remaining backward the way apex overlaps NCCL with autograd.
             world = jax.lax.psum(1, grad_average_axis)
             pre = gradient_predivide_factor
-            grads = jax.tree_util.tree_map(
-                lambda g: jax.lax.psum(g / pre, grad_average_axis)
-                * (pre / world), grads)
+
+            def avg(g):
+                return jax.lax.psum(g / pre, grad_average_axis) \
+                    * (pre / world)
+
+            if grad_average_mask is None:
+                grads = jax.tree_util.tree_map(avg, grads)
+            else:
+                # per-leaf reduction rule (apex analogue: per-param process
+                # groups in contrib DistributedFusedAdam). mask True →
+                # allreduce-mean (replicated params); False → the leaf is
+                # SHARDED over the axis (e.g. expert-parallel weights whose
+                # complete grad already arrived via the all_to_all
+                # transpose): scale by 1/world only, never psum — a psum
+                # would sum unrelated shards' parameters together.
+                grads = jax.tree_util.tree_map(
+                    lambda g, m: avg(g) if m else g / world,
+                    grads, grad_average_mask)
         use_masters = state.master_params is not None
         cur = state.master_params if use_masters else state.params
         # Master-weight runs unscale into fp32 master grads; without masters
         # (O0/O1/O3) grads stay in each param's own dtype so the optimizer
         # state dtypes match what optimizer.init saw (apex O3 is pure-half).
         unscaled, found_inf = unscale(grads, scaler, jnp.float32)
+        if grad_average_axis is not None and grad_average_mask is not None:
+            # masked (sharded) leaves never pass through the psum, so their
+            # infs don't propagate to other shards the way apex's NCCL
+            # allreduce propagates them — sync the flag explicitly or data
+            # shards would disagree on skip-vs-step and diverge.
+            found_inf = jax.lax.pmax(
+                jnp.asarray(found_inf, jnp.float32),
+                grad_average_axis).astype(jnp.bool_)
         if use_masters:
             master_grads = unscaled
         else:

@@ -10,13 +10,15 @@ collective-permute pipelining over the ``pipe`` axis.
 from . import context_parallel  # noqa: F401
 from . import enums  # noqa: F401
 from . import functional  # noqa: F401
+from . import moe  # noqa: F401
 from . import parallel_state  # noqa: F401
 from . import pipeline_parallel  # noqa: F401
 from . import tensor_parallel  # noqa: F401
 from .context_parallel import ring_attention, ulysses_attention  # noqa: F401
 from .enums import AttnMaskType, AttnType, LayerType, ModelType  # noqa: F401
+from .moe import MoEMLP  # noqa: F401
 
 __all__ = ["parallel_state", "tensor_parallel", "pipeline_parallel",
-           "functional", "enums", "context_parallel", "AttnMaskType",
+           "functional", "enums", "context_parallel", "moe", "AttnMaskType",
            "AttnType", "LayerType", "ModelType", "ring_attention",
-           "ulysses_attention"]
+           "ulysses_attention", "MoEMLP"]

@@ -1,0 +1,158 @@
+"""Expert parallelism: mixture-of-experts with an ``expert`` mesh axis.
+
+The reference has NO expert parallelism (SURVEY §3.3 — "EP: absent from
+apex; leave extension point in mesh design"). This module fills that
+extension point the TPU-native way — the GShard/Switch formulation whose
+dispatch/combine are einsums (MXU work, XLA-fusable) and whose only
+communication is one ``all_to_all`` pair over the ``expert`` axis (ICI).
+
+Design (top-1 switch routing, Fedus et al. 2021; GShard dispatch algebra,
+Lepikhin et al. 2020):
+
+- every shard routes its local tokens over ALL ``num_experts`` experts;
+- dispatch tensor [tokens, E, C] scatters tokens into per-expert capacity
+  slots; tokens over capacity are dropped (their combine weight is 0 and the
+  residual path carries them — standard switch behavior);
+- ``all_to_all`` sends each expert's slots to the shard that owns it, local
+  expert MLPs run on [E_local, shards*C, H], and the inverse ``all_to_all``
+  brings results home for the weighted combine.
+
+Single-shard (no mesh axis) degenerates to the same math without the
+all_to_alls, so the layer is testable on one device and parity-testable
+against its sharded self.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.comm import AXIS_EXPERT
+
+__all__ = ["MoEMLP", "top1_routing"]
+
+
+def top1_routing(router_logits, num_experts: int, capacity: int):
+    """Switch top-1 router → (dispatch [T,E,C], combine [T,E,C], aux_loss).
+
+    aux_loss is the switch load-balancing loss (mean_prob · mean_assignment
+    · E), reference formulation from the Switch paper.
+    """
+    T = router_logits.shape[0]
+    probs = jax.nn.softmax(jnp.asarray(router_logits, jnp.float32), axis=-1)
+    expert_index = jnp.argmax(probs, axis=-1)                  # [T]
+    expert_mask = jax.nn.one_hot(expert_index, num_experts)    # [T, E]
+
+    # position of each token within its expert's queue (prefix count)
+    position_in_expert = (jnp.cumsum(expert_mask, axis=0) - 1.0) * expert_mask
+    in_capacity = (position_in_expert < capacity).astype(jnp.float32) \
+        * expert_mask
+    gate = jnp.sum(probs * expert_mask, axis=-1)               # [T]
+
+    pos = jnp.sum(position_in_expert, axis=-1).astype(jnp.int32)  # [T]
+    pos_one_hot = jax.nn.one_hot(pos, capacity)                # [T, C]
+    dispatch = in_capacity[:, :, None] * pos_one_hot[:, None, :]  # [T,E,C]
+    combine = dispatch * gate[:, None, None]
+
+    # load-balancing aux loss
+    density = jnp.mean(expert_mask, axis=0)                    # [E]
+    density_proxy = jnp.mean(probs, axis=0)                    # [E]
+    aux = jnp.sum(density * density_proxy) * num_experts
+    return dispatch, combine, aux
+
+
+class MoEMLP(nn.Module):
+    """Expert-parallel MLP block.
+
+    ``num_experts`` total experts; inside ``shard_map`` over ``axis_name``
+    each shard holds ``num_experts // axis_size`` of them. Outside a mesh
+    (``axis_name=None`` or unbound) all experts are local — identical math.
+
+    ``__call__(x[T, H]) -> (y[T, H], aux_loss)``; callers add
+    ``aux_weight * aux_loss`` to their objective.
+    """
+
+    hidden: int
+    intermediate: int
+    num_experts: int
+    capacity_factor: float = 1.25
+    axis_name: Optional[str] = AXIS_EXPERT
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    def _axis_size(self) -> int:
+        if self.axis_name is None:
+            return 1
+        try:
+            return int(lax.psum(1, self.axis_name))
+        except NameError:  # axis not bound: single-shard math
+            return 1
+
+    @nn.compact
+    def __call__(self, x):
+        T, H = x.shape
+        E = self.num_experts
+        ep = self._axis_size()
+        if E % ep:
+            raise ValueError(f"num_experts={E} not divisible by expert-"
+                             f"parallel size {ep}")
+        e_local = E // ep
+        # capacity per expert per shard, padded to a multiple of 4 sublanes
+        C = max(4, int(self.capacity_factor * T / E + 0.5))
+        C = (C + 3) // 4 * 4
+
+        router = nn.Dense(E, dtype=jnp.float32,
+                          param_dtype=self.param_dtype, name="router")
+        dispatch, combine, aux = top1_routing(
+            router(jnp.asarray(x, jnp.float32)), E, C)
+        dispatch = jnp.asarray(dispatch, x.dtype)
+
+        # scatter tokens into expert slots: [E, C, H]
+        slots = jnp.einsum("tec,th->ech", dispatch, x,
+                           preferred_element_type=jnp.float32)
+        slots = jnp.asarray(slots, x.dtype)
+
+        if ep > 1:
+            # [E, C, H] → [ep, e_local, C, H] —a2a→ local experts' slots
+            # from every shard: [ep, e_local, C, H] → [e_local, ep*C, H]
+            slots = slots.reshape(ep, e_local, C, H)
+            slots = lax.all_to_all(slots, self.axis_name, split_axis=0,
+                                   concat_axis=0, tiled=False)
+            slots = jnp.moveaxis(slots, 0, 1).reshape(e_local, ep * C, H)
+        else:
+            slots = slots.reshape(e_local, C, H)
+
+        # local expert MLPs, batched over the expert dim (one big MXU GEMM)
+        w1 = self.param("w1", nn.initializers.normal(stddev=0.02),
+                        (e_local, H, self.intermediate), self.param_dtype)
+        b1 = self.param("b1", nn.initializers.zeros,
+                        (e_local, self.intermediate), self.param_dtype)
+        w2 = self.param("w2", nn.initializers.normal(stddev=0.02),
+                        (e_local, self.intermediate, H), self.param_dtype)
+        b2 = self.param("b2", nn.initializers.zeros,
+                        (e_local, H), self.param_dtype)
+        h = jnp.einsum("esh,ehi->esi", slots, jnp.asarray(w1, slots.dtype),
+                       preferred_element_type=jnp.float32)
+        h = jax.nn.gelu(h + b1[:, None, :], approximate=False)
+        h = jnp.asarray(h, slots.dtype)
+        out = jnp.einsum("esi,eih->esh", h, jnp.asarray(w2, slots.dtype),
+                         preferred_element_type=jnp.float32)
+        out = jnp.asarray(out + b2[:, None, :], x.dtype)
+
+        if ep > 1:
+            out = out.reshape(e_local, ep, C, H)
+            out = jnp.moveaxis(out, 1, 0)              # [ep, e_local, C, H]
+            out = lax.all_to_all(out, self.axis_name, split_axis=0,
+                                 concat_axis=0, tiled=False)
+            out = out.reshape(E, C, H)
+        else:
+            out = out.reshape(E, C, H)
+
+        y = jnp.einsum("tec,ech->th", jnp.asarray(combine, jnp.float32),
+                       jnp.asarray(out, jnp.float32),
+                       preferred_element_type=jnp.float32)
+        return jnp.asarray(y, x.dtype), aux

@@ -1,0 +1,255 @@
+"""Testing helpers — parity with apex/transformer/testing/ (P26), plus the
+full-parallelism dryrun model.
+
+The reference ships a standalone toy GPT/BERT and global_vars for its
+run_transformer tests. The TPU equivalent centers on
+:func:`build_full_parallel_step`: a miniature transformer training step that
+exercises EVERY parallelism axis at once —
+
+- **dp**   gradient psum over ``data`` (apex DDP semantics via
+  ``amp.make_train_step(grad_average_axis="data")``)
+- **tp**   Column/RowParallelLinear over ``model``
+- **sp**   sequence-parallel activations (gather/reduce-scatter pair)
+- **pp**   collective-permute 1F1B pipelining over ``pipe``
+- **ep**   expert-parallel MoE all_to_all over the ``data`` axis, with the
+  per-leaf grad reduction mask (expert grads are never psummed)
+
+Grad-correctness notes encoded here (the parts a naive composition gets
+wrong):
+
+- params replicated over ``model`` whose activations are model-sharded
+  (LN params, row bias, every MoE param under SP) are passed through
+  ``copy_to_tensor_model_parallel_region`` — identity forward, psum
+  backward — the Megatron rule for LN grads under sequence parallelism;
+- MoE expert weights are sharded over ``data``: their complete grads arrive
+  via the all_to_all transpose, so the DDP mask marks them False (scale by
+  1/world, no psum).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu import amp
+from apex_tpu.kernels.layer_norm import layer_norm
+from apex_tpu.transformer.moe import MoEMLP
+from apex_tpu.transformer.pipeline_parallel.schedules import (
+    make_pipeline_loss_fn)
+from apex_tpu.transformer.tensor_parallel.layers import (
+    ColumnParallelLinear, RowParallelLinear)
+from apex_tpu.transformer.tensor_parallel.mappings import (
+    copy_to_tensor_model_parallel_region)
+
+__all__ = ["build_full_parallel_step", "make_full_parallel_inputs",
+           "factor_mesh_axes"]
+
+
+def factor_mesh_axes(n: int) -> Dict[str, int]:
+    """Factor ``n`` devices into (data, pipe, model) sizes, largest first on
+    data, preferring 2s on pipe/model so every axis is exercised when room
+    allows (8 → 2/2/2, 4 → 1/2/2, 2 → 1/1/2, 1 → 1/1/1)."""
+    model = 2 if n % 2 == 0 else 1
+    rest = n // model
+    pipe = 2 if rest % 2 == 0 else 1
+    data = rest // pipe
+    return {"data": data, "pipe": pipe, "model": model}
+
+
+def _stage_params(rng, *, hidden, inner, tp, dp, n_experts, e_inner):
+    """Host-side numpy params for ONE stage, with explicit shard dims for
+    model-/data-sharded leaves (leading tp / dp dims).
+
+    Weights are drawn as GLOBAL matrices and then split, so two different
+    (tp, dp) layouts built from the same seed describe the identical model —
+    the property the cross-layout parity test asserts."""
+    rs = np.random.RandomState(rng)
+    e_local = n_experts // dp
+
+    def w(*shape, scale=0.05):
+        return (rs.randn(*shape) * scale).astype(np.float32)
+
+    col_global = w(hidden, inner)            # [H, I] → column blocks
+    row_global = w(inner, hidden)            # [I, H] → row blocks
+    moe_w1 = w(n_experts, hidden, e_inner, scale=0.02)
+    moe_w2 = w(n_experts, e_inner, hidden, scale=0.02)
+
+    return {
+        "ln1_scale": np.ones((hidden,), np.float32),
+        "ln1_bias": np.zeros((hidden,), np.float32),
+        # A = [A_1 .. A_p] column split → [tp, H, I/tp]
+        "col_kernel": np.ascontiguousarray(
+            col_global.reshape(hidden, tp, inner // tp).transpose(1, 0, 2)),
+        "col_bias": np.zeros((tp, inner // tp), np.float32),
+        # row split is contiguous over I → [tp, I/tp, H]
+        "row_kernel": row_global.reshape(tp, inner // tp, hidden).copy(),
+        "row_bias": np.zeros((hidden,), np.float32),
+        "ln2_scale": np.ones((hidden,), np.float32),
+        "ln2_bias": np.zeros((hidden,), np.float32),
+        "moe": {
+            "router": {"kernel": w(hidden, n_experts, scale=0.02),
+                       "bias": np.zeros((n_experts,), np.float32)},
+            "w1": moe_w1.reshape(dp, e_local, hidden, e_inner).copy(),
+            "b1": np.zeros((dp, e_local, e_inner), np.float32),
+            "w2": moe_w2.reshape(dp, e_local, e_inner, hidden).copy(),
+            "b2": np.zeros((dp, e_local, hidden), np.float32),
+        },
+    }
+
+
+# per-leaf: which mesh axes (beyond 'pipe') the GLOBAL array carries as
+# leading shard dims, in order. Used to build in_specs and to strip the
+# local singleton dims inside shard_map.
+_SHARD_AXES = {
+    ("col_kernel",): ("model",),
+    ("col_bias",): ("model",),
+    ("row_kernel",): ("model",),
+    ("moe", "w1"): ("data",),
+    ("moe", "b1"): ("data",),
+    ("moe", "w2"): ("data",),
+    ("moe", "b2"): ("data",),
+}
+
+# leaves replicated over 'data' get the normal DDP psum-mean; data-sharded
+# expert leaves must not (their grads arrive complete via a2a transpose)
+_DATA_SHARDED = {("moe", "w1"), ("moe", "b1"), ("moe", "w2"), ("moe", "b2")}
+
+
+def _leaf_key(path) -> Tuple[str, ...]:
+    return tuple(getattr(p, "key", str(p)) for p in path)
+
+
+def make_full_parallel_inputs(*, n_stages, tp, dp, hidden=32, inner=64,
+                              n_experts=4, e_inner=32, micro=4, batch=2,
+                              seq=8, seed=0, capacity_factor=1.25):
+    """Global (host) params + microbatch stream + in_specs for shard_map.
+
+    Returns (params, specs, mask, microbatches, targets, dims). Activation
+    layout is [S_local, B, H] (sequence first — the SP shard dim), so the
+    global microbatch array is [M, DP, TP, S_local, B, H]."""
+    from jax.sharding import PartitionSpec as P
+
+    stages = [_stage_params(seed + s, hidden=hidden, inner=inner, tp=tp,
+                            dp=dp, n_experts=n_experts, e_inner=e_inner)
+              for s in range(n_stages)]
+    params = jax.tree_util.tree_map(lambda *ls: np.stack(ls), *stages)
+
+    def spec_of(path, leaf):
+        axes = _SHARD_AXES.get(_leaf_key(path), ())
+        return P("pipe", *axes)
+
+    specs = jax.tree_util.tree_map_with_path(spec_of, params)
+    mask = jax.tree_util.tree_map_with_path(
+        lambda path, leaf: _leaf_key(path) not in _DATA_SHARDED, params)
+
+    rs = np.random.RandomState(seed + 999)
+    s_local = seq // tp
+    mb = rs.randn(micro, dp, tp, s_local, batch, hidden).astype(np.float32)
+    tg = rs.randn(micro, dp, tp, s_local, batch, hidden).astype(np.float32)
+    dims = dict(hidden=hidden, inner=inner, n_experts=n_experts,
+                e_inner=e_inner, tp=tp, dp=dp, n_stages=n_stages,
+                capacity_factor=capacity_factor)
+    return params, specs, mask, mb, tg, dims
+
+
+def _strip_local(params):
+    """Inside shard_map every sharded leading dim is a singleton: index it
+    away (pipe dim + any model/data shard dim)."""
+
+    def strip(path, leaf):
+        n = 1 + len(_SHARD_AXES.get(_leaf_key(path), ()))
+        for _ in range(n):
+            leaf = leaf[0]
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(strip, params)
+
+
+def build_full_parallel_step(dims, mask, *, opt_level="O2",
+                             n_steps: int = 2):
+    """Returns ``run(global_params, microbatches, targets) -> losses[n]`` to
+    be wrapped in ``shard_map`` over a ("data", "pipe", "model") mesh.
+
+    Inside: strips shard dims, builds the amp-O2 train step over the
+    pipelined stage function, runs ``n_steps`` steps on the same batch.
+    """
+    hidden, inner = dims["hidden"], dims["inner"]
+    tp, dp = dims["tp"], dims["dp"]
+    n_experts, e_inner = dims["n_experts"], dims["e_inner"]
+    n_stages = dims["n_stages"]
+
+    col = ColumnParallelLinear(input_size=hidden, output_size=inner,
+                               use_bias=False, sequence_parallel_enabled=True,
+                               world_size=tp)
+    row = RowParallelLinear(input_size=inner, output_size=hidden,
+                            use_bias=False, input_is_parallel=True,
+                            sequence_parallel_enabled=True, world_size=tp)
+    moe = MoEMLP(hidden=hidden, intermediate=e_inner, num_experts=n_experts,
+                 axis_name="data",
+                 capacity_factor=dims.get("capacity_factor", 1.25))
+
+    def rep(p):
+        # replicated-over-model param whose activations are model-sharded:
+        # identity fwd, psum bwd over 'model' (Megatron SP LN-grad rule)
+        return copy_to_tensor_model_parallel_region(p, "model") if tp > 1 \
+            else p
+
+    def stage_fn(p, x):
+        s_l, b, h = x.shape
+        a = x
+        h1 = layer_norm(a.reshape(-1, hidden), rep(p["ln1_scale"]),
+                        rep(p["ln1_bias"])).reshape(a.shape)
+        h1 = col.apply({"params": {"kernel": p["col_kernel"]}}, h1)
+        h1 = h1 + p["col_bias"]  # model-sharded: grads local-complete
+        h1 = jax.nn.gelu(h1, approximate=False)
+        h1 = row.apply({"params": {"kernel": p["row_kernel"]}}, h1)
+        h1 = h1 + rep(p["row_bias"])
+        a = a + h1
+        h2 = layer_norm(a.reshape(-1, hidden), rep(p["ln2_scale"]),
+                        rep(p["ln2_bias"]))
+        moe_params = jax.tree_util.tree_map(rep, p["moe"])
+        y, _aux = moe.apply({"params": moe_params}, h2)
+        # pipe-boundary activations keep the input dtype: the scan carry
+        # (and ppermute buffers) must be type-stable across stages
+        return jnp.asarray(a + y.reshape(a.shape), x.dtype)
+
+    def mb_loss(y, t):
+        # fp32 loss math (amp FP32_FUNCS)
+        l = jnp.mean((jnp.asarray(y, jnp.float32)
+                      - jnp.asarray(t, jnp.float32)) ** 2)
+        if tp > 1:
+            # under SP each model rank sees a seq chunk; collective
+            # transposes make the optimized objective Σ over ranks of the
+            # returned scalars, so return local/tp (→ objective = global
+            # mean) and add a value-only psum so the REPORTED loss is the
+            # global mean too (same trick as schedules.make_pipeline_loss_fn
+            # uses over the pipe axis).
+            l = l / tp
+            l = l + jax.lax.stop_gradient(jax.lax.psum(l, "model") - l)
+        return l
+
+    pipe_loss = make_pipeline_loss_fn(stage_fn, mb_loss,
+                                      num_stages=n_stages)
+
+    policy = amp.resolve_policy(opt_level=opt_level, loss_scale="dynamic")
+    import optax
+    # the mask tree mirrors params but holds python bools; no shard dims
+    init_fn, step_fn = amp.make_train_step(
+        pipe_loss, optax.sgd(0.05), policy,
+        grad_average_axis="data" if dp > 1 else None,
+        grad_average_mask=mask if dp > 1 else None)
+
+    def run(global_params, mb, tg):
+        p = _strip_local(global_params)
+        batch = (mb[:, 0, 0], tg[:, 0, 0])  # local mb: [M,1,1,S,B,H]
+        state = init_fn(p)
+        losses = []
+        for _ in range(n_steps):
+            state, metrics = step_fn(state, batch)
+            losses.append(metrics["loss"])
+        return jnp.stack(losses)
+
+    return run
