@@ -193,7 +193,8 @@ def make_train_step(loss_fn: Callable, optimizer, policy: Policy,
                     with_model_state: bool = False,
                     grad_average_axis: Optional[str] = None,
                     gradient_predivide_factor: float = 1.0,
-                    grad_average_mask=None):
+                    grad_average_mask=None,
+                    overflow_sync_axes=None):
     """Build ``(init_fn, step_fn)`` implementing the apex iteration (§4.2 of
     the survey) as one jitted function.
 
@@ -220,6 +221,14 @@ def make_train_step(loss_fn: Callable, optimizer, policy: Policy,
     structure. True (default) → allreduce-mean; False → the param is
     sharded over ``grad_average_axis`` (expert-parallel weights, ZeRO
     shards): its grad is scaled by 1/world but never psummed.
+
+    ``overflow_sync_axes``: mesh axes to pmax ``found_inf`` over. Whenever
+    ANY param is shard-local to an axis (pipe-stage chunks, TP kernel
+    shards, masked expert leaves), its infs don't ride a grad psum to the
+    other ranks the way apex's NCCL allreduce propagates them — name every
+    such axis here or ranks can disagree on skip-vs-step and the scaler
+    state desynchronizes. Defaults to ``(grad_average_axis,)`` when a
+    ``grad_average_mask`` is given.
 
     Skip-on-overflow matches apex: the optimizer state does NOT advance on a
     skipped step (apex/amp/_process_optimizer.py skips ``optimizer.step``
@@ -307,14 +316,19 @@ def make_train_step(loss_fn: Callable, optimizer, policy: Policy,
         # (O0/O1/O3) grads stay in each param's own dtype so the optimizer
         # state dtypes match what optimizer.init saw (apex O3 is pure-half).
         unscaled, found_inf = unscale(grads, scaler, jnp.float32)
-        if grad_average_axis is not None and grad_average_mask is not None:
-            # masked (sharded) leaves never pass through the psum, so their
-            # infs don't propagate to other shards the way apex's NCCL
-            # allreduce propagates them — sync the flag explicitly or data
-            # shards would disagree on skip-vs-step and diverge.
-            found_inf = jax.lax.pmax(
-                jnp.asarray(found_inf, jnp.float32),
-                grad_average_axis).astype(jnp.bool_)
+        sync_axes = overflow_sync_axes
+        if sync_axes is None and grad_average_axis is not None \
+                and grad_average_mask is not None:
+            sync_axes = (grad_average_axis,)
+        if sync_axes:
+            # shard-local leaves never pass through a grad psum, so their
+            # infs don't propagate to other ranks the way apex's NCCL
+            # allreduce propagates them — sync the flag explicitly or ranks
+            # would disagree on skip-vs-step and the scaler state diverges.
+            f = jnp.asarray(found_inf, jnp.float32)
+            for ax in sync_axes:
+                f = jax.lax.pmax(f, ax)
+            found_inf = f.astype(jnp.bool_)
         if use_masters:
             master_grads = unscaled
         else:

@@ -27,6 +27,7 @@ from __future__ import annotations
 from typing import Dict, Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
@@ -157,10 +158,15 @@ def broadcast_from(x, axis_name: str, src: int = 0):
     """dist.broadcast equivalent: every member gets src's value. Apex DDP
     broadcasts params from rank 0 at init (distributed.py — __init__'s
     flat_dist_call(dist.broadcast)); under SPMD initialization is already
-    replicated, so this exists for API parity and odd cases."""
-    n = jax.lax.psum(1, axis_name)
-    perm = [(src, i) for i in range(n)]
-    return jax.lax.ppermute(x, axis_name, perm)
+    replicated, so this exists for API parity and odd cases.
+
+    One-to-many can't be a single ppermute (sources must be unique); the
+    SPMD form is mask + psum, which XLA lowers to a broadcast from src.
+    """
+    x = jnp.asarray(x)
+    idx = jax.lax.axis_index(axis_name)
+    masked = jnp.where(idx == src, x, jnp.zeros_like(x))
+    return jax.lax.psum(masked, axis_name)
 
 
 def replicated_sharding(mesh: Optional[Mesh] = None) -> NamedSharding:

@@ -7,18 +7,24 @@ collectives (shard_map) or sharding constraints (pjit); PP becomes
 collective-permute pipelining over the ``pipe`` axis.
 """
 
+from . import amp  # noqa: F401
 from . import context_parallel  # noqa: F401
 from . import enums  # noqa: F401
 from . import functional  # noqa: F401
+from . import log_util  # noqa: F401
 from . import moe  # noqa: F401
 from . import parallel_state  # noqa: F401
 from . import pipeline_parallel  # noqa: F401
 from . import tensor_parallel  # noqa: F401
+from . import testing  # noqa: F401
 from .context_parallel import ring_attention, ulysses_attention  # noqa: F401
 from .enums import AttnMaskType, AttnType, LayerType, ModelType  # noqa: F401
+from .log_util import get_transformer_logger, set_logging_level  # noqa: F401
 from .moe import MoEMLP  # noqa: F401
 
-__all__ = ["parallel_state", "tensor_parallel", "pipeline_parallel",
+__all__ = ["amp", "log_util", "testing",
+           "get_transformer_logger", "set_logging_level",
+           "parallel_state", "tensor_parallel", "pipeline_parallel",
            "functional", "enums", "context_parallel", "moe", "AttnMaskType",
            "AttnType", "LayerType", "ModelType", "ring_attention",
            "ulysses_attention", "MoEMLP"]

@@ -1,0 +1,60 @@
+"""Transformer AMP bridge — parity with apex/transformer/amp/grad_scaler.py.
+
+The reference subclasses ``torch.cuda.amp.GradScaler`` to add a ``min_scale``
+floor (Megatron trains long enough that repeated overflows could otherwise
+drive the scale to zero). Here the same variant is a thin construction over
+:class:`apex_tpu.amp.scaler.LossScaler` / :func:`init_scaler`, exposing
+torch-GradScaler argument names.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from apex_tpu.amp.scaler import LossScaler, init_scaler
+
+__all__ = ["GradScaler", "grad_scaler_state"]
+
+
+class GradScaler(LossScaler):
+    """Min-scale-flooring GradScaler (reference:
+    transformer/amp/grad_scaler.py — class GradScaler(torch GradScaler)).
+
+    torch argument names: ``init_scale``, ``growth_factor``,
+    ``backoff_factor``, ``growth_interval``, plus the Megatron ``min_scale``.
+    ``growth_factor`` and ``1/backoff_factor`` must agree (the underlying
+    schedule uses one symmetric factor, apex's 2x/0.5x).
+    """
+
+    def __init__(self, init_scale=2.0 ** 16, growth_factor=2.0,
+                 backoff_factor=0.5, growth_interval=2000,
+                 min_scale=1.0, enabled=True):
+        if abs(growth_factor * backoff_factor - 1.0) > 1e-6:
+            raise ValueError(
+                "GradScaler requires backoff_factor == 1/growth_factor "
+                f"(got {growth_factor} and {backoff_factor}); the scale "
+                "schedule is symmetric like apex's 2x/0.5x")
+        super().__init__(
+            loss_scale="dynamic" if enabled else 1.0,
+            init_scale=init_scale, scale_factor=growth_factor,
+            scale_window=growth_interval, min_loss_scale=min_scale)
+
+    # torch-GradScaler names
+    def get_scale(self):
+        return self.loss_scale()
+
+    def scale(self, loss):
+        return self.scale_loss(jnp.asarray(loss))
+
+    def update(self):
+        return self.update_scale()
+
+
+def grad_scaler_state(init_scale=2.0 ** 16, growth_factor=2.0,
+                      growth_interval=2000, min_scale=1.0):
+    """Functional form: a ScalerState with the Megatron min-scale floor, for
+    use inside make_train_step-style jitted steps."""
+    return init_scaler("dynamic", init_scale=init_scale,
+                       scale_factor=growth_factor,
+                       scale_window=growth_interval,
+                       min_loss_scale=min_scale)

@@ -1,0 +1,24 @@
+"""Transformer logging utilities.
+
+Reference: apex/transformer/log_util.py — get_transformer_logger,
+set_logging_level. Same tiny surface on stdlib logging.
+"""
+
+from __future__ import annotations
+
+import logging
+
+__all__ = ["get_transformer_logger", "set_logging_level"]
+
+_ROOT = "apex_tpu.transformer"
+
+
+def get_transformer_logger(name: str = "") -> logging.Logger:
+    """Namespaced logger (reference: get_transformer_logger(__name__))."""
+    return logging.getLogger(f"{_ROOT}.{name}" if name else _ROOT)
+
+
+def set_logging_level(verbosity) -> None:
+    """Set the shared transformer logger level (reference:
+    set_logging_level; accepts ints or level names)."""
+    logging.getLogger(_ROOT).setLevel(verbosity)

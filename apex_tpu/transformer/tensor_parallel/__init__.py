@@ -1,6 +1,7 @@
 """Tensor parallelism (reference: apex/transformer/tensor_parallel/)."""
 
 from .cross_entropy import vocab_parallel_cross_entropy
+from .data import broadcast_data
 from .layers import (ColumnParallelLinear, RowParallelLinear,
                      VocabParallelEmbedding,
                      linear_with_grad_accumulation_and_async_allreduce)
@@ -20,6 +21,7 @@ from .utils import (VocabUtility, divide, ensure_divisibility,
                     split_tensor_along_last_dim)
 
 __all__ = [
+    "broadcast_data",
     "ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding",
     "linear_with_grad_accumulation_and_async_allreduce",
     "vocab_parallel_cross_entropy",

@@ -1,0 +1,27 @@
+"""Data broadcast utilities.
+
+Reference: apex/transformer/tensor_parallel/data.py — broadcast_data:
+rank 0 of each tensor-parallel group broadcasts the tokenized batch so the
+other TP ranks (which share the same data shard) don't each run the data
+pipeline.
+
+TPU design: a pytree map over the comm module's single broadcast primitive
+(``comm.broadcast_from``); keys/dtype bookkeeping from the reference
+collapses away.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.comm import AXIS_MODEL, broadcast_from
+
+__all__ = ["broadcast_data"]
+
+
+def broadcast_data(data, axis_name: str = AXIS_MODEL):
+    """Every rank returns TP-rank-0's ``data`` pytree (reference:
+    data.py — broadcast_data, minus the torch dtype/size plumbing)."""
+    return jax.tree_util.tree_map(
+        lambda x: broadcast_from(jnp.asarray(x), axis_name), data)

@@ -236,11 +236,17 @@ def build_full_parallel_step(dims, mask, *, opt_level="O2",
 
     policy = amp.resolve_policy(opt_level=opt_level, loss_scale="dynamic")
     import optax
-    # the mask tree mirrors params but holds python bools; no shard dims
+    # the mask tree mirrors params but holds python bools; no shard dims.
+    # every axis with shard-local params (pipe stages, tp kernels, data-
+    # sharded experts) must sync found_inf — see make_train_step docs.
+    sync = tuple(ax for ax, size in
+                 (("data", dp), ("pipe", n_stages), ("model", tp))
+                 if size > 1)
     init_fn, step_fn = amp.make_train_step(
         pipe_loss, optax.sgd(0.05), policy,
         grad_average_axis="data" if dp > 1 else None,
-        grad_average_mask=mask if dp > 1 else None)
+        grad_average_mask=mask if dp > 1 else None,
+        overflow_sync_axes=sync or None)
 
     def run(global_params, mb, tg):
         p = _strip_local(global_params)
