@@ -317,6 +317,8 @@ def make_train_step(loss_fn: Callable, optimizer, policy: Policy,
         # state dtypes match what optimizer.init saw (apex O3 is pure-half).
         unscaled, found_inf = unscale(grads, scaler, jnp.float32)
         sync_axes = overflow_sync_axes
+        if isinstance(sync_axes, str):
+            sync_axes = (sync_axes,)
         if sync_axes is None and grad_average_axis is not None \
                 and grad_average_mask is not None:
             sync_axes = (grad_average_axis,)
@@ -325,10 +327,9 @@ def make_train_step(loss_fn: Callable, optimizer, policy: Policy,
             # infs don't propagate to other ranks the way apex's NCCL
             # allreduce propagates them — sync the flag explicitly or ranks
             # would disagree on skip-vs-step and the scaler state diverges.
-            f = jnp.asarray(found_inf, jnp.float32)
-            for ax in sync_axes:
-                f = jax.lax.pmax(f, ax)
-            found_inf = f.astype(jnp.bool_)
+            found_inf = jax.lax.pmax(
+                jnp.asarray(found_inf, jnp.float32),
+                tuple(sync_axes)).astype(jnp.bool_)
         if use_masters:
             master_grads = unscaled
         else:

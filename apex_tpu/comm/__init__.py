@@ -166,7 +166,8 @@ def broadcast_from(x, axis_name: str, src: int = 0):
     x = jnp.asarray(x)
     idx = jax.lax.axis_index(axis_name)
     masked = jnp.where(idx == src, x, jnp.zeros_like(x))
-    return jax.lax.psum(masked, axis_name)
+    # psum promotes bool/narrow ints; the broadcast contract preserves dtype
+    return jax.lax.psum(masked, axis_name).astype(x.dtype)
 
 
 def replicated_sharding(mesh: Optional[Mesh] = None) -> NamedSharding:

@@ -57,7 +57,7 @@ class BertLayer(nn.Module):
     param_dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, x, attention_mask, *, train: bool):
+    def __call__(self, x, attention_mask, train: bool = True):
         cfg = self.config
         B, S, H = x.shape
         heads = cfg.num_attention_heads
@@ -102,6 +102,9 @@ class BertModel(nn.Module):
     config: BertConfig
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
+    # activation checkpointing per encoder layer (jax.checkpoint; the
+    # DeepLearningExamples recipe's checkpoint_activations flag)
+    remat: bool = False
     # optional externally-owned word embedding (weight tying with the MLM
     # decoder: BertForPreTraining constructs it and shares the instance)
     embed: Optional[nn.Module] = None
@@ -132,9 +135,14 @@ class BertModel(nn.Module):
         if cfg.hidden_dropout_prob > 0.0:
             x = nn.Dropout(rate=cfg.hidden_dropout_prob,
                            deterministic=not train)(x)
+        layer_cls = BertLayer
+        if self.remat:
+            layer_cls = nn.remat(BertLayer, static_argnums=(3,))
         for i in range(cfg.num_hidden_layers):
-            x = BertLayer(cfg, self.dtype, self.param_dtype,
-                          name=f"layer_{i}")(x, attention_mask, train=train)
+            layer = layer_cls(cfg, self.dtype, self.param_dtype,
+                              name=f"layer_{i}")
+            x = layer(x, attention_mask, train) if self.remat \
+                else layer(x, attention_mask, train=train)
         pooled = nn.Dense(cfg.hidden_size, dtype=self.dtype,
                           param_dtype=self.param_dtype, name="pooler")(
                               x[:, 0])

@@ -40,7 +40,7 @@ class SelfAttention(nn.Module):
     param_dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, x, *, train: bool):
+    def __call__(self, x, train: bool = True):
         B, S, H = x.shape
         d = self.hidden // self.num_heads
         qkv = nn.Dense(3 * self.hidden, dtype=self.dtype,
@@ -67,7 +67,7 @@ class TransformerBlock(nn.Module):
     param_dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, x, *, train: bool):
+    def __call__(self, x, train: bool = True):
         h = FusedLayerNorm(normalized_shape=self.hidden, dtype=self.dtype,
                            name="ln_attn")(x)
         x = x + SelfAttention(self.hidden, self.num_heads, self.dropout,
@@ -104,6 +104,11 @@ class TransformerLM(nn.Module):
     max_seq_len: int = 1024
     mlp_ratio: int = 4
     dropout: float = 0.0
+    # activation checkpointing per block (the reference gets this from
+    # apex/transformer/tensor_parallel/random.py — checkpoint; on TPU it is
+    # jax.checkpoint trading recompute for HBM, the standard long-context
+    # memory lever)
+    remat: bool = False
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
@@ -117,10 +122,14 @@ class TransformerLM(nn.Module):
         x = jnp.asarray(embed(tokens) + pos[:S][None], self.dtype)
         if self.dropout > 0.0:
             x = nn.Dropout(rate=self.dropout, deterministic=not train)(x)
+        block_cls = TransformerBlock
+        if self.remat:
+            block_cls = nn.remat(TransformerBlock, static_argnums=(2,))
         for i in range(self.num_layers):
-            x = TransformerBlock(self.hidden, self.num_heads, self.mlp_ratio,
-                                 self.dropout, self.dtype, self.param_dtype,
-                                 name=f"block_{i}")(x, train=train)
+            block = block_cls(self.hidden, self.num_heads, self.mlp_ratio,
+                              self.dropout, self.dtype, self.param_dtype,
+                              name=f"block_{i}")
+            x = block(x, train) if self.remat else block(x, train=train)
         x = FusedLayerNorm(normalized_shape=self.hidden, dtype=self.dtype,
                            name="ln_f")(x)
         # tied LM head; logits in fp32
@@ -140,7 +149,7 @@ _LM_SIZES = {
 
 def create_lm(size: str = "small", vocab_size: int = 32768,
               max_seq_len: int = 1024, dropout: float = 0.0,
-              dtype: Any = jnp.float32,
+              remat: bool = False, dtype: Any = jnp.float32,
               param_dtype: Any = jnp.float32) -> TransformerLM:
     if size not in _LM_SIZES:
         raise ValueError(f"unknown LM size {size!r}; one of {sorted(_LM_SIZES)}")
@@ -148,4 +157,4 @@ def create_lm(size: str = "small", vocab_size: int = 32768,
     return TransformerLM(vocab_size=vocab_size, hidden=hidden,
                          num_layers=layers, num_heads=heads,
                          max_seq_len=max_seq_len, dropout=dropout,
-                         dtype=dtype, param_dtype=param_dtype)
+                         remat=remat, dtype=dtype, param_dtype=param_dtype)

@@ -62,8 +62,8 @@ class Discriminator(nn.Module):
 
 def bce_logits(logits, target):
     logits = jnp.asarray(logits, jnp.float32)
-    return jnp.mean(jnp.maximum(logits, 0) - logits * target
-                    + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+    return optax.sigmoid_binary_cross_entropy(
+        logits, jnp.full_like(logits, target)).mean()
 
 
 def main(argv=None):
@@ -118,13 +118,10 @@ def main(argv=None):
         real = jax.random.uniform(kx, (args.batch_size, 32, 32, 3),
                                   minval=-1.0, maxval=1.0)
         z = jax.random.normal(kz, (args.batch_size, args.nz))
-        gparams = stateG.master_params if stateG.master_params is not None \
-            else stateG.params
-        fake = jit_gen(policy.cast_params(gparams), z)
+        fake = jit_gen(policy.cast_params(amp.master_params(stateG)), z)
         stateD, mD = jitD(stateD, (real, jax.lax.stop_gradient(fake)))
-        dparams = stateD.master_params if stateD.master_params is not None \
-            else stateD.params
-        stateG, mG = jitG(stateG, (z, policy.cast_params(dparams)))
+        stateG, mG = jitG(
+            stateG, (z, policy.cast_params(amp.master_params(stateD))))
         if it == 2:
             mG["loss"].block_until_ready()
             t0 = time.perf_counter()

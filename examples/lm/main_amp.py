@@ -55,6 +55,8 @@ def parse_args(argv=None):
                    help="label smoothing (fused xentropy kernel)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--deterministic", action="store_true")
+    p.add_argument("--remat", action="store_true",
+                   help="activation checkpointing per block (memory lever)")
     return p.parse_args(argv)
 
 
@@ -72,7 +74,7 @@ def main(argv=None):
     print(policy.banner())
 
     model = create_lm(args.size, vocab_size=args.vocab_size,
-                      max_seq_len=args.seq_len,
+                      max_seq_len=args.seq_len, remat=args.remat,
                       dtype=policy.compute_dtype)
     rng = jax.random.PRNGKey(args.seed)
     sample = jnp.zeros((2, args.seq_len), jnp.int32)

@@ -171,3 +171,45 @@ def test_bert_model_standalone():
     seq, pooled = m.apply({"params": params}, ids, train=False)
     assert seq.shape == (2, 8, cfg.hidden_size)
     assert pooled.shape == (2, cfg.hidden_size)
+
+
+def test_lm_remat_matches_plain():
+    """remat=True is a memory/recompute trade, not a numerics change: fwd
+    and grads must match the plain model exactly (SURVEY §6 — activation
+    checkpointing maps to jax.checkpoint)."""
+    toks = jax.random.randint(jax.random.PRNGKey(7), (2, 12), 0, VOCAB)
+    plain = _tiny_lm()
+    remat = _tiny_lm(remat=True)
+    params = plain.init(jax.random.PRNGKey(0), toks, train=False)["params"]
+    out_p = plain.apply({"params": params}, toks, train=False)
+    out_r = remat.apply({"params": params}, toks, train=False)
+    np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_r),
+                               rtol=1e-6, atol=1e-6)
+
+    def loss(m):
+        def f(p):
+            lg = m.apply({"params": p}, toks, train=True)
+            return jnp.sum(lg ** 2) * 1e-4
+        return f
+
+    g_p = jax.grad(loss(plain))(params)
+    g_r = jax.grad(loss(remat))(params)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6), g_p, g_r)
+
+
+def test_bert_remat_matches_plain():
+    cfg = create_bert("tiny", vocab_size=53, max_position_embeddings=16,
+                      hidden_dropout_prob=0.0,
+                      attention_probs_dropout_prob=0.0)
+    ids = jax.random.randint(jax.random.PRNGKey(0), (2, 8), 0, 53)
+    plain = BertModel(cfg)
+    remat = BertModel(cfg, remat=True)
+    params = plain.init(jax.random.PRNGKey(1), ids, train=False)["params"]
+    s_p, p_p = plain.apply({"params": params}, ids, train=False)
+    s_r, p_r = remat.apply({"params": params}, ids, train=False)
+    np.testing.assert_allclose(np.asarray(s_p), np.asarray(s_r),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(p_p), np.asarray(p_r),
+                               rtol=1e-6, atol=1e-6)
