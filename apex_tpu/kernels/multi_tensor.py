@@ -29,7 +29,11 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _LANES = 128
-_BLOCK_ROWS = 2048  # 2048*128*4B = 1MB per buffer in VMEM
+# 1024*128*4B = 0.5MB per buffer in VMEM; the adam kernel touches 7 blocked
+# buffers (+pipelining double-buffers + fp32 temporaries), and Mosaic's
+# scoped-vmem stack is 16MB — 2048-row blocks overflowed it by ~2MB at LM
+# scale, 1024 leaves headroom
+_BLOCK_ROWS = 1024
 
 
 def _as_rows(flat):

@@ -85,14 +85,20 @@ def _xent(logits, labels, smoothing, interpret):
     return loss
 
 
-def _block_rows(n):
-    b = 128 if n % 128 == 0 else 8
-    return b
+def _block_rows(n, v):
+    # the kernel holds the fp32 logits block plus ~3 same-size temporaries
+    # (exp, iota/onehot, output) in VMEM; keep br*v*4*4 within a ~4MB
+    # budget of the ~16MB scoped vmem or Mosaic OOMs at LM vocab sizes
+    budget_rows = max(8, (4 * 1024 * 1024) // (16 * max(v, 1)))
+    br = 128 if n % 128 == 0 else 8
+    while br > 8 and br > budget_rows:
+        br //= 2  # 128 | n ⇒ every halving still divides n
+    return br
 
 
 def _xent_fwd(logits, labels, smoothing, interpret):
     n, v = logits.shape
-    br = _block_rows(n)
+    br = _block_rows(n, v)
     kernel = functools.partial(_fwd_kernel, smoothing=smoothing)
     loss, mlse = pl.pallas_call(
         kernel,
@@ -117,7 +123,7 @@ def _xent_fwd(logits, labels, smoothing, interpret):
 def _xent_bwd(smoothing, interpret, res, g):
     logits, labels, mlse = res
     n, v = logits.shape
-    br = _block_rows(n)
+    br = _block_rows(n, v)
     kernel = functools.partial(_bwd_kernel, smoothing=smoothing)
     dlogits = pl.pallas_call(
         kernel,

@@ -57,7 +57,7 @@ class BertLayer(nn.Module):
     param_dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, x, attention_mask, train: bool = True):
+    def __call__(self, x, attention_mask, train: bool):
         cfg = self.config
         B, S, H = x.shape
         heads = cfg.num_attention_heads
@@ -139,10 +139,8 @@ class BertModel(nn.Module):
         if self.remat:
             layer_cls = nn.remat(BertLayer, static_argnums=(3,))
         for i in range(cfg.num_hidden_layers):
-            layer = layer_cls(cfg, self.dtype, self.param_dtype,
-                              name=f"layer_{i}")
-            x = layer(x, attention_mask, train) if self.remat \
-                else layer(x, attention_mask, train=train)
+            x = layer_cls(cfg, self.dtype, self.param_dtype,
+                          name=f"layer_{i}")(x, attention_mask, train)
         pooled = nn.Dense(cfg.hidden_size, dtype=self.dtype,
                           param_dtype=self.param_dtype, name="pooler")(
                               x[:, 0])

@@ -40,7 +40,7 @@ class SelfAttention(nn.Module):
     param_dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, x, train: bool = True):
+    def __call__(self, x, train: bool):
         B, S, H = x.shape
         d = self.hidden // self.num_heads
         qkv = nn.Dense(3 * self.hidden, dtype=self.dtype,
@@ -67,7 +67,7 @@ class TransformerBlock(nn.Module):
     param_dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, x, train: bool = True):
+    def __call__(self, x, train: bool):
         h = FusedLayerNorm(normalized_shape=self.hidden, dtype=self.dtype,
                            name="ln_attn")(x)
         x = x + SelfAttention(self.hidden, self.num_heads, self.dropout,
@@ -126,10 +126,9 @@ class TransformerLM(nn.Module):
         if self.remat:
             block_cls = nn.remat(TransformerBlock, static_argnums=(2,))
         for i in range(self.num_layers):
-            block = block_cls(self.hidden, self.num_heads, self.mlp_ratio,
-                              self.dropout, self.dtype, self.param_dtype,
-                              name=f"block_{i}")
-            x = block(x, train) if self.remat else block(x, train=train)
+            x = block_cls(self.hidden, self.num_heads, self.mlp_ratio,
+                          self.dropout, self.dtype, self.param_dtype,
+                          name=f"block_{i}")(x, train)
         x = FusedLayerNorm(normalized_shape=self.hidden, dtype=self.dtype,
                            name="ln_f")(x)
         # tied LM head; logits in fp32
