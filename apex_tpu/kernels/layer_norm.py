@@ -26,15 +26,15 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+from apex_tpu.kernels import vmem
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
 def _block_rows(n_rows: int, hidden: int, n_bufs: int) -> int:
-    # ~4MB of VMEM across the buffers the kernel holds at once, multiple of 8.
-    budget = (4 * 1024 * 1024) // max(1, 4 * hidden * n_bufs)
-    b = max(8, min(512, budget))
-    b = (b // 8) * 8
+    # shared scoped-VMEM budget heuristic (kernels/vmem.py)
+    b = vmem.block_rows(n_rows, row_bytes=4 * hidden, n_bufs=n_bufs)
     return min(b, max(8, ((n_rows + 7) // 8) * 8))
 
 

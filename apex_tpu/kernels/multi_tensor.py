@@ -28,12 +28,14 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from apex_tpu.kernels import vmem as _vmem
+
 _LANES = 128
-# 1024*128*4B = 0.5MB per buffer in VMEM; the adam kernel touches 7 blocked
-# buffers (+pipelining double-buffers + fp32 temporaries), and Mosaic's
-# scoped-vmem stack is 16MB — 2048-row blocks overflowed it by ~2MB at LM
-# scale, 1024 leaves headroom
-_BLOCK_ROWS = 1024
+# the adam kernel touches 7 blocked buffers (+pipelining double-buffers and
+# fp32 temporaries); the shared scoped-VMEM heuristic (kernels/vmem.py) gives
+# 1024 rows of 128 lanes — 2048 overflowed Mosaic's 16MB stack at LM scale
+_BLOCK_ROWS = _vmem.block_rows(1 << 30, row_bytes=4 * _LANES, n_bufs=8,
+                               max_rows=2048)
 
 
 def _as_rows(flat):

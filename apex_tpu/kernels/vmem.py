@@ -1,0 +1,31 @@
+"""Shared scoped-VMEM row-blocking heuristic for the Pallas kernel tier.
+
+Mosaic's scoped-VMEM stack on this generation is 16MB; a kernel's working
+set is roughly (rows_per_block × row_bytes × live_buffers), and pipelining
+double-buffers it. Every row-blocked kernel (layer_norm, xentropy,
+multi_tensor) sizes its block from the same ~4MB budget via this helper so
+a future limit change lands in one place.
+"""
+
+from __future__ import annotations
+
+VMEM_BUDGET_BYTES = 4 * 1024 * 1024
+
+
+def block_rows(n_rows: int, row_bytes: int, n_bufs: int,
+               max_rows: int = 512, divisor_of: int = 0) -> int:
+    """Rows per block such that ``rows*row_bytes*n_bufs`` ≲ the VMEM budget.
+
+    Result is a multiple of 8 (sublane tile), ≥ 8, ≤ ``max_rows``. With
+    ``divisor_of`` set, the result is halved from its power-of-two start
+    until it divides that total (used by kernels whose grid must tile
+    exactly).
+    """
+    budget = VMEM_BUDGET_BYTES // max(1, row_bytes * n_bufs)
+    b = max(8, min(max_rows, budget))
+    b = (b // 8) * 8
+    if divisor_of:
+        while b > 8 and divisor_of % b:
+            b //= 2
+        b = max(8, (b // 8) * 8)
+    return b

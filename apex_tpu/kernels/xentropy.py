@@ -23,6 +23,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from apex_tpu.kernels import vmem
+
 __all__ = ["softmax_cross_entropy_loss", "xent_reference"]
 
 
@@ -86,14 +88,10 @@ def _xent(logits, labels, smoothing, interpret):
 
 
 def _block_rows(n, v):
-    # the kernel holds the fp32 logits block plus ~3 same-size temporaries
-    # (exp, iota/onehot, output) in VMEM; keep br*v*4*4 within a ~4MB
-    # budget of the ~16MB scoped vmem or Mosaic OOMs at LM vocab sizes
-    budget_rows = max(8, (4 * 1024 * 1024) // (16 * max(v, 1)))
-    br = 128 if n % 128 == 0 else 8
-    while br > 8 and br > budget_rows:
-        br //= 2  # 128 | n ⇒ every halving still divides n
-    return br
+    # fp32 logits block + ~3 same-size temporaries (exp, iota/onehot,
+    # output); shared scoped-VMEM budget lives in kernels/vmem.py
+    return vmem.block_rows(n, row_bytes=4 * v, n_bufs=4, max_rows=128,
+                           divisor_of=n)
 
 
 def _xent_fwd(logits, labels, smoothing, interpret):

@@ -284,3 +284,30 @@ def test_self_attn_prob_dropout_path():
     drop2 = m.apply(v, x, is_training=True,
                     rngs={"dropout": jax.random.PRNGKey(2)})
     np.testing.assert_allclose(np.asarray(drop), np.asarray(drop2))
+
+
+def test_groupbn_nhwc_add_relu():
+    """contrib.groupbn BatchNorm2d_NHWC (reference: bnp batch_norm_add_relu):
+    BN vs flax reference, fused residual add + ReLU, and the bn_group guard."""
+    import flax.linen as fnn
+
+    from apex_tpu.contrib.groupbn import BatchNorm2d_NHWC
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 8, 8, 16))
+    z = jax.random.normal(jax.random.PRNGKey(1), (4, 8, 8, 16))
+    m = BatchNorm2d_NHWC(num_features=16, fuse_relu=True)
+    variables = m.init(jax.random.PRNGKey(2), x, z,
+                       use_running_average=False)
+    y, _ = m.apply(variables, x, z, use_running_average=False,
+                   mutable=["batch_stats"])
+
+    ref_bn = fnn.BatchNorm(use_running_average=False, momentum=0.9,
+                           epsilon=1e-5)
+    rv = ref_bn.init(jax.random.PRNGKey(2), x)
+    ref, _ = ref_bn.apply(rv, x, mutable=["batch_stats"])
+    expect = np.maximum(np.asarray(ref) + np.asarray(z), 0)
+    np.testing.assert_allclose(np.asarray(y), expect, rtol=1e-5, atol=1e-5)
+
+    with pytest.raises(ValueError, match="bn_group"):
+        BatchNorm2d_NHWC(num_features=16, bn_group=2).init(
+            jax.random.PRNGKey(0), x)
