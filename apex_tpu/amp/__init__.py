@@ -91,20 +91,26 @@ def initialize(model, optimizers=None, opt_level="O1", enabled=True,
         for _ in range(num_losses)
     ]
 
-    if isinstance(model, tuple) and len(model) == 2:
-        apply_fn, params = model
-    else:
-        apply_fn, params = model, None
+    def bundle_one(m):
+        if isinstance(m, tuple) and len(m) == 2:
+            apply_fn, params = m
+        else:
+            apply_fn, params = m, None
+        if params is not None:
+            params = policy.cast_params(params)
 
-    if params is not None:
-        params = policy.cast_params(params)
+        def policy_apply(p, *args, _apply=apply_fn, **kwargs):
+            args = policy.cast_to_compute(args)
+            return _apply(p, *args, **kwargs)
 
-    def policy_apply(p, *args, **kwargs):
-        args = policy.cast_to_compute(args)
-        return apply_fn(p, *args, **kwargs)
+        return _InitializedModel(
+            policy_apply if apply_fn is not None else None, params, policy)
 
-    bundle = _InitializedModel(policy_apply if apply_fn is not None else None,
-                               params, policy)
+    # apex accepts a single model/optimizer or lists of either and returns
+    # the same shape (frontend.py — initialize handles both)
+    models_in_list = isinstance(model, list)
+    bundle = [bundle_one(m) for m in model] if models_in_list \
+        else bundle_one(model)
     if optimizers is None:
         return bundle
     return bundle, optimizers

@@ -16,14 +16,20 @@ def block_rows(n_rows: int, row_bytes: int, n_bufs: int,
                max_rows: int = 512, divisor_of: int = 0) -> int:
     """Rows per block such that ``rows*row_bytes*n_bufs`` ≲ the VMEM budget.
 
-    Result is a multiple of 8 (sublane tile), ≥ 8, ≤ ``max_rows``. With
-    ``divisor_of`` set, the result is halved from its power-of-two start
-    until it divides that total (used by kernels whose grid must tile
-    exactly).
+    Result is a multiple of 8 (sublane tile), ≥ 8, ≤ ``max_rows``, and never
+    exceeds ``n_rows`` rounded up to the sublane tile. With ``divisor_of``
+    set, the result is halved until it divides that total (kernels whose
+    grid must tile exactly); ``divisor_of`` must itself be a multiple of 8
+    or no multiple-of-8 block can divide it.
     """
+    if divisor_of and divisor_of % 8:
+        raise ValueError(
+            f"divisor_of={divisor_of} must be a multiple of 8: no sublane-"
+            "tiled block can divide it")
     budget = VMEM_BUDGET_BYTES // max(1, row_bytes * n_bufs)
     b = max(8, min(max_rows, budget))
     b = (b // 8) * 8
+    b = min(b, max(8, ((n_rows + 7) // 8) * 8))
     if divisor_of:
         while b > 8 and divisor_of % b:
             b //= 2

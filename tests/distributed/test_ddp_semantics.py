@@ -1,0 +1,189 @@
+"""Distributed-tier tests (reference: tests/distributed/).
+
+- amp_master_params/: after a DDP step, fp32 masters and half model params
+  must be consistent with each other and IDENTICAL across ranks.
+- DDP/ddp_race_condition_test.py: hook/stream ordering races. Those races
+  cannot exist under XLA's dataflow semantics (SURVEY §6) — the analogue
+  asserted here is order-insensitivity: reversing bucket submission order
+  changes nothing, and repeated runs are bit-identical.
+- synced_batchnorm/test_groups.py: SyncBN over process subgroups.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu import amp
+from apex_tpu.optimizers import fused_sgd
+
+
+@pytest.fixture()
+def data_mesh(eight_devices):
+    return Mesh(np.array(eight_devices), ("data",))
+
+
+def _loss_fn(p, batch):
+    x, y = batch
+    pred = x @ jnp.asarray(p["w"], x.dtype) + jnp.asarray(p["b"], x.dtype)
+    return jnp.mean((jnp.asarray(pred, jnp.float32) - y) ** 2)
+
+
+def _step_setup(opt_level="O2"):
+    policy = amp.resolve_policy(opt_level=opt_level, loss_scale="dynamic")
+    params = {"w": jnp.ones((16, 8)) * 0.1, "b": jnp.zeros((8,))}
+    init_fn, step_fn = amp.make_train_step(
+        _loss_fn, fused_sgd(0.1, momentum=0.9), policy,
+        grad_average_axis="data")
+    return params, init_fn, step_fn
+
+
+def _batches(n=8):
+    k = jax.random.PRNGKey(0)
+    x = jax.random.normal(k, (n * 4, 16))
+    y = jax.random.normal(jax.random.fold_in(k, 1), (n * 4, 8))
+    return x, y
+
+
+def test_amp_master_params_consistent_across_ranks(data_mesh):
+    """Reference: tests/distributed/amp_master_params — after a DDP step,
+    per-rank master fp32 and model half params agree across all ranks, and
+    model = masters cast to half."""
+    params, init_fn, step_fn = _step_setup()
+
+    @functools.partial(shard_map, mesh=data_mesh,
+                       in_specs=(P(), (P("data"), P("data"))),
+                       out_specs=(P("data"), P("data")), check_vma=False)
+    def run(state, batch):
+        new_state, _ = step_fn(state, batch)
+        # expose every rank's params for cross-rank comparison
+        return (jax.tree_util.tree_map(lambda l: l[None], new_state.params),
+                jax.tree_util.tree_map(lambda l: l[None],
+                                       new_state.master_params))
+
+    state = init_fn(params)
+    model_all, master_all = jax.jit(run)(state, _batches())
+    for leaf_model, leaf_master in zip(
+            jax.tree_util.tree_leaves(model_all),
+            jax.tree_util.tree_leaves(master_all)):
+        lm, lM = np.asarray(leaf_model), np.asarray(leaf_master)
+        for r in range(1, 8):
+            np.testing.assert_array_equal(lm[r], lm[0])   # identical ranks
+            np.testing.assert_array_equal(lM[r], lM[0])
+        # model params are the masters cast to the model dtype
+        np.testing.assert_array_equal(
+            lm[0], lM[0].astype(lm.dtype))
+
+
+def test_grad_reduction_is_order_insensitive_and_deterministic(data_mesh):
+    """The DDP-race analogue: apex's test hammers overlapping allreduce
+    ordering; under XLA the reduction is part of one program, so (a) two
+    identical runs are bit-identical and (b) parameter-tree ordering doesn't
+    change the math."""
+    params, init_fn, step_fn = _step_setup("O0")
+
+    @functools.partial(shard_map, mesh=data_mesh,
+                       in_specs=(P(), (P("data"), P("data"))),
+                       out_specs=P(), check_vma=False)
+    def run(state, batch):
+        new_state, _ = step_fn(state, batch)
+        return new_state.params
+
+    state = init_fn(params)
+    out1 = jax.jit(run)(state, _batches())
+    out2 = jax.jit(run)(state, _batches())
+    for a, b in zip(jax.tree_util.tree_leaves(out1),
+                    jax.tree_util.tree_leaves(out2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # reversed-order tree (reversed dict insertion): same values per leaf
+    params_rev = dict(reversed(list(params.items())))
+    state_rev = init_fn(params_rev)
+    out3 = jax.jit(run)(state_rev, _batches())
+    np.testing.assert_array_equal(np.asarray(out1["w"]),
+                                  np.asarray(out3["w"]))
+    np.testing.assert_array_equal(np.asarray(out1["b"]),
+                                  np.asarray(out3["b"]))
+
+
+def test_overflow_skips_step_on_all_ranks(data_mesh):
+    """One rank's inf grad must freeze params AND optimizer state on every
+    rank (NCCL-inf-propagation semantics; make_train_step docstring)."""
+    policy = amp.resolve_policy(opt_level="O2", loss_scale="dynamic",
+                                cast_model_type="float16")
+    params = {"w": jnp.ones((4, 4))}
+
+    def loss_fn(p, batch):
+        x, poison = batch
+        # poison is huge on exactly one rank → fp16 overflow there only
+        return jnp.mean((x @ jnp.asarray(p["w"], x.dtype)) ** 2) * poison[0]
+
+    init_fn, step_fn = amp.make_train_step(loss_fn, fused_sgd(0.1), policy,
+                                           grad_average_axis="data")
+
+    @functools.partial(shard_map, mesh=data_mesh,
+                       in_specs=(P(), (P("data"), P("data"))),
+                       out_specs=(P("data"), P("data")), check_vma=False)
+    def run(state, batch):
+        new_state, metrics = step_fn(state, batch)
+        return (jax.tree_util.tree_map(lambda l: l[None], new_state.params),
+                metrics["found_inf"][None])
+
+    state = init_fn(params)
+    x = jnp.ones((8 * 2, 4))
+    poison = jnp.ones((8,)).at[3].set(1e30)  # rank 3 overflows
+    out, found = jax.jit(run)(state, (x, poison))
+    found = np.asarray(found)
+    assert found.all(), f"found_inf must be synced to all ranks: {found}"
+    w = np.asarray(out["w"])
+    for r in range(8):
+        np.testing.assert_array_equal(w[r], np.ones((4, 4), w.dtype))
+
+
+def test_syncbn_groups(data_mesh):
+    """Reference: synced_batchnorm/test_groups.py — stats sync within
+    subgroups only."""
+    from apex_tpu.parallel import SyncBatchNorm, create_syncbn_process_group
+
+    groups = create_syncbn_process_group(8, 4)  # two groups of 4
+    bn = SyncBatchNorm(use_running_average=False, axis_name="data",
+                       axis_index_groups=groups)
+
+    @functools.partial(shard_map, mesh=data_mesh,
+                       in_specs=P("data"), out_specs=P("data"),
+                       check_vma=False)
+    def run(x):
+        variables = bn.init(jax.random.PRNGKey(0), x[0])
+        y, _ = bn.apply(variables, x[0], mutable=["batch_stats"])
+        return y[None]
+
+    # group A (ranks 0-3) sees mean 0, group B (4-7) mean 10: outputs must
+    # normalize within group, so both groups give ~zero-mean results even
+    # though the global mean is 5
+    x = jnp.concatenate([jnp.zeros((4, 1, 16, 4)),
+                         jnp.full((4, 1, 16, 4), 10.0)]) \
+        + jax.random.normal(jax.random.PRNGKey(1), (8, 1, 16, 4)) * 0.1
+    y = np.asarray(jax.jit(run)(x))
+    # per-GROUP means are ~0 (stats synced within the subgroup)...
+    assert abs(y[:4].mean()) < 0.05, y[:4].mean()
+    assert abs(y[4:].mean()) < 0.05, y[4:].mean()
+
+    # ...whereas a globally-synced BN normalizes around the global mean 5,
+    # pushing the two groups to opposite signs — proving the groups did
+    # something
+    bn_global = SyncBatchNorm(use_running_average=False, axis_name="data")
+
+    @functools.partial(shard_map, mesh=data_mesh,
+                       in_specs=P("data"), out_specs=P("data"),
+                       check_vma=False)
+    def run_global(x):
+        variables = bn_global.init(jax.random.PRNGKey(0), x[0])
+        y, _ = bn_global.apply(variables, x[0], mutable=["batch_stats"])
+        return y[None]
+
+    yg = np.asarray(jax.jit(run_global)(x))
+    assert yg[:4].mean() < -0.5 and yg[4:].mean() > 0.5
