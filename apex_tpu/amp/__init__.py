@@ -99,9 +99,9 @@ def initialize(model, optimizers=None, opt_level="O1", enabled=True,
         if params is not None:
             params = policy.cast_params(params)
 
-        def policy_apply(p, *args, _apply=apply_fn, **kwargs):
+        def policy_apply(p, *args, **kwargs):
             args = policy.cast_to_compute(args)
-            return _apply(p, *args, **kwargs)
+            return apply_fn(p, *args, **kwargs)
 
         return _InitializedModel(
             policy_apply if apply_fn is not None else None, params, policy)

@@ -1,0 +1,93 @@
+"""Context-parallel TRAINING test: ring attention inside an amp-O2 train
+step over the ``context`` axis — the long-context story end-to-end, not just
+the attention op.
+
+Grad correctness note (why grad_average_axis="context" is right): params are
+replicated per shard; shard r's local backward already accumulates the
+k/v-path contributions of every shard (they flow back through the ring's
+ppermute transposes), while q-path terms live only on their own shard —
+each path term exists on exactly one shard's copy, so the psum-mean over
+the axis reconstructs d(mean-over-shards loss)/dθ with no double counting.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu import amp
+from apex_tpu.optimizers import fused_adam
+from apex_tpu.transformer import ring_attention
+
+B, H_HEADS, S_LOCAL, D, HID = 2, 4, 16, 8, 32
+
+
+def _attn_model(p, x, axis_name):
+    """One pre-LN-ish attention block over seq-sharded activations."""
+    qkv = x @ p["w_qkv"]                                # [B, S_l, 3*HID]
+    qkv = qkv.reshape(B, S_LOCAL, 3, H_HEADS, D)
+    q, k, v = (jnp.moveaxis(qkv[:, :, i], 1, 2) for i in range(3))
+    o = ring_attention(q, k, v, axis_name=axis_name, causal=True)
+    o = jnp.moveaxis(o, 1, 2).reshape(B, S_LOCAL, HID)
+    return x + o @ p["w_out"]
+
+
+def test_ring_attention_train_step_decreases_loss(eight_devices):
+    mesh = Mesh(np.array(eight_devices), ("context",))
+    rs = np.random.RandomState(0)
+    params = {
+        "w_qkv": jnp.asarray(rs.randn(HID, 3 * HID).astype(np.float32) * 0.1),
+        "w_out": jnp.asarray(rs.randn(HID, HID).astype(np.float32) * 0.1),
+    }
+    policy = amp.resolve_policy(opt_level="O2", loss_scale="dynamic")
+
+    def loss_fn(p, batch):
+        x, t = batch
+        y = _attn_model(p, jnp.asarray(x, policy.compute_dtype), "context")
+        return jnp.mean((jnp.asarray(y, jnp.float32) - t) ** 2)
+
+    init_fn, step_fn = amp.make_train_step(loss_fn, fused_adam(3e-3), policy,
+                                           grad_average_axis="context")
+
+    @functools.partial(shard_map, mesh=mesh,
+                       in_specs=(P(), (P(None, "context"),
+                                       P(None, "context"))),
+                       out_specs=(P(), P()), check_vma=False)
+    def run(state, batch):
+        for _ in range(6):
+            state, metrics = step_fn(state, batch)
+        first = metrics  # last step's metrics
+        return state.master_params, first["loss"]
+
+    # global sequence 8*S_LOCAL = 128 tokens, sharded contiguously
+    x = rs.randn(B, 8 * S_LOCAL, HID).astype(np.float32)
+    t = np.tanh(x[:, ::-1].copy())  # nontrivial target
+    state = init_fn(params)
+    masters, final_loss = jax.jit(run)(state, (jnp.asarray(x),
+                                              jnp.asarray(t)))
+
+    # baseline: untouched params' loss on the same batch (single-shard ref)
+    from apex_tpu.kernels.flash_attention import mha_reference
+
+    def ref_loss(p):
+        qkv = (x @ np.asarray(p["w_qkv"])).reshape(B, 8 * S_LOCAL, 3,
+                                                   H_HEADS, D)
+        q, k, v = (np.moveaxis(qkv[:, :, i], 1, 2) for i in range(3))
+        o = np.asarray(mha_reference(jnp.asarray(q), jnp.asarray(k),
+                                     jnp.asarray(v), causal=True,
+                                     scale=D ** -0.5))
+        y = x + np.moveaxis(o, 1, 2).reshape(B, 8 * S_LOCAL, HID) \
+            @ np.asarray(p["w_out"])
+        return float(np.mean((y - t) ** 2))
+
+    assert np.isfinite(float(final_loss))
+    assert float(final_loss) < ref_loss(params), (
+        float(final_loss), ref_loss(params))
+    # trained masters evaluated on the FULL (unsharded) reference model also
+    # improve — proving the sharded training optimized the real objective
+    assert ref_loss(jax.tree_util.tree_map(np.asarray, masters)) \
+        < ref_loss(params)
