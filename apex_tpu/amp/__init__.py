@@ -39,6 +39,9 @@ __all__ = [
     "initialize", "scale_loss", "master_params", "state_dict",
     "load_state_dict", "init_scaler", "unscale", "unscale_with_stashed",
     "update_scale", "make_train_step", "AmpState",
+    "half_function", "float_function", "promote_function",
+    "register_half_function", "register_float_function",
+    "register_promote_function",
 ]
 
 # Global registry mirroring apex/amp/_amp_state.py — class AmpState: frontends
@@ -114,6 +117,94 @@ def initialize(model, optimizers=None, opt_level="O1", enabled=True,
     if optimizers is None:
         return bundle
     return bundle, optimizers
+
+
+# --- legacy registry API (apex/amp/amp.py — half_function, float_function,
+# promote_function, register_*). Apex monkey-patches call sites; the
+# functional analogue wraps the callable so its floating array args are cast
+# on the way in — same observable op-level dtype policy, no patching.
+def _current_half_dtype():
+    """Active half dtype, or None when amp is inactive (uninitialized,
+    enabled=False, or O0) — apex's wrappers no-op when amp isn't on."""
+    pol = _amp_state.opt_properties
+    if pol is None or not pol.enabled or pol.compute_dtype == jnp.float32:
+        return None
+    return pol.compute_dtype
+
+
+def _is_float_array(a):
+    # only real arrays are cast (apex casts only torch Tensors): Python
+    # scalars/lists pass through untouched, preserving jax weak typing.
+    import numpy as np
+
+    return isinstance(a, (jax.Array, np.ndarray)) and \
+        jnp.issubdtype(a.dtype, jnp.floating)
+
+
+def _cast_call(fn, args, kwargs, dtype):
+    if dtype is None:
+        return fn(*args, **kwargs)
+
+    def one(a):
+        return jnp.asarray(a, dtype) if _is_float_array(a) else a
+
+    return fn(*(one(a) for a in args),
+              **{k: one(v) for k, v in kwargs.items()})
+
+
+def half_function(fn):
+    """Wrap ``fn`` to run in the policy's half dtype (amp.py — half_function
+    / FP16_FUNCS entry semantics). No-op while amp is inactive."""
+
+    def wrapped(*args, **kwargs):
+        return _cast_call(fn, args, kwargs, _current_half_dtype())
+
+    return wrapped
+
+
+def float_function(fn):
+    """Wrap ``fn`` to run in fp32 (amp.py — float_function / FP32_FUNCS).
+    No-op while amp is inactive."""
+
+    def wrapped(*args, **kwargs):
+        dtype = jnp.float32 if _current_half_dtype() is not None else None
+        return _cast_call(fn, args, kwargs, dtype)
+
+    return wrapped
+
+
+def promote_function(fn):
+    """Wrap ``fn`` to promote floating ARRAY args (positional and keyword)
+    to the widest floating dtype among them (amp.py — promote_function /
+    CASTS). Non-array args never participate, so Python scalars keep their
+    weak typing."""
+
+    def wrapped(*args, **kwargs):
+        floats = [a for a in list(args) + list(kwargs.values())
+                  if _is_float_array(a)]
+        if _current_half_dtype() is None or len(floats) < 2:
+            return fn(*args, **kwargs)
+        target = jnp.result_type(*[a.dtype for a in floats])
+        return _cast_call(fn, args, kwargs, target)
+
+    return wrapped
+
+
+def _register(module, name, wrapper):
+    setattr(module, name, wrapper(getattr(module, name)))
+
+
+def register_half_function(module, name):
+    """amp.py — register_half_function(module, function_name)."""
+    _register(module, name, half_function)
+
+
+def register_float_function(module, name):
+    _register(module, name, float_function)
+
+
+def register_promote_function(module, name):
+    _register(module, name, promote_function)
 
 
 @contextlib.contextmanager

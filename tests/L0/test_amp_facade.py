@@ -85,3 +85,83 @@ def test_two_train_states_share_nothing():
     w_before = np.asarray(amp.master_params(st0)["w"])
     w_after = np.asarray(amp.master_params(st0b)["w"])
     assert not np.array_equal(w_before, w_after)
+
+
+def test_half_float_promote_functions():
+    """Legacy registry API (apex/amp/amp.py — half/float/promote_function)."""
+    amp.initialize(_model(9), fused_sgd(0.1), opt_level="O2", verbosity=0)
+
+    @amp.half_function
+    def matmul(a, b):
+        assert a.dtype == jnp.bfloat16 and b.dtype == jnp.bfloat16
+        return a @ b
+
+    y = matmul(jnp.ones((2, 3)), jnp.ones((3, 2)))
+    assert y.dtype == jnp.bfloat16
+
+    @amp.float_function
+    def softmaxish(x):
+        assert x.dtype == jnp.float32
+        return jax.nn.softmax(x)
+
+    assert softmaxish(jnp.ones((4,), jnp.bfloat16)).dtype == jnp.float32
+
+    @amp.promote_function
+    def add(a, b):
+        assert a.dtype == b.dtype
+        return a + b
+
+    out = add(jnp.ones((2,), jnp.bfloat16), jnp.ones((2,), jnp.float32))
+    assert out.dtype == jnp.float32
+    # int args untouched
+    out = add(jnp.ones((2,), jnp.bfloat16), jnp.ones((2,), jnp.bfloat16))
+    assert out.dtype == jnp.bfloat16
+
+
+def test_register_functions_on_module():
+    import types
+
+    mod = types.SimpleNamespace(op=lambda x: x)
+    amp.initialize(_model(9), fused_sgd(0.1), opt_level="O2", verbosity=0)
+    amp.register_half_function(mod, "op")
+    assert mod.op(jnp.ones((2,), jnp.float32)).dtype == jnp.bfloat16
+    mod2 = types.SimpleNamespace(op=lambda x: x)
+    amp.register_float_function(mod2, "op")
+    assert mod2.op(jnp.ones((2,), jnp.bfloat16)).dtype == jnp.float32
+
+
+def test_registry_noops_when_amp_inactive():
+    """apex's wrappers no-op when amp is off (enabled=False / O0)."""
+    amp.initialize(_model(9), fused_sgd(0.1), opt_level="O0", verbosity=0)
+
+    @amp.half_function
+    def ident(x):
+        return x
+
+    assert ident(jnp.ones((2,), jnp.float32)).dtype == jnp.float32
+    amp.initialize(_model(9), fused_sgd(0.1), opt_level="O2", enabled=False,
+                   verbosity=0)
+    assert ident(jnp.ones((2,), jnp.float32)).dtype == jnp.float32
+
+
+def test_registry_preserves_non_arrays_and_weak_types():
+    amp.initialize(_model(9), fused_sgd(0.1), opt_level="O2", verbosity=0)
+
+    @amp.half_function
+    def takes_list(lst, x):
+        assert isinstance(lst, list)          # native object untouched
+        return x
+
+    assert takes_list([1.0, 2.0],
+                      jnp.ones((2,), jnp.float32)).dtype == jnp.bfloat16
+
+    @amp.promote_function
+    def add(a, b):
+        return a + b
+
+    # python scalar + bf16 array: scalar stays weak, no fp32 promotion
+    out = add(jnp.ones((2,), jnp.bfloat16), 2.0)
+    assert out.dtype == jnp.bfloat16
+    # kwargs participate in promotion
+    out = add(jnp.ones((2,), jnp.bfloat16), b=jnp.ones((2,), jnp.float32))
+    assert out.dtype == jnp.float32
