@@ -23,6 +23,7 @@ bf16 is the default half dtype (BASELINE.json), fp16 selectable.
 from __future__ import annotations
 
 import contextlib
+import functools
 from typing import Any, Callable, NamedTuple, Optional
 
 import jax
@@ -156,6 +157,7 @@ def half_function(fn):
     """Wrap ``fn`` to run in the policy's half dtype (amp.py — half_function
     / FP16_FUNCS entry semantics). No-op while amp is inactive."""
 
+    @functools.wraps(fn)
     def wrapped(*args, **kwargs):
         return _cast_call(fn, args, kwargs, _current_half_dtype())
 
@@ -166,6 +168,7 @@ def float_function(fn):
     """Wrap ``fn`` to run in fp32 (amp.py — float_function / FP32_FUNCS).
     No-op while amp is inactive."""
 
+    @functools.wraps(fn)
     def wrapped(*args, **kwargs):
         dtype = jnp.float32 if _current_half_dtype() is not None else None
         return _cast_call(fn, args, kwargs, dtype)
@@ -179,6 +182,7 @@ def promote_function(fn):
     CASTS). Non-array args never participate, so Python scalars keep their
     weak typing."""
 
+    @functools.wraps(fn)
     def wrapped(*args, **kwargs):
         floats = [a for a in list(args) + list(kwargs.values())
                   if _is_float_array(a)]

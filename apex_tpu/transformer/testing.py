@@ -124,7 +124,8 @@ def _leaf_key(path) -> Tuple[str, ...]:
 
 def make_full_parallel_inputs(*, n_stages, tp, dp, hidden=32, inner=64,
                               n_experts=4, e_inner=32, micro=4, batch=2,
-                              seq=8, seed=0, capacity_factor=1.25):
+                              seq=8, seed=0, capacity_factor=1.25,
+                              num_chunks=1):
     """Global (host) params + microbatch stream + in_specs for shard_map.
 
     Returns (params, specs, mask, microbatches, targets, dims). Activation
@@ -132,9 +133,17 @@ def make_full_parallel_inputs(*, n_stages, tp, dp, hidden=32, inner=64,
     global microbatch array is [M, DP, TP, S_local, B, H]."""
     from jax.sharding import PartitionSpec as P
 
+    # num_chunks > 1: interleaved virtual pipeline — logical stage
+    # (c*pp + r) lives on pipe rank r as its chunk c, so the stacked row
+    # order is r*v + c ↦ stage c*pp + r (schedules.py's round-robin split)
+    L = n_stages * num_chunks
     stages = [_stage_params(seed + s, hidden=hidden, inner=inner, tp=tp,
                             dp=dp, n_experts=n_experts, e_inner=e_inner)
-              for s in range(n_stages)]
+              for s in range(L)]
+    if num_chunks > 1:
+        order = [c * n_stages + r for r in range(n_stages)
+                 for c in range(num_chunks)]
+        stages = [stages[i] for i in order]
     params = jax.tree_util.tree_map(lambda *ls: np.stack(ls), *stages)
 
     def spec_of(path, leaf):
@@ -151,18 +160,26 @@ def make_full_parallel_inputs(*, n_stages, tp, dp, hidden=32, inner=64,
     tg = rs.randn(micro, dp, tp, s_local, batch, hidden).astype(np.float32)
     dims = dict(hidden=hidden, inner=inner, n_experts=n_experts,
                 e_inner=e_inner, tp=tp, dp=dp, n_stages=n_stages,
-                capacity_factor=capacity_factor)
+                capacity_factor=capacity_factor, num_chunks=num_chunks)
     return params, specs, mask, mb, tg, dims
 
 
-def _strip_local(params):
+def _strip_local(params, num_chunks=1):
     """Inside shard_map every sharded leading dim is a singleton: index it
-    away (pipe dim + any model/data shard dim)."""
+    away (any model/data shard dim; the pipe dim too unless it carries
+    ``num_chunks`` virtual-stage rows, which stage_fn consumes via
+    schedules._chunk)."""
 
     def strip(path, leaf):
-        n = 1 + len(_SHARD_AXES.get(_leaf_key(path), ()))
-        for _ in range(n):
-            leaf = leaf[0]
+        n_shard = len(_SHARD_AXES.get(_leaf_key(path), ()))
+        if num_chunks == 1:
+            leaf = leaf[0]          # pipe singleton
+            for _ in range(n_shard):
+                leaf = leaf[0]
+            return leaf
+        # keep the [v, ...] chunk stack; drop shard singletons at axis 1
+        for _ in range(n_shard):
+            leaf = leaf[:, 0]
         return leaf
 
     return jax.tree_util.tree_map_with_path(strip, params)
@@ -231,8 +248,10 @@ def build_full_parallel_step(dims, mask, *, opt_level="O2",
             l = l + jax.lax.stop_gradient(jax.lax.psum(l, "model") - l)
         return l
 
+    num_chunks = dims.get("num_chunks", 1)
     pipe_loss = make_pipeline_loss_fn(stage_fn, mb_loss,
-                                      num_stages=n_stages)
+                                      num_stages=n_stages,
+                                      num_chunks=num_chunks)
 
     policy = amp.resolve_policy(opt_level=opt_level, loss_scale="dynamic")
     import optax
@@ -249,7 +268,7 @@ def build_full_parallel_step(dims, mask, *, opt_level="O2",
         overflow_sync_axes=sync or None)
 
     def run(global_params, mb, tg):
-        p = _strip_local(global_params)
+        p = _strip_local(global_params, num_chunks)
         batch = (mb[:, 0, 0], tg[:, 0, 0])  # local mb: [M,1,1,S,B,H]
         state = init_fn(p)
         losses = []

@@ -16,14 +16,14 @@ from apex_tpu.transformer.testing import (build_full_parallel_step,
 
 
 def _run(devices, axes, *, opt_level="O2", n_steps=3, seed=0, seq=8,
-         capacity_factor=1.25):
+         capacity_factor=1.25, num_chunks=1):
     dp, pp, tp = axes["data"], axes["pipe"], axes["model"]
     n = dp * pp * tp
     mesh = Mesh(np.array(devices[:n]).reshape(dp, pp, tp),
                 ("data", "pipe", "model"))
     params, specs, mask, mb, tg, dims = make_full_parallel_inputs(
         n_stages=pp, tp=tp, dp=dp, n_experts=4, seed=seed, seq=seq,
-        capacity_factor=capacity_factor)
+        capacity_factor=capacity_factor, num_chunks=num_chunks)
     run = build_full_parallel_step(dims, mask, opt_level=opt_level,
                                    n_steps=n_steps)
     sharded = jax.jit(shard_map(
@@ -77,3 +77,12 @@ def test_tp_width_is_numerically_invisible(eight_devices):
 # overflow tokens drop (a property of token-dropping routers, not a bug).
 # The dispatch math itself is exactly parity-tested in test_moe.py; dp=2/4
 # layouts are covered by the parametrized step test above.
+
+
+def test_full_parallel_with_interleaved_pipeline(eight_devices):
+    """dp2 × pp2(v=2 virtual chunks → 4 logical stages) × tp2 — the
+    interleaved 1F1B schedule composed with every other axis."""
+    losses = _run(eight_devices, {"data": 2, "pipe": 2, "model": 2},
+                  seed=21, num_chunks=2)
+    assert np.isfinite(losses).all(), losses
+    assert losses[-1] < losses[0], losses
