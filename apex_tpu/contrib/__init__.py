@@ -8,19 +8,26 @@ aligned hot path.
 
 from . import bottleneck  # noqa: F401
 from . import clip_grad  # noqa: F401
+from . import conv_bias_relu  # noqa: F401
+from . import cudnn_gbn  # noqa: F401
 from . import fmha  # noqa: F401
 from . import focal_loss  # noqa: F401
+from . import gpu_direct_storage  # noqa: F401
 from . import group_norm  # noqa: F401
 from . import groupbn  # noqa: F401
 from . import index_mul_2d  # noqa: F401
 from . import layer_norm  # noqa: F401
 from . import multihead_attn  # noqa: F401
+from . import nccl_allocator  # noqa: F401
+from . import openfold_triton  # noqa: F401
 from . import optimizers  # noqa: F401
 from . import peer_memory  # noqa: F401
 from . import sparsity  # noqa: F401
 from . import transducer  # noqa: F401
 from . import xentropy  # noqa: F401
 
-__all__ = ["bottleneck", "clip_grad", "fmha", "focal_loss", "group_norm",
-           "groupbn", "index_mul_2d", "layer_norm", "multihead_attn",
-           "optimizers", "peer_memory", "sparsity", "transducer", "xentropy"]
+__all__ = ["bottleneck", "clip_grad", "conv_bias_relu", "cudnn_gbn", "fmha",
+           "focal_loss", "gpu_direct_storage", "group_norm", "groupbn",
+           "index_mul_2d", "layer_norm", "multihead_attn", "nccl_allocator",
+           "openfold_triton", "optimizers", "peer_memory", "sparsity",
+           "transducer", "xentropy"]

@@ -1,0 +1,84 @@
+"""Fused Conv+Bias(+ReLU / +Mask+ReLU / frozen scale-bias) blocks, NHWC.
+
+Reference: apex/contrib/conv_bias_relu/conv_bias_relu.py — ConvBiasReLU,
+ConvBias, ConvBiasMaskReLU, ConvFrozenScaleBiasReLU (cudnn_frontend v8 fused
+graphs via the ``fused_conv_bias_relu`` ext, SURVEY N16). TPU mapping
+(SURVEY §3.2 N16): XLA fuses conv epilogues natively — these are jittable
+functions whose bodies XLA compiles to a single fused conv; the module keeps
+the reference's call signatures (NHWC activations, OIHW-style weights are
+accepted as HWIO here, stride/padding ints) so callers port unchanged.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["conv_bias", "conv_bias_relu", "conv_bias_mask_relu",
+           "conv_frozen_scale_bias_relu",
+           "ConvBias", "ConvBiasReLU", "ConvBiasMaskReLU",
+           "ConvFrozenScaleBiasReLU"]
+
+
+def _conv_nhwc(x, weight, stride, padding):
+    """NHWC x HWIO conv. int padding means symmetric SAME-style explicit pad
+    (the reference passes cudnn-style int pad)."""
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    if isinstance(padding, int):
+        padding = ((padding, padding), (padding, padding))
+    return lax.conv_general_dilated(
+        x, jnp.asarray(weight, x.dtype), window_strides=stride,
+        padding=padding, dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32)
+
+
+def conv_bias(x, weight, bias, padding=0, stride=1):
+    """Conv + bias epilogue (reference: ConvBias.apply)."""
+    y = _conv_nhwc(x, weight, stride, padding)
+    return jnp.asarray(y + jnp.asarray(bias, y.dtype), x.dtype)
+
+
+def conv_bias_relu(x, weight, bias, padding=0, stride=1):
+    """Conv + bias + ReLU (reference: ConvBiasReLU.apply)."""
+    y = _conv_nhwc(x, weight, stride, padding)
+    return jnp.asarray(jnp.maximum(y + jnp.asarray(bias, y.dtype), 0), x.dtype)
+
+
+def conv_bias_mask_relu(x, weight, bias, mask, padding=0, stride=1):
+    """Conv + bias + elementwise mask + ReLU (reference: ConvBiasMaskReLU —
+    the mask is the ReLU bitmask of a parallel branch)."""
+    y = _conv_nhwc(x, weight, stride, padding)
+    y = (y + jnp.asarray(bias, y.dtype)) * jnp.asarray(mask, y.dtype)
+    return jnp.asarray(jnp.maximum(y, 0), x.dtype)
+
+
+def conv_frozen_scale_bias_relu(x, weight, scale, bias, padding=0, stride=1):
+    """Conv + frozen-BN affine (scale, bias treated as constants: no grad
+    flows to them — reference ConvFrozenScaleBiasReLU marks them
+    non-differentiable) + ReLU."""
+    scale = lax.stop_gradient(jnp.asarray(scale))
+    bias = lax.stop_gradient(jnp.asarray(bias))
+    y = _conv_nhwc(x, weight, stride, padding)
+    y = y * jnp.asarray(scale, y.dtype) + jnp.asarray(bias, y.dtype)
+    return jnp.asarray(jnp.maximum(y, 0), x.dtype)
+
+
+class _FnApply:
+    """Reference parity: apex exposes these as autograd Functions used via
+    ``.apply(...)``; grads come for free from jax AD here."""
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    def apply(self, *args):
+        return self._fn(*args)
+
+    __call__ = apply
+
+
+ConvBias = _FnApply(conv_bias)
+ConvBiasReLU = _FnApply(conv_bias_relu)
+ConvBiasMaskReLU = _FnApply(conv_bias_mask_relu)
+ConvFrozenScaleBiasReLU = _FnApply(conv_frozen_scale_bias_relu)

@@ -1,0 +1,48 @@
+"""Group BatchNorm (cuDNN-graph flavor) — NHWC, multi-device stat groups.
+
+Reference: apex/contrib/cudnn_gbn/batch_norm.py — class GroupBatchNorm
+(``cudnn_gbn_lib`` fused graphs, SURVEY N22). TPU mapping (SURVEY §3.2 N22):
+"covered by SyncBN psum" — the stat exchange is a Welford psum over the mesh
+axis and XLA fuses the normalize+affine epilogue, so this module is a
+signature-parity front over :mod:`apex_tpu.contrib.groupbn`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+
+from apex_tpu.contrib.groupbn import BatchNorm2d_NHWC
+
+__all__ = ["GroupBatchNorm2d"]
+
+
+class GroupBatchNorm2d(nn.Module):
+    """Reference signature: GroupBatchNorm2d(num_features, group_size=...).
+    ``group_size`` > 1 syncs stats across ``axis_name`` (the mesh axis is the
+    device group; subgroup selection is the axis_index_groups mechanism on
+    SyncBatchNorm — see parallel/sync_batchnorm.create_syncbn_process_group).
+    """
+
+    num_features: int
+    group_size: int = 1
+    axis_name: Optional[str] = None
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: Any = None
+    use_running_average: Optional[bool] = None
+
+    @nn.compact
+    def __call__(self, x, z=None, use_running_average: Optional[bool] = None):
+        return BatchNorm2d_NHWC(
+            num_features=self.num_features,
+            fuse_relu=False,
+            bn_group=self.group_size,
+            axis_name=self.axis_name,
+            momentum=self.momentum,
+            epsilon=self.epsilon,
+            dtype=self.dtype,
+            use_running_average=self.use_running_average
+            if use_running_average is None else use_running_average,
+            name="gbn")(x, z=z)

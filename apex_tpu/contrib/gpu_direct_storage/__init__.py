@@ -1,0 +1,65 @@
+"""Direct device↔disk tensor I/O (GDS flavor) over the async host path.
+
+Reference: apex/contrib/csrc/gpu_direct_storage/ — cuFile-based
+``save_data``/``load_data`` moving tensors GPU↔disk without a host bounce
+(SURVEY N24). TPU mapping (SURVEY §3.2 N24): there is no user-controlled DMA
+path to disk on TPU — the equivalent is the same host-staging copy the
+checkpoint pipeline uses: device→host, then one guaranteed-copy pass through
+``utils.pytree.host_flatten`` (the native ``apex_tpu._C`` GIL-released
+memcpy when the extension is built), then a single contiguous write. This
+module keeps the reference's flat per-tensor save/load surface on top of
+that path; whole-pytree and overlapped-with-training saves live in
+``utils/checkpoint.py — AsyncCheckpointer``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import numpy as np
+
+from apex_tpu.utils.pytree import host_flatten
+
+__all__ = ["save_data", "load_data", "save_data_no_gds", "load_data_no_gds"]
+
+
+def save_data(filename: str, tensor: Any) -> None:
+    """Reference: gds.save_data(filename, tensor) — direct-to-disk write.
+    Device→host transfer, guaranteed-copy staging (np.asarray of a
+    CPU-backend jax array can alias the XLA buffer — see
+    utils/checkpoint._snapshot), then a single contiguous write."""
+    arr = np.asarray(jax.device_get(tensor))
+    arr = host_flatten([arr]).reshape(arr.shape)
+    tmp = f"{filename}.tmp"
+    with open(tmp, "wb") as f:
+        np.lib.format.write_array(f, arr, allow_pickle=False)
+    os.replace(tmp, filename)
+
+
+def load_data(filename: str, tensor: Any) -> Any:
+    """Reference: gds.load_data(filename, tensor) — reads INTO the passed
+    tensor (shape/dtype must match). Functional here: returns the loaded
+    array placed on the argument's device, validating shape and dtype."""
+    with open(filename, "rb") as f:
+        arr = np.lib.format.read_array(f, allow_pickle=False)
+    shape = getattr(tensor, "shape", None)
+    dtype = getattr(tensor, "dtype", None)
+    if shape is not None and tuple(arr.shape) != tuple(shape):
+        raise ValueError(
+            f"load_data: file shape {arr.shape} != tensor shape {shape}")
+    if dtype is not None:
+        arr = arr.astype(dtype)
+    dev = None
+    try:
+        dev = list(getattr(tensor, "devices", lambda: [])())[0]
+    except (IndexError, TypeError):
+        pass
+    return jax.device_put(arr, dev) if dev is not None else jax.device_put(arr)
+
+
+# The reference exposes explicit bounce-buffer variants for comparison
+# benchmarks; on TPU both paths are the same host-staged copy.
+save_data_no_gds = save_data
+load_data_no_gds = load_data

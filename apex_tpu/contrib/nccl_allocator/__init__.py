@@ -1,0 +1,54 @@
+"""NCCL-registered buffer allocator — API-parity no-op on TPU.
+
+Reference: apex/contrib/nccl_allocator/NCCLAllocator.cpp — ``init()`` installs
+a pluggable CUDA allocator backed by ``ncclMemAlloc`` and ``nccl_mem()`` is a
+context manager under which tensor allocations land in NCCL-registered
+(user-buffer) memory, letting NCCL skip staging copies (SURVEY N24).
+
+TPU mapping (SURVEY §3.2 N24): "n/a on TPU (XLA owns buffers)" — every XLA
+buffer is already placed and registered by the runtime, and ICI collectives
+operate on device buffers directly; there is no user-visible allocator to
+swap. The API is preserved so reference callers run unchanged: ``init()``
+records availability, ``nccl_mem()`` is a no-op context manager, and both
+warn once at first use that registration is implicit on this backend.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import warnings
+
+__all__ = ["init", "nccl_mem", "is_initialized"]
+
+_initialized = False
+_warned = False
+
+
+def _warn_once():
+    global _warned
+    if not _warned:
+        warnings.warn(
+            "apex_tpu.contrib.nccl_allocator: buffer registration is "
+            "implicit under XLA (the runtime owns and registers all device "
+            "buffers); init()/nccl_mem() are no-ops kept for API parity.",
+            stacklevel=3)
+        _warned = True
+
+
+def init() -> None:
+    """Reference: nccl_allocator.init(). No-op: XLA owns the allocator."""
+    global _initialized
+    _warn_once()
+    _initialized = True
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+@contextlib.contextmanager
+def nccl_mem(enabled: bool = True):
+    """Reference: ``with nccl_allocator.nccl_mem():`` — allocations inside
+    are NCCL-registered. Here: every buffer already is; yields unchanged."""
+    _warn_once()
+    yield

@@ -1,0 +1,56 @@
+"""OpenFold fused kernels — TPU equivalents of the Triton set.
+
+Reference: apex/contrib/openfold_triton/ — Triton kernels used by the
+OpenFold (AlphaFold2) MLPerf submission: fused LayerNorm variants and a
+fused multi-head attention for the evoformer's gated attention
+(SURVEY P37 [vintage?]). TPU mapping: LayerNorm binds to the Pallas kernel
+(kernels/layer_norm.py); the evoformer attention is plain fused-by-XLA
+attention — it materializes the [..., heads, q, k] logits in fp32, which is
+the right call at evoformer sequence lengths (hundreds of residues); for
+long-sequence attention use kernels/flash_attention.py, which is blockwise
+but has no pair-bias input.
+
+``AttnBiasJIT``-style evoformer attention takes a pair bias term added to
+the logits pre-softmax and a sigmoid gate on the output.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.kernels.layer_norm import layer_norm
+
+__all__ = ["LayerNormSmallShapeOptImpl", "layer_norm_small",
+           "evoformer_attention"]
+
+
+def layer_norm_small(x, weight, bias, eps: float = 1e-5):
+    """Reference: LayerNormSmallShapeOptImpl — the small-hidden fast path.
+    The Pallas LN already blocks over hidden; one entry covers all shapes."""
+    return layer_norm(x, weight, bias, eps=eps)
+
+
+LayerNormSmallShapeOptImpl = layer_norm_small
+
+
+def evoformer_attention(q, k, v, bias: Optional[jnp.ndarray] = None,
+                        gate: Optional[jnp.ndarray] = None,
+                        scale: Optional[float] = None):
+    """Gated, pair-biased MHA (reference: openfold_triton MHA). q/k/v are
+    [..., heads, seq, head_dim]; ``bias`` broadcasts onto the [..., heads,
+    q_len, k_len] logits; ``gate`` (same shape as the output) is passed
+    through a sigmoid and multiplied in, per the evoformer block."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("...qd,...kd->...qk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        logits = logits + jnp.asarray(bias, logits.dtype)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("...qk,...kd->...qd", probs.astype(v.dtype), v)
+    if gate is not None:
+        out = out * jax.nn.sigmoid(jnp.asarray(gate, out.dtype))
+    return out

@@ -1,0 +1,139 @@
+"""Contrib tail tests: conv_bias_relu, cudnn_gbn, nccl_allocator,
+gpu_direct_storage, openfold_triton (mirrors apex/contrib/test/)."""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+# --------------------------------------------------------- conv_bias_relu
+def _ref_conv(x, w, stride, pad):
+    from jax import lax
+    return lax.conv_general_dilated(
+        x, w, (stride, stride), ((pad, pad), (pad, pad)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def test_conv_bias_relu_matches_composed():
+    from apex_tpu.contrib.conv_bias_relu import (ConvBiasReLU, conv_bias,
+                                                 conv_bias_relu)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(2, 8, 8, 4), jnp.float32)
+    w = jnp.asarray(rng.randn(3, 3, 4, 6) * 0.1, jnp.float32)
+    b = jnp.asarray(rng.randn(6) * 0.1, jnp.float32)
+
+    ref = jnp.maximum(_ref_conv(x, w, 1, 1) + b, 0)
+    np.testing.assert_allclose(np.asarray(conv_bias_relu(x, w, b, 1, 1)),
+                               np.asarray(ref), rtol=1e-5, atol=1e-5)
+    # Function-object .apply parity (reference autograd-Function surface)
+    np.testing.assert_allclose(np.asarray(ConvBiasReLU.apply(x, w, b, 1, 1)),
+                               np.asarray(ref), rtol=1e-5, atol=1e-5)
+    # no-relu variant keeps negatives
+    y = conv_bias(x, w, b, 1, 1)
+    assert (np.asarray(y) < 0).any()
+
+
+def test_conv_bias_mask_relu_and_frozen_scale_grads():
+    from apex_tpu.contrib.conv_bias_relu import (conv_bias_mask_relu,
+                                                 conv_frozen_scale_bias_relu)
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(1, 6, 6, 3), jnp.float32)
+    w = jnp.asarray(rng.randn(3, 3, 3, 5) * 0.2, jnp.float32)
+    b = jnp.zeros((5,), jnp.float32)
+    mask = jnp.asarray(rng.rand(1, 6, 6, 5) > 0.5, jnp.float32)
+    y = conv_bias_mask_relu(x, w, b, mask, 1, 1)
+    ref = jnp.maximum((_ref_conv(x, w, 1, 1) + b) * mask, 0)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+    # frozen scale/bias: no gradient to scale (reference marks them frozen)
+    scale = jnp.asarray(rng.rand(5) + 0.5, jnp.float32)
+    fb = jnp.asarray(rng.randn(5) * 0.1, jnp.float32)
+    gscale = jax.grad(
+        lambda s: conv_frozen_scale_bias_relu(x, w, s, fb, 1, 1).sum())(scale)
+    np.testing.assert_allclose(np.asarray(gscale), 0.0)
+    gw = jax.grad(
+        lambda ww: conv_frozen_scale_bias_relu(x, ww, scale, fb, 1, 1).sum())(w)
+    assert np.isfinite(np.asarray(gw)).all() and np.abs(np.asarray(gw)).sum() > 0
+
+
+# -------------------------------------------------------------- cudnn_gbn
+def test_cudnn_gbn_matches_groupbn():
+    from apex_tpu.contrib.cudnn_gbn import GroupBatchNorm2d
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(4, 5, 5, 8), jnp.float32)
+    m = GroupBatchNorm2d(num_features=8)
+    variables = m.init(jax.random.PRNGKey(0), x, use_running_average=False)
+    y, _ = m.apply(variables, x, use_running_average=False,
+                   mutable=["batch_stats"])
+    # per-channel normalization over N,H,W
+    yn = np.asarray(y).reshape(-1, 8)
+    np.testing.assert_allclose(yn.mean(0), 0.0, atol=1e-5)
+    np.testing.assert_allclose(yn.std(0), 1.0, atol=1e-2)
+
+
+# --------------------------------------------------------- nccl_allocator
+def test_nccl_allocator_noop_api():
+    from apex_tpu.contrib import nccl_allocator
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        nccl_allocator.init()
+        assert nccl_allocator.is_initialized()
+        with nccl_allocator.nccl_mem():
+            x = jnp.ones((4,))
+        assert float(x.sum()) == 4.0
+
+
+# ----------------------------------------------------- gpu_direct_storage
+def test_gds_save_load_roundtrip(tmp_path):
+    from apex_tpu.contrib.gpu_direct_storage import load_data, save_data
+    x = jnp.asarray(np.random.RandomState(3).randn(16, 8), jnp.float32)
+    path = str(tmp_path / "t.npy")
+    save_data(path, x)
+    y = load_data(path, jnp.zeros((16, 8), jnp.float32))
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x))
+    with pytest.raises(ValueError):
+        load_data(path, jnp.zeros((8, 8), jnp.float32))
+
+
+# ------------------------------------------------------- openfold_triton
+def test_openfold_layer_norm_alias():
+    from apex_tpu.contrib.openfold_triton import LayerNormSmallShapeOptImpl
+    rng = np.random.RandomState(4)
+    x = jnp.asarray(rng.randn(6, 128), jnp.float32)
+    w = jnp.ones((128,), jnp.float32)
+    b = jnp.zeros((128,), jnp.float32)
+    y = LayerNormSmallShapeOptImpl(x, w, b)
+    ref = (x - x.mean(-1, keepdims=True)) / jnp.sqrt(
+        x.var(-1, keepdims=True) + 1e-5)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_openfold_evoformer_attention():
+    from apex_tpu.contrib.openfold_triton import evoformer_attention
+    rng = np.random.RandomState(5)
+    q = jnp.asarray(rng.randn(2, 4, 16, 32) * 0.3, jnp.float32)
+    k = jnp.asarray(rng.randn(2, 4, 16, 32) * 0.3, jnp.float32)
+    v = jnp.asarray(rng.randn(2, 4, 16, 32) * 0.3, jnp.float32)
+    bias = jnp.asarray(rng.randn(2, 4, 16, 16) * 0.1, jnp.float32)
+    gate = jnp.asarray(rng.randn(2, 4, 16, 32), jnp.float32)
+
+    out = evoformer_attention(q, k, v, bias=bias, gate=gate)
+
+    scale = 32 ** -0.5
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale + bias
+    ref = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(logits, -1), v)
+    ref = ref * jax.nn.sigmoid(gate)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+    # bias-free, gate-free path == vanilla attention
+    out2 = evoformer_attention(q, k, v)
+    ref2 = jnp.einsum(
+        "bhqk,bhkd->bhqd",
+        jax.nn.softmax(jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale, -1), v)
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(ref2),
+                               rtol=1e-4, atol=1e-4)
