@@ -29,28 +29,38 @@ def save_data(filename: str, tensor: Any) -> None:
     """Reference: gds.save_data(filename, tensor) — direct-to-disk write.
     Device→host transfer, guaranteed-copy staging (np.asarray of a
     CPU-backend jax array can alias the XLA buffer — see
-    utils/checkpoint._snapshot), then a single contiguous write."""
+    utils/checkpoint._snapshot), then a single contiguous write.
+
+    Stored as npz of (raw bytes, dtype name, shape): ml_dtypes such as
+    bfloat16 — the default AMP dtype on TPU — do not round-trip through the
+    plain npy descr (they serialize as void and refuse to cast back)."""
     arr = np.asarray(jax.device_get(tensor))
     arr = host_flatten([arr]).reshape(arr.shape)
+    raw = arr.reshape(-1).view(np.uint8)
     tmp = f"{filename}.tmp"
     with open(tmp, "wb") as f:
-        np.lib.format.write_array(f, arr, allow_pickle=False)
+        np.savez(f, raw=raw, dtype=np.str_(arr.dtype.name),
+                 shape=np.asarray(arr.shape, np.int64))
     os.replace(tmp, filename)
 
 
 def load_data(filename: str, tensor: Any) -> Any:
     """Reference: gds.load_data(filename, tensor) — reads INTO the passed
-    tensor (shape/dtype must match). Functional here: returns the loaded
-    array placed on the argument's device, validating shape and dtype."""
-    with open(filename, "rb") as f:
-        arr = np.lib.format.read_array(f, allow_pickle=False)
-    shape = getattr(tensor, "shape", None)
-    dtype = getattr(tensor, "dtype", None)
-    if shape is not None and tuple(arr.shape) != tuple(shape):
+    tensor, so shape AND dtype must match exactly (a mismatch is a hard
+    error, never a silent cast). Functional here: returns the loaded array
+    placed on the argument's device."""
+    with np.load(filename) as z:
+        dtype = np.dtype(str(z["dtype"]))
+        shape = tuple(int(d) for d in z["shape"])
+        arr = z["raw"].view(dtype).reshape(shape)
+    want_shape = getattr(tensor, "shape", None)
+    want_dtype = getattr(tensor, "dtype", None)
+    if want_shape is not None and shape != tuple(want_shape):
         raise ValueError(
-            f"load_data: file shape {arr.shape} != tensor shape {shape}")
-    if dtype is not None:
-        arr = arr.astype(dtype)
+            f"load_data: file shape {shape} != tensor shape {want_shape}")
+    if want_dtype is not None and dtype != np.dtype(want_dtype):
+        raise ValueError(
+            f"load_data: file dtype {dtype} != tensor dtype {want_dtype}")
     dev = None
     try:
         dev = list(getattr(tensor, "devices", lambda: [])())[0]

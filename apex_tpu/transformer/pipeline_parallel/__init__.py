@@ -4,11 +4,12 @@ from .microbatches import build_num_microbatches_calculator
 from .p2p_communication import (send_backward, send_backward_recv_forward,
                                 send_forward, send_forward_recv_backward,
                                 shift_left, shift_right)
-from .schedules import (forward_backward_no_pipelining,
+from .schedules import (build_model, forward_backward_no_pipelining,
                         forward_backward_pipelining_with_interleaving,
                         forward_backward_pipelining_without_interleaving,
                         get_forward_backward_func, make_pipeline_loss_fn,
                         pipeline_apply)
+from .utils import get_ltor_masks_and_position_ids, listify_model
 
 __all__ = [
     "build_num_microbatches_calculator",
@@ -18,5 +19,6 @@ __all__ = [
     "forward_backward_no_pipelining",
     "forward_backward_pipelining_without_interleaving",
     "forward_backward_pipelining_with_interleaving",
-    "get_forward_backward_func",
+    "get_forward_backward_func", "build_model",
+    "get_ltor_masks_and_position_ids", "listify_model",
 ]

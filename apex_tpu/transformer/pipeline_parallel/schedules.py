@@ -42,7 +42,7 @@ __all__ = ["pipeline_apply", "make_pipeline_loss_fn",
            "forward_backward_no_pipelining",
            "forward_backward_pipelining_without_interleaving",
            "forward_backward_pipelining_with_interleaving",
-           "get_forward_backward_func"]
+           "get_forward_backward_func", "build_model"]
 
 
 def _chunk(tree, c):
@@ -221,3 +221,33 @@ def get_forward_backward_func(
             forward_backward_pipelining_without_interleaving,
             num_stages=pipeline_model_parallel_size)
     return forward_backward_no_pipelining
+
+
+def build_model(model_provider_func: Callable, *,
+                num_stages: int, num_chunks: int = 1,
+                wrap_with_ddp: bool = False, **provider_kwargs) -> list:
+    """Reference: schedules/common.py — build_model(model_provider_func,
+    wrap_with_ddp, virtual_pipeline_model_parallel_size): calls the provider
+    once per virtual-stage chunk on this rank with pre_process/post_process
+    flags marking the true pipeline ends, and returns the chunk list.
+
+    Functional analogue: the provider is called once per LOGICAL stage
+    ``s = chunk * num_stages + rank`` (the reference's round-robin split)
+    and returns that chunk's params (or an inited module/any pytree). The
+    result is RANK-MAJOR — entry ``rank * num_chunks + chunk`` — so that
+    stacking leaf-wise and sharding over the pipe axis with in_spec
+    P('pipe') lands each rank exactly its own [num_chunks, ...] block, in
+    the local-chunk order pipeline_apply/make_pipeline_loss_fn expect.
+    ``wrap_with_ddp`` is accepted for signature parity and ignored:
+    gradient averaging is composed in amp.make_train_step
+    (grad_average_axis), not by wrapping modules.
+    """
+    L = num_stages * num_chunks
+    models = []
+    for rank in range(num_stages):
+        for chunk in range(num_chunks):
+            s = chunk * num_stages + rank
+            models.append(model_provider_func(
+                pre_process=(s == 0), post_process=(s == L - 1),
+                **provider_kwargs))
+    return models

@@ -1,0 +1,80 @@
+"""Pipeline-parallel utilities.
+
+Reference: apex/transformer/pipeline_parallel/utils.py —
+``get_ltor_masks_and_position_ids`` (the Megatron GPT input-prep helper) and
+the microbatch bookkeeping accessors. TPU notes: the mask is built with
+broadcasted iota (static shapes, jit-friendly) rather than materialized
+tril; loss-mask zeroing of EOD/pad tokens and the attention-mask reset at
+EOD boundaries keep the reference's semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+__all__ = ["get_ltor_masks_and_position_ids", "listify_model"]
+
+
+def get_ltor_masks_and_position_ids(
+        data: jnp.ndarray,
+        eod_token: int,
+        reset_position_ids: bool = False,
+        reset_attention_mask: bool = False,
+        eod_mask_loss: bool = False):
+    """Left-to-right (causal) masks + position ids for token batch ``data``
+    of shape [batch, seq].
+
+    Returns (attention_mask, loss_mask, position_ids) with the reference's
+    conventions: attention_mask is boolean [batch, 1, seq, seq] where True
+    means MASKED OUT (the reference computes ``< 0.5`` on a tril of ones and
+    passes the result to masked softmax); loss_mask is float [batch, seq]
+    with 0.0 at EOD positions when ``eod_mask_loss``; position_ids reset to
+    zero after each EOD when ``reset_position_ids``.
+    """
+    batch, seq = data.shape
+
+    q_pos = jnp.arange(seq)[:, None]
+    k_pos = jnp.arange(seq)[None, :]
+    causal = k_pos <= q_pos                                # [seq, seq] visible
+
+    # Document-boundary handling: token j is visible to token i only if no
+    # EOD lies strictly between them (reference loops over eod indices and
+    # zeroes the block-lower-triangle; cumulative-EOD-count equality is the
+    # vectorized identical condition).
+    if reset_attention_mask or reset_position_ids:
+        is_eod = (data == eod_token)
+        # doc id of each position = number of EODs strictly before it
+        doc = jnp.cumsum(is_eod, axis=-1) - jnp.where(is_eod, 1, 0)
+    if reset_attention_mask:
+        same_doc = doc[:, :, None] == doc[:, None, :]      # [b, seq, seq]
+        visible = causal[None] & same_doc
+    else:
+        visible = jnp.broadcast_to(causal[None], (batch, seq, seq))
+
+    attention_mask = ~visible[:, None, :, :]               # True = masked
+
+    loss_mask = jnp.ones((batch, seq), jnp.float32)
+    if eod_mask_loss:
+        loss_mask = jnp.where(data == eod_token, 0.0, loss_mask)
+
+    position_ids = jnp.broadcast_to(jnp.arange(seq)[None, :], (batch, seq))
+    if reset_position_ids:
+        # Reference semantics: for each EOD at index i, positions from i+1
+        # onward subtract (i+1) — the EOD itself keeps its position in the
+        # prior document. doc_start[p] = 1 + (last EOD strictly before p),
+        # or 0 in the first document.
+        pos = jnp.arange(seq)[None, :]
+        prev_is_eod = jnp.pad(is_eod[:, :-1], ((0, 0), (1, 0)))
+        doc_start = jnp.maximum.accumulate(
+            jnp.where(prev_is_eod, pos, 0), axis=-1)
+        position_ids = position_ids - doc_start
+
+    return attention_mask, loss_mask, position_ids
+
+
+def listify_model(model) -> list:
+    """Reference: utils.listify_model — schedules accept a module or a list
+    of virtual-stage chunks; normalize to a list."""
+    return model if isinstance(model, list) else [model]

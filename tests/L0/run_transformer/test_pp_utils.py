@@ -1,0 +1,94 @@
+"""Tests for pipeline_parallel.utils (get_ltor_masks_and_position_ids,
+listify_model) and schedules.build_model.
+
+Oracle: a direct loop transcription of the reference algorithm
+(apex/transformer/pipeline_parallel/utils.py — for each EOD at i:
+attention_mask[(i+1):, :(i+1)] = 0; position_ids[(i+1):] -= delta)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from apex_tpu.transformer.pipeline_parallel import (
+    build_model, get_ltor_masks_and_position_ids, listify_model)
+
+
+def _oracle(data, eod, reset_pos, reset_attn, mask_loss):
+    b, s = data.shape
+    attn = np.tril(np.ones((s, s), bool))
+    attn = np.repeat(attn[None], b, 0)
+    loss_mask = np.ones((b, s), np.float32)
+    pos = np.repeat(np.arange(s)[None], b, 0).astype(np.int64)
+    for bi in range(b):
+        eods = np.nonzero(data[bi] == eod)[0]
+        if mask_loss:
+            loss_mask[bi, data[bi] == eod] = 0.0
+        prev = 0
+        for i in eods:
+            if reset_attn:
+                attn[bi, i + 1:, :i + 1] = False
+            if reset_pos:
+                pos[bi, i + 1:] -= (i + 1 - prev)
+                prev = i + 1
+    return ~attn[:, None], loss_mask, pos   # True = masked out
+
+
+def test_ltor_masks_match_reference_loop():
+    rng = np.random.RandomState(0)
+    data = rng.randint(1, 50, size=(3, 24))
+    data[0, [5, 13]] = 0          # two docs boundaries
+    data[1, 0] = 0                # EOD at position 0
+    data[2, 23] = 0               # EOD at the end
+    for reset_pos in (False, True):
+        for reset_attn in (False, True):
+            for mask_loss in (False, True):
+                am, lm, pid = get_ltor_masks_and_position_ids(
+                    jnp.asarray(data), 0, reset_pos, reset_attn, mask_loss)
+                ram, rlm, rpid = _oracle(
+                    data, 0, reset_pos, reset_attn, mask_loss)
+                np.testing.assert_array_equal(np.asarray(am), ram)
+                np.testing.assert_array_equal(np.asarray(lm), rlm)
+                np.testing.assert_array_equal(np.asarray(pid), rpid)
+
+
+def test_ltor_shapes_and_causality():
+    data = jnp.ones((2, 8), jnp.int32)
+    am, lm, pid = get_ltor_masks_and_position_ids(data, 0)
+    assert am.shape == (2, 1, 8, 8)
+    assert am.dtype == jnp.bool_
+    # strictly-upper triangle masked, diagonal+lower visible
+    a = np.asarray(am)[0, 0]
+    assert a[0, 1] and not a[1, 0] and not a[3, 3]
+    np.testing.assert_array_equal(np.asarray(pid)[0], np.arange(8))
+    np.testing.assert_array_equal(np.asarray(lm), 1.0)
+
+
+def test_build_model_flags_and_order():
+    stage = {"n": 0}
+
+    def provider(pre_process, post_process, width=4):
+        # provider is called in rank-major order; recover the logical stage
+        # from the call index to check round-robin placement
+        i = stage["n"]
+        stage["n"] += 1
+        return {"w": jnp.zeros((width,)), "pre": pre_process,
+                "post": post_process, "idx": i}
+
+    pp, v = 4, 2
+    chunks = build_model(provider, num_stages=pp, num_chunks=v, width=8)
+    assert len(chunks) == pp * v
+    # rank-major layout: entry rank*v + chunk holds logical stage chunk*pp +
+    # rank, so contiguous P('pipe') sharding gives rank r stages {c*pp + r}
+    for rank in range(pp):
+        for chunk in range(v):
+            assert chunks[rank * v + chunk]["idx"] == rank * v + chunk
+    pre = [c["pre"] for c in chunks]
+    post = [c["post"] for c in chunks]
+    # pre_process only at logical stage 0 = (rank 0, chunk 0) = entry 0;
+    # post_process only at stage pp*v-1 = (rank pp-1, chunk v-1) = last entry
+    assert pre == [True] + [False] * (pp * v - 1)
+    assert post == [False] * (pp * v - 1) + [True]
+    assert chunks[0]["w"].shape == (8,)
+
+    m = {"x": 1}
+    assert listify_model(m) == [m]
+    assert listify_model([m, m]) == [m, m]

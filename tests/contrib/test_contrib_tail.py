@@ -91,12 +91,27 @@ def test_nccl_allocator_noop_api():
 def test_gds_save_load_roundtrip(tmp_path):
     from apex_tpu.contrib.gpu_direct_storage import load_data, save_data
     x = jnp.asarray(np.random.RandomState(3).randn(16, 8), jnp.float32)
-    path = str(tmp_path / "t.npy")
+    path = str(tmp_path / "t.npz")
     save_data(path, x)
     y = load_data(path, jnp.zeros((16, 8), jnp.float32))
     np.testing.assert_allclose(np.asarray(y), np.asarray(x))
     with pytest.raises(ValueError):
-        load_data(path, jnp.zeros((8, 8), jnp.float32))
+        load_data(path, jnp.zeros((8, 8), jnp.float32))     # shape mismatch
+    with pytest.raises(ValueError):
+        load_data(path, jnp.zeros((16, 8), jnp.int8))       # dtype mismatch
+
+
+def test_gds_bfloat16_roundtrip(tmp_path):
+    """bfloat16 is the default AMP dtype on TPU — must round-trip exactly
+    (plain npy serializes ml_dtypes as void and cannot cast them back)."""
+    from apex_tpu.contrib.gpu_direct_storage import load_data, save_data
+    x = jnp.asarray(np.random.RandomState(7).randn(8, 4), jnp.bfloat16)
+    path = str(tmp_path / "bf16.npz")
+    save_data(path, x)
+    y = load_data(path, jnp.zeros((8, 4), jnp.bfloat16))
+    assert y.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(
+        np.asarray(y, np.float32), np.asarray(x, np.float32))
 
 
 # ------------------------------------------------------- openfold_triton

@@ -187,3 +187,35 @@ def test_syncbn_groups(data_mesh):
 
     yg = np.asarray(jax.jit(run_global)(x))
     assert yg[:4].mean() < -0.5 and yg[4:].mean() > 0.5
+
+
+@pytest.mark.parametrize("opt_level", ["O0", "O2"])
+def test_ddp_matches_single_process(data_mesh, opt_level):
+    """Reference: tests/L1/cross_product — the DDP axis of the matrix: an
+    8-way DDP run on a global batch must match the single-process run on
+    the same batch (grad averaging over equal shards == global mean)."""
+    params, init_fn, step_fn = _step_setup(opt_level)
+    x, y = _batches()
+
+    @functools.partial(shard_map, mesh=data_mesh,
+                       in_specs=(P(), (P("data"), P("data"))),
+                       out_specs=(P(), P()), check_vma=False)
+    def run_ddp(state, batch):
+        new_state, metrics = step_fn(state, batch)
+        return new_state.params, metrics["loss"]
+
+    ddp_params, ddp_loss = jax.jit(run_ddp)(init_fn(params), (x, y))
+
+    # single-process step on the full batch (no grad_average_axis)
+    policy = amp.resolve_policy(opt_level=opt_level, loss_scale="dynamic")
+    sp_init, sp_step = amp.make_train_step(
+        _loss_fn, fused_sgd(0.1, momentum=0.9), policy)
+    sp_state, sp_metrics = jax.jit(sp_step)(sp_init(params), (x, y))
+
+    np.testing.assert_allclose(float(ddp_loss), float(sp_metrics["loss"]),
+                               rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(ddp_params),
+                    jax.tree_util.tree_leaves(sp_state.params)):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=2e-3, atol=2e-3)
