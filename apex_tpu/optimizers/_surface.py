@@ -1,0 +1,49 @@
+"""torch.optim.Optimizer-shaped surface for the apex-shaped classes.
+
+The reference optimizers inherit torch.optim.Optimizer, so apex code reads
+AND WRITES ``opt.param_groups[0]["lr"]`` (lr schedules) and apex LARC zeroes
+``group["weight_decay"]`` around the inner step. Here the update math lives
+in an optax transform built from the hyperparameters, so the surface is kept
+live by rebuilding the transform whenever param_groups values change
+(rebuild is trivia — a closure construction; state is carried unchanged
+because optax state layout doesn't depend on scalar hyperparameters).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+
+def install_torch_surface(opt, params, factory: Callable, defaults: dict):
+    """Attach defaults/param_groups and the transform factory.
+
+    ``factory(**hyper) -> optax.GradientTransformation`` must accept exactly
+    the keys of ``defaults``.
+    """
+    opt._factory = factory
+    opt._built_with = dict(defaults)
+    opt.defaults = dict(defaults)
+    opt.param_groups = [dict(defaults, params=params)]
+
+
+def current_transform(opt):
+    """The transform matching param_groups[0]'s CURRENT hyperparameters —
+    rebuilt on change so writes to param_groups take effect like torch."""
+    hyper = {k: v for k, v in opt.param_groups[0].items() if k != "params"}
+    if hyper != opt._built_with:
+        opt.transform = opt._factory(**hyper)
+        opt._built_with = dict(hyper)
+    return opt.transform
+
+
+def group_property(key: str):
+    """Class-level property aliasing param_groups[0][key] (torch exposes
+    both spellings; LARC reads opt.lr / opt.weight_decay)."""
+
+    def _get(self):
+        return self.param_groups[0][key]
+
+    def _set(self, value):
+        self.param_groups[0][key] = value
+
+    return property(_get, _set)

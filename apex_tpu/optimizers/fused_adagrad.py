@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from ._surface import current_transform, group_property, install_torch_surface
 from .fused_adam import ScalarOrSchedule, _lr_at
 
 
@@ -59,14 +60,24 @@ def fused_adagrad(learning_rate: ScalarOrSchedule = 1e-2, eps: float = 1e-10,
 class FusedAdagrad:
     """apex-shaped stateful wrapper."""
 
+    lr = group_property("lr")
+    weight_decay = group_property("weight_decay")
+
     def __init__(self, params, lr=1e-2, eps=1e-10, weight_decay=0.0,
                  set_grad_none=True, adagrad_w_mode=False):
+        def factory(lr, eps, weight_decay, adagrad_w_mode):
+            return fused_adagrad(lr, eps, weight_decay, adagrad_w_mode)
+
         self.transform = fused_adagrad(lr, eps, weight_decay, adagrad_w_mode)
         self.state = self.transform.init(params)
         self.params = params
+        install_torch_surface(self, params, factory, dict(
+            lr=lr, eps=eps, weight_decay=weight_decay,
+            adagrad_w_mode=adagrad_w_mode))
 
     def step(self, grads, params=None):
         params = self.params if params is None else params
-        updates, self.state = self.transform.update(grads, self.state, params)
+        tx = current_transform(self)
+        updates, self.state = tx.update(grads, self.state, params)
         self.params = optax.apply_updates(params, updates)
         return self.params

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import optax
 
 from ..kernels.multi_tensor import fused_adam_step
+from ._surface import current_transform, group_property, install_torch_surface
 from ..utils.pytree import flatten
 
 
@@ -90,20 +91,33 @@ class FusedAdam:
     class FusedAdam). ``step(grads, params) -> new_params`` since JAX params
     are explicit; betas/eps/weight_decay/adam_w_mode keep apex names."""
 
+    lr = group_property("lr")
+    weight_decay = group_property("weight_decay")
+
     def __init__(self, params, lr=1e-3, bias_correction=True,
                  betas=(0.9, 0.999), eps=1e-8, adam_w_mode=True,
                  weight_decay=0.0, amsgrad=False, set_grad_none=True):
         if amsgrad:
             raise RuntimeError("FusedAdam does not support the AMSGrad "
                                "variant.")  # apex raises the same
+
+        def factory(lr, bias_correction, betas, eps, adam_w_mode,
+                    weight_decay):
+            return fused_adam(lr, betas[0], betas[1], eps, weight_decay,
+                              adam_w_mode, bias_correction)
+
         self.transform = fused_adam(lr, betas[0], betas[1], eps, weight_decay,
                                     adam_w_mode, bias_correction)
         self.state = self.transform.init(params)
         self.params = params
+        install_torch_surface(self, params, factory, dict(
+            lr=lr, bias_correction=bias_correction, betas=betas, eps=eps,
+            adam_w_mode=adam_w_mode, weight_decay=weight_decay))
 
     def step(self, grads, params=None):
         params = self.params if params is None else params
-        updates, self.state = self.transform.update(grads, self.state, params)
+        tx = current_transform(self)
+        updates, self.state = tx.update(grads, self.state, params)
         self.params = optax.apply_updates(params, updates)
         return self.params
 

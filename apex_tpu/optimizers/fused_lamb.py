@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import optax
 
 from ..kernels.multi_tensor import fused_l2norm
+from ._surface import current_transform, group_property, install_torch_surface
 from .fused_adam import ScalarOrSchedule, _lr_at
 
 
@@ -102,6 +103,9 @@ def fused_lamb(learning_rate: ScalarOrSchedule = 1e-3,
 class FusedLAMB:
     """apex-shaped stateful wrapper (apex/optimizers/fused_lamb.py)."""
 
+    lr = group_property("lr")
+    weight_decay = group_property("weight_decay")
+
     def __init__(self, params, lr=1e-3, bias_correction=True,
                  betas=(0.9, 0.999), eps=1e-6, weight_decay=0.01,
                  amsgrad=False, adam_w_mode=True, grad_averaging=True,
@@ -109,14 +113,25 @@ class FusedLAMB:
         if amsgrad:
             raise RuntimeError("FusedLAMB does not support the AMSGrad "
                                "variant.")
+        def factory(lr, bias_correction, betas, eps, weight_decay,
+                    grad_averaging, max_grad_norm, use_nvlamb):
+            return fused_lamb(lr, betas[0], betas[1], eps, weight_decay,
+                              bias_correction, grad_averaging,
+                              max_grad_norm, use_nvlamb)
+
         self.transform = fused_lamb(lr, betas[0], betas[1], eps, weight_decay,
                                     bias_correction, grad_averaging,
                                     max_grad_norm, use_nvlamb)
         self.state = self.transform.init(params)
         self.params = params
+        install_torch_surface(self, params, factory, dict(
+            lr=lr, bias_correction=bias_correction, betas=betas, eps=eps,
+            weight_decay=weight_decay, grad_averaging=grad_averaging,
+            max_grad_norm=max_grad_norm, use_nvlamb=use_nvlamb))
 
     def step(self, grads, params=None):
         params = self.params if params is None else params
-        updates, self.state = self.transform.update(grads, self.state, params)
+        tx = current_transform(self)
+        updates, self.state = tx.update(grads, self.state, params)
         self.params = optax.apply_updates(params, updates)
         return self.params

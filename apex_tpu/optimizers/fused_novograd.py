@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from ._surface import current_transform, group_property, install_torch_surface
 from .fused_adam import ScalarOrSchedule, _lr_at
 
 
@@ -77,6 +78,9 @@ def fused_novograd(learning_rate: ScalarOrSchedule = 1e-3, beta1: float = 0.95,
 class FusedNovoGrad:
     """apex-shaped stateful wrapper."""
 
+    lr = group_property("lr")
+    weight_decay = group_property("weight_decay")
+
     def __init__(self, params, lr=1e-3, bias_correction=False,
                  betas=(0.95, 0.98), eps=1e-8, weight_decay=0.0,
                  grad_averaging=True, init_zero=False, set_grad_none=True,
@@ -86,14 +90,24 @@ class FusedNovoGrad:
                                "variant.")
         if norm_type != 2:
             raise ValueError("FusedNovoGrad only supports the L2 norm")
+        def factory(lr, bias_correction, betas, eps, weight_decay,
+                    grad_averaging, init_zero):
+            return fused_novograd(lr, betas[0], betas[1], eps, weight_decay,
+                                  grad_averaging, init_zero, bias_correction)
+
         self.transform = fused_novograd(lr, betas[0], betas[1], eps,
                                         weight_decay, grad_averaging,
                                         init_zero, bias_correction)
         self.state = self.transform.init(params)
         self.params = params
+        install_torch_surface(self, params, factory, dict(
+            lr=lr, bias_correction=bias_correction, betas=betas, eps=eps,
+            weight_decay=weight_decay, grad_averaging=grad_averaging,
+            init_zero=init_zero))
 
     def step(self, grads, params=None):
         params = self.params if params is None else params
-        updates, self.state = self.transform.update(grads, self.state, params)
+        tx = current_transform(self)
+        updates, self.state = tx.update(grads, self.state, params)
         self.params = optax.apply_updates(params, updates)
         return self.params

@@ -15,6 +15,7 @@ import jax.numpy as jnp
 import optax
 
 from ..kernels.multi_tensor import fused_sgd_step
+from ._surface import current_transform, group_property, install_torch_surface
 from .fused_adam import ScalarOrSchedule, _flat32, _lr_at, _unflatten_like
 
 
@@ -56,16 +57,30 @@ def fused_sgd(learning_rate: ScalarOrSchedule, momentum: float = 0.0,
 class FusedSGD:
     """apex-shaped stateful wrapper (apex/optimizers/fused_sgd.py)."""
 
+    lr = group_property("lr")
+    weight_decay = group_property("weight_decay")
+
     def __init__(self, params, lr, momentum=0.0, dampening=0.0,
                  weight_decay=0.0, nesterov=False, wd_after_momentum=False,
                  materialize_master_grads=True, set_grad_none=False):
+
+        def factory(lr, momentum, dampening, weight_decay, nesterov,
+                    wd_after_momentum):
+            return fused_sgd(lr, momentum, dampening, weight_decay,
+                             nesterov, wd_after_momentum)
+
         self.transform = fused_sgd(lr, momentum, dampening, weight_decay,
                                    nesterov, wd_after_momentum)
         self.state = self.transform.init(params)
         self.params = params
+        install_torch_surface(self, params, factory, dict(
+            lr=lr, momentum=momentum, dampening=dampening,
+            weight_decay=weight_decay, nesterov=nesterov,
+            wd_after_momentum=wd_after_momentum))
 
     def step(self, grads, params=None):
         params = self.params if params is None else params
-        updates, self.state = self.transform.update(grads, self.state, params)
+        tx = current_transform(self)
+        updates, self.state = tx.update(grads, self.state, params)
         self.params = optax.apply_updates(params, updates)
         return self.params

@@ -110,4 +110,20 @@ class LARC:
         tx = larc_transform(lr if lr is not None else 1.0,
                             self.trust_coefficient, self.clip, self.eps, wd)
         scaled, _ = tx.update(grads, tx.init(params), params)
-        return self.optim.step(scaled, params)
+        # Apex idiom (LARC.py — step): weight decay is folded into the
+        # trust-scaled gradient above, so the INNER step must run with the
+        # group's weight_decay zeroed (else decay applies twice, unscaled),
+        # restored afterwards. param_groups is live — the fused classes
+        # rebuild their transform from it (optimizers/_surface.py).
+        groups = getattr(self.optim, "param_groups", None)
+        saved = None
+        if groups:
+            saved = [g.get("weight_decay", 0.0) for g in groups]
+            for g in groups:
+                g["weight_decay"] = 0.0
+        try:
+            return self.optim.step(scaled, params)
+        finally:
+            if groups:
+                for g, w in zip(groups, saved):
+                    g["weight_decay"] = w

@@ -29,6 +29,25 @@ if "--cpp_ext" in sys.argv:
             extra_compile_args=["-O3"],
         ))
 
+# The reference's per-feature build flags (setup.py — "--cuda_ext",
+# "--xentropy", ...) select which CUDA extensions compile. Their TPU
+# equivalents are Pallas/XLA and need no build step, so reference install
+# command lines are accepted verbatim: each flag is consumed (so setuptools
+# doesn't choke) and noted as always-on.
+_REFERENCE_FEATURE_FLAGS = [
+    "--cuda_ext", "--xentropy", "--fast_multihead_attn", "--fast_layer_norm",
+    "--bnp", "--fmha", "--transducer", "--peer_memory", "--nccl_p2p",
+    "--fast_bottleneck", "--focal_loss", "--index_mul_2d",
+    "--deprecated_fused_adam", "--deprecated_fused_lamb",
+    "--permutation_search", "--group_norm", "--cudnn_gbn",
+    "--nccl_allocator", "--gpu_direct_storage",
+]
+for _flag in _REFERENCE_FEATURE_FLAGS:
+    if _flag in sys.argv:
+        sys.argv.remove(_flag)
+        print(f"apex_tpu setup: {_flag} accepted — this feature is "
+              "always available (Pallas/XLA, no native build required)")
+
 setup(
     name="apex_tpu",
     version="0.1.0",

@@ -5,11 +5,17 @@ Oracle: a direct loop transcription of the reference algorithm
 (apex/transformer/pipeline_parallel/utils.py — for each EOD at i:
 attention_mask[(i+1):, :(i+1)] = 0; position_ids[(i+1):] -= delta)."""
 
-import numpy as np
+import functools
+
+import jax
 import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
 
 from apex_tpu.transformer.pipeline_parallel import (
-    build_model, get_ltor_masks_and_position_ids, listify_model)
+    build_model, get_ltor_masks_and_position_ids, listify_model,
+    pipeline_apply)
 
 
 def _oracle(data, eod, reset_pos, reset_attn, mask_loss):
@@ -76,11 +82,6 @@ def test_build_model_flags_and_order():
     pp, v = 4, 2
     chunks = build_model(provider, num_stages=pp, num_chunks=v, width=8)
     assert len(chunks) == pp * v
-    # rank-major layout: entry rank*v + chunk holds logical stage chunk*pp +
-    # rank, so contiguous P('pipe') sharding gives rank r stages {c*pp + r}
-    for rank in range(pp):
-        for chunk in range(v):
-            assert chunks[rank * v + chunk]["idx"] == rank * v + chunk
     pre = [c["pre"] for c in chunks]
     post = [c["post"] for c in chunks]
     # pre_process only at logical stage 0 = (rank 0, chunk 0) = entry 0;
@@ -88,6 +89,48 @@ def test_build_model_flags_and_order():
     assert pre == [True] + [False] * (pp * v - 1)
     assert post == [False] * (pp * v - 1) + [True]
     assert chunks[0]["w"].shape == (8,)
+
+
+def test_build_model_order_composes_correctly(eight_devices):
+    """The real property build_model claims: stacking its list and sharding
+    P('pipe') runs the interleaved pipeline in LOGICAL stage order
+    s = chunk*pp + rank. Each provider call returns a distinct affine stage
+    (call i applies x*2 + i); the pipelined output must equal composing the
+    stages in s-order with the documented i(s) = rank*v + chunk mapping — a
+    chunk-major build_model regression composes in the wrong order and
+    fails."""
+    pp, v = 4, 2
+    calls = {"i": 0}
+
+    def provider(pre_process, post_process):
+        i = calls["i"]
+        calls["i"] += 1
+        return {"a": jnp.asarray(2.0), "b": jnp.asarray(float(i))}
+
+    chunk_list = build_model(provider, num_stages=pp, num_chunks=v)
+    stacked = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *chunk_list)
+
+    def stage_fn(c, x):
+        return c["a"] * x + c["b"]
+
+    mesh = Mesh(np.array(eight_devices[:pp]), ("pipe",))
+    run = jax.jit(shard_map(
+        functools.partial(pipeline_apply, stage_fn, num_stages=pp,
+                          num_chunks=v, broadcast=True),
+        mesh=mesh, in_specs=(P("pipe"), P()), out_specs=P(),
+        check_vma=False))
+    x0 = jnp.full((3, 1), 1.0)
+    out = np.asarray(run(stacked, x0))
+
+    # sequential oracle: apply stages in logical order s, where stage s was
+    # produced by provider call i = rank*v + chunk with s = chunk*pp + rank
+    y = np.full((1,), 1.0)
+    for s in range(pp * v):
+        chunk, rank = divmod(s, pp)
+        i = rank * v + chunk
+        y = 2.0 * y + float(i)
+    np.testing.assert_allclose(out[0], y, rtol=1e-6)
 
     m = {"x": 1}
     assert listify_model(m) == [m]
