@@ -16,7 +16,10 @@ torch do it).
 
 Writes are atomic (tmp file + rename) so a preempted save never corrupts the
 previous checkpoint — the property orbax's async checkpointing provides on
-real pods; use orbax directly for multi-host sharded state.
+real pods. For multi-process SHARDED state (each host writing only its own
+shards, restore under a different topology), use the sibling
+:mod:`apex_tpu.utils.sharded_checkpoint` (``save_sharded``/``load_sharded``);
+this module is the single-controller whole-tree path.
 """
 
 from __future__ import annotations

@@ -1,0 +1,105 @@
+"""Property-based fuzzing of the Pallas kernel tier (interpret/CPU paths).
+
+The reference's L0 tests fix a handful of shapes; these close the gap on
+odd shapes, extreme values, and dtype combos. Oracles are pure jnp fp32
+compositions (SURVEY §5.1: reference-implementation oracles, never golden
+files)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+from apex_tpu.kernels.layer_norm import (layer_norm, layer_norm_reference,
+                                         rms_norm, rms_norm_reference)
+from apex_tpu.kernels.multi_tensor import (fused_axpby, fused_l2norm,
+                                           fused_scale)
+
+_SETTINGS = dict(max_examples=25, deadline=None)
+
+
+@st.composite
+def flat_arrays(draw, max_len=4096):
+    n = draw(st.integers(1, max_len))
+    seed = draw(st.integers(0, 2**31 - 1))
+    scale = draw(st.sampled_from([1e-3, 1.0, 1e3]))
+    rng = np.random.RandomState(seed)
+    return jnp.asarray(rng.randn(n) * scale, jnp.float32)
+
+
+@given(flat_arrays(), st.floats(-4.0, 4.0))
+@settings(**_SETTINGS)
+def test_fused_scale_matches_numpy(x, s):
+    out, flag = fused_scale(x, jnp.asarray(s, jnp.float32))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x) * np.float32(s),
+                               rtol=1e-6, atol=1e-6)
+    assert int(flag) == 0
+
+
+@given(flat_arrays(max_len=2048), st.floats(-2.0, 2.0), st.floats(-2.0, 2.0))
+@settings(**_SETTINGS)
+def test_fused_axpby_matches_numpy(x, a, b):
+    y = x[::-1].copy()
+    out, flag = fused_axpby(x, y, jnp.asarray(a, jnp.float32),
+                            jnp.asarray(b, jnp.float32))
+    ref = np.float32(a) * np.asarray(x) + np.float32(b) * np.asarray(y)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-5)
+    assert int(flag) == 0
+
+
+@given(flat_arrays(max_len=2048))
+@settings(**_SETTINGS)
+def test_fused_l2norm_matches_numpy(x):
+    out = fused_l2norm(x)
+    ref = np.linalg.norm(np.asarray(x, np.float64))
+    np.testing.assert_allclose(float(out), ref, rtol=1e-4, atol=1e-6)
+
+
+@given(flat_arrays(max_len=512))
+@settings(**_SETTINGS)
+def test_fused_scale_flags_nonfinite(x):
+    """Any inf/nan anywhere in the buffer must raise the found_inf flag
+    (amp_C overflow-check semantics)."""
+    bad = x.at[len(x) // 2].set(jnp.inf)
+    _, flag = fused_scale(bad, jnp.asarray(1.0, jnp.float32))
+    assert int(flag) == 1
+    bad = x.at[0].set(jnp.nan)
+    _, flag = fused_scale(bad, jnp.asarray(1.0, jnp.float32))
+    assert int(flag) == 1
+
+
+@st.composite
+def ln_inputs(draw):
+    rows = draw(st.integers(1, 12))
+    hidden = draw(st.sampled_from([1, 7, 64, 128, 513, 1024]))
+    seed = draw(st.integers(0, 2**31 - 1))
+    rng = np.random.RandomState(seed)
+    x = rng.randn(rows, hidden).astype(np.float32)
+    w = (1.0 + 0.1 * rng.randn(hidden)).astype(np.float32)
+    b = (0.1 * rng.randn(hidden)).astype(np.float32)
+    return jnp.asarray(x), jnp.asarray(w), jnp.asarray(b)
+
+
+@given(ln_inputs())
+@settings(**_SETTINGS)
+def test_layer_norm_fuzz(args):
+    x, w, b = args
+    out = layer_norm(x, w, b)
+    ref = layer_norm_reference(x, w, b)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    # grads stay finite and match the autodiff of the reference
+    g1 = jax.grad(lambda x: layer_norm(x, w, b).sum())(x)
+    g2 = jax.grad(lambda x: layer_norm_reference(x, w, b).sum())(x)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                               rtol=1e-3, atol=1e-4)
+
+
+@given(ln_inputs())
+@settings(**_SETTINGS)
+def test_rms_norm_fuzz(args):
+    x, w, _ = args
+    out = rms_norm(x, w)
+    ref = rms_norm_reference(x, w)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
