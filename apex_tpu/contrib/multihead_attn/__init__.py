@@ -40,15 +40,21 @@ def _merge_heads(x):
 
 
 def _attend(module, qh, kh, vh, *, causal, scale, key_padding_mask,
-            dropout, is_training):
+            dropout, is_training, attn_mask=None):
     """Fused path when possible; explicit-probs path when the reference
     semantics need the softmax matrix (prob dropout — the reference's fused
-    softmax+dropout kernel — or a padding mask)."""
+    softmax+dropout kernel — or a padding mask). ``attn_mask`` is the
+    ADDITIVE float mask of the reference's *_additive_mask_* variants
+    ([b|1, h|1, sq, sk], added to the scaled logits) and rides the flash
+    kernel's bias path."""
     use_dropout = dropout > 0.0 and is_training
     if key_padding_mask is None and not use_dropout:
-        return flash_attention(qh, kh, vh, causal=causal, scale=scale)
+        return flash_attention(qh, kh, vh, causal=causal, scale=scale,
+                               bias=attn_mask)
     s = jnp.einsum("bhqd,bhkd->bhqk", jnp.asarray(qh, jnp.float32),
                    jnp.asarray(kh, jnp.float32)) * scale
+    if attn_mask is not None:
+        s = s + jnp.asarray(attn_mask, jnp.float32)
     if causal:
         sq, sk = s.shape[-2], s.shape[-1]
         s = jnp.where(jnp.tril(jnp.ones((sq, sk), bool)), s, -1e30)
@@ -84,6 +90,7 @@ class SelfMultiheadAttn(nn.Module):
     def __call__(self, query, key=None, value=None, *,
                  mask_future_timesteps: bool = False,
                  key_padding_mask: Optional[jnp.ndarray] = None,
+                 attn_mask: Optional[jnp.ndarray] = None,
                  is_training: bool = True):
         x = jnp.asarray(query, self.dtype)
         residual = x
@@ -101,7 +108,8 @@ class SelfMultiheadAttn(nn.Module):
         scale = 1.0 / (self.embed_dim // self.num_heads) ** 0.5
         out = _attend(self, qh, kh, vh, causal=mask_future_timesteps,
                       scale=scale, key_padding_mask=key_padding_mask,
-                      dropout=self.dropout, is_training=is_training)
+                      dropout=self.dropout, is_training=is_training,
+                      attn_mask=attn_mask)
         y = _merge_heads(out)
         y = nn.Dense(self.embed_dim, use_bias=self.use_bias,
                      dtype=self.dtype, param_dtype=self.param_dtype,
@@ -137,6 +145,7 @@ class EncdecMultiheadAttn(nn.Module):
     @nn.compact
     def __call__(self, query, key, value=None, *,
                  key_padding_mask: Optional[jnp.ndarray] = None,
+                 attn_mask: Optional[jnp.ndarray] = None,
                  is_training: bool = True):
         q_in = jnp.asarray(query, self.dtype)
         kv_in = jnp.asarray(key, self.dtype)
@@ -155,7 +164,8 @@ class EncdecMultiheadAttn(nn.Module):
         scale = 1.0 / (self.embed_dim // self.num_heads) ** 0.5
         out = _attend(self, qh, kh, vh, causal=False, scale=scale,
                       key_padding_mask=key_padding_mask,
-                      dropout=self.dropout, is_training=is_training)
+                      dropout=self.dropout, is_training=is_training,
+                      attn_mask=attn_mask)
         y = _merge_heads(out)
         y = nn.Dense(self.embed_dim, use_bias=self.use_bias,
                      dtype=self.dtype, param_dtype=self.param_dtype,

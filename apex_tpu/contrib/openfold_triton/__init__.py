@@ -4,14 +4,11 @@ Reference: apex/contrib/openfold_triton/ — Triton kernels used by the
 OpenFold (AlphaFold2) MLPerf submission: fused LayerNorm variants and a
 fused multi-head attention for the evoformer's gated attention
 (SURVEY P37 [vintage?]). TPU mapping: LayerNorm binds to the Pallas kernel
-(kernels/layer_norm.py); the evoformer attention is plain fused-by-XLA
-attention — it materializes the [..., heads, q, k] logits in fp32, which is
-the right call at evoformer sequence lengths (hundreds of residues); for
-long-sequence attention use kernels/flash_attention.py, which is blockwise
-but has no pair-bias input.
-
-``AttnBiasJIT``-style evoformer attention takes a pair bias term added to
-the logits pre-softmax and a sigmoid gate on the output.
+(kernels/layer_norm.py); the evoformer attention rides the Pallas flash
+kernel's additive-bias path (kernels/flash_attention.py — ``bias=``) at
+block-aligned shapes, falling back to the fp32 jnp reference otherwise —
+either way the pair bias is added to the scaled logits pre-softmax and the
+sigmoid gate multiplies the output, per the evoformer block.
 """
 
 from __future__ import annotations
@@ -21,6 +18,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.kernels.flash_attention import flash_attention
 from apex_tpu.kernels.layer_norm import layer_norm
 
 __all__ = ["LayerNormSmallShapeOptImpl", "layer_norm_small",
@@ -40,17 +38,41 @@ def evoformer_attention(q, k, v, bias: Optional[jnp.ndarray] = None,
                         gate: Optional[jnp.ndarray] = None,
                         scale: Optional[float] = None):
     """Gated, pair-biased MHA (reference: openfold_triton MHA). q/k/v are
-    [..., heads, seq, head_dim]; ``bias`` broadcasts onto the [..., heads,
-    q_len, k_len] logits; ``gate`` (same shape as the output) is passed
-    through a sigmoid and multiplied in, per the evoformer block."""
+    [..., heads, seq, head_dim] — OpenFold's evoformer passes 5D tensors
+    like [batch, n_seq, heads, n_res, c], so arbitrary leading dims are
+    collapsed into the kernel's batch; ``bias`` broadcasts onto the
+    [..., heads, q_len, k_len] logits; ``gate`` (same shape as the output)
+    is passed through a sigmoid and multiplied in, per the evoformer block.
+    Rides the blockwise flash kernel (bias path) when shapes are
+    block-aligned."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    logits = jnp.einsum("...qd,...kd->...qk", q, k,
-                        preferred_element_type=jnp.float32) * scale
+    *lead, h, s, d = q.shape
+    sk = k.shape[-2]
+    batch = 1
+    for n in lead:
+        batch *= n
+    q4 = q.reshape(batch, h, s, d)
+    k4 = k.reshape(batch, h, sk, d)
+    v4 = v.reshape(batch, h, sk, v.shape[-1])
+    bias4 = None
     if bias is not None:
-        logits = logits + jnp.asarray(bias, logits.dtype)
-    probs = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("...qk,...kd->...qd", probs.astype(v.dtype), v)
+        # normalize to rank len(lead)+3; leading dims must be 1 or match —
+        # all-1 stays size-1 (kernel broadcasts over batch, no copy), a full
+        # match collapses, anything mixed is materialized by broadcast
+        want = tuple(lead) + (bias.shape[-3],) + (s, sk)
+        bias = jnp.reshape(bias, (1,) * (len(want) - bias.ndim) + bias.shape)
+        blead = bias.shape[:-3]
+        if all(n == 1 for n in blead):
+            bias4 = bias.reshape(1, *bias.shape[-3:])
+        elif blead == tuple(lead):
+            bias4 = bias.reshape(batch, *bias.shape[-3:])
+        else:
+            bias4 = jnp.broadcast_to(
+                bias, tuple(lead) + bias.shape[-3:]).reshape(
+                    batch, *bias.shape[-3:])
+    out = flash_attention(q4, k4, v4, scale=scale, bias=bias4)
+    out = out.reshape(*lead, h, s, v.shape[-1])
     if gate is not None:
         out = out * jax.nn.sigmoid(jnp.asarray(gate, out.dtype))
     return out

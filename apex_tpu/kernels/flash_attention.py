@@ -41,12 +41,16 @@ DEFAULT_BLOCK_K = 128
 # --------------------------------------------------------------- jnp reference
 def mha_reference(q, k, v, *, causal: bool = False, scale: float = 1.0,
                   segment_ids: Optional[jnp.ndarray] = None,
-                  mask: Optional[jnp.ndarray] = None):
+                  mask: Optional[jnp.ndarray] = None,
+                  bias: Optional[jnp.ndarray] = None):
     """fp32-math reference (the oracle the reference's tests use a torch
-    softmax composition for)."""
+    softmax composition for). ``bias`` is ADDITIVE on the scaled logits
+    (apex's additive-mask MHA variants), broadcastable to [b, h, sq, sk]."""
     out_dtype = q.dtype
     q32, k32, v32 = (jnp.asarray(t, jnp.float32) for t in (q, k, v))
     s = jnp.einsum("bhqd,bhkd->bhqk", q32, k32) * scale
+    if bias is not None:
+        s = s + jnp.asarray(bias, jnp.float32)
     sq, sk = s.shape[-2], s.shape[-1]
     if causal:
         s = jnp.where(jnp.tril(jnp.ones((sq, sk), bool)), s, _NEG_INF)
@@ -61,9 +65,9 @@ def mha_reference(q, k, v, *, causal: bool = False, scale: float = 1.0,
 
 
 # -------------------------------------------------------------- forward kernel
-def _fwd_kernel(q_ref, k_ref, v_ref, segq_ref, segk_ref, o_ref, lse_ref,
-                acc_ref, m_ref, l_ref, *, scale, causal, block_q, block_k,
-                have_segs):
+def _fwd_kernel(q_ref, k_ref, v_ref, segq_ref, segk_ref, bias_ref, o_ref,
+                lse_ref, acc_ref, m_ref, l_ref, *, scale, causal, block_q,
+                block_k, have_segs, have_bias):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -86,6 +90,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, segq_ref, segk_ref, o_ref, lse_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        if have_bias:
+            s = s + bias_ref[0].astype(jnp.float32)
 
         if causal:
             rows = qi * block_q + jax.lax.broadcasted_iota(
@@ -121,8 +127,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, segq_ref, segk_ref, o_ref, lse_ref,
 
 # ------------------------------------------------------------- backward kernels
 def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                     segq_ref, segk_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
-                     scale, causal, block_q, block_k, have_segs):
+                     segq_ref, segk_ref, bias_ref, dk_ref, dv_ref, dk_acc,
+                     dv_acc, *, scale, causal, block_q, block_k, have_segs,
+                     have_bias):
     ki = pl.program_id(1)
     qi = pl.program_id(2)
     nq = pl.num_programs(2)
@@ -144,6 +151,8 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        if have_bias:
+            s = s + bias_ref[0].astype(jnp.float32)
         if causal:
             rows = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -174,8 +183,14 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   segq_ref, segk_ref, dq_ref, dq_acc, *, scale, causal,
-                   block_q, block_k, have_segs):
+                   segq_ref, segk_ref, bias_ref, dq_ref, *rest, scale,
+                   causal, block_q, block_k, have_segs, have_bias,
+                   emit_dlog):
+    # rest = (dlog_ref, dq_acc) when emit_dlog else (dq_acc,)
+    if emit_dlog:
+        dlog_ref, dq_acc = rest
+    else:
+        (dq_acc,) = rest
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -188,6 +203,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     if causal:
         run = (qi * block_q + block_q - 1) >= (ki * block_k)
 
+    if emit_dlog and causal:
+        # each (qi, ki) grid step owns its dlog block; skipped blocks must
+        # still be defined
+        @pl.when(jnp.logical_not(run))
+        def _zero_dlog():
+            dlog_ref[0] = jnp.zeros_like(dlog_ref[0])
+
     @pl.when(run)
     def _body():
         q = q_ref[0].astype(jnp.float32)
@@ -196,6 +218,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        if have_bias:
+            s = s + bias_ref[0].astype(jnp.float32)
         if causal:
             rows = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -211,7 +235,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q)]
-        ds = p * (dp - delta[:, None]) * scale
+        dlogits = p * (dp - delta[:, None])       # d loss / d (scaled+bias)
+        if emit_dlog:
+            dlog_ref[0] = dlogits.astype(dlog_ref.dtype)
+        ds = dlogits * scale
         dq_acc[:] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -219,6 +246,53 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     @pl.when(ki == nk - 1)
     def _finish():
         dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _dbias_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                  segq_ref, segk_ref, bias_ref, dbias_ref, *, scale, causal,
+                  block_q, block_k, have_segs, n_inner):
+    """Reduced bias cotangent for BROADCAST bias classes: grid is
+    (B*, nq, nk, R) with the broadcast-reduced dim R innermost, so the
+    (class, i, j) output block stays resident in VMEM across the R steps
+    and dlogits accumulates in place — HBM only ever sees the final
+    [B*, sq, sk], never the [b*h, sq, sk] intermediate."""
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    r = pl.program_id(3)
+
+    @pl.when(r == 0)
+    def _init():
+        dbias_ref[0] = jnp.zeros_like(dbias_ref[0])
+
+    run = True
+    if causal:
+        run = (qi * block_q + block_q - 1) >= (ki * block_k)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        s = s + bias_ref[0].astype(jnp.float32)
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        if have_segs:
+            segq = segq_ref[0, 0, pl.ds(qi * block_q, block_q)]
+            segk = segk_ref[0, 0, pl.ds(ki * block_k, block_k)]
+            s = jnp.where(segq[:, None] == segk[None, :], s, _NEG_INF)
+        lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q)]
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q)]
+        dbias_ref[0] += (p * (dp - delta[:, None])).astype(dbias_ref.dtype)
 
 
 # ------------------------------------------------------------------- dispatch
@@ -280,7 +354,44 @@ def _pallas_ok(sq, sk, d, bq, bk):
             and bq % 8 == 0 and bk % 128 == 0)
 
 
-def _fwd_pallas(q3, k3, v3, segq, segk, scale, causal, bq, bk, interpret):
+def _validate_bias(bias, b, h, sq, sk):
+    """Shared bias validation for BOTH dispatch paths (Pallas and the jnp
+    fallback must agree on what is accepted, or a model validated at
+    unaligned shapes would crash once shapes become block-aligned)."""
+    if bias is None:
+        return
+    if getattr(bias, "ndim", None) != 4 or bias.shape[2:] != (sq, sk) \
+            or bias.shape[0] not in (1, b) or bias.shape[1] not in (1, h):
+        raise ValueError(
+            f"flash_attention: bias shape {getattr(bias, 'shape', None)} "
+            f"not broadcastable to {(b, h, sq, sk)} (rank 4; leading dims "
+            "may be 1; the [sq, sk] plane must be full)")
+
+
+def _canon_bias(bias, bh, h, sq, sk):
+    """Canonicalize an additive logits bias broadcastable to [b, h, sq, sk]
+    into (bias3 [B*, sq, sk], index fn flat-bh-index → B*-index, have_bias,
+    broadcast class).
+
+    Only the leading two dims may broadcast (the [sq, sk] plane is always
+    full — a [*, 1, sk] padding mask should be broadcast by the caller,
+    which costs sq× memory but keeps the kernel's block map static)."""
+    if bias is None:
+        return None, (lambda b: 0), False, "none"
+    b = bh // h
+    _validate_bias(bias, b, h, sq, sk)
+    bb, bhh = bias.shape[0], bias.shape[1]
+    if bb == 1 and bhh == 1:
+        return bias.reshape(1, sq, sk), (lambda i: 0), True, "one"
+    if bb == 1:
+        return bias.reshape(h, sq, sk), (lambda i: i % h), True, "head"
+    if bhh == 1:
+        return bias.reshape(b, sq, sk), (lambda i: i // h), True, "batch"
+    return bias.reshape(bh, sq, sk), (lambda i: i), True, "full"
+
+
+def _fwd_pallas(q3, k3, v3, segq, segk, scale, causal, bq, bk, interpret,
+                bias=None, h=None):
     bh, sq, d = q3.shape
     sk = k3.shape[1]
     have_segs = segq is not None
@@ -289,9 +400,17 @@ def _fwd_pallas(q3, k3, v3, segq, segk, scale, causal, bq, bk, interpret):
         segk = _match_vma(jnp.zeros((bh, sk), jnp.int32), q3)
     segq = segq.reshape(bh, 1, sq)
     segk = segk.reshape(bh, 1, sk)
+    bias3, bmap, have_bias, _ = _canon_bias(bias, bh, h or 1, sq, sk)
+    if not have_bias:
+        bias3 = _match_vma(jnp.zeros((1, bq, bk), jnp.float32), q3)
+        bias_spec = pl.BlockSpec((1, bq, bk), lambda b, i, j: (0, 0, 0))
+    else:
+        bias_spec = pl.BlockSpec((1, bq, bk),
+                                 lambda b, i, j: (bmap(b), i, j))
     grid = (bh, sq // bq, sk // bk)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               block_q=bq, block_k=bk, have_segs=have_segs)
+                               block_q=bq, block_k=bk, have_segs=have_segs,
+                               have_bias=have_bias)
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -301,6 +420,7 @@ def _fwd_pallas(q3, k3, v3, segq, segk, scale, causal, bq, bk, interpret):
             pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, 1, sq), lambda b, i, j: (b, 0, 0)),
             pl.BlockSpec((1, 1, sk), lambda b, i, j: (b, 0, 0)),
+            bias_spec,
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
@@ -316,17 +436,21 @@ def _fwd_pallas(q3, k3, v3, segq, segk, scale, causal, bq, bk, interpret):
             pltpu.VMEM((bq, 128), jnp.float32),
         ],
         interpret=interpret,
-    )(q3, k3, v3, segq, segk)
+    )(q3, k3, v3, segq, segk, bias3)
     return o, lse
 
 
 def _bwd_pallas(q3, k3, v3, do3, lse, delta, segq, segk, scale, causal, bq, bk,
-                interpret, out_dtype=None):
+                interpret, out_dtype=None, bias=None, h=None):
     """delta: [bh, 1, sq] fp32 = sum(do * o, -1); lse: [bh, 1, sq] fp32.
 
     ``out_dtype`` overrides the gradient dtypes (default: match inputs);
     ring attention passes fp32 so cross-chunk accumulation stays exact while
     the kernels still stream bf16 inputs (they upcast per-tile internally).
+
+    With ``bias``, additionally returns dlogits [bh, sq, sk] fp32 (the bias
+    cotangent before broadcast-reduction) — an O(s²) buffer, same footprint
+    the unfused backward pays; bias-free calls allocate nothing extra.
     """
     bh, sq, d = q3.shape
     sk = k3.shape[1]
@@ -336,10 +460,25 @@ def _bwd_pallas(q3, k3, v3, do3, lse, delta, segq, segk, scale, causal, bq, bk,
         segk = _match_vma(jnp.zeros((bh, sk), jnp.int32), q3)
     segq = segq.reshape(bh, 1, sq)
     segk = segk.reshape(bh, 1, sk)
+    bias3, bmap, have_bias, bclass = _canon_bias(bias, bh, h or 1, sq, sk)
+    if not have_bias:
+        bias3 = _match_vma(jnp.zeros((1, bq, bk), jnp.float32), q3)
+        bias_spec_ji = pl.BlockSpec((1, bq, bk), lambda b, j, i: (0, 0, 0))
+        bias_spec_ij = pl.BlockSpec((1, bq, bk), lambda b, i, j: (0, 0, 0))
+    else:
+        bias_spec_ji = pl.BlockSpec((1, bq, bk),
+                                    lambda b, j, i: (bmap(b), i, j))
+        bias_spec_ij = pl.BlockSpec((1, bq, bk),
+                                    lambda b, i, j: (bmap(b), i, j))
+    # full-rank bias: dlogits IS dbias, emit it straight from the dq kernel;
+    # broadcast classes: a separate reduced pass (below) so HBM never holds
+    # the [bh, sq, sk] intermediate
+    emit_dlog = have_bias and bclass == "full"
 
     dkdv = pl.pallas_call(
         functools.partial(_bwd_dkdv_kernel, scale=scale, causal=causal,
-                          block_q=bq, block_k=bk, have_segs=have_segs),
+                          block_q=bq, block_k=bk, have_segs=have_segs,
+                          have_bias=have_bias),
         grid=(bh, sk // bk, sq // bq),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),   # q
@@ -350,6 +489,7 @@ def _bwd_pallas(q3, k3, v3, do3, lse, delta, segq, segk, scale, causal, bq, bk,
             pl.BlockSpec((1, 1, sq), lambda b, j, i: (b, 0, 0)),   # delta
             pl.BlockSpec((1, 1, sq), lambda b, j, i: (b, 0, 0)),   # segq
             pl.BlockSpec((1, 1, sk), lambda b, j, i: (b, 0, 0)),   # segk
+            bias_spec_ji,
         ],
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
@@ -364,11 +504,18 @@ def _bwd_pallas(q3, k3, v3, do3, lse, delta, segq, segk, scale, causal, bq, bk,
             pltpu.VMEM((bk, d), jnp.float32),
         ],
         interpret=interpret,
-    )(q3, k3, v3, do3, lse, delta, segq, segk)
+    )(q3, k3, v3, do3, lse, delta, segq, segk, bias3)
 
-    dq = pl.pallas_call(
+    dq_out_specs = [pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0))]
+    dq_out_shape = [_sds((bh, sq, d), out_dtype or q3.dtype, q3)]
+    if emit_dlog:
+        dq_out_specs.append(
+            pl.BlockSpec((1, bq, bk), lambda b, i, j: (b, i, j)))
+        dq_out_shape.append(_sds((bh, sq, sk), jnp.float32, q3))
+    dq_res = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_q=bq, block_k=bk, have_segs=have_segs),
+                          block_q=bq, block_k=bk, have_segs=have_segs,
+                          have_bias=have_bias, emit_dlog=emit_dlog),
         grid=(bh, sq // bq, sk // bk),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),   # q
@@ -379,14 +526,63 @@ def _bwd_pallas(q3, k3, v3, do3, lse, delta, segq, segk, scale, causal, bq, bk,
             pl.BlockSpec((1, 1, sq), lambda b, i, j: (b, 0, 0)),   # delta
             pl.BlockSpec((1, 1, sq), lambda b, i, j: (b, 0, 0)),   # segq
             pl.BlockSpec((1, 1, sk), lambda b, i, j: (b, 0, 0)),   # segk
+            bias_spec_ij,
         ],
-        out_specs=[pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0))],
-        out_shape=[_sds((bh, sq, d), out_dtype or q3.dtype, q3)],
+        out_specs=dq_out_specs,
+        out_shape=dq_out_shape,
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
-    )(q3, k3, v3, do3, lse, delta, segq, segk)[0]
+    )(q3, k3, v3, do3, lse, delta, segq, segk, bias3)
+    dq = dq_res[0]
+    dlog = dq_res[1] if emit_dlog else None
 
-    return dq, dkdv[0], dkdv[1]
+    if have_bias and not emit_dlog:
+        # broadcast classes: one extra recompute pass whose output is the
+        # REDUCED cotangent [B*, sq, sk] — bh/B* × less HBM than emitting
+        # full dlogits and summing outside
+        h_ = h or 1
+        b_ = bh // h_
+        if bclass == "one":
+            B, R = 1, bh
+            bexpr = lambda c, r: r                            # noqa: E731
+        elif bclass == "head":
+            B, R = h_, b_
+            bexpr = lambda c, r: r * h_ + c                   # noqa: E731
+        else:                                                 # "batch"
+            B, R = b_, h_
+            bexpr = lambda c, r: c * h_ + r                   # noqa: E731
+        dlog = pl.pallas_call(
+            functools.partial(_dbias_kernel, scale=scale, causal=causal,
+                              block_q=bq, block_k=bk, have_segs=have_segs,
+                              n_inner=R),
+            grid=(B, sq // bq, sk // bk, R),
+            in_specs=[
+                pl.BlockSpec((1, bq, d),
+                             lambda c, i, j, r: (bexpr(c, r), i, 0)),  # q
+                pl.BlockSpec((1, bk, d),
+                             lambda c, i, j, r: (bexpr(c, r), j, 0)),  # k
+                pl.BlockSpec((1, bk, d),
+                             lambda c, i, j, r: (bexpr(c, r), j, 0)),  # v
+                pl.BlockSpec((1, bq, d),
+                             lambda c, i, j, r: (bexpr(c, r), i, 0)),  # do
+                pl.BlockSpec((1, 1, sq),
+                             lambda c, i, j, r: (bexpr(c, r), 0, 0)),  # lse
+                pl.BlockSpec((1, 1, sq),
+                             lambda c, i, j, r: (bexpr(c, r), 0, 0)),  # delta
+                pl.BlockSpec((1, 1, sq),
+                             lambda c, i, j, r: (bexpr(c, r), 0, 0)),  # segq
+                pl.BlockSpec((1, 1, sk),
+                             lambda c, i, j, r: (bexpr(c, r), 0, 0)),  # segk
+                pl.BlockSpec((1, bq, bk),
+                             lambda c, i, j, r: (c, i, j)),            # bias
+            ],
+            out_specs=[pl.BlockSpec((1, bq, bk),
+                                    lambda c, i, j, r: (c, i, j))],
+            out_shape=[_sds((B, sq, sk), jnp.float32, q3)],
+            interpret=interpret,
+        )(q3, k3, v3, do3, lse, delta, segq, segk, bias3)[0]
+
+    return dq, dkdv[0], dkdv[1], dlog
 
 
 # ------------------------------------------------- chunk API (ring attention)
@@ -460,21 +656,22 @@ def attn_chunk_bwd(q3, k3, v3, do3, lse, delta, *, scale, causal,
     # forced fp32 for exact cross-chunk accumulation in the ring.
     bh = q3.shape[0]
     lse3 = lse.reshape(bh, 1, sq)
-    dq, dk, dv = _bwd_pallas(q3, k3, v3, do3, lse3,
-                             delta.reshape(bh, 1, sq), None, None,
-                             scale, causal, bq, bk, interpret,
-                             out_dtype=jnp.float32)
+    dq, dk, dv, _ = _bwd_pallas(q3, k3, v3, do3, lse3,
+                                delta.reshape(bh, 1, sq), None, None,
+                                scale, causal, bq, bk, interpret,
+                                out_dtype=jnp.float32)
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash(q, k, v, segment_ids, causal, scale, block_q, block_k, interpret):
-    out, _ = _flash_fwd(q, k, v, segment_ids, causal, scale, block_q,
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash(q, k, v, bias, segment_ids, causal, scale, block_q, block_k,
+           interpret):
+    out, _ = _flash_fwd(q, k, v, bias, segment_ids, causal, scale, block_q,
                         block_k, interpret)
     return out
 
 
-def _flash_fwd(q, k, v, segment_ids, causal, scale, block_q, block_k,
+def _flash_fwd(q, k, v, bias, segment_ids, causal, scale, block_q, block_k,
                interpret):
     b, h, sq, d = q.shape
     q3, k3, v3 = _flatten(q), _flatten(k), _flatten(v)
@@ -483,26 +680,32 @@ def _flash_fwd(q, k, v, segment_ids, causal, scale, block_q, block_k,
         segq = _seg_flat(segment_ids, h)
         segk = segq
     o3, lse = _fwd_pallas(q3, k3, v3, segq, segk, scale, causal, block_q,
-                          block_k, interpret)
+                          block_k, interpret, bias=bias, h=h)
     out = o3.reshape(b, h, sq, d)
-    return out, (q3, k3, v3, o3, lse, segq, segk, b, h)
+    return out, (q3, k3, v3, o3, lse, segq, segk, bias, b, h)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q3, k3, v3, o3, lse, segq, segk, b, h = res
+    q3, k3, v3, o3, lse, segq, segk, bias, b, h = res
     do3 = _flatten(g)
     bh, sq = q3.shape[0], q3.shape[1]
     delta = jnp.sum(jnp.asarray(do3, jnp.float32) *
                     jnp.asarray(o3, jnp.float32), axis=-1,
                     keepdims=True).reshape(bh, 1, sq)
-    dq3, dk3, dv3 = _bwd_pallas(q3, k3, v3, do3, lse, delta, segq, segk,
-                                scale, causal, block_q, block_k, interpret)
+    dq3, dk3, dv3, dlog = _bwd_pallas(q3, k3, v3, do3, lse, delta, segq,
+                                      segk, scale, causal, block_q, block_k,
+                                      interpret, bias=bias, h=h)
     sq, d = q3.shape[1], q3.shape[2]
     sk = k3.shape[1]
     dq = dq3.reshape(b, h, sq, d)
     dk = dk3.reshape(b, h, sk, d)
     dv = dv3.reshape(b, h, sk, d)
-    return dq, dk, dv, None
+    dbias = None
+    if bias is not None:
+        # dlog arrives already reduced to the bias's broadcast class
+        # ([B*, sq, sk] with B* = prod of bias's leading dims)
+        dbias = dlog.reshape(bias.shape).astype(bias.dtype)
+    return dq, dk, dv, dbias, None
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -511,6 +714,7 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 def flash_attention(q, k, v, *, causal: bool = False,
                     scale: Optional[float] = None,
                     segment_ids: Optional[jnp.ndarray] = None,
+                    bias: Optional[jnp.ndarray] = None,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K,
                     interpret: bool = False):
@@ -519,16 +723,26 @@ def flash_attention(q, k, v, *, causal: bool = False,
     ``segment_ids``: [batch, seq] int — varlen packing (fmhalib parity);
     tokens attend only within equal segment ids. ``scale`` defaults to
     1/sqrt(head_dim) (the reference kernels bake the same default).
+
+    ``bias``: ADDITIVE logits bias of shape [b|1, h|1, sq, sk] (the apex
+    additive-mask MHA variants / evoformer pair bias), applied after the
+    q·k scale. Differentiable; the bias cotangent costs one O(s²) fp32
+    buffer in backward (the same footprint unfused attention pays) — the
+    bias-free path allocates nothing extra.
     """
     d = q.shape[-1]
     if scale is None:
         scale = 1.0 / (d ** 0.5)
     sq, sk = q.shape[2], k.shape[2]
+    # validated on EVERY path: the jnp fallback must reject exactly what the
+    # Pallas path rejects, or aligned shapes would crash where unaligned ran
+    _validate_bias(bias, q.shape[0], q.shape[1], sq, sk)
     bq = min(block_q, sq)
     bk = min(block_k, sk)
     if jax.default_backend() == "cpu":
         interpret = True  # pallas-TPU lowering needs a TPU; CPU interprets
     if not _pallas_ok(sq, sk, d, bq, bk) or (interpret and _has_vma(q)):
         return mha_reference(q, k, v, causal=causal, scale=scale,
-                             segment_ids=segment_ids)
-    return _flash(q, k, v, segment_ids, causal, scale, bq, bk, interpret)
+                             segment_ids=segment_ids, bias=bias)
+    return _flash(q, k, v, bias, segment_ids, causal, scale, bq, bk,
+                  interpret)

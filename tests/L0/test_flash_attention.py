@@ -91,3 +91,44 @@ def test_cross_attention_shapes():
     ref = mha_reference(q, k, v, scale=1.0 / D ** 0.5)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("bias_shape", [(1, 1), (1, H), (B, 1), (B, H)])
+@pytest.mark.parametrize("causal", [False, True])
+def test_bias_forward_and_grads(causal, bias_shape):
+    """Additive logits bias (apex additive-mask variants / evoformer pair
+    bias): forward and ALL grads — including dbias with broadcast
+    reduction — must match the unfused fp32 reference."""
+    q, k, v = _qkv(3)
+    bb, bh = bias_shape
+    bias = jax.random.normal(jax.random.PRNGKey(7), (bb, bh, S, S),
+                             jnp.float32) * 0.5
+    scale = 1.0 / D ** 0.5
+
+    out = flash_attention(q, k, v, causal=causal, bias=bias, interpret=True)
+    ref = mha_reference(q, k, v, causal=causal, scale=scale, bias=bias)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+    def f_flash(q, k, v, bias):
+        return (flash_attention(q, k, v, causal=causal, bias=bias,
+                                interpret=True)
+                .astype(jnp.float32) * _qkv(4)[0].astype(jnp.float32)).sum()
+
+    def f_ref(q, k, v, bias):
+        return (mha_reference(q, k, v, causal=causal, scale=scale, bias=bias)
+                .astype(jnp.float32) * _qkv(4)[0].astype(jnp.float32)).sum()
+
+    g1 = jax.grad(f_flash, argnums=(0, 1, 2, 3))(q, k, v, bias)
+    g2 = jax.grad(f_ref, argnums=(0, 1, 2, 3))(q, k, v, bias)
+    for a, b, name in zip(g1, g2, "q k v bias".split()):
+        assert a.shape == b.shape, name
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-3, err_msg=name)
+
+
+def test_bias_bad_shape_raises():
+    q, k, v = _qkv(5)
+    with pytest.raises(ValueError, match="bias"):
+        flash_attention(q, k, v, bias=jnp.zeros((1, 1, 1, S)),
+                        interpret=True)

@@ -311,3 +311,39 @@ def test_groupbn_nhwc_add_relu():
     with pytest.raises(ValueError, match="bn_group"):
         BatchNorm2d_NHWC(num_features=16, bn_group=2).init(
             jax.random.PRNGKey(0), x)
+
+
+def test_self_attn_additive_mask():
+    """Reference: fast_self_multihead_attn_additive_mask — a float mask
+    ADDED to the logits (−inf-style for disallowed positions) must match
+    applying the same mask in an explicit softmax composition."""
+    import flax.linen as nn
+    from apex_tpu.contrib.multihead_attn import SelfMultiheadAttn
+
+    S, B, E, H = 10, 2, 32, 4
+    x = jax.random.normal(jax.random.PRNGKey(0), (S, B, E))
+    # forbid attention to the last 3 keys, additively
+    mask = jnp.zeros((1, 1, S, S)).at[:, :, :, -3:].set(-1e30)
+
+    m = SelfMultiheadAttn(embed_dim=E, num_heads=H)
+    variables = m.init(jax.random.PRNGKey(1), x, is_training=False)
+    out_masked = m.apply(variables, x, attn_mask=mask, is_training=False)
+    out_plain = m.apply(variables, x, is_training=False)
+    assert not np.allclose(np.asarray(out_masked), np.asarray(out_plain))
+
+    # oracle: same projections, explicit softmax with the additive mask
+    qkv_k = variables["params"]["qkv_proj"]["kernel"]
+    out_k = variables["params"]["out_proj"]["kernel"]
+    qkv = jnp.einsum("sbe,ef->sbf", x, qkv_k)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    d = E // H
+    def heads(t):
+        return t.reshape(S, B, H, d).transpose(1, 2, 0, 3)
+    qh, kh, vh = heads(q), heads(k), heads(v)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) / d ** 0.5 + mask
+    p = jax.nn.softmax(s, -1)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, vh)
+    o = o.transpose(2, 0, 1, 3).reshape(S, B, E)
+    ref = jnp.einsum("sbe,ef->sbf", o, out_k)
+    np.testing.assert_allclose(np.asarray(out_masked), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)

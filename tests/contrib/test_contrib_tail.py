@@ -152,3 +152,27 @@ def test_openfold_evoformer_attention():
         jax.nn.softmax(jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale, -1), v)
     np.testing.assert_allclose(np.asarray(out2), np.asarray(ref2),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_openfold_evoformer_5d():
+    """OpenFold's real evoformer tensors are 5D ([batch, n_seq, heads,
+    n_res, c]) with a pair bias broadcast over n_seq — leading dims must
+    collapse into the kernel batch and match the explicit composition."""
+    from apex_tpu.contrib.openfold_triton import evoformer_attention
+    rng = np.random.RandomState(6)
+    B, N, H, R, C = 2, 3, 2, 8, 16
+    q = jnp.asarray(rng.randn(B, N, H, R, C) * 0.3, jnp.float32)
+    k = jnp.asarray(rng.randn(B, N, H, R, C) * 0.3, jnp.float32)
+    v = jnp.asarray(rng.randn(B, N, H, R, C) * 0.3, jnp.float32)
+    bias = jnp.asarray(rng.randn(B, 1, H, R, R) * 0.1, jnp.float32)
+    gate = jnp.asarray(rng.randn(B, N, H, R, C), jnp.float32)
+
+    out = evoformer_attention(q, k, v, bias=bias, gate=gate)
+    assert out.shape == (B, N, H, R, C)
+
+    scale = C ** -0.5
+    logits = jnp.einsum("bnhqd,bnhkd->bnhqk", q, k) * scale + bias
+    ref = jnp.einsum("bnhqk,bnhkd->bnhqd", jax.nn.softmax(logits, -1), v)
+    ref = ref * jax.nn.sigmoid(gate)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
