@@ -38,11 +38,15 @@ class ScalerState:
     unskipped: jnp.ndarray           # i32 scalar, consecutive clean steps
     steps: jnp.ndarray               # i32 scalar, total update_scale calls
     overflows: jnp.ndarray           # i32 scalar, total overflows seen
+    # overflows still tolerated before the next backoff (hysteresis support,
+    # Megatron DynamicGradScaler / csrc/update_scale_hysteresis.cu)
+    hysteresis_left: jnp.ndarray
     dynamic: bool = struct.field(pytree_node=False, default=True)
     scale_factor: float = struct.field(pytree_node=False, default=2.0)
     scale_window: int = struct.field(pytree_node=False, default=2000)
     min_loss_scale: float = struct.field(pytree_node=False, default=0.0)
     max_loss_scale: float = struct.field(pytree_node=False, default=2.0 ** 24)
+    hysteresis: int = struct.field(pytree_node=False, default=1)
 
 
 def init_scaler(
@@ -52,23 +56,36 @@ def init_scaler(
     scale_window: int = 2000,
     min_loss_scale: float = None,
     max_loss_scale: float = 2.0 ** 24,
+    hysteresis: int = 1,
 ) -> ScalerState:
-    """Build a ScalerState. Mirrors LossScaler.__init__ defaults."""
+    """Build a ScalerState. Mirrors LossScaler.__init__ defaults.
+
+    ``hysteresis`` — the Megatron DynamicGradScaler schedule (the same
+    mechanism as csrc/update_scale_hysteresis.cu): every overflow step
+    spends one tolerance point and the scale backs off only once the
+    tolerance is exhausted — and KEEPS backing off on each further overflow
+    while exhausted; the tolerance refills only when the scale grows (after
+    ``scale_window`` clean steps). The default 1 is apex amp's classic
+    immediate-backoff behavior."""
     dynamic = isinstance(loss_scale, str) and loss_scale == "dynamic"
     if dynamic:
         scale = min(max_loss_scale, init_scale)
     else:
         scale = float(loss_scale)
+    if hysteresis < 1:
+        raise ValueError(f"hysteresis must be >= 1, got {hysteresis}")
     return ScalerState(
         loss_scale=jnp.float32(scale),
         unskipped=jnp.int32(0),
         steps=jnp.int32(0),
         overflows=jnp.int32(0),
+        hysteresis_left=jnp.int32(hysteresis),
         dynamic=dynamic,
         scale_factor=float(scale_factor),
         scale_window=int(scale_window),
         min_loss_scale=0.0 if min_loss_scale is None else float(min_loss_scale),
         max_loss_scale=float(max_loss_scale),
+        hysteresis=int(hysteresis),
     )
 
 
@@ -130,13 +147,20 @@ def update_scale(state: ScalerState, found_inf) -> ScalerState:
     (static scalers never change scale but still count.)
     """
     found_inf = jnp.asarray(found_inf, jnp.bool_)
+    hyst = state.hysteresis_left
     if state.dynamic:
+        # Megatron DynamicGradScaler.update, vectorized: each overflow
+        # spends one tolerance point (floored at 0); while exhausted, EVERY
+        # overflow backs the scale off; the tolerance refills only on
+        # growth. hysteresis=1 degenerates to apex amp's immediate backoff.
+        hyst = jnp.where(found_inf, jnp.maximum(hyst - 1, 0), hyst)
+        do_backoff = found_inf & (hyst <= 0)
         dropped = jnp.maximum(
             state.loss_scale / state.scale_factor,
             jnp.float32(state.min_loss_scale) if state.min_loss_scale
             else jnp.float32(jnp.finfo(jnp.float32).tiny),
         )
-        scale = jnp.where(found_inf, dropped, state.loss_scale)
+        scale = jnp.where(do_backoff, dropped, state.loss_scale)
         unskipped = jnp.where(found_inf, 0, state.unskipped + 1)
         grow = unskipped >= state.scale_window
         scale = jnp.where(
@@ -146,6 +170,7 @@ def update_scale(state: ScalerState, found_inf) -> ScalerState:
             scale,
         )
         unskipped = jnp.where(grow, 0, unskipped)
+        hyst = jnp.where(grow, state.hysteresis, hyst)
     else:
         scale = state.loss_scale
         unskipped = jnp.where(found_inf, 0, state.unskipped + 1)
@@ -154,6 +179,7 @@ def update_scale(state: ScalerState, found_inf) -> ScalerState:
         unskipped=jnp.asarray(unskipped, jnp.int32),
         steps=state.steps + 1,
         overflows=state.overflows + jnp.asarray(found_inf, jnp.int32),
+        hysteresis_left=jnp.asarray(hyst, jnp.int32),
     )
 
 
@@ -166,9 +192,11 @@ class LossScaler:
 
     def __init__(self, loss_scale="dynamic", init_scale=2.0 ** 16,
                  scale_factor=2.0, scale_window=2000,
-                 min_loss_scale=None, max_loss_scale=2.0 ** 24):
+                 min_loss_scale=None, max_loss_scale=2.0 ** 24,
+                 hysteresis=1):
         self._state = init_scaler(loss_scale, init_scale, scale_factor,
-                                  scale_window, min_loss_scale, max_loss_scale)
+                                  scale_window, min_loss_scale,
+                                  max_loss_scale, hysteresis)
         self._has_overflow = False
         self.dynamic = self._state.dynamic
 
@@ -202,6 +230,7 @@ class LossScaler:
             "unskipped": int(self._state.unskipped),
             "steps": int(self._state.steps),
             "overflows": int(self._state.overflows),
+            "hysteresis_left": int(self._state.hysteresis_left),
         }
 
     def load_state_dict(self, sd):
@@ -210,4 +239,6 @@ class LossScaler:
             unskipped=jnp.int32(sd["unskipped"]),
             steps=jnp.int32(sd.get("steps", 0)),
             overflows=jnp.int32(sd.get("overflows", 0)),
+            hysteresis_left=jnp.int32(
+                sd.get("hysteresis_left", self._state.hysteresis)),
         )

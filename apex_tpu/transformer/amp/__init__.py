@@ -51,10 +51,13 @@ class GradScaler(LossScaler):
 
 
 def grad_scaler_state(init_scale=2.0 ** 16, growth_factor=2.0,
-                      growth_interval=2000, min_scale=1.0):
+                      growth_interval=2000, min_scale=1.0, hysteresis=2):
     """Functional form: a ScalerState with the Megatron min-scale floor, for
-    use inside make_train_step-style jitted steps."""
+    use inside make_train_step-style jitted steps. ``hysteresis=2`` is the
+    Megatron DynamicGradScaler default: the first overflow since the last
+    growth is tolerated, each further one backs the scale off (reference:
+    csrc/update_scale_hysteresis.cu)."""
     return init_scaler("dynamic", init_scale=init_scale,
                        scale_factor=growth_factor,
                        scale_window=growth_interval,
-                       min_loss_scale=min_scale)
+                       min_loss_scale=min_scale, hysteresis=hysteresis)

@@ -70,6 +70,10 @@ def parse_args(argv=None):
                    help="save ckpt_{epoch}.npz here after each epoch")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--synthetic", action="store_true", default=True)
+    p.add_argument("--host-data", action="store_true",
+                   help="generate batches on host and feed them through "
+                        "data_prefetcher (exercises the real-data "
+                        "host->device path with copy/compute overlap)")
     p.add_argument("--data-parallel", type=int, default=1,
                    help="size of the data mesh axis (devices)")
     return p.parse_args(argv)
@@ -126,6 +130,46 @@ def synthetic_batch(rng, batch_size, image_size, num_classes):
         rng, (batch_size, image_size, image_size, 3), jnp.float32)
     labels = jax.random.randint(rng, (batch_size,), 0, num_classes)
     return images, labels
+
+
+class data_prefetcher:
+    """Reference: main_amp.py — class data_prefetcher (side CUDA stream that
+    uploads + normalizes the NEXT batch while the current step computes).
+
+    TPU version: ``jax.device_put`` dispatches asynchronously, so issuing the
+    next batch's transfer BEFORE blocking on the current step gives the same
+    copy/compute overlap without any stream management. Wraps any iterator
+    of host (numpy) batches; used for the --host-data path (real-data I/O
+    shape), while the default synthetic path generates on device."""
+
+    def __init__(self, loader, sharding=None):
+        self.loader = iter(loader)
+        self.sharding = sharding
+        self._preload()
+
+    def _put(self, batch):
+        if self.sharding is not None:
+            return jax.device_put(batch, self.sharding)
+        return jax.device_put(batch)
+
+    def _preload(self):
+        try:
+            self.next_batch = self._put(next(self.loader))
+        except StopIteration:
+            self.next_batch = None
+
+    def next(self):
+        batch = self.next_batch
+        if batch is not None:
+            self._preload()   # issue next transfer before caller blocks
+        return batch
+
+    def __iter__(self):
+        while True:
+            batch = self.next()
+            if batch is None:
+                return
+            yield batch
 
 
 def main(argv=None):
@@ -204,17 +248,33 @@ def main(argv=None):
         from apex_tpu.utils import AsyncCheckpointer
         os.makedirs(args.checkpoint_dir, exist_ok=True)
         ckpt = AsyncCheckpointer()
+    def host_batches(epoch_seed, n):
+        hrng = np.random.RandomState(epoch_seed)
+        for _ in range(n):
+            yield (hrng.randn(args.batch_size, args.image_size,
+                              args.image_size, 3).astype(np.float32),
+                   hrng.randint(0, args.num_classes,
+                                size=(args.batch_size,)).astype(np.int32))
+
     for epoch in range(start_epoch, args.epochs):
         t0 = None
         imgs = 0
+        prefetcher = None
+        if args.host_data:
+            prefetcher = data_prefetcher(
+                host_batches(args.seed + epoch, args.iters),
+                sharding=batch_sharding)
         for it in range(args.iters):
-            rng, sub = jax.random.split(rng)
-            if args.deterministic:
-                sub = jax.random.PRNGKey(it)
-            batch = synthetic_batch(sub, args.batch_size, args.image_size,
-                                    args.num_classes)
-            if batch_sharding is not None:
-                batch = jax.device_put(batch, batch_sharding)
+            if prefetcher is not None:
+                batch = prefetcher.next()
+            else:
+                rng, sub = jax.random.split(rng)
+                if args.deterministic:
+                    sub = jax.random.PRNGKey(it)
+                batch = synthetic_batch(sub, args.batch_size,
+                                        args.image_size, args.num_classes)
+                if batch_sharding is not None:
+                    batch = jax.device_put(batch, batch_sharding)
             if args.prof and it == 5:
                 jax.profiler.start_trace("/tmp/apex_tpu_trace")
             state, metrics = jit_step(state, batch)

@@ -137,3 +137,60 @@ def test_module_state_dict():
     sd["loss_scaler0"]["loss_scale"] = 42.0
     amp.load_state_dict(sd)
     assert amp._amp_state.loss_scalers[0].loss_scale() == 42.0
+
+
+def test_hysteresis_delays_backoff():
+    """Megatron DynamicGradScaler.update schedule (the mechanism of
+    csrc/update_scale_hysteresis.cu): with hysteresis=2 the first overflow
+    since the last growth is tolerated; every further overflow backs off
+    (the tolerance stays exhausted — no refill on backoff or clean steps);
+    growth refills it."""
+    from apex_tpu.amp.scaler import init_scaler, update_scale
+
+    s = init_scaler("dynamic", init_scale=2.0 ** 10, hysteresis=2)
+    s1 = update_scale(s, True)                # first overflow: tolerated
+    assert float(s1.loss_scale) == 2.0 ** 10
+    assert int(s1.hysteresis_left) == 1
+    s2 = update_scale(s1, True)               # exhausted: backoff
+    assert float(s2.loss_scale) == 2.0 ** 9
+    assert int(s2.hysteresis_left) == 0
+    s3 = update_scale(s2, True)               # still exhausted: backoff again
+    assert float(s3.loss_scale) == 2.0 ** 8
+    assert int(s3.hysteresis_left) == 0
+    s4 = update_scale(s3, False)              # clean step: NO refill
+    assert int(s4.hysteresis_left) == 0
+    s5 = update_scale(s4, True)               # overflow while exhausted
+    assert float(s5.loss_scale) == 2.0 ** 7
+
+    # growth refills the tolerance
+    s6 = init_scaler("dynamic", init_scale=4.0, scale_window=1, hysteresis=2)
+    s6 = update_scale(s6, True)               # hl 2 -> 1
+    assert int(s6.hysteresis_left) == 1
+    s6 = update_scale(s6, False)              # clean step hits window: grow
+    assert float(s6.loss_scale) == 8.0
+    assert int(s6.hysteresis_left) == 2
+
+
+def test_hysteresis_default_is_apex_immediate_backoff():
+    """hysteresis=1 (default) must reproduce the classic apex schedule
+    bit-for-bit: every overflow halves immediately."""
+    from apex_tpu.amp.scaler import init_scaler, update_scale
+
+    s = init_scaler("dynamic", init_scale=2.0 ** 16)
+    s = update_scale(s, True)
+    assert float(s.loss_scale) == 2.0 ** 15
+    s = update_scale(s, True)
+    assert float(s.loss_scale) == 2.0 ** 14
+
+
+def test_hysteresis_state_dict_roundtrip():
+    from apex_tpu.amp.scaler import LossScaler
+
+    sc = LossScaler("dynamic", hysteresis=3)
+    sc._has_overflow = True
+    sc.update_scale()
+    sd = sc.state_dict()
+    assert sd["hysteresis_left"] == 2
+    sc2 = LossScaler("dynamic", hysteresis=3)
+    sc2.load_state_dict(sd)
+    assert int(sc2._state.hysteresis_left) == 2
