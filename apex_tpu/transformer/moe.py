@@ -33,7 +33,18 @@ from jax import lax
 
 from apex_tpu.comm import AXIS_EXPERT
 
-__all__ = ["MoEMLP", "top1_routing"]
+__all__ = ["MoEMLP", "top1_routing", "top2_routing", "router_z_loss"]
+
+
+def _scatter_to_slots(mask, pos, gate, capacity):
+    """(dispatch, combine) [T,E,C] for one routing choice: ``mask`` [T,E]
+    marks each token's expert, ``pos`` [T,E] its queue position there (only
+    the masked entry meaningful), ``gate`` [T] its combine weight. Tokens at
+    pos >= capacity are dropped (dispatch row zero)."""
+    keep = (pos < capacity).astype(jnp.float32) * mask         # [T, E]
+    p = jnp.sum(pos * mask, axis=-1).astype(jnp.int32)         # [T]
+    dispatch = keep[:, :, None] * jax.nn.one_hot(p, capacity)[:, None, :]
+    return dispatch, dispatch * gate[:, None, None]
 
 
 def top1_routing(router_logits, num_experts: int, capacity: int):
@@ -49,18 +60,70 @@ def top1_routing(router_logits, num_experts: int, capacity: int):
 
     # position of each token within its expert's queue (prefix count)
     position_in_expert = (jnp.cumsum(expert_mask, axis=0) - 1.0) * expert_mask
-    in_capacity = (position_in_expert < capacity).astype(jnp.float32) \
-        * expert_mask
     gate = jnp.sum(probs * expert_mask, axis=-1)               # [T]
-
-    pos = jnp.sum(position_in_expert, axis=-1).astype(jnp.int32)  # [T]
-    pos_one_hot = jax.nn.one_hot(pos, capacity)                # [T, C]
-    dispatch = in_capacity[:, :, None] * pos_one_hot[:, None, :]  # [T,E,C]
-    combine = dispatch * gate[:, None, None]
+    dispatch, combine = _scatter_to_slots(expert_mask, position_in_expert,
+                                          gate, capacity)
 
     # load-balancing aux loss
     density = jnp.mean(expert_mask, axis=0)                    # [E]
     density_proxy = jnp.mean(probs, axis=0)                    # [E]
+    aux = jnp.sum(density * density_proxy) * num_experts
+    return dispatch, combine, aux
+
+
+def router_z_loss(router_logits):
+    """ST-MoE router z-loss (Zoph et al. 2022): mean(logsumexp(logits)²).
+    Keeps router logits small so the fp32 softmax stays well-conditioned.
+    When adding it to the objective yourself, ~1e-3 is the paper's weight;
+    through ``MoEMLP(router_z_weight=...)`` it is folded into the returned
+    aux and therefore ALSO scaled by the caller's aux weight — see the
+    ``router_z_weight`` field doc."""
+    lse = jax.nn.logsumexp(jnp.asarray(router_logits, jnp.float32), axis=-1)
+    return jnp.mean(lse ** 2)
+
+
+def top2_routing(router_logits, num_experts: int, capacity: int):
+    """GShard top-2 router → (dispatch [T,E,C], combine [T,E,C], aux_loss).
+
+    Each token goes to its two highest-probability experts with combine
+    weights renormalized over the pair (GShard, Lepikhin et al. 2020).
+    Capacity is filled by all first choices before any second choice (the
+    GShard ordering: second choices are the first dropped under pressure).
+    aux_loss uses the FIRST-choice assignment density, the standard
+    formulation shared with switch.
+    """
+    T = router_logits.shape[0]
+    probs = jax.nn.softmax(jnp.asarray(router_logits, jnp.float32), axis=-1)
+
+    idx1 = jnp.argmax(probs, axis=-1)                          # [T]
+    mask1 = jax.nn.one_hot(idx1, num_experts)                  # [T, E]
+    probs_wo1 = probs * (1.0 - mask1)
+    idx2 = jnp.argmax(probs_wo1, axis=-1)                      # [T]
+    mask2 = jax.nn.one_hot(idx2, num_experts)
+
+    p1 = jnp.sum(probs * mask1, axis=-1)                       # [T]
+    # from the top-1-masked probs: a saturated softmax (p1 == 1 exactly)
+    # leaves probs_wo1 all-zero and argmax would alias expert 0 — p2 == 0
+    # then zeroes mask2 so no phantom second choice is dispatched and w1
+    # renormalizes to 1
+    p2 = jnp.sum(probs_wo1 * mask2, axis=-1)
+    mask2 = mask2 * (p2 > 0.0).astype(jnp.float32)[:, None]
+    denom = jnp.maximum(p1 + p2, 1e-9)
+    w1, w2 = p1 / denom, p2 / denom
+
+    # queue positions: every first choice precedes every second choice
+    pos1 = (jnp.cumsum(mask1, axis=0) - 1.0) * mask1           # [T, E]
+    count1 = jnp.sum(mask1, axis=0)                            # [E]
+    pos2 = ((jnp.cumsum(mask2, axis=0) - 1.0) + count1[None, :]) * mask2
+
+    d1, c1 = _scatter_to_slots(mask1, pos1, w1, capacity)
+    d2, c2 = _scatter_to_slots(mask2, pos2, w2, capacity)
+    # a slot is owned by exactly one (token, choice): positions are disjoint
+    dispatch = d1 + d2
+    combine = c1 + c2
+
+    density = jnp.mean(mask1, axis=0)
+    density_proxy = jnp.mean(probs, axis=0)
     aux = jnp.sum(density * density_proxy) * num_experts
     return dispatch, combine, aux
 
@@ -80,6 +143,13 @@ class MoEMLP(nn.Module):
     intermediate: int
     num_experts: int
     capacity_factor: float = 1.25
+    router_top_k: int = 1          # 1 = switch, 2 = GShard top-2
+    # ST-MoE z-loss weight RELATIVE to the load-balancing term: the layer
+    # returns aux = lb_aux + router_z_weight * z_loss and the caller scales
+    # the whole thing by its aux weight. For an objective weighting of
+    # aux_weight=1e-2 on lb and the paper's 1e-3 on z, set
+    # router_z_weight=0.1.
+    router_z_weight: float = 0.0
     axis_name: Optional[str] = AXIS_EXPERT
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
@@ -101,14 +171,23 @@ class MoEMLP(nn.Module):
             raise ValueError(f"num_experts={E} not divisible by expert-"
                              f"parallel size {ep}")
         e_local = E // ep
-        # capacity per expert per shard, padded to a multiple of 4 sublanes
-        C = max(4, int(self.capacity_factor * T / E + 0.5))
+        if self.router_top_k not in (1, 2):
+            raise ValueError(
+                f"router_top_k must be 1 (switch) or 2 (GShard top-2), "
+                f"got {self.router_top_k}")
+        # capacity per expert per shard (scaled by top_k: each token takes
+        # router_top_k slots on average), padded to a multiple of 4 sublanes
+        C = max(4, int(self.capacity_factor * self.router_top_k * T / E
+                       + 0.5))
         C = (C + 3) // 4 * 4
 
         router = nn.Dense(E, dtype=jnp.float32,
                           param_dtype=self.param_dtype, name="router")
-        dispatch, combine, aux = top1_routing(
-            router(jnp.asarray(x, jnp.float32)), E, C)
+        logits = router(jnp.asarray(x, jnp.float32))
+        routing = top1_routing if self.router_top_k == 1 else top2_routing
+        dispatch, combine, aux = routing(logits, E, C)
+        if self.router_z_weight:
+            aux = aux + self.router_z_weight * router_z_loss(logits)
         dispatch = jnp.asarray(dispatch, x.dtype)
 
         # scatter tokens into expert slots: [E, C, H]

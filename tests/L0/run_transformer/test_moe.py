@@ -5,6 +5,8 @@ sharded layer must match its own single-device math exactly, because each
 shard's routing/capacity is token-local and expert MLPs are per-slot.
 """
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -131,3 +133,115 @@ def test_moe_expert_parallel_grads_flow(eight_devices):
     total = sum(float(jnp.sum(jnp.abs(v)))
                 for v in jax.tree_util.tree_leaves(g))
     assert np.isfinite(total) and total > 0
+
+
+# ------------------------------------------------------------- top-2 routing
+def test_top2_routing_matches_manual_two_expert_mix():
+    """With capacity ≥ T no token drops: each token's output weights must be
+    the pair-renormalized top-2 softmax probs (GShard)."""
+    from apex_tpu.transformer.moe import top2_routing
+
+    logits = jax.random.normal(jax.random.PRNGKey(1), (T, E))
+    dispatch, combine, aux = top2_routing(logits, E, T)   # no capacity limit
+    probs = np.asarray(jax.nn.softmax(logits, -1))
+    w = np.asarray(jnp.sum(combine, axis=2))              # [T, E]
+    for t in range(T):
+        order = np.argsort(probs[t])[::-1]
+        e1, e2 = order[0], order[1]
+        denom = probs[t, e1] + probs[t, e2]
+        np.testing.assert_allclose(w[t, e1], probs[t, e1] / denom, rtol=1e-5)
+        np.testing.assert_allclose(w[t, e2], probs[t, e2] / denom, rtol=1e-5)
+        others = [e for e in range(E) if e not in (e1, e2)]
+        np.testing.assert_allclose(w[t, others], 0.0, atol=1e-7)
+    # every slot holds at most one token; counts ≤ 2T total
+    assert float(jnp.max(jnp.sum(dispatch, axis=0))) <= 1.0 + 1e-6
+    assert np.isfinite(float(aux))
+
+
+def test_top2_capacity_drops_second_choices_first():
+    """GShard ordering: under capacity pressure, first choices occupy the
+    queue ahead of every second choice."""
+    from apex_tpu.transformer.moe import top2_routing
+
+    # all tokens prefer expert 0 then expert 1
+    logits = jnp.tile(jnp.array([[4.0, 2.0, 0.0, 0.0]]), (6, 1))
+    C = 4
+    dispatch, combine, _ = top2_routing(logits, 4, C)
+    counts = np.asarray(jnp.sum(dispatch, axis=(0, 2)))   # per expert
+    assert counts[0] == C            # first choices fill expert 0 to cap
+    assert counts[1] == C            # second choices fill expert 1 to cap
+    # tokens 0..3 keep their first choice; 4,5 dropped from expert 0
+    kept0 = np.asarray(jnp.sum(dispatch[:, 0, :], axis=-1))
+    np.testing.assert_array_equal(kept0, [1, 1, 1, 1, 0, 0])
+
+
+def test_router_z_loss():
+    from apex_tpu.transformer.moe import router_z_loss
+
+    small = jnp.zeros((8, 4))
+    big = jnp.full((8, 4), 50.0)
+    # logsumexp(0,0,0,0) = log 4; z = (log 4)^2
+    np.testing.assert_allclose(float(router_z_loss(small)),
+                               np.log(4.0) ** 2, rtol=1e-6)
+    assert float(router_z_loss(big)) > float(router_z_loss(small))
+
+
+def test_top2_degenerate_softmax_no_phantom_second_choice():
+    """A saturated router softmax (top-1 prob exactly 1.0 in fp32) has no
+    valid second choice; the token must go ONLY to its first expert with
+    full weight — not be dispatched twice at w=0.5 (regression guard)."""
+    from apex_tpu.transformer.moe import top2_routing
+
+    logits = jnp.array([[200.0, 0.0, 0.0, 0.0],     # saturated: p1 == 1.0
+                        [1.0, 0.5, 0.0, 0.0]])       # normal top-2 row
+    dispatch, combine, _ = top2_routing(logits, 4, 4)
+    w = np.asarray(jnp.sum(combine, axis=2))         # [T, E]
+    np.testing.assert_allclose(w[0], [1.0, 0.0, 0.0, 0.0], atol=1e-6)
+    # saturated token occupies exactly one slot
+    assert float(jnp.sum(dispatch[0])) == 1.0
+    # normal row still splits across its two experts
+    assert w[1, 0] > 0.5 and w[1, 1] > 0.0
+    np.testing.assert_allclose(w[1, 0] + w[1, 1], 1.0, rtol=1e-6)
+
+
+def test_moe_top2_expert_parallel_matches_single_device(eight_devices):
+    """Top-2 sharded over the expert axis must equal its single-device
+    self (same internal-parity bar as the top-1 test)."""
+    mesh = Mesh(np.array(eight_devices[:4]), ("expert",))
+    x = jax.random.normal(jax.random.PRNGKey(2), (T, H))
+
+    m_local = MoEMLP(hidden=H, intermediate=I, num_experts=E,
+                     router_top_k=2, router_z_weight=1e-3, axis_name=None)
+    variables = m_local.init(jax.random.PRNGKey(3), x)
+    y_local, aux_local = m_local.apply(variables, x)
+
+    m_sharded = MoEMLP(hidden=H, intermediate=I, num_experts=E,
+                       router_top_k=2, router_z_weight=1e-3,
+                       axis_name="expert")
+
+    e_local = E // 4
+    params = dict(variables["params"])
+    full = {"router": params["router"],
+            "w1": params["w1"].reshape(4, e_local, H, I),
+            "b1": params["b1"].reshape(4, e_local, I),
+            "w2": params["w2"].reshape(4, e_local, I, H),
+            "b2": params["b2"].reshape(4, e_local, H)}
+
+    @jax.jit
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=({"router": P(), "w1": P("expert"), "b1": P("expert"),
+                   "w2": P("expert"), "b2": P("expert")}, P()),
+        out_specs=(P(), P()), check_vma=False)
+    def run(p, x):
+        local = {"params": {
+            "router": p["router"],
+            "w1": p["w1"][0], "b1": p["b1"][0],
+            "w2": p["w2"][0], "b2": p["b2"][0],
+        }}
+        return m_sharded.apply(local, x)
+
+    y_sh, aux_sh = run(full, x)
+    np.testing.assert_allclose(np.asarray(y_sh), np.asarray(y_local),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(float(aux_sh), float(aux_local), rtol=1e-5)
