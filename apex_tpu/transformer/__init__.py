@@ -17,7 +17,8 @@ from . import parallel_state  # noqa: F401
 from . import pipeline_parallel  # noqa: F401
 from . import tensor_parallel  # noqa: F401
 from . import testing  # noqa: F401
-from .context_parallel import ring_attention, ulysses_attention  # noqa: F401
+from .context_parallel import (ring_attention, ulysses_attention,  # noqa: F401
+                               zigzag_inverse, zigzag_order)
 from .enums import AttnMaskType, AttnType, LayerType, ModelType  # noqa: F401
 from .log_util import get_transformer_logger, set_logging_level  # noqa: F401
 from .moe import MoEMLP  # noqa: F401
@@ -26,5 +27,5 @@ __all__ = ["amp", "log_util", "testing",
            "get_transformer_logger", "set_logging_level",
            "parallel_state", "tensor_parallel", "pipeline_parallel",
            "functional", "enums", "context_parallel", "moe", "AttnMaskType",
-           "AttnType", "LayerType", "ModelType", "ring_attention",
+           "AttnType", "LayerType", "ModelType", "ring_attention", "zigzag_order", "zigzag_inverse",
            "ulysses_attention", "MoEMLP"]

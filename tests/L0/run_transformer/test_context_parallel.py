@@ -215,3 +215,94 @@ def test_ulysses_attention_multi_axis_mesh():
                    mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     np.testing.assert_allclose(jax.jit(fn)(q, k, v), want,
                                atol=2e-5, rtol=2e-5)
+
+
+# ------------------------------------------------------------------ zigzag
+def _zz(x, n):
+    from apex_tpu.transformer.context_parallel import zigzag_order
+    return jnp.take(x, zigzag_order(x.shape[2], n), axis=2)
+
+
+def _unzz(x, n):
+    from apex_tpu.transformer.context_parallel import zigzag_inverse
+    return jnp.take(x, zigzag_inverse(x.shape[2], n), axis=2)
+
+
+def test_zigzag_order_roundtrip():
+    from apex_tpu.transformer.context_parallel import (zigzag_inverse,
+                                                       zigzag_order)
+    order = np.asarray(zigzag_order(16, 4))
+    # rank 0 holds chunks 0 and 7, rank 1 chunks 1 and 6, ...
+    np.testing.assert_array_equal(order[:4], [0, 1, 14, 15])
+    np.testing.assert_array_equal(order[4:8], [2, 3, 12, 13])
+    inv = np.asarray(zigzag_inverse(16, 4))
+    np.testing.assert_array_equal(order[inv], np.arange(16))
+    np.testing.assert_array_equal(inv[order], np.arange(16))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("n", [4, 8])
+def test_ring_attention_zigzag_forward(causal, n):
+    mesh = _mesh(n)
+    q, k, v = _qkv(3)
+    want = mha_reference(q, k, v, causal=causal, scale=1.0 / D ** 0.5)
+
+    fn = _sharded(functools.partial(ring_attention, causal=causal,
+                                    layout="zigzag"), mesh)
+    got = _unzz(jax.jit(fn)(_zz(q, n), _zz(k, n), _zz(v, n)), n)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_zigzag_grads(causal):
+    n = 4
+    mesh = _mesh(n)
+    q, k, v = _qkv(4)
+    scale = 1.0 / D ** 0.5
+
+    def ref_loss(q, k, v):
+        o = mha_reference(q, k, v, causal=causal, scale=scale)
+        return (o.astype(jnp.float32) ** 2).sum()
+
+    fn = _sharded(functools.partial(ring_attention, causal=causal,
+                                    layout="zigzag"), mesh)
+    jfn = jax.jit(fn)
+
+    def zz_loss(q, k, v):
+        o = jfn(_zz(q, n), _zz(k, n), _zz(v, n))
+        return (_unzz(o, n).astype(jnp.float32) ** 2).sum()
+
+    g_ref = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    g_zz = jax.grad(zz_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_zz, g_ref, "dq dk dv".split()):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-4, err_msg=name)
+
+
+def test_ring_zigzag_matches_contiguous():
+    """Same math, different layout: zigzag output (un-permuted) must equal
+    the contiguous ring's output."""
+    n = 4
+    mesh = _mesh(n)
+    q, k, v = _qkv(5)
+    f_cont = jax.jit(_sharded(functools.partial(
+        ring_attention, causal=True, layout="contiguous"), mesh))
+    f_zz = jax.jit(_sharded(functools.partial(
+        ring_attention, causal=True, layout="zigzag"), mesh))
+    out_c = f_cont(q, k, v)
+    out_z = _unzz(f_zz(_zz(q, n), _zz(k, n), _zz(v, n)), n)
+    np.testing.assert_allclose(np.asarray(out_z), np.asarray(out_c),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_zigzag_rejects_odd_local_seq():
+    n = 4
+    mesh = _mesh(n)
+    q = jnp.zeros((1, 1, n * 3, 8))   # local_seq 3: odd
+    fn = _sharded(functools.partial(ring_attention, causal=True,
+                                    layout="zigzag"), mesh)
+    with pytest.raises(ValueError, match="even local_seq"):
+        jax.jit(fn)(q, q, q)
+    with pytest.raises(ValueError, match="layout"):
+        ring_attention(q, q, q, layout="spiral")
