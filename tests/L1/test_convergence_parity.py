@@ -6,9 +6,10 @@ and assert the half-precision runs track the fp32 run — loss curves within
 dtype tolerance and final weights allclose. This is the miniature of the
 driver's "top-1 parity" criterion.
 
-Two workloads, matching BASELINE configs 1 and 3:
+Three workloads, matching BASELINE configs 1, 3, and 4:
 - ResNet-ish conv net (BatchNorm, SGD momentum) — examples/imagenet shape
 - small transformer LM (FusedLayerNorm, flash-attn, FusedAdam) — LM shape
+- tiny BERT pretraining (MLM+NSP heads, FusedLAMB) — BERT-LAMB shape
 """
 
 import jax
@@ -134,3 +135,71 @@ def test_o0_is_deterministic(resnet_o0):
     l0, _ = resnet_o0
     l1, _ = _run_resnet("O0")
     np.testing.assert_array_equal(l0, l1)
+
+
+# ---------------------------------------------------- config 4: BERT + LAMB
+def _run_bert(opt_level, iters=ITERS):
+    from apex_tpu.models.bert import BertConfig, BertForPreTraining
+    from apex_tpu.optimizers import fused_lamb
+
+    policy = amp.resolve_policy(opt_level=opt_level, loss_scale="dynamic")
+    cfg = BertConfig(vocab_size=96, hidden_size=48, num_hidden_layers=2,
+                     num_attention_heads=4, intermediate_size=96,
+                     max_position_embeddings=32,
+                     hidden_dropout_prob=0.0,
+                     attention_probs_dropout_prob=0.0)  # deterministic runs
+    model = BertForPreTraining(cfg, dtype=policy.compute_dtype)
+    B, S, Pm = 4, 16, 3
+    ids0 = jnp.zeros((B, S), jnp.int32)
+    tt0 = jnp.zeros((B, S), jnp.int32)
+    am0 = jnp.ones((B, S), jnp.int32)
+    pos0 = jnp.zeros((B, Pm), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids0, tt0, am0, pos0,
+                        train=False)["params"]
+
+    def loss_fn(p, batch):
+        ids, tt, am, pos, labels, nsp = batch
+        mlm, nspl = model.apply({"params": p}, ids, tt, am, pos, train=True)
+        l_mlm = softmax_cross_entropy_loss(
+            mlm.reshape(-1, cfg.vocab_size), labels.reshape(-1)).mean()
+        l_nsp = softmax_cross_entropy_loss(nspl, nsp).mean()
+        return l_mlm + l_nsp
+
+    init_fn, step_fn = amp.make_train_step(
+        loss_fn, fused_lamb(1e-3, weight_decay=0.01), policy)
+    state = init_fn(params)
+    jit_step = jax.jit(step_fn)
+    losses = []
+    for i in range(iters):
+        k = jax.random.PRNGKey(300 + i)
+        ks = jax.random.split(k, 4)
+        batch = (jax.random.randint(ks[0], (B, S), 0, 96),
+                 jnp.zeros((B, S), jnp.int32),
+                 jnp.ones((B, S), jnp.int32),
+                 jax.random.randint(ks[1], (B, Pm), 0, S),
+                 jax.random.randint(ks[2], (B, Pm), 0, 96),
+                 jax.random.randint(ks[3], (B,), 0, 2))
+        state, m = jit_step(state, batch)
+        losses.append(float(m["loss"]))
+    final = state.master_params if state.master_params is not None \
+        else state.params
+    return np.asarray(losses), jax.tree_util.tree_map(
+        lambda v: np.asarray(v, np.float32), final)
+
+
+@pytest.fixture(scope="module")
+def bert_o0():
+    return _run_bert("O0")
+
+
+@pytest.mark.parametrize("opt_level,loss_rtol", [
+    ("O1", 0.05), ("O2", 0.05),
+])
+def test_bert_lamb_opt_level_parity(bert_o0, opt_level, loss_rtol):
+    """BASELINE config 4: BERT pretraining shape with FusedLAMB — bf16
+    policies must track the fp32 loss trajectory."""
+    l0, w0 = bert_o0
+    l, w = _run_bert(opt_level)
+    assert np.isfinite(l).all()
+    np.testing.assert_allclose(l, l0, rtol=loss_rtol, atol=0.08)
+    assert l[-1] < l[0] and l0[-1] < l0[0]   # both learning
