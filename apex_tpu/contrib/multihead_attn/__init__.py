@@ -48,9 +48,19 @@ def _attend(module, qh, kh, vh, *, causal, scale, key_padding_mask,
     ([b|1, h|1, sq, sk], added to the scaled logits) and rides the flash
     kernel's bias path."""
     use_dropout = dropout > 0.0 and is_training
-    if key_padding_mask is None and not use_dropout:
+    if key_padding_mask is None:
+        # fused path, including fused softmax+dropout (the reference's
+        # fast_self_attn philox-replay kernel): the in-kernel mask is
+        # seeded from this module's dropout rng per call
+        seed = None
+        rate = 0.0
+        if use_dropout:
+            rate = dropout
+            seed = jax.random.randint(
+                module.make_rng("dropout"), (), 0, 2 ** 31 - 1, jnp.int32)
         return flash_attention(qh, kh, vh, causal=causal, scale=scale,
-                               bias=attn_mask)
+                               bias=attn_mask, dropout_rate=rate,
+                               dropout_seed=seed)
     s = jnp.einsum("bhqd,bhkd->bhqk", jnp.asarray(qh, jnp.float32),
                    jnp.asarray(kh, jnp.float32)) * scale
     if attn_mask is not None:

@@ -42,10 +42,16 @@ DEFAULT_BLOCK_K = 128
 def mha_reference(q, k, v, *, causal: bool = False, scale: float = 1.0,
                   segment_ids: Optional[jnp.ndarray] = None,
                   mask: Optional[jnp.ndarray] = None,
-                  bias: Optional[jnp.ndarray] = None):
+                  bias: Optional[jnp.ndarray] = None,
+                  dropout_rate: float = 0.0,
+                  dropout_seed=None):
     """fp32-math reference (the oracle the reference's tests use a torch
     softmax composition for). ``bias`` is ADDITIVE on the scaled logits
-    (apex's additive-mask MHA variants), broadcastable to [b, h, sq, sk]."""
+    (apex's additive-mask MHA variants), broadcastable to [b, h, sq, sk].
+    ``dropout_rate``/``dropout_seed``: inverted dropout on the softmax
+    probabilities (the reference's fused softmax+dropout, N11) — the
+    fallback stream (jax.random) differs from the Pallas kernel's hardware
+    PRNG, like the reference's python vs fused impls differ."""
     out_dtype = q.dtype
     q32, k32, v32 = (jnp.asarray(t, jnp.float32) for t in (q, k, v))
     s = jnp.einsum("bhqd,bhkd->bhqk", q32, k32) * scale
@@ -61,13 +67,45 @@ def mha_reference(q, k, v, *, causal: bool = False, scale: float = 1.0,
     if mask is not None:
         s = jnp.where(mask, s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
+    if dropout_rate > 0.0:
+        if dropout_seed is None:
+            raise ValueError("dropout_rate > 0 requires dropout_seed")
+        key = jax.random.PRNGKey(jnp.asarray(dropout_seed, jnp.int32))
+        keep = jax.random.bernoulli(key, 1.0 - dropout_rate, p.shape)
+        p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
     return jnp.asarray(jnp.einsum("bhqk,bhkd->bhqd", p, v32), out_dtype)
 
 
+def _mix_seed(seed, b, qi, ki):
+    """Murmur-style avalanche of (user seed, bh index, q-block, k-block)
+    into one PRNG seed. A linear combination would collide systematically —
+    seed=step with step+1 at block index i-1 reuses step's block-i mask, and
+    nearby seeds shift rather than change the mask field; the wrap-multiply
+    + xorshift mixing decorrelates all four inputs."""
+    x = jnp.asarray(seed, jnp.uint32)
+    for v, c in ((b, 0x9E3779B1), (qi, 0x85EBCA77), (ki, 0xC2B2AE3D)):
+        x = (x ^ jnp.asarray(v, jnp.uint32)) * jnp.uint32(c)
+        x = x ^ (x >> 16)
+    return x.astype(jnp.int32)
+
+
+def _keep_mask(seed_ref, b, qi, ki, nq, nk, block_q, block_k, rate):
+    """Deterministic per-(bh, q-block, k-block) dropout keep-mask from the
+    hardware PRNG. The seed formula is shared by the forward and BOTH
+    backward kernels, so backward replays the exact forward mask (the
+    reference kernels replay their philox state the same way, N11)."""
+    del nq, nk  # grid extents no longer enter the seed (hash mixing instead)
+    pltpu.prng_seed(_mix_seed(seed_ref[0], b, qi, ki))
+    bits = pltpu.bitcast(
+        pltpu.prng_random_bits((block_q, block_k)), jnp.uint32)
+    thresh = min(int(rate * 4294967296.0), 4294967295)
+    return bits >= jnp.uint32(thresh)
+
+
 # -------------------------------------------------------------- forward kernel
-def _fwd_kernel(q_ref, k_ref, v_ref, segq_ref, segk_ref, bias_ref, o_ref,
-                lse_ref, acc_ref, m_ref, l_ref, *, scale, causal, block_q,
-                block_k, have_segs, have_bias):
+def _fwd_kernel(q_ref, k_ref, v_ref, segq_ref, segk_ref, bias_ref, seed_ref,
+                o_ref, lse_ref, acc_ref, m_ref, l_ref, *, scale, causal,
+                block_q, block_k, have_segs, have_bias, dropout_rate):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -109,9 +147,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, segq_ref, segk_ref, bias_ref, o_ref,
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new)                    # [bq, bk]
         alpha = jnp.exp(m_prev - m_new)           # [bq, 1]
+        # l accumulates UNDROPPED p (the softmax normalizer is exact);
+        # dropout zeroes entries only in the PV accumulation
         l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        p_acc = p
+        if dropout_rate > 0.0:
+            keep = _keep_mask(seed_ref, pl.program_id(0), qi, ki,
+                              pl.num_programs(1), nk, block_q, block_k,
+                              dropout_rate)
+            p_acc = jnp.where(keep, p, 0.0)
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            p_acc, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
@@ -120,16 +166,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, segq_ref, segk_ref, bias_ref, o_ref,
     def _finish():
         l = l_ref[:, :1]
         l_safe = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        denom = l_safe * (1.0 - dropout_rate)   # inverted-dropout scaling
+        o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
         lse = m_ref[:, :1] + jnp.log(l_safe)
         lse_ref[0, 0, pl.ds(qi * block_q, block_q)] = lse[:, 0]
 
 
 # ------------------------------------------------------------- backward kernels
 def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                     segq_ref, segk_ref, bias_ref, dk_ref, dv_ref, dk_acc,
-                     dv_acc, *, scale, causal, block_q, block_k, have_segs,
-                     have_bias):
+                     segq_ref, segk_ref, bias_ref, seed_ref, dk_ref, dv_ref,
+                     dk_acc, dv_acc, *, scale, causal, block_q, block_k,
+                     have_segs, have_bias, dropout_rate):
     ki = pl.program_id(1)
     qi = pl.program_id(2)
     nq = pl.num_programs(2)
@@ -165,11 +212,21 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             s = jnp.where(segq[:, None] == segk[None, :], s, _NEG_INF)
         lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q)]
         p = jnp.exp(s - lse[:, None])                 # [bq, bk]
-        dv_acc[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if dropout_rate > 0.0:
+            # replay the forward's mask: same seed formula, (qi, ki) order
+            keep = _keep_mask(seed_ref, pl.program_id(0), qi, ki,
+                              nq, pl.num_programs(1), block_q, block_k,
+                              dropout_rate)
+            inv = 1.0 / (1.0 - dropout_rate)
+            p_d = jnp.where(keep, p * inv, 0.0)
+            dp = jnp.where(keep, dp * inv, 0.0)
+        else:
+            p_d = p
+        dv_acc[:] += jax.lax.dot_general(
+            p_d, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q)]
         ds = p * (dp - delta[:, None]) * scale
         dk_acc[:] += jax.lax.dot_general(
@@ -183,9 +240,9 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   segq_ref, segk_ref, bias_ref, dq_ref, *rest, scale,
-                   causal, block_q, block_k, have_segs, have_bias,
-                   emit_dlog):
+                   segq_ref, segk_ref, bias_ref, seed_ref, dq_ref, *rest,
+                   scale, causal, block_q, block_k, have_segs, have_bias,
+                   emit_dlog, dropout_rate):
     # rest = (dlog_ref, dq_acc) when emit_dlog else (dq_acc,)
     if emit_dlog:
         dlog_ref, dq_acc = rest
@@ -234,6 +291,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         p = jnp.exp(s - lse[:, None])
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if dropout_rate > 0.0:
+            keep = _keep_mask(seed_ref, pl.program_id(0), qi, ki,
+                              pl.num_programs(1), nk, block_q, block_k,
+                              dropout_rate)
+            dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
         delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q)]
         dlogits = p * (dp - delta[:, None])       # d loss / d (scaled+bias)
         if emit_dlog:
@@ -249,8 +311,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _dbias_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                  segq_ref, segk_ref, bias_ref, dbias_ref, *, scale, causal,
-                  block_q, block_k, have_segs, n_inner):
+                  segq_ref, segk_ref, bias_ref, seed_ref, dbias_ref, *,
+                  scale, causal, block_q, block_k, have_segs, n_inner,
+                  dropout_rate, bh_of):
     """Reduced bias cotangent for BROADCAST bias classes: grid is
     (B*, nq, nk, R) with the broadcast-reduced dim R innermost, so the
     (class, i, j) output block stays resident in VMEM across the R steps
@@ -291,6 +354,12 @@ def _dbias_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         p = jnp.exp(s - lse[:, None])
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if dropout_rate > 0.0:
+            bh_idx = bh_of(pl.program_id(0), pl.program_id(3))
+            keep = _keep_mask(seed_ref, bh_idx, qi, ki,
+                              pl.num_programs(1), pl.num_programs(2),
+                              block_q, block_k, dropout_rate)
+            dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
         delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q)]
         dbias_ref[0] += (p * (dp - delta[:, None])).astype(dbias_ref.dtype)
 
@@ -390,8 +459,20 @@ def _canon_bias(bias, bh, h, sq, sk):
     return bias.reshape(bh, sq, sk), (lambda i: i), True, "full"
 
 
+def _seed_operand(seed, like):
+    """SMEM (1,) int32 seed operand (zeros when dropout is off)."""
+    if seed is None:
+        arr = jnp.zeros((1,), jnp.int32)
+    else:
+        arr = jnp.asarray(seed, jnp.int32).reshape(1)
+    return _match_vma(arr, like)
+
+
+_SEED_SPEC = pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
 def _fwd_pallas(q3, k3, v3, segq, segk, scale, causal, bq, bk, interpret,
-                bias=None, h=None):
+                bias=None, h=None, dropout_rate=0.0, dropout_seed=None):
     bh, sq, d = q3.shape
     sk = k3.shape[1]
     have_segs = segq is not None
@@ -407,10 +488,12 @@ def _fwd_pallas(q3, k3, v3, segq, segk, scale, causal, bq, bk, interpret,
     else:
         bias_spec = pl.BlockSpec((1, bq, bk),
                                  lambda b, i, j: (bmap(b), i, j))
+    seed1 = _seed_operand(dropout_seed, q3)
     grid = (bh, sq // bq, sk // bk)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                block_q=bq, block_k=bk, have_segs=have_segs,
-                               have_bias=have_bias)
+                               have_bias=have_bias,
+                               dropout_rate=dropout_rate)
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -421,6 +504,7 @@ def _fwd_pallas(q3, k3, v3, segq, segk, scale, causal, bq, bk, interpret,
             pl.BlockSpec((1, 1, sq), lambda b, i, j: (b, 0, 0)),
             pl.BlockSpec((1, 1, sk), lambda b, i, j: (b, 0, 0)),
             bias_spec,
+            _SEED_SPEC,
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
@@ -436,12 +520,13 @@ def _fwd_pallas(q3, k3, v3, segq, segk, scale, causal, bq, bk, interpret,
             pltpu.VMEM((bq, 128), jnp.float32),
         ],
         interpret=interpret,
-    )(q3, k3, v3, segq, segk, bias3)
+    )(q3, k3, v3, segq, segk, bias3, seed1)
     return o, lse
 
 
 def _bwd_pallas(q3, k3, v3, do3, lse, delta, segq, segk, scale, causal, bq, bk,
-                interpret, out_dtype=None, bias=None, h=None):
+                interpret, out_dtype=None, bias=None, h=None,
+                dropout_rate=0.0, dropout_seed=None):
     """delta: [bh, 1, sq] fp32 = sum(do * o, -1); lse: [bh, 1, sq] fp32.
 
     ``out_dtype`` overrides the gradient dtypes (default: match inputs);
@@ -474,11 +559,12 @@ def _bwd_pallas(q3, k3, v3, do3, lse, delta, segq, segk, scale, causal, bq, bk,
     # broadcast classes: a separate reduced pass (below) so HBM never holds
     # the [bh, sq, sk] intermediate
     emit_dlog = have_bias and bclass == "full"
+    seed1 = _seed_operand(dropout_seed, q3)
 
     dkdv = pl.pallas_call(
         functools.partial(_bwd_dkdv_kernel, scale=scale, causal=causal,
                           block_q=bq, block_k=bk, have_segs=have_segs,
-                          have_bias=have_bias),
+                          have_bias=have_bias, dropout_rate=dropout_rate),
         grid=(bh, sk // bk, sq // bq),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),   # q
@@ -490,6 +576,7 @@ def _bwd_pallas(q3, k3, v3, do3, lse, delta, segq, segk, scale, causal, bq, bk,
             pl.BlockSpec((1, 1, sq), lambda b, j, i: (b, 0, 0)),   # segq
             pl.BlockSpec((1, 1, sk), lambda b, j, i: (b, 0, 0)),   # segk
             bias_spec_ji,
+            _SEED_SPEC,
         ],
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
@@ -504,7 +591,7 @@ def _bwd_pallas(q3, k3, v3, do3, lse, delta, segq, segk, scale, causal, bq, bk,
             pltpu.VMEM((bk, d), jnp.float32),
         ],
         interpret=interpret,
-    )(q3, k3, v3, do3, lse, delta, segq, segk, bias3)
+    )(q3, k3, v3, do3, lse, delta, segq, segk, bias3, seed1)
 
     dq_out_specs = [pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0))]
     dq_out_shape = [_sds((bh, sq, d), out_dtype or q3.dtype, q3)]
@@ -515,7 +602,8 @@ def _bwd_pallas(q3, k3, v3, do3, lse, delta, segq, segk, scale, causal, bq, bk,
     dq_res = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           block_q=bq, block_k=bk, have_segs=have_segs,
-                          have_bias=have_bias, emit_dlog=emit_dlog),
+                          have_bias=have_bias, emit_dlog=emit_dlog,
+                          dropout_rate=dropout_rate),
         grid=(bh, sq // bq, sk // bk),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),   # q
@@ -527,12 +615,13 @@ def _bwd_pallas(q3, k3, v3, do3, lse, delta, segq, segk, scale, causal, bq, bk,
             pl.BlockSpec((1, 1, sq), lambda b, i, j: (b, 0, 0)),   # segq
             pl.BlockSpec((1, 1, sk), lambda b, i, j: (b, 0, 0)),   # segk
             bias_spec_ij,
+            _SEED_SPEC,
         ],
         out_specs=dq_out_specs,
         out_shape=dq_out_shape,
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
-    )(q3, k3, v3, do3, lse, delta, segq, segk, bias3)
+    )(q3, k3, v3, do3, lse, delta, segq, segk, bias3, seed1)
     dq = dq_res[0]
     dlog = dq_res[1] if emit_dlog else None
 
@@ -554,7 +643,8 @@ def _bwd_pallas(q3, k3, v3, do3, lse, delta, segq, segk, scale, causal, bq, bk,
         dlog = pl.pallas_call(
             functools.partial(_dbias_kernel, scale=scale, causal=causal,
                               block_q=bq, block_k=bk, have_segs=have_segs,
-                              n_inner=R),
+                              n_inner=R, dropout_rate=dropout_rate,
+                              bh_of=bexpr),
             grid=(B, sq // bq, sk // bk, R),
             in_specs=[
                 pl.BlockSpec((1, bq, d),
@@ -575,12 +665,13 @@ def _bwd_pallas(q3, k3, v3, do3, lse, delta, segq, segk, scale, causal, bq, bk,
                              lambda c, i, j, r: (bexpr(c, r), 0, 0)),  # segk
                 pl.BlockSpec((1, bq, bk),
                              lambda c, i, j, r: (c, i, j)),            # bias
+                _SEED_SPEC,
             ],
             out_specs=[pl.BlockSpec((1, bq, bk),
                                     lambda c, i, j, r: (c, i, j))],
             out_shape=[_sds((B, sq, sk), jnp.float32, q3)],
             interpret=interpret,
-        )(q3, k3, v3, do3, lse, delta, segq, segk, bias3)[0]
+        )(q3, k3, v3, do3, lse, delta, segq, segk, bias3, seed1)[0]
 
     return dq, dkdv[0], dkdv[1], dlog
 
@@ -663,16 +754,16 @@ def attn_chunk_bwd(q3, k3, v3, do3, lse, delta, *, scale, causal,
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
-def _flash(q, k, v, bias, segment_ids, causal, scale, block_q, block_k,
-           interpret):
-    out, _ = _flash_fwd(q, k, v, bias, segment_ids, causal, scale, block_q,
-                        block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11))
+def _flash(q, k, v, bias, segment_ids, dropout_seed, causal, scale, block_q,
+           block_k, interpret, dropout_rate):
+    out, _ = _flash_fwd(q, k, v, bias, segment_ids, dropout_seed, causal,
+                        scale, block_q, block_k, interpret, dropout_rate)
     return out
 
 
-def _flash_fwd(q, k, v, bias, segment_ids, causal, scale, block_q, block_k,
-               interpret):
+def _flash_fwd(q, k, v, bias, segment_ids, dropout_seed, causal, scale,
+               block_q, block_k, interpret, dropout_rate):
     b, h, sq, d = q.shape
     q3, k3, v3 = _flatten(q), _flatten(k), _flatten(v)
     segq = segk = None
@@ -680,13 +771,16 @@ def _flash_fwd(q, k, v, bias, segment_ids, causal, scale, block_q, block_k,
         segq = _seg_flat(segment_ids, h)
         segk = segq
     o3, lse = _fwd_pallas(q3, k3, v3, segq, segk, scale, causal, block_q,
-                          block_k, interpret, bias=bias, h=h)
+                          block_k, interpret, bias=bias, h=h,
+                          dropout_rate=dropout_rate,
+                          dropout_seed=dropout_seed)
     out = o3.reshape(b, h, sq, d)
-    return out, (q3, k3, v3, o3, lse, segq, segk, bias, b, h)
+    return out, (q3, k3, v3, o3, lse, segq, segk, bias, dropout_seed, b, h)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q3, k3, v3, o3, lse, segq, segk, bias, b, h = res
+def _flash_bwd(causal, scale, block_q, block_k, interpret, dropout_rate,
+               res, g):
+    q3, k3, v3, o3, lse, segq, segk, bias, dropout_seed, b, h = res
     do3 = _flatten(g)
     bh, sq = q3.shape[0], q3.shape[1]
     delta = jnp.sum(jnp.asarray(do3, jnp.float32) *
@@ -694,7 +788,9 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
                     keepdims=True).reshape(bh, 1, sq)
     dq3, dk3, dv3, dlog = _bwd_pallas(q3, k3, v3, do3, lse, delta, segq,
                                       segk, scale, causal, block_q, block_k,
-                                      interpret, bias=bias, h=h)
+                                      interpret, bias=bias, h=h,
+                                      dropout_rate=dropout_rate,
+                                      dropout_seed=dropout_seed)
     sq, d = q3.shape[1], q3.shape[2]
     sk = k3.shape[1]
     dq = dq3.reshape(b, h, sq, d)
@@ -705,7 +801,7 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
         # dlog arrives already reduced to the bias's broadcast class
         # ([B*, sq, sk] with B* = prod of bias's leading dims)
         dbias = dlog.reshape(bias.shape).astype(bias.dtype)
-    return dq, dk, dv, dbias, None
+    return dq, dk, dv, dbias, None, None
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -715,6 +811,8 @@ def flash_attention(q, k, v, *, causal: bool = False,
                     scale: Optional[float] = None,
                     segment_ids: Optional[jnp.ndarray] = None,
                     bias: Optional[jnp.ndarray] = None,
+                    dropout_rate: float = 0.0,
+                    dropout_seed=None,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K,
                     interpret: bool = False):
@@ -729,10 +827,27 @@ def flash_attention(q, k, v, *, causal: bool = False,
     q·k scale. Differentiable; the bias cotangent costs one O(s²) fp32
     buffer in backward (the same footprint unfused attention pays) — the
     bias-free path allocates nothing extra.
+
+    ``dropout_rate``/``dropout_seed``: fused softmax-probability dropout
+    (reference: fast_multihead_attn's fused softmax+dropout with philox
+    replay, N11). The mask is generated in-kernel from the hardware PRNG,
+    seeded per (batch·head, q-block, k-block) from ``dropout_seed`` (an
+    int32 scalar — vary it per training step; inside shard_map also fold
+    the shard's ``lax.axis_index`` into it, or every shard draws the same
+    mask field), and REPLAYED exactly in backward. On the CPU/interpret
+    fallback the mask comes from jax.random instead (same semantics,
+    different stream — matching how the reference's python and fused impls
+    differ). Hardware replay is covered by tests/tpu/ (self-skipping on
+    the CPU CI backend).
     """
     d = q.shape[-1]
     if scale is None:
         scale = 1.0 / (d ** 0.5)
+    if not 0.0 <= dropout_rate < 1.0:
+        raise ValueError(f"dropout_rate must be in [0, 1), got "
+                         f"{dropout_rate}")
+    if dropout_rate > 0.0 and dropout_seed is None:
+        raise ValueError("dropout_rate > 0 requires dropout_seed")
     sq, sk = q.shape[2], k.shape[2]
     # validated on EVERY path: the jnp fallback must reject exactly what the
     # Pallas path rejects, or aligned shapes would crash where unaligned ran
@@ -741,8 +856,12 @@ def flash_attention(q, k, v, *, causal: bool = False,
     bk = min(block_k, sk)
     if jax.default_backend() == "cpu":
         interpret = True  # pallas-TPU lowering needs a TPU; CPU interprets
-    if not _pallas_ok(sq, sk, d, bq, bk) or (interpret and _has_vma(q)):
+    if not _pallas_ok(sq, sk, d, bq, bk) or (interpret and _has_vma(q)) \
+            or (dropout_rate > 0.0 and interpret):
+        # interpret mode has no pltpu PRNG lowering → jnp dropout fallback
         return mha_reference(q, k, v, causal=causal, scale=scale,
-                             segment_ids=segment_ids, bias=bias)
-    return _flash(q, k, v, bias, segment_ids, causal, scale, bq, bk,
-                  interpret)
+                             segment_ids=segment_ids, bias=bias,
+                             dropout_rate=dropout_rate,
+                             dropout_seed=dropout_seed)
+    return _flash(q, k, v, bias, segment_ids, dropout_seed, causal, scale,
+                  bq, bk, interpret, dropout_rate)

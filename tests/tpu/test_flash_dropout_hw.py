@@ -1,0 +1,121 @@
+"""HARDWARE-ONLY test: in-kernel dropout mask replay on a real TPU.
+
+The CI suite (tests/conftest.py) forces the CPU backend, where the Pallas
+PRNG has no lowering and flash_attention's dropout dispatches to the
+jax.random fallback — so the kernel path's replay property (backward
+regenerates the forward's exact hardware mask per (bh, q-block, k-block))
+can only be checked on silicon. Run on a TPU-attached machine with
+apex_tpu importable (installed, or repo root on sys.path):
+
+    python -c "import sys; sys.path.insert(0, '.'); \
+               exec(open('tests/tpu/test_flash_dropout_hw.py').read())"
+
+or via pytest with a TPU backend (it self-skips on CPU; note the repo's
+tests/conftest.py forces CPU, so invoke pytest from outside tests/'s
+conftest scope to run it on hardware). A regression in
+the replay indexing (e.g. swapping _keep_mask's qi/ki in the transposed
+dkdv grid) fails this immediately while leaving the CPU suite green.
+"""
+
+import numpy as np
+
+
+def _mix_seed_np(seed, b, qi, ki):
+    """numpy replica of kernels.flash_attention._mix_seed."""
+    x = np.uint32(seed)
+    with np.errstate(over="ignore"):
+        for v, c in ((b, 0x9E3779B1), (qi, 0x85EBCA77), (ki, 0xC2B2AE3D)):
+            x = np.uint32((int(x) ^ int(np.uint32(v))) * c & 0xFFFFFFFF)
+            x = np.uint32(int(x) ^ (int(x) >> 16))
+    return np.int32(x)
+
+
+def test_dropout_replay_on_hardware():
+    import jax
+    import jax.numpy as jnp
+    import pytest
+
+    if jax.default_backend() == "cpu":
+        pytest.skip("hardware-PRNG path needs a real TPU backend")
+
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    from apex_tpu.kernels.flash_attention import flash_attention
+
+    B, H, S, D = 1, 2, 256, 64
+    BQ = BK = 128
+    R, SEED = 0.3, 21
+    k0 = jax.random.PRNGKey(0)
+    q = jax.random.normal(k0, (B, H, S, D), jnp.float32) * 0.5
+    k = jax.random.normal(jax.random.fold_in(k0, 1), (B, H, S, D),
+                          jnp.float32) * 0.5
+    v = jax.random.normal(jax.random.fold_in(k0, 2), (B, H, S, D),
+                          jnp.float32) * 0.5
+
+    f = jax.jit(lambda q, k, v: flash_attention(
+        q, k, v, causal=True, dropout_rate=R, dropout_seed=jnp.int32(SEED)))
+
+    # extract the kernel's per-block masks with the same seed derivation
+    def mask_kern(seed_ref, o_ref):
+        pltpu.prng_seed(seed_ref[0])
+        bits = pltpu.bitcast(pltpu.prng_random_bits((BQ, BK)), jnp.uint32)
+        thresh = min(int(R * 4294967296.0), 4294967295)
+        o_ref[...] = (bits >= jnp.uint32(thresh)).astype(jnp.int32)
+
+    def block_mask(mixed_seed):
+        return np.asarray(pl.pallas_call(
+            mask_kern,
+            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((BQ, BK), jnp.int32),
+        )(jnp.array([mixed_seed], jnp.int32))).astype(np.float64)
+
+    nq, nk, bh = S // BQ, S // BK, B * H
+    M = np.zeros((bh, S, S))
+    for b in range(bh):
+        for qi in range(nq):
+            for ki in range(nk):
+                M[b, qi * BQ:(qi + 1) * BQ, ki * BK:(ki + 1) * BK] = \
+                    block_mask(_mix_seed_np(SEED, b, qi, ki))
+
+    # analytic oracle with the extracted masks (fp64, loss = sum(o^2))
+    sc = 1 / np.sqrt(D)
+    qn = np.asarray(q, np.float64).reshape(bh, S, D)
+    kn = np.asarray(k, np.float64).reshape(bh, S, D)
+    vn = np.asarray(v, np.float64).reshape(bh, S, D)
+    tri = np.tril(np.ones((S, S)))
+    o_ref = np.zeros((bh, S, D))
+    dq_ref = np.zeros((bh, S, D))
+    dk_ref = np.zeros((bh, S, D))
+    dv_ref = np.zeros((bh, S, D))
+    for b in range(bh):
+        s = np.where(tri > 0, qn[b] @ kn[b].T * sc, -1e30)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        pd = p * M[b] / (1 - R)
+        o_ref[b] = pd @ vn[b]
+        do = 2 * o_ref[b]
+        dv_ref[b] = pd.T @ do
+        dphat = (do @ vn[b].T) * M[b] / (1 - R)
+        delta = (dphat * p).sum(-1, keepdims=True)
+        ds = p * (dphat - delta) * sc
+        dq_ref[b] = ds @ kn[b]
+        dk_ref[b] = ds.T @ qn[b]
+
+    out = np.asarray(f(q, k, v)).reshape(bh, S, D)
+    np.testing.assert_allclose(out, o_ref, atol=7e-3)
+
+    def loss(q, k, v):
+        return (f(q, k, v).astype(jnp.float32) ** 2).sum()
+
+    gq, gk, gv = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+    for name, g, ref in [("dq", gq, dq_ref), ("dk", gk, dk_ref),
+                         ("dv", gv, dv_ref)]:
+        rel = np.abs(np.asarray(g).reshape(bh, S, D) - ref).max() \
+            / (np.abs(ref).max() + 1e-9)
+        assert rel < 2e-2, (name, rel)
+
+
+if __name__ == "__main__":
+    test_dropout_replay_on_hardware()
+    print("HARDWARE DROPOUT REPLAY TEST PASSED")
