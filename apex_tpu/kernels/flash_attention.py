@@ -89,12 +89,11 @@ def _mix_seed(seed, b, qi, ki):
     return x.astype(jnp.int32)
 
 
-def _keep_mask(seed_ref, b, qi, ki, nq, nk, block_q, block_k, rate):
+def _keep_mask(seed_ref, b, qi, ki, block_q, block_k, rate):
     """Deterministic per-(bh, q-block, k-block) dropout keep-mask from the
     hardware PRNG. The seed formula is shared by the forward and BOTH
     backward kernels, so backward replays the exact forward mask (the
     reference kernels replay their philox state the same way, N11)."""
-    del nq, nk  # grid extents no longer enter the seed (hash mixing instead)
     pltpu.prng_seed(_mix_seed(seed_ref[0], b, qi, ki))
     bits = pltpu.bitcast(
         pltpu.prng_random_bits((block_q, block_k)), jnp.uint32)
@@ -153,8 +152,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, segq_ref, segk_ref, bias_ref, seed_ref,
         p_acc = p
         if dropout_rate > 0.0:
             keep = _keep_mask(seed_ref, pl.program_id(0), qi, ki,
-                              pl.num_programs(1), nk, block_q, block_k,
-                              dropout_rate)
+                              block_q, block_k, dropout_rate)
             p_acc = jnp.where(keep, p, 0.0)
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
             p_acc, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
@@ -217,8 +215,7 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if dropout_rate > 0.0:
             # replay the forward's mask: same seed formula, (qi, ki) order
             keep = _keep_mask(seed_ref, pl.program_id(0), qi, ki,
-                              nq, pl.num_programs(1), block_q, block_k,
-                              dropout_rate)
+                              block_q, block_k, dropout_rate)
             inv = 1.0 / (1.0 - dropout_rate)
             p_d = jnp.where(keep, p * inv, 0.0)
             dp = jnp.where(keep, dp * inv, 0.0)
@@ -293,8 +290,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                                  preferred_element_type=jnp.float32)
         if dropout_rate > 0.0:
             keep = _keep_mask(seed_ref, pl.program_id(0), qi, ki,
-                              pl.num_programs(1), nk, block_q, block_k,
-                              dropout_rate)
+                              block_q, block_k, dropout_rate)
             dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
         delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q)]
         dlogits = p * (dp - delta[:, None])       # d loss / d (scaled+bias)
@@ -357,7 +353,6 @@ def _dbias_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if dropout_rate > 0.0:
             bh_idx = bh_of(pl.program_id(0), pl.program_id(3))
             keep = _keep_mask(seed_ref, bh_idx, qi, ki,
-                              pl.num_programs(1), pl.num_programs(2),
                               block_q, block_k, dropout_rate)
             dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
         delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q)]

@@ -375,18 +375,31 @@ def ring_attention(q, k, v, *, axis_name: str = AXIS_CONTEXT,
 
 def ulysses_attention(q, k, v, *, axis_name: str = AXIS_CONTEXT,
                       causal: bool = False, scale: Optional[float] = None,
-                      segment_ids: Optional[jnp.ndarray] = None):
+                      segment_ids: Optional[jnp.ndarray] = None,
+                      bias: Optional[jnp.ndarray] = None,
+                      dropout_rate: float = 0.0, dropout_seed=None):
     """All-to-all (DeepSpeed-Ulysses-style) sequence-parallel attention.
 
     Seq-sharded [b, h, s/n, d] → head-sharded [b, h/n, s, d] via
     ``lax.all_to_all``, full-sequence flash attention locally, then the
     inverse all-to-all. Differentiable end-to-end (all_to_all transposes to
     itself); requires heads % axis_size == 0.
+
+    ``bias`` [b|1, h|1, S, S] covers the FULL sequence; the head dim must
+    be 1 (head-broadcast) — per-head bias would need an all-to-all of the
+    bias to follow its heads to their owning shard. ``dropout_rate``/
+    ``dropout_seed``: fused softmax dropout; the per-shard head slice makes
+    each shard's mask distinct automatically (the flash kernel seeds per
+    local batch·head, and the shard index is folded in here).
     """
     n = _axis_size(axis_name)
     h = q.shape[1]
     if h % n != 0:
         raise ValueError(f"heads ({h}) not divisible by axis size ({n})")
+    if bias is not None and bias.shape[1] != 1:
+        raise ValueError(
+            "ulysses_attention: per-head bias is not supported (heads "
+            "scatter across shards); use a [b|1, 1, S, S] bias")
     qh, kh, vh = (lax.all_to_all(t, axis_name, split_axis=1, concat_axis=2,
                                  tiled=True) for t in (q, k, v))
     if segment_ids is not None and segment_ids.shape[1] != qh.shape[2]:
@@ -394,7 +407,19 @@ def ulysses_attention(q, k, v, *, axis_name: str = AXIS_CONTEXT,
         # post-all_to_all attention runs over.
         segment_ids = lax.all_gather(segment_ids, axis_name, axis=1,
                                      tiled=True)
+    if dropout_rate > 0.0:
+        if dropout_seed is None:
+            raise ValueError("dropout_rate > 0 requires dropout_seed")
+        # distinct mask field per shard (each shard holds different heads).
+        # HASH the shard index in — linear addition would make shard k at
+        # step t collide with shard k+1 at step t-1 under the seed=step
+        # idiom, exactly the collision class _mix_seed exists to prevent.
+        from apex_tpu.kernels.flash_attention import _mix_seed
+        dropout_seed = _mix_seed(jnp.asarray(dropout_seed, jnp.int32),
+                                 lax.axis_index(axis_name), 0, 0)
     out = flash_attention(qh, kh, vh, causal=causal, scale=scale,
-                          segment_ids=segment_ids)
+                          segment_ids=segment_ids, bias=bias,
+                          dropout_rate=dropout_rate,
+                          dropout_seed=dropout_seed)
     return lax.all_to_all(out, axis_name, split_axis=2, concat_axis=1,
                           tiled=True)

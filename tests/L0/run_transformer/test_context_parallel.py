@@ -306,3 +306,57 @@ def test_ring_zigzag_rejects_odd_local_seq():
         jax.jit(fn)(q, q, q)
     with pytest.raises(ValueError, match="layout"):
         ring_attention(q, q, q, layout="spiral")
+
+
+# --------------------------------------------------- ulysses bias + dropout
+def test_ulysses_bias_matches_reference():
+    n = 4
+    mesh = _mesh(n)
+    q, k, v = _qkv(6)
+    bias = jax.random.normal(jax.random.PRNGKey(7), (B, 1, S, S)) * 0.3
+    want = mha_reference(q, k, v, causal=False, scale=1.0 / D ** 0.5,
+                         bias=bias)
+
+    fn = shard_map(
+        lambda q, k, v, b: ulysses_attention(q, k, v, causal=False, bias=b),
+        mesh=mesh,
+        in_specs=(P(None, None, AXIS, None),) * 3 + (P(),),
+        out_specs=P(None, None, AXIS, None))
+    got = jax.jit(fn)(q, k, v, bias)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_ulysses_per_head_bias_rejected():
+    n = 4
+    mesh = _mesh(n)
+    q, k, v = _qkv(6)
+    bias = jnp.zeros((B, H, S, S))
+    fn = shard_map(
+        lambda q, k, v, b: ulysses_attention(q, k, v, causal=False, bias=b),
+        mesh=mesh,
+        in_specs=(P(None, None, AXIS, None),) * 3 + (P(),),
+        out_specs=P(None, None, AXIS, None))
+    with pytest.raises(ValueError, match="per-head bias"):
+        jax.jit(fn)(q, k, v, bias)
+
+
+def test_ulysses_dropout_deterministic_and_sharded_heads_differ():
+    n = 4
+    mesh = _mesh(n)
+    q, k, v = _qkv(8)
+    fn = shard_map(
+        lambda q, k, v, s: ulysses_attention(q, k, v, causal=False,
+                                             dropout_rate=0.4,
+                                             dropout_seed=s),
+        mesh=mesh,
+        in_specs=(P(None, None, AXIS, None),) * 3 + (P(),),
+        out_specs=P(None, None, AXIS, None))
+    f = jax.jit(fn)
+    d1 = f(q, k, v, jnp.int32(5))
+    d1b = f(q, k, v, jnp.int32(5))
+    d2 = f(q, k, v, jnp.int32(6))
+    np.testing.assert_array_equal(np.asarray(d1), np.asarray(d1b))
+    assert not np.allclose(np.asarray(d1), np.asarray(d2))
+    base = f(q, k, v, jnp.int32(5))  # same seed -> deterministic again
+    np.testing.assert_array_equal(np.asarray(d1), np.asarray(base))
