@@ -672,7 +672,15 @@ def _bwd_pallas(q3, k3, v3, do3, lse, delta, segq, segk, scale, causal, bq, bk,
 
 
 # ------------------------------------------------- chunk API (ring attention)
-def _ref_chunk_fwd(q3, k3, v3, scale, causal):
+def _ref_chunk_keep(dropout_seed, shape, dropout_rate):
+    """Fallback-path keep mask: regenerated identically in chunk fwd and
+    bwd from the (deterministic) per-chunk-pair seed."""
+    key = jax.random.PRNGKey(jnp.asarray(dropout_seed, jnp.int32))
+    return jax.random.bernoulli(key, 1.0 - dropout_rate, shape)
+
+
+def _ref_chunk_fwd(q3, k3, v3, scale, causal, dropout_rate=0.0,
+                   dropout_seed=None):
     """jnp chunk forward returning (o fp32-normalized, lse fp32)."""
     q32, k32, v32 = (jnp.asarray(t, jnp.float32) for t in (q3, k3, v3))
     s = jnp.einsum("bqd,bkd->bqk", q32, k32) * scale
@@ -681,14 +689,21 @@ def _ref_chunk_fwd(q3, k3, v3, scale, causal):
         s = jnp.where(jnp.tril(jnp.ones((sq, sk), bool)), s, _NEG_INF)
     m = jnp.max(s, axis=-1)
     p = jnp.exp(s - m[..., None])
-    l = jnp.sum(p, axis=-1)
+    l = jnp.sum(p, axis=-1)          # normalizer stays UNDROPPED
     l_safe = jnp.where(l == 0.0, 1.0, l)
-    o = jnp.einsum("bqk,bkd->bqd", p, v32) / l_safe[..., None]
+    p_acc = p
+    denom = l_safe[..., None]
+    if dropout_rate > 0.0:
+        keep = _ref_chunk_keep(dropout_seed, p.shape, dropout_rate)
+        p_acc = jnp.where(keep, p, 0.0)
+        denom = denom * (1.0 - dropout_rate)
+    o = jnp.einsum("bqk,bkd->bqd", p_acc, v32) / denom
     lse = m + jnp.log(l_safe)
     return o, lse
 
 
-def _ref_chunk_bwd(q3, k3, v3, do3, lse, delta, scale, causal):
+def _ref_chunk_bwd(q3, k3, v3, do3, lse, delta, scale, causal,
+                   dropout_rate=0.0, dropout_seed=None):
     """jnp chunk backward given fwd residuals (lse [bh,s], delta=sum(do*o))."""
     q32, k32, v32 = (jnp.asarray(t, jnp.float32) for t in (q3, k3, v3))
     do32 = jnp.asarray(do3, jnp.float32)
@@ -697,8 +712,14 @@ def _ref_chunk_bwd(q3, k3, v3, do3, lse, delta, scale, causal):
         sq, sk = s.shape[-2], s.shape[-1]
         s = jnp.where(jnp.tril(jnp.ones((sq, sk), bool)), s, _NEG_INF)
     p = jnp.exp(s - lse[..., None])
-    dv = jnp.einsum("bqk,bqd->bkd", p, do32)
     dp = jnp.einsum("bqd,bkd->bqk", do32, v32)
+    p_d = p
+    if dropout_rate > 0.0:
+        keep = _ref_chunk_keep(dropout_seed, p.shape, dropout_rate)
+        inv = 1.0 / (1.0 - dropout_rate)
+        p_d = jnp.where(keep, p * inv, 0.0)
+        dp = jnp.where(keep, dp * inv, 0.0)
+    dv = jnp.einsum("bqk,bqd->bkd", p_d, do32)
     ds = p * (dp - delta[..., None]) * scale
     dq = jnp.einsum("bqk,bkd->bqd", ds, k32)
     dk = jnp.einsum("bqk,bqd->bkd", ds, q32)
@@ -707,6 +728,7 @@ def _ref_chunk_bwd(q3, k3, v3, do3, lse, delta, scale, causal):
 
 def attn_chunk_fwd(q3, k3, v3, *, scale, causal,
                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                   dropout_rate=0.0, dropout_seed=None,
                    interpret=False):
     """One attention block: [bh, sq, d] x [bh, sk, d] -> (o fp32, lse fp32).
 
@@ -714,28 +736,39 @@ def attn_chunk_fwd(q3, k3, v3, *, scale, causal,
     kernel is blockwise over KV precisely so context parallelism can reuse
     it). Output is softmax-normalized *within the chunk*; ``lse`` lets the
     caller re-weight when combining chunks (o, lse) -> global softmax.
+
+    ``dropout_rate``/``dropout_seed``: fused softmax dropout; the caller
+    must pass a seed unique per (ring step, chunk pair) — ring attention
+    derives it via _mix_seed — and the SAME seed to attn_chunk_bwd so the
+    mask replays.
     """
     sq, sk, d = q3.shape[1], k3.shape[1], q3.shape[2]
     bq, bk = min(block_q, sq), min(block_k, sk)
     if jax.default_backend() == "cpu":
         interpret = True
-    if not _pallas_ok(sq, sk, d, bq, bk) or (interpret and _has_vma(q3)):
-        return _ref_chunk_fwd(q3, k3, v3, scale, causal)
+    if not _pallas_ok(sq, sk, d, bq, bk) or (interpret and _has_vma(q3)) \
+            or (dropout_rate > 0.0 and interpret):
+        return _ref_chunk_fwd(q3, k3, v3, scale, causal, dropout_rate,
+                              dropout_seed)
     o3, lse = _fwd_pallas(q3, k3, v3, None, None, scale, causal, bq, bk,
-                          interpret)
+                          interpret, dropout_rate=dropout_rate,
+                          dropout_seed=dropout_seed)
     return jnp.asarray(o3, jnp.float32), lse[:, 0, :]
 
 
 def attn_chunk_bwd(q3, k3, v3, do3, lse, delta, *, scale, causal,
                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                   dropout_rate=0.0, dropout_seed=None,
                    interpret=False):
     """Chunk backward given residuals; returns fp32 (dq, dk, dv)."""
     sq, sk, d = q3.shape[1], k3.shape[1], q3.shape[2]
     bq, bk = min(block_q, sq), min(block_k, sk)
     if jax.default_backend() == "cpu":
         interpret = True
-    if not _pallas_ok(sq, sk, d, bq, bk) or (interpret and _has_vma(q3)):
-        return _ref_chunk_bwd(q3, k3, v3, do3, lse, delta, scale, causal)
+    if not _pallas_ok(sq, sk, d, bq, bk) or (interpret and _has_vma(q3)) \
+            or (dropout_rate > 0.0 and interpret):
+        return _ref_chunk_bwd(q3, k3, v3, do3, lse, delta, scale, causal,
+                              dropout_rate, dropout_seed)
     # _bwd_pallas recomputes p from lse and reads delta directly; o3 itself
     # is not needed once delta is in hand, so pass delta through. Inputs keep
     # their storage dtype (the kernels upcast per-tile); only the outputs are
@@ -745,7 +778,9 @@ def attn_chunk_bwd(q3, k3, v3, do3, lse, delta, *, scale, causal,
     dq, dk, dv, _ = _bwd_pallas(q3, k3, v3, do3, lse3,
                                 delta.reshape(bh, 1, sq), None, None,
                                 scale, causal, bq, bk, interpret,
-                                out_dtype=jnp.float32)
+                                out_dtype=jnp.float32,
+                                dropout_rate=dropout_rate,
+                                dropout_seed=dropout_seed)
     return dq, dk, dv
 
 

@@ -34,8 +34,8 @@ from jax import lax
 
 from apex_tpu.comm import AXIS_CONTEXT
 from apex_tpu.kernels.flash_attention import (_flatten as _flat, _match_vma,
-                                              attn_chunk_bwd, attn_chunk_fwd,
-                                              flash_attention)
+                                              _mix_seed, attn_chunk_bwd,
+                                              attn_chunk_fwd, flash_attention)
 
 __all__ = ["ring_attention", "ulysses_attention", "AXIS_CONTEXT",
            "zigzag_order", "zigzag_inverse"]
@@ -71,28 +71,43 @@ def _rotate(tree, axis_name, n):
     return jax.tree.map(lambda x: lax.ppermute(x, axis_name, perm), tree)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _ring(q, k, v, axis_name, causal, scale):
-    out, _ = _ring_fwd(q, k, v, axis_name, causal, scale)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _ring(q, k, v, dropout_seed, axis_name, causal, scale, dropout_rate):
+    out, _ = _ring_fwd(q, k, v, dropout_seed, axis_name, causal, scale,
+                       dropout_rate)
     return out
 
 
-def _chunk_cases(q3, k3, v3, causal, scale, kv_idx, my_idx):
+def _pair_seed(dropout_seed, kv_idx, my_idx):
+    """Per-(q-chunk, kv-chunk) dropout seed: HASHED so no two ring pairs
+    (or steps, under the seed=step idiom) share a mask field; the same
+    derivation in forward and backward replays the mask."""
+    if dropout_seed is None:
+        return None
+    return _mix_seed(jnp.asarray(dropout_seed, jnp.int32), my_idx, kv_idx, 1)
+
+
+def _chunk_cases(q3, k3, v3, causal, scale, kv_idx, my_idx,
+                 dropout_rate=0.0, dropout_seed=None):
     """(o, lse) for one ring step, dispatching on the chunk relation.
 
     With contiguous sequence chunks, chunk j is entirely *before* chunk i in
     global positions when j < i → unmasked; j == i → local causal mask;
     j > i → fully masked out (skip). Non-causal always takes the full path.
     """
+    seed = _pair_seed(dropout_seed, kv_idx, my_idx)
     if not causal:
-        return attn_chunk_fwd(q3, k3, v3, scale=scale, causal=False)
+        return attn_chunk_fwd(q3, k3, v3, scale=scale, causal=False,
+                              dropout_rate=dropout_rate, dropout_seed=seed)
     bh, s, d = q3.shape
 
     def full(_):
-        return attn_chunk_fwd(q3, k3, v3, scale=scale, causal=False)
+        return attn_chunk_fwd(q3, k3, v3, scale=scale, causal=False,
+                              dropout_rate=dropout_rate, dropout_seed=seed)
 
     def diag(_):
-        return attn_chunk_fwd(q3, k3, v3, scale=scale, causal=True)
+        return attn_chunk_fwd(q3, k3, v3, scale=scale, causal=True,
+                              dropout_rate=dropout_rate, dropout_seed=seed)
 
     def skip(_):
         return (_vary_like(jnp.zeros((bh, s, d), jnp.float32), q3, k3),
@@ -103,21 +118,25 @@ def _chunk_cases(q3, k3, v3, causal, scale, kv_idx, my_idx):
 
 
 def _chunk_bwd_cases(q3, k3, v3, do3, lse, delta, causal, scale, kv_idx,
-                     my_idx):
+                     my_idx, dropout_rate=0.0, dropout_seed=None):
     """(dq, dk, dv) for one chunk pair, dispatching on the chunk relation —
     the backward mirror of :func:`_chunk_cases`; shared by the contiguous
     and zigzag rings."""
+    seed = _pair_seed(dropout_seed, kv_idx, my_idx)
     if not causal:
         return attn_chunk_bwd(q3, k3, v3, do3, lse, delta,
-                              scale=scale, causal=False)
+                              scale=scale, causal=False,
+                              dropout_rate=dropout_rate, dropout_seed=seed)
 
     def full(_):
         return attn_chunk_bwd(q3, k3, v3, do3, lse, delta,
-                              scale=scale, causal=False)
+                              scale=scale, causal=False,
+                              dropout_rate=dropout_rate, dropout_seed=seed)
 
     def diag(_):
         return attn_chunk_bwd(q3, k3, v3, do3, lse, delta,
-                              scale=scale, causal=True)
+                              scale=scale, causal=True,
+                              dropout_rate=dropout_rate, dropout_seed=seed)
 
     def skip(_):
         return (_vary_like(jnp.zeros(q3.shape, jnp.float32), q3, k3),
@@ -129,7 +148,8 @@ def _chunk_bwd_cases(q3, k3, v3, do3, lse, delta, causal, scale, kv_idx,
     return lax.switch(branch, [full, diag, skip], None)
 
 
-def _ring_fwd(q, k, v, axis_name, causal, scale):
+def _ring_fwd(q, k, v, dropout_seed, axis_name, causal, scale,
+              dropout_rate):
     n = _axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     b, h, s, d = q.shape
@@ -137,7 +157,8 @@ def _ring_fwd(q, k, v, axis_name, causal, scale):
 
     def compute(t, o_run, lse_run, k_cur, v_cur):
         kv_idx = (idx - t) % n
-        o_t, lse_t = _chunk_cases(q3, k_cur, v_cur, causal, scale, kv_idx, idx)
+        o_t, lse_t = _chunk_cases(q3, k_cur, v_cur, causal, scale, kv_idx,
+                                  idx, dropout_rate, dropout_seed)
         return _combine(o_run, lse_run, o_t, lse_t)
 
     def step(t, carry):
@@ -156,11 +177,11 @@ def _ring_fwd(q, k, v, axis_name, causal, scale):
         0, n - 1, step, (o0, lse0, k3, v3))
     o3, lse = compute(n - 1, o_run, lse_run, k_last, v_last)
     out = o3.astype(q.dtype).reshape(b, h, s, d)
-    return out, (q3, k3, v3, o3, lse)
+    return out, (q3, k3, v3, o3, lse, dropout_seed)
 
 
-def _ring_bwd(axis_name, causal, scale, res, g):
-    q3, k3, v3, o3, lse = res
+def _ring_bwd(axis_name, causal, scale, dropout_rate, res, g):
+    q3, k3, v3, o3, lse, dropout_seed = res
     b, h = g.shape[0], g.shape[1]
     n = _axis_size(axis_name)
     idx = lax.axis_index(axis_name)
@@ -171,7 +192,7 @@ def _ring_bwd(axis_name, causal, scale, res, g):
         kv_idx = (idx - t) % n
         dq_t, dk_t, dv_t = _chunk_bwd_cases(q3, k_cur, v_cur, do3, lse,
                                             delta, causal, scale, kv_idx,
-                                            idx)
+                                            idx, dropout_rate, dropout_seed)
         return dq + dq_t, dk_acc + dk_t, dv_acc + dv_t
 
     def step(t, carry):
@@ -195,7 +216,7 @@ def _ring_bwd(axis_name, causal, scale, res, g):
     s, d = q3.shape[1], q3.shape[2]
     return (dq.astype(q3.dtype).reshape(b, h, s, d),
             dk.astype(k3.dtype).reshape(b, h, k3.shape[1], d),
-            dv.astype(v3.dtype).reshape(b, h, v3.shape[1], d))
+            dv.astype(v3.dtype).reshape(b, h, v3.shape[1], d), None)
 
 
 _ring.defvjp(_ring_fwd, _ring_bwd)
@@ -228,13 +249,15 @@ def _zz_halves(x3, half):
     return x3[:, :half], x3[:, half:]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _ring_zz(q, k, v, axis_name, causal, scale):
-    out, _ = _ring_zz_fwd(q, k, v, axis_name, causal, scale)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _ring_zz(q, k, v, dropout_seed, axis_name, causal, scale, dropout_rate):
+    out, _ = _ring_zz_fwd(q, k, v, dropout_seed, axis_name, causal, scale,
+                          dropout_rate)
     return out
 
 
-def _ring_zz_fwd(q, k, v, axis_name, causal, scale):
+def _ring_zz_fwd(q, k, v, dropout_seed, axis_name, causal, scale,
+                 dropout_rate):
     n = _axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     b, h, s, d = q.shape
@@ -248,13 +271,17 @@ def _ring_zz_fwd(q, k, v, axis_name, causal, scale):
         ka, kb = _zz_halves(k_cur, half)
         va, vb = _zz_halves(v_cur, half)
         ka_idx, kb_idx = r, 2 * n - 1 - r
-        o_t, l_t = _chunk_cases(qa, ka, va, causal, scale, ka_idx, qa_idx)
+        o_t, l_t = _chunk_cases(qa, ka, va, causal, scale, ka_idx, qa_idx,
+                                dropout_rate, dropout_seed)
         oa, la = _combine(oa, la, o_t, l_t)
-        o_t, l_t = _chunk_cases(qa, kb, vb, causal, scale, kb_idx, qa_idx)
+        o_t, l_t = _chunk_cases(qa, kb, vb, causal, scale, kb_idx, qa_idx,
+                                dropout_rate, dropout_seed)
         oa, la = _combine(oa, la, o_t, l_t)
-        o_t, l_t = _chunk_cases(qb, ka, va, causal, scale, ka_idx, qb_idx)
+        o_t, l_t = _chunk_cases(qb, ka, va, causal, scale, ka_idx, qb_idx,
+                                dropout_rate, dropout_seed)
         ob, lb = _combine(ob, lb, o_t, l_t)
-        o_t, l_t = _chunk_cases(qb, kb, vb, causal, scale, kb_idx, qb_idx)
+        o_t, l_t = _chunk_cases(qb, kb, vb, causal, scale, kb_idx, qb_idx,
+                                dropout_rate, dropout_seed)
         ob, lb = _combine(ob, lb, o_t, l_t)
         return oa, la, ob, lb
 
@@ -272,11 +299,11 @@ def _ring_zz_fwd(q, k, v, axis_name, causal, scale):
     o3 = jnp.concatenate([oa, ob], axis=1)
     lse = jnp.concatenate([la, lb], axis=1)
     out = o3.astype(q.dtype).reshape(b, h, s, d)
-    return out, (q3, k3, v3, o3, lse)
+    return out, (q3, k3, v3, o3, lse, dropout_seed)
 
 
-def _ring_zz_bwd(axis_name, causal, scale, res, g):
-    q3, k3, v3, o3, lse = res
+def _ring_zz_bwd(axis_name, causal, scale, dropout_rate, res, g):
+    q3, k3, v3, o3, lse, dropout_seed = res
     b, h = g.shape[0], g.shape[1]
     n = _axis_size(axis_name)
     idx = lax.axis_index(axis_name)
@@ -297,16 +324,20 @@ def _ring_zz_bwd(axis_name, causal, scale, res, g):
         va, vb = _zz_halves(v_cur, half)
         ka_idx, kb_idx = r, 2 * n - 1 - r
         dq_t, dka1, dva1 = _chunk_bwd_cases(qa, ka, va, doa, lsa, dea,
-                                        causal, scale, ka_idx, qa_idx)
+                                            causal, scale, ka_idx, qa_idx,
+                                            dropout_rate, dropout_seed)
         dqa = dqa + dq_t
         dq_t, dkb1, dvb1 = _chunk_bwd_cases(qa, kb, vb, doa, lsa, dea,
-                                        causal, scale, kb_idx, qa_idx)
+                                            causal, scale, kb_idx, qa_idx,
+                                            dropout_rate, dropout_seed)
         dqa = dqa + dq_t
         dq_t, dka2, dva2 = _chunk_bwd_cases(qb, ka, va, dob, lsb, deb,
-                                        causal, scale, ka_idx, qb_idx)
+                                            causal, scale, ka_idx, qb_idx,
+                                            dropout_rate, dropout_seed)
         dqb = dqb + dq_t
         dq_t, dkb2, dvb2 = _chunk_bwd_cases(qb, kb, vb, dob, lsb, deb,
-                                        causal, scale, kb_idx, qb_idx)
+                                            causal, scale, kb_idx, qb_idx,
+                                            dropout_rate, dropout_seed)
         dqb = dqb + dq_t
         dk_t = jnp.concatenate([dka1 + dka2, dkb1 + dkb2], axis=1)
         dv_t = jnp.concatenate([dva1 + dva2, dvb1 + dvb2], axis=1)
@@ -332,7 +363,7 @@ def _ring_zz_bwd(axis_name, causal, scale, res, g):
 
     return (dq.astype(q3.dtype).reshape(b, h, s, d),
             dk.astype(k3.dtype).reshape(b, h, s, d),
-            dv.astype(v3.dtype).reshape(b, h, s, d))
+            dv.astype(v3.dtype).reshape(b, h, s, d), None)
 
 
 _ring_zz.defvjp(_ring_zz_fwd, _ring_zz_bwd)
@@ -340,7 +371,8 @@ _ring_zz.defvjp(_ring_zz_fwd, _ring_zz_bwd)
 
 def ring_attention(q, k, v, *, axis_name: str = AXIS_CONTEXT,
                    causal: bool = False, scale: Optional[float] = None,
-                   layout: str = "contiguous"):
+                   layout: str = "contiguous",
+                   dropout_rate: float = 0.0, dropout_seed=None):
     """Exact ring attention over a context-parallel mesh axis.
 
     q, k, v: [batch, heads, local_seq, head_dim], sequence sharded over
@@ -359,16 +391,27 @@ def ring_attention(q, k, v, *, axis_name: str = AXIS_CONTEXT,
     """
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
+    if not 0.0 <= dropout_rate < 1.0:
+        raise ValueError(
+            f"dropout_rate must be in [0, 1), got {dropout_rate}")
+    if dropout_rate > 0.0 and dropout_seed is None:
+        raise ValueError("dropout_rate > 0 requires dropout_seed")
+    # no per-shard seed fold needed (unlike ulysses): every (q-chunk,
+    # kv-chunk) pair seed hashes in the GLOBAL chunk ids via _pair_seed,
+    # and each rank owns distinct q chunks — mask fields are already
+    # rank-distinct and replay on the rank that computed them
     if layout == "contiguous" or (layout == "zigzag" and not causal):
         # non-causal attention is layout-invariant: the contiguous ring
         # computes the identical result in one full-chunk pass per step
         # instead of four half-chunk passes
-        return _ring(q, k, v, axis_name, causal, float(scale))
+        return _ring(q, k, v, dropout_seed, axis_name, causal, float(scale),
+                     dropout_rate)
     if layout == "zigzag":
         if q.shape[2] % 2:
             raise ValueError(
                 f"zigzag layout needs an even local_seq, got {q.shape[2]}")
-        return _ring_zz(q, k, v, axis_name, causal, float(scale))
+        return _ring_zz(q, k, v, dropout_seed, axis_name, causal,
+                        float(scale), dropout_rate)
     raise ValueError(f"unknown ring layout {layout!r} "
                      "(expected 'contiguous' or 'zigzag')")
 
