@@ -742,6 +742,8 @@ def attn_chunk_fwd(q3, k3, v3, *, scale, causal,
     derives it via _mix_seed — and the SAME seed to attn_chunk_bwd so the
     mask replays.
     """
+    if dropout_rate > 0.0 and dropout_seed is None:
+        raise ValueError("dropout_rate > 0 requires dropout_seed")
     sq, sk, d = q3.shape[1], k3.shape[1], q3.shape[2]
     bq, bk = min(block_q, sq), min(block_k, sk)
     if jax.default_backend() == "cpu":
@@ -761,6 +763,8 @@ def attn_chunk_bwd(q3, k3, v3, do3, lse, delta, *, scale, causal,
                    dropout_rate=0.0, dropout_seed=None,
                    interpret=False):
     """Chunk backward given residuals; returns fp32 (dq, dk, dv)."""
+    if dropout_rate > 0.0 and dropout_seed is None:
+        raise ValueError("dropout_rate > 0 requires dropout_seed")
     sq, sk, d = q3.shape[1], k3.shape[1], q3.shape[2]
     bq, bk = min(block_q, sq), min(block_k, sk)
     if jax.default_backend() == "cpu":

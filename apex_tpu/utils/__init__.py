@@ -1,4 +1,5 @@
 from .checkpoint import (AsyncCheckpointer, latest_checkpoint,  # noqa: F401
                          load_checkpoint, save_checkpoint)
 from .pytree import flatten, unflatten, flatten_tree, unflatten_tree  # noqa: F401
-from .sharded_checkpoint import load_sharded, save_sharded  # noqa: F401
+from .sharded_checkpoint import (AsyncShardedCheckpointer,  # noqa: F401
+                                 load_sharded, save_sharded)

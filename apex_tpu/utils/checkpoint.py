@@ -143,7 +143,41 @@ def _snapshot(state):
     return jax.tree_util.tree_unflatten(treedef, copies)
 
 
-class AsyncCheckpointer:
+class AsyncWriterBase:
+    """One-in-flight background writer: ``_submit(fn, *args)`` runs ``fn``
+    on a worker thread after waiting out the previous write; ``wait()``
+    joins and RE-RAISES any write failure (a swallowed error would report
+    phantom checkpoints). Subclasses do their snapshot copies on the
+    caller's thread before submitting — the copies must complete before the
+    next donating step reuses the buffers."""
+
+    def __init__(self):
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+
+    def _run(self, fn, args):
+        try:
+            fn(*args)
+        except BaseException as e:  # surfaced from wait()/next save()
+            self._error = e
+
+    def _submit(self, fn, *args):
+        self.wait()
+        self._thread = threading.Thread(target=self._run, args=(fn, args),
+                                        daemon=True)
+        self._thread.start()
+
+    def wait(self):
+        """Block until the in-flight write finishes; re-raise its failure."""
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+
+
+class AsyncCheckpointer(AsyncWriterBase):
     """Background-thread checkpoint writer (orbax-style async save).
 
     Device→host transfer + snapshot copy happen on the caller's thread
@@ -152,31 +186,7 @@ class AsyncCheckpointer:
     happens on a worker thread so the train loop never blocks on disk.
     """
 
-    def __init__(self):
-        self._thread: Optional[threading.Thread] = None
-        self._error: Optional[BaseException] = None
-
-    def _write(self, path, state, step, extra):
-        try:
-            save_checkpoint(path, state, step, extra)
-        except BaseException as e:  # surfaced from wait()/next save()
-            self._error = e
-
     def save(self, path: str, state: Any, step: int = 0,
              extra: Optional[dict] = None):
         host_state = _snapshot(state)
-        self.wait()
-        self._thread = threading.Thread(
-            target=self._write, args=(path, host_state, step, extra),
-            daemon=True)
-        self._thread.start()
-
-    def wait(self):
-        """Block until the in-flight write finishes; re-raise its failure —
-        a swallowed write error would report phantom checkpoints."""
-        if self._thread is not None:
-            self._thread.join()
-            self._thread = None
-        if self._error is not None:
-            err, self._error = self._error, None
-            raise err
+        self._submit(save_checkpoint, path, host_state, step, extra)

@@ -38,9 +38,10 @@ from typing import Any
 import jax
 import numpy as np
 
+from .checkpoint import AsyncWriterBase
 from .pytree import host_flatten
 
-__all__ = ["save_sharded", "load_sharded"]
+__all__ = ["save_sharded", "load_sharded", "AsyncShardedCheckpointer"]
 
 _META = "sharded_meta.json"
 _STEP_KEY = "__step__"
@@ -60,16 +61,10 @@ def _slice_spec(index, shape):
     return spec
 
 
-def save_sharded(directory: str, state: Any, step: int = 0) -> str:
-    """Write this process's shards of ``state`` under ``directory``.
-
-    Every process must call this with the same ``step`` (collective-like,
-    but no communication happens); process 0 additionally writes the
-    metadata file naming the exact file set a restore must see.
-    """
-    os.makedirs(directory, exist_ok=True)
+def _collect_shards(state: Any, step: int):
+    """Device→host snapshot of this process's shards: the guaranteed-copy
+    phase that must complete before any donating step reuses the buffers."""
     leaves, _ = jax.tree_util.tree_flatten(state)
-
     payload = {_STEP_KEY: np.asarray(step, np.int64)}
     meta_leaves = []
     for i, leaf in enumerate(leaves):
@@ -91,8 +86,12 @@ def save_sharded(directory: str, state: Any, step: int = 0) -> str:
             # survive the npy descr; dtype is recovered from the metadata
             payload[key] = data.reshape(-1).view(np.uint8)
             payload[key + "_idx"] = np.asarray(spec, np.int64).reshape(-1, 2)
+    return payload, meta_leaves
 
-    pidx = jax.process_index()
+
+def _write_shards(directory: str, payload: dict, meta_leaves, step: int,
+                  pidx: int, n_processes: int) -> str:
+    os.makedirs(directory, exist_ok=True)
     path = os.path.join(directory, f"shards_p{pidx}.npz")
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
@@ -102,13 +101,38 @@ def save_sharded(directory: str, state: Any, step: int = 0) -> str:
     if pidx == 0:
         # tree structure comes from the restore-side template (same contract
         # as load_checkpoint: you load into an already-constructed state)
-        meta = {"step": step, "n_leaves": len(leaves),
-                "n_processes": jax.process_count(), "leaves": meta_leaves}
+        meta = {"step": step, "n_leaves": len(meta_leaves),
+                "n_processes": n_processes, "leaves": meta_leaves}
         mtmp = os.path.join(directory, _META + ".tmp")
         with open(mtmp, "w") as f:
             json.dump(meta, f)
         os.replace(mtmp, os.path.join(directory, _META))
     return path
+
+
+def save_sharded(directory: str, state: Any, step: int = 0) -> str:
+    """Write this process's shards of ``state`` under ``directory``.
+
+    Every process must call this with the same ``step`` (collective-like,
+    but no communication happens); process 0 additionally writes the
+    metadata file naming the exact file set a restore must see.
+    """
+    payload, meta_leaves = _collect_shards(state, step)
+    return _write_shards(directory, payload, meta_leaves, step,
+                         jax.process_index(), jax.process_count())
+
+
+class AsyncShardedCheckpointer(AsyncWriterBase):
+    """Background-thread sharded writer (the AsyncCheckpointer pattern over
+    :func:`save_sharded`): the device→host snapshot copies happen on the
+    caller's thread — required before the next donating step — and the
+    npz/metadata writes happen on a worker thread so the train loop never
+    blocks on disk."""
+
+    def save(self, directory: str, state: Any, step: int = 0):
+        payload, meta_leaves = _collect_shards(state, step)
+        self._submit(_write_shards, directory, payload, meta_leaves, step,
+                     jax.process_index(), jax.process_count())
 
 
 def _normalize_index(index, shape):

@@ -103,3 +103,38 @@ def test_rms_norm_fuzz(args):
     ref = rms_norm_reference(x, w)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+# ------------------------------------------------------------- xentropy
+from apex_tpu.kernels.xentropy import (softmax_cross_entropy_loss,
+                                       xent_reference)
+
+
+@st.composite
+def xent_inputs(draw):
+    n = draw(st.sampled_from([1, 3, 8, 16, 128]))
+    v = draw(st.sampled_from([2, 10, 128, 513, 1024]))
+    seed = draw(st.integers(0, 2**31 - 1))
+    smoothing = draw(st.sampled_from([0.0, 0.1]))
+    scale = draw(st.sampled_from([1.0, 10.0]))
+    rng = np.random.RandomState(seed)
+    logits = jnp.asarray(rng.randn(n, v) * scale, jnp.float32)
+    labels = jnp.asarray(rng.randint(0, v, size=n), jnp.int32)
+    return logits, labels, smoothing
+
+
+@given(xent_inputs())
+@settings(**_SETTINGS)
+def test_xentropy_fuzz(args):
+    logits, labels, smoothing = args
+    loss = softmax_cross_entropy_loss(logits, labels, smoothing)
+    ref = xent_reference(logits, labels, smoothing)
+    np.testing.assert_allclose(np.asarray(loss), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    # grads vs autodiff of the reference
+    g1 = jax.grad(lambda lg: softmax_cross_entropy_loss(
+        lg, labels, smoothing).sum())(logits)
+    g2 = jax.grad(lambda lg: xent_reference(
+        lg, labels, smoothing).sum())(logits)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                               rtol=1e-4, atol=1e-5)

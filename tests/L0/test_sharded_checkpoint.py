@@ -146,3 +146,25 @@ def test_amp_state_roundtrip(mesh, tmp_path):
     for a, b in zip(jax.tree_util.tree_leaves(next_a.params),
                     jax.tree_util.tree_leaves(next_b.params)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_async_sharded_checkpointer(mesh, tmp_path):
+    """Async variant: snapshot on the caller's thread, write in background;
+    wait() surfaces write failures; result identical to the sync save."""
+    from apex_tpu.utils.sharded_checkpoint import AsyncShardedCheckpointer
+
+    state, ref = _sharded_state(mesh)
+    ck = AsyncShardedCheckpointer()
+    ck.save(str(tmp_path), state, step=9)
+    ck.wait()
+    restored, step = load_sharded(str(tmp_path), state)
+    assert step == 9
+    np.testing.assert_array_equal(np.asarray(restored["w"]), ref["w"])
+
+    # write failure surfaces on wait (unwritable directory)
+    bad = tmp_path / "f"
+    bad.write_text("not a dir")
+    ck2 = AsyncShardedCheckpointer()
+    ck2.save(str(bad), state, step=1)
+    with pytest.raises(Exception):
+        ck2.wait()
