@@ -7,9 +7,12 @@ residual-add fusion — the *_norm_add_* kernel variants) and impl='default'
 (pure-torch reference alongside).
 
 TPU: one flax module per reference class; the fused attention core is the
-flash-attention Pallas kernel; pre-LN fusion is the fused LN kernel; dropout
-uses functional flax rngs. ``impl`` is kept for API parity — 'fast' and
-'default' produce the same math here (XLA fuses the 'default' path too).
+flash-attention Pallas kernel; pre-LN fusion is the fused LN kernel. Like
+the reference, ``impl`` selects the engine: 'fast' (default) runs the flash
+kernel — including fused softmax-dropout with hardware-PRNG replay, additive
+masks, and key-padding masks (as additive key bias) — and 'default' keeps
+the explicit-probs softmax composition (the reference's python impls; same
+math, materialized probabilities, flax-rng dropout stream).
 """
 
 from __future__ import annotations
@@ -41,17 +44,33 @@ def _merge_heads(x):
 
 def _attend(module, qh, kh, vh, *, causal, scale, key_padding_mask,
             dropout, is_training, attn_mask=None):
-    """Fused path when possible; explicit-probs path when the reference
-    semantics need the softmax matrix (prob dropout — the reference's fused
-    softmax+dropout kernel — or a padding mask). ``attn_mask`` is the
-    ADDITIVE float mask of the reference's *_additive_mask_* variants
-    ([b|1, h|1, sq, sk], added to the scaled logits) and rides the flash
-    kernel's bias path."""
+    """Fused path (impl='fast'): everything — softmax+dropout with in-kernel
+    philox-replay semantics, additive masks, AND key-padding masks — runs
+    through the flash kernel; a padding mask becomes an additive −inf bias
+    on the masked KEYS, which is exactly the reference's semantics (padded
+    queries still attend normally; their outputs are garbage the caller
+    masks, same as apex). impl='default' keeps the explicit-probs softmax
+    composition, like the reference's python fallback impls.
+
+    ``attn_mask`` is the ADDITIVE float mask of the reference's
+    *_additive_mask_* variants ([b|1, h|1, sq, sk], added to the scaled
+    logits)."""
+    if module.impl not in ("fast", "default"):
+        raise ValueError(
+            f"impl must be 'fast' or 'default', got {module.impl!r} "
+            "(the reference asserts the same)")
     use_dropout = dropout > 0.0 and is_training
-    if key_padding_mask is None:
-        # fused path, including fused softmax+dropout (the reference's
-        # fast_self_attn philox-replay kernel): the in-kernel mask is
-        # seeded from this module's dropout rng per call
+    if module.impl == "fast":
+        bias = attn_mask
+        if key_padding_mask is not None:
+            b = qh.shape[0]
+            sq, sk = qh.shape[2], kh.shape[2]
+            # full [sq, sk] plane (kernel bias contract) — b×sq×sk fp32,
+            # h× smaller than the explicit path's per-head prob matrix
+            pad = jnp.where(key_padding_mask[:, None, None, :], -1e30, 0.0)
+            pad = jnp.broadcast_to(pad.astype(jnp.float32), (b, 1, sq, sk))
+            bias = pad if bias is None else jnp.asarray(bias,
+                                                        jnp.float32) + pad
         seed = None
         rate = 0.0
         if use_dropout:
@@ -59,7 +78,7 @@ def _attend(module, qh, kh, vh, *, causal, scale, key_padding_mask,
             seed = jax.random.randint(
                 module.make_rng("dropout"), (), 0, 2 ** 31 - 1, jnp.int32)
         return flash_attention(qh, kh, vh, causal=causal, scale=scale,
-                               bias=attn_mask, dropout_rate=rate,
+                               bias=bias, dropout_rate=rate,
                                dropout_seed=seed)
     s = jnp.einsum("bhqd,bhkd->bhqk", jnp.asarray(qh, jnp.float32),
                    jnp.asarray(kh, jnp.float32)) * scale
