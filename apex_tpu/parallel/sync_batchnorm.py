@@ -8,10 +8,15 @@ normalizes; backward all_reduces (sum_dy, sum_dy_xmu).
 
 TPU mapping: the per-device moment computation is one fused XLA reduction, the
 cross-rank Welford combine collapses to ``psum`` of (sum, sum-of-squares,
-count) over the named axis — algebraically identical to Welford combination
-with equal-count shards and numerically done in fp32. Backward needs no custom
-kernel at all: the psums sit inside the autodiff graph, so XLA derives exactly
-apex's batchnorm_backward allreduce pattern (the transpose of psum is psum).
+count) over the named axis — algebraically identical to the count-weighted
+Welford combination (csrc/welford.cu — welford_parallel_CUDA weights each
+rank's contribution by its element count) and numerically done in fp32. Under
+SPMD every rank's *shape* is identical, so unequal counts enter through the
+optional ``mask`` argument (ragged last batches padded to shape): masked
+elements are excluded from the statistics but still normalized. Backward needs
+no custom kernel at all: the psums sit inside the autodiff graph, so XLA
+derives exactly apex's batchnorm_backward allreduce pattern (the transpose of
+psum is psum).
 
 Process groups (apex/parallel/__init__.py — create_syncbn_process_group's
 ``group_size``) map to ``axis_index_groups``: stats sync within fixed-size
@@ -66,7 +71,8 @@ class SyncBatchNorm(nn.Module):
     axis_index_groups: Optional[Sequence[Sequence[int]]] = None
 
     @nn.compact
-    def __call__(self, x, use_running_average: Optional[bool] = None):
+    def __call__(self, x, use_running_average: Optional[bool] = None,
+                 mask=None):
         use_running_average = nn.merge_param(
             "use_running_average", self.use_running_average,
             use_running_average)
@@ -83,37 +89,47 @@ class SyncBatchNorm(nn.Module):
             mean, var = ra_mean.value, ra_var.value
         else:
             x32 = x.astype(jnp.float32)
-            # local moments in fp32 (csrc/welford.cu — welford_mean_var
-            # accumulates in accscalar_t=float)
-            mean = jnp.mean(x32, axis=reduction_axes)
-            mean2 = jnp.mean(jnp.square(x32), axis=reduction_axes)
+            # Local partial sums in fp32 (csrc/welford.cu — welford_mean_var
+            # accumulates in accscalar_t=float). We carry (sum, sumsq, count)
+            # rather than moments so the cross-rank combine is exact for
+            # unequal per-rank element counts (welford_parallel_CUDA weights
+            # by count); counts differ only when a validity mask marks padded
+            # elements of a ragged batch.
+            if mask is not None:
+                m32 = jnp.broadcast_to(mask, x.shape).astype(jnp.float32)
+                s = jnp.sum(x32 * m32, axis=reduction_axes)
+                ss = jnp.sum(jnp.square(x32) * m32, axis=reduction_axes)
+                cnt = jnp.sum(m32, axis=reduction_axes)
+            else:
+                s = jnp.sum(x32, axis=reduction_axes)
+                ss = jnp.sum(jnp.square(x32), axis=reduction_axes)
+                cnt = jnp.full(feature_shape,
+                               float(x32.size // x32.shape[feature_axis]),
+                               jnp.float32)
             # During module init there is no bound mesh axis to reduce over
             # (apex likewise skips comm when torch.distributed isn't up).
             if self.axis_name is not None and not self.is_initializing():
-                # welford_parallel: combine per-rank (mean, var, n). Equal
-                # shard counts ⇒ combination = mean of moments.
-                mean = jax.lax.pmean(
-                    mean, self.axis_name,
+                s, ss, cnt = jax.lax.psum(
+                    (s, ss, cnt), self.axis_name,
                     axis_index_groups=self.axis_index_groups)
-                mean2 = jax.lax.pmean(
-                    mean2, self.axis_name,
-                    axis_index_groups=self.axis_index_groups)
-            var = mean2 - jnp.square(mean)
+            safe_cnt = jnp.maximum(cnt, 1.0)
+            mean = s / safe_cnt
+            var = ss / safe_cnt - jnp.square(mean)
 
             if not self.is_initializing():
                 # biased var for normalization, unbiased for running stats —
-                # apex matches torch.nn.BatchNorm semantics here
-                n = x32.size // x32.shape[feature_axis]
-                if self.axis_name is not None:
-                    group = (len(self.axis_index_groups[0])
-                             if self.axis_index_groups else None)
-                    world = group if group is not None else jax.lax.psum(
-                        1, self.axis_name)
-                    n = n * world
-                unbiased = var * (n / max(n - 1, 1))
+                # apex matches torch.nn.BatchNorm semantics here. A batch with
+                # zero valid elements (all-padding drain step) must leave the
+                # running stats untouched rather than decay them toward 0.
+                unbiased = var * (safe_cnt / jnp.maximum(safe_cnt - 1.0, 1.0))
                 m = self.momentum
-                ra_mean.value = m * ra_mean.value + (1 - m) * mean
-                ra_var.value = m * ra_var.value + (1 - m) * unbiased
+                has_data = cnt > 0
+                ra_mean.value = jnp.where(
+                    has_data, m * ra_mean.value + (1 - m) * mean,
+                    ra_mean.value)
+                ra_var.value = jnp.where(
+                    has_data, m * ra_var.value + (1 - m) * unbiased,
+                    ra_var.value)
 
         y = (x.astype(jnp.float32)
              - mean.reshape([-1 if i == feature_axis else 1

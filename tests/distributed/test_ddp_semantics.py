@@ -189,6 +189,59 @@ def test_syncbn_groups(data_mesh):
     assert yg[:4].mean() < -0.5 and yg[4:].mean() > 0.5
 
 
+def test_syncbn_ragged_counts_match_single_device_oracle(data_mesh):
+    """Count-weighted Welford combine (csrc/welford.cu —
+    welford_parallel_CUDA): with ragged per-rank element counts (padded rows
+    marked invalid by ``mask``) the synced stats must equal the single-device
+    stats over only the valid elements. A moment-averaging (pmean) combine
+    gets this wrong whenever counts differ."""
+    from apex_tpu.parallel import SyncBatchNorm
+
+    rows_per_rank = 6
+    feat = 4
+    k = jax.random.PRNGKey(7)
+    x = jax.random.normal(k, (8, rows_per_rank, feat)) * 3.0 + 1.5
+    # rank r keeps r%5 + 2 valid rows → counts vary 2..6 across ranks
+    valid = np.array([r % 5 + 2 for r in range(8)])
+    mask = np.zeros((8, rows_per_rank, 1), np.float32)
+    for r in range(8):
+        mask[r, :valid[r]] = 1.0
+    mask = jnp.asarray(mask)
+
+    bn = SyncBatchNorm(use_running_average=False, axis_name="data",
+                       momentum=0.9)
+
+    @functools.partial(shard_map, mesh=data_mesh,
+                       in_specs=(P("data"), P("data")),
+                       out_specs=(P("data"), P()), check_vma=False)
+    def run(x, m):
+        variables = bn.init(jax.random.PRNGKey(0), x[0])
+        y, updated = bn.apply(variables, x[0], mask=m[0],
+                              mutable=["batch_stats"])
+        return y[None], updated["batch_stats"]
+
+    y, stats = jax.jit(run)(x, mask)
+    y = np.asarray(y)
+
+    # oracle: stats over ONLY the valid rows, gathered to one device
+    xv = np.concatenate([np.asarray(x[r, :valid[r]]) for r in range(8)])
+    mean_ref = xv.mean(axis=0)
+    var_ref = xv.var(axis=0)
+    n = xv.shape[0]
+
+    # the normalized output on valid rows matches (x - mean)/sqrt(var + eps)
+    yv = np.concatenate([y[r, :valid[r]] for r in range(8)])
+    ref = (xv - mean_ref) / np.sqrt(var_ref + 1e-5)
+    np.testing.assert_allclose(yv, ref, rtol=1e-4, atol=1e-4)
+
+    # running stats: m*init + (1-m)*batch_stat with the unbiased global var
+    np.testing.assert_allclose(np.asarray(stats["mean"]),
+                               0.1 * mean_ref, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(stats["var"]),
+                               0.9 + 0.1 * var_ref * n / (n - 1),
+                               rtol=1e-4, atol=1e-5)
+
+
 @pytest.mark.parametrize("opt_level", ["O0", "O2"])
 def test_ddp_matches_single_process(data_mesh, opt_level):
     """Reference: tests/L1/cross_product — the DDP axis of the matrix: an
