@@ -16,7 +16,14 @@ unbound) they degrade to the single-process fused optimizers.
 
 LAMB's per-tensor trust ratios are applied after the gather (they need whole
 tensors); the state (m, v) stays fully sharded, matching the reference's
-"each rank owns a state shard" memory profile.
+"each rank owns a state shard" memory profile. Its stage-1 math (global
+grad-norm clip → moments → update direction) is identical to
+apex_tpu.optimizers.fused_lamb for the same constructor args.
+
+Checkpoint/topology changes (reference: DistributedFusedAdam.state_dict
+reconstitution — SURVEY P32, §6 checkpoint (c)): state is checkpointed in
+*concatenated* form (rank shards in order + old-world tail padding) and
+re-partitioned for a new world size by :func:`reshard_zero_state`.
 """
 
 from __future__ import annotations
@@ -33,7 +40,8 @@ from apex_tpu.kernels.multi_tensor import fused_adam_step
 from apex_tpu.optimizers.fused_adam import (_flat32, _lr_at, _unflatten_like)
 
 __all__ = ["distributed_fused_adam", "distributed_fused_lamb",
-           "DistributedFusedAdam", "DistributedFusedLAMB"]
+           "DistributedFusedAdam", "DistributedFusedLAMB",
+           "reshard_zero_state"]
 
 ScalarOrSchedule = Union[float, Callable[[jnp.ndarray], Any]]
 
@@ -56,6 +64,51 @@ def _padded(n, world):
     return ((n + world - 1) // world) * world
 
 
+def _num_params(params):
+    return sum(x.size for x in jax.tree_util.tree_leaves(params))
+
+
+def _zero_init(params, world):
+    """Shard-sized zero (m, v) state — each rank owns padded_n/world."""
+    shard = _padded(_num_params(params), world) // world
+    return DistAdamState(count=jnp.zeros((), jnp.int32),
+                         m_shard=jnp.zeros((shard,), jnp.float32),
+                         v_shard=jnp.zeros((shard,), jnp.float32))
+
+
+def _check_world(axis_name, world, opt_name):
+    """Validate mesh-vs-state agreement; returns whether the update runs
+    sharded. Trace-time axis size is authoritative: a mismatch against the
+    shard-sized state (init used comm.axis_size/world_size) means the mesh
+    changed between init and update — fail loud."""
+    bound = _axis_bound(axis_name)
+    if bound:
+        traced_world = jax.lax.psum(1, axis_name)
+        if isinstance(traced_world, int) and traced_world != world:
+            raise ValueError(
+                f"axis {axis_name!r} has size {traced_world} under "
+                f"shard_map but optimizer state was initialized for "
+                f"world {world}")
+    elif world > 1:
+        raise RuntimeError(
+            f"{opt_name}(world_size={world}) must run inside "
+            f"shard_map/pmap with axis {axis_name!r} bound; the "
+            f"shard-sized state cannot be updated unsharded")
+    return bound and world > 1
+
+
+def _shard_grads_and_params(flat_g, flat_p, axis_name, world, sharded):
+    """ZeRO entry: mean-reduce-scatter grads; slice own param shard."""
+    if not sharded:
+        return flat_g, flat_p
+    g_shard = jax.lax.psum_scatter(flat_g, axis_name, scatter_dimension=0,
+                                   tiled=True) / world
+    rank = jax.lax.axis_index(axis_name)
+    shard = flat_p.shape[0] // world
+    p_shard = jax.lax.dynamic_slice_in_dim(flat_p, rank * shard, shard)
+    return g_shard, p_shard
+
+
 def distributed_fused_adam(
         learning_rate: ScalarOrSchedule = 1e-3, beta1: float = 0.9,
         beta2: float = 0.999, eps: float = 1e-8, weight_decay: float = 0.0,
@@ -73,52 +126,22 @@ def distributed_fused_adam(
             else comm.axis_size(axis_name)
 
     def init_fn(params):
-        n = sum(x.size for x in jax.tree_util.tree_leaves(params))
-        world = _world()
-        shard = _padded(n, world) // world
-        return DistAdamState(count=jnp.zeros((), jnp.int32),
-                             m_shard=jnp.zeros((shard,), jnp.float32),
-                             v_shard=jnp.zeros((shard,), jnp.float32))
+        return _zero_init(params, _world())
 
     def update_fn(updates, state, params=None):
         if params is None:
             raise ValueError("distributed_fused_adam requires params")
         world = _world()
-        bound = _axis_bound(axis_name)
-        if bound:
-            # trace-time axis size is authoritative; a mismatch against the
-            # shard-sized state (init used comm.axis_size/world_size) means
-            # the mesh changed between init and update — fail loud.
-            traced_world = jax.lax.psum(1, axis_name)
-            if isinstance(traced_world, int) and traced_world != world:
-                raise ValueError(
-                    f"axis {axis_name!r} has size {traced_world} under "
-                    f"shard_map but optimizer state was initialized for "
-                    f"world {world}")
-        elif world > 1:
-            raise RuntimeError(
-                f"distributed_fused_adam(world_size={world}) must run "
-                f"inside shard_map/pmap with axis {axis_name!r} bound; the "
-                f"shard-sized state cannot be updated unsharded")
+        sharded = _check_world(axis_name, world, "distributed_fused_adam")
         count = state.count + 1
         flat_p = _flat32(params)
         flat_g = _flat32(updates)
         n = flat_p.shape[0]
-        pn = _padded(n, world)
-        pad = pn - n
+        pad = _padded(n, world) - n
         flat_p = jnp.pad(flat_p, (0, pad))
         flat_g = jnp.pad(flat_g, (0, pad))
-        if bound and world > 1:
-            # ZeRO: mean-reduce-scatter grads; slice own param shard
-            g_shard = jax.lax.psum_scatter(flat_g, axis_name,
-                                           scatter_dimension=0,
-                                           tiled=True) / world
-            rank = jax.lax.axis_index(axis_name)
-            shard = pn // world
-            p_shard = jax.lax.dynamic_slice_in_dim(flat_p, rank * shard,
-                                                   shard)
-        else:
-            g_shard, p_shard = flat_g, flat_p
+        g_shard, p_shard = _shard_grads_and_params(
+            flat_g, flat_p, axis_name, world, sharded)
         lr = _lr_at(learning_rate, count)
         new_p, new_m, new_v = fused_adam_step(
             p_shard, state.m_shard, state.v_shard, g_shard, lr=lr,
@@ -126,13 +149,12 @@ def distributed_fused_adam(
             step=count, adam_w_mode=adam_w_mode,
             bias_correction=bias_correction)
         delta_shard = new_p - p_shard
-        if bound and world > 1:
+        if sharded:
             delta = jax.lax.all_gather(delta_shard, axis_name, axis=0,
                                        tiled=True)
         else:
             delta = delta_shard
-        delta = delta[:n]
-        new_updates = _unflatten_like(delta, params)
+        new_updates = _unflatten_like(delta[:n], params)
         return new_updates, DistAdamState(count, new_m, new_v)
 
     return optax.GradientTransformation(init_fn, update_fn)
@@ -141,66 +163,211 @@ def distributed_fused_adam(
 def distributed_fused_lamb(
         learning_rate: ScalarOrSchedule = 1e-3, beta1: float = 0.9,
         beta2: float = 0.999, eps: float = 1e-6, weight_decay: float = 0.01,
+        bias_correction: bool = True, grad_averaging: bool = True,
+        max_grad_norm: float = 1.0, use_nvlamb: bool = False,
         max_coeff: float = 10.0, min_coeff: float = 0.01,
-        axis_name: str = AXIS_DATA) -> optax.GradientTransformation:
-    """ZeRO-sharded LAMB (reference: DistributedFusedLAMB). Sharded Adam-ish
-    moment update; trust ratio per tensor applied post-gather, matching
-    NVLAMB stage-2 (multi_tensor_lamb's per-chunk ratio application)."""
+        axis_name: str = AXIS_DATA,
+        world_size: Optional[int] = None) -> optax.GradientTransformation:
+    """ZeRO-sharded LAMB (reference: DistributedFusedLAMB). The stage-1 math
+    (global-grad-norm clip → moments → Adam-style update direction) is
+    IDENTICAL to :func:`apex_tpu.optimizers.fused_lamb` for the same
+    constructor args — the reference kernel is the same multi_tensor_lamb.cu
+    either way; only the state placement differs. Moments live sharded
+    (each rank owns 1/world of fp32 m, v); the per-tensor trust ratio runs
+    post-gather because it needs whole-tensor norms (NVLAMB stage 2 /
+    LAMBStage2Functor). ``max_coeff``/``min_coeff`` bound the trust ratio
+    (the reference DistributedFusedLAMB constructor args of the same names);
+    ``use_nvlamb=False`` forces ratio 1.0 for undecayed params exactly as
+    fused_lamb does.
 
-    base = distributed_fused_adam(
-        learning_rate=1.0,  # lr applied inside trust-ratio stage
-        beta1=beta1, beta2=beta2, eps=eps, weight_decay=weight_decay,
-        adam_w_mode=True, bias_correction=True, axis_name=axis_name)
+    Grad-norm clip note: the clip stage sees the *mean* gradient (grads are
+    reduce-scatter-averaged first), so the clipped quantity matches the
+    single-process fused_lamb applied to the DP-mean gradient — the
+    reference's clipped_global_grad_norm over the reduced grads."""
+
+    def _world():
+        return world_size if world_size is not None \
+            else comm.axis_size(axis_name)
 
     def init_fn(params):
-        return base.init(params)
+        return _zero_init(params, _world())
 
     def update_fn(updates, state, params=None):
-        raw_updates, new_state = base.update(updates, state, params)
-        lr = _lr_at(learning_rate, new_state.count)
+        if params is None:
+            raise ValueError("distributed_fused_lamb requires params")
+        world = _world()
+        sharded = _check_world(axis_name, world, "distributed_fused_lamb")
+        count = state.count + 1
+        countf = count.astype(jnp.float32)
+        lr = _lr_at(learning_rate, count)
+        flat_p = _flat32(params)
+        flat_g = _flat32(updates)
+        n = flat_p.shape[0]
+        pad = _padded(n, world) - n
+        flat_p = jnp.pad(flat_p, (0, pad))
+        flat_g = jnp.pad(flat_g, (0, pad))
+        g_shard, p_shard = _shard_grads_and_params(
+            flat_g, flat_p, axis_name, world, sharded)
 
-        def per_tensor(u, p):
+        # stage 0: global-norm clip of the (mean) gradient — the kernel's
+        # clipped_global_grad_norm; padding contributes zeros to the norm
+        local_sq = jnp.sum(g_shard * g_shard)
+        global_sq = jax.lax.psum(local_sq, axis_name) if sharded else local_sq
+        global_norm = jnp.sqrt(global_sq)
+        clip = jnp.where(global_norm > max_grad_norm,
+                         global_norm / max_grad_norm, 1.0)
+        g_shard = g_shard / clip
+
+        # stage 1 on the shard: moments + Adam-style update direction
+        beta1_grad = (1.0 - beta1) if grad_averaging else 1.0
+        m_new = beta1 * state.m_shard + beta1_grad * g_shard
+        v_new = beta2 * state.v_shard + (1.0 - beta2) * g_shard * g_shard
+        if bias_correction:
+            bc1 = 1.0 - beta1 ** countf
+            bc2 = 1.0 - beta2 ** countf
+        else:
+            bc1 = bc2 = 1.0
+        u_shard = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps) \
+            + weight_decay * p_shard
+
+        if sharded:
+            u = jax.lax.all_gather(u_shard, axis_name, axis=0, tiled=True)
+        else:
+            u = u_shard
+        # unflatten into an fp32 tree: the update direction must stay fp32
+        # through the norm/ratio stage (half params would otherwise quantize
+        # it before u_norm, breaking parity with fused_lamb)
+        f32_tmpl = jax.tree_util.tree_map(
+            lambda p: jax.ShapeDtypeStruct(p.shape, jnp.float32), params)
+        update_tree = _unflatten_like(u[:n], f32_tmpl)
+
+        # stage 2 per tensor: trust ratio on whole-tensor norms
+        def per_tensor(u32, p):
             p32 = jnp.asarray(p, jnp.float32)
-            u32 = jnp.asarray(u, jnp.float32)
             w_norm = jnp.sqrt(jnp.sum(p32 * p32))
             u_norm = jnp.sqrt(jnp.sum(u32 * u32))
             ratio = jnp.where(
                 (w_norm > 0) & (u_norm > 0),
                 jnp.clip(w_norm / u_norm, min_coeff, max_coeff), 1.0)
-            return (lr * ratio * u32).astype(jnp.asarray(u).dtype)
+            if weight_decay == 0.0 and not use_nvlamb:
+                ratio = 1.0  # fused_lamb parity: no ratio for undecayed
+            return (-lr * ratio * u32).astype(jnp.asarray(p).dtype)
 
-        scaled = jax.tree_util.tree_map(per_tensor, raw_updates, params)
-        return scaled, new_state
+        delta = jax.tree_util.tree_map(per_tensor, update_tree, params)
+        return delta, DistAdamState(count, m_new, v_new)
 
     return optax.GradientTransformation(init_fn, update_fn)
 
 
-class DistributedFusedAdam:
+def reshard_zero_state(state: DistAdamState, num_params: int,
+                       new_world: int,
+                       old_world: Optional[int] = None) -> DistAdamState:
+    """Re-partition concatenated ZeRO optimizer state for a different world
+    size (reference: DistributedFusedAdam.state_dict/load_state_dict
+    reconstitute sharded state across topology changes — SURVEY P32, §6
+    checkpoint (c)).
+
+    ``state`` holds the *concatenated* shards (the representation produced
+    by gathering with out_specs=P(axis) — rank shards in order, old-world
+    padding at the tail). Strips the old padding, re-pads for ``new_world``.
+    Pass ``old_world`` when known: the expected concatenated length is then
+    checked exactly, catching a per-rank shard passed by mistake even when
+    the shard happens to be longer than ``num_params``.
+    """
+    def repad(flat):
+        if old_world is not None:
+            expect = _padded(num_params, old_world)
+            if flat.shape[0] != expect:
+                raise ValueError(
+                    f"state of length {flat.shape[0]} is not the "
+                    f"concatenated world-{old_world} state for "
+                    f"{num_params} params (expected {expect}) — gather "
+                    f"shards (out_specs=P(axis)) before resharding")
+        elif flat.shape[0] < num_params:
+            raise ValueError(
+                f"state of length {flat.shape[0]} is a single shard, not "
+                f"the concatenated state for {num_params} params — gather "
+                f"shards (out_specs=P(axis)) before resharding")
+        flat = flat[:num_params]
+        return jnp.pad(flat, (0, _padded(num_params, new_world) - num_params))
+
+    return DistAdamState(count=state.count, m_shard=repad(state.m_shard),
+                         v_shard=repad(state.v_shard))
+
+
+class _DistributedOptimizer:
+    """Shared wrapper behavior: step, and topology-aware checkpointing.
+
+    ``state_dict``/``load_state_dict`` mirror the reference's state
+    reconstitution. The checkpointable representation is the CONCATENATED
+    state: at world 1 that is what the instance holds; at world>1 the caller
+    must first gather the per-rank shards (out_specs=P(axis)) and assign the
+    result back to ``.state`` — ``state_dict`` verifies the length and
+    refuses a single shard. ``load_state_dict`` rebuilds the transformation
+    for the new world so subsequent shard sizes agree with the restored
+    state (a stale world here would trip _check_world on the next step).
+    """
+
+    def _setup(self, params, axis_name, world_size, factory, factory_kwargs):
+        self._axis_name = axis_name
+        self._factory = factory
+        self._factory_kwargs = factory_kwargs
+        self._world = world_size if world_size is not None \
+            else comm.axis_size(axis_name)
+        self.tx = factory(axis_name=axis_name, world_size=self._world,
+                          **factory_kwargs)
+        self.state = self.tx.init(params)
+        self._num_params = _num_params(params)
+
+    def step(self, grads, params):
+        upd, self.state = self.tx.update(grads, self.state, params)
+        return optax.apply_updates(params, upd)
+
+    def state_dict(self):
+        expect = _padded(self._num_params, self._world)
+        if self.state.m_shard.shape[0] != expect:
+            raise ValueError(
+                f"state holds a per-rank shard of length "
+                f"{self.state.m_shard.shape[0]}; checkpointing at world "
+                f"{self._world} requires the concatenated state of length "
+                f"{expect} — gather shards (out_specs=P(axis)) and assign "
+                f"to .state first")
+        return {"state": self.state, "num_params": self._num_params,
+                "world": self._world}
+
+    def load_state_dict(self, sd, new_world: int):
+        self._world = new_world
+        self.tx = self._factory(axis_name=self._axis_name,
+                                world_size=new_world,
+                                **self._factory_kwargs)
+        self.state = reshard_zero_state(sd["state"], sd["num_params"],
+                                        new_world, old_world=sd["world"])
+
+
+class DistributedFusedAdam(_DistributedOptimizer):
     """Class-shaped wrapper mirroring the reference constructor; holds the
     optax transformation plus step/init helpers."""
 
     def __init__(self, params, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
                  weight_decay=0.0, adam_w_mode=True, bias_correction=True,
-                 axis_name: str = AXIS_DATA, **_ignored):
-        self.tx = distributed_fused_adam(
-            lr, betas[0], betas[1], eps, weight_decay, adam_w_mode,
-            bias_correction, axis_name)
-        self.state = self.tx.init(params)
-
-    def step(self, grads, params):
-        upd, self.state = self.tx.update(grads, self.state, params)
-        return optax.apply_updates(params, upd)
+                 axis_name: str = AXIS_DATA, world_size=None, **_ignored):
+        self._setup(params, axis_name, world_size, distributed_fused_adam,
+                    dict(learning_rate=lr, beta1=betas[0], beta2=betas[1],
+                         eps=eps, weight_decay=weight_decay,
+                         adam_w_mode=adam_w_mode,
+                         bias_correction=bias_correction))
 
 
-class DistributedFusedLAMB:
-    def __init__(self, params, lr=1e-3, betas=(0.9, 0.999), eps=1e-6,
-                 weight_decay=0.01, max_coeff=10.0, min_coeff=0.01,
-                 axis_name: str = AXIS_DATA, **_ignored):
-        self.tx = distributed_fused_lamb(
-            lr, betas[0], betas[1], eps, weight_decay, max_coeff, min_coeff,
-            axis_name)
-        self.state = self.tx.init(params)
-
-    def step(self, grads, params):
-        upd, self.state = self.tx.update(grads, self.state, params)
-        return optax.apply_updates(params, upd)
+class DistributedFusedLAMB(_DistributedOptimizer):
+    def __init__(self, params, lr=1e-3, bias_correction=True,
+                 betas=(0.9, 0.999), eps=1e-6, weight_decay=0.01,
+                 grad_averaging=True, max_grad_norm=1.0, use_nvlamb=False,
+                 max_coeff=10.0, min_coeff=0.01,
+                 axis_name: str = AXIS_DATA, world_size=None, **_ignored):
+        self._setup(params, axis_name, world_size, distributed_fused_lamb,
+                    dict(learning_rate=lr, beta1=betas[0], beta2=betas[1],
+                         eps=eps, weight_decay=weight_decay,
+                         bias_correction=bias_correction,
+                         grad_averaging=grad_averaging,
+                         max_grad_norm=max_grad_norm, use_nvlamb=use_nvlamb,
+                         max_coeff=max_coeff, min_coeff=min_coeff))

@@ -101,6 +101,202 @@ def test_dist_lamb_runs_and_differs_by_trust_ratio(data_mesh):
     assert not np.allclose(np.asarray(out["w"]), np.asarray(params["w"]))
 
 
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_dist_lamb_matches_fused_lamb(data_mesh, dtype):
+    """distributed_fused_lamb == fused_lamb on the mean gradient for the
+    same constructor args (VERDICT: the two LAMBs must agree — same
+    multi_tensor_lamb.cu math, different state placement). Grads are large
+    enough that the global-norm clip stage engages, proving the distributed
+    path has one. bf16 params exercise the update-stays-fp32-through-the-
+    trust-ratio-stage requirement."""
+    from apex_tpu.contrib.optimizers import distributed_fused_lamb
+    from apex_tpu.optimizers.fused_lamb import fused_lamb
+
+    kw = dict(learning_rate=1e-2, weight_decay=0.01, max_grad_norm=1.0,
+              use_nvlamb=False)
+    params = {"w": jax.random.normal(jax.random.PRNGKey(1),
+                                     (16, 8)).astype(dtype),
+              "b": jax.random.normal(jax.random.PRNGKey(2),
+                                     (5,)).astype(dtype)}
+    base = {"w": jnp.full((16, 8), 4.0), "b": jnp.full((5,), -3.0)}
+    steps = 3
+
+    tx = distributed_fused_lamb(axis_name="data", world_size=WORLD, **kw)
+    state = tx.init(params)
+
+    @functools.partial(shard_map, mesh=data_mesh,
+                       in_specs=(P(), P(), P("data")), out_specs=P(),
+                       check_rep=False)
+    def run(params, state, rank_scale):
+        for _ in range(steps):
+            grads = jax.tree_util.tree_map(lambda g: g * rank_scale[0], base)
+            upd, state = tx.update(grads, state, params)
+            params = optax.apply_updates(params, upd)
+        return params
+
+    scales = jnp.arange(1.0, WORLD + 1)  # mean 2.5
+    dist_params = jax.jit(run)(params, state, scales)
+
+    ref_tx = fused_lamb(**kw)
+    ref_state = ref_tx.init(params)
+    ref_params = params
+    mean_grads = jax.tree_util.tree_map(lambda g: g * 2.5, base)
+    for _ in range(steps):
+        upd, ref_state = ref_tx.update(mean_grads, ref_state, ref_params)
+        ref_params = optax.apply_updates(ref_params, upd)
+
+    # sanity: the clip stage must actually have engaged
+    gn = float(jnp.sqrt(sum(jnp.sum((g * 2.5) ** 2)
+                            for g in jax.tree_util.tree_leaves(base))))
+    assert gn > 1.0
+    for k in params:
+        np.testing.assert_allclose(np.asarray(dist_params[k]),
+                                   np.asarray(ref_params[k]),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_dist_lamb_nvlamb_switch_matches_fused_lamb(data_mesh):
+    """weight_decay=0 + use_nvlamb=False forces trust ratio 1.0 in BOTH
+    LAMBs (the kernel's NVLAMB switch) — previously only fused_lamb did."""
+    from apex_tpu.contrib.optimizers import distributed_fused_lamb
+    from apex_tpu.optimizers.fused_lamb import fused_lamb
+
+    params = {"w": jax.random.normal(jax.random.PRNGKey(3), (8, 4)) * 5.0}
+    grads = {"w": jnp.full((8, 4), 0.1)}  # below max_grad_norm: no clip
+
+    for nv in (False, True):
+        kw = dict(learning_rate=1e-2, weight_decay=0.0, max_grad_norm=1e9,
+                  use_nvlamb=nv)
+        tx = distributed_fused_lamb(axis_name="data", world_size=WORLD, **kw)
+        state = tx.init(params)
+
+        @functools.partial(shard_map, mesh=data_mesh,
+                           in_specs=(P(), P()), out_specs=P(),
+                           check_rep=False)
+        def run(params, state):
+            upd, _ = tx.update(grads, state, params)
+            return optax.apply_updates(params, upd)
+
+        dist_out = jax.jit(run)(params, state)
+        ref_tx = fused_lamb(**kw)
+        upd, _ = ref_tx.update(grads, ref_tx.init(params), params)
+        ref_out = optax.apply_updates(params, upd)
+        np.testing.assert_allclose(np.asarray(dist_out["w"]),
+                                   np.asarray(ref_out["w"]),
+                                   rtol=1e-5, atol=1e-6,
+                                   err_msg=f"use_nvlamb={nv}")
+
+
+def test_zero_state_resharded_roundtrip(data_mesh, tmp_path):
+    """ZeRO optimizer-state save/restore across a world-size change
+    (reference: DistributedFusedAdam.state_dict reconstitution — SURVEY §6
+    checkpoint (c)): train 2 steps at world 4, checkpoint via the sharded
+    writer, restore under a world-2 mesh, train 2 more steps; the result
+    must equal 4 uninterrupted steps (oracle: fused_lamb on mean grads)."""
+    from jax.sharding import NamedSharding
+    from apex_tpu.contrib.optimizers import (DistAdamState,
+                                             distributed_fused_lamb,
+                                             reshard_zero_state)
+    from apex_tpu.optimizers.fused_lamb import fused_lamb
+    from apex_tpu.utils.sharded_checkpoint import load_sharded, save_sharded
+
+    kw = dict(learning_rate=1e-2, weight_decay=0.01, max_grad_norm=1.0)
+    # n = 13*3 + 7 = 46: pads to 48 at world 4, 46 at world 2 — the repad
+    # path is actually exercised
+    params = {"w": jax.random.normal(jax.random.PRNGKey(5), (13, 3)),
+              "b": jnp.zeros((7,))}
+    n = 46
+    base = {"w": jnp.full((13, 3), 2.0), "b": jnp.full((7,), -1.0)}
+
+    def make_run(mesh, world, steps):
+        tx = distributed_fused_lamb(axis_name="data", world_size=world, **kw)
+        sspec = DistAdamState(count=P(), m_shard=P("data"),
+                              v_shard=P("data"))
+
+        @functools.partial(shard_map, mesh=mesh,
+                           in_specs=(P(), sspec, P("data")),
+                           out_specs=(P(), sspec), check_rep=False)
+        def run(params, state, rank_scale):
+            for _ in range(steps):
+                grads = jax.tree_util.tree_map(
+                    lambda g: g * rank_scale[0], base)
+                upd, state = tx.update(grads, state, params)
+                params = optax.apply_updates(params, upd)
+            return params, state
+
+        return jax.jit(run)
+
+    # phase 1: world 4, concatenated state representation [48]
+    state4 = DistAdamState(count=jnp.zeros((), jnp.int32),
+                           m_shard=jnp.zeros((48,), jnp.float32),
+                           v_shard=jnp.zeros((48,), jnp.float32))
+    scales4 = jnp.arange(1.0, 5.0)  # mean 2.5
+    p_mid, state_mid = make_run(data_mesh, 4, 2)(params, state4, scales4)
+
+    # checkpoint: place the concatenated state sharded over the 4-dev mesh
+    # and write through the real sharded writer
+    sh4 = NamedSharding(data_mesh, P("data"))
+    state_placed = DistAdamState(
+        count=state_mid.count,
+        m_shard=jax.device_put(state_mid.m_shard, sh4),
+        v_shard=jax.device_put(state_mid.v_shard, sh4))
+    save_sharded(str(tmp_path), state_placed, step=2)
+
+    # restore under a DIFFERENT mesh (2 devices) — resharded restore
+    mesh2 = Mesh(np.array(data_mesh.devices.flatten()[:2]), ("data",))
+    sh2 = NamedSharding(mesh2, P("data"))
+    template = DistAdamState(
+        count=jnp.zeros((), jnp.int32),
+        m_shard=jax.device_put(jnp.zeros((48,), jnp.float32), sh2),
+        v_shard=jax.device_put(jnp.zeros((48,), jnp.float32), sh2))
+    restored, step = load_sharded(str(tmp_path), template)
+    assert step == 2
+    state2 = reshard_zero_state(restored, n, 2)  # strip pad48 → pad46
+    assert state2.m_shard.shape == (46,)
+
+    # phase 2: world 2, same mean gradient (scales (2,3) → mean 2.5)
+    p_mid = jax.tree_util.tree_map(np.asarray, p_mid)  # off the 4-dev mesh
+    state2 = jax.tree_util.tree_map(np.asarray, state2)
+    scales2 = jnp.asarray([2.0, 3.0])
+    p_final, _ = make_run(mesh2, 2, 2)(p_mid, state2, scales2)
+
+    # oracle: 4 uninterrupted fused_lamb steps on the mean grads
+    ref_tx = fused_lamb(**kw)
+    ref_state = ref_tx.init(params)
+    ref_params = params
+    mean_grads = jax.tree_util.tree_map(lambda g: g * 2.5, base)
+    for _ in range(4):
+        upd, ref_state = ref_tx.update(mean_grads, ref_state, ref_params)
+        ref_params = optax.apply_updates(ref_params, upd)
+
+    for k in params:
+        np.testing.assert_allclose(np.asarray(p_final[k]),
+                                   np.asarray(ref_params[k]),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_wrapper_state_dict_semantics(data_mesh):
+    """Wrapper checkpoint API: world-1 round-trips and rebuilds the
+    transformation for the new world; a world>1 instance holding only its
+    per-rank shard refuses to checkpoint (the concatenated state must be
+    gathered first)."""
+    from apex_tpu.contrib.optimizers import DistributedFusedLAMB
+
+    params = {"w": jnp.ones((5, 7))}  # n=35: pads to 36 at world 2
+
+    opt1 = DistributedFusedLAMB(params, lr=1e-2, world_size=1)
+    sd = opt1.state_dict()
+    assert sd["world"] == 1 and sd["num_params"] == 35
+    opt1.load_state_dict(sd, new_world=2)
+    assert opt1.state.m_shard.shape == (36,)
+    assert opt1._world == 2  # tx rebuilt: next step's shard math uses 2
+
+    opt4 = DistributedFusedLAMB(params, lr=1e-2, world_size=4)
+    assert opt4.state.m_shard.shape == (9,)  # per-rank shard
+    with pytest.raises(ValueError, match="gather shards"):
+        opt4.state_dict()
+
+
 def test_halo_exchange_1d(data_mesh):
     from apex_tpu.contrib.peer_memory import halo_exchange_1d
     # global [WORLD*4, 3] sharded along dim 0 (rows)
