@@ -30,6 +30,8 @@ import jax
 import jax.numpy as jnp
 
 from . import lists  # noqa: F401
+from .autocast import (active_policy, autocast, cast_op_inputs,
+                       op_compute_dtype, resolve_dtype)
 from .policy import Policy, default_is_norm_param, opt_levels, resolve_policy
 from .scaler import (LossScaler, ScalerState, init_scaler, scale_loss as
                      _scale_loss_fn, unscale, unscale_with_stashed,
@@ -43,6 +45,8 @@ __all__ = [
     "half_function", "float_function", "promote_function",
     "register_half_function", "register_float_function",
     "register_promote_function",
+    "autocast", "active_policy", "op_compute_dtype", "resolve_dtype",
+    "cast_op_inputs",
 ]
 
 # Global registry mirroring apex/amp/_amp_state.py — class AmpState: frontends
@@ -105,7 +109,11 @@ def initialize(model, optimizers=None, opt_level="O1", enabled=True,
 
         def policy_apply(p, *args, **kwargs):
             args = policy.cast_to_compute(args)
-            return apply_fn(p, *args, **kwargs)
+            # O1 engine: policy-aware ops inside apply_fn consult the
+            # ambient policy's tables (apex applies its patches here too —
+            # _initialize.py installs them during initialize)
+            with autocast(policy):
+                return apply_fn(p, *args, **kwargs)
 
         return _InitializedModel(
             policy_apply if apply_fn is not None else None, params, policy)
@@ -381,8 +389,13 @@ def make_train_step(loss_fn: Callable, optimizer, policy: Policy,
                 mstate = None
             return _scale_loss_fn(loss, scaler), (loss, aux, mstate)
 
-        grads, (loss, aux, new_model_state) = jax.grad(
-            scaled_loss_fn, has_aux=True)(state.params)
+        # O1 engine active for the whole traced forward+backward: FP32_FUNCS
+        # ops (softmax/norms/losses) lift themselves to fp32, FP16_FUNCS
+        # (matmul/conv) drop to half — the trace-time equivalent of apex's
+        # table-driven call-site patches (amp/lists/, SURVEY P6).
+        with autocast(policy):
+            grads, (loss, aux, new_model_state) = jax.grad(
+                scaled_loss_fn, has_aux=True)(state.params)
         if grad_average_axis is not None:
             # the reported loss is the global-batch mean, not one shard's
             # local value (the reference recipe all-reduces its metrics:

@@ -84,6 +84,27 @@ class Policy:
             return _canon_dtype(self.half_dtype)
         return jnp.float32
 
+    def op_dtype(self, op_name: str, *operand_dtypes):
+        """Per-op compute dtype under this policy — the O1 engine's core
+        (reference: apex/amp/lists/torch_overrides.py tables, applied by
+        _initialize.py's patching; SURVEY P6).
+
+        Only O1 (``patch_torch_functions``) has per-op opinions: FP16_FUNCS
+        run in ``half_dtype``, FP32_FUNCS in fp32, CASTS promote to the
+        widest floating operand. O0/O2/O3 return None — apex patches no
+        functions there (the model dtype governs).
+        """
+        if not self.enabled or not self.patch_torch_functions:
+            return None
+        from . import lists
+
+        d = lists.compute_dtype_for(op_name, self.half_dtype)
+        if d is not None:
+            return d
+        if op_name in lists.CASTS or op_name in lists.SEQUENCE_CASTS:
+            return lists.promote_dtype(*operand_dtypes)
+        return None
+
     @property
     def param_dtype(self):
         """Dtype model ("working") parameters are stored in."""

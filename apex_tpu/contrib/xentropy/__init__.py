@@ -10,9 +10,25 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from apex_tpu.kernels.xentropy import softmax_cross_entropy_loss
+from apex_tpu.kernels.xentropy import (
+    softmax_cross_entropy_loss as _kernel_xent)
 
 __all__ = ["SoftmaxCrossEntropyLoss", "softmax_cross_entropy_loss"]
+
+
+def softmax_cross_entropy_loss(logits, labels, smoothing: float = 0.0):
+    """Policy-aware CE: 'cross_entropy' is an FP32_FUNCS entry. The kernel
+    already does fp32 math internally for any input dtype, so honoring the
+    O1 table only means pinning the *observable* loss dtype — cast the [N]
+    losses, never the [N, V] logits (an fp32 logits copy would be the
+    largest tensor in an LM step for zero numerical effect)."""
+    from apex_tpu.amp.autocast import op_compute_dtype
+
+    losses = _kernel_xent(logits, labels, smoothing=smoothing)
+    target = op_compute_dtype("cross_entropy")
+    if target is not None:
+        losses = jnp.asarray(losses, target)
+    return losses
 
 
 class SoftmaxCrossEntropyLoss:

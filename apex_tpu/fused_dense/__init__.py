@@ -48,8 +48,19 @@ def _linear_fp32(x, weight, bias=None):
     return y
 
 
+def _gemm_in(x):
+    # O1 engine: 'linear' is FP16_FUNCS — under an active autocast policy the
+    # GEMM input (and the weight, via the cast-to-x.dtype in _linear_fp32)
+    # drops to the half dtype; accumulation stays fp32.
+    from apex_tpu.amp.autocast import op_compute_dtype
+
+    d = op_compute_dtype("linear")
+    return x if d is None else jnp.asarray(x, d)
+
+
 def fused_dense_function(x, weight, bias=None):
     """y = x @ W.T + b (reference: fused_dense_cuda.linear_bias_forward)."""
+    x = _gemm_in(x)
     return jnp.asarray(_linear_fp32(x, weight, bias), x.dtype)
 
 
@@ -60,6 +71,9 @@ def fused_dense_gelu_dense_function(x, weight1, bias1, weight2, bias2):
     apex uses CUBLASLT_EPILOGUE_GELU) is applied to the fp32 accumulator
     before any output-dtype conversion, as the cublasLt epilogue does.
     """
+    x = _gemm_in(x)
+    # gelu is an FP32 classification (amp/lists.py); it runs on the fp32
+    # accumulator here regardless, matching the cublasLt epilogue.
     h = jax.nn.gelu(_linear_fp32(x, weight1, bias1), approximate=False)
     h = jnp.asarray(h, x.dtype)
     return fused_dense_function(h, weight2, bias2)

@@ -43,7 +43,14 @@ def mlp_function(x, weights: Sequence[Any], biases: Optional[Sequence[Any]],
             f"activation must be one of {sorted(_ACTIVATIONS)}, got "
             f"{activation!r}")
     act = _ACTIVATIONS[activation]
-    y = x
+    # O1 engine: 'linear' is an FP16_FUNCS entry — under an active autocast
+    # policy the GEMMs run in the half dtype (weights follow via the
+    # cast-to-y.dtype below, apex's cached weight cast); fp32 accumulation
+    # is kept via preferred_element_type either way.
+    from apex_tpu.amp.autocast import op_compute_dtype
+    gemm_dtype = op_compute_dtype("linear")
+    y = x if gemm_dtype is None else jnp.asarray(x, gemm_dtype)
+    out_dtype = x.dtype if gemm_dtype is None else gemm_dtype
     for i, w in enumerate(weights):
         # apex stores weights as (out_features, in_features) (torch Linear
         # layout); keep that layout so state dicts line up, transpose in-trace
@@ -53,7 +60,7 @@ def mlp_function(x, weights: Sequence[Any], biases: Optional[Sequence[Any]],
         if biases is not None:
             y = y + jnp.asarray(biases[i], jnp.float32)
         y = act(y)
-        y = jnp.asarray(y, x.dtype)
+        y = jnp.asarray(y, out_dtype)
     return y
 
 

@@ -53,17 +53,23 @@ class BertLayer(nn.Module):
     """Post-LN block: LN(x + attn(x)); LN(x + mlp(x))."""
 
     config: BertConfig
-    dtype: Any = jnp.float32
+    dtype: Optional[Any] = None
     param_dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, attention_mask, train: bool):
         cfg = self.config
+        # dtype=None consults the O1 engine: GEMMs are FP16_FUNCS 'linear'
+        # (half under an active policy, fp32 otherwise); FusedLayerNorm below
+        # receives the raw self.dtype and does its own 'layer_norm' (FP32)
+        # resolution
+        from apex_tpu.amp.autocast import resolve_dtype
+        dense_dtype = resolve_dtype(self.dtype, "linear", jnp.float32)
         B, S, H = x.shape
         heads = cfg.num_attention_heads
         d = H // heads
-        qkv = nn.Dense(3 * H, dtype=self.dtype, param_dtype=self.param_dtype,
-                       name="qkv")(x)
+        qkv = nn.Dense(3 * H, dtype=dense_dtype,
+                       param_dtype=self.param_dtype, name="qkv")(x)
         qkv = qkv.reshape(B, S, 3, heads, d)
         q, k, v = (jnp.moveaxis(qkv[:, :, i], 1, 2) for i in range(3))
         # padding mask as segment ids: real tokens (1) attend only among
@@ -73,18 +79,18 @@ class BertLayer(nn.Module):
         seg = attention_mask.astype(jnp.int32)
         out = flash_attention(q, k, v, segment_ids=seg)
         out = jnp.moveaxis(out, 1, 2).reshape(B, S, H)
-        out = nn.Dense(H, dtype=self.dtype, param_dtype=self.param_dtype,
+        out = nn.Dense(H, dtype=dense_dtype, param_dtype=self.param_dtype,
                        name="attn_out")(out)
         if cfg.hidden_dropout_prob > 0.0:
             out = nn.Dropout(rate=cfg.hidden_dropout_prob,
                              deterministic=not train)(out)
         x = FusedLayerNorm(normalized_shape=H, eps=cfg.layer_norm_eps,
                            dtype=self.dtype, name="ln_attn")(x + out)
-        h = nn.Dense(cfg.intermediate_size, dtype=self.dtype,
+        h = nn.Dense(cfg.intermediate_size, dtype=dense_dtype,
                      param_dtype=self.param_dtype, name="mlp_in")(x)
         h = nn.gelu(jnp.asarray(h, jnp.float32), approximate=False)
-        h = nn.Dense(H, dtype=self.dtype, param_dtype=self.param_dtype,
-                     name="mlp_out")(jnp.asarray(h, self.dtype))
+        h = nn.Dense(H, dtype=dense_dtype, param_dtype=self.param_dtype,
+                     name="mlp_out")(jnp.asarray(h, dense_dtype))
         if cfg.hidden_dropout_prob > 0.0:
             h = nn.Dropout(rate=cfg.hidden_dropout_prob,
                            deterministic=not train)(h)
@@ -100,7 +106,7 @@ class BertModel(nn.Module):
     """
 
     config: BertConfig
-    dtype: Any = jnp.float32
+    dtype: Optional[Any] = None
     param_dtype: Any = jnp.float32
     # activation checkpointing per encoder layer (jax.checkpoint; the
     # DeepLearningExamples recipe's checkpoint_activations flag)
@@ -128,10 +134,12 @@ class BertModel(nn.Module):
                          nn.initializers.normal(stddev=0.02),
                          (cfg.max_position_embeddings, cfg.hidden_size),
                          self.param_dtype)
+        from apex_tpu.amp.autocast import resolve_dtype
+        dense_dtype = resolve_dtype(self.dtype, "linear", jnp.float32)
         x = wte(input_ids) + tte(token_type_ids) + wpe[:S][None]
         x = FusedLayerNorm(normalized_shape=cfg.hidden_size,
                            eps=cfg.layer_norm_eps, name="embed_ln")(x)
-        x = jnp.asarray(x, self.dtype)
+        x = jnp.asarray(x, dense_dtype)
         if cfg.hidden_dropout_prob > 0.0:
             x = nn.Dropout(rate=cfg.hidden_dropout_prob,
                            deterministic=not train)(x)
@@ -141,7 +149,7 @@ class BertModel(nn.Module):
         for i in range(cfg.num_hidden_layers):
             x = layer_cls(cfg, self.dtype, self.param_dtype,
                           name=f"layer_{i}")(x, attention_mask, train)
-        pooled = nn.Dense(cfg.hidden_size, dtype=self.dtype,
+        pooled = nn.Dense(cfg.hidden_size, dtype=dense_dtype,
                           param_dtype=self.param_dtype, name="pooler")(
                               x[:, 0])
         pooled = jnp.tanh(pooled)
@@ -159,13 +167,15 @@ class BertForPreTraining(nn.Module):
     """
 
     config: BertConfig
-    dtype: Any = jnp.float32
+    dtype: Optional[Any] = None
     param_dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, input_ids, token_type_ids, attention_mask,
                  masked_lm_positions, *, train: bool = True):
         cfg = self.config
+        from apex_tpu.amp.autocast import resolve_dtype
+        dense_dtype = resolve_dtype(self.dtype, "linear", jnp.float32)
         # word embedding owned here so the MLM decoder can tie to it (flax
         # module sharing: the instance is a child of this module; BertModel
         # calls it by reference)
@@ -179,7 +189,7 @@ class BertForPreTraining(nn.Module):
         # gather masked positions before the vocab GEMM: [B, P, H]
         gathered = jnp.take_along_axis(
             seq, masked_lm_positions[..., None].astype(jnp.int32), axis=1)
-        h = nn.Dense(H, dtype=self.dtype, param_dtype=self.param_dtype,
+        h = nn.Dense(H, dtype=dense_dtype, param_dtype=self.param_dtype,
                      name="mlm_transform")(gathered)
         h = nn.gelu(jnp.asarray(h, jnp.float32), approximate=False)
         h = FusedLayerNorm(normalized_shape=H, eps=cfg.layer_norm_eps,

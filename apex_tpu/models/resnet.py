@@ -97,7 +97,7 @@ class ResNet(nn.Module):
     block_cls: ModuleDef
     num_classes: int = 1000
     num_filters: int = 64
-    dtype: Any = jnp.float32
+    dtype: Optional[Any] = None
     param_dtype: Any = jnp.float32
     norm_dtype: Optional[Any] = jnp.float32
     norm_cls: Optional[ModuleDef] = None
@@ -105,9 +105,17 @@ class ResNet(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype,
+        # dtype=None consults the O1 engine per op class: convs/fc run in
+        # the policy half dtype (FP16_FUNCS 'conv2d'/'linear'), batch norm
+        # stays fp32 (FP32_FUNCS 'batch_norm'); no active policy → fp32
+        # (identical to the old jnp.float32 default).
+        from apex_tpu.amp.autocast import resolve_dtype
+        conv_dtype = resolve_dtype(self.dtype, "conv2d", jnp.float32)
+        fc_dtype = resolve_dtype(self.dtype, "linear", jnp.float32)
+        conv = functools.partial(nn.Conv, use_bias=False, dtype=conv_dtype,
                                  param_dtype=self.param_dtype)
-        norm_dtype = self.norm_dtype if self.norm_dtype is not None else self.dtype
+        norm_dtype = self.norm_dtype if self.norm_dtype is not None \
+            else resolve_dtype(None, "batch_norm", conv_dtype)
         base_norm = self.norm_cls if self.norm_cls is not None else nn.BatchNorm
         norm = functools.partial(
             base_norm, use_running_average=not train, momentum=0.9,
@@ -125,7 +133,7 @@ class ResNet(nn.Module):
                                    conv=conv, norm=norm, act=self.act,
                                    name=f"stage{i + 1}_block{j}")(x)
         x = jnp.mean(x, axis=(1, 2))
-        x = nn.Dense(self.num_classes, dtype=self.dtype,
+        x = nn.Dense(self.num_classes, dtype=fc_dtype,
                      param_dtype=self.param_dtype, name="fc")(x)
         return x.astype(jnp.float32)
 

@@ -36,20 +36,23 @@ class SelfAttention(nn.Module):
     hidden: int
     num_heads: int
     dropout: float = 0.0
-    dtype: Any = jnp.float32
+    dtype: Optional[Any] = None
     param_dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool):
+        # dtype=None → O1 engine: GEMMs are FP16_FUNCS 'linear'
+        from apex_tpu.amp.autocast import resolve_dtype
+        dense_dtype = resolve_dtype(self.dtype, "linear", jnp.float32)
         B, S, H = x.shape
         d = self.hidden // self.num_heads
-        qkv = nn.Dense(3 * self.hidden, dtype=self.dtype,
+        qkv = nn.Dense(3 * self.hidden, dtype=dense_dtype,
                        param_dtype=self.param_dtype, name="qkv")(x)
         qkv = qkv.reshape(B, S, 3, self.num_heads, d)
         q, k, v = (jnp.moveaxis(qkv[:, :, i], 1, 2) for i in range(3))
         out = flash_attention(q, k, v, causal=True)  # [B, h, S, d]
         out = jnp.moveaxis(out, 1, 2).reshape(B, S, self.hidden)
-        out = nn.Dense(self.hidden, dtype=self.dtype,
+        out = nn.Dense(self.hidden, dtype=dense_dtype,
                        param_dtype=self.param_dtype, name="proj")(out)
         if self.dropout > 0.0:
             out = nn.Dropout(rate=self.dropout, deterministic=not train)(out)
@@ -63,11 +66,15 @@ class TransformerBlock(nn.Module):
     num_heads: int
     mlp_ratio: int = 4
     dropout: float = 0.0
-    dtype: Any = jnp.float32
+    dtype: Optional[Any] = None
     param_dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool):
+        # FusedLayerNorm resolves 'layer_norm' (FP32) itself from the raw
+        # self.dtype; the Dense sites resolve 'linear' (FP16) here
+        from apex_tpu.amp.autocast import resolve_dtype
+        dense_dtype = resolve_dtype(self.dtype, "linear", jnp.float32)
         h = FusedLayerNorm(normalized_shape=self.hidden, dtype=self.dtype,
                            name="ln_attn")(x)
         x = x + SelfAttention(self.hidden, self.num_heads, self.dropout,
@@ -76,14 +83,14 @@ class TransformerBlock(nn.Module):
         h = FusedLayerNorm(normalized_shape=self.hidden, dtype=self.dtype,
                            name="ln_mlp")(x)
         inner = self.mlp_ratio * self.hidden
-        h = nn.Dense(inner, dtype=self.dtype, param_dtype=self.param_dtype,
+        h = nn.Dense(inner, dtype=dense_dtype, param_dtype=self.param_dtype,
                      name="mlp_in")(h)
         # exact-erf GELU on the fp32 accumulator (fused_dense epilogue
         # semantics — apex/fused_dense: CUBLASLT_EPILOGUE_GELU)
         h = nn.gelu(jnp.asarray(h, jnp.float32), approximate=False)
-        h = nn.Dense(self.hidden, dtype=self.dtype,
+        h = nn.Dense(self.hidden, dtype=dense_dtype,
                      param_dtype=self.param_dtype,
-                     name="mlp_out")(jnp.asarray(h, self.dtype))
+                     name="mlp_out")(jnp.asarray(h, dense_dtype))
         if self.dropout > 0.0:
             h = nn.Dropout(rate=self.dropout, deterministic=not train)(h)
         return x + h
@@ -109,17 +116,19 @@ class TransformerLM(nn.Module):
     # jax.checkpoint trading recompute for HBM, the standard long-context
     # memory lever)
     remat: bool = False
-    dtype: Any = jnp.float32
+    dtype: Optional[Any] = None
     param_dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, tokens, *, train: bool = True):
+        from apex_tpu.amp.autocast import resolve_dtype
+        dense_dtype = resolve_dtype(self.dtype, "linear", jnp.float32)
         B, S = tokens.shape
         embed = nn.Embed(self.vocab_size, self.hidden,
                          param_dtype=self.param_dtype, name="wte")
         pos = self.param("wpe", nn.initializers.normal(stddev=0.02),
                          (self.max_seq_len, self.hidden), self.param_dtype)
-        x = jnp.asarray(embed(tokens) + pos[:S][None], self.dtype)
+        x = jnp.asarray(embed(tokens) + pos[:S][None], dense_dtype)
         if self.dropout > 0.0:
             x = nn.Dropout(rate=self.dropout, deterministic=not train)(x)
         block_cls = TransformerBlock
@@ -148,7 +157,7 @@ _LM_SIZES = {
 
 def create_lm(size: str = "small", vocab_size: int = 32768,
               max_seq_len: int = 1024, dropout: float = 0.0,
-              remat: bool = False, dtype: Any = jnp.float32,
+              remat: bool = False, dtype: Optional[Any] = None,
               param_dtype: Any = jnp.float32) -> TransformerLM:
     if size not in _LM_SIZES:
         raise ValueError(f"unknown LM size {size!r}; one of {sorted(_LM_SIZES)}")

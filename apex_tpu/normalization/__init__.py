@@ -60,8 +60,14 @@ class FusedLayerNorm(nn.Module):
         hidden = 1
         for s in shape:
             hidden *= s
-        if self.dtype is not None:
-            x = jnp.asarray(x, self.dtype)
+        # O1 engine: 'layer_norm' is an FP32_FUNCS entry — with no explicit
+        # dtype, an active autocast policy lifts the op to fp32 (input AND
+        # output, like apex's patched F.layer_norm; the next FP16 op casts
+        # back down). Kernel stats are fp32 in every case.
+        from apex_tpu.amp.autocast import resolve_dtype
+        dtype = resolve_dtype(self.dtype, "layer_norm")
+        if dtype is not None:
+            x = jnp.asarray(x, dtype)
         orig_shape = x.shape
         x2 = x.reshape(x.shape[:x.ndim - len(shape)] + (hidden,))
         if self.elementwise_affine:
@@ -92,8 +98,11 @@ class FusedRMSNorm(nn.Module):
         hidden = 1
         for s in shape:
             hidden *= s
-        if self.dtype is not None:
-            x = jnp.asarray(x, self.dtype)
+        # same O1 lift as FusedLayerNorm ('layer_norm' FP32 classification)
+        from apex_tpu.amp.autocast import resolve_dtype
+        dtype = resolve_dtype(self.dtype, "layer_norm")
+        if dtype is not None:
+            x = jnp.asarray(x, dtype)
         orig_shape = x.shape
         x2 = x.reshape(x.shape[:x.ndim - len(shape)] + (hidden,))
         if self.elementwise_affine:

@@ -147,7 +147,10 @@ class SyncBatchNorm(nn.Module):
                               self.param_dtype)
             y = y + bias.astype(jnp.float32).reshape(
                 [-1 if i == feature_axis else 1 for i in range(x.ndim)])
-        out_dtype = self.dtype if self.dtype is not None else x.dtype
+        # O1 engine: 'batch_norm' is FP32_FUNCS — with no explicit dtype an
+        # active autocast policy keeps the (already-fp32) result in fp32
+        from apex_tpu.amp.autocast import resolve_dtype
+        out_dtype = resolve_dtype(self.dtype, "batch_norm", x.dtype)
         return y.astype(out_dtype)
 
 

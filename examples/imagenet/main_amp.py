@@ -95,14 +95,21 @@ def cross_entropy(logits, labels):
     return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=1))
 
 
+def topk_hits(logits, labels, ks=(1, 5)):
+    """Per-batch top-k hit counts via one lax.top_k(max(ks)) — shared by
+    training metrics and validate()."""
+    kmax = min(max(ks), logits.shape[-1])
+    _, top = jax.lax.top_k(logits, kmax)
+    return [jnp.sum(jnp.any(top[:, :min(k, kmax)] == labels[:, None],
+                            axis=1))
+            for k in ks]
+
+
 def topk_accuracy(logits, labels, ks=(1, 5)):
     """examples/imagenet/main_amp.py — accuracy(output, target, topk)."""
-    order = jnp.argsort(logits, axis=-1)[:, ::-1]
-    out = []
-    for k in ks:
-        hit = jnp.any(order[:, :k] == labels[:, None], axis=1)
-        out.append(jnp.mean(hit.astype(jnp.float32)) * 100.0)
-    return out
+    n = labels.shape[0]
+    return [100.0 * h.astype(jnp.float32) / n
+            for h in topk_hits(logits, labels, ks)]
 
 
 def adjust_learning_rate(base_lr, epoch, steps_per_epoch):
@@ -123,6 +130,94 @@ def make_loss_fn(model):
         loss = cross_entropy(outputs, labels)
         return loss, (mutated, outputs)
     return loss_fn
+
+
+def make_eval_step(model):
+    """Eval step (reference: main_amp.py — validate's inner loop): frozen
+    batch stats, per-batch (top1 hits, top5 hits, summed loss, count)."""
+
+    def eval_step(params, model_state, batch):
+        images, labels = batch
+        logits = model.apply({"params": params, **model_state}, images,
+                             train=False)
+        logits = jnp.asarray(logits, jnp.float32)
+        hit1, hit5 = topk_hits(logits, labels)
+        loss = cross_entropy(logits, labels) * labels.shape[0]
+        return hit1, hit5, loss, labels.shape[0]
+
+    return eval_step
+
+
+def validate(jit_eval, state, batches, epoch=None, quiet=False):
+    """Reference: main_amp.py — validate(val_loader, model, criterion):
+    full pass over the held-out set, prints and returns (prec1, prec5).
+    """
+    h1 = h5 = n = 0
+    loss_sum = 0.0
+    for batch in batches:
+        b1, b5, bl, bn = jit_eval(state.params, state.model_state, batch)
+        h1 += int(b1)
+        h5 += int(b5)
+        loss_sum += float(bl)
+        n += int(bn)
+    prec1 = 100.0 * h1 / max(n, 1)
+    prec5 = 100.0 * h5 / max(n, 1)
+    if not quiet:
+        tag = f"Epoch {epoch} " if epoch is not None else ""
+        print(f"{tag}* Prec@1 {prec1:.3f} Prec@5 {prec5:.3f} "
+              f"val-loss {loss_sum / max(n, 1):.4f}")
+    return prec1, prec5
+
+
+# ImageNet channel statistics (the reference's data_prefetcher normalizes
+# with these on the GPU: main_amp.py — data_prefetcher mean/std)
+_MEAN = np.array([0.485, 0.456, 0.406], np.float32) * 255.0
+_STD = np.array([0.229, 0.224, 0.225], np.float32) * 255.0
+
+
+def load_file_dataset(path):
+    """File-backed dataset: ``path`` is an .npz (keys train_images,
+    train_labels[, val_images, val_labels]) or a directory containing
+    train.npz / val.npz with keys images, labels. Images are NHWC; uint8
+    images are normalized with the ImageNet statistics (the prefetcher's
+    job in the reference), float images are used as-is."""
+
+    def norm(images):
+        images = np.asarray(images)
+        if images.dtype == np.uint8:
+            return ((images.astype(np.float32) - _MEAN) / _STD)
+        return images.astype(np.float32)
+
+    splits = {}
+    if os.path.isdir(path):
+        for split in ("train", "val"):
+            f = os.path.join(path, f"{split}.npz")
+            if os.path.exists(f):
+                with np.load(f) as z:
+                    splits[split] = (norm(z["images"]),
+                                     np.asarray(z["labels"], np.int32))
+    else:
+        with np.load(path) as z:
+            for split in ("train", "val"):
+                if f"{split}_images" in z:
+                    splits[split] = (norm(z[f"{split}_images"]),
+                                     np.asarray(z[f"{split}_labels"],
+                                                np.int32))
+    if "train" not in splits:
+        raise SystemExit(f"=> no train split found under {path!r}")
+    return splits
+
+
+def file_batches(images, labels, batch_size, seed=None, drop_last=True):
+    """Shuffled (seeded) host batches over a file-backed split."""
+    n = images.shape[0]
+    idx = np.arange(n)
+    if seed is not None:
+        np.random.RandomState(seed).shuffle(idx)
+    stop = (n // batch_size) * batch_size if drop_last else n
+    for i in range(0, stop, batch_size):
+        take = idx[i:i + batch_size]
+        yield images[take], labels[take]
 
 
 def synthetic_batch(rng, batch_size, image_size, num_classes):
@@ -185,8 +280,13 @@ def main(argv=None):
         from apex_tpu.parallel import SyncBatchNorm
         norm_cls = functools.partial(SyncBatchNorm, axis_name=axis_name)
 
+    # O1 (patch_torch_functions): leave dtype=None — the model resolves each
+    # op class against the policy tables inside make_train_step's autocast
+    # (convs half, batch_norm fp32). O0/O2/O3: the blanket compute dtype.
+    model_dtype = None if policy.patch_torch_functions \
+        else policy.compute_dtype
     model = create_model(
-        args.arch, num_classes=args.num_classes, dtype=policy.compute_dtype,
+        args.arch, num_classes=args.num_classes, dtype=model_dtype,
         param_dtype=jnp.float32, norm_cls=norm_cls)
 
     rng = jax.random.PRNGKey(args.seed)
@@ -194,6 +294,16 @@ def main(argv=None):
     variables = model.init(rng, sample, train=True)
     model_state = {k: v for k, v in variables.items() if k != "params"}
     params = variables["params"]
+
+    # dataset first: a file-backed dataset defines iters/epoch, which the
+    # LR schedule's epoch-30/60/80 boundaries depend on (reference:
+    # adjust_learning_rate is driven by the real loader length)
+    dataset = load_file_dataset(args.data) if args.data else None
+    if dataset is not None:
+        n_train = dataset["train"][0].shape[0]
+        args.iters = max(n_train // args.batch_size, 1)
+        print(f"=> file dataset: {n_train} train images, "
+              f"{args.iters} iters/epoch")
 
     steps_per_epoch = args.iters
     schedule = adjust_learning_rate(args.lr, 0, steps_per_epoch)
@@ -256,17 +366,41 @@ def main(argv=None):
                    hrng.randint(0, args.num_classes,
                                 size=(args.batch_size,)).astype(np.int32))
 
+    # validation: the file dataset's val split when present, otherwise a
+    # FIXED held-out synthetic set so top-1 is still a measured number
+    jit_eval = jax.jit(make_eval_step(model))
+    if dataset is not None and "val" in dataset:
+        def val_batches():
+            return file_batches(*dataset["val"], args.batch_size,
+                                drop_last=False)
+    else:
+        _val = [synthetic_batch(jax.random.PRNGKey(10_000 + i),
+                                args.batch_size, args.image_size,
+                                args.num_classes)
+                for i in range(4)]
+
+        def val_batches():
+            return iter(_val)
+
+    best_prec1 = 0.0
     for epoch in range(start_epoch, args.epochs):
         t0 = None
         imgs = 0
         prefetcher = None
-        if args.host_data:
+        if dataset is not None:
+            prefetcher = data_prefetcher(
+                file_batches(*dataset["train"], args.batch_size,
+                             seed=args.seed + epoch),
+                sharding=batch_sharding)
+        elif args.host_data:
             prefetcher = data_prefetcher(
                 host_batches(args.seed + epoch, args.iters),
                 sharding=batch_sharding)
         for it in range(args.iters):
             if prefetcher is not None:
                 batch = prefetcher.next()
+                if batch is None:
+                    break
             else:
                 rng, sub = jax.random.split(rng)
                 if args.deterministic:
@@ -295,14 +429,19 @@ def main(argv=None):
         if t0 is not None and args.iters > 5:
             dt = time.perf_counter() - t0
             print(f"Epoch {epoch}: {(imgs - args.batch_size) / dt:.1f} img/s")
+        # validation pass each epoch (reference: prec1 = validate(...);
+        # best_prec1 tracked for the checkpoint's is_best flag)
+        prec1, _ = validate(jit_eval, state, val_batches(), epoch=epoch)
+        best_prec1 = max(best_prec1, prec1)
         if ckpt is not None:
             path = os.path.join(args.checkpoint_dir,
                                 f"ckpt_{epoch + 1}.npz")
             ckpt.save(path, state, step=epoch + 1,
-                      extra={"epoch": epoch + 1})
+                      extra={"epoch": epoch + 1, "best_prec1": best_prec1})
             print(f"=> saved {path}")
     if ckpt is not None:
         ckpt.wait()
+    print(f"=> best Prec@1 {best_prec1:.3f}")
     return state
 
 
