@@ -1,0 +1,130 @@
+"""Fused scale + causal-mask + softmax Pallas kernel.
+
+TPU-native equivalent of the reference's
+``scaled_upper_triang_masked_softmax_cuda`` extension
+(apex/contrib → csrc/megatron/scaled_upper_triang_masked_softmax.h —
+scaled_upper_triang_masked_softmax_warp_forward/backward; SURVEY N8).
+Semantics preserved: half I/O allowed, softmax math in fp32, strictly-upper-
+triangular entries masked to zero probability.
+
+Layout: rows ride a (batch, q-block) grid with the full key row in VMEM per
+block (the xentropy kernel's layout). The causal structure is applied as an
+in-register iota mask; entirely-masked key spans cost no exp/sum work on the
+VPU (the "tile-skip win" of the CUDA kernel — note that for a kernel that
+MATERIALIZES the probability matrix, HBM traffic bounds throughput, so the
+skip is a compute saving; the full fusion of softmax into the surrounding
+GEMMs, where skipping saves bandwidth too, is the flash-attention kernel).
+
+Backward: dx = scale * p * (g - sum(g*p, -1)); causal zeros in p make the
+masked gradient exactly zero with no explicit mask.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from apex_tpu.kernels import vmem
+
+__all__ = ["causal_softmax", "causal_softmax_reference"]
+
+_NEG = -1e30
+
+
+def causal_softmax_reference(x, scale: float = 1.0):
+    """fp32 composed reference (the jnp fallback path)."""
+    out_dtype = x.dtype
+    x32 = jnp.asarray(x, jnp.float32) * scale
+    sq, sk = x32.shape[-2], x32.shape[-1]
+    mask = jnp.triu(jnp.ones((sq, sk), jnp.bool_), k=1)
+    x32 = jnp.where(mask, _NEG, x32)
+    y = jnp.exp(x32 - jnp.max(x32, axis=-1, keepdims=True))
+    y = y / jnp.sum(y, axis=-1, keepdims=True)
+    return jnp.asarray(y, out_dtype)
+
+
+def _fwd_kernel(x_ref, out_ref, *, scale, bq):
+    q0 = pl.program_id(1) * bq
+    x = x_ref[0].astype(jnp.float32) * scale          # [bq, sk]
+    rows = jax.lax.broadcasted_iota(jnp.int32, x.shape, 0) + q0
+    cols = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    x = jnp.where(cols > rows, _NEG, x)
+    m = jnp.max(x, axis=-1, keepdims=True)
+    e = jnp.exp(x - m)
+    out = e / jnp.sum(e, axis=-1, keepdims=True)
+    out_ref[0] = out.astype(out_ref.dtype)
+
+
+def _bwd_kernel(p_ref, g_ref, out_ref, *, scale):
+    p = p_ref[0].astype(jnp.float32)                  # [bq, sk]
+    g = g_ref[0].astype(jnp.float32)
+    dot = jnp.sum(g * p, axis=-1, keepdims=True)
+    out_ref[0] = (scale * p * (g - dot)).astype(out_ref.dtype)
+
+
+def _block_q(sq, sk):
+    # fp32 row block + ~3 temporaries (exp, iota, output)
+    return vmem.block_rows(sq, row_bytes=4 * sk, n_bufs=4, max_rows=128,
+                           divisor_of=sq)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _causal_softmax(x, scale, interpret):
+    out, _ = _causal_fwd(x, scale, interpret)
+    return out
+
+
+def _causal_fwd(x, scale, interpret):
+    n, sq, sk = x.shape
+    bq = _block_q(sq, sk)
+    out = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, bq=bq),
+        grid=(n, sq // bq),
+        in_specs=[pl.BlockSpec((1, bq, sk), lambda i, j: (i, j, 0))],
+        out_specs=pl.BlockSpec((1, bq, sk), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, sq, sk), x.dtype),
+        interpret=interpret,
+    )(x)
+    return out, out
+
+
+def _causal_bwd(scale, interpret, p, g):
+    n, sq, sk = p.shape
+    bq = _block_q(sq, sk)
+    dx = pl.pallas_call(
+        functools.partial(_bwd_kernel, scale=scale),
+        grid=(n, sq // bq),
+        in_specs=[pl.BlockSpec((1, bq, sk), lambda i, j: (i, j, 0)),
+                  pl.BlockSpec((1, bq, sk), lambda i, j: (i, j, 0))],
+        out_specs=pl.BlockSpec((1, bq, sk), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, sq, sk), p.dtype),
+        interpret=interpret,
+    )(p, g)
+    return (dx,)
+
+
+_causal_softmax.defvjp(_causal_fwd, _causal_bwd)
+
+
+def causal_softmax(x, scale: float = 1.0, interpret: bool = False):
+    """probs = softmax(scale * x + causal_mask) over the last dim.
+
+    ``x``: [..., sq, sk], half or fp32; returns probs in the input dtype
+    with fp32 softmax math (the reference kernel's contract). Unaligned
+    shapes fall back to the jnp reference.
+    """
+    shape = x.shape
+    sq, sk = shape[-2], shape[-1]
+    n = 1
+    for s in shape[:-2]:
+        n *= s
+    aligned = sk % 128 == 0 and (sq % 128 == 0 or sq % 8 == 0)
+    if not aligned:
+        return causal_softmax_reference(x, scale)
+    if jax.default_backend() == "cpu":
+        interpret = True
+    return _causal_softmax(x.reshape(n, sq, sk), scale,
+                           interpret).reshape(shape)

@@ -5,12 +5,16 @@ FusedScaleMaskSoftmax dispatches between the megatron CUDA kernels
 (scaled_masked_softmax_cuda, scaled_upper_triang_masked_softmax_cuda; csrc/
 megatron/scaled_masked_softmax.h) and a torch fallback, by dtype/shape limits.
 
-TPU design: one jnp expression — XLA fuses scale+mask+softmax into the
-surrounding matmuls on its own, which is precisely what the CUDA kernels
-exist to do by hand; the kernels' semantics are kept (half I/O allowed,
+TPU design: the causal variant routes to the Pallas kernel in
+apex_tpu.kernels.causal_softmax (one VMEM pass, iota mask, fp32 math — the
+N8 equivalent) when shapes align, with the jnp composition as fallback; the
+generic-mask variant stays a jnp expression that XLA fuses into the
+surrounding matmuls. Kernel semantics are kept either way (half I/O allowed,
 softmax math in fp32 when softmax_in_fp32, additive -10000 masking for the
 padding mask, strict upper-triangular causal mask). The module class keeps
 the reference's constructor surface so Megatron-style blocks port unchanged.
+Callers wanting the softmax fused BETWEEN the attention GEMMs (the even
+bigger win) should use kernels.flash_attention — the N11/N12 path.
 """
 
 from __future__ import annotations
@@ -50,7 +54,13 @@ def scaled_masked_softmax(x, mask, scale: float = 1.0,
 def scaled_upper_triang_masked_softmax(x, scale: float = 1.0,
                                        softmax_in_fp32: bool = True):
     """Causal: strictly-upper-triangular entries masked (reference kernel:
-    scaled_upper_triang_masked_softmax_warp_forward)."""
+    scaled_upper_triang_masked_softmax_warp_forward). Dispatches to the
+    Pallas causal-softmax kernel when softmax_in_fp32 (the kernel's only
+    mode, matching the CUDA kernel's fp32 accumulation); the
+    not-softmax_in_fp32 oddity keeps the jnp path."""
+    if softmax_in_fp32:
+        from apex_tpu.kernels.causal_softmax import causal_softmax
+        return causal_softmax(x, scale)
     sq, sk = x.shape[-2], x.shape[-1]
     causal = jnp.triu(jnp.ones((sq, sk), jnp.bool_), k=1)
     return scaled_masked_softmax(x, causal, scale, softmax_in_fp32)

@@ -4,7 +4,8 @@ from .microbatches import build_num_microbatches_calculator
 from .p2p_communication import (send_backward, send_backward_recv_forward,
                                 send_forward, send_forward_recv_backward,
                                 shift_left, shift_right)
-from .schedules import (build_model, forward_backward_no_pipelining,
+from .schedules import (build_model, forward_backward_1f1b,
+                        forward_backward_no_pipelining,
                         forward_backward_pipelining_with_interleaving,
                         forward_backward_pipelining_without_interleaving,
                         get_forward_backward_func, make_pipeline_loss_fn,

@@ -6,16 +6,19 @@ interleaving (1F1B: warmup/steady/cooldown over torch.distributed P2P),
 forward_backward_pipelining_with_interleaving (virtual stages), selected by
 get_forward_backward_func.
 
-TPU design — collective-permute pipelining. The reference hand-schedules
-1F1B because torch autograd is eager and NCCL P2P must be interleaved by
-hand. Under XLA the whole pipeline is ONE program: microbatches flow through
-stages via ``ppermute`` over the ``pipe`` axis inside ``lax.scan``, and the
-BACKWARD schedule is derived by autodiff (the transpose of a ppermute scan is
-the reversed-perm scan — exactly the cooldown/steady/warmup mirror), with
-XLA's latency-hiding scheduler overlapping the permutes with compute. Memory
-behavior matches GPipe fill-drain; wrap ``stage_fn`` in ``jax.checkpoint``
-(tensor_parallel.random.checkpoint) to get the activation-memory profile the
-reference gets from its schedule.
+TPU design — collective-permute pipelining, two complementary paths:
+
+1. **Autodiff path** (:func:`make_pipeline_loss_fn` / :func:`pipeline_apply`):
+   microbatches flow through stages via ``ppermute`` inside ``lax.scan``; the
+   backward schedule is derived by autodiff (the transpose of a ppermute scan
+   is the reversed-perm scan — the cooldown/steady/warmup mirror). Composes
+   as an ordinary differentiable loss with amp.make_train_step, but memory
+   behaves like GPipe fill-drain: scan residuals grow with microbatch count.
+2. **Hand-scheduled 1F1B** (:func:`forward_backward_1f1b`): one forward-only
+   scan interleaving a fwd stage step and a bwd stage step per tick, with a
+   static-depth saved-input FIFO and in-backward recompute — activation
+   memory O(pp), flat in M, the reference schedule's actual memory profile.
+   Returns (loss, grads) like the reference's fwd-bwd functions.
 
 Interleaving (virtual pipeline): each device holds ``v`` model chunks;
 logical stage ``s = chunk * pp + rank`` (the reference's round-robin model
@@ -39,6 +42,7 @@ import jax.numpy as jnp
 from apex_tpu.comm import AXIS_PIPE
 
 __all__ = ["pipeline_apply", "make_pipeline_loss_fn",
+           "forward_backward_1f1b",
            "forward_backward_no_pipelining",
            "forward_backward_pipelining_without_interleaving",
            "forward_backward_pipelining_with_interleaving",
@@ -133,13 +137,11 @@ def make_pipeline_loss_fn(stage_fn: Callable, loss_fn: Callable, *,
                              axis_name=axis_name, num_stages=num_stages,
                              num_chunks=num_chunks)
 
-        def per_tick(t):
-            m = jnp.clip(t - (L - 1), 0, M - 1)
-            l = loss_fn(outs[t], targets[m])
-            valid = (t >= L - 1) & (rank == num_stages - 1)
-            return jnp.where(valid, l, 0.0)
-
-        total = jnp.sum(jax.vmap(per_tick)(jnp.arange(T)))
+        # loss only on the M finished-microbatch ticks (static slice), not
+        # all T — warmup/drain garbage never reaches loss_fn
+        del T
+        losses = jax.vmap(loss_fn)(outs[L - 1:], targets)        # [M]
+        total = jnp.where(rank == num_stages - 1, jnp.sum(losses), 0.0)
         # replicate the scalar across stages so every rank's train step sees
         # the same loss (grads for other stages' params flow via ppermute's
         # transpose regardless). The psum is value-only (stop_gradient):
@@ -150,6 +152,118 @@ def make_pipeline_loss_fn(stage_fn: Callable, loss_fn: Callable, *,
         return total / M
 
     return fn
+
+
+def forward_backward_1f1b(stage_fn: Callable, loss_fn: Callable,
+                          local_params, microbatches, targets, *,
+                          axis_name: str = AXIS_PIPE, num_stages: int,
+                          loss_scale=None):
+    """Hand-scheduled 1F1B with O(pp) activation memory — the TRUE memory
+    profile of the reference schedule (apex/transformer/pipeline_parallel/
+    schedules/fwd_bwd_pipelining_without_interleaving.py —
+    forward_backward_pipelining_without_interleaving; SURVEY P24, §4.5).
+
+    The autodiff path (:func:`make_pipeline_loss_fn` under ``jax.grad``)
+    saves residuals for every scan tick, so its activation memory grows with
+    the microbatch count M — exactly what 1F1B exists to prevent. This
+    function instead writes the backward schedule BY HAND inside one
+    forward-only ``lax.scan``:
+
+    - each tick runs one forward stage step (microbatch stream + ppermute
+      rotation, as pipeline_apply) AND one backward stage step (cotangent
+      counter-rotated with a reverse ppermute) — the steady-state 1F1B
+      cadence of one fwd + one bwd per device per slot;
+    - the only per-microbatch state is a FIFO of saved stage INPUTS of
+      static depth 2·pp−1 — independent of M. Stage internals are
+      recomputed in the backward via ``jax.vjp`` (the reference trains big
+      models with the same full-recompute policy:
+      tensor_parallel/random.py — checkpoint);
+    - microbatch m's forward runs on stage s at tick m+s; its backward on
+      stage s at tick m + 2(pp−1) − s; total ticks T = M + 2(pp−1). The
+      loss cotangent is seeded at the last stage in the same tick its
+      forward completes (1F1B's defining "backward as early as possible").
+
+    Returns ``(mean_loss, grads)`` like the reference's fwd-bwd functions —
+    grads for THIS stage's params, loss replicated across stages. Must run
+    inside shard_map with the pipe axis bound. ``loss_scale`` (optional,
+    traced ok) scales the seeded cotangent — the amp composition point
+    (scale here, unscale via amp.unscale on the returned grads).
+
+    In-flight bound: stage r holds at most 2(pp−1−r)+1 ≤ 2·pp−1 microbatch
+    inputs — a ~2× constant over the reference's pp bound (its warmup runs
+    forwards at double rate; a uniform-tick collective-permute schedule
+    spends that in exchange for one traced program) but flat in M, which is
+    the property that matters at scale.
+    """
+    S = num_stages
+    if S <= 1:
+        raise ValueError("forward_backward_1f1b needs num_stages > 1; use "
+                         "forward_backward_no_pipelining")
+    rank = jax.lax.axis_index(axis_name)
+    M = microbatches.shape[0]
+    Q = 2 * S - 1
+    T = M + 2 * (S - 1)
+    fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+    bwd_perm = [(i, (i - 1) % S) for i in range(S)]
+
+    x0 = jnp.zeros_like(microbatches[0])
+    queue0 = jnp.stack([x0] * Q)
+    grads0 = jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), local_params)
+    scale = 1.0 if loss_scale is None else loss_scale
+
+    def tick(carry, t):
+        fwd_buf, cot_buf, queue, grads, loss_acc = carry
+
+        # ---- forward unit (same dataflow as _pipe_scan, v=1)
+        m_f = t - rank                      # this stage's fwd microbatch
+        fresh = microbatches[jnp.clip(m_f, 0, M - 1)]
+        x_in = jnp.where(rank == 0, fresh, fwd_buf)
+        y = stage_fn(local_params, x_in)
+        queue = jax.lax.dynamic_update_index_in_dim(
+            queue, x_in, t % Q, axis=0)
+
+        # ---- backward unit: microbatch m_b = t - 2(S-1) + rank
+        m_b = t - 2 * (S - 1) + rank
+        valid_b = (m_b >= 0) & (m_b < M)
+        # last stage seeds the cotangent from the loss of the microbatch
+        # whose forward JUST completed (same tick); other stages consume
+        # the counter-rotated cotangent from stage r+1
+        tgt = targets[jnp.clip(t - (S - 1), 0, M - 1)]
+        dly = jax.grad(lambda yy: loss_fn(yy, tgt) * scale)(y)
+        cot_in = jnp.where(rank == S - 1, jnp.asarray(dly, cot_buf.dtype),
+                           cot_buf)
+        # saved input for m_b: written 2(S-1-rank) ticks ago
+        x_saved = jax.lax.dynamic_index_in_dim(
+            queue, (t - 2 * (S - 1 - rank)) % Q, axis=0, keepdims=False)
+        # recompute-in-backward: vjp re-runs the stage forward (reference:
+        # full activation recompute via tensor_parallel checkpoint)
+        _, vjp_fn = jax.vjp(stage_fn, local_params, x_saved)
+        dparams, dx = vjp_fn(cot_in)
+        grads = jax.tree_util.tree_map(
+            lambda g, d: g + jnp.where(valid_b, d, 0.0).astype(g.dtype),
+            grads, dparams)
+
+        # ---- loss bookkeeping (last stage, fwd-completion ticks)
+        l = loss_fn(y, tgt)
+        valid_l = (rank == S - 1) & (t >= S - 1) & (t - (S - 1) < M)
+        loss_acc = loss_acc + jnp.where(valid_l, l, 0.0)
+
+        # ---- rotations
+        fwd_buf = jax.lax.ppermute(y, axis_name, fwd_perm)
+        cot_buf = jax.lax.ppermute(
+            jnp.where(valid_b, dx, jnp.zeros_like(dx)), axis_name, bwd_perm)
+        return (fwd_buf, cot_buf, queue, grads, loss_acc), None
+
+    carry0 = (x0, jnp.zeros_like(x0), queue0, grads0, jnp.float32(0.0))
+    (_, _, _, grads, loss), _ = jax.lax.scan(tick, carry0, jnp.arange(T))
+
+    grads = jax.tree_util.tree_map(lambda g: g / M, grads)
+    loss = loss / M
+    # replicate the scalar loss across stages (value-only)
+    loss = loss + jax.lax.stop_gradient(
+        jax.lax.psum(loss, axis_name) - loss)
+    return loss, grads
 
 
 # ------------------------------------------------------- reference-shaped API
@@ -183,12 +297,24 @@ def forward_backward_no_pipelining(loss_fn, params, microbatches, targets,
 def forward_backward_pipelining_without_interleaving(
         stage_fn, loss_fn, local_params, microbatches, targets, *,
         axis_name: str = AXIS_PIPE, num_stages: int, grad: bool = True):
-    """1F1B-equivalent (reference: schedules/fwd_bwd_pipelining_without_
-    interleaving.py). Must run inside shard_map with the pipe axis bound."""
+    """1F1B (reference: schedules/fwd_bwd_pipelining_without_
+    interleaving.py). Must run inside shard_map with the pipe axis bound.
+
+    ``grad=True`` runs the hand-scheduled :func:`forward_backward_1f1b`
+    (O(pp) activation memory, matching the reference's memory profile);
+    ``grad=False`` is a plain pipelined forward. For a differentiable loss
+    to hand to ``jax.grad``/amp.make_train_step, use
+    :func:`make_pipeline_loss_fn` — its fill-drain autodiff memory grows
+    with the microbatch count, the documented trade for whole-step jit
+    composability.
+    """
+    if grad:
+        return forward_backward_1f1b(stage_fn, loss_fn, local_params,
+                                     microbatches, targets,
+                                     axis_name=axis_name,
+                                     num_stages=num_stages)
     pl = make_pipeline_loss_fn(stage_fn, loss_fn, axis_name=axis_name,
                                num_stages=num_stages, num_chunks=1)
-    if grad:
-        return jax.value_and_grad(pl)(local_params, (microbatches, targets))
     return pl(local_params, (microbatches, targets))
 
 

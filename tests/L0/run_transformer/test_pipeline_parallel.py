@@ -172,3 +172,116 @@ def test_get_forward_backward_func():
     assert f.func is pp.forward_backward_pipelining_without_interleaving
     f = pp.get_forward_backward_func(2, 4)
     assert f.func is pp.forward_backward_pipelining_with_interleaving
+
+
+# --------------------------------------------------------------- 1F1B proper
+def test_1f1b_matches_sequential(pipe_mesh):
+    """Hand-scheduled 1F1B (loss, grads) == sequential oracle — same math
+    as the autodiff path, different schedule."""
+    ws, mb, tg = _data()
+
+    @functools.partial(shard_map, mesh=pipe_mesh,
+                       in_specs=(P("pipe"), P(), P()),
+                       out_specs=(P(), P("pipe")), check_rep=False)
+    def run(ws_local, mb, tg):
+        l, g = pp.forward_backward_1f1b(stage_fn, loss_fn, ws_local[0],
+                                        mb, tg, num_stages=PP)
+        return l, g[None]
+
+    loss, grads = jax.jit(run)(ws, mb, tg)
+    ref_loss, ref_grads = jax.value_and_grad(_ref_loss)(ws, mb, tg)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(grads), np.asarray(ref_grads),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_1f1b_via_reference_shaped_api(pipe_mesh):
+    """forward_backward_pipelining_without_interleaving(grad=True) routes to
+    the 1F1B schedule and matches the oracle."""
+    ws, mb, tg = _data()
+
+    @functools.partial(shard_map, mesh=pipe_mesh,
+                       in_specs=(P("pipe"), P(), P()),
+                       out_specs=(P(), P("pipe")), check_rep=False)
+    def run(ws_local, mb, tg):
+        l, g = pp.forward_backward_pipelining_without_interleaving(
+            stage_fn, loss_fn, ws_local[0], mb, tg, num_stages=PP)
+        return l, g[None]
+
+    loss, grads = jax.jit(run)(ws, mb, tg)
+    ref_loss, ref_grads = jax.value_and_grad(_ref_loss)(ws, mb, tg)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(grads), np.asarray(ref_grads),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_1f1b_loss_scale_scales_grads_only(pipe_mesh):
+    """loss_scale seeds the cotangent (amp composition): grads x scale,
+    reported loss unscaled."""
+    ws, mb, tg = _data()
+
+    def run_with(scale):
+        @functools.partial(shard_map, mesh=pipe_mesh,
+                           in_specs=(P("pipe"), P(), P()),
+                           out_specs=(P(), P("pipe")), check_rep=False)
+        def run(ws_local, mb, tg):
+            l, g = pp.forward_backward_1f1b(
+                stage_fn, loss_fn, ws_local[0], mb, tg, num_stages=PP,
+                loss_scale=scale)
+            return l, g[None]
+        return jax.jit(run)(ws, mb, tg)
+
+    l1, g1 = run_with(None)
+    l8, g8 = run_with(8.0)
+    np.testing.assert_allclose(float(l1), float(l8), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(g8), 8.0 * np.asarray(g1),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_1f1b_memory_flat_as_microbatches_double(pipe_mesh):
+    """THE 1F1B property (VERDICT round-1 item 3): peak temp memory of the
+    compiled step stays flat as M doubles, while the autodiff fill-drain
+    path's residual stash grows with M."""
+    D2 = 64
+
+    def big_stage(w, x):
+        return jnp.tanh(x @ w)
+
+    def temp_bytes(fn, M):
+        ws = jnp.ones((PP, D2, D2))
+        mb = jnp.ones((M, 32, D2))
+        tg = jnp.ones((M, 32, D2))
+        c = jax.jit(fn).lower(ws, mb, tg).compile()
+        return c.memory_analysis().temp_size_in_bytes
+
+    def onef1b(ws, mb, tg):
+        @functools.partial(shard_map, mesh=pipe_mesh,
+                           in_specs=(P("pipe"), P(), P()),
+                           out_specs=(P(), P("pipe")), check_rep=False)
+        def run(ws_local, mb, tg):
+            l, g = pp.forward_backward_1f1b(big_stage, loss_fn, ws_local[0],
+                                            mb, tg, num_stages=PP)
+            return l, g[None]
+        return run(ws, mb, tg)
+
+    def autodiff(ws, mb, tg):
+        pl = pp.make_pipeline_loss_fn(big_stage, loss_fn, num_stages=PP)
+
+        @functools.partial(shard_map, mesh=pipe_mesh,
+                           in_specs=(P("pipe"), P(), P()),
+                           out_specs=(P(), P("pipe")), check_rep=False)
+        def run(ws_local, mb, tg):
+            l, g = jax.value_and_grad(pl)(ws_local[0], (mb, tg))
+            return l, g[None]
+        return run(ws, mb, tg)
+
+    m_small, m_big = 8, 32
+    f_small = temp_bytes(onef1b, m_small)
+    f_big = temp_bytes(onef1b, m_big)
+    a_small = temp_bytes(autodiff, m_small)
+    a_big = temp_bytes(autodiff, m_big)
+
+    # autodiff residuals grow with M...
+    assert a_big > 1.5 * a_small, (a_small, a_big)
+    # ...1F1B's saved state does not (allow slack for per-tick scratch)
+    assert f_big < 1.25 * f_small, (f_small, f_big)

@@ -1,0 +1,72 @@
+"""Pallas causal-softmax kernel (N8) parity tests vs the fp32 jnp reference.
+
+Mirrors the reference's contrib test pattern: fused kernel against a composed
+reference with dtype-dependent tolerances (SURVEY §5.1).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.kernels.causal_softmax import (causal_softmax,
+                                             causal_softmax_reference)
+
+
+@pytest.mark.parametrize("dtype,tol", [(jnp.float32, 1e-6),
+                                       (jnp.bfloat16, 1e-2)])
+@pytest.mark.parametrize("shape", [(2, 3, 128, 128), (1, 2, 256, 384),
+                                   (4, 8, 128)])
+def test_forward_parity(dtype, tol, shape):
+    x = jax.random.normal(jax.random.PRNGKey(0), shape, dtype) * 3.0
+    out = causal_softmax(x, scale=0.5)
+    ref = causal_softmax_reference(x, scale=0.5)
+    assert out.dtype == dtype
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=tol, atol=tol)
+    # rows sum to 1, strict upper triangle is zero
+    s = np.asarray(out, np.float32)
+    np.testing.assert_allclose(s.sum(-1), 1.0, rtol=2 * tol, atol=2 * tol)
+    sq, sk = shape[-2], shape[-1]
+    mask = np.triu(np.ones((sq, sk), bool), k=1)
+    assert (np.abs(s[..., mask]) < tol).all()
+
+
+def test_backward_parity():
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 128, 128), jnp.float32)
+
+    def f_kernel(x):
+        return jnp.sum(jnp.sin(causal_softmax(x, scale=0.7) * 3.0))
+
+    def f_ref(x):
+        return jnp.sum(jnp.sin(causal_softmax_reference(x, scale=0.7) * 3.0))
+
+    gk = jax.grad(f_kernel)(x)
+    gr = jax.grad(f_ref)(x)
+    np.testing.assert_allclose(np.asarray(gk), np.asarray(gr),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_unaligned_falls_back():
+    x = jax.random.normal(jax.random.PRNGKey(2), (2, 7, 33), jnp.float32)
+    out = causal_softmax(x)  # 33 % 128 != 0 → reference path, still correct
+    ref = causal_softmax_reference(x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-6)
+
+
+def test_fused_scale_mask_softmax_routes_causal():
+    """FusedScaleMaskSoftmax(causal) → the Pallas path (VERDICT round-1
+    item 8), numerically matching the kernel reference."""
+    from apex_tpu.transformer.enums import AttnMaskType
+    from apex_tpu.transformer.functional.fused_softmax import (
+        FusedScaleMaskSoftmax)
+
+    x = jax.random.normal(jax.random.PRNGKey(3), (2, 4, 128, 128),
+                          jnp.bfloat16)
+    m = FusedScaleMaskSoftmax(attn_mask_type=AttnMaskType.causal, scale=0.25)
+    out = m(x)
+    ref = causal_softmax_reference(x, scale=0.25)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=1e-2, atol=1e-2)

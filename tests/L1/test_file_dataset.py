@@ -1,0 +1,73 @@
+"""File-backed imagenet loader (VERDICT round-1 item 5: a real-data path,
+not synthetic-only). Round-trips an npz dataset through the recipe's
+loader, trains on it via main(), and checks validate() runs on the val
+split."""
+
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+from examples.imagenet import main_amp  # noqa: E402
+
+
+def _write_dataset(tmp_path, n_train=48, n_val=16, size=16, classes=4):
+    rng = np.random.RandomState(0)
+
+    def split(n):
+        labels = rng.randint(0, classes, size=n).astype(np.int32)
+        base = labels[:, None, None, None].astype(np.float32)
+        images = (base * 40 + rng.randn(n, size, size, 3) * 10 + 100)
+        return images.clip(0, 255).astype(np.uint8), labels
+
+    ti, tl = split(n_train)
+    vi, vl = split(n_val)
+    np.savez(tmp_path / "train.npz", images=ti, labels=tl)
+    np.savez(tmp_path / "val.npz", images=vi, labels=vl)
+    return tmp_path
+
+
+def test_load_file_dataset_dir_and_npz(tmp_path):
+    d = _write_dataset(tmp_path)
+    ds = main_amp.load_file_dataset(str(d))
+    assert set(ds) == {"train", "val"}
+    images, labels = ds["train"]
+    assert images.dtype == np.float32        # uint8 → normalized float
+    assert abs(images.mean()) < 3.0          # roughly centered
+    assert labels.dtype == np.int32
+
+    # single-npz form
+    f = tmp_path / "all.npz"
+    np.savez(f, train_images=images, train_labels=labels)
+    ds2 = main_amp.load_file_dataset(str(f))
+    assert "train" in ds2 and "val" not in ds2
+
+    with pytest.raises(SystemExit):
+        empty = tmp_path / "empty.npz"
+        np.savez(empty, other=np.zeros(3))
+        main_amp.load_file_dataset(str(empty))
+
+
+def test_file_batches_shuffle_and_drop():
+    images = np.arange(10)[:, None].astype(np.float32)
+    labels = np.arange(10).astype(np.int32)
+    batches = list(main_amp.file_batches(images, labels, 4, seed=0))
+    assert len(batches) == 2                      # drop_last
+    seen = np.concatenate([b[1] for b in batches])
+    assert len(set(seen.tolist())) == 8           # no dupes
+    full = list(main_amp.file_batches(images, labels, 4, drop_last=False))
+    assert sum(b[1].shape[0] for b in full) == 10
+
+
+@pytest.mark.slow
+def test_main_trains_and_validates_on_file_data(tmp_path, capsys):
+    d = _write_dataset(tmp_path)
+    main_amp.main([str(d), "--arch", "resnet18", "-b", "16",
+                   "--image-size", "16", "--num-classes", "4",
+                   "--opt-level", "O2", "--epochs", "2", "--lr", "0.05"])
+    out = capsys.readouterr().out
+    assert "file dataset: 48 train images" in out
+    assert "Prec@1" in out and "best Prec@1" in out
