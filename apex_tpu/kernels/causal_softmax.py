@@ -121,7 +121,7 @@ def causal_softmax(x, scale: float = 1.0, interpret: bool = False):
     n = 1
     for s in shape[:-2]:
         n *= s
-    aligned = sk % 128 == 0 and (sq % 128 == 0 or sq % 8 == 0)
+    aligned = sk % 128 == 0 and sq % 8 == 0
     if not aligned:
         return causal_softmax_reference(x, scale)
     if jax.default_backend() == "cpu":

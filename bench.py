@@ -8,6 +8,13 @@ data. Prints ONE JSON line:
 vs_baseline is relative to the apex O2 V100 per-GPU rate (~820 img/s, NVIDIA
 DeepLearningExamples ResNet50v1.5 README — see BASELINE.md; the driver's bar
 is >=0.9 on real v5e hardware).
+
+The JSON is self-describing about plausibility (VERDICT round-1 weak #1):
+``mfu_est`` is the model-FLOPs utilization implied by the measured rate
+against the chip's bf16 peak, and ``implausible: true`` flags any reading
+over 1.0 — on the axon emulator, step time is dispatch-dominated and the
+absolute rate exceeds silicon physics; such readings are regression
+trackers only, never hardware claims.
 """
 
 from __future__ import annotations
@@ -25,6 +32,27 @@ from apex_tpu.amp.policy import resolve_policy
 from apex_tpu.models.resnet import create_model
 
 V100_O2_IMG_PER_SEC = 820.0
+
+# Analytic ResNet-50 cost: ~4.1 GMACs forward per 224x224 image = ~8.2
+# GFLOP at mult+add=2 counting; a training step is ~3x forward
+# (backward ~2x). Scaled by (IMAGE/224)^2 for non-default resolutions
+# (conv cost is proportional to spatial area).
+RESNET50_TRAIN_FLOP_PER_IMG_224 = 3 * 8.2e9
+
+# bf16 peak by device kind; conservative default for unknown kinds.
+_PEAK_BF16 = {
+    "TPU v5 lite": 394e12,   # v5e
+    "TPU v5": 459e12,        # v5p
+    "TPU v4": 275e12,
+}
+
+
+def peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "")
+    for key, peak in _PEAK_BF16.items():
+        if kind.startswith(key):
+            return peak
+    return 394e12
 
 # 256/chip is the apex-recipe production batch for ResNet-50 amp O2 (NVIDIA
 # DeepLearningExamples uses 256/V100-32G; a v5e's 16GB holds it in bf16) and
@@ -74,11 +102,16 @@ def main():
     dt = time.perf_counter() - t0
 
     img_per_sec = BATCH * STEPS / dt
+    flop_per_img = RESNET50_TRAIN_FLOP_PER_IMG_224 * (IMAGE / 224.0) ** 2
+    mfu = img_per_sec * flop_per_img / peak_flops(jax.devices()[0])
     print(json.dumps({
         "metric": "resnet50_amp_o2_train_img_per_sec_per_chip",
         "value": round(img_per_sec, 2),
         "unit": "img/s/chip",
         "vs_baseline": round(img_per_sec / V100_O2_IMG_PER_SEC, 4),
+        "mfu_est": round(mfu, 4),
+        "implausible": bool(mfu > 1.0),
+        "device_kind": getattr(jax.devices()[0], "device_kind", "unknown"),
     }))
 
 

@@ -1,0 +1,242 @@
+"""Kernel microbenchmarks: Pallas kernels vs their jnp/XLA compositions on
+the SAME backend, at LM-production shapes (VERDICT round-1 item 1b).
+
+Every fused kernel family gets a measured same-device speedup (or a
+documented "XLA wins, fallback kept" verdict) — the evidence tier backing
+the SURVEY N2/N4/N8/N10/N11 kernel list. Results are recorded in
+BASELINE.md. Run on the real chip:
+
+    python bench_kernels.py            # all suites
+    python bench_kernels.py flash ln   # a subset
+
+Prints one JSON line per row:
+  {"bench": ..., "shape": ..., "pallas_ms": ..., "xla_ms": ...,
+   "speedup": ...}
+Absolute times on the axon emulator are dispatch-dominated; the speedup
+column (same backend, same harness) is the meaningful number.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def timeit(fn, *args, warmup=2, steps=10):
+    """Median-of-steps wall time of a jitted callable, ms."""
+    fn = jax.jit(fn)
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    times = []
+    for _ in range(steps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        times.append((time.perf_counter() - t0) * 1e3)
+    times.sort()
+    return times[len(times) // 2]
+
+
+HBM_GBPS = 819.0        # v5e
+PEAK_TFLOPS = 394.0     # v5e bf16
+
+
+def row(bench, shape, pallas_ms, xla_ms, gbytes=None, gflops=None):
+    """One result row, self-describing about plausibility: if the measured
+    time implies bandwidth/compute beyond the chip's physical limits the
+    row is dispatch-dominated (the axon emulator does not model HBM/MXU
+    timing) and its speedup column is NOT meaningful."""
+    out = {
+        "bench": bench, "shape": shape,
+        "pallas_ms": round(pallas_ms, 3), "xla_ms": round(xla_ms, 3),
+        "speedup": round(xla_ms / pallas_ms, 2),
+    }
+    implausible = False
+    if gbytes is not None:
+        bw = gbytes / (pallas_ms / 1e3)
+        out["implied_gbps"] = round(bw, 1)
+        implausible |= bw > 1.2 * HBM_GBPS
+    if gflops is not None:
+        tf = gflops / 1e3 / (pallas_ms / 1e3)
+        out["implied_tflops"] = round(tf, 1)
+        implausible |= tf > 1.2 * PEAK_TFLOPS
+    out["implausible"] = bool(implausible)
+    print(json.dumps(out), flush=True)
+
+
+# ------------------------------------------------------------------ flash
+def bench_flash():
+    from apex_tpu.kernels.flash_attention import flash_attention, \
+        mha_reference
+
+    for b, h, s, d in ((8, 8, 2048, 128), (2, 8, 8192, 128)):
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        q, k, v = (jax.random.normal(kk, (b, h, s, d), jnp.bfloat16)
+                   for kk in ks)
+
+        def fwd_k(q, k, v):
+            return flash_attention(q, k, v, causal=True)
+
+        def fwd_x(q, k, v):
+            return mha_reference(q, k, v, causal=True, scale=d ** -0.5)
+
+        # causal fwd: 2 matmuls x 2*b*h*s^2*d flops, halved by tile skip
+        gf = 2 * 2 * b * h * s * s * d / 2 / 1e9
+        row("flash_fwd_causal", f"b{b} h{h} s{s} d{d}",
+            timeit(fwd_k, q, k, v), timeit(fwd_x, q, k, v), gflops=gf)
+
+        def bwd_k(q, k, v):
+            return jax.grad(
+                lambda q, k, v: jnp.sum(
+                    flash_attention(q, k, v, causal=True)
+                    .astype(jnp.float32)),
+                argnums=(0, 1, 2))(q, k, v)
+
+        def bwd_x(q, k, v):
+            return jax.grad(
+                lambda q, k, v: jnp.sum(
+                    mha_reference(q, k, v, causal=True, scale=d ** -0.5)
+                    .astype(jnp.float32)),
+                argnums=(0, 1, 2))(q, k, v)
+
+        row("flash_fwd_bwd_causal", f"b{b} h{h} s{s} d{d}",
+            timeit(bwd_k, q, k, v), timeit(bwd_x, q, k, v),
+            gflops=3.5 * gf)
+
+
+# --------------------------------------------------------------------- ln
+def bench_ln():
+    from apex_tpu.kernels.layer_norm import layer_norm, layer_norm_reference
+
+    for rows_, hidden in ((8192, 4096), (4096, 8192)):
+        x = jax.random.normal(jax.random.PRNGKey(1), (rows_, hidden),
+                              jnp.bfloat16)
+        w = jnp.ones((hidden,))
+        b = jnp.zeros((hidden,))
+
+        gb = 2 * rows_ * hidden * 2 / 1e9      # read x + write y, bf16
+        row("layer_norm_fwd", f"{rows_}x{hidden}",
+            timeit(layer_norm, x, w, b),
+            timeit(layer_norm_reference, x, w, b), gbytes=gb)
+
+        def bwd_k(x, w, b):
+            return jax.grad(lambda x, w, b: jnp.sum(
+                layer_norm(x, w, b).astype(jnp.float32)),
+                argnums=(0, 1, 2))(x, w, b)
+
+        def bwd_x(x, w, b):
+            return jax.grad(lambda x, w, b: jnp.sum(
+                layer_norm_reference(x, w, b).astype(jnp.float32)),
+                argnums=(0, 1, 2))(x, w, b)
+
+        row("layer_norm_fwd_bwd", f"{rows_}x{hidden}",
+            timeit(bwd_k, x, w, b), timeit(bwd_x, x, w, b),
+            gbytes=2.5 * gb)
+
+
+# ---------------------------------------------------------------- xentropy
+def bench_xentropy():
+    from apex_tpu.kernels.xentropy import (softmax_cross_entropy_loss,
+                                           xent_reference)
+
+    n, v = 8192, 32768
+    logits = jax.random.normal(jax.random.PRNGKey(2), (n, v), jnp.bfloat16)
+    labels = jax.random.randint(jax.random.PRNGKey(3), (n,), 0, v)
+
+    gb = n * v * 2 / 1e9                       # logits read, bf16
+    row("xentropy_fwd", f"{n}x{v}",
+        timeit(lambda l: softmax_cross_entropy_loss(l, labels), logits),
+        timeit(lambda l: xent_reference(l, labels), logits), gbytes=gb)
+
+    def bwd_k(l):
+        return jax.grad(lambda l: jnp.sum(
+            softmax_cross_entropy_loss(l, labels)))(l)
+
+    def bwd_x(l):
+        return jax.grad(lambda l: jnp.sum(xent_reference(l, labels)))(l)
+
+    row("xentropy_fwd_bwd", f"{n}x{v}",
+        timeit(bwd_k, logits), timeit(bwd_x, logits), gbytes=3 * gb)
+
+
+# ------------------------------------------------------------ multi-tensor
+def bench_adam():
+    # big-tensor case: few large leaves (optax's per-leaf chain is already
+    # one fused elementwise op per leaf here — the launch-count win is small)
+    _bench_adam_tree(
+        "fused_adam_step", {
+            f"w{i}": jax.random.normal(jax.random.PRNGKey(i),
+                                       (4096, 1528), jnp.float32)
+            for i in range(20)})
+    # many-small-tensors case: the scenario multi_tensor_apply exists for
+    # (a ResNet-50-like tree: ~160 leaves from 1K to 2.3M elements)
+    leaves = {}
+    kidx = 0
+    for i in range(40):
+        for shape in ((256,), (64, 64), (3, 3, 128, 128)):
+            leaves[f"p{kidx}"] = jax.random.normal(
+                jax.random.PRNGKey(kidx), shape, jnp.float32)
+            kidx += 1
+    _bench_adam_tree("fused_adam_step_many_small", leaves)
+
+
+def _bench_adam_tree(name, leaves):
+    import optax
+    from apex_tpu.optimizers.fused_adam import fused_adam
+    grads = jax.tree_util.tree_map(
+        lambda p: jnp.full(p.shape, 1e-3, p.dtype), leaves)
+
+    tx_f = fused_adam(1e-3, weight_decay=0.01)
+    st_f = tx_f.init(leaves)
+    tx_o = optax.adamw(1e-3, weight_decay=0.01)
+    st_o = tx_o.init(leaves)
+
+    def step_fused(p, s):
+        u, s2 = tx_f.update(grads, s, p)
+        return optax.apply_updates(p, u), s2
+
+    def step_optax(p, s):
+        u, s2 = tx_o.update(grads, s, p)
+        return optax.apply_updates(p, u), s2
+
+    n = sum(x.size for x in jax.tree_util.tree_leaves(leaves))
+    gb = 7 * n * 4 / 1e9                       # read p,m,v,g; write p,m,v
+    row(name, f"{n / 1e6:.1f}M params, {len(leaves)} tensors",
+        timeit(step_fused, leaves, st_f), timeit(step_optax, leaves, st_o),
+        gbytes=gb)
+
+
+# ---------------------------------------------------------- causal softmax
+def bench_causal_softmax():
+    from apex_tpu.kernels.causal_softmax import (causal_softmax,
+                                                 causal_softmax_reference)
+
+    x = jax.random.normal(jax.random.PRNGKey(4), (16, 2048, 2048),
+                          jnp.bfloat16)
+    gb = 2 * 16 * 2048 * 2048 * 2 / 1e9
+    row("causal_softmax_fwd", "16x2048x2048",
+        timeit(functools.partial(causal_softmax, scale=0.125), x),
+        timeit(functools.partial(causal_softmax_reference, scale=0.125), x),
+        gbytes=gb)
+
+
+SUITES = {"flash": bench_flash, "ln": bench_ln, "xentropy": bench_xentropy,
+          "adam": bench_adam, "causal_softmax": bench_causal_softmax}
+
+
+def main(argv):
+    names = argv or list(SUITES)
+    print(json.dumps({"device": str(jax.devices()[0]),
+                      "backend": jax.default_backend()}), flush=True)
+    for name in names:
+        SUITES[name]()
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -31,14 +31,15 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from apex_tpu import amp, comm
 
 
-def manual_ddp_loop(mesh, n, model, params, loss_fn, iters=10):
+def manual_ddp_loop(mesh, n, model, params, iters=10):
     """The reference's ACTUAL recipe shape: wrap the model in
     DistributedDataParallel, then hand-write the iteration — scaled loss →
     backward → ddp.reduce_gradients → unscale/found_inf → cond-skip step →
     update_scale (examples/simple/distributed/distributed_data_parallel.py +
-    the amp README manual loop). Returns the final params for the parity
+    the amp README manual loop). Deliberately self-contained (an example
+    users copy); tests/distributed/test_ddp_facade.py asserts the same
+    recipe shape hermetically. Returns the final params for the parity
     check against make_train_step."""
-    from jax.sharding import NamedSharding
     from apex_tpu.parallel import DistributedDataParallel
     from apex_tpu.amp import init_scaler, unscale, update_scale
     from apex_tpu.amp.scaler import scale_loss as scale_loss_fn
@@ -151,7 +152,7 @@ def main():
                      NamedSharding(mesh, P("data"))))
         st0, _ = jit0(st0, batch)
 
-    manual = manual_ddp_loop(mesh, n, model, params, loss_fn, iters=10)
+    manual = manual_ddp_loop(mesh, n, model, params, iters=10)
     for k in params:
         np.testing.assert_allclose(np.asarray(manual[k]),
                                    np.asarray(st0.params[k]),

@@ -9,21 +9,49 @@ Must run before jax initializes its backends, hence module-level in conftest.
 """
 
 import os
+import sys
 
-# Force (not setdefault): the driver environment pins JAX_PLATFORMS=axon (the
-# one real TPU); the test suite must be hermetic CPU with 8 virtual devices.
-# The axon sitecustomize imports jax at interpreter start, so jax has already
-# captured JAX_PLATFORMS=axon — update the live config too (backends are still
-# uninitialized when conftest runs, so this takes effect).
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
 
-import jax  # noqa: E402
+def _tpu_only_invocation():
+    """True when every selected test path targets tests/tpu — the on-silicon
+    tier (tests/tpu/conftest.py) must see the REAL device, so the CPU
+    forcing below is skipped for `pytest tests/tpu ...` invocations.
 
-jax.config.update("jax_platforms", "cpu")
+    Selection detection is filesystem-based (an argv entry that exists on
+    disk is a test path; `-k`/`-m` expression values are not), with a cwd
+    fallback for `cd tests/tpu && pytest`.
+    """
+    here = os.path.dirname(os.path.abspath(__file__))     # .../tests
+    tpu_dir = os.path.realpath(os.path.join(here, "tpu"))
+
+    def is_tpu_path(a):
+        p = os.path.realpath(os.path.abspath(a.split("::")[0]))
+        return p == tpu_dir or p.startswith(tpu_dir + os.sep)
+
+    selected = [a for a in sys.argv[1:]
+                if not a.startswith("-") and os.path.exists(a.split("::")[0])]
+    if selected:
+        return all(is_tpu_path(a) for a in selected)
+    return is_tpu_path(os.getcwd())
+
+
+if not _tpu_only_invocation():
+    # Force (not setdefault): the driver environment pins JAX_PLATFORMS=axon
+    # (the one real TPU); the hermetic suite must be CPU with 8 virtual
+    # devices. The axon sitecustomize imports jax at interpreter start, so
+    # jax has already captured JAX_PLATFORMS=axon — update the live config
+    # too (backends are still uninitialized when conftest runs, so this
+    # takes effect). Under `pytest tests/` the tests/tpu tier self-skips
+    # (its conftest requires a tpu backend).
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

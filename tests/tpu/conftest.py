@@ -1,0 +1,32 @@
+"""On-silicon tier conftest: REQUIRES a real TPU backend.
+
+This is the hardware gate (VERDICT round-1 item 4): `pytest tests/tpu`
+runs every Pallas kernel through its actual Mosaic lowering on the chip —
+the hermetic suite (tests/conftest.py forces CPU) only ever exercises
+interpret mode, so a lowering regression would otherwise ship green.
+Run it before every BENCH:
+
+    pytest tests/tpu -q          # from the repo root, no env overrides
+
+Under `pytest tests/` (CPU forced by the parent conftest) every test here
+self-skips, keeping the hermetic suite hermetic.
+"""
+
+import jax
+import pytest
+
+
+def pytest_collection_modifyitems(config, items):
+    if jax.default_backend() == "cpu":
+        skip = pytest.mark.skip(
+            reason="on-silicon tier: needs a real TPU backend (run as "
+                   "`pytest tests/tpu` so the CPU forcing is bypassed)")
+        for item in items:
+            if "tests/tpu" in str(item.fspath).replace("\\", "/"):
+                item.add_marker(skip)
+
+
+@pytest.fixture(scope="session")
+def tpu_backend():
+    assert jax.default_backend() != "cpu"
+    return jax.devices()[0]

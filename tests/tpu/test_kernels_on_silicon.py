@@ -1,0 +1,195 @@
+"""Mosaic-lowering parity tier: every Pallas kernel vs its fp32 jnp oracle
+ON THE REAL CHIP (VERDICT round-1 item 4 / SURVEY §5.4 inverse).
+
+The hermetic suite runs these kernels in interpret mode only; this tier is
+the proof the compiled Mosaic code computes the same numbers. Tolerances
+follow the reference's L0 kernel tests (fp32 tight, bf16 ~1e-2).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+def _close(a, b, tol, atol=None):
+    # On silicon, fp32 matmuls run through the MXU at default precision
+    # (bf16 passes), so near-zero outputs show large RELATIVE error while
+    # absolute error stays at bf16-epsilon scale — compare atol-dominant.
+    np.testing.assert_allclose(np.asarray(a, np.float32),
+                               np.asarray(b, np.float32),
+                               rtol=tol, atol=tol if atol is None else atol)
+
+
+# ------------------------------------------------------------ layer norm
+@pytest.mark.parametrize("dtype,tol", [(jnp.float32, 2e-5),
+                                       (jnp.bfloat16, 2e-2)])
+def test_layer_norm_fwd_bwd(tpu_backend, dtype, tol):
+    from apex_tpu.kernels.layer_norm import layer_norm, layer_norm_reference
+
+    n, h = 256, 512
+    x = jax.random.normal(jax.random.PRNGKey(0), (n, h), dtype) * 2.0
+    w = jax.random.normal(jax.random.PRNGKey(1), (h,), jnp.float32)
+    b = jax.random.normal(jax.random.PRNGKey(2), (h,), jnp.float32)
+
+    _close(jax.jit(layer_norm)(x, w, b),
+           layer_norm_reference(x, w, b), tol)
+
+    def loss_k(x, w, b):
+        return jnp.sum(jnp.square(layer_norm(x, w, b)))
+
+    def loss_r(x, w, b):
+        return jnp.sum(jnp.square(layer_norm_reference(
+            jnp.asarray(x, jnp.float32), w, b)))
+
+    gk = jax.jit(jax.grad(loss_k, argnums=(0, 1, 2)))(
+        x.astype(jnp.float32), w, b)
+    gr = jax.grad(loss_r, argnums=(0, 1, 2))(x.astype(jnp.float32), w, b)
+    for a, r in zip(gk, gr):
+        _close(a, r, 1e-3)
+
+
+def test_rms_norm(tpu_backend):
+    from apex_tpu.kernels.layer_norm import rms_norm, rms_norm_reference
+
+    x = jax.random.normal(jax.random.PRNGKey(3), (128, 384), jnp.float32)
+    w = jnp.ones((384,)) * 1.5
+    _close(jax.jit(rms_norm)(x, w), rms_norm_reference(x, w), 2e-5)
+
+
+# ------------------------------------------------------------- xentropy
+@pytest.mark.parametrize("smoothing", [0.0, 0.1])
+def test_xentropy_fwd_bwd(tpu_backend, smoothing):
+    from apex_tpu.kernels.xentropy import (softmax_cross_entropy_loss,
+                                           xent_reference)
+
+    n, v = 128, 1024
+    logits = jax.random.normal(jax.random.PRNGKey(4), (n, v),
+                               jnp.float32) * 4.0
+    labels = jax.random.randint(jax.random.PRNGKey(5), (n,), 0, v)
+
+    _close(jax.jit(lambda l: softmax_cross_entropy_loss(
+        l, labels, smoothing=smoothing))(logits),
+        xent_reference(logits, labels, smoothing), 1e-5)
+
+    gk = jax.jit(jax.grad(lambda l: jnp.sum(softmax_cross_entropy_loss(
+        l, labels, smoothing=smoothing))))(logits)
+    gr = jax.grad(lambda l: jnp.sum(xent_reference(
+        l, labels, smoothing)))(logits)
+    # compiled exp/sum reassociation differs from the composed oracle at
+    # ~1e-4 relative on the smallest softmax entries
+    _close(gk, gr, 5e-4, atol=1e-5)
+
+
+# -------------------------------------------------------- multi-tensor
+def test_multi_tensor_ops(tpu_backend):
+    from apex_tpu.kernels.multi_tensor import (fused_adam_step, fused_axpby,
+                                               fused_l2norm, fused_scale)
+
+    n = 8192
+    x = jax.random.normal(jax.random.PRNGKey(6), (n,), jnp.float32)
+    y = jax.random.normal(jax.random.PRNGKey(7), (n,), jnp.float32)
+
+    out, inf = jax.jit(fused_scale)(x, 0.5)
+    _close(out, x * 0.5, 1e-6)
+    assert not bool(inf)
+
+    ax, inf = jax.jit(fused_axpby)(x, y, 2.0, -1.0)
+    _close(ax, 2.0 * x - y, 1e-6)
+
+    _close(jax.jit(fused_l2norm)(x), jnp.sqrt(jnp.sum(x * x)), 1e-5)
+
+    # inf detection must survive lowering
+    bad = x.at[17].set(jnp.inf)
+    _, inf = jax.jit(fused_scale)(bad, 1.0)
+    assert bool(inf)
+
+    # one adam step vs the composed update
+    m = jnp.zeros((n,))
+    v = jnp.zeros((n,))
+    p2, m2, v2 = jax.jit(lambda p, m, v, g: fused_adam_step(
+        p, m, v, g, lr=1e-2, beta1=0.9, beta2=0.999, eps=1e-8,
+        weight_decay=0.0, step=1, adam_w_mode=True))(x, m, v, y)
+    m_ref = 0.1 * y
+    v_ref = 0.001 * y * y
+    update = (m_ref / 0.1) / (jnp.sqrt(v_ref / 0.001) + 1e-8)
+    _close(p2, x - 1e-2 * update, 1e-5)
+    _close(m2, m_ref, 1e-4, atol=1e-6)
+    _close(v2, v_ref, 1e-4, atol=1e-6)
+
+
+# ------------------------------------------------------ flash attention
+@pytest.mark.parametrize("case", ["plain", "causal", "segments", "bias"])
+def test_flash_attention_fwd_bwd(tpu_backend, case):
+    from apex_tpu.kernels.flash_attention import (flash_attention,
+                                                  mha_reference)
+
+    b, h, s, d = 2, 4, 256, 64
+    ks = jax.random.split(jax.random.PRNGKey(8), 4)
+    q = jax.random.normal(ks[0], (b, h, s, d), jnp.float32)
+    k = jax.random.normal(ks[1], (b, h, s, d), jnp.float32)
+    v = jax.random.normal(ks[2], (b, h, s, d), jnp.float32)
+    kw = {"scale": d ** -0.5}
+    if case == "causal":
+        kw["causal"] = True
+    elif case == "segments":
+        kw["segment_ids"] = jnp.concatenate(
+            [jnp.zeros((b, s // 2), jnp.int32),
+             jnp.ones((b, s - s // 2), jnp.int32)], axis=1)
+    elif case == "bias":
+        kw["bias"] = jax.random.normal(ks[3], (b, 1, s, s),
+                                       jnp.float32) * 0.5
+
+    out = jax.jit(lambda q, k, v: flash_attention(q, k, v, **kw))(q, k, v)
+    ref = mha_reference(q, k, v, **kw)
+    _close(out, ref, 2e-2)  # MXU default-precision scale (see _close)
+
+    def lk(q, k, v):
+        return jnp.sum(jnp.square(flash_attention(q, k, v, **kw)))
+
+    def lr(q, k, v):
+        return jnp.sum(jnp.square(mha_reference(q, k, v, **kw)))
+
+    gk = jax.jit(jax.grad(lk, argnums=(0, 1, 2)))(q, k, v)
+    gr = jax.grad(lr, argnums=(0, 1, 2))(q, k, v)
+    for a, r in zip(gk, gr):
+        _close(a, r, 2e-2, atol=1e-1)  # grad magnitudes are O(seq)
+    if case == "bias":
+        gbk = jax.jit(jax.grad(
+            lambda bb: jnp.sum(jnp.square(flash_attention(
+                q, k, v, scale=d ** -0.5, bias=bb)))))(kw["bias"])
+        gbr = jax.grad(
+            lambda bb: jnp.sum(jnp.square(mha_reference(
+                q, k, v, scale=d ** -0.5, bias=bb))))(kw["bias"])
+        _close(gbk, gbr, 2e-2, atol=1e-1)
+
+
+def test_flash_attention_bf16(tpu_backend):
+    from apex_tpu.kernels.flash_attention import (flash_attention,
+                                                  mha_reference)
+
+    b, h, s, d = 1, 2, 256, 64
+    ks = jax.random.split(jax.random.PRNGKey(9), 3)
+    q, k, v = (jax.random.normal(kk, (b, h, s, d), jnp.bfloat16)
+               for kk in ks)
+    out = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))(
+        q, k, v)
+    assert out.dtype == jnp.bfloat16
+    # flash_attention defaults scale to 1/sqrt(d); mha_reference to 1.0
+    _close(out, mha_reference(q, k, v, causal=True, scale=d ** -0.5), 5e-2)
+
+
+# ------------------------------------------------------ causal softmax
+def test_causal_softmax(tpu_backend):
+    from apex_tpu.kernels.causal_softmax import (causal_softmax,
+                                                 causal_softmax_reference)
+
+    x = jax.random.normal(jax.random.PRNGKey(10), (4, 256, 256),
+                          jnp.float32) * 3.0
+    _close(jax.jit(lambda x: causal_softmax(x, 0.5))(x),
+           causal_softmax_reference(x, 0.5), 1e-5)
+    gk = jax.jit(jax.grad(lambda x: jnp.sum(jnp.sin(
+        causal_softmax(x) * 3))))(x)
+    gr = jax.grad(lambda x: jnp.sum(jnp.sin(
+        causal_softmax_reference(x) * 3)))(x)
+    _close(gk, gr, 1e-4)
