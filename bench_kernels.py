@@ -175,7 +175,7 @@ def bench_adam():
                                        (4096, 1528), jnp.float32)
             for i in range(20)})
     # many-small-tensors case: the scenario multi_tensor_apply exists for
-    # (a ResNet-50-like tree: ~160 leaves from 1K to 2.3M elements)
+    # (120 leaves from 256 to ~147K elements — conv-net-like sizes)
     leaves = {}
     kidx = 0
     for i in range(40):

@@ -17,10 +17,17 @@ def _tpu_only_invocation():
     tier (tests/tpu/conftest.py) must see the REAL device, so the CPU
     forcing below is skipped for `pytest tests/tpu ...` invocations.
 
-    Selection detection is filesystem-based (an argv entry that exists on
-    disk is a test path; `-k`/`-m` expression values are not), with a cwd
-    fallback for `cd tests/tpu && pytest`.
+    `APEX_TPU_SILICON=1` is the explicit, invocation-proof override (use it
+    under pytest-xdist or option-heavy command lines, where argv sniffing
+    cannot classify reliably: option VALUES that happen to be paths, or
+    xdist workers re-execing with a different argv). Otherwise, selection
+    detection is filesystem-based (an argv entry that exists on disk is a
+    test path; `-k`/`-m` expression values are not), with a cwd fallback
+    for `cd tests/tpu && pytest` — which covers the documented plain
+    `pytest tests/tpu` invocation.
     """
+    if os.environ.get("APEX_TPU_SILICON"):
+        return True
     here = os.path.dirname(os.path.abspath(__file__))     # .../tests
     tpu_dir = os.path.realpath(os.path.join(here, "tpu"))
 

@@ -12,15 +12,24 @@ Under `pytest tests/` (CPU forced by the parent conftest) every test here
 self-skips, keeping the hermetic suite hermetic.
 """
 
+import os
+
 import jax
 import pytest
 
 
 def pytest_collection_modifyitems(config, items):
     if jax.default_backend() == "cpu":
+        if os.environ.get("APEX_TPU_SILICON"):
+            # the explicit opt-in means "this MUST run on silicon" — a CPU
+            # backend here is a broken invocation/host, never a quiet skip
+            raise RuntimeError(
+                "APEX_TPU_SILICON is set but the jax backend is cpu — the "
+                "on-silicon tier would silently not test the hardware")
         skip = pytest.mark.skip(
             reason="on-silicon tier: needs a real TPU backend (run as "
-                   "`pytest tests/tpu` so the CPU forcing is bypassed)")
+                   "`pytest tests/tpu`, or APEX_TPU_SILICON=1 for "
+                   "xdist/option-heavy invocations)")
         for item in items:
             if "tests/tpu" in str(item.fspath).replace("\\", "/"):
                 item.add_marker(skip)
