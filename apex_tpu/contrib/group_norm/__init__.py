@@ -1,9 +1,10 @@
 """NHWC GroupNorm (+ fused SiLU) — diffusion workloads.
 
 Reference: apex/contrib/group_norm/group_norm.py — GroupNorm
-(group_norm_nhwc kernels, N23). NHWC is TPU's native conv layout, so the
-math is one fp32-accumulated jnp expression XLA fuses; ``act="silu"``
-mirrors the kernel's fused activation.
+(group_norm_nhwc kernels, N23). The compute lives in
+apex_tpu.kernels.group_norm: a two-pass Pallas kernel pair (sum-pass →
+normalize-pass with the SiLU epilogue fused, custom_vjp backward with the
+same structure) on lane-aligned channel counts, jnp fallback otherwise.
 """
 
 from __future__ import annotations
@@ -11,32 +12,11 @@ from __future__ import annotations
 from typing import Any, Optional
 
 import flax.linen as nn
-import jax
 import jax.numpy as jnp
 
+from apex_tpu.kernels.group_norm import group_norm_nhwc
+
 __all__ = ["GroupNorm", "group_norm_nhwc"]
-
-
-def group_norm_nhwc(x, num_groups: int, weight=None, bias=None,
-                    eps: float = 1e-5, act: Optional[str] = None):
-    """x: [N, H, W, C]; stats per (sample, group) in fp32."""
-    n, h, w, c = x.shape
-    if c % num_groups:
-        raise ValueError(f"channels {c} not divisible by groups {num_groups}")
-    x32 = jnp.asarray(x, jnp.float32).reshape(n, h, w, num_groups,
-                                              c // num_groups)
-    mean = jnp.mean(x32, axis=(1, 2, 4), keepdims=True)
-    var = jnp.mean((x32 - mean) ** 2, axis=(1, 2, 4), keepdims=True)
-    y = ((x32 - mean) * jax.lax.rsqrt(var + eps)).reshape(n, h, w, c)
-    if weight is not None:
-        y = y * jnp.asarray(weight, jnp.float32)
-    if bias is not None:
-        y = y + jnp.asarray(bias, jnp.float32)
-    if act == "silu":
-        y = y * jax.nn.sigmoid(y)
-    elif act not in (None, "identity", ""):
-        raise ValueError(f"unsupported act {act!r}")
-    return jnp.asarray(y, x.dtype)
 
 
 class GroupNorm(nn.Module):

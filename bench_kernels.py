@@ -226,8 +226,25 @@ def bench_causal_softmax():
         gbytes=gb)
 
 
+# ------------------------------------------------------------- group norm
+def bench_group_norm():
+    from apex_tpu.kernels.group_norm import (group_norm_nhwc,
+                                             group_norm_reference)
+
+    n, h, w, c = 8, 64, 64, 512           # diffusion UNet mid-block shape
+    x = jax.random.normal(jax.random.PRNGKey(5), (n, h, w, c), jnp.bfloat16)
+    g = jnp.ones((c,))
+    b = jnp.zeros((c,))
+    gb = 2 * n * h * w * c * 2 / 1e9
+    row("group_norm_silu_fwd", f"{n}x{h}x{w}x{c} g32",
+        timeit(lambda x: group_norm_nhwc(x, 32, g, b, act="silu"), x),
+        timeit(lambda x: group_norm_reference(x, 32, g, b, act="silu"), x),
+        gbytes=gb)
+
+
 SUITES = {"flash": bench_flash, "ln": bench_ln, "xentropy": bench_xentropy,
-          "adam": bench_adam, "causal_softmax": bench_causal_softmax}
+          "adam": bench_adam, "causal_softmax": bench_causal_softmax,
+          "group_norm": bench_group_norm}
 
 
 def main(argv):

@@ -26,8 +26,6 @@ def _tpu_only_invocation():
     for `cd tests/tpu && pytest` — which covers the documented plain
     `pytest tests/tpu` invocation.
     """
-    if os.environ.get("APEX_TPU_SILICON"):
-        return True
     here = os.path.dirname(os.path.abspath(__file__))     # .../tests
     tpu_dir = os.path.realpath(os.path.join(here, "tpu"))
 
@@ -37,6 +35,17 @@ def _tpu_only_invocation():
 
     selected = [a for a in sys.argv[1:]
                 if not a.startswith("-") and os.path.exists(a.split("::")[0])]
+    if os.environ.get("APEX_TPU_SILICON"):
+        # explicit opt-in — but never let a leaked env var silently break
+        # the hermetic suite: mixing non-tpu selections with the override
+        # is a configuration error, named loudly here.
+        non_tpu = [a for a in selected if not is_tpu_path(a)]
+        if non_tpu:
+            raise RuntimeError(
+                f"APEX_TPU_SILICON is set but non-silicon tests are "
+                f"selected ({non_tpu[:3]}...): unset it to run the "
+                f"hermetic suite")
+        return True
     if selected:
         return all(is_tpu_path(a) for a in selected)
     return is_tpu_path(os.getcwd())

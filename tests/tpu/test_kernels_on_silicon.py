@@ -193,3 +193,28 @@ def test_causal_softmax(tpu_backend):
     gr = jax.grad(lambda x: jnp.sum(jnp.sin(
         causal_softmax_reference(x) * 3)))(x)
     _close(gk, gr, 1e-4)
+
+
+# ---------------------------------------------------------- group norm
+@pytest.mark.parametrize("act", [None, "silu"])
+def test_group_norm_fwd_bwd(tpu_backend, act):
+    from apex_tpu.kernels.group_norm import (group_norm_nhwc,
+                                             group_norm_reference)
+
+    x = jax.random.normal(jax.random.PRNGKey(11), (2, 16, 16, 256),
+                          jnp.float32) * 2.0
+    g = jax.random.normal(jax.random.PRNGKey(12), (256,)) + 1.0
+    b = jax.random.normal(jax.random.PRNGKey(13), (256,))
+
+    out = jax.jit(lambda x: group_norm_nhwc(x, 16, g, b, act=act))(x)
+    ref = group_norm_reference(x, 16, g, b, act=act)
+    _close(out, ref, 1e-4, atol=1e-4)
+
+    gk = jax.jit(jax.grad(lambda x, g, b: jnp.sum(jnp.sin(
+        group_norm_nhwc(x, 16, g, b, act=act) * 2)), argnums=(0, 1, 2)))(
+        x, g, b)
+    gr = jax.grad(lambda x, g, b: jnp.sum(jnp.sin(
+        group_norm_reference(x, 16, g, b, act=act) * 2)),
+        argnums=(0, 1, 2))(x, g, b)
+    for a, r in zip(gk, gr):
+        _close(a, r, 1e-3, atol=1e-3)
