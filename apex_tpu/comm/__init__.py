@@ -33,8 +33,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 __all__ = [
     "AXIS_DATA", "AXIS_MODEL", "AXIS_PIPE", "AXIS_EXPERT", "AXIS_CONTEXT",
-    "make_mesh", "default_mesh", "get_mesh", "set_mesh", "reset_mesh",
-    "axis_size",
+    "make_mesh", "make_hybrid_mesh", "default_mesh", "get_mesh", "set_mesh",
+    "reset_mesh", "axis_size",
     "all_reduce", "all_reduce_max", "all_gather", "reduce_scatter",
     "ppermute", "broadcast_from", "axis_index", "initialize_distributed",
 ]
@@ -73,6 +73,48 @@ def make_mesh(axes: Dict[str, int], devices: Optional[Sequence] = None) -> Mesh:
             f"mesh {dict(axes)} needs {need} devices, have {len(devices)}")
     arr = np.asarray(devices[:need], dtype=object).reshape(sizes)
     return Mesh(arr, names)
+
+
+def make_hybrid_mesh(ici_axes: Dict[str, int],
+                     dcn_axes: Dict[str, int]) -> Mesh:
+    """Multi-slice mesh: ``dcn_axes`` partition ACROSS slices (riding DCN,
+    the slow fabric), ``ici_axes`` within a slice (ICI). This is how the
+    SURVEY §3.4 mapping scales past one slice: put data parallelism (the
+    once-per-step grad allreduce) on DCN and TP/SP/PP (the per-layer
+    collectives) on ICI — the TPU analogue of apex keeping NCCL rings
+    inside a node and gradient averaging across nodes.
+
+    Example on 4 slices of a v5e-64::
+
+        mesh = comm.make_hybrid_mesh(ici_axes={"pipe": 4, "model": 16},
+                                     dcn_axes={"data": 4})
+
+    Axis names may appear in only one of the two dicts (size 1 elsewhere).
+    On a single slice (or hosts whose devices carry no slice topology,
+    e.g. the CPU test backend) this degrades to :func:`make_mesh` with the
+    DCN axes outermost — same names, same shape, so code written against
+    the hybrid mesh runs unchanged in CI.
+    """
+    overlap = set(ici_axes) & set(dcn_axes)
+    if overlap:
+        raise ValueError(
+            f"axes {sorted(overlap)} appear in both ici_axes and dcn_axes; "
+            f"an axis lives on exactly one fabric")
+    names = tuple(dcn_axes) + tuple(ici_axes)
+    devices = jax.devices()
+    n_slices = len({getattr(d, "slice_index", 0) for d in devices})
+    if n_slices > 1:
+        from jax.experimental import mesh_utils
+
+        ici_shape = [ici_axes.get(n, 1) for n in names]
+        dcn_shape = [dcn_axes.get(n, 1) for n in names]
+        arr = mesh_utils.create_hybrid_device_mesh(
+            ici_shape, dcn_shape, devices=devices)
+        return Mesh(arr, names)
+    # single slice / no slice topology: plain mesh, DCN axes outermost
+    # (names is exactly the union of both dicts, DCN first)
+    merged = {**dcn_axes, **ici_axes}
+    return make_mesh({n: merged[n] for n in names})
 
 
 def default_mesh() -> Mesh:

@@ -1,0 +1,38 @@
+"""comm module tests: mesh construction incl. the hybrid ICI/DCN helper
+(single-slice degradation path — multi-slice needs a pod)."""
+
+import jax
+import numpy as np
+import pytest
+
+from apex_tpu import comm
+
+
+def test_make_mesh_order_and_validation(eight_devices):
+    mesh = comm.make_mesh({"data": 2, "model": 4})
+    assert mesh.axis_names == ("data", "model")
+    assert mesh.shape == {"data": 2, "model": 4}
+    with pytest.raises(ValueError, match="needs"):
+        comm.make_mesh({"data": 100})
+
+
+def test_hybrid_mesh_single_slice_degrades_to_plain(eight_devices):
+    mesh = comm.make_hybrid_mesh(ici_axes={"model": 4}, dcn_axes={"data": 2})
+    # DCN axes outermost, same names/shape as the plain construction
+    assert mesh.axis_names == ("data", "model")
+    assert mesh.shape == {"data": 2, "model": 4}
+    np.testing.assert_array_equal(
+        np.array([[d.id for d in row] for row in mesh.devices]),
+        np.arange(8).reshape(2, 4))
+
+
+def test_hybrid_mesh_axis_in_one_fabric_only():
+    with pytest.raises(ValueError, match="exactly one fabric"):
+        comm.make_hybrid_mesh(ici_axes={"data": 2}, dcn_axes={"data": 2})
+
+
+def test_hybrid_mesh_size_one_axes(eight_devices):
+    mesh = comm.make_hybrid_mesh(ici_axes={"pipe": 2, "model": 2},
+                                 dcn_axes={"data": 2})
+    assert mesh.axis_names == ("data", "pipe", "model")
+    assert mesh.shape == {"data": 2, "pipe": 2, "model": 2}

@@ -37,13 +37,16 @@ def _tpu_only_invocation():
                 if not a.startswith("-") and os.path.exists(a.split("::")[0])]
     if os.environ.get("APEX_TPU_SILICON"):
         # explicit opt-in — but never let a leaked env var silently break
-        # the hermetic suite: mixing non-tpu selections with the override
-        # is a configuration error, named loudly here.
+        # the hermetic suite: using the override for anything but a
+        # tests/tpu selection (including a bare `pytest` from the repo
+        # root) is a configuration error, named loudly here.
         non_tpu = [a for a in selected if not is_tpu_path(a)]
+        if not selected and not is_tpu_path(os.getcwd()):
+            non_tpu = [os.getcwd()]
         if non_tpu:
             raise RuntimeError(
                 f"APEX_TPU_SILICON is set but non-silicon tests are "
-                f"selected ({non_tpu[:3]}...): unset it to run the "
+                f"selected ({non_tpu[:3]}): unset it to run the "
                 f"hermetic suite")
         return True
     if selected:
