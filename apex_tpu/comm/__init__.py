@@ -112,9 +112,8 @@ def make_hybrid_mesh(ici_axes: Dict[str, int],
             ici_shape, dcn_shape, devices=devices)
         return Mesh(arr, names)
     # single slice / no slice topology: plain mesh, DCN axes outermost
-    # (names is exactly the union of both dicts, DCN first)
-    merged = {**dcn_axes, **ici_axes}
-    return make_mesh({n: merged[n] for n in names})
+    # ({**dcn, **ici} insertion order is exactly `names`)
+    return make_mesh({**dcn_axes, **ici_axes})
 
 
 def default_mesh() -> Mesh:

@@ -6,17 +6,18 @@ computes per-GPU Welford (mean, var, count) with the ``syncbn`` extension,
 all_gathers the per-rank stats, combines them (welford_parallel), then
 normalizes; backward all_reduces (sum_dy, sum_dy_xmu).
 
-TPU mapping: the per-device moment computation is one fused XLA reduction, the
-cross-rank Welford combine collapses to ``psum`` of (sum, sum-of-squares,
-count) over the named axis — algebraically identical to the count-weighted
-Welford combination (csrc/welford.cu — welford_parallel_CUDA weights each
-rank's contribution by its element count) and numerically done in fp32. Under
-SPMD every rank's *shape* is identical, so unequal counts enter through the
-optional ``mask`` argument (ragged last batches padded to shape): masked
-elements are excluded from the statistics but still normalized. Backward needs
-no custom kernel at all: the psums sit inside the autodiff graph, so XLA
-derives exactly apex's batchnorm_backward allreduce pattern (the transpose of
-psum is psum).
+TPU mapping: the per-device moment computation is one fused XLA reduction
+producing the Welford triple (mean, M2, count); the cross-rank combine
+all_gathers the per-rank triples and folds them with Chan's count-weighted
+formula — the ACTUAL welford_parallel algorithm (csrc/welford.cu —
+welford_parallel_CUDA), which is exact for unequal counts AND numerically
+stable where a psum of (sum, sumsq) cancels catastrophically for
+large-mean activations. Under SPMD every rank's *shape* is identical, so
+unequal counts enter through the optional ``mask`` argument (ragged last
+batches padded to shape): masked elements are excluded from the statistics
+but still normalized. Backward needs no custom kernel at all: the gathers
+sit inside the autodiff graph, so XLA derives exactly apex's
+batchnorm_backward allreduce pattern.
 
 Process groups (apex/parallel/__init__.py — create_syncbn_process_group's
 ``group_size``) map to ``axis_index_groups``: stats sync within fixed-size
@@ -46,6 +47,31 @@ def create_syncbn_process_group(axis_size: int, group_size: int):
             f"group_size {group_size} must evenly divide axis size {axis_size}")
     return [list(range(i, i + group_size))
             for i in range(0, axis_size, group_size)]
+
+
+def _welford_fold(means, m2s, cnts):
+    """Fold stacked per-rank Welford triples [W, C] with Chan's
+    count-weighted combine (csrc/welford.cu — welford_parallel_CUDA).
+    The combine is associative, so pairs fold in log2(W) rounds — O(W)
+    serial chains would stretch the critical path on wide axes. Odd
+    remainders carry a zero-count pad, which the combine ignores exactly
+    (nb=0 leaves (mean, m2) untouched)."""
+    while means.shape[0] > 1:
+        w = means.shape[0]
+        if w % 2:
+            pad = lambda a: jnp.concatenate(
+                [a, jnp.zeros_like(a[:1])], axis=0)
+            means, m2s, cnts = pad(means), pad(m2s), pad(cnts)
+            w += 1
+        ma, mb = means[0::2], means[1::2]
+        sa, sb = m2s[0::2], m2s[1::2]
+        na, nb = cnts[0::2], cnts[1::2]
+        total = jnp.maximum(na + nb, 1.0)
+        delta = mb - ma
+        means = ma + delta * (nb / total)
+        m2s = sa + sb + jnp.square(delta) * (na * nb / total)
+        cnts = na + nb
+    return means[0], m2s[0], cnts[0]
 
 
 class SyncBatchNorm(nn.Module):
@@ -89,32 +115,39 @@ class SyncBatchNorm(nn.Module):
             mean, var = ra_mean.value, ra_var.value
         else:
             x32 = x.astype(jnp.float32)
-            # Local partial sums in fp32 (csrc/welford.cu — welford_mean_var
-            # accumulates in accscalar_t=float). We carry (sum, sumsq, count)
-            # rather than moments so the cross-rank combine is exact for
-            # unequal per-rank element counts (welford_parallel_CUDA weights
-            # by count); counts differ only when a validity mask marks padded
-            # elements of a ragged batch.
+            expand = [-1 if i == feature_axis else 1 for i in range(x.ndim)]
+            # Local Welford triple in fp32 (csrc/welford.cu —
+            # welford_mean_var accumulates in accscalar_t=float): mean,
+            # CENTERED M2, count. Centering before squaring keeps
+            # large-mean activations finite where sum/sumsq cancels;
+            # counts differ across ranks only through the validity mask
+            # (ragged padded batches).
             if mask is not None:
                 m32 = jnp.broadcast_to(mask, x.shape).astype(jnp.float32)
-                s = jnp.sum(x32 * m32, axis=reduction_axes)
-                ss = jnp.sum(jnp.square(x32) * m32, axis=reduction_axes)
                 cnt = jnp.sum(m32, axis=reduction_axes)
+                safe = jnp.maximum(cnt, 1.0)
+                mean = jnp.sum(x32 * m32, axis=reduction_axes) / safe
+                centered = (x32 - mean.reshape(expand)) * m32
+                m2 = jnp.sum(jnp.square(centered), axis=reduction_axes)
             else:
-                s = jnp.sum(x32, axis=reduction_axes)
-                ss = jnp.sum(jnp.square(x32), axis=reduction_axes)
                 cnt = jnp.full(feature_shape,
                                float(x32.size // x32.shape[feature_axis]),
                                jnp.float32)
+                mean = jnp.mean(x32, axis=reduction_axes)
+                m2 = jnp.sum(jnp.square(x32 - mean.reshape(expand)),
+                             axis=reduction_axes)
             # During module init there is no bound mesh axis to reduce over
             # (apex likewise skips comm when torch.distributed isn't up).
             if self.axis_name is not None and not self.is_initializing():
-                s, ss, cnt = jax.lax.psum(
-                    (s, ss, cnt), self.axis_name,
+                # welford_parallel: all_gather the per-rank triples and
+                # fold with Chan's count-weighted combine — apex gathers
+                # mean_l/var_l/count and combines exactly the same way
+                mean_g, m2_g, cnt_g = jax.lax.all_gather(
+                    (mean, m2, cnt), self.axis_name,
                     axis_index_groups=self.axis_index_groups)
+                mean, m2, cnt = _welford_fold(mean_g, m2_g, cnt_g)
             safe_cnt = jnp.maximum(cnt, 1.0)
-            mean = s / safe_cnt
-            var = ss / safe_cnt - jnp.square(mean)
+            var = m2 / safe_cnt
 
             if not self.is_initializing():
                 # biased var for normalization, unbiased for running stats —

@@ -39,7 +39,12 @@ def _tpu_only_invocation():
         # explicit opt-in — but never let a leaked env var silently break
         # the hermetic suite: using the override for anything but a
         # tests/tpu selection (including a bare `pytest` from the repo
-        # root) is a configuration error, named loudly here.
+        # root) is a configuration error, named loudly here. xdist WORKERS
+        # re-exec with an empty argv and the rootdir cwd, so they must
+        # trust the master's classification (PYTEST_XDIST_WORKER marks
+        # them) — the master itself still validates the selection.
+        if os.environ.get("PYTEST_XDIST_WORKER"):
+            return True
         non_tpu = [a for a in selected if not is_tpu_path(a)]
         if not selected and not is_tpu_path(os.getcwd()):
             non_tpu = [os.getcwd()]

@@ -272,3 +272,29 @@ def test_ddp_matches_single_process(data_mesh, opt_level):
         np.testing.assert_allclose(np.asarray(a, np.float32),
                                    np.asarray(b, np.float32),
                                    rtol=2e-3, atol=2e-3)
+
+
+def test_syncbn_large_mean_stability(data_mesh):
+    """welford_parallel (Chan fold of per-rank triples) must stay finite
+    where a psum of (sum, sumsq) cancels catastrophically: activations at
+    mean >> std."""
+    from apex_tpu.parallel import SyncBatchNorm
+
+    bn = SyncBatchNorm(use_running_average=False, axis_name="data")
+    x = 4096.0 + jax.random.normal(jax.random.PRNGKey(11),
+                                   (8, 64, 4)) * 0.01
+
+    @functools.partial(shard_map, mesh=data_mesh,
+                       in_specs=P("data"), out_specs=P("data"),
+                       check_vma=False)
+    def run(x):
+        variables = bn.init(jax.random.PRNGKey(0), x[0])
+        y, _ = bn.apply(variables, x[0], mutable=["batch_stats"])
+        return y[None]
+
+    y = np.asarray(jax.jit(run)(x))
+    assert np.isfinite(y).all()
+    # the normalized output matches the fp64 oracle over the global batch
+    x64 = np.asarray(x, np.float64).reshape(-1, 4)
+    ref = (x64 - x64.mean(0)) / np.sqrt(x64.var(0) + 1e-5)
+    np.testing.assert_allclose(y.reshape(-1, 4), ref, rtol=5e-2, atol=5e-2)
