@@ -6,10 +6,16 @@ kernel) and class TransducerLoss (alpha-beta forward-backward DP loss, N21
 loss kernel with bwd-in-fwd).
 
 TPU design: the joint is a broadcast add XLA fuses. The loss is the
-classic RNN-T log-likelihood: alphas computed with a ``lax.scan`` over the
-anti-diagonal recursion (t dimension scanned, u dimension vectorized — the
-wavefront trick the CUDA kernel parallelizes the same way), gradients via
-autodiff of the scan (exact, replacing the hand-written backward kernel).
+classic RNN-T log-likelihood with the CUDA kernel's wavefront
+parallelization expressed to the compiler: elements on anti-diagonal
+d = t+u depend only on diagonal d-1, so (blank, emit) are re-laid-out
+diagonally once and alphas advance with ONE ``lax.scan`` of T+U steps of
+[B, U+1] vector ops — versus T·U sequential steps for the textbook
+row-by-row recursion. Gradients come from autodiff of the scan (exact,
+replacing the hand-written backward kernel). A Pallas kernel buys nothing
+here: the bottleneck is the sequential diagonal dependency, which no
+launch structure removes — the win is the wavefront vectorization itself
+(the "Pallas alpha-beta scan" N21 mapping resolves to this).
 """
 
 from __future__ import annotations
@@ -57,8 +63,9 @@ def transducer_loss(log_probs, labels, f_len, y_len, blank_idx: int = 0):
 
     log_probs: [B, T, U+1, V] log-softmax outputs; labels: [B, U] int;
     f_len: [B] valid T per sample; y_len: [B] valid U per sample.
-    (Reference: transducer_loss_cuda.forward — alphas/betas; here alphas by
-    scan over t with u vectorized; grads by autodiff.)
+    (Reference: transducer_loss_cuda.forward — alphas/betas; here one scan
+    over the T+U anti-diagonals with the whole diagonal vectorized — see
+    the module docstring; grads by autodiff.)
     """
     b, t_max, u1, v = log_probs.shape
     u_max = u1 - 1
@@ -71,36 +78,43 @@ def transducer_loss(log_probs, labels, f_len, y_len, blank_idx: int = 0):
     emit = jnp.pad(emit, ((0, 0), (0, 0), (0, 1)),
                    constant_values=_NEG)                        # [B, T, U+1]
 
-    us = jnp.arange(u1)
+    # diagonal re-layout: X_diag[d, u] = X[d - u, u] (t = d - u), the
+    # wavefront coordinates. One gather each; invalid t → -inf.
+    us = jnp.arange(u1)                                         # [U+1]
+    n_diag = t_max + u1 - 1                                     # d = t + u
+    t_idx = jnp.arange(n_diag)[:, None] - us[None, :]           # [D, U+1]
+    t_ok = (t_idx >= 0) & (t_idx < t_max)
+    t_clip = jnp.clip(t_idx, 0, t_max - 1)
 
-    def step_t(alpha_prev, t):
-        # alpha[t, u] = logsumexp(alpha[t-1, u] + blank[t-1, u],
-        #                         alpha[t, u-1] + emit[t, u-1])
-        horiz = alpha_prev + blank[:, t - 1, :]
+    def to_diag(x):                                             # [B,T,U+1]
+        g = x[:, t_clip, us[None, :]]                           # [B,D,U+1]
+        return jnp.where(t_ok[None], g, _NEG)
 
-        def step_u(carry, u):
-            # left-to-right dependency in u at fixed t
-            left = carry
-            val = jnp.where(
-                u == 0, horiz[:, 0],
-                jnp.logaddexp(horiz[:, u],
-                              left + emit[:, t, u - 1]))
-            # t == 0 row: only emit transitions from u-1
-            val0 = jnp.where(u == 0, 0.0, left + emit[:, 0, u - 1])
-            val = jnp.where(t == 0, val0, val)
-            return val, val
+    blank_diag = to_diag(blank)
+    emit_diag = to_diag(emit)
 
-        _, cols = jax.lax.scan(step_u, jnp.full((b,), _NEG), us)
-        alpha_t = cols.T                                        # [B, U+1]
-        return alpha_t, alpha_t
+    def step_d(alpha_prev, d):
+        # alpha_d[u] = logaddexp(alpha_{d-1}[u]   + blank_diag[d-1, u],
+        #                        alpha_{d-1}[u-1] + emit_diag[d-1, u-1])
+        # (the t=0 row falls out automatically: its t-1 parent sits at an
+        # invalid diagonal slot already masked to -inf)
+        horiz = alpha_prev + blank_diag[:, d - 1, :]
+        diag = jnp.concatenate(
+            [jnp.full((b, 1), _NEG),
+             alpha_prev[:, :-1] + emit_diag[:, d - 1, :-1]], axis=1)
+        alpha_d = jnp.logaddexp(horiz, diag)
+        valid = (us[None] <= d) & (d - us[None] <= t_max - 1)
+        alpha_d = jnp.where(valid, alpha_d, _NEG)
+        return alpha_d, alpha_d
 
-    alpha0 = jnp.full((b, u1), _NEG)
-    _, alphas = jax.lax.scan(step_t, alpha0, jnp.arange(t_max))
-    alphas = alphas.transpose(1, 0, 2)                          # [B, T, U+1]
+    alpha0 = jnp.full((b, u1), _NEG).at[:, 0].set(0.0)          # alpha[0,0]
+    _, alphas = jax.lax.scan(step_d, alpha0, jnp.arange(1, n_diag))
+    alphas = jnp.concatenate([alpha0[None], alphas], axis=0)    # [D,B,U+1]
 
-    # ll = alpha[f_len-1, y_len] + blank[f_len-1, y_len]
+    # ll = alpha[f_len-1, y_len] + blank[f_len-1, y_len]; in diagonal
+    # coordinates alpha[t, u] lives at (d = t + u, u)
     bi = jnp.arange(b)
-    a_final = alphas[bi, f_len - 1, y_len]
+    a_final = alphas[f_len - 1 + y_len, bi, y_len]
     ll = a_final + blank[bi, f_len - 1, y_len]
     return -ll
 
