@@ -113,6 +113,16 @@ class Policy:
         return jnp.float32
 
     @property
+    def model_dtype(self):
+        """What recipes should pass as a flax module's ``dtype``: None
+        under O1 (modules resolve per op class through the autocast
+        engine — convs/GEMMs half, norms/losses fp32), the blanket compute
+        dtype otherwise (O0 fp32; O2/O3 the cast type)."""
+        if self.enabled and self.patch_torch_functions:
+            return None
+        return self.compute_dtype
+
+    @property
     def wants_master_weights(self) -> bool:
         if not self.enabled:
             return False

@@ -76,7 +76,8 @@ class ColumnParallelLinear(nn.Module):
     sequence_parallel_enabled: bool = False
     axis_name: str = AXIS_MODEL
     world_size: Optional[int] = None
-    dtype: Any = jnp.float32
+    # None → consult the O1 engine ('linear' is FP16_FUNCS); fp32 otherwise
+    dtype: Optional[Any] = None
     param_dtype: Any = jnp.float32
     kernel_init: Callable = nn.initializers.lecun_normal()
     bias_init: Callable = nn.initializers.zeros
@@ -87,6 +88,8 @@ class ColumnParallelLinear(nn.Module):
 
     @nn.compact
     def __call__(self, x):
+        from apex_tpu.amp.autocast import resolve_dtype
+        dtype = resolve_dtype(self.dtype, "linear", jnp.float32)
         world = self._world()
         out_local = divide(self.output_size, world)
         kernel = self.param("kernel",
@@ -98,12 +101,11 @@ class ColumnParallelLinear(nn.Module):
             x = gather_from_sequence_parallel_region(x, self.axis_name, 0)
         elif world > 1:
             x = copy_to_tensor_model_parallel_region(x, self.axis_name)
-        y = jnp.dot(jnp.asarray(x, self.dtype),
-                    jnp.asarray(kernel, self.dtype))
+        y = jnp.dot(jnp.asarray(x, dtype), jnp.asarray(kernel, dtype))
         if self.use_bias:
             bias = self.param("bias", self.bias_init, (out_local,),
                               self.param_dtype)
-            y = y + jnp.asarray(bias, self.dtype)
+            y = y + jnp.asarray(bias, dtype)
         if self.gather_output and world > 1:
             y = gather_from_tensor_model_parallel_region(y, self.axis_name, -1)
         return y
@@ -127,7 +129,8 @@ class RowParallelLinear(nn.Module):
     sequence_parallel_enabled: bool = False
     axis_name: str = AXIS_MODEL
     world_size: Optional[int] = None
-    dtype: Any = jnp.float32
+    # None → consult the O1 engine ('linear' is FP16_FUNCS); fp32 otherwise
+    dtype: Optional[Any] = None
     param_dtype: Any = jnp.float32
     kernel_init: Callable = nn.initializers.lecun_normal()
     bias_init: Callable = nn.initializers.zeros
@@ -138,6 +141,8 @@ class RowParallelLinear(nn.Module):
 
     @nn.compact
     def __call__(self, x):
+        from apex_tpu.amp.autocast import resolve_dtype
+        dtype = resolve_dtype(self.dtype, "linear", jnp.float32)
         world = self._world()
         in_local = divide(self.input_size, world)
         kernel = self.param("kernel",
@@ -146,8 +151,7 @@ class RowParallelLinear(nn.Module):
         if not self.input_is_parallel and world > 1:
             from .mappings import scatter_to_tensor_model_parallel_region
             x = scatter_to_tensor_model_parallel_region(x, self.axis_name, -1)
-        y = jnp.dot(jnp.asarray(x, self.dtype),
-                    jnp.asarray(kernel, self.dtype))
+        y = jnp.dot(jnp.asarray(x, dtype), jnp.asarray(kernel, dtype))
         if world > 1:
             if self.sequence_parallel_enabled:
                 y = reduce_scatter_to_sequence_parallel_region(
@@ -157,7 +161,7 @@ class RowParallelLinear(nn.Module):
         if self.use_bias:
             bias = self.param("bias", self.bias_init, (self.output_size,),
                               self.param_dtype)
-            y = y + jnp.asarray(bias, self.dtype)
+            y = y + jnp.asarray(bias, dtype)
         return y
 
     def kernel_partition_spec(self) -> PartitionSpec:
@@ -176,7 +180,9 @@ class VocabParallelEmbedding(nn.Module):
     embedding_dim: int
     axis_name: str = AXIS_MODEL
     world_size: Optional[int] = None
-    dtype: Any = jnp.float32
+    # None → activations in the embedding table's own dtype (embedding
+    # lookups are not classified by the O1 tables)
+    dtype: Optional[Any] = None
     param_dtype: Any = jnp.float32
     embedding_init: Callable = nn.initializers.normal(stddev=0.02)
 
@@ -224,7 +230,12 @@ def linear_with_grad_accumulation_and_async_allreduce(
         x = gather_from_sequence_parallel_region(x, axis_name, 0)
     elif async_grad_allreduce:
         x = copy_to_tensor_model_parallel_region(x, axis_name)
+    # same O1-engine consultation as the module classes above ('linear' is
+    # FP16_FUNCS): the Megatron shim must not silently diverge from them
+    from apex_tpu.amp.autocast import cast_op_inputs
+
+    x, weight = cast_op_inputs("linear", x, weight)
     y = jnp.dot(x, weight)
     if bias is not None:
-        y = y + bias
+        y = y + jnp.asarray(bias, y.dtype)
     return y

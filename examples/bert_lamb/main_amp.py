@@ -93,7 +93,7 @@ def main(argv=None):
 
     cfg = create_bert(args.bert_model,
                       max_position_embeddings=args.max_seq_length)
-    model = BertForPreTraining(cfg, dtype=policy.compute_dtype)
+    model = BertForPreTraining(cfg, dtype=policy.model_dtype)
     rng = jax.random.PRNGKey(args.seed)
     b0 = synthetic_bert_batch(rng, 2, args.max_seq_length,
                               args.max_predictions_per_seq, cfg.vocab_size)

@@ -142,7 +142,7 @@ def main(argv=None):
                              dtype=jnp.float32)
 
     model = FPNSegModel(num_classes=args.num_classes, norm=norm,
-                        dtype=policy.compute_dtype)
+                        dtype=policy.model_dtype)
     rng = jax.random.PRNGKey(args.seed)
     sample = jnp.zeros((1, args.image_size, args.image_size, 3),
                        jnp.float32)

@@ -280,13 +280,8 @@ def main(argv=None):
         from apex_tpu.parallel import SyncBatchNorm
         norm_cls = functools.partial(SyncBatchNorm, axis_name=axis_name)
 
-    # O1 (patch_torch_functions): leave dtype=None — the model resolves each
-    # op class against the policy tables inside make_train_step's autocast
-    # (convs half, batch_norm fp32). O0/O2/O3: the blanket compute dtype.
-    model_dtype = None if policy.patch_torch_functions \
-        else policy.compute_dtype
     model = create_model(
-        args.arch, num_classes=args.num_classes, dtype=model_dtype,
+        args.arch, num_classes=args.num_classes, dtype=policy.model_dtype,
         param_dtype=jnp.float32, norm_cls=norm_cls)
 
     rng = jax.random.PRNGKey(args.seed)

@@ -75,7 +75,7 @@ def main(argv=None):
 
     model = create_lm(args.size, vocab_size=args.vocab_size,
                       max_seq_len=args.seq_len, remat=args.remat,
-                      dtype=policy.compute_dtype)
+                      dtype=policy.model_dtype)
     rng = jax.random.PRNGKey(args.seed)
     sample = jnp.zeros((2, args.seq_len), jnp.int32)
     params = model.init(rng, sample, train=False)["params"]

@@ -223,3 +223,44 @@ def test_promote_casts_entries():
 def test_sequence_casts_table():
     assert "cat" in lists.SEQUENCE_CASTS and "stack" in lists.SEQUENCE_CASTS
     assert O1.op_dtype("stack", jnp.bfloat16, jnp.float32) == jnp.float32
+
+
+def test_tensor_parallel_layers_consult_engine(eight_devices):
+    """Column/RowParallelLinear run half under O1 when dtype=None, fp32
+    otherwise — the Megatron path honors the same tables as the rest."""
+    import functools
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+    from apex_tpu.transformer.tensor_parallel import (ColumnParallelLinear,
+                                                      RowParallelLinear)
+
+    mesh = Mesh(np.array(eight_devices[:2]), ("model",))
+    col = ColumnParallelLinear(input_size=8, output_size=16, world_size=2)
+    row = RowParallelLinear(input_size=16, output_size=8, world_size=2,
+                            input_is_parallel=True)
+    x = jnp.ones((4, 8), jnp.float32)
+
+    @functools.partial(shard_map, mesh=mesh, in_specs=P(),
+                       out_specs=(P(), P()), check_rep=False)
+    def run(x):
+        cv = col.init(jax.random.PRNGKey(0), x)
+        h = col.apply(cv, x)
+        rv = row.init(jax.random.PRNGKey(1), h)
+        return h, row.apply(rv, h)
+
+    h0, y0 = run(x)
+    assert h0.dtype == jnp.float32 and y0.dtype == jnp.float32
+    with autocast(O1):
+        h1, y1 = run(x)
+    assert h1.dtype == jnp.bfloat16 and y1.dtype == jnp.bfloat16
+
+
+def test_policy_model_dtype_property():
+    """Recipes pass policy.model_dtype as the flax dtype: None under O1
+    (per-op engine), the blanket compute dtype otherwise."""
+    assert amp.resolve_policy("O1", verbose=False).model_dtype is None
+    assert amp.resolve_policy("O0", verbose=False).model_dtype == jnp.float32
+    assert amp.resolve_policy("O2", verbose=False).model_dtype == jnp.bfloat16
+    assert amp.resolve_policy("O3", verbose=False).model_dtype == jnp.bfloat16
+    off = amp.resolve_policy("O1", enabled=False, verbose=False)
+    assert off.model_dtype == jnp.float32
