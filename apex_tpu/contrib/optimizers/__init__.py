@@ -41,9 +41,15 @@ from apex_tpu.optimizers.fused_adam import (_flat32, _lr_at, _unflatten_like)
 
 __all__ = ["distributed_fused_adam", "distributed_fused_lamb",
            "DistributedFusedAdam", "DistributedFusedLAMB",
-           "reshard_zero_state"]
+           "reshard_zero_state", "FP16_Optimizer", "FusedSGD"]
 
 ScalarOrSchedule = Union[float, Callable[[jnp.ndarray], Any]]
+
+# deprecated API-parity surface (reference: contrib/optimizers/
+# fp16_optimizer.py + fused_sgd.py, SURVEY P32) — import lazily-cheap
+# forwarding classes; each warns on construction
+from apex_tpu.contrib.optimizers.fp16_optimizer import FP16_Optimizer  # noqa: E402,F401
+from apex_tpu.contrib.optimizers.fused_sgd import FusedSGD  # noqa: E402,F401
 
 
 class DistAdamState(NamedTuple):

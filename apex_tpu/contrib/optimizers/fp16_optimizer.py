@@ -1,0 +1,31 @@
+"""Deprecated contrib FP16_Optimizer (API-parity surface).
+
+Reference: apex/contrib/optimizers/fp16_optimizer.py — class FP16_Optimizer,
+the deprecated wrapper that drove the old ``fused_adam_cuda``/
+``fused_lamb_cuda`` extensions (SURVEY N7, behind
+``--deprecated_fused_adam``). Upstream apex deprecates it in favor of
+apex.fp16_utils.FP16_Optimizer / amp; this module preserves the import
+path and forwards to the maintained implementation, whose semantics
+(master weights, static/dynamic scaler, skip-on-overflow) already match —
+the N7 kernels' math lives in the N2 superbuffer harness here.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+from apex_tpu.fp16_utils import FP16_Optimizer as _FP16_Optimizer
+
+__all__ = ["FP16_Optimizer"]
+
+
+class FP16_Optimizer(_FP16_Optimizer):
+    """Deprecated alias of :class:`apex_tpu.fp16_utils.FP16_Optimizer`
+    (the reference prints the same deprecation pointer)."""
+
+    def __init__(self, *args, **kwargs):
+        warnings.warn(
+            "apex_tpu.contrib.optimizers.FP16_Optimizer is deprecated; use "
+            "apex_tpu.fp16_utils.FP16_Optimizer or apex_tpu.amp",
+            DeprecationWarning, stacklevel=2)
+        super().__init__(*args, **kwargs)

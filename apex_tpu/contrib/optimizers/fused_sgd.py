@@ -1,0 +1,28 @@
+"""Deprecated contrib FusedSGD (API-parity surface).
+
+Reference: apex/contrib/optimizers/fused_sgd.py — the deprecated FusedSGD
+variant kept for old recipes (SURVEY P32). Forwards to the maintained
+apex_tpu.optimizers.FusedSGD, which implements the same multi_tensor_sgd
+semantics (momentum, wd_after_momentum, materialize_master_grads) on the
+superbuffer harness.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+from apex_tpu.optimizers.fused_sgd import FusedSGD as _FusedSGD
+from apex_tpu.optimizers.fused_sgd import fused_sgd  # noqa: F401
+
+__all__ = ["FusedSGD", "fused_sgd"]
+
+
+class FusedSGD(_FusedSGD):
+    """Deprecated alias of :class:`apex_tpu.optimizers.FusedSGD`."""
+
+    def __init__(self, *args, **kwargs):
+        warnings.warn(
+            "apex_tpu.contrib.optimizers.FusedSGD is deprecated; use "
+            "apex_tpu.optimizers.FusedSGD",
+            DeprecationWarning, stacklevel=2)
+        super().__init__(*args, **kwargs)
