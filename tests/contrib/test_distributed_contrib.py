@@ -346,3 +346,24 @@ def test_spatial_bottleneck_matches_dense(data_mesh):
     out = run(dv, x)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_deprecated_optimizer_aliases():
+    """The P32 deprecated wrappers stay importable and forward correctly
+    (an eager package import would break ALL contrib.optimizers imports if
+    a forwarding target moved)."""
+    import warnings
+    from apex_tpu.contrib.optimizers import FP16_Optimizer, FusedSGD
+    from apex_tpu.fp16_utils import FP16_Optimizer as Real16
+    from apex_tpu.optimizers import FusedSGD as RealSGD
+
+    assert issubclass(FP16_Optimizer, Real16)
+    assert issubclass(FusedSGD, RealSGD)
+    params = {"w": jnp.ones((4,))}
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        opt = FusedSGD(params, lr=0.1)
+        FP16_Optimizer(optax.sgd(0.1), params)
+    assert sum("deprecated" in str(x.message) for x in w) >= 2
+    out = opt.step({"w": jnp.full((4,), 0.5)})
+    np.testing.assert_allclose(np.asarray(out["w"]), 1.0 - 0.05, rtol=1e-6)
