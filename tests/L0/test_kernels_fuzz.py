@@ -138,3 +138,56 @@ def test_xentropy_fuzz(args):
         lg, labels, smoothing).sum())(logits)
     np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
                                rtol=1e-4, atol=1e-5)
+
+
+@st.composite
+def causal_shapes(draw):
+    n = draw(st.integers(1, 3))
+    sq = draw(st.sampled_from([8, 16, 24, 128]))
+    sk = draw(st.sampled_from([128, 256]))
+    seed = draw(st.integers(0, 2**31 - 1))
+    scale = draw(st.sampled_from([0.1, 1.0, 4.0]))
+    rng = np.random.RandomState(seed)
+    return jnp.asarray(rng.randn(n, sq, sk) * scale, jnp.float32), \
+        draw(st.sampled_from([0.125, 1.0]))
+
+
+@given(causal_shapes())
+@settings(**_SETTINGS)
+def test_causal_softmax_fuzz(args):
+    from apex_tpu.kernels.causal_softmax import (causal_softmax,
+                                                 causal_softmax_reference)
+
+    x, scale = args
+    out = causal_softmax(x, scale, interpret=True)
+    ref = causal_softmax_reference(x, scale)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+@st.composite
+def gn_inputs(draw):
+    n = draw(st.integers(1, 2))
+    s = draw(st.sampled_from([7, 16, 33]))
+    c = draw(st.sampled_from([128, 256]))
+    groups = draw(st.sampled_from([1, 8, c]))
+    shift = draw(st.sampled_from([0.0, 100.0]))
+    seed = draw(st.integers(0, 2**31 - 1))
+    rng = np.random.RandomState(seed)
+    x = jnp.asarray(rng.randn(n, s, c) + shift, jnp.float32)
+    g = jnp.asarray(rng.randn(c) + 1.0, jnp.float32)
+    b = jnp.asarray(rng.randn(c), jnp.float32)
+    return x, groups, g, b
+
+
+@given(gn_inputs(), st.sampled_from([None, "silu"]))
+@settings(**_SETTINGS)
+def test_group_norm_fuzz(args, act):
+    from apex_tpu.kernels.group_norm import (group_norm_nhwc,
+                                             group_norm_reference)
+
+    x, groups, g, b = args
+    out = group_norm_nhwc(x, groups, g, b, act=act, interpret=True)
+    ref = group_norm_reference(x, groups, g, b, act=act)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
