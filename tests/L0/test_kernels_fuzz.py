@@ -163,6 +163,12 @@ def test_causal_softmax_fuzz(args):
     ref = causal_softmax_reference(x, scale)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-5, atol=1e-6)
+    gk = jax.grad(lambda x: jnp.sum(jnp.sin(
+        causal_softmax(x, scale, interpret=True) * 2.0)))(x)
+    gr = jax.grad(lambda x: jnp.sum(jnp.sin(
+        causal_softmax_reference(x, scale) * 2.0)))(x)
+    np.testing.assert_allclose(np.asarray(gk), np.asarray(gr),
+                               rtol=1e-4, atol=1e-5)
 
 
 @st.composite
@@ -191,3 +197,15 @@ def test_group_norm_fuzz(args, act):
     ref = group_norm_reference(x, groups, g, b, act=act)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-4, atol=2e-4)
+    gk = jax.grad(lambda x, g, b: jnp.sum(jnp.sin(
+        group_norm_nhwc(x, groups, g, b, act=act, interpret=True))),
+        argnums=(0, 1, 2))(x, g, b)
+    gr = jax.grad(lambda x, g, b: jnp.sum(jnp.sin(
+        group_norm_reference(x, groups, g, b, act=act))),
+        argnums=(0, 1, 2))(x, g, b)
+    # large-mean draws (shift=100) amplify fp32 cancellation in BOTH
+    # paths' xhat by ~mean/std; tolerance covers that while still
+    # catching structural (wrong-slot) errors, which are O(1)
+    for a, r in zip(gk, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=1e-3, atol=3e-3)
