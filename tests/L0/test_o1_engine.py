@@ -264,3 +264,32 @@ def test_policy_model_dtype_property():
     assert amp.resolve_policy("O3", verbose=False).model_dtype == jnp.bfloat16
     off = amp.resolve_policy("O1", enabled=False, verbose=False)
     assert off.model_dtype == jnp.float32
+
+
+def test_o1_fp16_overflow_skips_step():
+    """O1 with half_dtype=fp16: an overflow in the half GEMM trips the
+    dynamic scaler and freezes params+opt state (the engine composes with
+    the scaler exactly like O2)."""
+    import optax
+    from apex_tpu.mlp import MLP
+
+    m = MLP(mlp_sizes=[8, 8])
+    policy = amp.resolve_policy("O1", half_dtype=jnp.float16, verbose=False)
+
+    def loss_fn(params, batch):
+        y = m.apply(params, batch)     # fp16 GEMM under the engine
+        return jnp.mean(jnp.square(jnp.asarray(y, jnp.float32)))
+
+    x_ok = jnp.ones((2, 8), jnp.float32)
+    x_huge = jnp.full((2, 8), 1e30, jnp.float32)  # overflows in fp16
+    params = m.init(jax.random.PRNGKey(0), x_ok)
+    init_fn, step_fn = amp.make_train_step(loss_fn, optax.sgd(0.1), policy)
+    state = init_fn(params)
+    state2, metrics = jax.jit(step_fn)(state, x_huge)
+    assert bool(metrics["found_inf"])
+    for a, b in zip(jax.tree_util.tree_leaves(state.params),
+                    jax.tree_util.tree_leaves(state2.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # scale halved by the schedule
+    assert float(state2.scaler.loss_scale) == \
+        float(state.scaler.loss_scale) / 2
