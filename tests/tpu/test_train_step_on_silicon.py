@@ -1,0 +1,77 @@
+"""Full amp train step on the real chip: the kernel tier proves each
+Pallas lowering; this proves the COMPOSED benchmark-shaped step (policy
+casts + fused optimizer + scaler cond + BN state) compiles and executes
+on silicon end-to-end — the single-chip slice of the bench.py workload."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+
+@pytest.mark.parametrize("opt_level", ["O1", "O2"])
+def test_resnet_train_step(tpu_backend, opt_level):
+    from apex_tpu import amp
+    from apex_tpu.models import create_model
+
+    policy = amp.resolve_policy(opt_level=opt_level, loss_scale="dynamic",
+                                verbose=False)
+    model = create_model("resnet18", num_classes=10,
+                         dtype=policy.model_dtype)
+    x = jnp.ones((4, 32, 32, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=True)
+    params = variables["params"]
+    mstate = {k: v for k, v in variables.items() if k != "params"}
+
+    def loss_fn(p, ms, batch):
+        images, labels = batch
+        logits, updated = model.apply({"params": p, **ms}, images,
+                                      train=True, mutable=list(ms.keys()))
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            jnp.asarray(logits, jnp.float32), labels).mean()
+        return loss, updated
+
+    init_fn, step_fn = amp.make_train_step(
+        loss_fn, optax.sgd(0.01, momentum=0.9), policy,
+        with_model_state=True)
+    state = init_fn(params, mstate)
+    jit_step = jax.jit(step_fn)
+    labels = jnp.zeros((4,), jnp.int32)
+    losses = []
+    for _ in range(3):
+        state, metrics = jit_step(state, (x, labels))
+        losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(losses)), losses
+    assert losses[2] < losses[0]      # it actually learns the fixed batch
+    assert not bool(metrics["found_inf"])
+
+
+def test_lm_train_step_with_fused_xentropy(tpu_backend):
+    from apex_tpu import amp
+    from apex_tpu.contrib.xentropy import softmax_cross_entropy_loss
+    from apex_tpu.models import create_lm
+
+    policy = amp.resolve_policy(opt_level="O2", loss_scale="dynamic",
+                                verbose=False)
+    model = create_lm("tiny", vocab_size=128, max_seq_len=32,
+                      dtype=policy.model_dtype)
+    tokens = jnp.ones((2, 32), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens, train=False)["params"]
+
+    def loss_fn(p, batch):
+        logits = model.apply({"params": p}, batch, train=False)
+        return softmax_cross_entropy_loss(
+            logits[:, :-1].reshape(-1, 128),
+            batch[:, 1:].reshape(-1)).mean()
+
+    from apex_tpu.optimizers.fused_adam import fused_adam
+    init_fn, step_fn = amp.make_train_step(loss_fn, fused_adam(1e-3), policy)
+    state = init_fn(params)
+    jit_step = jax.jit(step_fn)
+    l0 = None
+    for _ in range(3):
+        state, metrics = jit_step(state, tokens)
+        l0 = l0 if l0 is not None else float(metrics["loss"])
+    assert np.isfinite(float(metrics["loss"]))
+    assert float(metrics["loss"]) < l0   # flash + xentropy + fused adam
