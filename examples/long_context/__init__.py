@@ -1,0 +1,1 @@
+"""apex_tpu examples (regular package so in-repo imports beat any site-packages \"examples\" distribution)."""
