@@ -116,6 +116,29 @@ def make_hybrid_mesh(ici_axes: Dict[str, int],
     return make_mesh({**dcn_axes, **ici_axes})
 
 
+def ensure_devices(n: int) -> list:
+    """Return ≥ ``n`` devices, falling back to virtual CPU devices when the
+    attached platform has fewer (hermetic runs of multi-device recipes).
+
+    The config updates are needed even when ``JAX_PLATFORMS=cpu`` is
+    exported — the axon sitecustomize imports jax at interpreter start and
+    pins ``jax_platforms``, overriding the env var; and
+    ``jax_num_cpu_devices`` refuses to change on initialized backends,
+    hence the clear_backends first.
+    """
+    devices = jax.devices()
+    if len(devices) < n:
+        from jax.extend.backend import clear_backends
+
+        clear_backends()
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", n)
+        devices = jax.devices()
+    if len(devices) < n:
+        raise RuntimeError(f"need {n} devices, have {len(devices)}")
+    return devices
+
+
 def default_mesh() -> Mesh:
     """All local devices on a single ``data`` axis — what plain apex DDP
     (pure data parallelism) corresponds to."""

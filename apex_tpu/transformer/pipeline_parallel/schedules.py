@@ -117,7 +117,7 @@ def pipeline_apply(stage_fn: Callable, local_chunks, microbatches, *,
 
 def make_pipeline_loss_fn(stage_fn: Callable, loss_fn: Callable, *,
                           axis_name: str = AXIS_PIPE, num_stages: int,
-                          num_chunks: int = 1):
+                          num_chunks: int = 1, remat: bool = False):
     """Build ``fn(local_chunks, (microbatches, targets)) -> scalar loss``.
 
     This is the composition point with apex_tpu.amp.make_train_step: the
@@ -126,7 +126,17 @@ def make_pipeline_loss_fn(stage_fn: Callable, loss_fn: Callable, *,
     in_spec P('pipe') and they arrive here as [v, ...]).
 
     ``loss_fn(output, target) -> scalar`` (per-microbatch mean).
+
+    ``remat=True`` wraps the stage function in ``jax.checkpoint``
+    (reference: tensor_parallel/random.py — checkpoint), shrinking this
+    autodiff path's saved residuals to the stage BOUNDARY activations:
+    memory still grows with the microbatch count (the scan carry is saved
+    per tick — use :func:`forward_backward_1f1b` for the O(pp) profile)
+    but the per-tick constant drops from all stage internals to one
+    boundary tensor.
     """
+    if remat:
+        stage_fn = jax.checkpoint(stage_fn)
 
     def fn(local_chunks, batch):
         microbatches, targets = batch

@@ -124,14 +124,9 @@ def main(argv=None):
     args = parse_args(argv)
     if args.iters < 1:
         raise SystemExit("--iters must be >= 1")
-    if args.data_parallel > 1 and len(jax.devices()) < args.data_parallel:
-        # hermetic multi-chip: N virtual CPU devices (the axon sitecustomize
-        # pins jax_platforms, so update the live config BEFORE any arrays
-        # exist — same dance as __graft_entry__.dryrun_multichip)
-        from jax.extend.backend import clear_backends
-        clear_backends()
-        jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", args.data_parallel)
+    if args.data_parallel > 1:
+        from apex_tpu import comm as _comm
+        _comm.ensure_devices(args.data_parallel)
     policy = amp.resolve_policy(opt_level=args.opt_level,
                                 loss_scale="dynamic")
     print(policy.banner())

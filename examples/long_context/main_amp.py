@@ -122,25 +122,14 @@ class RingLM(nn.Module):
 def main(argv=None):
     args = parse_args(argv)
     policy = amp.resolve_policy(opt_level=args.opt_level)
-    devices = jax.devices()
-    if len(devices) < args.ring:
-        # fall back to virtual CPU devices (the axon sitecustomize pins
-        # jax_platforms at interpreter start, so the env var alone is not
-        # enough — same dance as __graft_entry__.dryrun_multichip)
-        from jax.extend.backend import clear_backends
-
-        clear_backends()
-        jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", args.ring)
-        devices = jax.devices()
-    if len(devices) < args.ring:
-        raise SystemExit(f"--ring {args.ring} needs {args.ring} devices, "
-                         f"have {len(devices)}")
+    devices = comm.ensure_devices(args.ring)
     mesh = Mesh(np.array(devices[:args.ring]), ("context",))
     comm.set_mesh(mesh)
     S, n = args.seq_len, args.ring
-    if S % (2 * n):
-        raise SystemExit("--seq-len must divide by 2*ring (zigzag chunks)")
+    chunk = 2 * n if args.layout == "zigzag" else n
+    if S % chunk:
+        raise SystemExit(f"--seq-len must divide by {chunk} "
+                         f"({args.layout} chunks over a ring of {n})")
 
     model = RingLM(args.vocab, args.hidden, args.layers, args.heads,
                    max_seq=S, layout=args.layout)

@@ -346,3 +346,37 @@ def test_build_model_flags_vpp3_pp4():
     logical = [c * 4 + r for r in range(4) for c in range(3)]
     for (pre, post), s in zip(calls, logical):
         assert pre == (s == 0) and post == (s == 11), (s, pre, post)
+
+
+def test_pipeline_remat_reduces_residuals(pipe_mesh):
+    """remat=True shrinks the autodiff path's per-tick residual stash (the
+    jax.checkpoint policy route of VERDICT item 3) while computing the
+    same numbers."""
+    D2 = 64
+
+    def big_stage(w, x):
+        h = jnp.tanh(x @ w)
+        return jnp.tanh(h @ w.T) @ w     # 3 internal activations
+
+    def temp_bytes(remat, M):
+        pl = pp.make_pipeline_loss_fn(big_stage, loss_fn, num_stages=PP,
+                                      remat=remat)
+
+        @functools.partial(shard_map, mesh=pipe_mesh,
+                           in_specs=(P("pipe"), P(), P()),
+                           out_specs=(P(), P("pipe")), check_rep=False)
+        def run(ws_local, mb, tg):
+            l, g = jax.value_and_grad(pl)(ws_local[0], (mb, tg))
+            return l, g[None]
+
+        ws = jnp.ones((PP, D2, D2))
+        mb = jnp.ones((M, 32, D2))
+        tg = jnp.ones((M, 32, D2))
+        c = jax.jit(run).lower(ws, mb, tg).compile()
+        return c.memory_analysis().temp_size_in_bytes, c(ws, mb, tg)
+
+    bytes_plain, (l0, g0) = temp_bytes(False, 16)
+    bytes_remat, (l1, g1) = temp_bytes(True, 16)
+    assert bytes_remat < 0.8 * bytes_plain, (bytes_remat, bytes_plain)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(g0), np.asarray(g1), rtol=1e-5)
