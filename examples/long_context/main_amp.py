@@ -43,6 +43,7 @@ from apex_tpu import amp, comm
 from apex_tpu.kernels.xentropy import softmax_cross_entropy_loss
 from apex_tpu.normalization import FusedLayerNorm
 from apex_tpu.transformer.context_parallel import (ring_attention,
+                                                   ulysses_attention,
                                                    zigzag_order)
 
 
@@ -62,6 +63,9 @@ def parse_args(argv=None):
     p.add_argument("--opt-level", default="O2")
     p.add_argument("--layout", default="zigzag",
                    choices=["zigzag", "contiguous"])
+    p.add_argument("--attn", default="ring", choices=["ring", "ulysses"],
+                   help="ring: KV rotates via ppermute; ulysses: "
+                        "all-to-all head scatter (needs heads %% ring == 0)")
     return p.parse_args(argv)
 
 
@@ -73,6 +77,7 @@ class RingBlock(nn.Module):
     hidden: int
     heads: int
     layout: str
+    attn: str = "ring"
 
     @nn.compact
     def __call__(self, x):
@@ -85,7 +90,10 @@ class RingBlock(nn.Module):
         qkv = nn.Dense(3 * H, dtype=dtype, name="qkv")(h)
         qkv = qkv.reshape(B, S, 3, self.heads, d)
         q, k, v = (jnp.moveaxis(qkv[:, :, i], 1, 2) for i in range(3))
-        out = ring_attention(q, k, v, causal=True, layout=self.layout)
+        if self.attn == "ulysses":
+            out = ulysses_attention(q, k, v, causal=True)
+        else:
+            out = ring_attention(q, k, v, causal=True, layout=self.layout)
         out = jnp.moveaxis(out, 1, 2).reshape(B, S, H)
         x = x + nn.Dense(H, dtype=dtype, name="proj")(out)
         h = FusedLayerNorm(normalized_shape=H, name="ln_mlp")(x)
@@ -103,6 +111,7 @@ class RingLM(nn.Module):
     heads: int
     max_seq: int
     layout: str
+    attn: str = "ring"
 
     @nn.compact
     def __call__(self, tokens, positions):
@@ -113,7 +122,7 @@ class RingLM(nn.Module):
                          (self.max_seq, self.hidden), jnp.float32)
         x = wte(tokens) + wpe[positions]
         for i in range(self.layers):
-            x = RingBlock(self.hidden, self.heads, self.layout,
+            x = RingBlock(self.hidden, self.heads, self.layout, self.attn,
                           name=f"block_{i}")(x)
         x = FusedLayerNorm(normalized_shape=self.hidden, name="ln_f")(x)
         return wte.attend(jnp.asarray(x, jnp.float32))
@@ -126,13 +135,18 @@ def main(argv=None):
     mesh = Mesh(np.array(devices[:args.ring]), ("context",))
     comm.set_mesh(mesh)
     S, n = args.seq_len, args.ring
+    if args.attn == "ulysses":
+        # ulysses permutes heads, not the sequence: contiguous layout only
+        args.layout = "contiguous"
+        if args.heads % n:
+            raise SystemExit(f"--attn ulysses needs heads % ring == 0 "
+                             f"({args.heads} % {n})")
     chunk = 2 * n if args.layout == "zigzag" else n
     if S % chunk:
         raise SystemExit(f"--seq-len must divide by {chunk} "
                          f"({args.layout} chunks over a ring of {n})")
-
     model = RingLM(args.vocab, args.hidden, args.layers, args.heads,
-                   max_seq=S, layout=args.layout)
+                   max_seq=S, layout=args.layout, attn=args.attn)
 
     # zigzag layout: permute the GLOBAL sequence once on the host; each
     # rank then owns balanced front+back chunks of the causal triangle
@@ -217,7 +231,8 @@ def main(argv=None):
     if args.iters > 1:
         dt = time.perf_counter() - t0
         tok_s = args.batch_size * S * (args.iters - 1) / dt
-        print(f"=> {tok_s:.0f} tokens/s ({args.layout} ring of {n})")
+        kind = args.attn if args.attn == "ulysses" else args.layout
+        print(f"=> {tok_s:.0f} tokens/s ({kind} ring of {n})")
     return float(loss)
 
 

@@ -29,3 +29,16 @@ def test_ring_lm_trains_and_layouts_agree():
                                        "--opt-level", "O0"])
     assert loss_zig < 4.5 and loss_con < 4.5, (loss_zig, loss_con)
     assert abs(loss_zig - loss_con) < 1e-4, (loss_zig, loss_con)
+
+
+@pytest.mark.slow
+def test_ulysses_mode_matches_ring():
+    """--attn ulysses computes the same attention a different way (a2a head
+    scatter vs KV rotation): identical data + init → same fp32 loss."""
+    common = ["--ring", "4", "--seq-len", "256", "--hidden", "64",
+              "--layers", "1", "--heads", "4", "--vocab", "128",
+              "--iters", "3", "--lr", "3e-3", "--opt-level", "O0",
+              "--layout", "contiguous"]
+    loss_ring = main_amp.main(common + ["--attn", "ring"])
+    loss_uly = main_amp.main(common + ["--attn", "ulysses"])
+    assert abs(loss_ring - loss_uly) < 1e-3, (loss_ring, loss_uly)
