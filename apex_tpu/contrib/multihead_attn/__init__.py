@@ -112,7 +112,12 @@ class SelfMultiheadAttn(nn.Module):
     use_bias: bool = False
     include_norm_add: bool = False
     impl: str = "fast"
-    dtype: Any = jnp.float32
+    # None → consult the O1 engine ('linear' is FP16_FUNCS), else fp32 —
+    # the same None semantics as every GEMM-family module (models, TP
+    # layers): the pre-engine default was fp32, so no-policy behavior is
+    # unchanged. (Norm modules differ deliberately: their None follows the
+    # input dtype, since they are dtype-preserving ops in apex.)
+    dtype: Optional[Any] = None
     param_dtype: Any = jnp.float32
 
     @nn.compact
@@ -121,7 +126,9 @@ class SelfMultiheadAttn(nn.Module):
                  key_padding_mask: Optional[jnp.ndarray] = None,
                  attn_mask: Optional[jnp.ndarray] = None,
                  is_training: bool = True):
-        x = jnp.asarray(query, self.dtype)
+        from apex_tpu.amp.autocast import resolve_dtype
+        dtype = resolve_dtype(self.dtype, "linear", jnp.float32)
+        x = jnp.asarray(query, dtype)
         residual = x
         if self.include_norm_add:
             # *_norm_add_* variants: pre-LN fused into the block, residual
@@ -129,7 +136,7 @@ class SelfMultiheadAttn(nn.Module):
             x = FusedLayerNorm(normalized_shape=self.embed_dim,
                                dtype=self.dtype, name="lyr_norm")(x)
         qkv = nn.Dense(3 * self.embed_dim, use_bias=self.use_bias,
-                       dtype=self.dtype, param_dtype=self.param_dtype,
+                       dtype=dtype, param_dtype=self.param_dtype,
                        name="qkv_proj")(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         qh, kh, vh = (_split_heads(t, self.num_heads) for t in (q, k, v))
@@ -141,7 +148,7 @@ class SelfMultiheadAttn(nn.Module):
                       attn_mask=attn_mask)
         y = _merge_heads(out)
         y = nn.Dense(self.embed_dim, use_bias=self.use_bias,
-                     dtype=self.dtype, param_dtype=self.param_dtype,
+                     dtype=dtype, param_dtype=self.param_dtype,
                      name="out_proj")(y)
         if self.include_norm_add:
             # *_norm_add_* fuses dropout into the residual add
@@ -168,7 +175,12 @@ class EncdecMultiheadAttn(nn.Module):
     use_bias: bool = False
     include_norm_add: bool = False
     impl: str = "fast"
-    dtype: Any = jnp.float32
+    # None → consult the O1 engine ('linear' is FP16_FUNCS), else fp32 —
+    # the same None semantics as every GEMM-family module (models, TP
+    # layers): the pre-engine default was fp32, so no-policy behavior is
+    # unchanged. (Norm modules differ deliberately: their None follows the
+    # input dtype, since they are dtype-preserving ops in apex.)
+    dtype: Optional[Any] = None
     param_dtype: Any = jnp.float32
 
     @nn.compact
@@ -176,17 +188,19 @@ class EncdecMultiheadAttn(nn.Module):
                  key_padding_mask: Optional[jnp.ndarray] = None,
                  attn_mask: Optional[jnp.ndarray] = None,
                  is_training: bool = True):
-        q_in = jnp.asarray(query, self.dtype)
-        kv_in = jnp.asarray(key, self.dtype)
+        from apex_tpu.amp.autocast import resolve_dtype
+        dtype = resolve_dtype(self.dtype, "linear", jnp.float32)
+        q_in = jnp.asarray(query, dtype)
+        kv_in = jnp.asarray(key, dtype)
         residual = q_in
         if self.include_norm_add:
             q_in = FusedLayerNorm(normalized_shape=self.embed_dim,
                                   dtype=self.dtype, name="lyr_norm")(q_in)
         q = nn.Dense(self.embed_dim, use_bias=self.use_bias,
-                     dtype=self.dtype, param_dtype=self.param_dtype,
+                     dtype=dtype, param_dtype=self.param_dtype,
                      name="q_proj")(q_in)
         kv = nn.Dense(2 * self.embed_dim, use_bias=self.use_bias,
-                      dtype=self.dtype, param_dtype=self.param_dtype,
+                      dtype=dtype, param_dtype=self.param_dtype,
                       name="kv_proj")(kv_in)
         k, v = jnp.split(kv, 2, axis=-1)
         qh, kh, vh = (_split_heads(t, self.num_heads) for t in (q, k, v))
@@ -197,7 +211,7 @@ class EncdecMultiheadAttn(nn.Module):
                       attn_mask=attn_mask)
         y = _merge_heads(out)
         y = nn.Dense(self.embed_dim, use_bias=self.use_bias,
-                     dtype=self.dtype, param_dtype=self.param_dtype,
+                     dtype=dtype, param_dtype=self.param_dtype,
                      name="out_proj")(y)
         if self.include_norm_add:
             if self.dropout > 0.0 and is_training:

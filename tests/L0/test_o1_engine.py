@@ -293,3 +293,24 @@ def test_o1_fp16_overflow_skips_step():
     # scale halved by the schedule
     assert float(state2.scaler.loss_scale) == \
         float(state.scaler.loss_scale) / 2
+
+
+def test_contrib_mha_consults_engine():
+    """Self/Encdec MultiheadAttn GEMMs run half under O1 when dtype=None;
+    the pre-norm (include_norm_add) still lifts to fp32 internally."""
+    from apex_tpu.contrib.multihead_attn import (EncdecMultiheadAttn,
+                                                 SelfMultiheadAttn)
+
+    x = jnp.ones((2, 3, 32), jnp.float32)  # [b, s, H]
+    mha = SelfMultiheadAttn(embed_dim=32, num_heads=4, impl="default",
+                            include_norm_add=True)
+    v = mha.init(jax.random.PRNGKey(0), x, is_training=False)
+    assert mha.apply(v, x, is_training=False).dtype == jnp.float32
+    with autocast(O1):
+        assert mha.apply(v, x, is_training=False).dtype == jnp.bfloat16
+
+    enc = EncdecMultiheadAttn(embed_dim=32, num_heads=4, impl="default")
+    ve = enc.init(jax.random.PRNGKey(1), x, x, is_training=False)
+    assert enc.apply(ve, x, x, is_training=False).dtype == jnp.float32
+    with autocast(O1):
+        assert enc.apply(ve, x, x, is_training=False).dtype == jnp.bfloat16
