@@ -120,6 +120,12 @@ def ensure_devices(n: int) -> list:
     """Return ≥ ``n`` devices, falling back to virtual CPU devices when the
     attached platform has fewer (hermetic runs of multi-device recipes).
 
+    **Call this at program start, before creating any jax arrays or
+    compiled computations.** When the attached platform is short it
+    switches backends (``clear_backends``), which invalidates every live
+    array and jitted executable; to prevent silent corruption it refuses
+    to switch while arrays are live.
+
     The config updates are needed even when ``JAX_PLATFORMS=cpu`` is
     exported — the axon sitecustomize imports jax at interpreter start and
     pins ``jax_platforms``, overriding the env var; and
@@ -130,6 +136,20 @@ def ensure_devices(n: int) -> list:
     if len(devices) < n:
         from jax.extend.backend import clear_backends
 
+        import gc
+
+        live = jax.live_arrays()
+        if live:
+            # dead-but-uncollected arrays (reference cycles, pytest-pinned
+            # tracebacks) must not trigger a spurious refusal
+            gc.collect()
+            live = jax.live_arrays()
+        if live:
+            raise RuntimeError(
+                f"ensure_devices({n}) would switch jax backends, "
+                f"invalidating {len(live)} live array(s). Call it before "
+                "creating any arrays or compiled computations (recipe "
+                "start), as the examples do.")
         clear_backends()
         jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_num_cpu_devices", n)

@@ -37,6 +37,25 @@ __all__ = ["save_checkpoint", "load_checkpoint", "latest_checkpoint",
 
 _META_KEY = "__apex_tpu_meta__"
 
+# State fields added after their dataclass first shipped. A checkpoint
+# written before the field existed is missing that leaf; restore fills it
+# from the template (the freshly-constructed state's default) — the pytree
+# analogue of LossScaler.load_state_dict's ``sd.get("hysteresis_left", …)``
+# and of apex amp.load_state_dict tolerating older state_dicts
+# (apex/amp/frontend.py — state_dict round-trips across versions).
+_MIGRATABLE_FIELDS = frozenset({"hysteresis_left"})
+
+
+def _leaf_paths(state) -> list:
+    """Key-path string per flattened leaf, aligned with tree_flatten order."""
+    flat_p = jax.tree_util.tree_flatten_with_path(state)[0]
+    return [jax.tree_util.keystr(p) for p, _ in flat_p]
+
+
+def _path_field(path: str) -> str:
+    """Final attribute/key name of a keystr path like ".scaler.loss_scale"."""
+    return path.rsplit(".", 1)[-1].strip("[]'\"")
+
 
 def save_checkpoint(path: str, state: Any, step: int = 0,
                     extra: Optional[dict] = None) -> str:
@@ -55,7 +74,7 @@ def save_checkpoint(path: str, state: Any, step: int = 0,
             a = a.astype(np.float32)
         arrays[f"leaf_{i}"] = a
     meta = {"step": int(step), "n_leaves": len(flat), "dtypes": dtypes,
-            "extra": extra or {}}
+            "paths": _leaf_paths(state), "extra": extra or {}}
     arrays[_META_KEY] = np.frombuffer(
         json.dumps(meta).encode("utf-8"), dtype=np.uint8)
     tmp = path + ".tmp"
@@ -71,30 +90,73 @@ def load_checkpoint(path: str, template: Any) -> Tuple[Any, int, dict]:
     ``template`` supplies the treedef and the expected shapes/dtypes (the
     already-built state, as with torch's load_state_dict). Returns
     ``(state, step, extra)``.
+
+    Checkpoints from before a :data:`_MIGRATABLE_FIELDS` field existed (e.g.
+    a round-1 AmpState without ``ScalerState.hysteresis_left``) restore
+    cleanly: the missing leaves keep the template's freshly-initialized
+    values and every other leaf loads normally.
     """
     with np.load(path) as data:
         meta = json.loads(bytes(data[_META_KEY].tolist()).decode("utf-8"))
         flat_t, treedef = jax.tree_util.tree_flatten(template)
+        # Template positions filled from the template itself because the
+        # (older) checkpoint predates the field. Identified positionally:
+        # struct.dataclass flattening is declaration-ordered, so removing
+        # the migratable leaves from the template must reproduce the old
+        # layout exactly (checked by count, and by name when the checkpoint
+        # recorded key paths).
+        fill_from_template: set = set()
+        old_paths = meta.get("paths")
+        if meta["n_leaves"] == len(flat_t) and old_paths is not None:
+            # equal-count load: when the checkpoint recorded key paths,
+            # a same-shaped but differently-named template is still a
+            # configuration mismatch — catch it by name, not just shape
+            t_paths = _leaf_paths(template)
+            if t_paths != old_paths:
+                bad = next((a, b) for a, b in zip(old_paths, t_paths)
+                           if a != b)
+                raise ValueError(
+                    f"checkpoint leaf paths do not match the template "
+                    f"(first difference: saved {bad[0]!r} vs template "
+                    f"{bad[1]!r}) — wrong model/optimizer configuration")
         if meta["n_leaves"] != len(flat_t):
-            raise ValueError(
-                f"checkpoint has {meta['n_leaves']} leaves, template has "
-                f"{len(flat_t)} — wrong model/optimizer configuration")
+            t_paths = _leaf_paths(template)
+            migratable = [i for i, p in enumerate(t_paths)
+                          if _path_field(p) in _MIGRATABLE_FIELDS]
+            if meta["n_leaves"] != len(flat_t) - len(migratable) or not migratable:
+                raise ValueError(
+                    f"checkpoint has {meta['n_leaves']} leaves, template has "
+                    f"{len(flat_t)} — wrong model/optimizer configuration")
+            fill_from_template = set(migratable)
+            if old_paths is not None:
+                kept = [p for i, p in enumerate(t_paths)
+                        if i not in fill_from_template]
+                if kept != old_paths:
+                    raise ValueError(
+                        "checkpoint leaf paths do not match the template "
+                        "even after dropping migratable fields — wrong "
+                        "model/optimizer configuration")
         saved_dtypes = meta.get("dtypes")
         flat = []
+        ckpt_i = 0
         for i, t in enumerate(flat_t):
-            arr = data[f"leaf_{i}"]
             t = np.asarray(t)
+            if i in fill_from_template:
+                flat.append(jax.numpy.asarray(t))
+                continue
+            arr = data[f"leaf_{ckpt_i}"]
             if arr.shape != t.shape:
                 raise ValueError(
-                    f"leaf {i}: checkpoint shape {arr.shape} != template "
+                    f"leaf {ckpt_i}: checkpoint shape {arr.shape} != template "
                     f"shape {t.shape}")
-            if saved_dtypes is not None and saved_dtypes[i] != t.dtype.name:
+            if saved_dtypes is not None and saved_dtypes[ckpt_i] != t.dtype.name:
                 raise ValueError(
-                    f"leaf {i}: checkpoint dtype {saved_dtypes[i]} != "
+                    f"leaf {ckpt_i}: checkpoint dtype {saved_dtypes[ckpt_i]} != "
                     f"template dtype {t.dtype.name} — resuming into a "
                     "different precision configuration would silently "
                     "change numerics")
             flat.append(jax.numpy.asarray(arr.astype(t.dtype)))
+            ckpt_i += 1
     state = jax.tree_util.tree_unflatten(treedef, flat)
     return state, meta["step"], meta["extra"]
 

@@ -78,12 +78,15 @@ class RingBlock(nn.Module):
     heads: int
     layout: str
     attn: str = "ring"
+    # policy.model_dtype from the recipe: half under O2/O3, None under O1
+    # (the autocast engine's per-op table decides), fp32 under O0.
+    dtype: object = None
 
     @nn.compact
     def __call__(self, x):
         from apex_tpu.amp.autocast import resolve_dtype
 
-        dtype = resolve_dtype(None, "linear", jnp.float32)
+        dtype = resolve_dtype(self.dtype, "linear", jnp.float32)
         B, S, H = x.shape
         d = self.hidden // self.heads
         h = FusedLayerNorm(normalized_shape=H, name="ln_attn")(x)
@@ -112,6 +115,7 @@ class RingLM(nn.Module):
     max_seq: int
     layout: str
     attn: str = "ring"
+    dtype: object = None  # threaded into every RingBlock
 
     @nn.compact
     def __call__(self, tokens, positions):
@@ -123,7 +127,7 @@ class RingLM(nn.Module):
         x = wte(tokens) + wpe[positions]
         for i in range(self.layers):
             x = RingBlock(self.hidden, self.heads, self.layout, self.attn,
-                          name=f"block_{i}")(x)
+                          dtype=self.dtype, name=f"block_{i}")(x)
         x = FusedLayerNorm(normalized_shape=self.hidden, name="ln_f")(x)
         return wte.attend(jnp.asarray(x, jnp.float32))
 
@@ -146,7 +150,8 @@ def main(argv=None):
         raise SystemExit(f"--seq-len must divide by {chunk} "
                          f"({args.layout} chunks over a ring of {n})")
     model = RingLM(args.vocab, args.hidden, args.layers, args.heads,
-                   max_seq=S, layout=args.layout, attn=args.attn)
+                   max_seq=S, layout=args.layout, attn=args.attn,
+                   dtype=policy.model_dtype)
 
     # zigzag layout: permute the GLOBAL sequence once on the host; each
     # rank then owns balanced front+back chunks of the causal triangle
