@@ -303,7 +303,8 @@ def make_train_step(loss_fn: Callable, optimizer, policy: Policy,
                     grad_average_axis: Optional[str] = None,
                     gradient_predivide_factor: float = 1.0,
                     grad_average_mask=None,
-                    overflow_sync_axes=None):
+                    overflow_sync_axes=None,
+                    grad_fn: Optional[Callable] = None):
     """Build ``(init_fn, step_fn)`` implementing the apex iteration (§4.2 of
     the survey) as one jitted function.
 
@@ -342,7 +343,22 @@ def make_train_step(loss_fn: Callable, optimizer, policy: Policy,
     Skip-on-overflow matches apex: the optimizer state does NOT advance on a
     skipped step (apex/amp/_process_optimizer.py skips ``optimizer.step``
     entirely), and the loss scale halves via the scaler schedule.
+
+    ``grad_fn``: custom loss+gradient producer replacing the internal
+    ``jax.grad`` — the composition point for hand-scheduled backward passes
+    (pipeline 1F1B). Signature
+    ``grad_fn(params, batch, loss_scale) -> (loss, grads)`` where ``loss``
+    is the UNSCALED scalar and ``grads`` are SCALED by ``loss_scale``
+    (exactly what ``forward_backward_1f1b(..., loss_scale=...)`` returns) —
+    everything downstream (grad averaging, unscale, found_inf skip-step,
+    master-weight copy, scaler schedule) applies unchanged. When given,
+    ``loss_fn`` is ignored and may be None; incompatible with ``has_aux``
+    and ``with_model_state``.
     """
+    if grad_fn is not None and (has_aux or with_model_state):
+        raise ValueError("grad_fn is incompatible with has_aux/"
+                         "with_model_state — the custom producer returns "
+                         "only (loss, grads)")
 
     def init_fn(params, model_state=None):
         params32 = jax.tree_util.tree_map(
@@ -394,8 +410,13 @@ def make_train_step(loss_fn: Callable, optimizer, policy: Policy,
         # (matmul/conv) drop to half — the trace-time equivalent of apex's
         # table-driven call-site patches (amp/lists/, SURVEY P6).
         with autocast(policy):
-            grads, (loss, aux, new_model_state) = jax.grad(
-                scaled_loss_fn, has_aux=True)(state.params)
+            if grad_fn is not None:
+                loss, grads = grad_fn(state.params, batch,
+                                      scaler.loss_scale)
+                aux, new_model_state = None, None
+            else:
+                grads, (loss, aux, new_model_state) = jax.grad(
+                    scaled_loss_fn, has_aux=True)(state.params)
         if grad_average_axis is not None:
             # the reported loss is the global-batch mean, not one shard's
             # local value (the reference recipe all-reduces its metrics:

@@ -57,6 +57,21 @@ def parse_args(argv=None):
     p.add_argument("--deterministic", action="store_true")
     p.add_argument("--remat", action="store_true",
                    help="activation checkpointing per block (memory lever)")
+    # ---- model-parallel tier (SURVEY P22-P24): dp x tp x pp over a
+    # ('data','pipe','model') mesh; any value > 1 selects the parallel path
+    p.add_argument("--data-parallel", type=int, default=1, metavar="DP",
+                   help="data-parallel ranks (DDP grad psum)")
+    p.add_argument("--tensor-parallel", type=int, default=1, metavar="TP",
+                   help="Megatron TP: QKV/MLP column+row parallel")
+    p.add_argument("--pipeline-parallel", type=int, default=1, metavar="PP",
+                   help="pipeline stages, hand-scheduled 1F1B when > 1")
+    p.add_argument("--virtual-pipeline", type=int, default=1, metavar="VPP",
+                   help="virtual chunks per stage (interleaved 1F1B)")
+    p.add_argument("--microbatches", type=int, default=None,
+                   help="pipeline microbatches (default 2*pp)")
+    p.add_argument("--layers", type=int, default=None,
+                   help="override the size preset's layer count (parallel "
+                        "path; must divide by pp*vpp)")
     return p.parse_args(argv)
 
 
@@ -67,11 +82,355 @@ def synthetic_tokens(rng, batch, seq_len, vocab):
     return jax.random.categorical(rng, logits, shape=(batch, seq_len + 1))
 
 
+# --------------------------------------------------------------------------
+# Model-parallel tier: Megatron-composed LM over a (data, pipe, model) mesh.
+#
+# Reference composition (SURVEY P22-P24, §4.5): Megatron trainers drive
+# apex's ColumnParallelLinear/RowParallelLinear (TP) and the 1F1B pipeline
+# schedules through a training loop with amp O2 master weights + the dynamic
+# loss scaler. This is that loop, TPU-first: blocks pipelined with the
+# hand-scheduled collective-permute 1F1B (O(pp) activation memory), QKV/MLP
+# column+row-parallel over 'model', DDP as one grad psum over 'data',
+# embedding/head replicated with grads completed via the 1F1B
+# input-cotangent / loss-param hooks, all inside ONE jitted train step built
+# by amp.make_train_step(grad_fn=...) — unscale -> found_inf -> skip/step ->
+# master->model copy semantics identical to the single-chip path.
+# --------------------------------------------------------------------------
+
+def build_parallel_lm(args, policy):
+    """Build (mesh, state, jit_step, batch_shape) for the dp x tp x pp LM.
+
+    Returns a jitted ``step(state, tokens) -> (state, metrics)`` already
+    shard_mapped over the mesh; ``tokens`` is the GLOBAL int32 batch
+    ``[B, seq_len+1]``, sharded over 'data' by the step itself.
+    """
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    from apex_tpu import comm
+    from apex_tpu.kernels.layer_norm import layer_norm
+    from apex_tpu.models.transformer_lm import _LM_SIZES
+    from apex_tpu.transformer import pipeline_parallel as pp_mod
+    from apex_tpu.transformer.tensor_parallel.layers import (
+        ColumnParallelLinear, RowParallelLinear)
+
+    dp, tp = args.data_parallel, args.tensor_parallel
+    pp, vpp = args.pipeline_parallel, args.virtual_pipeline
+    hidden, layers, heads = _LM_SIZES[args.size]
+    if args.layers:
+        layers = args.layers
+    L = pp * vpp
+    if layers % L:
+        raise SystemExit(f"--size {args.size} has {layers} layers; needs "
+                         f"layers % (pp*vpp) == 0, got pp*vpp={L}")
+    if vpp > 1 and pp == 1:
+        raise SystemExit("--virtual-pipeline needs --pipeline-parallel > 1")
+    if heads % tp:
+        raise SystemExit(f"heads {heads} must divide by tp {tp}")
+    if hidden % heads:
+        raise SystemExit(f"hidden {hidden} must divide by heads {heads}")
+    per_stage = layers // L
+    H, V, S = hidden, args.vocab_size, args.seq_len
+    inner = 4 * H
+    M = args.microbatches or 2 * pp
+    B = args.batch_size
+    if B % dp or (B // dp) % M:
+        raise SystemExit(f"batch {B} must divide by dp*microbatches "
+                         f"({dp}*{M})")
+    n_dev = dp * pp * tp
+    devices = comm.ensure_devices(n_dev)
+    mesh = Mesh(np.array(devices[:n_dev]).reshape(dp, pp, tp),
+                ("data", "pipe", "model"))
+
+    h_local, d_head = heads // tp, H // heads
+    mdt = policy.model_dtype  # thread into the TP modules (ADVICE round-2)
+    col_qkv = ColumnParallelLinear(input_size=H, output_size=3 * H,
+                                   use_bias=False, world_size=tp, dtype=mdt)
+    row_proj = RowParallelLinear(input_size=H, output_size=H, use_bias=True,
+                                 input_is_parallel=True, world_size=tp,
+                                 dtype=mdt)
+    col_mlp = ColumnParallelLinear(input_size=H, output_size=inner,
+                                   use_bias=False, world_size=tp, dtype=mdt)
+    row_mlp = RowParallelLinear(input_size=inner, output_size=H,
+                                use_bias=True, input_is_parallel=True,
+                                world_size=tp, dtype=mdt)
+
+    # ---- parameters. TP-sharded leaves ("col") carry an explicit model-
+    # shard dim [L, tp, per_stage, ...] so the HOST holds the full weight
+    # and shard_map hands each (pipe, model) rank its own block — the
+    # functional analogue of the reference's _initialize_affine_weight_gpu
+    # scatter (the full weight is drawn in canonical layout and split, so
+    # the same seed yields the same MATH at every dp/tp/pp — testable
+    # against the 1-device configuration). Replicated-per-stage leaves
+    # ("rep") are [L, per_stage, ...].
+    def init_params(rng):
+        def nrm(k, shape, std):
+            return (jax.random.normal(k, shape) * std).astype(jnp.float32)
+
+        ks = iter(jax.random.split(rng, 8))
+        # canonical full weights; head dim layout [3, heads, d_head]
+        qkv_full = nrm(next(ks), (L, per_stage, H, 3, heads, d_head), 0.02)
+        proj_full = nrm(next(ks), (L, per_stage, heads, d_head, H), 0.02)
+        mlp_in_full = nrm(next(ks), (L, per_stage, H, inner), 0.02)
+        mlp_out_full = nrm(next(ks), (L, per_stage, inner, H), 0.02)
+        col = {
+            # rank r owns heads [r*h_local, (r+1)*h_local)
+            "qkv_k": jnp.stack(
+                [qkv_full[:, :, :, :, r * h_local:(r + 1) * h_local]
+                 .reshape(L, per_stage, H, 3 * H // tp)
+                 for r in range(tp)], axis=1),
+            "proj_k": jnp.stack(
+                [proj_full[:, :, r * h_local:(r + 1) * h_local]
+                 .reshape(L, per_stage, H // tp, H)
+                 for r in range(tp)], axis=1),
+            "mlp_in_k": jnp.stack(
+                [mlp_in_full[..., r * (inner // tp):(r + 1) * (inner // tp)]
+                 for r in range(tp)], axis=1),
+            "mlp_out_k": jnp.stack(
+                [mlp_out_full[:, :, r * (inner // tp):(r + 1) * (inner // tp)]
+                 for r in range(tp)], axis=1),
+        }
+        rep = {
+            "ln1_s": jnp.ones((L, per_stage, H)),
+            "ln1_b": jnp.zeros((L, per_stage, H)),
+            "ln2_s": jnp.ones((L, per_stage, H)),
+            "ln2_b": jnp.zeros((L, per_stage, H)),
+            "proj_b": jnp.zeros((L, per_stage, H)),
+            "mlp_out_b": jnp.zeros((L, per_stage, H)),
+        }
+        emb = {"wte": nrm(next(ks), (V, H), 0.02),
+               "wpe": nrm(next(ks), (S, H), 0.01)}
+        head = {"ln_s": jnp.ones((H,)), "ln_b": jnp.zeros((H,)),
+                "kernel": nrm(next(ks), (H, V), 0.02)}
+        return {"emb": emb, "stages": {"col": col, "rep": rep},
+                "head": head}
+
+    # rank-major pipe layout: global row r*vpp + c holds logical stage
+    # c*pp + r (build_model's round-robin split)
+    order = np.asarray([c * pp + r for r in range(pp) for c in range(vpp)])
+
+    def block_fn(bp, x):
+        mb, s, _ = x.shape
+        cdt = x.dtype
+        h = layer_norm(x.reshape(-1, H), bp["rep"]["ln1_s"],
+                       bp["rep"]["ln1_b"]).reshape(x.shape).astype(cdt)
+        qkv = col_qkv.apply({"params": {"kernel": bp["col"]["qkv_k"]}}, h)
+        qkv = qkv.reshape(mb, s, 3, h_local, d_head)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        att = jnp.einsum("bqhd,bkhd->bhqk", q, k) / float(np.sqrt(d_head))
+        causal = jnp.tril(jnp.ones((s, s), bool))
+        att = jnp.where(causal, jnp.asarray(att, jnp.float32), -jnp.inf)
+        att = jax.nn.softmax(att, axis=-1).astype(cdt)  # fp32 softmax (O1 rule)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", att, v).reshape(
+            mb, s, h_local * d_head)
+        x = x + row_proj.apply(
+            {"params": {"kernel": bp["col"]["proj_k"],
+                        "bias": bp["rep"]["proj_b"]}}, ctx).astype(cdt)
+        h = layer_norm(x.reshape(-1, H), bp["rep"]["ln2_s"],
+                       bp["rep"]["ln2_b"]).reshape(x.shape).astype(cdt)
+        h = col_mlp.apply({"params": {"kernel": bp["col"]["mlp_in_k"]}}, h)
+        h = jax.nn.gelu(jnp.asarray(h, jnp.float32),
+                        approximate=False).astype(cdt)
+        h = row_mlp.apply({"params": {"kernel": bp["col"]["mlp_out_k"],
+                                      "bias": bp["rep"]["mlp_out_b"]}}, h)
+        return (x + h.astype(cdt)).astype(cdt)
+
+    def stage_fn(sp, x):
+        for i in range(per_stage):
+            bp = jax.tree_util.tree_map(lambda l: l[i], sp)
+            x = block_fn(bp, x)
+        return x
+
+    def lm_loss(y, tgt, head):
+        hh = layer_norm(y.reshape(-1, H), head["ln_s"], head["ln_b"])
+        logits = jnp.dot(jnp.asarray(hh, y.dtype),
+                         jnp.asarray(head["kernel"], y.dtype))
+        losses = softmax_cross_entropy_loss(
+            jnp.asarray(logits, jnp.float32), tgt.reshape(-1),
+            smoothing=args.smoothing)
+        return losses.mean()
+
+    cdtype = policy.compute_dtype
+
+    def grad_fn(params, batch, loss_scale):
+        tokens = batch                               # [B/dp, S+1] int32
+        inp = tokens[:, :-1].reshape(M, -1, S)
+        tgt = tokens[:, 1:].reshape(M, -1, S)
+
+        def embed(ep):
+            x = jnp.asarray(ep["wte"], cdtype)[inp] \
+                + jnp.asarray(ep["wpe"], cdtype)[None, None]
+            return x                                  # [M, mb, S, H]
+
+        # strip the model-shard dim shard_map left on the col leaves
+        sp_local = {"col": jax.tree_util.tree_map(lambda l: l[:, 0],
+                                                  params["stages"]["col"]),
+                    "rep": params["stages"]["rep"]}
+        if vpp == 1:
+            sp_local = jax.tree_util.tree_map(lambda l: l[0], sp_local)
+
+        if pp == 1:
+            # TP-only (no pipe axis): reference fwd_bwd_no_pipelining —
+            # grad accumulation over the microbatch stream
+            def mb_loss_fn(p3, mb_tokens, t3):
+                x = jnp.asarray(p3["emb"]["wte"], cdtype)[mb_tokens] \
+                    + jnp.asarray(p3["emb"]["wpe"], cdtype)[None]
+                return lm_loss(stage_fn(p3["sp"], x), t3, p3["head"])
+
+            loss, g3 = pp_mod.forward_backward_no_pipelining(
+                mb_loss_fn,
+                {"emb": params["emb"], "sp": sp_local,
+                 "head": params["head"]},
+                inp, tgt)
+            g3 = jax.tree_util.tree_map(
+                lambda g: g * jnp.asarray(loss_scale, g.dtype), g3)
+            sgrads = g3["sp"]
+            if vpp == 1:
+                sgrads = jax.tree_util.tree_map(lambda g: g[None], sgrads)
+            return loss, {
+                "emb": g3["emb"],
+                "stages": {"col": jax.tree_util.tree_map(
+                    lambda g: g[:, None], sgrads["col"]),
+                    "rep": sgrads["rep"]},
+                "head": g3["head"],
+            }
+
+        x_stream, emb_vjp = jax.vjp(embed, params["emb"])
+        loss, sgrads, aux = pp_mod.forward_backward_1f1b(
+            stage_fn, lm_loss, sp_local, x_stream, tgt,
+            num_stages=pp, num_chunks=vpp, loss_scale=loss_scale,
+            loss_params=params["head"], return_input_cotangents=True)
+        if vpp == 1:
+            sgrads = jax.tree_util.tree_map(lambda g: g[None], sgrads)
+        (demb,) = emb_vjp(jnp.asarray(aux["input_cotangents"],
+                                      x_stream.dtype))
+        return loss, {
+            "emb": demb,
+            "stages": {"col": jax.tree_util.tree_map(lambda g: g[:, None],
+                                                     sgrads["col"]),
+                       "rep": sgrads["rep"]},
+            "head": aux["loss_param_grads"],
+        }
+
+    optimizer = fused_adam(args.lr, weight_decay=args.weight_decay,
+                           adam_w_mode=True)
+    # stage/col leaves are shard-local to pipe/model: their infs never ride
+    # a grad psum, so found_inf must sync explicitly (make_train_step docs)
+    sync = tuple(ax for ax, size in (("pipe", pp), ("model", tp))
+                 if size > 1) or None
+    init_fn, step_fn = amp.make_train_step(
+        None, optimizer, policy, grad_fn=grad_fn,
+        grad_average_axis="data" if dp > 1 else None,
+        overflow_sync_axes=sync)
+
+    params = init_params(jax.random.PRNGKey(args.seed))
+    params["stages"] = jax.tree_util.tree_map(
+        lambda l: l[order], params["stages"])
+
+    def _keys(path):
+        return [getattr(k, "key", getattr(k, "name", None)) for k in path]
+
+    def param_spec(path, _leaf):
+        keys = _keys(path)
+        if "col" in keys:
+            return P("pipe", "model")
+        if "stages" in keys:
+            return P("pipe")
+        return P()
+
+    pspec = jax.tree_util.tree_map_with_path(param_spec, params)
+
+    # Per-rank local param shapes → the amp state (masters, scaler, and
+    # fused_adam's FLAT m/v superbuffers) must be created INSIDE shard_map
+    # so each rank's optimizer state covers exactly its own shards.
+    def local_struct(path, l):
+        keys = _keys(path)
+        shape = list(l.shape)
+        if "col" in keys:
+            shape[0] //= pp
+            shape[1] //= tp
+        elif "stages" in keys:
+            shape[0] //= pp
+        return jax.ShapeDtypeStruct(tuple(shape), l.dtype)
+
+    local_params = jax.tree_util.tree_map_with_path(local_struct, params)
+    local_float = sum(int(np.prod(s.shape))
+                      for s in jax.tree_util.tree_leaves(local_params))
+    state_shapes = jax.eval_shape(init_fn, local_params)
+
+    def state_spec(path, sds):
+        keys = _keys(path)
+        if "col" in keys:
+            return P("pipe", "model")
+        if "stages" in keys:
+            return P("pipe")
+        if len(sds.shape) == 1 and int(np.prod(sds.shape)) == local_float:
+            # flat superbuffer (fused_adam m/v): rank-local, stacked over
+            # the (pipe, model) product on the global axis
+            return P(("pipe", "model"))
+        return P()
+
+    sspec = jax.tree_util.tree_map_with_path(state_spec, state_shapes)
+    sharded_init = jax.jit(shard_map(init_fn, mesh=mesh, in_specs=(pspec,),
+                                     out_specs=sspec, check_rep=False))
+    state = sharded_init(params)
+
+    sharded = shard_map(step_fn, mesh=mesh,
+                        in_specs=(sspec, P("data")),
+                        out_specs=(sspec, P()), check_rep=False)
+    jit_step = jax.jit(sharded, donate_argnums=(0,))
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(params))
+    return mesh, state, jit_step, n_params
+
+
+def run_parallel(args, policy):
+    if args.data:
+        raise SystemExit("--data is not supported on the model-parallel "
+                         "path yet; drop it or run single-chip")
+    if args.remat:
+        raise SystemExit("--remat is not supported on the model-parallel "
+                         "path (the 1F1B schedule already recomputes "
+                         "in-backward); drop the flag")
+    mesh, state, jit_step, n_params = build_parallel_lm(args, policy)
+    print(f"=> LM {args.size} dp={args.data_parallel} "
+          f"tp={args.tensor_parallel} pp={args.pipeline_parallel} "
+          f"vpp={args.virtual_pipeline}, params: {n_params:,}")
+    rng = jax.random.PRNGKey(args.seed)
+    t0, toks, metrics = None, 0, None
+    with mesh:
+        for it in range(args.iters):
+            rng, sub = jax.random.split(rng)
+            if args.deterministic:
+                sub = jax.random.PRNGKey(it)
+            batch = synthetic_tokens(sub, args.batch_size, args.seq_len,
+                                     args.vocab_size)
+            state, metrics = jit_step(state, batch)
+            if it == 2:
+                metrics["loss"].block_until_ready()
+                t0 = time.perf_counter()
+                toks = 0
+            toks += args.batch_size * args.seq_len
+            if it % 10 == 0 or it == args.iters - 1:
+                print(f"[{it}/{args.iters}] loss "
+                      f"{float(metrics['loss']):.4f} loss_scale "
+                      f"{float(metrics['loss_scale']):g}")
+    jax.tree_util.tree_leaves(state.params)[0].block_until_ready()
+    if t0 is not None and args.iters > 3:
+        dt = time.perf_counter() - t0
+        print(f"throughput: "
+              f"{(toks - args.batch_size * args.seq_len) / dt:,.0f} tokens/s")
+    return metrics
+
+
 def main(argv=None):
     args = parse_args(argv)
     policy = amp.resolve_policy(opt_level=args.opt_level,
                                 loss_scale=args.loss_scale)
     print(policy.banner())
+    if (args.data_parallel * args.tensor_parallel
+            * args.pipeline_parallel * args.virtual_pipeline) > 1:
+        return run_parallel(args, policy)
 
     model = create_lm(args.size, vocab_size=args.vocab_size,
                       max_seq_len=args.seq_len, remat=args.remat,
