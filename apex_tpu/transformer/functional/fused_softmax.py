@@ -5,11 +5,12 @@ FusedScaleMaskSoftmax dispatches between the megatron CUDA kernels
 (scaled_masked_softmax_cuda, scaled_upper_triang_masked_softmax_cuda; csrc/
 megatron/scaled_masked_softmax.h) and a torch fallback, by dtype/shape limits.
 
-TPU design: the causal variant routes to the Pallas kernel in
-apex_tpu.kernels.causal_softmax (one VMEM pass, iota mask, fp32 math — the
-N8 equivalent) when shapes align, with the jnp composition as fallback; the
-generic-mask variant stays a jnp expression that XLA fuses into the
-surrounding matmuls. Kernel semantics are kept either way (half I/O allowed,
+TPU design: BOTH N8 kernels are Pallas. The causal variant routes to
+apex_tpu.kernels.causal_softmax (k-chunk triangular compute skip, fp32
+math) and the generic-mask variant to apex_tpu.kernels.masked_softmax
+(mask tile in VMEM, broadcast folded into the block index map) when
+shapes align, with the jnp composition as fallback (which XLA fuses into
+the surrounding matmuls). Kernel semantics are kept either way (half I/O allowed,
 softmax math in fp32 when softmax_in_fp32, additive -10000 masking for the
 padding mask, strict upper-triangular causal mask). The module class keeps
 the reference's constructor surface so Megatron-style blocks port unchanged.
@@ -41,7 +42,14 @@ def _softmax_fp32(x, out_dtype):
 def scaled_masked_softmax(x, mask, scale: float = 1.0,
                           softmax_in_fp32: bool = True):
     """x: [..., sq, sk]; mask: broadcastable bool (True = masked out).
-    Reference kernel: scaled_masked_softmax_warp_forward."""
+    Reference kernel: scaled_masked_softmax_warp_forward. Dispatches to
+    the Pallas masked-softmax kernel when softmax_in_fp32 (the kernel's
+    only mode, matching the CUDA kernel's fp32 accumulation); the kernel
+    itself falls back to the jnp composition on unaligned shapes or
+    non-prefix mask broadcasts."""
+    if softmax_in_fp32 and mask is not None:
+        from apex_tpu.kernels.masked_softmax import masked_softmax
+        return masked_softmax(x, jnp.asarray(mask, jnp.bool_), scale)
     out_dtype = x.dtype
     x = jnp.asarray(x, jnp.float32) * scale
     if mask is not None:

@@ -89,7 +89,8 @@ def synthetic_tokens(rng, batch, seq_len, vocab):
 # apex's ColumnParallelLinear/RowParallelLinear (TP) and the 1F1B pipeline
 # schedules through a training loop with amp O2 master weights + the dynamic
 # loss scaler. This is that loop, TPU-first: blocks pipelined with the
-# hand-scheduled collective-permute 1F1B (O(pp) activation memory), QKV/MLP
+# hand-scheduled collective-permute 1F1B (activation memory flat in the
+# microbatch count; in-flight bound in schedules.forward_backward_1f1b), QKV/MLP
 # column+row-parallel over 'model', DDP as one grad psum over 'data',
 # embedding/head replicated with grads completed via the 1F1B
 # input-cotangent / loss-param hooks, all inside ONE jitted train step built
@@ -98,7 +99,7 @@ def synthetic_tokens(rng, batch, seq_len, vocab):
 # --------------------------------------------------------------------------
 
 def build_parallel_lm(args, policy):
-    """Build (mesh, state, jit_step, batch_shape) for the dp x tp x pp LM.
+    """Build (mesh, state, jit_step, n_params) for the dp x tp x pp LM.
 
     Returns a jitted ``step(state, tokens) -> (state, metrics)`` already
     shard_mapped over the mesh; ``tokens`` is the GLOBAL int32 batch
@@ -217,10 +218,13 @@ def build_parallel_lm(args, policy):
         qkv = col_qkv.apply({"params": {"kernel": bp["col"]["qkv_k"]}}, h)
         qkv = qkv.reshape(mb, s, 3, h_local, d_head)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        att = jnp.einsum("bqhd,bkhd->bhqk", q, k) / float(np.sqrt(d_head))
-        causal = jnp.tril(jnp.ones((s, s), bool))
-        att = jnp.where(causal, jnp.asarray(att, jnp.float32), -jnp.inf)
-        att = jax.nn.softmax(att, axis=-1).astype(cdt)  # fp32 softmax (O1 rule)
+        att = jnp.einsum("bqhd,bkhd->bhqk", q, k)
+        # N8 fused path: scale+causal-mask+softmax in one Pallas pass
+        # (fp32 math, half I/O), jnp fallback on unaligned shapes
+        from apex_tpu.transformer.functional.fused_softmax import (
+            scaled_upper_triang_masked_softmax)
+        att = scaled_upper_triang_masked_softmax(
+            att, scale=float(1.0 / np.sqrt(d_head))).astype(cdt)
         ctx = jnp.einsum("bhqk,bkhd->bqhd", att, v).reshape(
             mb, s, h_local * d_head)
         x = x + row_proj.apply(

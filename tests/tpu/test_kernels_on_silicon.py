@@ -195,6 +195,27 @@ def test_causal_softmax(tpu_backend):
     _close(gk, gr, 1e-4)
 
 
+# ------------------------------------------------------ masked softmax
+def test_masked_softmax(tpu_backend):
+    """N8's arbitrary-mask kernel (round 3): compiled Mosaic lowering vs
+    the fp32 oracle, incl. the [b, 1, sq, sk] head-broadcast mask."""
+    from apex_tpu.kernels.masked_softmax import (masked_softmax,
+                                                 masked_softmax_reference)
+
+    b, h, sq, sk = 2, 4, 128, 256
+    x = jax.random.normal(jax.random.PRNGKey(11), (b, h, sq, sk),
+                          jnp.float32) * 3.0
+    m = jax.random.bernoulli(jax.random.PRNGKey(12), 0.3,
+                             (b, 1, sq, sk)).at[..., 0].set(False)
+    _close(jax.jit(lambda x: masked_softmax(x, m, 0.5))(x),
+           masked_softmax_reference(x, m, 0.5), 1e-5)
+    gk = jax.jit(jax.grad(lambda x: jnp.sum(jnp.sin(
+        masked_softmax(x, m) * 3))))(x)
+    gr = jax.grad(lambda x: jnp.sum(jnp.sin(
+        masked_softmax_reference(x, m) * 3)))(x)
+    _close(gk, gr, 1e-4)
+
+
 # ---------------------------------------------------------- group norm
 @pytest.mark.parametrize("act", [None, "silu"])
 def test_group_norm_fwd_bwd(tpu_backend, act):
