@@ -139,7 +139,7 @@ def _bwd_kernel(p_ref, g_ref, out_ref, *, scale, bq, bk):
 def _block_q(sq, sk):
     # fp32 row block + exp scratch + output + chunk temporaries
     return vmem.block_rows(sq, row_bytes=4 * sk, n_bufs=5, max_rows=128,
-                           divisor_of=sq)
+                           divisor_of=sq, key="causal_softmax.block_q")
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))

@@ -30,6 +30,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from apex_tpu.kernels import vmem
+
 __all__ = ["flash_attention", "mha_reference", "attn_chunk_fwd",
            "attn_chunk_bwd"]
 
@@ -847,8 +849,8 @@ def flash_attention(q, k, v, *, causal: bool = False,
                     bias: Optional[jnp.ndarray] = None,
                     dropout_rate: float = 0.0,
                     dropout_seed=None,
-                    block_q: int = DEFAULT_BLOCK_Q,
-                    block_k: int = DEFAULT_BLOCK_K,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     interpret: bool = False):
     """Fused attention. q,k,v: [batch, heads, seq, head_dim].
 
@@ -886,6 +888,12 @@ def flash_attention(q, k, v, *, causal: bool = False,
     # validated on EVERY path: the jnp fallback must reject exactly what the
     # Pallas path rejects, or aligned shapes would crash where unaligned ran
     _validate_bias(bias, q.shape[0], q.shape[1], sq, sk)
+    if block_q is None:
+        block_q = vmem.get_override("flash.block_q", DEFAULT_BLOCK_Q,
+                                    multiple=8)
+    if block_k is None:
+        block_k = vmem.get_override("flash.block_k", DEFAULT_BLOCK_K,
+                                    multiple=8)
     bq = min(block_q, sq)
     bk = min(block_k, sk)
     if jax.default_backend() == "cpu":

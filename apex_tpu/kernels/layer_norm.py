@@ -34,7 +34,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 def _block_rows(n_rows: int, hidden: int, n_bufs: int) -> int:
     # shared scoped-VMEM budget heuristic (kernels/vmem.py) clamps to n_rows
-    return vmem.block_rows(n_rows, row_bytes=4 * hidden, n_bufs=n_bufs)
+    return vmem.block_rows(n_rows, row_bytes=4 * hidden, n_bufs=n_bufs,
+                           key="layer_norm.block_rows")
 
 
 def _pallas_ok(n: int, h: int) -> bool:

@@ -71,7 +71,7 @@ def _bwd_kernel(p_ref, g_ref, out_ref, *, scale):
 def _block_q(sq, sk):
     # fp32 row block + mask tile + ~3 temporaries
     return vmem.block_rows(sq, row_bytes=4 * sk, n_bufs=5, max_rows=128,
-                           divisor_of=sq)
+                           divisor_of=sq, key="masked_softmax.block_q")
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))

@@ -47,7 +47,8 @@ def _as_rows(flat):
 
 
 def _grid_rows(rows):
-    bm = min(_BLOCK_ROWS, rows)
+    bm = min(_vmem.get_override("multi_tensor.block_rows", _BLOCK_ROWS,
+                                multiple=8), rows)
     rows_p = -(-rows // bm) * bm
     return bm, rows_p, rows_p // bm
 

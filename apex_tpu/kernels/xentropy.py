@@ -91,7 +91,7 @@ def _block_rows(n, v):
     # fp32 logits block + ~3 same-size temporaries (exp, iota/onehot,
     # output); shared scoped-VMEM budget lives in kernels/vmem.py
     return vmem.block_rows(n, row_bytes=4 * v, n_bufs=4, max_rows=128,
-                           divisor_of=n)
+                           divisor_of=n, key="xentropy.block_rows")
 
 
 def _xent_fwd(logits, labels, smoothing, interpret):

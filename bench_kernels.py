@@ -247,7 +247,120 @@ SUITES = {"flash": bench_flash, "ln": bench_ln, "xentropy": bench_xentropy,
           "group_norm": bench_group_norm}
 
 
+# ------------------------------------------------------------------ sweep
+# Block-shape sweep (VERDICT round-2 item 4): per kernel, time each
+# candidate block config on THIS device and emit the best as a tuned-
+# overrides JSON consumable by apex_tpu.kernels.vmem.load_overrides /
+# APEX_TPU_TUNED. On the axon emulator the ranking carries no signal
+# (dispatch-dominated; each row self-flags) — the harness exists so the
+# first real-silicon session is one command + one file.
+
+def _sweep_knob(results, key, candidates, measure):
+    """Time ``measure()`` under each override value; record the best."""
+    from apex_tpu.kernels import vmem
+
+    best_v, best_ms = None, float("inf")
+    for v in candidates:
+        vmem.set_override(key, v)
+        # overrides are read at TRACE time; jit caches key on function
+        # identity + avals, so a reused callable (e.g. layer_norm itself)
+        # would silently time the first candidate's trace for all values
+        jax.clear_caches()
+        try:
+            ms = measure()
+        except Exception as e:  # a config Mosaic rejects is a data point
+            print(json.dumps({"sweep": key, "value": v,
+                              "error": str(e)[:120]}), flush=True)
+            continue
+        finally:
+            vmem.remove_override(key)  # other pinned knobs stay
+        print(json.dumps({"sweep": key, "value": v, "ms": round(ms, 3)}),
+              flush=True)
+        if ms < best_ms:
+            best_v, best_ms = v, ms
+    if best_v is not None:
+        results[key] = best_v
+
+
+def sweep(out_path="tuned_blocks.json"):
+    from apex_tpu.kernels import vmem
+
+    results = {}
+
+    # flash attention q/k blocks at the LM shape
+    from apex_tpu.kernels.flash_attention import flash_attention
+    b, h, s, d = 4, 8, 2048, 128
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (b, h, s, d), jnp.bfloat16)
+               for kk in ks)
+
+    def flash_ms():
+        return timeit(lambda q, k, v: flash_attention(q, k, v, causal=True),
+                      q, k, v)
+
+    _sweep_knob(results, "flash.block_q", (64, 128, 256), flash_ms)
+    if "flash.block_q" in results:
+        vmem.set_override("flash.block_q", results["flash.block_q"])
+    _sweep_knob(results, "flash.block_k", (64, 128, 256), flash_ms)
+    vmem.clear_overrides()
+
+    # layer norm row block
+    from apex_tpu.kernels.layer_norm import layer_norm
+    x = jax.random.normal(jax.random.PRNGKey(1), (8192, 4096), jnp.bfloat16)
+    w, bb = jnp.ones((4096,)), jnp.zeros((4096,))
+    _sweep_knob(results, "layer_norm.block_rows", (8, 16, 32, 64, 128),
+                lambda: timeit(layer_norm, x, w, bb))
+
+    # xentropy row block (vocab-heavy rows)
+    from apex_tpu.kernels.xentropy import softmax_cross_entropy_loss
+    logits = jax.random.normal(jax.random.PRNGKey(2), (4096, 32768),
+                               jnp.bfloat16)
+    labels = jax.random.randint(jax.random.PRNGKey(3), (4096,), 0, 32768)
+    _sweep_knob(results, "xentropy.block_rows", (8, 16, 32, 64),
+                lambda: timeit(
+                    lambda l: softmax_cross_entropy_loss(l, labels), logits))
+
+    # multi-tensor superbuffer rows
+    from apex_tpu.optimizers.fused_adam import fused_adam
+    import optax
+    leaves = {f"w{i}": jax.random.normal(jax.random.PRNGKey(i),
+                                         (1024, 1528), jnp.float32)
+              for i in range(20)}
+    grads = jax.tree_util.tree_map(
+        lambda p: jnp.full(p.shape, 1e-3, p.dtype), leaves)
+    tx = fused_adam(1e-3, weight_decay=0.01)
+    st = tx.init(leaves)
+
+    def adam_ms():
+        def step(p, s):
+            u, s2 = tx.update(grads, s, p)
+            return optax.apply_updates(p, u), s2
+        return timeit(step, leaves, st)
+
+    _sweep_knob(results, "multi_tensor.block_rows", (256, 512, 1024, 2048),
+                adam_ms)
+
+    # causal softmax q block
+    from apex_tpu.kernels.causal_softmax import causal_softmax
+    xs = jax.random.normal(jax.random.PRNGKey(4), (8, 2048, 2048),
+                           jnp.bfloat16)
+    _sweep_knob(results, "causal_softmax.block_q", (8, 16, 32, 64, 128),
+                lambda: timeit(
+                    functools.partial(causal_softmax, scale=0.125), xs))
+
+    with open(out_path, "w") as f:
+        json.dump(results, f, indent=1, sort_keys=True)
+    print(json.dumps({"sweep_best": results, "written": out_path}),
+          flush=True)
+
+
 def main(argv):
+    if argv and argv[0] == "--sweep":
+        out = argv[1] if len(argv) > 1 else "tuned_blocks.json"
+        print(json.dumps({"device": str(jax.devices()[0]),
+                          "backend": jax.default_backend()}), flush=True)
+        sweep(out)
+        return
     names = argv or list(SUITES)
     print(json.dumps({"device": str(jax.devices()[0]),
                       "backend": jax.default_backend()}), flush=True)

@@ -1,0 +1,137 @@
+"""Tuned-block override registry (bench_kernels --sweep consumer).
+
+The sweep harness discovers per-kernel block sizes on real silicon and
+writes a JSON; vmem.load_overrides / APEX_TPU_TUNED apply it. Correctness
+must be block-size-independent: kernels under any override still match
+their oracles (the clamps guarantee a stale file can only cost speed).
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.kernels import vmem
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    vmem.clear_overrides()
+    yield
+    vmem.clear_overrides()
+
+
+def test_registry_roundtrip(tmp_path):
+    vmem.set_override("layer_norm.block_rows", 16)
+    assert vmem.get_override("layer_norm.block_rows", 99) == 16
+    assert vmem.get_override("unknown", 7) == 7
+    assert vmem.get_override(None, 5) == 5
+    vmem.remove_override("layer_norm.block_rows")
+    assert vmem.get_override("layer_norm.block_rows", 99) == 99
+
+    path = tmp_path / "tuned.json"
+    path.write_text(json.dumps({"xentropy.block_rows": 32,
+                                "flash.block_q": 256}))
+    loaded = vmem.load_overrides(str(path))
+    assert loaded == {"xentropy.block_rows": 32, "flash.block_q": 256}
+    assert vmem.get_override("flash.block_q", 128) == 256
+
+
+def test_get_override_alignment_and_cap():
+    vmem.set_override("k", 100)
+    assert vmem.get_override("k", 1, multiple=8) == 96
+    assert vmem.get_override("k", 1, multiple=8, cap=64) == 64
+    vmem.set_override("k", 3)
+    assert vmem.get_override("k", 1, multiple=8) == 8  # floor, never 0
+
+
+def test_block_rows_override_capped_by_vmem_stack():
+    """A tuned value can exceed the heuristic's max_rows preference but not
+    ~4x the conservative budget (the physical scoped-VMEM stack): past
+    that the 'only ever slower, never broken' invariant would fail at a
+    larger shape than the sweep ran at."""
+    row_bytes, n_bufs = 4 * 8192, 4          # budget = 4MB/(32KB*4) = 32
+    vmem.set_override("k", 1 << 20)
+    b = vmem.block_rows(1 << 20, row_bytes=row_bytes, n_bufs=n_bufs,
+                        key="k")
+    assert b <= 4 * (vmem.VMEM_BUDGET_BYTES // (row_bytes * n_bufs))
+
+
+def test_bad_tuned_file_does_not_brick_import(tmp_path):
+    """APEX_TPU_TUNED pointing at a missing or corrupt file must warn, not
+    raise, at import (the env var is set-and-forget in shell profiles)."""
+    import subprocess
+    import sys
+
+    for content in (None, "{not json"):
+        path = tmp_path / "tuned.json"
+        if content is None:
+            env_path = str(tmp_path / "missing.json")
+        else:
+            path.write_text(content)
+            env_path = str(path)
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import apex_tpu.kernels.vmem as v; print(v.overrides())"],
+            capture_output=True, text=True,
+            env={"APEX_TPU_TUNED": env_path, "PATH": "/usr/bin:/bin",
+                 "HOME": "/root", "PYTHONPATH": "/root/repo",
+                 "JAX_PLATFORMS": "cpu"})
+        assert r.returncode == 0, r.stderr[-500:]
+        assert "{}" in r.stdout
+
+
+def test_override_passes_through_clamps():
+    # override larger than the row count clamps to the sublane-padded total
+    vmem.set_override("k", 4096)
+    assert vmem.block_rows(64, row_bytes=4, n_bufs=1, key="k") == 64
+    # and to the divisor constraint
+    assert vmem.block_rows(4096, row_bytes=4, n_bufs=1, divisor_of=24,
+                           key="k") == 8
+    # unaligned override rounds down to the sublane tile
+    vmem.set_override("k", 13)
+    assert vmem.block_rows(4096, row_bytes=4, n_bufs=1, key="k") == 8
+
+
+@pytest.mark.parametrize("block", [8, 32, 128])
+def test_layer_norm_correct_under_any_block(block):
+    from apex_tpu.kernels.layer_norm import layer_norm, layer_norm_reference
+
+    vmem.set_override("layer_norm.block_rows", block)
+    x = jax.random.normal(jax.random.PRNGKey(0), (256, 512))
+    w = jnp.ones((512,)) * 1.3
+    b = jnp.zeros((512,)) + 0.1
+    np.testing.assert_allclose(np.asarray(layer_norm(x, w, b)),
+                               np.asarray(layer_norm_reference(x, w, b)),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("block", [8, 64])
+def test_xentropy_correct_under_any_block(block):
+    from apex_tpu.kernels.xentropy import (softmax_cross_entropy_loss,
+                                           xent_reference)
+
+    vmem.set_override("xentropy.block_rows", block)
+    logits = jax.random.normal(jax.random.PRNGKey(1), (64, 256))
+    labels = jax.random.randint(jax.random.PRNGKey(2), (64,), 0, 256)
+    np.testing.assert_allclose(
+        np.asarray(softmax_cross_entropy_loss(logits, labels)),
+        np.asarray(xent_reference(logits, labels)), rtol=1e-5, atol=1e-5)
+
+
+def test_flash_block_override_used():
+    """flash_attention defaults resolve through the registry (and stay
+    numerically exact)."""
+    from apex_tpu.kernels.flash_attention import (flash_attention,
+                                                  mha_reference)
+
+    vmem.set_override("flash.block_q", 64)
+    vmem.set_override("flash.block_k", 64)
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q, k, v = (jax.random.normal(kk, (1, 2, 256, 128)) for kk in ks)
+    out = flash_attention(q, k, v, causal=True)
+    ref = mha_reference(q, k, v, causal=True, scale=128 ** -0.5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
