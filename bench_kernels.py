@@ -226,6 +226,24 @@ def bench_causal_softmax():
         gbytes=gb)
 
 
+# ---------------------------------------------------------- masked softmax
+def bench_masked_softmax():
+    from apex_tpu.kernels.masked_softmax import (masked_softmax,
+                                                 masked_softmax_reference)
+
+    b, h, sq, sk = 4, 8, 1024, 1024       # BERT-large-ish padded block
+    x = jax.random.normal(jax.random.PRNGKey(6), (b, h, sq, sk),
+                          jnp.bfloat16)
+    m = jax.random.bernoulli(jax.random.PRNGKey(7), 0.3, (b, 1, sq, sk))
+    m = m.at[..., 0].set(False)
+    gb = 2 * b * h * sq * sk * 2 / 1e9 + b * sq * sk / 1e9
+    row("masked_softmax_fwd", f"{b}x{h}x{sq}x{sk} mask b1",
+        timeit(functools.partial(masked_softmax, scale=0.125), x, m),
+        timeit(functools.partial(masked_softmax_reference, scale=0.125),
+               x, m),
+        gbytes=gb)
+
+
 # ------------------------------------------------------------- group norm
 def bench_group_norm():
     from apex_tpu.kernels.group_norm import (group_norm_nhwc,
@@ -244,6 +262,7 @@ def bench_group_norm():
 
 SUITES = {"flash": bench_flash, "ln": bench_ln, "xentropy": bench_xentropy,
           "adam": bench_adam, "causal_softmax": bench_causal_softmax,
+          "masked_softmax": bench_masked_softmax,
           "group_norm": bench_group_norm}
 
 
