@@ -285,7 +285,7 @@ def build_parallel_lm(args, policy):
                 mb_loss_fn,
                 {"emb": params["emb"], "sp": sp_local,
                  "head": params["head"]},
-                inp, tgt)
+                inp, tgt, accum_dtype=jnp.float32)
             g3 = jax.tree_util.tree_map(
                 lambda g: g * jnp.asarray(loss_scale, g.dtype), g3)
             sgrads = g3["sp"]
