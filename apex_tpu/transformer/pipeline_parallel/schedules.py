@@ -215,13 +215,14 @@ def forward_backward_1f1b(stage_fn: Callable, loss_fn: Callable,
 
     ``cotangent_dtype`` (default fp32) is the dtype the boundary cotangent
     is rotated and promoted in: the loss-grad seed enters the ring at full
-    precision and the where/zero masking arithmetic is exact. Each stage's
-    vjp still consumes the cotangent in its OWN output dtype (jax requires
-    tangent dtype == primal dtype), so half-precision stages still round
-    once per stage — what fp32 rotation removes is the second rounding at
-    every device boundary and any range clipping of the scaled seed under
-    fp16. Pass ``None`` to rotate in the activation dtype (round-2
-    behavior, cheapest on ICI bandwidth).
+    precision and the where/zero masking arithmetic is exact. Stage
+    outputs are coerced to the MICROBATCH dtype (the boundary type-
+    stability contract), so each stage's vjp consumes the cotangent in
+    that dtype and half-precision stages still round once per stage —
+    what fp32 rotation removes is the second rounding at every device
+    boundary and any range clipping of the scaled seed under fp16. Pass
+    ``None`` to rotate in the activation dtype (round-2 behavior,
+    cheapest on ICI bandwidth).
 
     In-flight bound: each device holds v FIFOs of depth 2L−1 ≈ 2·v²·pp
     saved microbatch inputs (v=1: 2·pp−1) — a ~2v× constant over the

@@ -67,6 +67,9 @@ def parse_args(argv=None):
                    help="pipeline stages, hand-scheduled 1F1B when > 1")
     p.add_argument("--virtual-pipeline", type=int, default=1, metavar="VPP",
                    help="virtual chunks per stage (interleaved 1F1B)")
+    p.add_argument("--sequence-parallel", action="store_true",
+                   help="Megatron SP: LN/residual activations sharded "
+                        "along sequence over the TP group (needs tp>1)")
     p.add_argument("--microbatches", type=int, default=None,
                    help="pipeline microbatches (default 2*pp)")
     p.add_argument("--layers", type=int, default=None,
@@ -130,6 +133,12 @@ def build_parallel_lm(args, policy):
         raise SystemExit(f"heads {heads} must divide by tp {tp}")
     if hidden % heads:
         raise SystemExit(f"hidden {hidden} must divide by heads {heads}")
+    sp_on = bool(args.sequence_parallel)
+    if sp_on and tp < 2:
+        raise SystemExit("--sequence-parallel needs --tensor-parallel > 1")
+    if sp_on and args.seq_len % tp:
+        raise SystemExit(f"--seq-len {args.seq_len} must divide by tp {tp} "
+                         "under --sequence-parallel")
     per_stage = layers // L
     H, V, S = hidden, args.vocab_size, args.seq_len
     inner = 4 * H
@@ -145,16 +154,24 @@ def build_parallel_lm(args, policy):
 
     h_local, d_head = heads // tp, H // heads
     mdt = policy.model_dtype  # thread into the TP modules (ADVICE round-2)
+    # Under SP the column linears all-gather the sequence (dim 0 — hence
+    # the recipe's seq-first [s, mb, H] activation layout) and the row
+    # linears reduce-scatter it back: the TP allreduce split into its two
+    # halves around the seq-sharded LN/residual region (SURVEY §3.3 SP).
     col_qkv = ColumnParallelLinear(input_size=H, output_size=3 * H,
-                                   use_bias=False, world_size=tp, dtype=mdt)
+                                   use_bias=False, world_size=tp, dtype=mdt,
+                                   sequence_parallel_enabled=sp_on)
     row_proj = RowParallelLinear(input_size=H, output_size=H, use_bias=True,
                                  input_is_parallel=True, world_size=tp,
-                                 dtype=mdt)
+                                 dtype=mdt,
+                                 sequence_parallel_enabled=sp_on)
     col_mlp = ColumnParallelLinear(input_size=H, output_size=inner,
-                                   use_bias=False, world_size=tp, dtype=mdt)
+                                   use_bias=False, world_size=tp, dtype=mdt,
+                                   sequence_parallel_enabled=sp_on)
     row_mlp = RowParallelLinear(input_size=inner, output_size=H,
                                 use_bias=True, input_is_parallel=True,
-                                world_size=tp, dtype=mdt)
+                                world_size=tp, dtype=mdt,
+                                sequence_parallel_enabled=sp_on)
 
     # ---- parameters. TP-sharded leaves ("col") carry an explicit model-
     # shard dim [L, tp, per_stage, ...] so the HOST holds the full weight
@@ -210,33 +227,49 @@ def build_parallel_lm(args, policy):
     # c*pp + r (build_model's round-robin split)
     order = np.asarray([c * pp + r for r in range(pp) for c in range(vpp)])
 
+    def maybe_rep(p):
+        # Under SP, LN/bias params act on seq-LOCAL activations, so each
+        # model rank's grad is partial: identity-fwd/psum-bwd completes it
+        # (Megatron's SP LN-grad allreduce; mappings.copy_to_...).
+        if sp_on:
+            from apex_tpu.transformer.tensor_parallel.mappings import (
+                copy_to_tensor_model_parallel_region)
+            return copy_to_tensor_model_parallel_region(p, "model")
+        return p
+
     def block_fn(bp, x):
-        mb, s, _ = x.shape
+        # x: [s_local_or_s, mb, H] — seq-first (the SP shard dim is dim 0)
+        mb = x.shape[1]
         cdt = x.dtype
-        h = layer_norm(x.reshape(-1, H), bp["rep"]["ln1_s"],
-                       bp["rep"]["ln1_b"]).reshape(x.shape).astype(cdt)
+        h = layer_norm(x.reshape(-1, H), maybe_rep(bp["rep"]["ln1_s"]),
+                       maybe_rep(bp["rep"]["ln1_b"])
+                       ).reshape(x.shape).astype(cdt)
         qkv = col_qkv.apply({"params": {"kernel": bp["col"]["qkv_k"]}}, h)
-        qkv = qkv.reshape(mb, s, 3, h_local, d_head)
+        s_full = qkv.shape[0]              # SP: seq gathered back to full
+        qkv = qkv.reshape(s_full, mb, 3, h_local, d_head)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        att = jnp.einsum("bqhd,bkhd->bhqk", q, k)
+        att = jnp.einsum("qbhd,kbhd->bhqk", q, k)
         # N8 fused path: scale+causal-mask+softmax in one Pallas pass
         # (fp32 math, half I/O), jnp fallback on unaligned shapes
         from apex_tpu.transformer.functional.fused_softmax import (
             scaled_upper_triang_masked_softmax)
         att = scaled_upper_triang_masked_softmax(
             att, scale=float(1.0 / np.sqrt(d_head))).astype(cdt)
-        ctx = jnp.einsum("bhqk,bkhd->bqhd", att, v).reshape(
-            mb, s, h_local * d_head)
+        ctx = jnp.einsum("bhqk,kbhd->qbhd", att, v).reshape(
+            s_full, mb, h_local * d_head)
         x = x + row_proj.apply(
             {"params": {"kernel": bp["col"]["proj_k"],
-                        "bias": bp["rep"]["proj_b"]}}, ctx).astype(cdt)
-        h = layer_norm(x.reshape(-1, H), bp["rep"]["ln2_s"],
-                       bp["rep"]["ln2_b"]).reshape(x.shape).astype(cdt)
+                        "bias": maybe_rep(bp["rep"]["proj_b"])}},
+            ctx).astype(cdt)
+        h = layer_norm(x.reshape(-1, H), maybe_rep(bp["rep"]["ln2_s"]),
+                       maybe_rep(bp["rep"]["ln2_b"])
+                       ).reshape(x.shape).astype(cdt)
         h = col_mlp.apply({"params": {"kernel": bp["col"]["mlp_in_k"]}}, h)
         h = jax.nn.gelu(jnp.asarray(h, jnp.float32),
                         approximate=False).astype(cdt)
         h = row_mlp.apply({"params": {"kernel": bp["col"]["mlp_out_k"],
-                                      "bias": bp["rep"]["mlp_out_b"]}}, h)
+                                      "bias": maybe_rep(
+                                          bp["rep"]["mlp_out_b"])}}, h)
         return (x + h.astype(cdt)).astype(cdt)
 
     def stage_fn(sp, x):
@@ -246,25 +279,57 @@ def build_parallel_lm(args, policy):
         return x
 
     def lm_loss(y, tgt, head):
+        # y: [s_local_or_s, mb, H], tgt: [s_local_or_s, mb] (seq-first).
+        # head params are used RAW (no maybe_rep): under SP every head
+        # grad (LN and kernel alike) is seq-chunk-partial and the caller
+        # psums the whole head tree over 'model' once — mixing in
+        # copy_to's psum-bwd here would double-count the LN grads.
         hh = layer_norm(y.reshape(-1, H), head["ln_s"], head["ln_b"])
         logits = jnp.dot(jnp.asarray(hh, y.dtype),
                          jnp.asarray(head["kernel"], y.dtype))
         losses = softmax_cross_entropy_loss(
             jnp.asarray(logits, jnp.float32), tgt.reshape(-1),
             smoothing=args.smoothing)
-        return losses.mean()
+        l = losses.mean()
+        if sp_on:
+            # each model rank sees a seq chunk; return local/tp so the
+            # collective transposes make the optimized objective the
+            # GLOBAL mean, and psum value-only so the reported loss is
+            # the global mean too (testing.build_full_parallel_step's
+            # mb_loss rule)
+            l = l / tp
+            l = l + jax.lax.stop_gradient(jax.lax.psum(l, "model") - l)
+        return l
 
     cdtype = policy.compute_dtype
+    s_loc = S // tp if sp_on else S
+
+    def _psum_model(tree):
+        return jax.tree_util.tree_map(
+            lambda g: jax.lax.psum(g, "model"), tree)
 
     def grad_fn(params, batch, loss_scale):
         tokens = batch                               # [B/dp, S+1] int32
-        inp = tokens[:, :-1].reshape(M, -1, S)
-        tgt = tokens[:, 1:].reshape(M, -1, S)
+        # seq-first streams: [M, S, mb]
+        inp = tokens[:, :-1].reshape(M, -1, S).transpose(0, 2, 1)
+        tgt = tokens[:, 1:].reshape(M, -1, S).transpose(0, 2, 1)
+        if sp_on:
+            # slice token ids (not embeddings) to the rank's chunk: the
+            # lookup then costs 1/tp, and the vjp scatter only touches
+            # local positions (psum over 'model' completes demb)
+            mr = jax.lax.axis_index("model")
+            tgt = jax.lax.dynamic_slice_in_dim(tgt, mr * s_loc, s_loc,
+                                               axis=1)
+            inp = jax.lax.dynamic_slice_in_dim(inp, mr * s_loc, s_loc,
+                                               axis=1)
 
         def embed(ep):
-            x = jnp.asarray(ep["wte"], cdtype)[inp] \
-                + jnp.asarray(ep["wpe"], cdtype)[None, None]
-            return x                                  # [M, mb, S, H]
+            wpe = jnp.asarray(ep["wpe"], cdtype)
+            if sp_on:
+                wpe = jax.lax.dynamic_slice_in_dim(wpe, mr * s_loc, s_loc,
+                                                   axis=0)
+            return jnp.asarray(ep["wte"], cdtype)[inp] \
+                + wpe[None, :, None, :]        # [M, s_loc, mb, H]
 
         # strip the model-shard dim shard_map left on the col leaves
         sp_local = {"col": jax.tree_util.tree_map(lambda l: l[:, 0],
@@ -277,8 +342,14 @@ def build_parallel_lm(args, policy):
             # TP-only (no pipe axis): reference fwd_bwd_no_pipelining —
             # grad accumulation over the microbatch stream
             def mb_loss_fn(p3, mb_tokens, t3):
+                # mb_tokens: [s_loc, mb] seq-first (pre-sliced under SP)
+                wpe = jnp.asarray(p3["emb"]["wpe"], cdtype)
+                if sp_on:
+                    wpe = jax.lax.dynamic_slice_in_dim(
+                        wpe, jax.lax.axis_index("model") * s_loc, s_loc,
+                        axis=0)
                 x = jnp.asarray(p3["emb"]["wte"], cdtype)[mb_tokens] \
-                    + jnp.asarray(p3["emb"]["wpe"], cdtype)[None]
+                    + wpe[:, None, :]
                 return lm_loss(stage_fn(p3["sp"], x), t3, p3["head"])
 
             loss, g3 = pp_mod.forward_backward_no_pipelining(
@@ -288,15 +359,19 @@ def build_parallel_lm(args, policy):
                 inp, tgt, accum_dtype=jnp.float32)
             g3 = jax.tree_util.tree_map(
                 lambda g: g * jnp.asarray(loss_scale, g.dtype), g3)
+            emb_g, head_g = g3["emb"], g3["head"]
+            if sp_on:
+                # per-rank seq chunks contribute partial emb/head grads
+                emb_g, head_g = _psum_model(emb_g), _psum_model(head_g)
             sgrads = g3["sp"]
             if vpp == 1:
                 sgrads = jax.tree_util.tree_map(lambda g: g[None], sgrads)
             return loss, {
-                "emb": g3["emb"],
+                "emb": emb_g,
                 "stages": {"col": jax.tree_util.tree_map(
                     lambda g: g[:, None], sgrads["col"]),
                     "rep": sgrads["rep"]},
-                "head": g3["head"],
+                "head": head_g,
             }
 
         x_stream, emb_vjp = jax.vjp(embed, params["emb"])
@@ -308,12 +383,15 @@ def build_parallel_lm(args, policy):
             sgrads = jax.tree_util.tree_map(lambda g: g[None], sgrads)
         (demb,) = emb_vjp(jnp.asarray(aux["input_cotangents"],
                                       x_stream.dtype))
+        head_g = aux["loss_param_grads"]
+        if sp_on:
+            demb, head_g = _psum_model(demb), _psum_model(head_g)
         return loss, {
             "emb": demb,
             "stages": {"col": jax.tree_util.tree_map(lambda g: g[:, None],
                                                      sgrads["col"]),
                        "rep": sgrads["rep"]},
-            "head": aux["loss_param_grads"],
+            "head": head_g,
         }
 
     optimizer = fused_adam(args.lr, weight_decay=args.weight_decay,
@@ -399,7 +477,9 @@ def run_parallel(args, policy):
     mesh, state, jit_step, n_params = build_parallel_lm(args, policy)
     print(f"=> LM {args.size} dp={args.data_parallel} "
           f"tp={args.tensor_parallel} pp={args.pipeline_parallel} "
-          f"vpp={args.virtual_pipeline}, params: {n_params:,}")
+          f"vpp={args.virtual_pipeline}"
+          f"{' sp' if args.sequence_parallel else ''}, "
+          f"params: {n_params:,}")
     rng = jax.random.PRNGKey(args.seed)
     t0, toks, metrics = None, 0, None
     with mesh:

@@ -86,6 +86,22 @@ def test_interleaved_vpp_trajectory_matches(lm, eight_devices):
                                rtol=2e-4)
 
 
+def test_sequence_parallel_trajectory_matches(lm, eight_devices):
+    """--sequence-parallel (Megatron SP: seq-sharded LN/residual region,
+    col all-gather / row reduce-scatter) computes the same trajectory as
+    the single-rank oracle, through both the 1F1B (pp2) and the
+    grad-accumulation (tp-only) paths."""
+    m_seq = _baseline(lm)
+    m_sp_pp = _run(lm, ["--tensor-parallel", "2", "--pipeline-parallel",
+                        "2", "--sequence-parallel"])
+    np.testing.assert_allclose(float(m_sp_pp["loss"]), float(m_seq["loss"]),
+                               rtol=2e-4)
+    m_sp_tp = _run(lm, ["--tensor-parallel", "2", "--pipeline-parallel",
+                        "1", "--sequence-parallel"])
+    np.testing.assert_allclose(float(m_sp_tp["loss"]), float(m_seq["loss"]),
+                               rtol=2e-4)
+
+
 def test_full_combo_dp_tp_pp_vpp_trajectory(lm, eight_devices):
     """Every axis at once — dp2 x tp2 x pp2 with vpp2 (8 devices, 4 logical
     stages) reproduces the single-device trajectory."""
