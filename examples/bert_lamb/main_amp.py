@@ -56,6 +56,10 @@ def parse_args(argv=None):
     p.add_argument("--opt-level", default="O2")
     p.add_argument("--loss-scale", default="dynamic")
     p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--data-parallel", type=int, default=1, metavar="N",
+                   help="DDP over an N-way 'data' mesh axis (LAMB update "
+                        "on psum-averaged grads — the reference's "
+                        "multi-GPU BERT-LAMB shape)")
     return p.parse_args(argv)
 
 
@@ -87,6 +91,15 @@ def make_schedule(lr, max_steps, warmup_proportion):
 
 def main(argv=None):
     args = parse_args(argv)
+    if args.train_batch_size % max(args.data_parallel, 1):
+        raise SystemExit(f"--train_batch_size {args.train_batch_size} "
+                         f"must divide by --data-parallel "
+                         f"{args.data_parallel}")
+    if args.data_parallel > 1:
+        # before ANY arrays exist: ensure_devices may switch backends
+        # (virtual CPU fallback) and refuses once state is live
+        from apex_tpu import comm
+        comm.ensure_devices(args.data_parallel)
     policy = amp.resolve_policy(opt_level=args.opt_level,
                                 loss_scale=args.loss_scale)
     print(policy.banner())
@@ -118,38 +131,71 @@ def main(argv=None):
         nsp_loss = softmax_cross_entropy_loss(nsp_logits, nsp_labels).mean()
         return mlm_loss + nsp_loss
 
-    init_fn, step_fn = amp.make_train_step(loss_fn, optimizer, policy)
+    dp = args.data_parallel
+    init_fn, step_fn = amp.make_train_step(
+        loss_fn, optimizer, policy,
+        grad_average_axis="data" if dp > 1 else None)
     state = init_fn(params)
-    jit_step = jax.jit(step_fn, donate_argnums=(0,))
+    if dp > 1:
+        # reference shape: apex DDP over the batch + FusedLAMB — here one
+        # grad psum over the 'data' axis (examples/imagenet's pattern);
+        # the dropout rng is folded per-rank so masks differ across shards
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from apex_tpu import comm
+
+        devices = comm.ensure_devices(dp)
+        mesh = Mesh(np.array(devices[:dp]), ("data",))
+
+        def sharded_step(state, batch):
+            *arrays, drop = batch
+            drop = jax.random.fold_in(drop, jax.lax.axis_index("data"))
+            return step_fn(state, tuple(arrays) + (drop,))
+
+        jit_step = jax.jit(shard_map(
+            sharded_step, mesh=mesh,
+            in_specs=(P(), (P("data"), P("data"), P("data"), P("data"),
+                            P("data"), P("data"), P())),
+            out_specs=(P(), P()), check_rep=False),
+            donate_argnums=(0,))
+        ctx = mesh
+    else:
+        import contextlib
+        jit_step = jax.jit(step_fn, donate_argnums=(0,))
+        ctx = contextlib.nullcontext()
 
     n_params = sum(int(np.prod(p.shape))
                    for p in jax.tree_util.tree_leaves(params))
-    print(f"=> BERT-{args.bert_model}, params: {n_params:,}")
+    print(f"=> BERT-{args.bert_model} dp={dp}, params: {n_params:,}")
 
     t0 = None
     seqs = 0
-    for it in range(args.max_steps):
-        rng, sub = jax.random.split(rng)
-        sub, drop = jax.random.split(sub)
-        batch = synthetic_bert_batch(sub, args.train_batch_size,
-                                     args.max_seq_length,
-                                     args.max_predictions_per_seq,
-                                     cfg.vocab_size) + (drop,)
-        state, metrics = jit_step(state, batch)
-        if it == 4:
-            metrics["loss"].block_until_ready()
-            t0 = time.perf_counter()
-            seqs = 0
-        seqs += args.train_batch_size
-        if it % 10 == 0 or it == args.max_steps - 1:
-            print(f"[{it}/{args.max_steps}] loss "
-                  f"{float(metrics['loss']):.4f} "
-                  f"loss_scale {float(metrics['loss_scale']):g}")
+    metrics = None
+    with ctx:
+        for it in range(args.max_steps):
+            rng, sub = jax.random.split(rng)
+            sub, drop = jax.random.split(sub)
+            batch = synthetic_bert_batch(sub, args.train_batch_size,
+                                         args.max_seq_length,
+                                         args.max_predictions_per_seq,
+                                         cfg.vocab_size) + (drop,)
+            state, metrics = jit_step(state, batch)
+            if it == 4:
+                metrics["loss"].block_until_ready()
+                t0 = time.perf_counter()
+                seqs = 0
+            seqs += args.train_batch_size
+            if it % 10 == 0 or it == args.max_steps - 1:
+                print(f"[{it}/{args.max_steps}] loss "
+                      f"{float(metrics['loss']):.4f} "
+                      f"loss_scale {float(metrics['loss_scale']):g}")
     jax.tree_util.tree_leaves(state.params)[0].block_until_ready()
     if t0 is not None and args.max_steps > 5:
         dt = time.perf_counter() - t0
         print(f"throughput: "
               f"{(seqs - args.train_batch_size) / dt:,.1f} sequences/s")
+    return metrics
 
 
 if __name__ == "__main__":

@@ -304,6 +304,13 @@ def build_parallel_lm(args, policy):
     cdtype = policy.compute_dtype
     s_loc = S // tp if sp_on else S
 
+    def slice_wpe(wpe):
+        """This rank's position-embedding rows under SP (full rows else)."""
+        if sp_on:
+            wpe = jax.lax.dynamic_slice_in_dim(
+                wpe, jax.lax.axis_index("model") * s_loc, s_loc, axis=0)
+        return wpe
+
     def _psum_model(tree):
         return jax.tree_util.tree_map(
             lambda g: jax.lax.psum(g, "model"), tree)
@@ -324,10 +331,7 @@ def build_parallel_lm(args, policy):
                                                axis=1)
 
         def embed(ep):
-            wpe = jnp.asarray(ep["wpe"], cdtype)
-            if sp_on:
-                wpe = jax.lax.dynamic_slice_in_dim(wpe, mr * s_loc, s_loc,
-                                                   axis=0)
+            wpe = slice_wpe(jnp.asarray(ep["wpe"], cdtype))
             return jnp.asarray(ep["wte"], cdtype)[inp] \
                 + wpe[None, :, None, :]        # [M, s_loc, mb, H]
 
@@ -343,11 +347,7 @@ def build_parallel_lm(args, policy):
             # grad accumulation over the microbatch stream
             def mb_loss_fn(p3, mb_tokens, t3):
                 # mb_tokens: [s_loc, mb] seq-first (pre-sliced under SP)
-                wpe = jnp.asarray(p3["emb"]["wpe"], cdtype)
-                if sp_on:
-                    wpe = jax.lax.dynamic_slice_in_dim(
-                        wpe, jax.lax.axis_index("model") * s_loc, s_loc,
-                        axis=0)
+                wpe = slice_wpe(jnp.asarray(p3["emb"]["wpe"], cdtype))
                 x = jnp.asarray(p3["emb"]["wte"], cdtype)[mb_tokens] \
                     + wpe[:, None, :]
                 return lm_loss(stage_fn(p3["sp"], x), t3, p3["head"])
