@@ -70,6 +70,11 @@ def parse_args(argv=None):
     p.add_argument("--sequence-parallel", action="store_true",
                    help="Megatron SP: LN/residual activations sharded "
                         "along sequence over the TP group (needs tp>1)")
+    p.add_argument("--vocab-parallel", action="store_true",
+                   help="Megatron parallel LM head: the output projection "
+                        "sharded over the vocab dim with "
+                        "vocab_parallel_cross_entropy (needs tp>1; "
+                        "exclusive with --sequence-parallel)")
     p.add_argument("--microbatches", type=int, default=None,
                    help="pipeline microbatches (default 2*pp)")
     p.add_argument("--layers", type=int, default=None,
@@ -139,6 +144,16 @@ def build_parallel_lm(args, policy):
     if sp_on and args.seq_len % tp:
         raise SystemExit(f"--seq-len {args.seq_len} must divide by tp {tp} "
                          "under --sequence-parallel")
+    vp_on = bool(args.vocab_parallel)
+    if vp_on and tp < 2:
+        raise SystemExit("--vocab-parallel needs --tensor-parallel > 1")
+    if vp_on and sp_on:
+        raise SystemExit("--vocab-parallel and --sequence-parallel are "
+                         "currently exclusive (the head's seq layouts "
+                         "differ)")
+    if vp_on and args.vocab_size % tp:
+        raise SystemExit(f"--vocab-size {args.vocab_size} must divide by "
+                         f"tp {tp} under --vocab-parallel")
     per_stage = layers // L
     H, V, S = hidden, args.vocab_size, args.seq_len
     inner = 4 * H
@@ -218,8 +233,17 @@ def build_parallel_lm(args, policy):
         }
         emb = {"wte": nrm(next(ks), (V, H), 0.02),
                "wpe": nrm(next(ks), (S, H), 0.01)}
+        head_full = nrm(next(ks), (H, V), 0.02)
+        if vp_on:
+            # Megatron parallel head: vocab columns split over tp; drawn
+            # full-first so the math is tp-invariant like the col leaves
+            head_k = jnp.stack(
+                [head_full[:, r * (V // tp):(r + 1) * (V // tp)]
+                 for r in range(tp)], axis=0)       # [tp, H, V/tp]
+        else:
+            head_k = head_full
         head = {"ln_s": jnp.ones((H,)), "ln_b": jnp.zeros((H,)),
-                "kernel": nrm(next(ks), (H, V), 0.02)}
+                "kernel": head_k}
         return {"emb": emb, "stages": {"col": col, "rep": rep},
                 "head": head}
 
@@ -285,6 +309,23 @@ def build_parallel_lm(args, policy):
         # psums the whole head tree over 'model' once — mixing in
         # copy_to's psum-bwd here would double-count the LN grads.
         hh = layer_norm(y.reshape(-1, H), head["ln_s"], head["ln_b"])
+        if vp_on:
+            # Megatron parallel-LM-head rule (P23): the head input goes
+            # through copy_to (identity fwd, psum bwd) so every vocab
+            # shard back-props the FULL dL/dh; the local logits block
+            # feeds the all-reduce-based parallel cross entropy. Head
+            # grads come out complete per shard (kernel: its vocab
+            # block; LN: identical on every rank) — no caller psum.
+            from apex_tpu.transformer.tensor_parallel import (
+                copy_to_tensor_model_parallel_region,
+                vocab_parallel_cross_entropy)
+            hh = copy_to_tensor_model_parallel_region(hh, "model")
+            logits = jnp.dot(jnp.asarray(hh, y.dtype),
+                             jnp.asarray(head["kernel"], y.dtype))
+            losses = vocab_parallel_cross_entropy(
+                logits, tgt.reshape(-1), label_smoothing=args.smoothing,
+                axis_name="model")
+            return losses.mean()
         logits = jnp.dot(jnp.asarray(hh, y.dtype),
                          jnp.asarray(head["kernel"], y.dtype))
         losses = softmax_cross_entropy_loss(
@@ -341,6 +382,15 @@ def build_parallel_lm(args, policy):
                     "rep": params["stages"]["rep"]}
         if vpp == 1:
             sp_local = jax.tree_util.tree_map(lambda l: l[0], sp_local)
+        head_local = dict(params["head"])
+        if vp_on:
+            head_local["kernel"] = params["head"]["kernel"][0]
+
+        def pack_head_grads(hg):
+            if vp_on:
+                hg = dict(hg)
+                hg["kernel"] = hg["kernel"][None]
+            return hg
 
         if pp == 1:
             # TP-only (no pipe axis): reference fwd_bwd_no_pipelining —
@@ -355,7 +405,7 @@ def build_parallel_lm(args, policy):
             loss, g3 = pp_mod.forward_backward_no_pipelining(
                 mb_loss_fn,
                 {"emb": params["emb"], "sp": sp_local,
-                 "head": params["head"]},
+                 "head": head_local},
                 inp, tgt, accum_dtype=jnp.float32)
             g3 = jax.tree_util.tree_map(
                 lambda g: g * jnp.asarray(loss_scale, g.dtype), g3)
@@ -371,14 +421,14 @@ def build_parallel_lm(args, policy):
                 "stages": {"col": jax.tree_util.tree_map(
                     lambda g: g[:, None], sgrads["col"]),
                     "rep": sgrads["rep"]},
-                "head": head_g,
+                "head": pack_head_grads(head_g),
             }
 
         x_stream, emb_vjp = jax.vjp(embed, params["emb"])
         loss, sgrads, aux = pp_mod.forward_backward_1f1b(
             stage_fn, lm_loss, sp_local, x_stream, tgt,
             num_stages=pp, num_chunks=vpp, loss_scale=loss_scale,
-            loss_params=params["head"], return_input_cotangents=True)
+            loss_params=head_local, return_input_cotangents=True)
         if vpp == 1:
             sgrads = jax.tree_util.tree_map(lambda g: g[None], sgrads)
         (demb,) = emb_vjp(jnp.asarray(aux["input_cotangents"],
@@ -391,7 +441,7 @@ def build_parallel_lm(args, policy):
             "stages": {"col": jax.tree_util.tree_map(lambda g: g[:, None],
                                                      sgrads["col"]),
                        "rep": sgrads["rep"]},
-            "head": head_g,
+            "head": pack_head_grads(head_g),
         }
 
     optimizer = fused_adam(args.lr, weight_decay=args.weight_decay,
@@ -418,6 +468,8 @@ def build_parallel_lm(args, policy):
             return P("pipe", "model")
         if "stages" in keys:
             return P("pipe")
+        if vp_on and "head" in keys and "kernel" in keys:
+            return P("model")
         return P()
 
     pspec = jax.tree_util.tree_map_with_path(param_spec, params)
@@ -433,6 +485,8 @@ def build_parallel_lm(args, policy):
             shape[1] //= tp
         elif "stages" in keys:
             shape[0] //= pp
+        elif vp_on and "head" in keys and "kernel" in keys:
+            shape[0] //= tp
         return jax.ShapeDtypeStruct(tuple(shape), l.dtype)
 
     local_params = jax.tree_util.tree_map_with_path(local_struct, params)
@@ -446,6 +500,8 @@ def build_parallel_lm(args, policy):
             return P("pipe", "model")
         if "stages" in keys:
             return P("pipe")
+        if vp_on and "head" in keys and "kernel" in keys:
+            return P("model")
         if len(sds.shape) == 1 and int(np.prod(sds.shape)) == local_float:
             # flat superbuffer (fused_adam m/v): rank-local, stacked over
             # the (pipe, model) product on the global axis
@@ -478,7 +534,8 @@ def run_parallel(args, policy):
     print(f"=> LM {args.size} dp={args.data_parallel} "
           f"tp={args.tensor_parallel} pp={args.pipeline_parallel} "
           f"vpp={args.virtual_pipeline}"
-          f"{' sp' if args.sequence_parallel else ''}, "
+          f"{' sp' if args.sequence_parallel else ''}"
+          f"{' vocab-parallel' if args.vocab_parallel else ''}, "
           f"params: {n_params:,}")
     rng = jax.random.PRNGKey(args.seed)
     t0, toks, metrics = None, 0, None

@@ -102,6 +102,21 @@ def test_sequence_parallel_trajectory_matches(lm, eight_devices):
                                rtol=2e-4)
 
 
+def test_vocab_parallel_head_trajectory_matches(lm, eight_devices):
+    """--vocab-parallel (Megatron parallel LM head: copy_to before the
+    head, vocab-sharded kernel, all-reduce-based parallel cross entropy)
+    computes the same trajectory through both pp and tp-only paths."""
+    m_seq = _baseline(lm)
+    m_vp_pp = _run(lm, ["--tensor-parallel", "2", "--pipeline-parallel",
+                        "2", "--vocab-parallel"])
+    np.testing.assert_allclose(float(m_vp_pp["loss"]), float(m_seq["loss"]),
+                               rtol=2e-4)
+    m_vp_tp = _run(lm, ["--tensor-parallel", "2", "--pipeline-parallel",
+                        "1", "--vocab-parallel"])
+    np.testing.assert_allclose(float(m_vp_tp["loss"]), float(m_seq["loss"]),
+                               rtol=2e-4)
+
+
 def test_full_combo_dp_tp_pp_vpp_trajectory(lm, eight_devices):
     """Every axis at once — dp2 x tp2 x pp2 with vpp2 (8 devices, 4 logical
     stages) reproduces the single-device trajectory."""
