@@ -75,6 +75,11 @@ def parse_args(argv=None):
                         "sharded over the vocab dim with "
                         "vocab_parallel_cross_entropy (needs tp>1; "
                         "exclusive with --sequence-parallel)")
+    p.add_argument("--zero", action="store_true",
+                   help="ZeRO: shard optimizer state over the data axis "
+                        "(contrib DistributedFusedAdam — mean-reduce-"
+                        "scatter grads, shard-local update, all-gather "
+                        "params; needs dp>1)")
     p.add_argument("--microbatches", type=int, default=None,
                    help="pipeline microbatches (default 2*pp)")
     p.add_argument("--layers", type=int, default=None,
@@ -154,6 +159,9 @@ def build_parallel_lm(args, policy):
     if vp_on and args.vocab_size % tp:
         raise SystemExit(f"--vocab-size {args.vocab_size} must divide by "
                          f"tp {tp} under --vocab-parallel")
+    zero_on = bool(args.zero)
+    if zero_on and dp < 2:
+        raise SystemExit("--zero needs --data-parallel > 1")
     per_stage = layers // L
     H, V, S = hidden, args.vocab_size, args.seq_len
     inner = 4 * H
@@ -444,16 +452,40 @@ def build_parallel_lm(args, policy):
             "head": pack_head_grads(head_g),
         }
 
-    optimizer = fused_adam(args.lr, weight_decay=args.weight_decay,
-                           adam_w_mode=True)
+    if zero_on:
+        _inner_grad_fn = grad_fn
+
+        def grad_fn(params, batch, loss_scale):  # noqa: F811
+            loss, grads = _inner_grad_fn(params, batch, loss_scale)
+            # the grad psum normally pmean's the reported loss inside
+            # make_train_step; ZeRO hands grads over un-averaged, so the
+            # metric needs the global-batch mean here
+            return jax.lax.pmean(loss, "data"), grads
+
+        # ZeRO (contrib DistributedFusedAdam): the transformation does its
+        # own mean-reduce-scatter over 'data', updates its 1/dp state
+        # shard, and all-gathers params — so grads are handed over
+        # UN-averaged (grad_average_axis=None) and found_inf must sync
+        # over 'data' explicitly (no grad psum carries the infs).
+        from apex_tpu.contrib.optimizers import distributed_fused_adam
+        optimizer = distributed_fused_adam(
+            args.lr, weight_decay=args.weight_decay, adam_w_mode=True,
+            axis_name="data", world_size=dp)
+        grad_avg_axis = None
+    else:
+        optimizer = fused_adam(args.lr, weight_decay=args.weight_decay,
+                               adam_w_mode=True)
+        grad_avg_axis = "data" if dp > 1 else None
     # stage/col leaves are shard-local to pipe/model: their infs never ride
     # a grad psum, so found_inf must sync explicitly (make_train_step docs)
     sync = tuple(ax for ax, size in (("pipe", pp), ("model", tp))
-                 if size > 1) or None
+                 if size > 1)
+    if zero_on:
+        sync = ("data",) + sync
     init_fn, step_fn = amp.make_train_step(
         None, optimizer, policy, grad_fn=grad_fn,
-        grad_average_axis="data" if dp > 1 else None,
-        overflow_sync_axes=sync)
+        grad_average_axis=grad_avg_axis,
+        overflow_sync_axes=sync or None)
 
     params = init_params(jax.random.PRNGKey(args.seed))
     params["stages"] = jax.tree_util.tree_map(
@@ -502,6 +534,10 @@ def build_parallel_lm(args, policy):
             return P("pipe")
         if vp_on and "head" in keys and "kernel" in keys:
             return P("model")
+        if zero_on and ("m_shard" in keys or "v_shard" in keys):
+            # ZeRO m/v shard (DistAdamState fields, matched by name):
+            # rank-local over data AND (pipe, model)
+            return P(("data", "pipe", "model"))
         if len(sds.shape) == 1 and int(np.prod(sds.shape)) == local_float:
             # flat superbuffer (fused_adam m/v): rank-local, stacked over
             # the (pipe, model) product on the global axis
@@ -535,7 +571,8 @@ def run_parallel(args, policy):
           f"tp={args.tensor_parallel} pp={args.pipeline_parallel} "
           f"vpp={args.virtual_pipeline}"
           f"{' sp' if args.sequence_parallel else ''}"
-          f"{' vocab-parallel' if args.vocab_parallel else ''}, "
+          f"{' vocab-parallel' if args.vocab_parallel else ''}"
+          f"{' zero' if args.zero else ''}, "
           f"params: {n_params:,}")
     rng = jax.random.PRNGKey(args.seed)
     t0, toks, metrics = None, 0, None
