@@ -300,7 +300,7 @@ def make_train_step(loss_fn: Callable, optimizer, policy: Policy,
                     has_aux: bool = False,
                     is_norm_param: Optional[Callable] = None,
                     with_model_state: bool = False,
-                    grad_average_axis: Optional[str] = None,
+                    grad_average_axis=None,  # str | tuple[str, ...] | None
                     gradient_predivide_factor: float = 1.0,
                     grad_average_mask=None,
                     overflow_sync_axes=None,
@@ -318,10 +318,12 @@ def make_train_step(loss_fn: Callable, optimizer, policy: Policy,
     for flax mutable collections such as BatchNorm batch_stats, and
     ``init_fn(params, model_state)`` stores it on the AmpState.
 
-    ``grad_average_axis`` names a mesh axis to mean-reduce gradients over —
-    the apex DDP composition point (apex/parallel/distributed.py averages
-    grads over the world inside its allreduce hooks; here it is one psum
-    under shard_map/pmap). ``gradient_predivide_factor`` mirrors apex DDP's
+    ``grad_average_axis`` names a mesh axis — or a TUPLE of axes (the
+    lax collectives accept either; e.g. ``("data", "context")`` for DDP
+    composed outside a context-parallel ring) — to mean-reduce gradients
+    over: the apex DDP composition point (apex/parallel/distributed.py
+    averages grads over the world inside its allreduce hooks; here it is
+    one psum under shard_map/pmap). ``gradient_predivide_factor`` mirrors apex DDP's
     option of the same name: grads are divided by the factor BEFORE the
     sum and by world/factor after, trading overflow headroom in half-precision
     sums. Overflow detection runs on the *reduced* grads, so any rank's inf

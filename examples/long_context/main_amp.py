@@ -58,6 +58,10 @@ def parse_args(argv=None):
     p.add_argument("--heads", type=int, default=4)
     p.add_argument("--vocab", type=int, default=1024)
     p.add_argument("-b", "--batch-size", type=int, default=2)
+    p.add_argument("--data-parallel", type=int, default=1, metavar="DP",
+                   help="DDP over a 'data' axis composed OUTSIDE the "
+                        "context ring (mesh [data, context]; grads "
+                        "averaged over both axes)")
     p.add_argument("--iters", type=int, default=8)
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--opt-level", default="O2")
@@ -135,8 +139,15 @@ class RingLM(nn.Module):
 def main(argv=None):
     args = parse_args(argv)
     policy = amp.resolve_policy(opt_level=args.opt_level)
-    devices = comm.ensure_devices(args.ring)
-    mesh = Mesh(np.array(devices[:args.ring]), ("context",))
+    dp = args.data_parallel
+    if dp < 1:
+        raise SystemExit(f"--data-parallel must be >= 1, got {dp}")
+    if args.batch_size % dp:
+        raise SystemExit(f"--batch-size {args.batch_size} must divide by "
+                         f"--data-parallel {dp}")
+    devices = comm.ensure_devices(dp * args.ring)
+    mesh = Mesh(np.array(devices[:dp * args.ring]).reshape(dp, args.ring),
+                ("data", "context"))
     comm.set_mesh(mesh)
     S, n = args.seq_len, args.ring
     if args.attn == "ulysses":
@@ -190,14 +201,18 @@ def main(argv=None):
     # rank's loss covers only its sequence shard — grads must be averaged
     # over the context axis (Megatron-SP's grad allreduce for sequence-
     # parallel regions) or every rank trains on a different objective
+    # the average spans BOTH axes (make_train_step accepts axis tuples):
+    # mean over per-data-shard means, each shard's mean already exact over
+    # its ring (the reference DDP objective); at dp=1 the data axis has
+    # size 1 and the extra pmean is the identity
     init_fn, step_fn = amp.make_train_step(
         loss_fn, fused_adam(args.lr), policy,
-        grad_average_axis="context")
+        grad_average_axis=("data", "context"))
 
     @functools.partial(jax.shard_map, mesh=mesh,
-                       in_specs=(P(), (P(None, "context"),
-                                       P(None, "context"),
-                                       P(None, "context"))),
+                       in_specs=(P(), (P("data", "context"),
+                                       P("data", "context"),
+                                       P("data", "context"))),
                        out_specs=(P(), P()), check_vma=False)
     def sharded_step(state, batch):
         new_state, metrics = step_fn(state, batch)
@@ -209,7 +224,7 @@ def main(argv=None):
     s_local = S // n
 
     @functools.partial(jax.shard_map, mesh=mesh,
-                       in_specs=(P(None, "context"), P(None, "context")),
+                       in_specs=(P("data", "context"), P("data", "context")),
                        out_specs=P(), check_vma=False)
     def init_params(toks, pos):
         return model.init(jax.random.PRNGKey(0), toks, pos)["params"]
@@ -217,10 +232,10 @@ def main(argv=None):
     params = init_params(tokens, positions)
     n_params = sum(np.prod(p.shape)
                    for p in jax.tree_util.tree_leaves(params))
-    print(f"=> ring={n} layout={args.layout} global seq {S} "
+    print(f"=> ring={n} dp={dp} layout={args.layout} global seq {S} "
           f"(local {s_local}), params {n_params:,}")
     state = jax.device_put(init_fn(params), NamedSharding(mesh, P()))
-    sharding = NamedSharding(mesh, P(None, "context"))
+    sharding = NamedSharding(mesh, P("data", "context"))
     batch = tuple(jax.device_put(t, sharding)
                   for t in (tokens, targets, positions))
 

@@ -172,6 +172,48 @@ def test_causal_softmax_fuzz(args):
 
 
 @st.composite
+def masked_shapes(draw):
+    sq = draw(st.sampled_from([8, 16, 24, 128]))
+    sk = draw(st.sampled_from([128, 256]))
+    # broadcast patterns the kernel folds into its index map: full lead
+    # dims, a [b, 1] head broadcast, and no lead dims at all
+    layout = draw(st.sampled_from(["full", "head_bcast", "bare"]))
+    b = draw(st.integers(1, 3))
+    h = draw(st.integers(1, 3))
+    seed = draw(st.integers(0, 2**31 - 1))
+    rng = np.random.RandomState(seed)
+    if layout == "bare":
+        xshape, mshape = (sq, sk), (sq, sk)
+    elif layout == "full":
+        xshape, mshape = (b, h, sq, sk), (b, h, sq, sk)
+    else:
+        xshape, mshape = (b, h, sq, sk), (b, 1, sq, sk)
+    x = jnp.asarray(rng.randn(*xshape) * 2.0, jnp.float32)
+    m = rng.rand(*mshape) < draw(st.sampled_from([0.0, 0.3, 0.7]))
+    m[..., 0] = False       # never a fully-masked row (reference padding)
+    return x, jnp.asarray(m), draw(st.sampled_from([0.125, 1.0]))
+
+
+@given(masked_shapes())
+@settings(**_SETTINGS)
+def test_masked_softmax_fuzz(args):
+    from apex_tpu.kernels.masked_softmax import (masked_softmax,
+                                                 masked_softmax_reference)
+
+    x, m, scale = args
+    out = masked_softmax(x, m, scale, interpret=True)
+    ref = masked_softmax_reference(x, m, scale)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+    gk = jax.grad(lambda x: jnp.sum(jnp.sin(
+        masked_softmax(x, m, scale, interpret=True) * 2.0)))(x)
+    gr = jax.grad(lambda x: jnp.sum(jnp.sin(
+        masked_softmax_reference(x, m, scale) * 2.0)))(x)
+    np.testing.assert_allclose(np.asarray(gk), np.asarray(gr),
+                               rtol=1e-4, atol=1e-5)
+
+
+@st.composite
 def gn_inputs(draw):
     n = draw(st.integers(1, 2))
     s = draw(st.sampled_from([7, 16, 33]))

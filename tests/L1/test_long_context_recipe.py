@@ -32,6 +32,21 @@ def test_ring_lm_trains_and_layouts_agree():
 
 
 @pytest.mark.slow
+def test_data_parallel_composes_with_ring():
+    """--data-parallel shards the batch over a 'data' axis OUTSIDE the
+    context ring (mesh [data, context], grads averaged over both axes);
+    the fixed global batch makes dp2 reproduce the dp1 trajectory
+    exactly — DDP as a pure layout change."""
+    common = ["--ring", "2", "--seq-len", "128", "--hidden", "64",
+              "--layers", "1", "--heads", "2", "--vocab", "128",
+              "--iters", "3", "-b", "4", "--lr", "3e-3",
+              "--opt-level", "O0"]
+    loss_dp1 = main_amp.main(common)
+    loss_dp2 = main_amp.main(common + ["--data-parallel", "2"])
+    assert abs(loss_dp1 - loss_dp2) < 1e-4, (loss_dp1, loss_dp2)
+
+
+@pytest.mark.slow
 def test_ulysses_mode_matches_ring():
     """--attn ulysses computes the same attention a different way (a2a head
     scatter vs KV rotation): identical data + init → same fp32 loss."""
