@@ -458,7 +458,11 @@ def make_train_step(loss_fn: Callable, optimizer, policy: Policy,
             sync_axes = (sync_axes,)
         if sync_axes is None and grad_average_axis is not None \
                 and grad_average_mask is not None:
-            sync_axes = (grad_average_axis,)
+            # grad_average_axis may itself be a tuple of axes — flatten,
+            # never nest (pmax would read a nested tuple as one axis name)
+            sync_axes = (tuple(grad_average_axis)
+                         if isinstance(grad_average_axis, tuple)
+                         else (grad_average_axis,))
         if sync_axes:
             # shard-local leaves never pass through a grad psum, so their
             # infs don't propagate to other ranks the way apex's NCCL

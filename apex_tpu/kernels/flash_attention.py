@@ -413,6 +413,19 @@ def _sds(shape, dtype, like):
     return jax.ShapeDtypeStruct(shape, dtype)
 
 
+def _resolve_blocks(block_q, block_k):
+    """Default/tuned block sizes, clamped to the Pallas tile alignments
+    (_pallas_ok: bq sublane-multiple 8, bk lane-multiple 128 — a tuned
+    file must never drop the kernel to the quadratic-memory fallback)."""
+    if block_q is None:
+        block_q = vmem.get_override("flash.block_q", DEFAULT_BLOCK_Q,
+                                    multiple=8)
+    if block_k is None:
+        block_k = vmem.get_override("flash.block_k", DEFAULT_BLOCK_K,
+                                    multiple=128)
+    return block_q, block_k
+
+
 def _pallas_ok(sq, sk, d, bq, bk):
     # bk is the lane dim of the [bq, bk] score tile → multiple of 128;
     # bq is the sublane dim → multiple of 8.
@@ -729,7 +742,7 @@ def _ref_chunk_bwd(q3, k3, v3, do3, lse, delta, scale, causal,
 
 
 def attn_chunk_fwd(q3, k3, v3, *, scale, causal,
-                   block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                   block_q=None, block_k=None,
                    dropout_rate=0.0, dropout_seed=None,
                    interpret=False):
     """One attention block: [bh, sq, d] x [bh, sk, d] -> (o fp32, lse fp32).
@@ -747,6 +760,7 @@ def attn_chunk_fwd(q3, k3, v3, *, scale, causal,
     if dropout_rate > 0.0 and dropout_seed is None:
         raise ValueError("dropout_rate > 0 requires dropout_seed")
     sq, sk, d = q3.shape[1], k3.shape[1], q3.shape[2]
+    block_q, block_k = _resolve_blocks(block_q, block_k)
     bq, bk = min(block_q, sq), min(block_k, sk)
     if jax.default_backend() == "cpu":
         interpret = True
@@ -761,13 +775,14 @@ def attn_chunk_fwd(q3, k3, v3, *, scale, causal,
 
 
 def attn_chunk_bwd(q3, k3, v3, do3, lse, delta, *, scale, causal,
-                   block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                   block_q=None, block_k=None,
                    dropout_rate=0.0, dropout_seed=None,
                    interpret=False):
     """Chunk backward given residuals; returns fp32 (dq, dk, dv)."""
     if dropout_rate > 0.0 and dropout_seed is None:
         raise ValueError("dropout_rate > 0 requires dropout_seed")
     sq, sk, d = q3.shape[1], k3.shape[1], q3.shape[2]
+    block_q, block_k = _resolve_blocks(block_q, block_k)
     bq, bk = min(block_q, sq), min(block_k, sk)
     if jax.default_backend() == "cpu":
         interpret = True
@@ -888,12 +903,7 @@ def flash_attention(q, k, v, *, causal: bool = False,
     # validated on EVERY path: the jnp fallback must reject exactly what the
     # Pallas path rejects, or aligned shapes would crash where unaligned ran
     _validate_bias(bias, q.shape[0], q.shape[1], sq, sk)
-    if block_q is None:
-        block_q = vmem.get_override("flash.block_q", DEFAULT_BLOCK_Q,
-                                    multiple=8)
-    if block_k is None:
-        block_k = vmem.get_override("flash.block_k", DEFAULT_BLOCK_K,
-                                    multiple=8)
+    block_q, block_k = _resolve_blocks(block_q, block_k)
     bq = min(block_q, sq)
     bk = min(block_k, sk)
     if jax.default_backend() == "cpu":

@@ -320,7 +320,9 @@ def sweep(out_path="tuned_blocks.json"):
     _sweep_knob(results, "flash.block_q", (64, 128, 256), flash_ms)
     if "flash.block_q" in results:
         vmem.set_override("flash.block_q", results["flash.block_q"])
-    _sweep_knob(results, "flash.block_k", (64, 128, 256), flash_ms)
+    # block_k is lane-aligned to 128 (values below clamp up — see
+    # flash_attention._resolve_blocks), so 64 would duplicate 128
+    _sweep_knob(results, "flash.block_k", (128, 256, 512), flash_ms)
     vmem.clear_overrides()
 
     # layer norm row block

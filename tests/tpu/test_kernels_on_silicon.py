@@ -195,6 +195,30 @@ def test_causal_softmax(tpu_backend):
     _close(gk, gr, 1e-4)
 
 
+# ------------------------------------------------- tuned block overrides
+def test_tuned_override_lowers_and_matches(tpu_backend):
+    """A bench_kernels --sweep override (non-default block) must lower on
+    silicon and keep oracle parity — the 'only ever slower, never broken'
+    contract behind APEX_TPU_TUNED."""
+    from apex_tpu.kernels import vmem
+    from apex_tpu.kernels.layer_norm import layer_norm, layer_norm_reference
+
+    prev = vmem.overrides().get("layer_norm.block_rows")
+    try:
+        vmem.set_override("layer_norm.block_rows", 32)
+        x = jax.random.normal(jax.random.PRNGKey(20), (512, 1024))
+        w, b = jnp.ones((1024,)) * 1.1, jnp.zeros((1024,)) + 0.1
+        _close(jax.jit(layer_norm)(x, w, b),
+               layer_norm_reference(x, w, b), 1e-5)
+    finally:
+        # restore only OUR key — an APEX_TPU_TUNED registry loaded for
+        # the whole gate run must survive this test
+        if prev is None:
+            vmem.remove_override("layer_norm.block_rows")
+        else:
+            vmem.set_override("layer_norm.block_rows", prev)
+
+
 # ------------------------------------------------------ masked softmax
 def test_masked_softmax(tpu_backend):
     """N8's arbitrary-mask kernel (round 3): compiled Mosaic lowering vs
