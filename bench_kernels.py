@@ -43,29 +43,42 @@ def timeit(fn, *args, warmup=2, steps=10):
     return times[len(times) // 2]
 
 
-HBM_GBPS = 819.0        # v5e
-PEAK_TFLOPS = 394.0     # v5e bf16
+HBM_GBPS = 819.0        # v5e HBM bandwidth
+PEAK_TFLOPS = 197.0     # v5e bf16 (394 is the int8 figure)
 
 
 def row(bench, shape, pallas_ms, xla_ms, gbytes=None, gflops=None):
     """One result row, self-describing about plausibility: if the measured
     time implies bandwidth/compute beyond the chip's physical limits the
     row is dispatch-dominated (the axon emulator does not model HBM/MXU
-    timing) and its speedup column is NOT meaningful."""
+    timing) and its speedup column is NOT meaningful.
+
+    ``roofline_ms`` is the analytic floor on real v5e silicon —
+    max(bytes / HBM bandwidth, flops / bf16 peak) — so the first
+    real-silicon session reads achieved-vs-roofline immediately
+    (``pct_of_roofline`` = roofline/measured; 100 = at the roofline,
+    >120 = the clock is non-physical, same condition as ``implausible``)."""
     out = {
         "bench": bench, "shape": shape,
         "pallas_ms": round(pallas_ms, 3), "xla_ms": round(xla_ms, 3),
         "speedup": round(xla_ms / pallas_ms, 2),
     }
     implausible = False
+    roofline_s = 0.0
     if gbytes is not None:
         bw = gbytes / (pallas_ms / 1e3)
         out["implied_gbps"] = round(bw, 1)
+        roofline_s = max(roofline_s, gbytes / HBM_GBPS)
         implausible |= bw > 1.2 * HBM_GBPS
     if gflops is not None:
         tf = gflops / 1e3 / (pallas_ms / 1e3)
         out["implied_tflops"] = round(tf, 1)
+        roofline_s = max(roofline_s, gflops / 1e3 / PEAK_TFLOPS)
         implausible |= tf > 1.2 * PEAK_TFLOPS
+    if roofline_s > 0.0:
+        out["roofline_ms"] = round(roofline_s * 1e3, 3)
+        out["pct_of_roofline"] = round(100.0 * roofline_s * 1e3 / pallas_ms,
+                                       1)
     out["implausible"] = bool(implausible)
     print(json.dumps(out), flush=True)
 

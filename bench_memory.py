@@ -1,0 +1,120 @@
+"""Compiler-priced memory contracts at production shapes.
+
+The counterpart of bench_kernels.py for the evidence the emulator's clock
+cannot produce (VERDICT round-3 item 1): each row lowers the SAME
+computation with the Pallas kernel and with the jnp/XLA composition,
+compiles both on the attached backend (nothing executes — abstract avals,
+zero device allocation), and prints the peak-memory delta certified by
+XLA buffer assignment. Run on the TPU backend (the CPU backend's
+memory_analysis excludes its temp arena and prices nothing):
+
+    python bench_memory.py             # all contracts
+    python bench_memory.py xentropy    # a subset
+
+One JSON line per row: {"contract", "shape", "fused_peak_bytes",
+"composed_peak_bytes", "saved_peak_bytes", "theory_bytes", "vs_theory"}.
+``theory_bytes`` is the analytic size of the buffer the contract says the
+fused kernel never materializes (reference claims: xentropy_kernel.cu
+bprop-in-fprop — no [N, V] softmax residual; fmhalib — no O(s^2)
+probability buffer). The contract setups are shared with the asserting
+tests (tests/tpu/test_memory_contracts_on_silicon.py) via
+apex_tpu.utils.memory_report, so the asserted and the reported contract
+cannot drift; this tool produces the BASELINE.md table at real shapes.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+import jax
+import jax.numpy as jnp
+
+S = jax.ShapeDtypeStruct
+
+
+def emit(row, shape):
+    row["shape"] = shape
+    for k in ("fused_peak_bytes", "composed_peak_bytes",
+              "saved_peak_bytes", "theory_bytes"):
+        if k in row:
+            row[k + "_mb"] = round(row[k] / 2**20, 1)
+    print(json.dumps(row), flush=True)
+
+
+def bench_xentropy():
+    from apex_tpu.utils.memory_report import (price_contract,
+                                              xentropy_contract)
+
+    for n, v in ((8192, 32768), (4096, 50304)):
+        fused, composed, avals, theory = xentropy_contract(n, v)
+        emit(price_contract("xentropy_fwd_bwd", fused, composed, avals,
+                            theory_bytes=theory), f"{n}x{v}")
+
+
+def bench_flash():
+    from apex_tpu.utils.memory_report import flash_contract, price_contract
+
+    d = 128
+    for b, h, s in ((2, 8, 2048), (1, 8, 4096)):
+        fused, composed, avals, theory = flash_contract(b, h, s, d,
+                                                        with_bwd=True)
+        emit(price_contract("flash_fwd_bwd", fused, composed, avals,
+                            theory_bytes=theory), f"b{b} h{h} s{s} d{d}")
+
+    for b, h, s in ((1, 8, 8192),):
+        fused, composed, avals, theory = flash_contract(b, h, s, d,
+                                                        with_bwd=False)
+        emit(price_contract("flash_fwd", fused, composed, avals,
+                            theory_bytes=theory), f"b{b} h{h} s{s} d{d}")
+
+
+def bench_remat():
+    from apex_tpu.utils.memory_report import (price_contract,
+                                              remat_mlp_contract)
+
+    n_layers, n, hdim = 12, 2048, 1024
+    plain_fn, remat_fn, avals, theory = remat_mlp_contract(n_layers, n,
+                                                           hdim)
+    # fused = checkpointed, composed = plain autodiff
+    emit(price_contract("remat_activation_memory", remat_fn, plain_fn,
+                        avals, theory_bytes=theory),
+         f"L{n_layers} n{n} h{hdim} (jax.checkpoint per block)")
+
+
+def bench_layer_norm():
+    """Honest negative row: LN claims fusion, not memory. At standalone
+    microbench shapes the pallas_call boundary even COSTS bytes (the
+    sum-loss cotangent must materialize as a real HBM buffer where XLA
+    would have fused it away); in a real model that cotangent exists
+    anyway. Recorded so BASELINE.md can say it, not hide it."""
+    from apex_tpu.kernels.layer_norm import layer_norm, layer_norm_reference
+    from apex_tpu.utils.memory_report import price_contract
+
+    n, hdim = 8192, 4096
+    avals = [S((n, hdim), jnp.bfloat16), S((hdim,), jnp.float32),
+             S((hdim,), jnp.float32)]
+    row = price_contract(
+        "layer_norm_fwd_bwd (no memory contract claimed)",
+        jax.value_and_grad(lambda x, w, b: jnp.sum(
+            layer_norm(x, w, b).astype(jnp.float32)), argnums=(0, 1, 2)),
+        jax.value_and_grad(lambda x, w, b: jnp.sum(
+            layer_norm_reference(x, w, b).astype(jnp.float32)),
+            argnums=(0, 1, 2)),
+        avals)
+    emit(row, f"{n}x{hdim}")
+
+
+SUITES = {"xentropy": bench_xentropy, "flash": bench_flash,
+          "remat": bench_remat, "layer_norm": bench_layer_norm}
+
+
+def main(argv):
+    print(json.dumps({"device": str(jax.devices()[0]),
+                      "backend": jax.default_backend()}), flush=True)
+    for name in (argv or list(SUITES)):
+        SUITES[name]()
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

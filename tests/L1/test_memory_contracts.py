@@ -1,0 +1,91 @@
+"""Hermetic structural half of the kernel memory contracts.
+
+The byte-priced half lives in tests/tpu/test_memory_contracts_on_silicon.py (XLA
+buffer assignment on the real backend — the CPU backend's
+``memory_analysis`` excludes its temp arena, so peaks carry no signal
+here). What CAN be asserted hermetically is the *structure* the pricing
+rests on: the residual pytrees the custom_vjp forward rules save. These
+are backend-independent — ``jax.eval_shape`` of the fwd rule shows
+exactly which tensors backward will consume.
+
+Contracts (the reference's own claims):
+- xentropy bprop-in-fprop (apex/contrib/csrc/xentropy/xentropy_kernel.cu):
+  residuals are (logits, labels, mlse) — nothing new of size [N, V].
+- flash attention (apex/contrib/fmha — fmhalib): residuals are
+  (q, k, v, o, lse) — all O(s*d) or O(s); never O(s^2).
+"""
+
+import jax
+import jax.numpy as jnp
+
+S = jax.ShapeDtypeStruct
+
+
+def _residual_leaves(shapes_tree):
+    """ShapeDtypeStruct leaves of a residual pytree (drops static ints)."""
+    return [l for l in jax.tree_util.tree_leaves(shapes_tree)
+            if hasattr(l, "shape")]
+
+
+def test_xentropy_residuals_are_bprop_in_fprop():
+    """Beyond the input logits/labels themselves, the saved residual is
+    one [N, 1] mlse vector — no [N, V] tensor of any dtype."""
+    from apex_tpu.kernels import xentropy as xk
+
+    n, v = 256, 1024
+    res = jax.eval_shape(
+        lambda lg, lb: xk._xent_fwd(lg, lb, 0.0, True)[1],
+        S((n, v), jnp.bfloat16), S((n,), jnp.int32))
+    leaves = _residual_leaves(res)
+    # exactly ONE [N, V] leaf may appear: the pass-through bf16 logits.
+    # A second one (e.g. a regressed fp32 softmax residual) is precisely
+    # the contract violation this test exists to catch.
+    nv_leaves = [l for l in leaves if l.size == n * v]
+    assert len(nv_leaves) == 1 and nv_leaves[0].dtype == jnp.bfloat16, \
+        [(l.shape, l.dtype) for l in nv_leaves]
+    # total residual bytes = logits + labels + mlse, nothing else
+    total = sum(l.size * l.dtype.itemsize for l in leaves)
+    assert total <= n * v * 2 + n * 4 + n * 8, \
+        [(l.shape, l.dtype) for l in leaves]
+
+
+def test_flash_residuals_scale_linearly_with_seq():
+    """No residual leaf has s^2 elements; total residual bytes beyond the
+    (q, k, v) inputs is O(s*d) (the saved o + lse), at any s."""
+    from apex_tpu.kernels import flash_attention as fk
+
+    for s in (512, 1024):
+        b, h, d = 1, 2, 128
+        q = S((b, h, s, d), jnp.bfloat16)
+        res = jax.eval_shape(
+            lambda q, k, v: fk._flash_fwd(
+                q, k, v, None, None, None, True, d ** -0.5, 128, 128,
+                True, 0.0)[1],
+            q, q, q)
+        leaves = _residual_leaves(res)
+        for l in leaves:
+            # no leaf as large as ANY s^2-class buffer ([s,s] or bigger),
+            # and every leaf is within the O(s*d) input/output class
+            assert l.size < s * s, f"s^2 residual {l.shape} at s={s}"
+            assert l.size <= b * h * s * d, (l.shape, l.dtype)
+        # everything beyond the flattened inputs: o [bh, s, d] + lse — O(s*d)
+        total = sum(l.size * l.dtype.itemsize for l in leaves)
+        inputs = 3 * b * h * s * d * 2
+        assert total - inputs <= b * h * s * (2 * d + 8), total
+
+
+def test_flash_residual_structure_is_independent_of_masking_flags():
+    """Causal and non-causal save the same O(s*d) residual class —
+    the no-s^2 contract isn't an artifact of the causal skip."""
+    from apex_tpu.kernels import flash_attention as fk
+
+    b, h, s, d = 1, 1, 512, 128
+    q = S((b, h, s, d), jnp.bfloat16)
+    for causal in (False, True):
+        res = jax.eval_shape(
+            lambda q, k, v: fk._flash_fwd(
+                q, k, v, None, None, None, causal, d ** -0.5, 128, 128,
+                True, 0.0)[1],
+            q, q, q)
+        for l in _residual_leaves(res):
+            assert l.size < s * s, (causal, l.shape)
