@@ -112,6 +112,10 @@ SUITES = {"xentropy": bench_xentropy, "flash": bench_flash,
 def main(argv):
     print(json.dumps({"device": str(jax.devices()[0]),
                       "backend": jax.default_backend()}), flush=True)
+    bad = [n for n in argv if n not in SUITES]
+    if bad:
+        raise SystemExit(f"unknown suite(s) {', '.join(map(repr, bad))}; "
+                         f"pick from {', '.join(sorted(SUITES))}")
     for name in (argv or list(SUITES)):
         SUITES[name]()
 

@@ -255,9 +255,7 @@ def build_parallel_lm(args, policy):
         return {"emb": emb, "stages": {"col": col, "rep": rep},
                 "head": head}
 
-    # rank-major pipe layout: global row r*vpp + c holds logical stage
-    # c*pp + r (build_model's round-robin split)
-    order = np.asarray([c * pp + r for r in range(pp) for c in range(vpp)])
+    order = _stage_order(pp, vpp)
 
     def maybe_rep(p):
         # Under SP, LN/bias params act on seq-LOCAL activations, so each
@@ -522,8 +520,6 @@ def build_parallel_lm(args, policy):
         return jax.ShapeDtypeStruct(tuple(shape), l.dtype)
 
     local_params = jax.tree_util.tree_map_with_path(local_struct, params)
-    local_float = sum(int(np.prod(s.shape))
-                      for s in jax.tree_util.tree_leaves(local_params))
     state_shapes = jax.eval_shape(init_fn, local_params)
 
     def state_spec(path, sds):
@@ -538,9 +534,11 @@ def build_parallel_lm(args, policy):
             # ZeRO m/v shard (DistAdamState fields, matched by name):
             # rank-local over data AND (pipe, model)
             return P(("data", "pipe", "model"))
-        if len(sds.shape) == 1 and int(np.prod(sds.shape)) == local_float:
-            # flat superbuffer (fused_adam m/v): rank-local, stacked over
-            # the (pipe, model) product on the global axis
+        if keys and keys[-1] in ("m", "v") and len(sds.shape) == 1:
+            # flat superbuffer (FusedAdamState.m/.v, matched by field
+            # name — ADVICE r3: a coincidental same-size 1-D leaf must
+            # not be swept in): rank-local, stacked over the
+            # (pipe, model) product on the global axis
             return P(("pipe", "model"))
         return P()
 
@@ -556,6 +554,80 @@ def build_parallel_lm(args, policy):
     n_params = sum(int(np.prod(p.shape))
                    for p in jax.tree_util.tree_leaves(params))
     return mesh, state, jit_step, n_params
+
+
+def _stage_order(pp, vpp):
+    """Rank-major pipe layout: global row r*vpp + c holds logical stage
+    c*pp + r (the interleaved schedule's round-robin split). Shared by the
+    scatter in build_parallel_lm and its inverse in canonicalize_params."""
+    return np.asarray([c * pp + r for r in range(pp) for c in range(vpp)])
+
+
+def canonicalize_params(params, *, pp, vpp, heads, vocab_parallel=False):
+    """Invert build_parallel_lm's (pipe, model) scatter back to the
+    canonical full-weight layout init_params drew from.
+
+    The scatter is pure layout — rank-major stage permutation, explicit tp
+    shard dim on the "col" leaves, vocab-column split on the parallel head
+    — so two runs at different dp/tp/pp agree iff their canonicalized
+    trees agree. This is the reference's cross-rank master-param
+    consistency check (SURVEY §5 — amp_master_params/compare.py) in
+    functional form: tests and the multichip dryrun compare WHOLE final
+    param/master trees, not a loss scalar.
+    """
+    inv = np.argsort(_stage_order(pp, vpp))
+
+    def unstage(l):
+        # global row i holds logical stage order[i]; sort rows into
+        # logical-stage order, then flatten [L, per_stage, ...] -> layers
+        l = l[inv]
+        return l.reshape((l.shape[0] * l.shape[1],) + l.shape[2:])
+
+    col = params["stages"]["col"]
+    qkv = col["qkv_k"][inv]            # [L, tp, per_stage, H, 3H/tp]
+    Ld, tpd, per_stage, H = qkv.shape[:4]
+    d_head = H // heads
+    h_local = heads // tpd
+    qkv_full = jnp.concatenate(
+        [qkv[:, r].reshape(Ld, per_stage, H, 3, h_local, d_head)
+         for r in range(tpd)], axis=4)
+    proj = col["proj_k"][inv]          # [L, tp, per_stage, H/tp, H]
+    proj_full = jnp.concatenate(
+        [proj[:, r].reshape(Ld, per_stage, h_local, d_head, H)
+         for r in range(tpd)], axis=2)
+    mlp_in_full = jnp.concatenate(     # [L, tp, per_stage, H, inner/tp]
+        [col["mlp_in_k"][inv][:, r] for r in range(tpd)], axis=-1)
+    mlp_out_full = jnp.concatenate(    # [L, tp, per_stage, inner/tp, H]
+        [col["mlp_out_k"][inv][:, r] for r in range(tpd)], axis=2)
+
+    def layers_first(l):
+        return l.reshape((Ld * per_stage,) + l.shape[2:])
+
+    head = dict(params["head"])
+    if vocab_parallel:                 # [tp, H, V/tp] -> [H, V]
+        head["kernel"] = jnp.concatenate(
+            [head["kernel"][r] for r in range(head["kernel"].shape[0])],
+            axis=-1)
+    return {
+        "emb": params["emb"],
+        "stages": {
+            "qkv": layers_first(qkv_full),
+            "proj": layers_first(proj_full),
+            "mlp_in": layers_first(mlp_in_full),
+            "mlp_out": layers_first(mlp_out_full),
+            **{k: unstage(v) for k, v in params["stages"]["rep"].items()},
+        },
+        "head": head,
+    }
+
+
+def canonicalize_from_args(params, args):
+    """canonicalize_params with the knobs read off the parsed recipe args."""
+    from apex_tpu.models.transformer_lm import _LM_SIZES
+    heads = _LM_SIZES[args.size][2]
+    return canonicalize_params(params, pp=args.pipeline_parallel,
+                               vpp=args.virtual_pipeline, heads=heads,
+                               vocab_parallel=bool(args.vocab_parallel))
 
 
 def run_parallel(args, policy):
@@ -576,6 +648,7 @@ def run_parallel(args, policy):
           f"params: {n_params:,}")
     rng = jax.random.PRNGKey(args.seed)
     t0, toks, metrics = None, 0, None
+    loss_history = []
     with mesh:
         for it in range(args.iters):
             rng, sub = jax.random.split(rng)
@@ -584,6 +657,7 @@ def run_parallel(args, policy):
             batch = synthetic_tokens(sub, args.batch_size, args.seq_len,
                                      args.vocab_size)
             state, metrics = jit_step(state, batch)
+            loss_history.append(metrics["loss"])
             if it == 2:
                 metrics["loss"].block_until_ready()
                 t0 = time.perf_counter()
@@ -598,6 +672,11 @@ def run_parallel(args, policy):
         dt = time.perf_counter() - t0
         print(f"throughput: "
               f"{(toks - args.batch_size * args.seq_len) / dt:,.0f} tokens/s")
+    if metrics is None:        # --iters 0
+        return None
+    metrics = dict(metrics)
+    metrics["final_state"] = state
+    metrics["loss_history"] = [float(l) for l in loss_history]
     return metrics
 
 

@@ -4,6 +4,14 @@ One command trains an LM with dp x tp x pp on the 8-device CPU mesh, the
 hand-scheduled 1F1B composed with amp O2 master weights + dynamic scaler
 through make_train_step(grad_fn=...). Mirrors the reference pattern of
 Megatron trainers driving apex TP/PP layers + amp (SURVEY P22-P24, §4.5).
+
+Parity is asserted on FULL FINAL PARAM TREES, not loss scalars (VERDICT
+round-3 weak #2): canonicalize_params inverts each configuration's
+(pipe, model) scatter so the whole parameter trajectory — every weight,
+bias, embedding, and head — must agree leaf-for-leaf with the single-rank
+oracle. This is the reference's cross-rank master-param consistency check
+(SURVEY §5 — examples/simple/distributed/amp_master_params/compare.py)
+made configuration-invariant.
 """
 
 import importlib.util
@@ -37,7 +45,24 @@ def _run(lm, extra, opt_level="O0"):
     args = lm.parse_args(BASE + ["--opt-level", opt_level] + extra)
     policy = amp.resolve_policy(opt_level=opt_level,
                                 loss_scale=args.loss_scale, verbose=False)
-    return lm.run_parallel(args, policy)
+    m = lm.run_parallel(args, policy)
+    m["args"] = args
+    return m
+
+
+def _canon(lm, m):
+    """This run's final params in the configuration-invariant layout."""
+    return lm.canonicalize_from_args(m["final_state"].params, m["args"])
+
+
+def _assert_trees_close(got, want, rtol=2e-4, atol=1e-5):
+    """Leaf-for-leaf allclose over whole pytrees, with the failing leaf's
+    key path in the error."""
+    jax.tree_util.tree_map_with_path(
+        lambda path, a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=rtol, atol=atol,
+            err_msg=jax.tree_util.keystr(path)),
+        got, want)
 
 
 _BASELINES: dict = {}
@@ -57,11 +82,20 @@ def _baseline(lm, extra_key=()):
 
 def test_one_command_trains_dp_tp_pp(lm, eight_devices):
     """The VERDICT done-bar: one command, dp2 x tp2 x pp2 over 8 devices,
-    O2 master weights + dynamic scaler, finite decreasing loss."""
+    O2 master weights + dynamic scaler, finite decreasing loss — and the
+    O2 invariant that the half model params ARE the cast masters."""
     m = _run(lm, ["--data-parallel", "2", "--tensor-parallel", "2",
                   "--pipeline-parallel", "2"], opt_level="O2")
     assert np.isfinite(float(m["loss"]))
     assert not bool(m["found_inf"])
+    hist = m["loss_history"]
+    assert all(np.isfinite(hist))
+    assert hist[-1] < hist[0], f"loss did not decrease: {hist}"
+    state = m["final_state"]
+    cast = jax.tree_util.tree_map(
+        lambda mp, p: jnp.asarray(mp, p.dtype),
+        state.master_params, state.params)
+    _assert_trees_close(state.params, cast, rtol=0, atol=0)
 
 
 def test_parallel_trajectory_matches_single_rank_oracle(lm, eight_devices):
@@ -69,21 +103,25 @@ def test_parallel_trajectory_matches_single_rank_oracle(lm, eight_devices):
     the full dp2 x tp2 x pp2 trajectory reproduces the 1-device (grad-
     accumulation, no collectives) trajectory — end-to-end evidence that TP
     sharding, 1F1B scheduling, embedding-cotangent and head-grad plumbing,
-    and the DDP psum all compute the sequential gradients."""
+    and the DDP psum all compute the sequential gradients. Asserted on the
+    whole final param tree, loss included."""
     m_seq = _baseline(lm)
     m_par = _run(lm, ["--data-parallel", "2", "--tensor-parallel", "2",
                       "--pipeline-parallel", "2"])
     np.testing.assert_allclose(float(m_par["loss"]), float(m_seq["loss"]),
                                rtol=2e-4)
+    _assert_trees_close(_canon(lm, m_par), _canon(lm, m_seq))
 
 
 def test_interleaved_vpp_trajectory_matches(lm, eight_devices):
-    """vpp=2 (interleaved 1F1B) computes the same trajectory."""
+    """vpp=2 (interleaved 1F1B) computes the same trajectory — final
+    param tree compared through the chunk-round-robin un-permutation."""
     m_seq = _baseline(lm, ("--layers", "4"))
     m_vpp = _run(lm, ["--layers", "4", "--pipeline-parallel", "2",
                       "--virtual-pipeline", "2"])
     np.testing.assert_allclose(float(m_vpp["loss"]), float(m_seq["loss"]),
                                rtol=2e-4)
+    _assert_trees_close(_canon(lm, m_vpp), _canon(lm, m_seq))
 
 
 def test_sequence_parallel_trajectory_matches(lm, eight_devices):
@@ -96,10 +134,12 @@ def test_sequence_parallel_trajectory_matches(lm, eight_devices):
                         "2", "--sequence-parallel"])
     np.testing.assert_allclose(float(m_sp_pp["loss"]), float(m_seq["loss"]),
                                rtol=2e-4)
+    _assert_trees_close(_canon(lm, m_sp_pp), _canon(lm, m_seq))
     m_sp_tp = _run(lm, ["--tensor-parallel", "2", "--pipeline-parallel",
                         "1", "--sequence-parallel"])
     np.testing.assert_allclose(float(m_sp_tp["loss"]), float(m_seq["loss"]),
                                rtol=2e-4)
+    _assert_trees_close(_canon(lm, m_sp_tp), _canon(lm, m_seq))
 
 
 def test_vocab_parallel_head_trajectory_matches(lm, eight_devices):
@@ -111,34 +151,57 @@ def test_vocab_parallel_head_trajectory_matches(lm, eight_devices):
                         "2", "--vocab-parallel"])
     np.testing.assert_allclose(float(m_vp_pp["loss"]), float(m_seq["loss"]),
                                rtol=2e-4)
+    _assert_trees_close(_canon(lm, m_vp_pp), _canon(lm, m_seq))
     m_vp_tp = _run(lm, ["--tensor-parallel", "2", "--pipeline-parallel",
                         "1", "--vocab-parallel"])
     np.testing.assert_allclose(float(m_vp_tp["loss"]), float(m_seq["loss"]),
                                rtol=2e-4)
+    _assert_trees_close(_canon(lm, m_vp_tp), _canon(lm, m_seq))
 
 
 def test_full_combo_dp_tp_pp_vpp_trajectory(lm, eight_devices):
     """Every axis at once — dp2 x tp2 x pp2 with vpp2 (8 devices, 4 logical
-    stages) reproduces the single-device trajectory."""
+    stages) reproduces the single-device trajectory, whole param tree."""
     m_seq = _baseline(lm, ("--layers", "4"))
     m_all = _run(lm, ["--layers", "4", "--data-parallel", "2",
                       "--tensor-parallel", "2", "--pipeline-parallel", "2",
                       "--virtual-pipeline", "2"])
     np.testing.assert_allclose(float(m_all["loss"]), float(m_seq["loss"]),
                                rtol=2e-4)
+    _assert_trees_close(_canon(lm, m_all), _canon(lm, m_seq))
 
 
 def test_zero_sharded_optimizer_trajectory_matches(lm, eight_devices):
     """--zero (contrib DistributedFusedAdam: mean-reduce-scatter grads,
     1/dp optimizer-state shard per rank, all-gather params) reproduces the
     plain fused_adam trajectory at dp2 x tp2 x pp2 — ZeRO sharding is a
-    memory layout, not a numerics change."""
+    memory layout, not a numerics change. Asserted on the final param
+    tree AND the first-moment superbuffers, de-interleaved shard-to-shard.
+    """
     m_adam = _run(lm, ["--data-parallel", "2", "--tensor-parallel", "2",
                        "--pipeline-parallel", "2"])
     m_zero = _run(lm, ["--data-parallel", "2", "--tensor-parallel", "2",
                        "--pipeline-parallel", "2", "--zero"])
     np.testing.assert_allclose(float(m_zero["loss"]), float(m_adam["loss"]),
                                rtol=2e-4)
+    # same configuration on both sides: params trees compare directly
+    _assert_trees_close(m_zero["final_state"].params,
+                        m_adam["final_state"].params)
+
+    # first moments: fused_adam's global m is the (pipe, model) stack of
+    # rank-local flat buffers [pp*tp, local]; ZeRO's is the same buffers
+    # split 1/dp with data outermost [dp, pp*tp, pad_local/dp] (plus a
+    # divisibility pad at each buffer's tail). De-interleave and trim.
+    dp = pp = tp = 2
+    m_flat = np.asarray(m_adam["final_state"].opt_state.m)
+    local = m_flat.size // (pp * tp)
+    m_ref = m_flat.reshape(pp * tp, local)
+    z_flat = np.asarray(m_zero["final_state"].opt_state.m_shard)
+    shard = z_flat.size // (dp * pp * tp)
+    m_got = (z_flat.reshape(dp, pp * tp, shard).transpose(1, 0, 2)
+             .reshape(pp * tp, dp * shard)[:, :local])
+    np.testing.assert_allclose(m_got, m_ref, rtol=2e-4, atol=1e-7)
+
     # and the documented O2 composition: masters + dynamic scaler + ZeRO
     m_zero_o2 = _run(lm, ["--data-parallel", "2", "--tensor-parallel", "2",
                           "--pipeline-parallel", "2", "--zero"],
