@@ -630,7 +630,20 @@ def canonicalize_from_args(params, args):
                                vocab_parallel=bool(args.vocab_parallel))
 
 
+def assert_trees_close(got, want, rtol=2e-4, atol=1e-5):
+    """Leaf-for-leaf allclose over whole pytrees, failing with the leaf's
+    key path. Shared by the hermetic parity tests and the multichip
+    dryrun so both certify the same canonicalized-tree agreement."""
+    jax.tree_util.tree_map_with_path(
+        lambda path, a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=rtol, atol=atol,
+            err_msg=jax.tree_util.keystr(path)),
+        got, want)
+
+
 def run_parallel(args, policy):
+    if args.iters < 1:
+        raise SystemExit("--iters must be >= 1")
     if args.data:
         raise SystemExit("--data is not supported on the model-parallel "
                          "path yet; drop it or run single-chip")
@@ -672,8 +685,6 @@ def run_parallel(args, policy):
         dt = time.perf_counter() - t0
         print(f"throughput: "
               f"{(toks - args.batch_size * args.seq_len) / dt:,.0f} tokens/s")
-    if metrics is None:        # --iters 0
-        return None
     metrics = dict(metrics)
     metrics["final_state"] = state
     metrics["loss_history"] = [float(l) for l in loss_history]

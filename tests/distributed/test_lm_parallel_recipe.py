@@ -28,12 +28,19 @@ _RECIPE = os.path.join(os.path.dirname(__file__), os.pardir, os.pardir,
                        "examples", "lm", "main_amp.py")
 
 
-@pytest.fixture(scope="module")
-def lm():
+def _load_recipe():
     spec = importlib.util.spec_from_file_location("lm_recipe", _RECIPE)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
+
+
+_LM = _load_recipe()
+
+
+@pytest.fixture(scope="module")
+def lm():
+    return _LM
 
 
 BASE = ["--size", "tiny", "--vocab-size", "128", "--seq-len", "16",
@@ -55,14 +62,9 @@ def _canon(lm, m):
     return lm.canonicalize_from_args(m["final_state"].params, m["args"])
 
 
-def _assert_trees_close(got, want, rtol=2e-4, atol=1e-5):
-    """Leaf-for-leaf allclose over whole pytrees, with the failing leaf's
-    key path in the error."""
-    jax.tree_util.tree_map_with_path(
-        lambda path, a, b: np.testing.assert_allclose(
-            np.asarray(a), np.asarray(b), rtol=rtol, atol=atol,
-            err_msg=jax.tree_util.keystr(path)),
-        got, want)
+# leaf-for-leaf allclose with the failing leaf's key path — the recipe's
+# own helper, shared with the multichip dryrun
+_assert_trees_close = _LM.assert_trees_close
 
 
 _BASELINES: dict = {}
