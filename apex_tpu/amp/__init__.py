@@ -31,7 +31,7 @@ import jax.numpy as jnp
 
 from . import lists  # noqa: F401
 from .autocast import (active_policy, autocast, cast_op_inputs,
-                       op_compute_dtype, resolve_dtype)
+                       op_compute_dtype, resolve_dtype, trace_token)
 from .policy import Policy, default_is_norm_param, opt_levels, resolve_policy
 from .scaler import (LossScaler, ScalerState, init_scaler, scale_loss as
                      _scale_loss_fn, unscale, unscale_with_stashed,
@@ -46,7 +46,7 @@ __all__ = [
     "register_half_function", "register_float_function",
     "register_promote_function",
     "autocast", "active_policy", "op_compute_dtype", "resolve_dtype",
-    "cast_op_inputs",
+    "cast_op_inputs", "trace_token",
 ]
 
 # Global registry mirroring apex/amp/_amp_state.py — class AmpState: frontends

@@ -10,7 +10,13 @@ of the traced forward, and policy-aware modules ask :func:`op_compute_dtype`
 what dtype the table assigns their op.
 
 The context is thread-local Python state consulted at *trace* time only —
-nothing here appears in the jaxpr except the casts it decides on.
+nothing here appears in the jaxpr except the casts it decides on. Because
+jit caches by jaxpr inputs, the active policy is ALSO salted into jax's
+jit cache key (``include_in_jit_key`` config state): a user-jitted
+policy-aware function traced under one ambient policy re-traces — instead
+of silently reusing stale cast decisions — when called under another
+(ADVICE r2 #1; apex re-applies its patches on every ``amp.initialize``,
+so stale wrappers cannot survive a policy change there either).
 """
 
 from __future__ import annotations
@@ -24,9 +30,29 @@ import jax.numpy as jnp
 from . import lists
 
 __all__ = ["autocast", "active_policy", "op_compute_dtype", "resolve_dtype",
-           "cast_op_inputs"]
+           "cast_op_inputs", "trace_token"]
 
 _tls = threading.local()
+
+# jit-cache salt: a jax user context carrying the active policy — part of
+# the tracing/lowering/compilation cache key, so jit distinguishes traces
+# made under different ambient policies. Gated for older jax without the
+# API: the fallback is thread-local state only, with trace_token() for
+# manual static-arg salting.
+try:
+    import jax as _jax
+
+    _policy_state = _jax.make_user_context(default_value=None)
+except AttributeError:  # pragma: no cover - jax without make_user_context
+    import warnings
+
+    warnings.warn(
+        "this jax has no make_user_context: the ambient amp policy cannot "
+        "be salted into the jit cache key, so a function YOU jit and call "
+        "under different autocast policies will silently reuse its first "
+        "trace's cast decisions. Re-jit per policy, or upgrade jax.",
+        stacklevel=2)
+    _policy_state = None
 
 
 def active_policy():
@@ -34,15 +60,31 @@ def active_policy():
     return getattr(_tls, "policy", None)
 
 
+def trace_token():
+    """A hashable fingerprint of the active policy (None outside
+    :func:`autocast`). jit already re-traces on policy changes via the
+    cache salt; pass this as an extra static argument for caches jax does
+    not manage (e.g. functools.lru_cache over traced helpers)."""
+    return active_policy()
+
+
 @contextlib.contextmanager
 def autocast(policy):
     """Install ``policy`` as the ambient op-cast policy (the O1 engine's
     analogue of apex applying its patches at ``amp.initialize`` time —
-    scoped, because trace-time globals must not leak across steps)."""
+    scoped, because trace-time globals must not leak across steps).
+
+    Entering also salts jax's jit cache with the policy, so re-entering a
+    previously-jitted function under a different policy re-traces it with
+    the new cast decisions rather than reusing the old executable."""
     prev = getattr(_tls, "policy", None)
     _tls.policy = policy
     try:
-        yield policy
+        if _policy_state is not None:
+            with _policy_state(policy):
+                yield policy
+        else:
+            yield policy
     finally:
         _tls.policy = prev
 

@@ -314,3 +314,35 @@ def test_contrib_mha_consults_engine():
     assert enc.apply(ve, x, x, is_training=False).dtype == jnp.float32
     with autocast(O1):
         assert enc.apply(ve, x, x, is_training=False).dtype == jnp.bfloat16
+
+
+def test_jit_cache_salted_by_ambient_policy():
+    """ADVICE r2 #1, engineered (round 4): a USER-jitted policy-aware
+    function traced under one ambient policy must not silently reuse its
+    stale cast decisions under another — the active policy is part of the
+    jit cache key, so re-entry re-traces. apex can't hit this (patches are
+    re-applied at every amp.initialize); the trace-time engine must salt
+    the cache instead."""
+    traces = []
+
+    @jax.jit
+    def f(x, w):
+        traces.append(1)  # trace-time side effect: counts retraces
+        a, b = amp.cast_op_inputs("matmul", x, w)
+        return a @ b
+
+    x = jnp.ones((4, 8), jnp.float32)
+    w = jnp.ones((8, 4), jnp.float32)
+
+    with autocast(O1):
+        assert f(x, w).dtype == jnp.bfloat16   # O1: matmul runs half
+    # same jitted fn, no ambient policy: must re-trace and run fp32,
+    # NOT reuse the O1 executable
+    assert f(x, w).dtype == jnp.float32
+    with autocast(O3):                          # O3 patches nothing
+        assert f(x, w).dtype == jnp.float32
+    with autocast(O1):                          # back to O1: cache hit
+        assert f(x, w).dtype == jnp.bfloat16
+    assert len(traces) == 3, (
+        f"expected 3 traces (O1, none, O3; final O1 cached), got "
+        f"{len(traces)}")
