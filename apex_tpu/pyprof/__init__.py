@@ -12,19 +12,27 @@ TPU-native mapping (SURVEY §6 — tracing):
 - nvprof capture → :func:`trace` around ``jax.profiler`` (perfetto dump).
 - the flop/byte report → :func:`cost_report`, straight from XLA's own cost
   analysis of the compiled executable — no dump parsing, the compiler knows.
+- pyprof/parse + pyprof/prof (sqlite dump → per-kernel table) →
+  :func:`analyze`: parse the captured trace's device lane into per-op rows
+  (occurrences, ms, flops, bytes) and :func:`report` to format them.
 - iteration timing (main_amp.py --prof N's role) → :class:`StepTimer`.
 """
 
 from __future__ import annotations
 
 import contextlib
+import glob
+import gzip
+import json
+import os
 import time
 from typing import Any, Callable, Dict, List, Optional
 
 import jax
 import numpy as np
 
-__all__ = ["init", "annotate", "trace", "cost_report", "StepTimer"]
+__all__ = ["init", "annotate", "trace", "cost_report", "analyze", "report",
+           "StepTimer"]
 
 _enabled = True
 
@@ -93,6 +101,128 @@ def cost_report(fn: Callable, *args, **kwargs) -> Dict[str, Any]:
         "raw": dict(raw),
     }
     return report
+
+
+def _trace_files(trace_dir: str) -> List[str]:
+    """The newest profile run's chrome-trace dumps under ``trace_dir``
+    (one per host), or ``trace_dir`` itself if it is already a dump."""
+    if os.path.isfile(trace_dir):
+        return [trace_dir]
+    runs = sorted(glob.glob(os.path.join(
+        trace_dir, "plugins", "profile", "*")))
+    if not runs:
+        raise FileNotFoundError(
+            f"no profile runs under {trace_dir!r} — capture one with "
+            "pyprof.trace(log_dir) first")
+    files = sorted(glob.glob(os.path.join(runs[-1], "*.trace.json.gz")))
+    if not files:
+        raise FileNotFoundError(f"no *.trace.json.gz in {runs[-1]!r}")
+    return files
+
+
+def _leaf_spans(evs: List[dict]) -> List[dict]:
+    """Drop spans that enclose another span on the same (pid, tid) lane —
+    parents double-count their children's time. One sorted sweep per lane
+    with an open-interval stack."""
+    lanes: Dict[tuple, List[dict]] = {}
+    for e in evs:
+        lanes.setdefault((e.get("pid"), e.get("tid")), []).append(e)
+    out: List[dict] = []
+    for lane in lanes.values():
+        lane.sort(key=lambda e: (float(e.get("ts", 0.0)),
+                                 -float(e.get("dur", 0.0))))
+        parents: set = set()
+        stack: List[tuple] = []          # (end_ts, id(event))
+        for e in lane:
+            ts = float(e.get("ts", 0.0))
+            end = ts + float(e.get("dur", 0.0))
+            while stack and ts >= stack[-1][0]:
+                stack.pop()
+            if stack:                    # e nests inside stack[-1]
+                parents.add(stack[-1][1])
+            stack.append((end, id(e)))
+        out += [e for e in lane if id(e) not in parents]
+    return out
+
+
+def analyze(trace_dir: str, top: Optional[int] = None) -> List[Dict[str, Any]]:
+    """Per-op table from a captured trace — the reference's pyprof/parse +
+    pyprof/prof stages (nvprof sqlite → per-kernel name/occurrence/ns/
+    flops/bytes report) applied to the ``jax.profiler`` dump that
+    :func:`trace` writes.
+
+    Reads the device lanes' HLO-op events (each carries its duration plus
+    XLA's own ``model_flops`` / ``bytes_accessed``) and aggregates by op
+    name. Returns rows sorted by total time, descending::
+
+        {"name", "category", "occurrences", "total_ms", "mean_ms",
+         "flops", "bytes", "intensity", "pct_time"}
+
+    ``flops``/``bytes`` are totals across occurrences; ``intensity`` is
+    flops/byte; ``pct_time`` is this op's share of all device-op time.
+    When the dump has no cost-annotated device ops (host-only capture,
+    or a backend without per-op HLO args), leaf spans are tabulated
+    instead — parents that enclose other spans are dropped so region
+    wrappers don't double-count their children — with zero flops/bytes.
+    """
+    # (lane_name, event) pairs — pid namespaces are PER FILE (one dump per
+    # host), so classify against each file's own process_name metadata
+    events: List[tuple] = []
+    for path in _trace_files(trace_dir):
+        with gzip.open(path, "rt") as f:
+            data = json.load(f)
+        evs = data.get("traceEvents", [])
+        pids = {e["pid"]: e.get("args", {}).get("name", "")
+                for e in evs
+                if e.get("ph") == "M" and e.get("name") == "process_name"}
+        events += [(pids.get(e.get("pid"), ""), e)
+                   for e in evs if e.get("ph") == "X"]
+
+    dev = [e for lane, e in events if lane.startswith("/device:")]
+    # per-op HLO events carry hlo_category; region/module spans (jit_fn(…))
+    # don't and would double-count their children's time
+    ops = [e for e in dev if "hlo_category" in e.get("args", {})]
+    if not ops:
+        # degraded mode (no cost-annotated device ops): keep only LEAF
+        # spans — a parent region would double-count its children
+        ops = _leaf_spans(dev or [e for _, e in events])
+
+    rows: Dict[str, Dict[str, Any]] = {}
+    for e in ops:
+        args = e.get("args", {})
+        r = rows.setdefault(e["name"], {
+            "name": e["name"],
+            "category": args.get("hlo_category", ""),
+            "occurrences": 0, "total_ms": 0.0,
+            "flops": 0.0, "bytes": 0.0,
+        })
+        r["occurrences"] += 1
+        r["total_ms"] += float(e.get("dur", 0.0)) / 1e3   # dur is µs
+        r["flops"] += float(args.get("model_flops", 0.0))
+        r["bytes"] += float(args.get("raw_bytes_accessed",
+                                     args.get("bytes_accessed", 0.0)))
+    total_ms = sum(r["total_ms"] for r in rows.values()) or 1.0
+    out = sorted(rows.values(), key=lambda r: -r["total_ms"])
+    for r in out:
+        r["mean_ms"] = r["total_ms"] / r["occurrences"]
+        r["intensity"] = r["flops"] / r["bytes"] if r["bytes"] else 0.0
+        r["pct_time"] = 100.0 * r["total_ms"] / total_ms
+    return out[:top] if top else out
+
+
+def report(rows: List[Dict[str, Any]]) -> str:
+    """Format :func:`analyze` rows as the aligned text table the
+    reference's ``python -m pyprof.prof`` prints."""
+    hdr = f"{'op':<40} {'n':>5} {'ms':>10} {'%':>6} {'GFLOP':>10} " \
+          f"{'MB':>10} {'F/B':>8}"
+    lines = [hdr, "-" * len(hdr)]
+    for r in rows:
+        lines.append(
+            f"{r['name'][:40]:<40} {r['occurrences']:>5} "
+            f"{r['total_ms']:>10.3f} {r['pct_time']:>6.1f} "
+            f"{r['flops'] / 1e9:>10.3f} {r['bytes'] / 1e6:>10.3f} "
+            f"{r['intensity']:>8.2f}")
+    return "\n".join(lines)
 
 
 class StepTimer:

@@ -64,3 +64,53 @@ def test_step_timer_report():
     assert rep["items_per_s"] > 0
     assert rep["p90_s"] >= rep["p50_s"] >= 0
     assert StepTimer().report() == {"steps": 0}
+
+
+def test_analyze_trace_per_op_table(tmp_path):
+    """pyprof.analyze — the pyprof/parse + pyprof/prof stages (P42): a
+    captured trace yields per-op rows with occurrences, time, and XLA's
+    flop/byte accounting; pyprof.report formats them."""
+    d = os.path.join(tmp_path, "tr")
+
+    @jax.jit
+    def f(x, w):
+        return jnp.tanh(x @ w).sum()
+
+    x = jnp.ones((64, 64)); w = jnp.ones((64, 64))
+    f(x, w).block_until_ready()          # compile outside the capture
+    n_steps = 3
+    with trace(d):
+        for _ in range(n_steps):
+            f(x, w).block_until_ready()
+
+    rows = pyprof.analyze(d)
+    assert rows, "no ops extracted from the trace"
+    for r in rows:
+        assert r["occurrences"] >= 1
+        assert r["total_ms"] >= 0.0
+        assert r["mean_ms"] == pytest.approx(
+            r["total_ms"] / r["occurrences"])
+    # shares sum to ~100%
+    assert sum(r["pct_time"] for r in rows) == pytest.approx(100.0, abs=1.0)
+    # rows sorted by total time, descending
+    times = [r["total_ms"] for r in rows]
+    assert times == sorted(times, reverse=True)
+    # the dominant op repeated once per step
+    assert max(r["occurrences"] for r in rows) >= n_steps
+    # the matmul's flops are visible somewhere in the table (2*M*N*K,
+    # counted once per step) — only asserted when the backend emits
+    # device-lane cost args (hlo_category rows)
+    if any(r["category"] for r in rows):
+        total_flops = sum(r["flops"] for r in rows)
+        assert total_flops >= 2 * 64 * 64 * 64 * n_steps * 0.5
+    # top= truncates
+    assert len(pyprof.analyze(d, top=2)) <= 2
+    # report renders every row plus a 2-line header
+    txt = pyprof.report(rows)
+    assert len(txt.splitlines()) == len(rows) + 2
+    assert "op" in txt.splitlines()[0]
+
+
+def test_analyze_missing_dir_raises(tmp_path):
+    with pytest.raises(FileNotFoundError, match="no profile runs"):
+        pyprof.analyze(os.path.join(tmp_path, "nothing_here"))
