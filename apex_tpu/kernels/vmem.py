@@ -64,12 +64,16 @@ def overrides() -> dict:
 
 def load_overrides(path: str) -> dict:
     """Load a ``bench_kernels.py --sweep`` JSON ({key: value}) into the
-    registry; returns the loaded mapping."""
+    registry; returns the loaded mapping.
+
+    The whole file is validated before any entry is committed, so a bad
+    value (non-integer) leaves the registry untouched rather than
+    partially overwritten (ADVICE r3)."""
     with open(path) as f:
         data = json.load(f)
-    for k, v in data.items():
-        set_override(k, v)
-    return data
+    validated = {str(k): int(v) for k, v in data.items()}
+    _OVERRIDES.update(validated)
+    return validated
 
 
 if os.environ.get("APEX_TPU_TUNED"):

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import threading
 from typing import Any, Optional, Tuple
 
@@ -52,8 +53,20 @@ def _leaf_paths(state) -> list:
     return [jax.tree_util.keystr(p) for p, _ in flat_p]
 
 
+_LAST_SEGMENT = re.compile(
+    r"(?:\.([A-Za-z_]\w*)"          # .attr        (GetAttrKey)
+    r"|\[['\"]([^'\"]+)['\"]\]"     # ['key']      (DictKey)
+    r"|\[(\d+)\])$")                # [idx]        (SequenceKey)
+
+
 def _path_field(path: str) -> str:
-    """Final attribute/key name of a keystr path like ".scaler.loss_scale"."""
+    """Final attribute/key name of a keystr path — handles ".attr",
+    "['key']", and "[idx]" terminal segments (ADVICE r3: dict-keyed
+    leaves like "…['hysteresis_left']" must parse to the bare name, or
+    migratable fields under dict nodes are never detected)."""
+    m = _LAST_SEGMENT.search(path)
+    if m:
+        return next(g for g in m.groups() if g is not None)
     return path.rsplit(".", 1)[-1].strip("[]'\"")
 
 

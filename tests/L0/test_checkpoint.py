@@ -185,3 +185,36 @@ def test_latest_checkpoint_and_async(tmp_path):
     np.testing.assert_array_equal(np.asarray(restored["a"]),
                                   np.arange(4.0))
     assert latest_checkpoint(str(tmp_path) + "/nope") is None
+
+
+def test_path_field_parses_dict_and_index_segments():
+    """ADVICE r3: keystr terminal segments come in three forms — ".attr"
+    (GetAttrKey), "['key']" (DictKey), "[idx]" (SequenceKey) — and all
+    must parse to the bare field name, or migratable fields under dict
+    nodes are never detected."""
+    from apex_tpu.utils.checkpoint import _path_field
+
+    assert _path_field(".scaler.hysteresis_left") == "hysteresis_left"
+    assert _path_field(".scaler['hysteresis_left']") == "hysteresis_left"
+    assert _path_field('.scaler["hysteresis_left"]') == "hysteresis_left"
+    assert _path_field("['opt']['hysteresis_left']") == "hysteresis_left"
+    assert _path_field(".stack[3]") == "3"
+
+
+def test_migration_detects_dict_keyed_field(tmp_path):
+    """A migratable field living under a DICT node (keystr
+    "…['hysteresis_left']") migrates the same way the dataclass-attribute
+    form does — an old checkpoint without the leaf restores, the new
+    field keeping the template's default."""
+    old = {"w": jnp.arange(3.0), "extras": {"count": jnp.asarray(7)}}
+    path = os.path.join(tmp_path, "old.npz")
+    save_checkpoint(path, old, step=5)
+
+    template = {"w": jnp.zeros(3), "extras": {
+        "count": jnp.asarray(0), "hysteresis_left": jnp.asarray(2)}}
+    restored, step, _ = load_checkpoint(path, template)
+    assert step == 5
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.arange(3.0))
+    assert int(restored["extras"]["count"]) == 7
+    assert int(restored["extras"]["hysteresis_left"]) == 2  # template fill

@@ -135,3 +135,20 @@ def test_flash_block_override_used():
     ref = mha_reference(q, k, v, causal=True, scale=128 ** -0.5)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_load_overrides_atomic_on_bad_value(tmp_path):
+    """ADVICE r3: a file with one non-integer value must leave the
+    registry untouched — validate whole, then commit."""
+    import os
+
+    vmem.clear_overrides()
+    vmem.set_override("layer_norm.block_rows", 128)
+    bad = os.path.join(tmp_path, "tuned.json")
+    with open(bad, "w") as f:
+        json.dump({"flash.block_q": 256, "flash.block_k": "not-an-int"}, f)
+    with pytest.raises(ValueError):
+        vmem.load_overrides(bad)
+    assert vmem.overrides() == {"layer_norm.block_rows": 128}, \
+        "partial override set committed from an invalid file"
+    vmem.clear_overrides()
