@@ -12,9 +12,11 @@ al. 2019). The recipe keeps DeepLearningExamples' argument names
 (--train_batch_size, --max_seq_length, --max_predictions_per_seq,
 --warmup_proportion) and the poly-decay warmup schedule.
 
-Synthetic data only in this environment (no network); batches follow the
-BERT input schema: (input_ids, token_type_ids, attention_mask,
-masked_lm_positions, masked_lm_ids, next_sentence_labels).
+Data: ``--data shards.npz`` loads pre-tokenized examples carrying the
+DeepLearningExamples hdf5-shard fields (input_ids, token_type_ids,
+attention_mask, masked_lm_positions, masked_lm_ids,
+next_sentence_labels); without it, synthetic batches with the same
+schema (no network in this environment).
 """
 
 from __future__ import annotations
@@ -60,7 +62,48 @@ def parse_args(argv=None):
                    help="DDP over an N-way 'data' mesh axis (LAMB update "
                         "on psum-averaged grads — the reference's "
                         "multi-GPU BERT-LAMB shape)")
+    p.add_argument("--data", default=None,
+                   help="pre-tokenized .npz with the BERT input schema "
+                        "(input_ids, token_type_ids, attention_mask, "
+                        "masked_lm_positions, masked_lm_ids, "
+                        "next_sentence_labels) — the DeepLearningExamples "
+                        "hdf5 shards' fields; synthetic batches otherwise")
     return p.parse_args(argv)
+
+
+_DATA_KEYS = ("input_ids", "token_type_ids", "attention_mask",
+              "masked_lm_positions", "masked_lm_ids",
+              "next_sentence_labels")
+
+
+def load_pretokenized(path, seq_len, n_pred):
+    """Load + validate a pre-tokenized .npz against the run's shapes."""
+    with np.load(path) as z:
+        missing = [k for k in _DATA_KEYS if k not in z]
+        if missing:
+            raise SystemExit(f"--data {path!r} is missing fields "
+                             f"{missing}; need {list(_DATA_KEYS)}")
+        data = {k: np.asarray(z[k]) for k in _DATA_KEYS}
+    if data["input_ids"].shape[1] != seq_len:
+        raise SystemExit(
+            f"--data sequences are {data['input_ids'].shape[1]} long; "
+            f"--max_seq_length is {seq_len}")
+    if data["masked_lm_positions"].shape[1] != n_pred:
+        raise SystemExit(
+            f"--data has {data['masked_lm_positions'].shape[1]} "
+            f"prediction slots; --max_predictions_per_seq is {n_pred}")
+    counts = {k: len(v) for k, v in data.items()}
+    if len(set(counts.values())) != 1:
+        raise SystemExit(f"--data fields disagree on example count: "
+                         f"{counts}")
+    if len(data["input_ids"]) == 0:
+        raise SystemExit(f"--data {path!r} holds zero examples")
+    if int(data["masked_lm_positions"].max()) >= seq_len:
+        raise SystemExit(
+            f"--data masked_lm_positions reach "
+            f"{int(data['masked_lm_positions'].max())}; sequences are "
+            f"{seq_len} long (jit would clamp the gather silently)")
+    return data
 
 
 def synthetic_bert_batch(rng, batch, seq_len, n_pred, vocab):
@@ -169,18 +212,43 @@ def main(argv=None):
                    for p in jax.tree_util.tree_leaves(params))
     print(f"=> BERT-{args.bert_model} dp={dp}, params: {n_params:,}")
 
+    data = None
+    if args.data:
+        data = load_pretokenized(args.data, args.max_seq_length,
+                                 args.max_predictions_per_seq)
+        # range-check LABELS too: an out-of-vocab masked_lm_id would be
+        # clamped by XLA's gather under jit — silently wrong loss, not
+        # a crash
+        top = max(int(data["input_ids"].max()),
+                  int(data["masked_lm_ids"].max()))
+        if top >= cfg.vocab_size:
+            raise SystemExit(
+                f"--data token ids reach {top}; "
+                f"BERT-{args.bert_model} vocab is {cfg.vocab_size}")
+        print(f"=> {len(data['input_ids'])} pre-tokenized examples "
+              f"from {args.data}")
+
     t0 = None
     seqs = 0
     metrics = None
+    loss_history = []
     with ctx:
         for it in range(args.max_steps):
             rng, sub = jax.random.split(rng)
             sub, drop = jax.random.split(sub)
-            batch = synthetic_bert_batch(sub, args.train_batch_size,
-                                         args.max_seq_length,
-                                         args.max_predictions_per_seq,
-                                         cfg.vocab_size) + (drop,)
+            if data is not None:
+                idx = np.asarray(jax.random.randint(
+                    sub, (args.train_batch_size,), 0,
+                    len(data["input_ids"])))
+                batch = tuple(jnp.asarray(data[k][idx])
+                              for k in _DATA_KEYS) + (drop,)
+            else:
+                batch = synthetic_bert_batch(sub, args.train_batch_size,
+                                             args.max_seq_length,
+                                             args.max_predictions_per_seq,
+                                             cfg.vocab_size) + (drop,)
             state, metrics = jit_step(state, batch)
+            loss_history.append(metrics["loss"])
             if it == 4:
                 metrics["loss"].block_until_ready()
                 t0 = time.perf_counter()
@@ -195,6 +263,12 @@ def main(argv=None):
         dt = time.perf_counter() - t0
         print(f"throughput: "
               f"{(seqs - args.train_batch_size) / dt:,.1f} sequences/s")
+    if metrics is None:
+        return None
+    metrics = dict(metrics)
+    # one device-to-host transfer for the whole history, not one per step
+    metrics["loss_history"] = np.asarray(jnp.stack(loss_history),
+                                         np.float32).tolist()
     return metrics
 
 

@@ -687,7 +687,9 @@ def run_parallel(args, policy):
               f"{(toks - args.batch_size * args.seq_len) / dt:,.0f} tokens/s")
     metrics = dict(metrics)
     metrics["final_state"] = state
-    metrics["loss_history"] = [float(l) for l in loss_history]
+    # one device-to-host transfer for the whole history
+    metrics["loss_history"] = np.asarray(jnp.stack(loss_history),
+                                         np.float32).tolist()
     return metrics
 
 
@@ -731,6 +733,8 @@ def main(argv=None):
 
     t0 = None
     toks = 0
+    metrics = None
+    loss_history = []
     for it in range(args.iters):
         rng, sub = jax.random.split(rng)
         if args.deterministic:
@@ -744,6 +748,7 @@ def main(argv=None):
             batch = synthetic_tokens(sub, args.batch_size, args.seq_len,
                                      args.vocab_size)
         state, metrics = jit_step(state, batch)
+        loss_history.append(metrics["loss"])
         if it == 4:
             metrics["loss"].block_until_ready()
             t0 = time.perf_counter()
@@ -757,6 +762,14 @@ def main(argv=None):
         dt = time.perf_counter() - t0
         print(f"throughput: "
               f"{(toks - args.batch_size * args.seq_len) / dt:,.0f} tokens/s")
+    if metrics is None:
+        return None
+    metrics = dict(metrics)
+    metrics["final_state"] = state
+    # one device-to-host transfer for the whole history
+    metrics["loss_history"] = np.asarray(jnp.stack(loss_history),
+                                         np.float32).tolist()
+    return metrics
 
 
 if __name__ == "__main__":

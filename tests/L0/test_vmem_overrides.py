@@ -143,12 +143,15 @@ def test_load_overrides_atomic_on_bad_value(tmp_path):
     import os
 
     vmem.clear_overrides()
-    vmem.set_override("layer_norm.block_rows", 128)
-    bad = os.path.join(tmp_path, "tuned.json")
-    with open(bad, "w") as f:
-        json.dump({"flash.block_q": 256, "flash.block_k": "not-an-int"}, f)
-    with pytest.raises(ValueError):
-        vmem.load_overrides(bad)
-    assert vmem.overrides() == {"layer_norm.block_rows": 128}, \
-        "partial override set committed from an invalid file"
-    vmem.clear_overrides()
+    try:
+        vmem.set_override("layer_norm.block_rows", 128)
+        bad = os.path.join(tmp_path, "tuned.json")
+        with open(bad, "w") as f:
+            json.dump({"flash.block_q": 256,
+                       "flash.block_k": "not-an-int"}, f)
+        with pytest.raises(ValueError):
+            vmem.load_overrides(bad)
+        assert vmem.overrides() == {"layer_norm.block_rows": 128}, \
+            "partial override set committed from an invalid file"
+    finally:
+        vmem.clear_overrides()
