@@ -36,7 +36,8 @@ from typing import Any, Callable, Optional, Sequence
 import jax
 
 __all__ = ["MemoryStats", "compiled_memory", "price_contract",
-           "xentropy_contract", "flash_contract", "remat_mlp_contract"]
+           "xentropy_contract", "flash_contract", "remat_mlp_contract",
+           "causal_softmax_contract", "masked_softmax_contract"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -141,6 +142,67 @@ def remat_mlp_contract(n_layers: int, n: int, hdim: int):
     plain = jax.value_and_grad(functools.partial(net, remat=False))
     remat = jax.value_and_grad(functools.partial(net, remat=True))
     return plain, remat, avals, n_layers * n * 4 * hdim * 4
+
+
+def causal_softmax_contract(b: int, h: int, s: int, with_bwd: bool):
+    """Canonical N8 fused-causal-softmax pricing: (fused_fn, composed_fn,
+    avals, theory_bytes). The kernel's contract is half I/O with per-tile
+    fp32 math (apex/csrc/megatron/scaled_upper_triang_masked_softmax.h
+    computes fp32 in registers over half storage); the composed path
+    upcasts the whole [b, h, s, s] scores plane. Theory = the fp32-vs-bf16
+    difference on one scores buffer (b·h·s·s·2)."""
+    import jax.numpy as jnp
+
+    from apex_tpu.kernels.causal_softmax import (causal_softmax,
+                                                 causal_softmax_reference)
+
+    avals = [jax.ShapeDtypeStruct((b, h, s, s), jnp.bfloat16)]
+    scale = 0.125
+
+    def fused_fwd(x):
+        return causal_softmax(x, scale=scale)
+
+    def composed_fwd(x):
+        return causal_softmax_reference(x, scale=scale).astype(x.dtype)
+
+    if with_bwd:
+        fused = jax.value_and_grad(
+            lambda x: jax.numpy.sum(fused_fwd(x).astype(jnp.float32)))
+        composed = jax.value_and_grad(
+            lambda x: jax.numpy.sum(composed_fwd(x).astype(jnp.float32)))
+    else:
+        fused, composed = fused_fwd, composed_fwd
+    return fused, composed, avals, b * h * s * s * 2
+
+
+def masked_softmax_contract(b: int, h: int, s: int, with_bwd: bool):
+    """Canonical N8 arbitrary-mask softmax pricing — like
+    :func:`causal_softmax_contract` but with the [b, 1, s, s] int8 mask
+    operand (apex/csrc/megatron/scaled_masked_softmax.h)."""
+    import jax.numpy as jnp
+
+    from apex_tpu.kernels.masked_softmax import (masked_softmax,
+                                                 masked_softmax_reference)
+
+    avals = [jax.ShapeDtypeStruct((b, h, s, s), jnp.bfloat16),
+             jax.ShapeDtypeStruct((b, 1, s, s), jnp.int8)]
+    scale = 0.125
+
+    def fused_fwd(x, m):
+        return masked_softmax(x, m, scale=scale)
+
+    def composed_fwd(x, m):
+        return masked_softmax_reference(x, m, scale=scale).astype(x.dtype)
+
+    if with_bwd:
+        fused = jax.value_and_grad(
+            lambda x, m: jax.numpy.sum(fused_fwd(x, m).astype(jnp.float32)))
+        composed = jax.value_and_grad(
+            lambda x, m: jax.numpy.sum(
+                composed_fwd(x, m).astype(jnp.float32)))
+    else:
+        fused, composed = fused_fwd, composed_fwd
+    return fused, composed, avals, b * h * s * s * 2
 
 
 def price_contract(name: str, fused_fn: Callable, composed_fn: Callable,

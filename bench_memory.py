@@ -69,6 +69,37 @@ def bench_flash():
                             theory_bytes=theory), f"b{b} h{h} s{s} d{d}")
 
 
+def bench_fused_softmax():
+    """Honest rows: the N8 kernels' contract is HALF I/O (bf16 storage,
+    per-tile fp32 math), not peak memory. Their custom_vjp saves the
+    bf16 probs — exactly the reference's saved softmax_results
+    (apex/csrc/megatron/scaled_*_softmax_cuda.cu backward reads them) —
+    while XLA's composed path REMATERIALIZES the softmax into the
+    backward, keeping ~0 residual. At the module boundary the fused rows
+    therefore price NEGATIVE (reference-parity residuals, not a win);
+    the bandwidth win is a time quantity the emulator cannot measure."""
+    from apex_tpu.utils.memory_report import (causal_softmax_contract,
+                                              masked_softmax_contract,
+                                              price_contract)
+
+    note = ("saves bf16 probs like the reference backward; XLA "
+            "rematerializes instead - peak delta is an honest negative, "
+            "the contract is I/O not residency")
+    for b, h, s in ((8, 16, 1024), (4, 16, 2048)):
+        fused, composed, avals, theory = causal_softmax_contract(
+            b, h, s, with_bwd=True)
+        row = price_contract("causal_softmax_fwd_bwd", fused, composed,
+                             avals, theory_bytes=theory)
+        row["note"] = note
+        emit(row, f"b{b} h{h} s{s}")
+        fused, composed, avals, theory = masked_softmax_contract(
+            b, h, s, with_bwd=True)
+        row = price_contract("masked_softmax_fwd_bwd", fused, composed,
+                             avals, theory_bytes=theory)
+        row["note"] = note
+        emit(row, f"b{b} h{h} s{s}")
+
+
 def bench_remat():
     from apex_tpu.utils.memory_report import (price_contract,
                                               remat_mlp_contract)
@@ -106,7 +137,8 @@ def bench_layer_norm():
 
 
 SUITES = {"xentropy": bench_xentropy, "flash": bench_flash,
-          "remat": bench_remat, "layer_norm": bench_layer_norm}
+          "fused_softmax": bench_fused_softmax, "remat": bench_remat,
+          "layer_norm": bench_layer_norm}
 
 
 def main(argv):

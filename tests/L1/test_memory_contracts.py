@@ -74,6 +74,34 @@ def test_flash_residuals_scale_linearly_with_seq():
         assert total - inputs <= b * h * s * (2 * d + 8), total
 
 
+def test_fused_softmax_residuals_match_reference_not_more():
+    """Honest structure of the N8 softmax kernels (BASELINE.md's negative
+    rows): the custom_vjp saves EXACTLY the input-dtype probs — the
+    reference's saved softmax_results
+    (apex/csrc/megatron/scaled_*_softmax backward), half the bytes of an
+    fp32 save — and nothing else. The peak-memory rows price negative
+    because XLA's composition rematerializes instead; this test pins the
+    residual to reference parity so a regression (e.g. an extra fp32
+    copy) cannot hide behind the already-negative row."""
+    from apex_tpu.kernels import causal_softmax as ck
+    from apex_tpu.kernels import masked_softmax as mk
+
+    n, sq, sk = 4, 256, 256
+    res = jax.eval_shape(
+        lambda x: ck._causal_fwd(x, 1.0, True)[1],
+        S((n, sq, sk), jnp.bfloat16))
+    leaves = _residual_leaves(res)
+    assert [(l.shape, l.dtype) for l in leaves] == \
+        [((n, sq, sk), jnp.bfloat16)], leaves
+
+    res = jax.eval_shape(
+        lambda x, m: mk._masked_fwd(x, m, 1.0, 1, True)[1],
+        S((n, sq, sk), jnp.bfloat16), S((n, sq, sk), jnp.int8))
+    leaves = _residual_leaves(res)
+    assert [(l.shape, l.dtype) for l in leaves] == \
+        [((n, sq, sk), jnp.bfloat16)], leaves
+
+
 def test_flash_residual_structure_is_independent_of_masking_flags():
     """Causal and non-causal save the same O(s*d) residual class —
     the no-s^2 contract isn't an artifact of the causal skip."""
