@@ -98,11 +98,17 @@ def load_pretokenized(path, seq_len, n_pred):
                          f"{counts}")
     if len(data["input_ids"]) == 0:
         raise SystemExit(f"--data {path!r} holds zero examples")
-    if int(data["masked_lm_positions"].max()) >= seq_len:
+    pos_lo = int(data["masked_lm_positions"].min())
+    pos_hi = int(data["masked_lm_positions"].max())
+    if pos_lo < 0 or pos_hi >= seq_len:
         raise SystemExit(
-            f"--data masked_lm_positions reach "
-            f"{int(data['masked_lm_positions'].max())}; sequences are "
-            f"{seq_len} long (jit would clamp the gather silently)")
+            f"--data masked_lm_positions span [{pos_lo}, {pos_hi}]; "
+            f"sequences are {seq_len} long (jit would clamp the gather "
+            f"silently)")
+    for k in ("input_ids", "masked_lm_ids"):
+        if int(data[k].min()) < 0:
+            raise SystemExit(f"--data {k} holds negative ids (jit would "
+                             f"clamp the gather silently)")
     return data
 
 

@@ -95,6 +95,34 @@ def synthetic_tokens(rng, batch, seq_len, vocab):
     return jax.random.categorical(rng, logits, shape=(batch, seq_len + 1))
 
 
+def load_token_stream(path, vocab_size, seq_len):
+    """Load + validate a pre-tokenized flat .npy for --data. Out-of-vocab
+    ids are rejected here because under jit the embedding gather would
+    clamp them silently — wrong training, not a crash."""
+    data = np.load(path)
+    if data.ndim != 1:
+        raise SystemExit(f"--data {path!r} must be a flat token stream; "
+                         f"got shape {data.shape}")
+    if len(data) < seq_len + 2:
+        raise SystemExit(f"--data holds {len(data)} tokens; need at least "
+                         f"seq_len+2 = {seq_len + 2}")
+    lo, hi = int(data.min()), int(data.max())
+    if lo < 0 or hi >= vocab_size:
+        raise SystemExit(f"--data token ids span [{lo}, {hi}]; "
+                         f"--vocab-size is {vocab_size}")
+    return data
+
+
+def data_batch(data, rng, batch_size, seq_len):
+    """Random [batch, seq_len+1] windows from the flat stream — the same
+    sampler on the single-chip and model-parallel paths. Gathered in
+    numpy and shipped as ONE host-to-device transfer; maxval is
+    exclusive, so len-seq_len admits the last valid window start."""
+    idx = np.asarray(jax.random.randint(rng, (batch_size,), 0,
+                                        len(data) - seq_len))
+    return jnp.asarray(np.stack([data[i:i + seq_len + 1] for i in idx]))
+
+
 # --------------------------------------------------------------------------
 # Model-parallel tier: Megatron-composed LM over a (data, pipe, model) mesh.
 #
@@ -644,9 +672,6 @@ def assert_trees_close(got, want, rtol=2e-4, atol=1e-5):
 def run_parallel(args, policy):
     if args.iters < 1:
         raise SystemExit("--iters must be >= 1")
-    if args.data:
-        raise SystemExit("--data is not supported on the model-parallel "
-                         "path yet; drop it or run single-chip")
     if args.remat:
         raise SystemExit("--remat is not supported on the model-parallel "
                          "path (the 1F1B schedule already recomputes "
@@ -659,6 +684,9 @@ def run_parallel(args, policy):
           f"{' vocab-parallel' if args.vocab_parallel else ''}"
           f"{' zero' if args.zero else ''}, "
           f"params: {n_params:,}")
+    data = None
+    if args.data:
+        data = load_token_stream(args.data, args.vocab_size, args.seq_len)
     rng = jax.random.PRNGKey(args.seed)
     t0, toks, metrics = None, 0, None
     loss_history = []
@@ -667,8 +695,12 @@ def run_parallel(args, policy):
             rng, sub = jax.random.split(rng)
             if args.deterministic:
                 sub = jax.random.PRNGKey(it)
-            batch = synthetic_tokens(sub, args.batch_size, args.seq_len,
-                                     args.vocab_size)
+            if data is not None:
+                batch = data_batch(data, sub, args.batch_size,
+                                   args.seq_len)
+            else:
+                batch = synthetic_tokens(sub, args.batch_size,
+                                         args.seq_len, args.vocab_size)
             state, metrics = jit_step(state, batch)
             loss_history.append(metrics["loss"])
             if it == 2:
@@ -725,7 +757,7 @@ def main(argv=None):
 
     data = None
     if args.data:
-        data = np.load(args.data)
+        data = load_token_stream(args.data, args.vocab_size, args.seq_len)
 
     n_params = sum(int(np.prod(p.shape))
                    for p in jax.tree_util.tree_leaves(params))
@@ -740,10 +772,7 @@ def main(argv=None):
         if args.deterministic:
             sub = jax.random.PRNGKey(it)
         if data is not None:
-            idx = jax.random.randint(sub, (args.batch_size,), 0,
-                                     len(data) - args.seq_len - 1)
-            batch = jnp.stack([jnp.asarray(
-                data[int(i):int(i) + args.seq_len + 1]) for i in idx])
+            batch = data_batch(data, sub, args.batch_size, args.seq_len)
         else:
             batch = synthetic_tokens(sub, args.batch_size, args.seq_len,
                                      args.vocab_size)
