@@ -1,0 +1,42 @@
+"""CLI for the analyze stage — the reference's ``python -m pyprof.prof``
+usage (apex/pyprof/prof/__main__.py drives parse→prof over an nvprof
+dump; here the dump is the ``jax.profiler`` capture pyprof.trace wrote):
+
+    python -m apex_tpu.pyprof /tmp/trace_dir [--top N] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import analyze, report
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="python -m apex_tpu.pyprof",
+        description="Per-op table from a captured jax.profiler trace")
+    p.add_argument("trace_dir",
+                   help="log dir passed to pyprof.trace (or a "
+                        "*.trace.json.gz directly)")
+    p.add_argument("--top", type=int, default=None,
+                   help="only the N most time-consuming ops")
+    p.add_argument("--json", action="store_true",
+                   help="one JSON row per op instead of the table")
+    args = p.parse_args(argv)
+    try:
+        rows = analyze(args.trace_dir, top=args.top)
+    except FileNotFoundError as e:
+        raise SystemExit(str(e))
+    if args.json:
+        for r in rows:
+            print(json.dumps(r))
+    else:
+        print(report(rows))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -37,7 +37,8 @@ import jax
 
 __all__ = ["MemoryStats", "compiled_memory", "price_contract",
            "xentropy_contract", "flash_contract", "remat_mlp_contract",
-           "causal_softmax_contract", "masked_softmax_contract"]
+           "causal_softmax_contract", "masked_softmax_contract",
+           "lm_step_remat_contract"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -142,6 +143,54 @@ def remat_mlp_contract(n_layers: int, n: int, hdim: int):
     plain = jax.value_and_grad(functools.partial(net, remat=False))
     remat = jax.value_and_grad(functools.partial(net, remat=True))
     return plain, remat, avals, n_layers * n * 4 * hdim * 4
+
+
+def lm_step_remat_contract(size: str = "small", vocab: int = 32768,
+                           seq: int = 512, batch: int = 8):
+    """Integrated pricing of the LM recipe's own ``--remat`` lever: the
+    COMPLETE amp-O2 train step (create_lm + fused CE + fused_adam +
+    dynamic scaler — exactly what ``examples/lm/main_amp.py`` jits) with
+    per-block activation checkpointing vs without. Returns
+    (remat_step, plain_step, avals, theory_bytes); theory = one [B, S,
+    4H] bf16 MLP hidden per block, the dominant buffer remat drops.
+
+    Unlike the toy-MLP remat row this prices the recipe the user
+    actually runs — flash attention, fused LN, fused CE, O2 masters and
+    scaler state all inside the measured computation.
+    """
+    import jax.numpy as jnp
+
+    from apex_tpu import amp
+    from apex_tpu.kernels.xentropy import softmax_cross_entropy_loss
+    from apex_tpu.models.transformer_lm import _LM_SIZES, create_lm
+    from apex_tpu.optimizers import fused_adam
+
+    policy = amp.resolve_policy("O2", verbose=False)
+
+    def build(remat):
+        model = create_lm(size, vocab_size=vocab, max_seq_len=seq,
+                          remat=remat, dtype=policy.model_dtype)
+
+        def loss_fn(p, tokens):
+            logits = model.apply({"params": p}, tokens[:, :-1],
+                                 train=True)
+            return softmax_cross_entropy_loss(logits,
+                                              tokens[:, 1:]).mean()
+
+        init_fn, step_fn = amp.make_train_step(loss_fn, fused_adam(1e-4),
+                                               policy)
+        params = jax.eval_shape(
+            lambda: model.init(jax.random.PRNGKey(0),
+                               jax.numpy.zeros((2, seq), jnp.int32),
+                               train=False)["params"])
+        return step_fn, jax.eval_shape(init_fn, params)
+
+    remat_step, state = build(True)
+    plain_step, _ = build(False)
+    avals = [state, jax.ShapeDtypeStruct((batch, seq + 1), jnp.int32)]
+    hidden, layers, _ = _LM_SIZES[size]
+    theory = layers * batch * seq * 4 * hidden * 2
+    return remat_step, plain_step, avals, theory
 
 
 def causal_softmax_contract(b: int, h: int, s: int, with_bwd: bool):

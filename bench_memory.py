@@ -101,7 +101,8 @@ def bench_fused_softmax():
 
 
 def bench_remat():
-    from apex_tpu.utils.memory_report import (price_contract,
+    from apex_tpu.utils.memory_report import (lm_step_remat_contract,
+                                              price_contract,
                                               remat_mlp_contract)
 
     n_layers, n, hdim = 12, 2048, 1024
@@ -111,6 +112,15 @@ def bench_remat():
     emit(price_contract("remat_activation_memory", remat_fn, plain_fn,
                         avals, theory_bytes=theory),
          f"L{n_layers} n{n} h{hdim} (jax.checkpoint per block)")
+
+    # the integrated row: the LM recipe's COMPLETE amp-O2 train step
+    # with its own --remat flag on vs off
+    size, vocab, seq, batch = "small", 32768, 512, 8
+    remat_step, plain_step, avals, theory = lm_step_remat_contract(
+        size, vocab, seq, batch)
+    emit(price_contract("lm_train_step_remat", remat_step, plain_step,
+                        avals, theory_bytes=theory),
+         f"{size} v{vocab} s{seq} b{batch} (examples/lm --remat)")
 
 
 def bench_layer_norm():

@@ -105,7 +105,7 @@ def load_pretokenized(path, seq_len, n_pred):
             f"--data masked_lm_positions span [{pos_lo}, {pos_hi}]; "
             f"sequences are {seq_len} long (jit would clamp the gather "
             f"silently)")
-    for k in ("input_ids", "masked_lm_ids"):
+    for k in ("input_ids", "token_type_ids", "masked_lm_ids"):
         if int(data[k].min()) < 0:
             raise SystemExit(f"--data {k} holds negative ids (jit would "
                              f"clamp the gather silently)")

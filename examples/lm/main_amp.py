@@ -103,6 +103,10 @@ def load_token_stream(path, vocab_size, seq_len):
     if data.ndim != 1:
         raise SystemExit(f"--data {path!r} must be a flat token stream; "
                          f"got shape {data.shape}")
+    if not np.issubdtype(data.dtype, np.integer):
+        raise SystemExit(f"--data {path!r} holds {data.dtype} values; "
+                         "token streams must be integers (floats would "
+                         "truncate silently)")
     if len(data) < seq_len + 2:
         raise SystemExit(f"--data holds {len(data)} tokens; need at least "
                          f"seq_len+2 = {seq_len + 2}")

@@ -70,6 +70,10 @@ def parse_args(argv=None):
     p.add_argument("--attn", default="ring", choices=["ring", "ulysses"],
                    help="ring: KV rotates via ppermute; ulysses: "
                         "all-to-all head scatter (needs heads %% ring == 0)")
+    p.add_argument("--data", default=None,
+                   help="pre-tokenized int32 .npy token stream — the "
+                        "fixed training batch becomes real long-context "
+                        "windows instead of uniform noise")
     return p.parse_args(argv)
 
 
@@ -171,10 +175,22 @@ def main(argv=None):
     positions = jnp.asarray(order)[None].repeat(args.batch_size, 0)
 
     rng = np.random.RandomState(0)
-    tokens_global = rng.randint(0, args.vocab,
-                                size=(args.batch_size, S)).astype(np.int32)
-    # next-token targets in GLOBAL order, then permuted like the inputs
-    targets_global = np.roll(tokens_global, -1, axis=1)
+    if args.data:
+        # real windows from a token stream (the LM recipe's validated
+        # loader — out-of-vocab ids rejected, not clamped); targets are
+        # the TRUE next tokens, though position S-1 stays masked below
+        # so both data sources train the identical objective
+        from examples.lm.main_amp import load_token_stream
+        stream = load_token_stream(args.data, args.vocab, S)
+        starts = rng.randint(0, len(stream) - S, size=args.batch_size)
+        win = np.stack([stream[st:st + S + 1] for st in starts])
+        tokens_global = win[:, :S].astype(np.int32)
+        targets_global = win[:, 1:].astype(np.int32)
+    else:
+        tokens_global = rng.randint(
+            0, args.vocab, size=(args.batch_size, S)).astype(np.int32)
+        # next-token targets in GLOBAL order, permuted like the inputs
+        targets_global = np.roll(tokens_global, -1, axis=1)
     tokens = jnp.asarray(tokens_global[:, order])
     targets = jnp.asarray(targets_global[:, order])
 

@@ -114,3 +114,23 @@ def test_analyze_trace_per_op_table(tmp_path):
 def test_analyze_missing_dir_raises(tmp_path):
     with pytest.raises(FileNotFoundError, match="no profile runs"):
         pyprof.analyze(os.path.join(tmp_path, "nothing_here"))
+
+
+def test_pyprof_cli_renders_table(tmp_path, capsys):
+    """python -m apex_tpu.pyprof <dir> — the reference's
+    `python -m pyprof.prof` entry point over the captured dump."""
+    from apex_tpu.pyprof.__main__ import main as cli
+
+    d = os.path.join(tmp_path, "tr")
+    with trace(d):
+        jax.jit(lambda x: (x @ x).sum())(jnp.ones((64, 64))) \
+            .block_until_ready()
+    assert cli([d, "--top", "3"]) == 0
+    out = capsys.readouterr().out
+    assert "op" in out.splitlines()[0] and len(out.splitlines()) >= 3
+    assert cli([d, "--json"]) == 0
+    import json as _json
+    rows = [_json.loads(l) for l in capsys.readouterr().out.splitlines()]
+    assert rows and all("occurrences" in r for r in rows)
+    with pytest.raises(SystemExit, match="no profile runs"):
+        cli([os.path.join(tmp_path, "missing")])
