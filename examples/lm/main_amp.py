@@ -82,6 +82,14 @@ def parse_args(argv=None):
                         "params; needs dp>1)")
     p.add_argument("--microbatches", type=int, default=None,
                    help="pipeline microbatches (default 2*pp)")
+    p.add_argument("--save", default=None, metavar="CKPT",
+                   help="write the final train state (params, masters, "
+                        "optimizer state incl. ZeRO shards, scaler) plus "
+                        "the step count to this .npz")
+    p.add_argument("--resume", default=None, metavar="CKPT",
+                   help="restore a --save checkpoint and continue: with "
+                        "--deterministic the resumed run reproduces the "
+                        "uninterrupted trajectory exactly")
     p.add_argument("--layers", type=int, default=None,
                    help="override the size preset's layer count (parallel "
                         "path; must divide by pp*vpp)")
@@ -692,10 +700,11 @@ def run_parallel(args, policy):
     if args.data:
         data = load_token_stream(args.data, args.vocab_size, args.seq_len)
     rng = jax.random.PRNGKey(args.seed)
+    state, start_it, rng = _maybe_resume(args, state, rng)
     t0, toks, metrics = None, 0, None
     loss_history = []
     with mesh:
-        for it in range(args.iters):
+        for it in range(start_it, args.iters):
             rng, sub = jax.random.split(rng)
             if args.deterministic:
                 sub = jax.random.PRNGKey(it)
@@ -707,7 +716,7 @@ def run_parallel(args, policy):
                                          args.seq_len, args.vocab_size)
             state, metrics = jit_step(state, batch)
             loss_history.append(metrics["loss"])
-            if it == 2:
+            if it == start_it + 2:
                 metrics["loss"].block_until_ready()
                 t0 = time.perf_counter()
                 toks = 0
@@ -717,16 +726,46 @@ def run_parallel(args, policy):
                       f"{float(metrics['loss']):.4f} loss_scale "
                       f"{float(metrics['loss_scale']):g}")
     jax.tree_util.tree_leaves(state.params)[0].block_until_ready()
-    if t0 is not None and args.iters > 3:
+    if t0 is not None and args.iters - start_it > 3:
         dt = time.perf_counter() - t0
         print(f"throughput: "
               f"{(toks - args.batch_size * args.seq_len) / dt:,.0f} tokens/s")
+    _maybe_save(args, state, rng)
     metrics = dict(metrics)
     metrics["final_state"] = state
     # one device-to-host transfer for the whole history
     metrics["loss_history"] = np.asarray(jnp.stack(loss_history),
                                          np.float32).tolist()
     return metrics
+
+
+def _maybe_resume(args, state, rng):
+    """--resume: template-shaped restore (torch load_state_dict
+    semantics — the freshly-built state supplies treedef + shapes; jit
+    re-shards host arrays per the step's in_specs on entry). The saved
+    rng key rides the checkpoint's extra dict, so restart cost does not
+    grow with the checkpoint step."""
+    if not args.resume:
+        return state, 0, rng
+    from apex_tpu.utils.checkpoint import load_checkpoint
+    state, start_it, extra = load_checkpoint(args.resume, state)
+    if "rng" in (extra or {}):
+        rng = jnp.asarray(extra["rng"], jnp.uint32)
+    print(f"=> resumed from {args.resume} (step {start_it})")
+    if start_it >= args.iters:
+        raise SystemExit(f"--resume checkpoint is at step {start_it}; "
+                         f"--iters {args.iters} adds nothing (pass a "
+                         "larger --iters to continue)")
+    return state, start_it, rng
+
+
+def _maybe_save(args, state, rng):
+    if not args.save:
+        return
+    from apex_tpu.utils.checkpoint import save_checkpoint
+    save_checkpoint(args.save, state, step=args.iters,
+                    extra={"rng": np.asarray(rng).tolist()})
+    print(f"=> saved step {args.iters} to {args.save}")
 
 
 def main(argv=None):
@@ -767,11 +806,12 @@ def main(argv=None):
                    for p in jax.tree_util.tree_leaves(params))
     print(f"=> LM {args.size}, params: {n_params:,}")
 
+    state, start_it, rng = _maybe_resume(args, state, rng)
     t0 = None
     toks = 0
     metrics = None
     loss_history = []
-    for it in range(args.iters):
+    for it in range(start_it, args.iters):
         rng, sub = jax.random.split(rng)
         if args.deterministic:
             sub = jax.random.PRNGKey(it)
@@ -782,7 +822,7 @@ def main(argv=None):
                                      args.vocab_size)
         state, metrics = jit_step(state, batch)
         loss_history.append(metrics["loss"])
-        if it == 4:
+        if it == start_it + 4:
             metrics["loss"].block_until_ready()
             t0 = time.perf_counter()
             toks = 0
@@ -791,12 +831,13 @@ def main(argv=None):
             print(f"[{it}/{args.iters}] loss {float(metrics['loss']):.4f} "
                   f"loss_scale {float(metrics['loss_scale']):g}")
     jax.tree_util.tree_leaves(state.params)[0].block_until_ready()
-    if t0 is not None and args.iters > 5:
+    if t0 is not None and args.iters - start_it > 5:
         dt = time.perf_counter() - t0
         print(f"throughput: "
               f"{(toks - args.batch_size * args.seq_len) / dt:,.0f} tokens/s")
     if metrics is None:
         return None
+    _maybe_save(args, state, rng)
     metrics = dict(metrics)
     metrics["final_state"] = state
     # one device-to-host transfer for the whole history

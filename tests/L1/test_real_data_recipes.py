@@ -44,6 +44,30 @@ def test_lm_trains_on_pretokenized_npy():
     assert len(m_syn["loss_history"]) == len(hist)
 
 
+def test_lm_single_chip_save_resume_bitwise(tmp_path):
+    """--save/--resume on the single-chip path too (review r4: the flags
+    must not be parallel-only): interrupted-at-4 + resumed reproduces
+    the uninterrupted 8-iter run bitwise on the real-data stream."""
+    import jax
+
+    from examples.lm import main_amp as lm
+
+    data = os.path.join(_DATA, "tiny_lm_tokens.npy")
+    ckpt = os.path.join(tmp_path, "lm.npz")
+    common = ["--size", "tiny", "--vocab-size", "128", "--seq-len", "32",
+              "-b", "8", "--deterministic", "--opt-level", "O2",
+              "--lr", "3e-3", "--data", data]
+    m_full = lm.main(common + ["--iters", "8"])
+    lm.main(common + ["--iters", "4", "--save", ckpt])
+    m_res = lm.main(common + ["--iters", "8", "--resume", ckpt])
+    np.testing.assert_array_equal(m_res["loss_history"],
+                                  m_full["loss_history"][4:])
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        m_res["final_state"].params, m_full["final_state"].params)
+
+
 def test_bert_trains_on_pretokenized_npz():
     from examples.bert_lamb import main_amp as bert
 

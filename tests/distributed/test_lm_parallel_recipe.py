@@ -231,6 +231,32 @@ def test_real_data_through_the_parallel_tier(lm, eight_devices):
     _assert_trees_close(_canon(lm, m_par), _canon(lm, m_seq))
 
 
+def test_save_resume_continues_trajectory_exactly(lm, eight_devices,
+                                                  tmp_path):
+    """--save/--resume on the full parallel tier (reference recipes are
+    checkpoint-first: imagenet --resume, BERT phase1→phase2): an O2+ZeRO
+    dp2 x tp2 x pp2 run interrupted at step 3 and resumed reproduces the
+    uninterrupted 6-step run BITWISE — params, fp32 masters, sharded
+    first moments, and the remaining loss history."""
+    ckpt = str(tmp_path / "lm_parallel.npz")
+    extra = ["--data-parallel", "2", "--tensor-parallel", "2",
+             "--pipeline-parallel", "2", "--zero"]
+    m_full = _run(lm, extra, opt_level="O2")
+    _run(lm, extra + ["--iters", "3", "--save", ckpt], opt_level="O2")
+    m_res = _run(lm, extra + ["--resume", ckpt], opt_level="O2")
+    np.testing.assert_array_equal(m_res["loss_history"],
+                                  m_full["loss_history"][3:])
+    full_s, res_s = m_full["final_state"], m_res["final_state"]
+    _assert_trees_close(res_s.params, full_s.params, rtol=0, atol=0)
+    _assert_trees_close(res_s.master_params, full_s.master_params,
+                        rtol=0, atol=0)
+    np.testing.assert_array_equal(
+        np.asarray(res_s.opt_state.m_shard),
+        np.asarray(full_s.opt_state.m_shard))
+    assert float(res_s.scaler.loss_scale) == \
+        float(full_s.scaler.loss_scale)
+
+
 def test_o2_skip_on_overflow_across_pipe(lm, eight_devices):
     """apex semantics through the pipelined step (VERDICT item 3): an
     overflow on ANY rank must skip the step on EVERY rank — params, master
