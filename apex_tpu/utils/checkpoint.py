@@ -34,6 +34,7 @@ import jax
 import numpy as np
 
 __all__ = ["save_checkpoint", "load_checkpoint", "latest_checkpoint",
+           "save_train_checkpoint", "resume_train_checkpoint",
            "AsyncCheckpointer"]
 
 _META_KEY = "__apex_tpu_meta__"
@@ -68,6 +69,33 @@ def _path_field(path: str) -> str:
     if m:
         return next(g for g in m.groups() if g is not None)
     return path.rsplit(".", 1)[-1].strip("[]'\"")
+
+
+def save_train_checkpoint(path: str, state: Any, step: int, rng) -> str:
+    """The recipes' ``--save``: :func:`save_checkpoint` plus the rng key
+    in the extra dict, so a resumed run continues the exact random
+    stream without replaying ``step`` splits."""
+    return save_checkpoint(path, state, step=step,
+                           extra={"rng": np.asarray(rng).tolist()})
+
+
+def resume_train_checkpoint(path: str, template: Any, rng, *,
+                            step_limit: int, limit_flag: str):
+    """The recipes' ``--resume``: template-shaped restore (torch
+    load_state_dict semantics), rng key recovered from the checkpoint's
+    extra dict. Returns ``(state, start_step, rng)``; rejects a
+    checkpoint already at/past ``step_limit`` with the recipe's flag
+    name in the message."""
+    state, start, extra = load_checkpoint(path, template)
+    if "rng" in (extra or {}):
+        rng = jax.numpy.asarray(extra["rng"], jax.numpy.uint32)
+    print(f"=> resumed from {path} (step {start})")
+    if start >= step_limit:
+        raise SystemExit(
+            f"--resume checkpoint is at step {start}; {limit_flag} "
+            f"{step_limit} adds nothing (pass a larger {limit_flag} to "
+            "continue)")
+    return state, start, rng
 
 
 def save_checkpoint(path: str, state: Any, step: int = 0,

@@ -68,6 +68,30 @@ def parse_args(argv=None):
                         "masked_lm_positions, masked_lm_ids, "
                         "next_sentence_labels) — the DeepLearningExamples "
                         "hdf5 shards' fields; synthetic batches otherwise")
+    p.add_argument("--max_position_embeddings", type=int, default=None,
+                   help="position-table size (default: max_seq_length). "
+                        "Set 512 in BOTH phases for the reference's "
+                        "phase1(seq128)→phase2(seq512) workflow, or "
+                        "--init-checkpoint cannot carry the weights over")
+    p.add_argument("--total_steps", type=int, default=None,
+                   help="length of the lr schedule (default: max_steps). "
+                        "Set it to the FULL run length when saving an "
+                        "interrupted run (--max_steps < --total_steps), "
+                        "so the resumed run continues the same schedule "
+                        "— DeepLearningExamples' max_steps vs "
+                        "steps_this_run split")
+    p.add_argument("--save", default=None, metavar="CKPT",
+                   help="write the final train state + step to this .npz")
+    p.add_argument("--resume", default=None, metavar="CKPT",
+                   help="restore a --save checkpoint (full state) and "
+                        "continue the same phase")
+    p.add_argument("--init-checkpoint", default=None, metavar="CKPT",
+                   help="DeepLearningExamples --init_checkpoint: load "
+                        "ONLY the model params from a --save checkpoint; "
+                        "masters re-derived, optimizer and schedule start "
+                        "fresh (the phase1→phase2 handoff). Run both "
+                        "phases with the same --bert-model, "
+                        "--max_position_embeddings, and --opt-level")
     return p.parse_args(argv)
 
 
@@ -138,6 +162,25 @@ def make_schedule(lr, max_steps, warmup_proportion):
         [warmup])
 
 
+def _phase_handoff_params(path, init_fn, params):
+    """DeepLearningExamples phase1→phase2 handoff: carry the MODEL over
+    (fp32 masters preferred), restart optimizer + schedule. The position
+    table must be sized identically in both phases
+    (--max_position_embeddings 512 there) or shapes won't match. Scoped
+    in a helper so the restored phase-1 state (params + masters + both
+    LAMB moments — ~4x model size) frees as soon as params are copied
+    out."""
+    from apex_tpu.utils.checkpoint import load_checkpoint
+    restored, from_step, _ = load_checkpoint(path, init_fn(params))
+    src = (restored.master_params
+           if restored.master_params is not None else restored.params)
+    out = jax.tree_util.tree_map(lambda m, p: jnp.asarray(m, p.dtype),
+                                 src, params)
+    print(f"=> initialized model from {path} "
+          f"(phase handoff at step {from_step}; fresh optimizer)")
+    return out
+
+
 def main(argv=None):
     args = parse_args(argv)
     if args.train_batch_size % max(args.data_parallel, 1):
@@ -154,14 +197,27 @@ def main(argv=None):
     print(policy.banner())
 
     cfg = create_bert(args.bert_model,
-                      max_position_embeddings=args.max_seq_length)
+                      max_position_embeddings=(
+                          args.max_position_embeddings
+                          or args.max_seq_length))
+    if args.max_seq_length > cfg.max_position_embeddings:
+        raise SystemExit(
+            f"--max_seq_length {args.max_seq_length} exceeds the "
+            f"position table ({cfg.max_position_embeddings}); raise "
+            "--max_position_embeddings")
     model = BertForPreTraining(cfg, dtype=policy.model_dtype)
     rng = jax.random.PRNGKey(args.seed)
     b0 = synthetic_bert_batch(rng, 2, args.max_seq_length,
                               args.max_predictions_per_seq, cfg.vocab_size)
     params = model.init(rng, *b0[:4], train=False)["params"]
 
-    schedule = make_schedule(args.learning_rate, args.max_steps,
+    if args.total_steps is not None and args.total_steps < args.max_steps:
+        raise SystemExit(
+            f"--total_steps {args.total_steps} < --max_steps "
+            f"{args.max_steps}: the schedule would pin lr to 0 past "
+            "total_steps (swapped flags?)")
+    schedule = make_schedule(args.learning_rate,
+                             args.total_steps or args.max_steps,
                              args.warmup_proportion)
     optimizer = fused_lamb(schedule, weight_decay=0.01)
 
@@ -184,7 +240,20 @@ def main(argv=None):
     init_fn, step_fn = amp.make_train_step(
         loss_fn, optimizer, policy,
         grad_average_axis="data" if dp > 1 else None)
+    if args.resume and args.init_checkpoint:
+        raise SystemExit("--resume (continue the phase) and "
+                         "--init-checkpoint (fresh phase from saved "
+                         "params) are exclusive")
+    start_it = 0
+    if args.init_checkpoint:
+        params = _phase_handoff_params(args.init_checkpoint, init_fn,
+                                       params)
     state = init_fn(params)
+    if args.resume:
+        from apex_tpu.utils.checkpoint import resume_train_checkpoint
+        state, start_it, rng = resume_train_checkpoint(
+            args.resume, state, rng, step_limit=args.max_steps,
+            limit_flag="--max_steps")
     if dp > 1:
         # reference shape: apex DDP over the batch + FusedLAMB — here one
         # grad psum over the 'data' axis (examples/imagenet's pattern);
@@ -239,7 +308,7 @@ def main(argv=None):
     metrics = None
     loss_history = []
     with ctx:
-        for it in range(args.max_steps):
+        for it in range(start_it, args.max_steps):
             rng, sub = jax.random.split(rng)
             sub, drop = jax.random.split(sub)
             if data is not None:
@@ -255,7 +324,7 @@ def main(argv=None):
                                              cfg.vocab_size) + (drop,)
             state, metrics = jit_step(state, batch)
             loss_history.append(metrics["loss"])
-            if it == 4:
+            if it == start_it + 4:
                 metrics["loss"].block_until_ready()
                 t0 = time.perf_counter()
                 seqs = 0
@@ -265,12 +334,16 @@ def main(argv=None):
                       f"{float(metrics['loss']):.4f} "
                       f"loss_scale {float(metrics['loss_scale']):g}")
     jax.tree_util.tree_leaves(state.params)[0].block_until_ready()
-    if t0 is not None and args.max_steps > 5:
+    if t0 is not None and args.max_steps - start_it > 5:
         dt = time.perf_counter() - t0
         print(f"throughput: "
               f"{(seqs - args.train_batch_size) / dt:,.1f} sequences/s")
     if metrics is None:
         return None
+    if args.save:
+        from apex_tpu.utils.checkpoint import save_train_checkpoint
+        save_train_checkpoint(args.save, state, args.max_steps, rng)
+        print(f"=> saved step {args.max_steps} to {args.save}")
     metrics = dict(metrics)
     # one device-to-host transfer for the whole history, not one per step
     metrics["loss_history"] = np.asarray(jnp.stack(loss_history),

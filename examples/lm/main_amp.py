@@ -740,31 +740,22 @@ def run_parallel(args, policy):
 
 
 def _maybe_resume(args, state, rng):
-    """--resume: template-shaped restore (torch load_state_dict
-    semantics — the freshly-built state supplies treedef + shapes; jit
-    re-shards host arrays per the step's in_specs on entry). The saved
-    rng key rides the checkpoint's extra dict, so restart cost does not
-    grow with the checkpoint step."""
+    """--resume via the shared helper (jit re-shards the restored host
+    arrays per the step's in_specs on entry, so the same call serves the
+    single-chip and shard_mapped paths)."""
     if not args.resume:
         return state, 0, rng
-    from apex_tpu.utils.checkpoint import load_checkpoint
-    state, start_it, extra = load_checkpoint(args.resume, state)
-    if "rng" in (extra or {}):
-        rng = jnp.asarray(extra["rng"], jnp.uint32)
-    print(f"=> resumed from {args.resume} (step {start_it})")
-    if start_it >= args.iters:
-        raise SystemExit(f"--resume checkpoint is at step {start_it}; "
-                         f"--iters {args.iters} adds nothing (pass a "
-                         "larger --iters to continue)")
-    return state, start_it, rng
+    from apex_tpu.utils.checkpoint import resume_train_checkpoint
+    return resume_train_checkpoint(args.resume, state, rng,
+                                   step_limit=args.iters,
+                                   limit_flag="--iters")
 
 
 def _maybe_save(args, state, rng):
     if not args.save:
         return
-    from apex_tpu.utils.checkpoint import save_checkpoint
-    save_checkpoint(args.save, state, step=args.iters,
-                    extra={"rng": np.asarray(rng).tolist()})
+    from apex_tpu.utils.checkpoint import save_train_checkpoint
+    save_train_checkpoint(args.save, state, args.iters, rng)
     print(f"=> saved step {args.iters} to {args.save}")
 
 

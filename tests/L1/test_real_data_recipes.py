@@ -99,3 +99,46 @@ def test_bert_data_validation_rejects_mismatches(tmp_path):
     np.savez(bad, input_ids=data["input_ids"])
     with pytest.raises(SystemExit, match="missing fields"):
         load_pretokenized(bad, seq_len=32, n_pred=5)
+
+
+def test_bert_two_phase_pretraining_handoff(tmp_path):
+    """The reference's BERT workflow (DeepLearningExamples
+    run_pretraining): phase 1 at short sequences, --save, then phase 2 at
+    longer sequences via --init-checkpoint — model weights carry over
+    (fp32 masters), optimizer and schedule restart, the shared position
+    table (--max_position_embeddings) covers both phases. Plus --resume:
+    an interrupted phase continues bitwise."""
+    import jax
+
+    from examples.bert_lamb import main_amp as bert
+
+    ckpt = os.path.join(tmp_path, "phase1.npz")
+    common = ["--bert-model", "tiny", "--max_predictions_per_seq", "5",
+              "--train_batch_size", "4", "--learning_rate", "1e-3",
+              "--max_position_embeddings", "64"]
+    # interrupted phase 1: 6 of 10 schedule steps, then save
+    p1 = bert.main(common + ["--max_seq_length", "32", "--max_steps", "6",
+                             "--total_steps", "10", "--save", ckpt])
+    assert np.isfinite(p1["loss_history"]).all()
+
+    # phase 2: longer sequences, fresh optimizer, params carried over
+    p2 = bert.main(common + ["--max_seq_length", "64", "--max_steps", "4",
+                             "--init-checkpoint", ckpt])
+    assert np.isfinite(p2["loss_history"]).all()
+
+    # --resume continues phase 1 bitwise (same 10-step schedule)
+    full = bert.main(common + ["--max_seq_length", "32",
+                               "--max_steps", "10"])
+    res = bert.main(common + ["--max_seq_length", "32",
+                              "--max_steps", "10", "--resume", ckpt])
+    np.testing.assert_array_equal(res["loss_history"],
+                                  full["loss_history"][6:])
+
+    # --resume and --init-checkpoint are exclusive; oversized sequences
+    # are rejected against the position table
+    with pytest.raises(SystemExit, match="exclusive"):
+        bert.main(common + ["--max_seq_length", "32", "--resume", ckpt,
+                            "--init-checkpoint", ckpt])
+    with pytest.raises(SystemExit, match="position table"):
+        bert.main(["--bert-model", "tiny", "--max_seq_length", "128",
+                   "--max_position_embeddings", "64"])
