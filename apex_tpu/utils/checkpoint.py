@@ -75,8 +75,10 @@ def save_train_checkpoint(path: str, state: Any, step: int, rng) -> str:
     """The recipes' ``--save``: :func:`save_checkpoint` plus the rng key
     in the extra dict, so a resumed run continues the exact random
     stream without replaying ``step`` splits."""
-    return save_checkpoint(path, state, step=step,
-                           extra={"rng": np.asarray(rng).tolist()})
+    out = save_checkpoint(path, state, step=step,
+                          extra={"rng": np.asarray(rng).tolist()})
+    print(f"=> saved step {step} to {path}")
+    return out
 
 
 def resume_train_checkpoint(path: str, template: Any, rng, *,

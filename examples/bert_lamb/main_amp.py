@@ -172,8 +172,7 @@ def _phase_handoff_params(path, init_fn, params):
     out."""
     from apex_tpu.utils.checkpoint import load_checkpoint
     restored, from_step, _ = load_checkpoint(path, init_fn(params))
-    src = (restored.master_params
-           if restored.master_params is not None else restored.params)
+    src = amp.master_params(restored)
     out = jax.tree_util.tree_map(lambda m, p: jnp.asarray(m, p.dtype),
                                  src, params)
     print(f"=> initialized model from {path} "
@@ -187,6 +186,10 @@ def main(argv=None):
         raise SystemExit(f"--train_batch_size {args.train_batch_size} "
                          f"must divide by --data-parallel "
                          f"{args.data_parallel}")
+    if args.resume and args.init_checkpoint:
+        raise SystemExit("--resume (continue the phase) and "
+                         "--init-checkpoint (fresh phase from saved "
+                         "params) are exclusive")
     if args.data_parallel > 1:
         # before ANY arrays exist: ensure_devices may switch backends
         # (virtual CPU fallback) and refuses once state is live
@@ -240,10 +243,6 @@ def main(argv=None):
     init_fn, step_fn = amp.make_train_step(
         loss_fn, optimizer, policy,
         grad_average_axis="data" if dp > 1 else None)
-    if args.resume and args.init_checkpoint:
-        raise SystemExit("--resume (continue the phase) and "
-                         "--init-checkpoint (fresh phase from saved "
-                         "params) are exclusive")
     start_it = 0
     if args.init_checkpoint:
         params = _phase_handoff_params(args.init_checkpoint, init_fn,
@@ -343,7 +342,6 @@ def main(argv=None):
     if args.save:
         from apex_tpu.utils.checkpoint import save_train_checkpoint
         save_train_checkpoint(args.save, state, args.max_steps, rng)
-        print(f"=> saved step {args.max_steps} to {args.save}")
     metrics = dict(metrics)
     # one device-to-host transfer for the whole history, not one per step
     metrics["loss_history"] = np.asarray(jnp.stack(loss_history),

@@ -756,7 +756,6 @@ def _maybe_save(args, state, rng):
         return
     from apex_tpu.utils.checkpoint import save_train_checkpoint
     save_train_checkpoint(args.save, state, args.iters, rng)
-    print(f"=> saved step {args.iters} to {args.save}")
 
 
 def main(argv=None):

@@ -134,3 +134,26 @@ def test_pyprof_cli_renders_table(tmp_path, capsys):
     assert rows and all("occurrences" in r for r in rows)
     with pytest.raises(SystemExit, match="no profile runs"):
         cli([os.path.join(tmp_path, "missing")])
+
+
+def test_leaf_spans_drop_enclosing_parents():
+    """Degraded-mode aggregation (no cost-annotated device ops) must not
+    double-count: a span enclosing another on the same lane is a parent
+    and is dropped; disjoint and cross-lane spans survive."""
+    from apex_tpu.pyprof import _leaf_spans
+
+    parent = {"pid": 1, "tid": 1, "ts": 0.0, "dur": 10.0, "name": "jit_f"}
+    child1 = {"pid": 1, "tid": 1, "ts": 1.0, "dur": 3.0, "name": "op_a"}
+    child2 = {"pid": 1, "tid": 1, "ts": 5.0, "dur": 4.0, "name": "op_b"}
+    after = {"pid": 1, "tid": 1, "ts": 11.0, "dur": 2.0, "name": "op_c"}
+    other_lane = {"pid": 2, "tid": 1, "ts": 0.0, "dur": 10.0,
+                  "name": "op_d"}
+    out = _leaf_spans([parent, child1, child2, after, other_lane])
+    names = sorted(e["name"] for e in out)
+    assert names == ["op_a", "op_b", "op_c", "op_d"], names
+    # nested-in-nested: only the innermost survives
+    mid = {"pid": 3, "tid": 0, "ts": 0.0, "dur": 8.0, "name": "mid"}
+    inner = {"pid": 3, "tid": 0, "ts": 2.0, "dur": 2.0, "name": "inner"}
+    outer = {"pid": 3, "tid": 0, "ts": 0.0, "dur": 10.0, "name": "outer"}
+    out = _leaf_spans([outer, mid, inner])
+    assert [e["name"] for e in out] == ["inner"]

@@ -142,3 +142,25 @@ def test_bert_two_phase_pretraining_handoff(tmp_path):
     with pytest.raises(SystemExit, match="position table"):
         bert.main(["--bert-model", "tiny", "--max_seq_length", "128",
                    "--max_position_embeddings", "64"])
+
+
+def test_window_sampler_reaches_final_token():
+    """Regression (review r4): randint's exclusive bound is
+    len-seq_len, so the LAST window start — and with it the stream's
+    final token — is reachable. At the minimum accepted stream length
+    (seq_len+2) there are exactly two starts; both must occur."""
+    import jax
+
+    from examples.lm.main_amp import data_batch
+
+    stream = np.arange(34, dtype=np.int32)          # seq_len 32 minimum
+    seen_last = False
+    starts = set()
+    for k in range(20):
+        batch = np.asarray(data_batch(stream, jax.random.PRNGKey(k),
+                                      batch_size=4, seq_len=32))
+        assert batch.shape == (4, 33)
+        starts.update(batch[:, 0].tolist())
+        seen_last |= bool((batch[:, -1] == 33).any())
+    assert starts == {0, 1}, starts
+    assert seen_last, "final token never sampled"
