@@ -120,27 +120,34 @@ def _trace_files(trace_dir: str) -> List[str]:
     return files
 
 
-def _leaf_spans(evs: List[dict]) -> List[dict]:
-    """Drop spans that enclose another span on the same (pid, tid) lane —
+def _leaf_spans(evs: List[dict],
+                lane_of: Optional[Callable[[dict], tuple]] = None
+                ) -> List[dict]:
+    """Drop spans that PROPERLY enclose another span on the same lane —
     parents double-count their children's time. One sorted sweep per lane
-    with an open-interval stack."""
+    with an open-interval stack. Identical intervals are siblings (two
+    same-timestamp ops), not parent/child. ``lane_of`` defaults to
+    (pid, tid); pass a richer key when events come from several files
+    whose pid namespaces are independent."""
+    if lane_of is None:
+        lane_of = lambda e: (e.get("pid"), e.get("tid"))  # noqa: E731
     lanes: Dict[tuple, List[dict]] = {}
     for e in evs:
-        lanes.setdefault((e.get("pid"), e.get("tid")), []).append(e)
+        lanes.setdefault(lane_of(e), []).append(e)
     out: List[dict] = []
     for lane in lanes.values():
         lane.sort(key=lambda e: (float(e.get("ts", 0.0)),
                                  -float(e.get("dur", 0.0))))
         parents: set = set()
-        stack: List[tuple] = []          # (end_ts, id(event))
+        stack: List[tuple] = []          # (start_ts, end_ts, id(event))
         for e in lane:
             ts = float(e.get("ts", 0.0))
             end = ts + float(e.get("dur", 0.0))
-            while stack and ts >= stack[-1][0]:
+            while stack and ts >= stack[-1][1]:
                 stack.pop()
-            if stack:                    # e nests inside stack[-1]
-                parents.add(stack[-1][1])
-            stack.append((end, id(e)))
+            if stack and (stack[-1][0], stack[-1][1]) != (ts, end):
+                parents.add(stack[-1][2])   # e nests PROPERLY inside
+            stack.append((ts, end, id(e)))
         out += [e for e in lane if id(e) not in parents]
     return out
 
@@ -165,27 +172,33 @@ def analyze(trace_dir: str, top: Optional[int] = None) -> List[Dict[str, Any]]:
     instead — parents that enclose other spans are dropped so region
     wrappers don't double-count their children — with zero flops/bytes.
     """
-    # (lane_name, event) pairs — pid namespaces are PER FILE (one dump per
-    # host), so classify against each file's own process_name metadata
+    # (lane_name, file_idx, event) triples — pid namespaces are PER FILE
+    # (one dump per host), so classify against each file's own
+    # process_name metadata and never mix lanes across files
     events: List[tuple] = []
-    for path in _trace_files(trace_dir):
+    for fi, path in enumerate(_trace_files(trace_dir)):
         with gzip.open(path, "rt") as f:
             data = json.load(f)
         evs = data.get("traceEvents", [])
         pids = {e["pid"]: e.get("args", {}).get("name", "")
                 for e in evs
                 if e.get("ph") == "M" and e.get("name") == "process_name"}
-        events += [(pids.get(e.get("pid"), ""), e)
+        events += [(pids.get(e.get("pid"), ""), fi, e)
                    for e in evs if e.get("ph") == "X"]
 
-    dev = [e for lane, e in events if lane.startswith("/device:")]
+    file_of = {id(e): fi for _, fi, e in events}
+    dev = [e for lane, _, e in events if lane.startswith("/device:")]
     # per-op HLO events carry hlo_category; region/module spans (jit_fn(…))
     # don't and would double-count their children's time
     ops = [e for e in dev if "hlo_category" in e.get("args", {})]
     if not ops:
         # degraded mode (no cost-annotated device ops): keep only LEAF
-        # spans — a parent region would double-count its children
-        ops = _leaf_spans(dev or [e for _, e in events])
+        # spans — a parent region would double-count its children; lanes
+        # keyed per source file so independent hosts can't nest
+        ops = _leaf_spans(
+            dev or [e for _, _, e in events],
+            lane_of=lambda e: (file_of[id(e)], e.get("pid"),
+                               e.get("tid")))
 
     rows: Dict[str, Dict[str, Any]] = {}
     for e in ops:

@@ -183,22 +183,31 @@ def load_checkpoint(path: str, template: Any) -> Tuple[Any, int, dict]:
         flat = []
         ckpt_i = 0
         for i, t in enumerate(flat_t):
-            t = np.asarray(t)
+            # abstract templates (jax.eval_shape output) are fine for
+            # plain restores — only a migratable fill needs real values
+            t_shape = tuple(t.shape)
+            t_dtype = np.dtype(t.dtype)
             if i in fill_from_template:
-                flat.append(jax.numpy.asarray(t))
+                if isinstance(t, jax.ShapeDtypeStruct):
+                    raise ValueError(
+                        "restoring an old checkpoint that needs field "
+                        "migration requires a real-valued template (the "
+                        "migrated leaf keeps the template's value); got "
+                        "an abstract ShapeDtypeStruct template")
+                flat.append(jax.numpy.asarray(np.asarray(t)))
                 continue
             arr = data[f"leaf_{ckpt_i}"]
-            if arr.shape != t.shape:
+            if arr.shape != t_shape:
                 raise ValueError(
                     f"leaf {ckpt_i}: checkpoint shape {arr.shape} != template "
-                    f"shape {t.shape}")
-            if saved_dtypes is not None and saved_dtypes[ckpt_i] != t.dtype.name:
+                    f"shape {t_shape}")
+            if saved_dtypes is not None and saved_dtypes[ckpt_i] != t_dtype.name:
                 raise ValueError(
                     f"leaf {ckpt_i}: checkpoint dtype {saved_dtypes[ckpt_i]} != "
-                    f"template dtype {t.dtype.name} — resuming into a "
+                    f"template dtype {t_dtype.name} — resuming into a "
                     "different precision configuration would silently "
                     "change numerics")
-            flat.append(jax.numpy.asarray(arr.astype(t.dtype)))
+            flat.append(jax.numpy.asarray(arr.astype(t_dtype)))
             ckpt_i += 1
     state = jax.tree_util.tree_unflatten(treedef, flat)
     return state, meta["step"], meta["extra"]

@@ -133,6 +133,18 @@ def load_pretokenized(path, seq_len, n_pred):
         if int(data[k].min()) < 0:
             raise SystemExit(f"--data {k} holds negative ids (jit would "
                              f"clamp the gather silently)")
+    if int(data["token_type_ids"].max()) > 1:
+        raise SystemExit(
+            f"--data token_type_ids reach "
+            f"{int(data['token_type_ids'].max())}; BERT has 2 segment "
+            "embeddings (jit would clamp the gather silently)")
+    nsp_lo = int(data["next_sentence_labels"].min())
+    nsp_hi = int(data["next_sentence_labels"].max())
+    if nsp_lo < 0 or nsp_hi > 1:
+        raise SystemExit(
+            f"--data next_sentence_labels span [{nsp_lo}, {nsp_hi}]; "
+            "NSP is binary (the xentropy label gather would clamp "
+            "silently)")
     return data
 
 
@@ -171,7 +183,10 @@ def _phase_handoff_params(path, init_fn, params):
     LAMB moments — ~4x model size) frees as soon as params are copied
     out."""
     from apex_tpu.utils.checkpoint import load_checkpoint
-    restored, from_step, _ = load_checkpoint(path, init_fn(params))
+    # abstract template: shapes/dtypes for validation without
+    # materializing a throwaway full train state
+    restored, from_step, _ = load_checkpoint(
+        path, jax.eval_shape(init_fn, params))
     src = amp.master_params(restored)
     out = jax.tree_util.tree_map(lambda m, p: jnp.asarray(m, p.dtype),
                                  src, params)

@@ -108,6 +108,9 @@ def load_token_stream(path, vocab_size, seq_len):
     ids are rejected here because under jit the embedding gather would
     clamp them silently — wrong training, not a crash."""
     data = np.load(path)
+    if not isinstance(data, np.ndarray):
+        raise SystemExit(f"--data {path!r} is an archive (.npz?); "
+                         "expected a flat .npy token stream")
     if data.ndim != 1:
         raise SystemExit(f"--data {path!r} must be a flat token stream; "
                          f"got shape {data.shape}")
@@ -760,6 +763,8 @@ def _maybe_save(args, state, rng):
 
 def main(argv=None):
     args = parse_args(argv)
+    if args.iters < 1:
+        raise SystemExit("--iters must be >= 1")
     policy = amp.resolve_policy(opt_level=args.opt_level,
                                 loss_scale=args.loss_scale)
     print(policy.banner())

@@ -268,3 +268,27 @@ def test_checkpoint_roundtrip_any_pytree(tmp_path_factory, tree, step):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
     jax.tree_util.tree_map(check, restored, tree)
+
+
+def test_abstract_template_restores_without_materializing(tmp_path):
+    """jax.eval_shape output works as the load template (shapes/dtypes
+    validated, nothing allocated) — unless migration needs real values,
+    which raises a clear error."""
+    tree = {"w": jnp.arange(6.0).reshape(2, 3),
+            "n": jnp.asarray(4, jnp.int32)}
+    path = os.path.join(tmp_path, "t.npz")
+    save_checkpoint(path, tree, step=2)
+
+    abstract = jax.eval_shape(lambda: tree)
+    restored, step, _ = load_checkpoint(path, abstract)
+    assert step == 2
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.asarray(tree["w"]))
+    assert restored["n"].dtype == jnp.int32
+
+    # migration + abstract template: refused with guidance
+    template = {"w": jnp.zeros((2, 3)), "n": jnp.asarray(0, jnp.int32),
+                "hysteresis_left": jnp.asarray(2)}
+    abstract2 = jax.eval_shape(lambda: template)
+    with pytest.raises(ValueError, match="real-valued template"):
+        load_checkpoint(path, abstract2)

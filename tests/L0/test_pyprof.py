@@ -157,3 +157,22 @@ def test_leaf_spans_drop_enclosing_parents():
     outer = {"pid": 3, "tid": 0, "ts": 0.0, "dur": 10.0, "name": "outer"}
     out = _leaf_spans([outer, mid, inner])
     assert [e["name"] for e in out] == ["inner"]
+
+
+def test_leaf_spans_identical_intervals_are_siblings():
+    """Two same-(ts, dur) ops on one lane are both counted — equal
+    intervals are repeat ops, not parent/child."""
+    from apex_tpu.pyprof import _leaf_spans
+
+    twin_a = {"pid": 1, "tid": 1, "ts": 5.0, "dur": 2.0, "name": "op"}
+    twin_b = {"pid": 1, "tid": 1, "ts": 5.0, "dur": 2.0, "name": "op"}
+    out = _leaf_spans([twin_a, twin_b])
+    assert len(out) == 2
+
+    # and a custom lane key keeps independent files from nesting
+    host_a = {"pid": 1, "tid": 1, "ts": 0.0, "dur": 10.0, "name": "a"}
+    host_b = {"pid": 1, "tid": 1, "ts": 2.0, "dur": 4.0, "name": "b"}
+    lanes = {id(host_a): 0, id(host_b): 1}
+    out = _leaf_spans([host_a, host_b],
+                      lane_of=lambda e: (lanes[id(e)], e.get("pid")))
+    assert len(out) == 2, "cross-file spans must not nest"

@@ -164,3 +164,32 @@ def test_window_sampler_reaches_final_token():
         seen_last |= bool((batch[:, -1] == 33).any())
     assert starts == {0, 1}, starts
     assert seen_last, "final token never sampled"
+
+
+def test_loaders_reject_silent_clamp_classes(tmp_path):
+    """Every id class jit's gathers would clamp silently is rejected at
+    load: .npz-for-.npy confusion, out-of-range segment ids, non-binary
+    NSP labels (review r4-high)."""
+    from examples.bert_lamb.main_amp import load_pretokenized
+    from examples.lm.main_amp import load_token_stream
+
+    with pytest.raises(SystemExit, match="archive"):
+        load_token_stream(os.path.join(_DATA, "tiny_bert_shard.npz"),
+                          128, 32)
+
+    good = dict(np.load(os.path.join(_DATA, "tiny_bert_shard.npz")))
+
+    def _write(**overrides):
+        path = os.path.join(tmp_path, "bad.npz")
+        np.savez(path, **{**good, **overrides})
+        return path
+
+    bad_tt = good["token_type_ids"].copy()
+    bad_tt[0, 0] = 3
+    with pytest.raises(SystemExit, match="segment"):
+        load_pretokenized(_write(token_type_ids=bad_tt), 32, 5)
+
+    bad_nsp = good["next_sentence_labels"].copy()
+    bad_nsp[0] = 2
+    with pytest.raises(SystemExit, match="binary"):
+        load_pretokenized(_write(next_sentence_labels=bad_nsp), 32, 5)

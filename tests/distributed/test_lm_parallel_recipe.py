@@ -28,19 +28,22 @@ _RECIPE = os.path.join(os.path.dirname(__file__), os.pardir, os.pardir,
                        "examples", "lm", "main_amp.py")
 
 
-def _load_recipe():
-    spec = importlib.util.spec_from_file_location("lm_recipe", _RECIPE)
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
+_LM_CACHE: list = []
 
 
-_LM = _load_recipe()
+def _lm():
+    """Lazy singleton — module exec deferred past pytest collection."""
+    if not _LM_CACHE:
+        spec = importlib.util.spec_from_file_location("lm_recipe", _RECIPE)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _LM_CACHE.append(mod)
+    return _LM_CACHE[0]
 
 
 @pytest.fixture(scope="module")
 def lm():
-    return _LM
+    return _lm()
 
 
 BASE = ["--size", "tiny", "--vocab-size", "128", "--seq-len", "16",
@@ -62,9 +65,10 @@ def _canon(lm, m):
     return lm.canonicalize_from_args(m["final_state"].params, m["args"])
 
 
-# leaf-for-leaf allclose with the failing leaf's key path — the recipe's
-# own helper, shared with the multichip dryrun
-_assert_trees_close = _LM.assert_trees_close
+def _assert_trees_close(*args, **kwargs):
+    """Leaf-for-leaf allclose with the failing leaf's key path — the
+    recipe's own helper, shared with the multichip dryrun."""
+    return _lm().assert_trees_close(*args, **kwargs)
 
 
 _BASELINES: dict = {}
