@@ -105,17 +105,8 @@ def flash_contract(b: int, h: int, s: int, d: int, with_bwd: bool):
     def composed_fwd(q, k, v):
         return mha_reference(q, k, v, causal=True, scale=d ** -0.5)
 
-    if with_bwd:
-        fused = jax.value_and_grad(
-            lambda q, k, v: jax.numpy.sum(
-                fused_fwd(q, k, v).astype(jnp.float32)),
-            argnums=(0, 1, 2))
-        composed = jax.value_and_grad(
-            lambda q, k, v: jax.numpy.sum(
-                composed_fwd(q, k, v).astype(jnp.float32)),
-            argnums=(0, 1, 2))
-    else:
-        fused, composed = fused_fwd, composed_fwd
+    fused, composed = _fwd_or_grad(fused_fwd, composed_fwd, with_bwd,
+                                   argnums=(0, 1, 2))
     return fused, composed, avals, b * h * s * s * 4
 
 
@@ -193,6 +184,21 @@ def lm_step_remat_contract(size: str = "small", vocab: int = 32768,
     return remat_step, plain_step, avals, theory
 
 
+def _fwd_or_grad(fused_fwd, composed_fwd, with_bwd, argnums=0):
+    """Shared with_bwd wrapping for the contract setups: sum-loss
+    value_and_grad over both implementations, or the bare forwards."""
+    if not with_bwd:
+        return fused_fwd, composed_fwd
+    import jax.numpy as jnp
+
+    def mk(f):
+        return jax.value_and_grad(
+            lambda *a: jnp.sum(f(*a).astype(jnp.float32)),
+            argnums=argnums)
+
+    return mk(fused_fwd), mk(composed_fwd)
+
+
 def causal_softmax_contract(b: int, h: int, s: int, with_bwd: bool):
     """Canonical N8 fused-causal-softmax pricing: (fused_fn, composed_fn,
     avals, theory_bytes). The kernel's contract is half I/O with per-tile
@@ -214,13 +220,7 @@ def causal_softmax_contract(b: int, h: int, s: int, with_bwd: bool):
     def composed_fwd(x):
         return causal_softmax_reference(x, scale=scale).astype(x.dtype)
 
-    if with_bwd:
-        fused = jax.value_and_grad(
-            lambda x: jax.numpy.sum(fused_fwd(x).astype(jnp.float32)))
-        composed = jax.value_and_grad(
-            lambda x: jax.numpy.sum(composed_fwd(x).astype(jnp.float32)))
-    else:
-        fused, composed = fused_fwd, composed_fwd
+    fused, composed = _fwd_or_grad(fused_fwd, composed_fwd, with_bwd)
     return fused, composed, avals, b * h * s * s * 2
 
 
@@ -243,14 +243,7 @@ def masked_softmax_contract(b: int, h: int, s: int, with_bwd: bool):
     def composed_fwd(x, m):
         return masked_softmax_reference(x, m, scale=scale).astype(x.dtype)
 
-    if with_bwd:
-        fused = jax.value_and_grad(
-            lambda x, m: jax.numpy.sum(fused_fwd(x, m).astype(jnp.float32)))
-        composed = jax.value_and_grad(
-            lambda x, m: jax.numpy.sum(
-                composed_fwd(x, m).astype(jnp.float32)))
-    else:
-        fused, composed = fused_fwd, composed_fwd
+    fused, composed = _fwd_or_grad(fused_fwd, composed_fwd, with_bwd)
     return fused, composed, avals, b * h * s * s * 2
 
 

@@ -100,8 +100,21 @@ _DATA_KEYS = ("input_ids", "token_type_ids", "attention_mask",
               "next_sentence_labels")
 
 
-def load_pretokenized(path, seq_len, n_pred):
-    """Load + validate a pre-tokenized .npz against the run's shapes."""
+def _check_id_range(name, arr, hi_exclusive, what):
+    """One rule for every id field: out-of-range ids would be CLAMPED by
+    XLA's gather under jit — silently wrong training, not a crash — so
+    they are rejected at load."""
+    lo, hi = int(arr.min()), int(arr.max())
+    if lo < 0 or hi >= hi_exclusive:
+        raise SystemExit(
+            f"--data {name} span [{lo}, {hi}]; {what} (jit would clamp "
+            "the gather silently)")
+
+
+def load_pretokenized(path, seq_len, n_pred, vocab_size=None):
+    """Load + validate a pre-tokenized .npz against the run's shapes and
+    (when given) the model's vocab — every id class jit's gathers would
+    otherwise clamp silently is rejected here."""
     with np.load(path) as z:
         missing = [k for k in _DATA_KEYS if k not in z]
         if missing:
@@ -122,29 +135,19 @@ def load_pretokenized(path, seq_len, n_pred):
                          f"{counts}")
     if len(data["input_ids"]) == 0:
         raise SystemExit(f"--data {path!r} holds zero examples")
-    pos_lo = int(data["masked_lm_positions"].min())
-    pos_hi = int(data["masked_lm_positions"].max())
-    if pos_lo < 0 or pos_hi >= seq_len:
-        raise SystemExit(
-            f"--data masked_lm_positions span [{pos_lo}, {pos_hi}]; "
-            f"sequences are {seq_len} long (jit would clamp the gather "
-            f"silently)")
-    for k in ("input_ids", "token_type_ids", "masked_lm_ids"):
-        if int(data[k].min()) < 0:
+    _check_id_range("masked_lm_positions", data["masked_lm_positions"],
+                    seq_len, f"sequences are {seq_len} long")
+    _check_id_range("token_type_ids", data["token_type_ids"], 2,
+                    "BERT has 2 segment embeddings")
+    _check_id_range("next_sentence_labels", data["next_sentence_labels"],
+                    2, "NSP is binary")
+    for k in ("input_ids", "masked_lm_ids"):
+        if vocab_size is not None:
+            _check_id_range(k, data[k], vocab_size,
+                            f"the vocab is {vocab_size}")
+        elif int(data[k].min()) < 0:   # negatives rejected regardless
             raise SystemExit(f"--data {k} holds negative ids (jit would "
-                             f"clamp the gather silently)")
-    if int(data["token_type_ids"].max()) > 1:
-        raise SystemExit(
-            f"--data token_type_ids reach "
-            f"{int(data['token_type_ids'].max())}; BERT has 2 segment "
-            "embeddings (jit would clamp the gather silently)")
-    nsp_lo = int(data["next_sentence_labels"].min())
-    nsp_hi = int(data["next_sentence_labels"].max())
-    if nsp_lo < 0 or nsp_hi > 1:
-        raise SystemExit(
-            f"--data next_sentence_labels span [{nsp_lo}, {nsp_hi}]; "
-            "NSP is binary (the xentropy label gather would clamp "
-            "silently)")
+                             "clamp the gather silently)")
     return data
 
 
@@ -304,16 +307,8 @@ def main(argv=None):
     data = None
     if args.data:
         data = load_pretokenized(args.data, args.max_seq_length,
-                                 args.max_predictions_per_seq)
-        # range-check LABELS too: an out-of-vocab masked_lm_id would be
-        # clamped by XLA's gather under jit — silently wrong loss, not
-        # a crash
-        top = max(int(data["input_ids"].max()),
-                  int(data["masked_lm_ids"].max()))
-        if top >= cfg.vocab_size:
-            raise SystemExit(
-                f"--data token ids reach {top}; "
-                f"BERT-{args.bert_model} vocab is {cfg.vocab_size}")
+                                 args.max_predictions_per_seq,
+                                 vocab_size=cfg.vocab_size)
         print(f"=> {len(data['input_ids'])} pre-tokenized examples "
               f"from {args.data}")
 
