@@ -20,6 +20,7 @@ heuristic, so a stale file can slow kernels down but never break them.
 from __future__ import annotations
 
 import json
+import math
 import os
 
 VMEM_BUDGET_BYTES = 4 * 1024 * 1024
@@ -71,7 +72,16 @@ def load_overrides(path: str) -> dict:
     partially overwritten (ADVICE r3)."""
     with open(path) as f:
         data = json.load(f)
-    validated = {str(k): int(v) for k, v in data.items()}
+    validated = {}
+    for k, v in data.items():
+        # ints only: bools, digit strings, and non-integral floats (which
+        # int() would silently truncate) must all fail before the commit
+        ok = (isinstance(v, int) and not isinstance(v, bool)) or (
+            isinstance(v, float) and math.isfinite(v) and int(v) == v)
+        if not ok:
+            raise ValueError(
+                f"tuned override {k!r}={v!r} is not an integer")
+        validated[str(k)] = int(v)
     _OVERRIDES.update(validated)
     return validated
 

@@ -146,7 +146,14 @@ def _leaf_spans(evs: List[dict],
             while stack and ts >= stack[-1][1]:
                 stack.pop()
             if stack and (stack[-1][0], stack[-1][1]) != (ts, end):
-                parents.add(stack[-1][2])   # e nests PROPERLY inside
+                # e nests PROPERLY inside the top — and inside every twin
+                # of the top (identical intervals sit adjacent on the
+                # stack as siblings; each one encloses e equally)
+                top = (stack[-1][0], stack[-1][1])
+                for s_ts, s_end, s_id in reversed(stack):
+                    if (s_ts, s_end) != top:
+                        break
+                    parents.add(s_id)
             stack.append((ts, end, id(e)))
         out += [e for e in lane if id(e) not in parents]
     return out

@@ -155,7 +155,7 @@ def make_pipeline_loss_fn(stage_fn: Callable, loss_fn: Callable, *,
         # replicate the scalar across stages so every rank's train step sees
         # the same loss (grads for other stages' params flow via ppermute's
         # transpose regardless). The psum is value-only (stop_gradient):
-        # under check_rep=False its transpose would psum the replicated
+        # under check_vma=False its transpose would psum the replicated
         # cotangent and scale every grad by num_stages.
         total = total + jax.lax.stop_gradient(
             jax.lax.psum(total, axis_name) - total)

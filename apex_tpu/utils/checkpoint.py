@@ -75,8 +75,18 @@ def save_train_checkpoint(path: str, state: Any, step: int, rng) -> str:
     """The recipes' ``--save``: :func:`save_checkpoint` plus the rng key
     in the extra dict, so a resumed run continues the exact random
     stream without replaying ``step`` splits."""
+    rng = jax.numpy.asarray(rng)
+    impl = None
+    if jax.numpy.issubdtype(rng.dtype, jax.dtypes.prng_key):
+        # typed key array (jax_enable_custom_prng): persist its raw data
+        # plus the impl name (rbg keys can't re-wrap as threefry) so
+        # restore rebuilds the same key — np.asarray on the key itself
+        # would fail (ADVICE r4)
+        impl = str(jax.random.key_impl(rng))
+        rng = jax.random.key_data(rng)
     out = save_checkpoint(path, state, step=step,
-                          extra={"rng": np.asarray(rng).tolist()})
+                          extra={"rng": np.asarray(rng).tolist(),
+                                 "rng_impl": impl})
     print(f"=> saved step {step} to {path}")
     return out
 
@@ -91,6 +101,11 @@ def resume_train_checkpoint(path: str, template: Any, rng, *,
     state, start, extra = load_checkpoint(path, template)
     if "rng" in (extra or {}):
         rng = jax.numpy.asarray(extra["rng"], jax.numpy.uint32)
+        impl = extra.get("rng_impl") or (
+            # pre-impl round-5 checkpoints recorded only a typed/raw bit
+            "threefry2x32" if extra.get("rng_typed") else None)
+        if impl:
+            rng = jax.random.wrap_key_data(rng, impl=impl)
     print(f"=> resumed from {path} (step {start})")
     if start >= step_limit:
         raise SystemExit(

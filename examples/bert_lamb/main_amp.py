@@ -200,6 +200,8 @@ def _phase_handoff_params(path, init_fn, params):
 
 def main(argv=None):
     args = parse_args(argv)
+    if args.max_steps < 1:
+        raise SystemExit("--max_steps must be >= 1")
     if args.train_batch_size % max(args.data_parallel, 1):
         raise SystemExit(f"--train_batch_size {args.train_batch_size} "
                          f"must divide by --data-parallel "
@@ -275,7 +277,7 @@ def main(argv=None):
         # reference shape: apex DDP over the batch + FusedLAMB — here one
         # grad psum over the 'data' axis (examples/imagenet's pattern);
         # the dropout rng is folded per-rank so masks differ across shards
-        from jax.experimental.shard_map import shard_map
+        from jax import shard_map
         from jax.sharding import Mesh, PartitionSpec as P
 
         from apex_tpu import comm
@@ -292,7 +294,7 @@ def main(argv=None):
             sharded_step, mesh=mesh,
             in_specs=(P(), (P("data"), P("data"), P("data"), P("data"),
                             P("data"), P("data"), P())),
-            out_specs=(P(), P()), check_rep=False),
+            out_specs=(P(), P()), check_vma=False),
             donate_argnums=(0,))
         ctx = mesh
     else:

@@ -162,7 +162,7 @@ def build_parallel_lm(args, policy):
     ``[B, seq_len+1]``, sharded over 'data' by the step itself.
     """
     from jax.sharding import Mesh, PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     from apex_tpu import comm
     from apex_tpu.kernels.layer_norm import layer_norm
@@ -587,12 +587,12 @@ def build_parallel_lm(args, policy):
 
     sspec = jax.tree_util.tree_map_with_path(state_spec, state_shapes)
     sharded_init = jax.jit(shard_map(init_fn, mesh=mesh, in_specs=(pspec,),
-                                     out_specs=sspec, check_rep=False))
+                                     out_specs=sspec, check_vma=False))
     state = sharded_init(params)
 
     sharded = shard_map(step_fn, mesh=mesh,
                         in_specs=(sspec, P("data")),
-                        out_specs=(sspec, P()), check_rep=False)
+                        out_specs=(sspec, P()), check_vma=False)
     jit_step = jax.jit(sharded, donate_argnums=(0,))
     n_params = sum(int(np.prod(p.shape))
                    for p in jax.tree_util.tree_leaves(params))

@@ -12,7 +12,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 from apex_tpu.transformer import pipeline_parallel as pp
 
@@ -58,7 +58,7 @@ def test_pipeline_apply_matches_sequential(pipe_mesh):
 
     @functools.partial(shard_map, mesh=pipe_mesh,
                        in_specs=(P("pipe"), P()), out_specs=P(),
-                       check_rep=False)
+                       check_vma=False)
     def run(ws_local, mb):
         w = ws_local[0]  # [1, D, D] local slice
         return pp.pipeline_apply(stage_fn, w, mb, num_stages=PP)
@@ -78,7 +78,7 @@ def test_pipeline_loss_and_grads_match_sequential(pipe_mesh):
 
     @functools.partial(shard_map, mesh=pipe_mesh,
                        in_specs=(P("pipe"), P(), P()),
-                       out_specs=(P(), P("pipe")), check_rep=False)
+                       out_specs=(P(), P("pipe")), check_vma=False)
     def run(ws_local, mb, tg):
         w = ws_local[0]
         l, g = jax.value_and_grad(pl)(w, (mb, tg))
@@ -108,7 +108,7 @@ def test_interleaved_pipeline(eight_devices):
 
     @functools.partial(shard_map, mesh=mesh,
                        in_specs=(P("pipe"), P(), P()),
-                       out_specs=(P(), P("pipe")), check_rep=False)
+                       out_specs=(P(), P("pipe")), check_vma=False)
     def run(ws_local, mb, tg):
         l, g = jax.value_and_grad(pl)(ws_local, (mb, tg))
         return l, g
@@ -141,7 +141,7 @@ def test_shift_ring(eight_devices):
     mesh = Mesh(np.array(eight_devices[:4]), ("pipe",))
 
     @functools.partial(shard_map, mesh=mesh, in_specs=(P("pipe"),),
-                       out_specs=P("pipe"), check_rep=False)
+                       out_specs=P("pipe"), check_vma=False)
     def shift(x):
         return pp.shift_right(x, n=4)
 
@@ -182,7 +182,7 @@ def test_1f1b_matches_sequential(pipe_mesh):
 
     @functools.partial(shard_map, mesh=pipe_mesh,
                        in_specs=(P("pipe"), P(), P()),
-                       out_specs=(P(), P("pipe")), check_rep=False)
+                       out_specs=(P(), P("pipe")), check_vma=False)
     def run(ws_local, mb, tg):
         l, g = pp.forward_backward_1f1b(stage_fn, loss_fn, ws_local[0],
                                         mb, tg, num_stages=PP)
@@ -202,7 +202,7 @@ def test_1f1b_via_reference_shaped_api(pipe_mesh):
 
     @functools.partial(shard_map, mesh=pipe_mesh,
                        in_specs=(P("pipe"), P(), P()),
-                       out_specs=(P(), P("pipe")), check_rep=False)
+                       out_specs=(P(), P("pipe")), check_vma=False)
     def run(ws_local, mb, tg):
         l, g = pp.forward_backward_pipelining_without_interleaving(
             stage_fn, loss_fn, ws_local[0], mb, tg, num_stages=PP)
@@ -223,7 +223,7 @@ def test_1f1b_loss_scale_scales_grads_only(pipe_mesh):
     def run_with(scale):
         @functools.partial(shard_map, mesh=pipe_mesh,
                            in_specs=(P("pipe"), P(), P()),
-                           out_specs=(P(), P("pipe")), check_rep=False)
+                           out_specs=(P(), P("pipe")), check_vma=False)
         def run(ws_local, mb, tg):
             l, g = pp.forward_backward_1f1b(
                 stage_fn, loss_fn, ws_local[0], mb, tg, num_stages=PP,
@@ -257,7 +257,7 @@ def test_1f1b_memory_flat_as_microbatches_double(pipe_mesh):
     def onef1b(ws, mb, tg):
         @functools.partial(shard_map, mesh=pipe_mesh,
                            in_specs=(P("pipe"), P(), P()),
-                           out_specs=(P(), P("pipe")), check_rep=False)
+                           out_specs=(P(), P("pipe")), check_vma=False)
         def run(ws_local, mb, tg):
             l, g = pp.forward_backward_1f1b(big_stage, loss_fn, ws_local[0],
                                             mb, tg, num_stages=PP)
@@ -269,7 +269,7 @@ def test_1f1b_memory_flat_as_microbatches_double(pipe_mesh):
 
         @functools.partial(shard_map, mesh=pipe_mesh,
                            in_specs=(P("pipe"), P(), P()),
-                           out_specs=(P(), P("pipe")), check_rep=False)
+                           out_specs=(P(), P("pipe")), check_vma=False)
         def run(ws_local, mb, tg):
             l, g = jax.value_and_grad(pl)(ws_local[0], (mb, tg))
             return l, g[None]
@@ -311,7 +311,7 @@ def test_interleaved_1f1b_matches_sequential(eight_devices, pp_size, v):
 
     @functools.partial(shard_map, mesh=mesh,
                        in_specs=(P("pipe"), P(), P()),
-                       out_specs=(P(), P("pipe")), check_rep=False)
+                       out_specs=(P(), P("pipe")), check_vma=False)
     def run(ws_local, mb, tg):
         l, g = pp.forward_backward_1f1b(stage_fn, loss_fn, ws_local, mb, tg,
                                         num_stages=pp_size, num_chunks=v)
@@ -347,7 +347,7 @@ def test_interleaved_reference_api_routes_to_1f1b(eight_devices):
 
     @functools.partial(shard_map, mesh=mesh,
                        in_specs=(P("pipe"), P(), P()),
-                       out_specs=(P(), P("pipe")), check_rep=False)
+                       out_specs=(P(), P("pipe")), check_vma=False)
     def run(ws_local, mb, tg):
         l, g = fb(stage_fn, loss_fn, ws_local, mb, tg)
         return l, g
@@ -380,7 +380,7 @@ def test_interleaved_1f1b_memory_flat_as_microbatches_double(pipe_mesh):
     def onef1b(ws, mb, tg):
         @functools.partial(shard_map, mesh=pipe_mesh,
                            in_specs=(P("pipe"), P(), P()),
-                           out_specs=(P(), P("pipe")), check_rep=False)
+                           out_specs=(P(), P("pipe")), check_vma=False)
         def run(ws_local, mb, tg):
             l, g = pp.forward_backward_1f1b(big_stage, loss_fn, ws_local,
                                             mb, tg, num_stages=PP,
@@ -394,7 +394,7 @@ def test_interleaved_1f1b_memory_flat_as_microbatches_double(pipe_mesh):
 
         @functools.partial(shard_map, mesh=pipe_mesh,
                            in_specs=(P("pipe"), P(), P()),
-                           out_specs=(P(), P("pipe")), check_rep=False)
+                           out_specs=(P(), P("pipe")), check_vma=False)
         def run(ws_local, mb, tg):
             l, g = jax.value_and_grad(pl)(ws_local, (mb, tg))
             return l, g
@@ -423,7 +423,7 @@ def test_1f1b_cotangent_dtype(pipe_mesh):
     def run_with(cdt):
         @functools.partial(shard_map, mesh=pipe_mesh,
                            in_specs=(P("pipe"), P(), P()),
-                           out_specs=(P(), P("pipe")), check_rep=False)
+                           out_specs=(P(), P("pipe")), check_vma=False)
         def run(ws_local, mb, tg):
             l, g = pp.forward_backward_1f1b(
                 bf16_stage, loss_fn, ws_local[0], mb, tg, num_stages=PP,
@@ -481,7 +481,7 @@ def test_interleaved_pipeline_vpp3_pp4(eight_devices):
 
     @functools.partial(shard_map, mesh=mesh,
                        in_specs=(P("pipe"), P(), P()),
-                       out_specs=(P(), P("pipe")), check_rep=False)
+                       out_specs=(P(), P("pipe")), check_vma=False)
     def run(ws_local, mb, tg):
         l, g = jax.value_and_grad(pl)(ws_local, (mb, tg))
         return l, g
@@ -527,7 +527,7 @@ def test_pipeline_remat_reduces_residuals(pipe_mesh):
 
         @functools.partial(shard_map, mesh=pipe_mesh,
                            in_specs=(P("pipe"), P(), P()),
-                           out_specs=(P(), P("pipe")), check_rep=False)
+                           out_specs=(P(), P("pipe")), check_vma=False)
         def run(ws_local, mb, tg):
             l, g = jax.value_and_grad(pl)(ws_local[0], (mb, tg))
             return l, g[None]

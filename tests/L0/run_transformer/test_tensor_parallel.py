@@ -12,7 +12,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 from apex_tpu.transformer import tensor_parallel as tp
 
@@ -29,7 +29,7 @@ def _stacked_init(module, x_local, mesh):
     plain P('model') out_spec works for every leaf."""
 
     @functools.partial(shard_map, mesh=mesh, in_specs=(P(),),
-                       out_specs=P("model"), check_rep=False)
+                       out_specs=P("model"), check_vma=False)
     def init(x):
         v = module.init(jax.random.PRNGKey(0), x)
         return jax.tree_util.tree_map(lambda l: l[None], v)
@@ -45,7 +45,7 @@ def test_column_parallel_linear_matches_dense(model_mesh):
 
     @functools.partial(shard_map, mesh=model_mesh,
                        in_specs=(P("model"), P()), out_specs=P(),
-                       check_rep=False)
+                       check_vma=False)
     def fwd(sv, x):
         v = jax.tree_util.tree_map(lambda l: l[0], sv)
         y = m.apply(v, x)
@@ -70,7 +70,7 @@ def test_row_parallel_linear_matches_dense(model_mesh):
 
     @functools.partial(shard_map, mesh=model_mesh,
                        in_specs=(P("model"), P(None, "model")),
-                       out_specs=P(), check_rep=False)
+                       out_specs=P(), check_vma=False)
     def fwd(sv, x_local):
         v = jax.tree_util.tree_map(lambda l: l[0], sv)
         return m.apply(v, x_local)  # psum inside → replicated
@@ -95,7 +95,7 @@ def test_column_row_grads_match_dense(model_mesh):
     x = jax.random.normal(jax.random.PRNGKey(2), (4, 8))
 
     @functools.partial(shard_map, mesh=model_mesh, in_specs=(P(),),
-                       out_specs=P("model"), check_rep=False)
+                       out_specs=P("model"), check_vma=False)
     def init(x):
         vc = col.init(jax.random.PRNGKey(0), x)
         h = col.apply(vc, x)
@@ -107,7 +107,7 @@ def test_column_row_grads_match_dense(model_mesh):
     @functools.partial(shard_map, mesh=model_mesh,
                        in_specs=(P("model"), P("model"), P()),
                        out_specs=(P(), P("model"), P("model")),
-                       check_rep=False)
+                       check_vma=False)
     def lg(svc, svr, x):
         vc = jax.tree_util.tree_map(lambda l: l[0], svc)
         vr = jax.tree_util.tree_map(lambda l: l[0], svr)
@@ -159,7 +159,7 @@ def test_vocab_parallel_embedding(model_mesh):
 
     @functools.partial(shard_map, mesh=model_mesh,
                        in_specs=(P("model"), P()), out_specs=P(),
-                       check_rep=False)
+                       check_vma=False)
     def fwd(sv, ids):
         v = jax.tree_util.tree_map(lambda l: l[0], sv)
         return m.apply(v, ids)
@@ -178,7 +178,7 @@ def test_vocab_parallel_cross_entropy(model_mesh):
 
     @functools.partial(shard_map, mesh=model_mesh,
                        in_specs=(P(None, "model"), P()), out_specs=P(),
-                       check_rep=False)
+                       check_vma=False)
     def xent(lg, t):
         return tp.vocab_parallel_cross_entropy(lg, t)
 
@@ -195,7 +195,7 @@ def test_vocab_parallel_cross_entropy_grad(model_mesh):
 
     @functools.partial(shard_map, mesh=model_mesh,
                        in_specs=(P(None, "model"), P()),
-                       out_specs=P(None, "model"), check_rep=False)
+                       out_specs=P(None, "model"), check_vma=False)
     def grad_fn(lg, t):
         return jax.grad(
             lambda l: jnp.mean(tp.vocab_parallel_cross_entropy(l, t)))(lg)
@@ -226,7 +226,7 @@ def test_mappings_roundtrip(model_mesh):
     x = jax.random.normal(jax.random.PRNGKey(9), (4, 8))
 
     @functools.partial(shard_map, mesh=model_mesh, in_specs=(P(),),
-                       out_specs=P(), check_rep=False)
+                       out_specs=P(), check_vma=False)
     def roundtrip(x):
         local = tp.scatter_to_tensor_model_parallel_region(x, "model", -1)
         back = tp.gather_from_tensor_model_parallel_region(local, "model", -1)
@@ -240,7 +240,7 @@ def test_copy_reduce_duality(model_mesh):
     x = jnp.ones((3,))
 
     @functools.partial(shard_map, mesh=model_mesh, in_specs=(P(),),
-                       out_specs=P(), check_rep=False)
+                       out_specs=P(), check_vma=False)
     def f(x):
         y = tp.copy_to_tensor_model_parallel_region(x, "model")
         g = jax.grad(lambda v: jnp.sum(
@@ -262,7 +262,7 @@ def test_sequence_parallel_pair(model_mesh):
     x = jax.random.normal(jax.random.PRNGKey(10), (8, 4))
 
     @functools.partial(shard_map, mesh=model_mesh, in_specs=(P(),),
-                       out_specs=P("model"), check_rep=False)
+                       out_specs=P("model"), check_vma=False)
     def rs(x):
         return tp.reduce_scatter_to_sequence_parallel_region(x, "model", 0)
 
@@ -272,7 +272,7 @@ def test_sequence_parallel_pair(model_mesh):
 
     @functools.partial(shard_map, mesh=model_mesh,
                        in_specs=(P("model"),), out_specs=P(),
-                       check_rep=False)
+                       check_vma=False)
     def ag(xl):
         return tp.gather_from_sequence_parallel_region(xl, "model", 0)
 

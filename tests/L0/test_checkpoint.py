@@ -292,3 +292,45 @@ def test_abstract_template_restores_without_materializing(tmp_path):
     abstract2 = jax.eval_shape(lambda: template)
     with pytest.raises(ValueError, match="real-valued template"):
         load_checkpoint(path, abstract2)
+
+
+@pytest.mark.parametrize("typed", [False, True])
+def test_train_checkpoint_rng_roundtrip(tmp_path, typed):
+    """ADVICE r4: save/resume must handle BOTH rng representations — raw
+    uint32 PRNGKey arrays and typed key arrays (jax.random.key) — and
+    restore the one that was saved, not silently coerce."""
+    from apex_tpu.utils.checkpoint import (resume_train_checkpoint,
+                                           save_train_checkpoint)
+
+    rng = jax.random.key(7) if typed else jax.random.PRNGKey(7)
+    tree = {"w": jnp.arange(4.0)}
+    path = os.path.join(tmp_path, "t.npz")
+    save_train_checkpoint(path, tree, step=3, rng=rng)
+    _, start, rng2 = resume_train_checkpoint(
+        path, tree, jax.random.PRNGKey(0), step_limit=10,
+        limit_flag="--iters")
+    assert start == 3
+    assert jnp.issubdtype(rng2.dtype, jax.dtypes.prng_key) == typed
+    # the restored key drives the same stream
+    a = jax.random.normal(jax.random.fold_in(rng, 1), (3,))
+    b = jax.random.normal(jax.random.fold_in(rng2, 1), (3,))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_train_checkpoint_rng_preserves_key_impl(tmp_path):
+    """A non-default typed key (rbg) must restore with ITS impl — wrapping
+    its data as threefry would raise on shape, or worse, change the
+    stream."""
+    from apex_tpu.utils.checkpoint import (resume_train_checkpoint,
+                                           save_train_checkpoint)
+
+    rng = jax.random.key(7, impl="rbg")
+    path = os.path.join(tmp_path, "t.npz")
+    save_train_checkpoint(path, {"w": jnp.ones(3)}, step=1, rng=rng)
+    _, _, rng2 = resume_train_checkpoint(
+        path, {"w": jnp.ones(3)}, jax.random.PRNGKey(0), step_limit=5,
+        limit_flag="--iters")
+    assert str(jax.random.key_impl(rng2)) == "rbg"
+    a = jax.random.normal(jax.random.fold_in(rng, 1), (3,))
+    b = jax.random.normal(jax.random.fold_in(rng2, 1), (3,))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

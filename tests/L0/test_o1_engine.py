@@ -229,7 +229,7 @@ def test_tensor_parallel_layers_consult_engine(eight_devices):
     """Column/RowParallelLinear run half under O1 when dtype=None, fp32
     otherwise — the Megatron path honors the same tables as the rest."""
     import functools
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
     from apex_tpu.transformer.tensor_parallel import (ColumnParallelLinear,
                                                       RowParallelLinear)
@@ -241,7 +241,7 @@ def test_tensor_parallel_layers_consult_engine(eight_devices):
     x = jnp.ones((4, 8), jnp.float32)
 
     @functools.partial(shard_map, mesh=mesh, in_specs=P(),
-                       out_specs=(P(), P()), check_rep=False)
+                       out_specs=(P(), P()), check_vma=False)
     def run(x):
         cv = col.init(jax.random.PRNGKey(0), x)
         h = col.apply(cv, x)

@@ -176,3 +176,16 @@ def test_leaf_spans_identical_intervals_are_siblings():
     out = _leaf_spans([host_a, host_b],
                       lane_of=lambda e: (lanes[id(e)], e.get("pid")))
     assert len(out) == 2, "cross-file spans must not nest"
+
+
+def test_leaf_spans_twin_parents_both_dropped():
+    """ADVICE r4: when two identical-(ts, dur) spans BOTH enclose a
+    child, both are parents and both must be dropped — not just the
+    most-recently-pushed twin."""
+    from apex_tpu.pyprof import _leaf_spans
+
+    twin_a = {"pid": 1, "tid": 1, "ts": 0.0, "dur": 10.0, "name": "tw_a"}
+    twin_b = {"pid": 1, "tid": 1, "ts": 0.0, "dur": 10.0, "name": "tw_b"}
+    child = {"pid": 1, "tid": 1, "ts": 2.0, "dur": 3.0, "name": "child"}
+    out = _leaf_spans([twin_a, twin_b, child])
+    assert [e["name"] for e in out] == ["child"]

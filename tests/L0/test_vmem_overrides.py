@@ -155,3 +155,52 @@ def test_load_overrides_atomic_on_bad_value(tmp_path):
             "partial override set committed from an invalid file"
     finally:
         vmem.clear_overrides()
+
+
+@pytest.mark.parametrize("bad", [12.7, "128", True])
+def test_load_overrides_rejects_non_integer_values(tmp_path, bad):
+    """ADVICE r4: int(v) must not silently truncate floats or accept
+    digit strings/bools — every non-integer value fails before commit."""
+    import os
+
+    vmem.clear_overrides()
+    try:
+        path = os.path.join(tmp_path, "tuned.json")
+        with open(path, "w") as f:
+            json.dump({"flash.block_q": bad}, f)
+        with pytest.raises(ValueError):
+            vmem.load_overrides(path)
+        assert vmem.overrides() == {}
+    finally:
+        vmem.clear_overrides()
+
+
+def test_load_overrides_accepts_integral_float(tmp_path):
+    """A JSON 128.0 is an exact integer — accepted, stored as int."""
+    import os
+
+    vmem.clear_overrides()
+    try:
+        path = os.path.join(tmp_path, "tuned.json")
+        with open(path, "w") as f:
+            json.dump({"flash.block_q": 128.0}, f)
+        assert vmem.load_overrides(path) == {"flash.block_q": 128}
+    finally:
+        vmem.clear_overrides()
+
+
+def test_load_overrides_rejects_infinity_with_valueerror(tmp_path):
+    """json accepts bare Infinity; the validator must turn it into the
+    documented ValueError, not leak OverflowError from int()."""
+    import os
+
+    vmem.clear_overrides()
+    try:
+        path = os.path.join(tmp_path, "tuned.json")
+        with open(path, "w") as f:
+            f.write('{"flash.block_q": Infinity}')
+        with pytest.raises(ValueError, match="not an integer"):
+            vmem.load_overrides(path)
+        assert vmem.overrides() == {}
+    finally:
+        vmem.clear_overrides()

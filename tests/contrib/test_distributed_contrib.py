@@ -11,7 +11,7 @@ import numpy as np
 import optax
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 from apex_tpu import comm
 
@@ -48,7 +48,7 @@ def test_dist_adam_matches_fused_adam(data_mesh):
 
     @functools.partial(shard_map, mesh=data_mesh,
                        in_specs=(P(), P(), P("data")), out_specs=P(),
-                       check_rep=False)
+                       check_vma=False)
     def sharded_step(params, state_and_base, rank_scale):
         state, base = state_and_base
         grads = jax.tree_util.tree_map(lambda g: g * rank_scale[0], base)
@@ -90,7 +90,7 @@ def test_dist_lamb_runs_and_differs_by_trust_ratio(data_mesh):
 
     @functools.partial(shard_map, mesh=data_mesh,
                        in_specs=(P(), P()), out_specs=P(),
-                       check_rep=False)
+                       check_vma=False)
     def step(params, state):
         grads = jax.tree_util.tree_map(jnp.ones_like, params)
         upd, _ = tx.update(grads, state, params)
@@ -126,7 +126,7 @@ def test_dist_lamb_matches_fused_lamb(data_mesh, dtype):
 
     @functools.partial(shard_map, mesh=data_mesh,
                        in_specs=(P(), P(), P("data")), out_specs=P(),
-                       check_rep=False)
+                       check_vma=False)
     def run(params, state, rank_scale):
         for _ in range(steps):
             grads = jax.tree_util.tree_map(lambda g: g * rank_scale[0], base)
@@ -172,7 +172,7 @@ def test_dist_lamb_nvlamb_switch_matches_fused_lamb(data_mesh):
 
         @functools.partial(shard_map, mesh=data_mesh,
                            in_specs=(P(), P()), out_specs=P(),
-                           check_rep=False)
+                           check_vma=False)
         def run(params, state):
             upd, _ = tx.update(grads, state, params)
             return optax.apply_updates(params, upd)
@@ -215,7 +215,7 @@ def test_zero_state_resharded_roundtrip(data_mesh, tmp_path):
 
         @functools.partial(shard_map, mesh=mesh,
                            in_specs=(P(), sspec, P("data")),
-                           out_specs=(P(), sspec), check_rep=False)
+                           out_specs=(P(), sspec), check_vma=False)
         def run(params, state, rank_scale):
             for _ in range(steps):
                 grads = jax.tree_util.tree_map(
@@ -304,7 +304,7 @@ def test_halo_exchange_1d(data_mesh):
 
     @functools.partial(shard_map, mesh=data_mesh,
                        in_specs=(P("data"),), out_specs=P("data"),
-                       check_rep=False)
+                       check_vma=False)
     def ex(xl):
         return halo_exchange_1d(xl, 1, "data", dim=0)
 
@@ -339,7 +339,7 @@ def test_spatial_bottleneck_matches_dense(data_mesh):
 
     @functools.partial(shard_map, mesh=data_mesh,
                        in_specs=(P(), P(None, "data")),
-                       out_specs=P(None, "data"), check_rep=False)
+                       out_specs=P(None, "data"), check_vma=False)
     def run(variables, xl):
         return spatial.apply(variables, xl, train=False)
 

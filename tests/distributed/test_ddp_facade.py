@@ -11,7 +11,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 import pytest
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from apex_tpu import amp
@@ -82,7 +82,7 @@ def test_manual_ddp_loop_matches_make_train_step(data_mesh, predivide):
     run_manual = jax.jit(functools.partial(
         shard_map, mesh=data_mesh,
         in_specs=(P(), P(), P(), (P("data"), P("data"))),
-        out_specs=(P(), P(), P()), check_rep=False)(manual_step))
+        out_specs=(P(), P(), P()), check_vma=False)(manual_step))
 
     p_a, opt_a, sc_a = params, tx.init(params), init_scaler("dynamic")
     for b in batches:
@@ -96,7 +96,7 @@ def test_manual_ddp_loop_matches_make_train_step(data_mesh, predivide):
     run_b = jax.jit(functools.partial(
         shard_map, mesh=data_mesh,
         in_specs=(P(), (P("data"), P("data"))), out_specs=P(),
-        check_rep=False)(step_fn))
+        check_vma=False)(step_fn))
     st = init_fn(params)
     for b in batches:
         st, _ = run_b(st, b)
@@ -126,7 +126,7 @@ def test_ddp_allreduce_always_fp32(data_mesh):
                                   allreduce_always_fp32=True)
 
     @functools.partial(shard_map, mesh=data_mesh, in_specs=P("data"),
-                       out_specs=P(), check_rep=False)
+                       out_specs=P(), check_vma=False)
     def reduce(gs):
         out = ddp.reduce_gradients({"g": gs[0]})
         return out["g"]
