@@ -82,6 +82,14 @@ def parse_args(argv=None):
                         "params; needs dp>1)")
     p.add_argument("--microbatches", type=int, default=None,
                    help="pipeline microbatches (default 2*pp)")
+    p.add_argument("--partitioning", default="shard_map",
+                   choices=["shard_map", "gspmd"],
+                   help="how the mesh is driven: explicit shard_map "
+                        "collectives (default), or 'gspmd' — plain "
+                        "jax.jit over the SAME 1-device program with "
+                        "NamedShardings built from the TP modules' "
+                        "kernel_partition_spec(); XLA's SPMD partitioner "
+                        "inserts the collectives (dp x tp only)")
     p.add_argument("--save", default=None, metavar="CKPT",
                    help="write the final train state (params, masters, "
                         "optimizer state incl. ZeRO shards, scaler) plus "
@@ -173,6 +181,7 @@ def build_parallel_lm(args, policy):
 
     dp, tp = args.data_parallel, args.tensor_parallel
     pp, vpp = args.pipeline_parallel, args.virtual_pipeline
+    gspmd = getattr(args, "partitioning", "shard_map") == "gspmd"
     hidden, layers, heads = _LM_SIZES[args.size]
     if args.layers:
         layers = args.layers
@@ -205,6 +214,14 @@ def build_parallel_lm(args, policy):
     zero_on = bool(args.zero)
     if zero_on and dp < 2:
         raise SystemExit("--zero needs --data-parallel > 1")
+    if gspmd and (pp > 1 or vpp > 1 or sp_on or vp_on or zero_on):
+        raise SystemExit(
+            "--partitioning gspmd drives dp x tp only; pipeline/"
+            "sequence/vocab-parallel and --zero run under the "
+            "(default) shard_map path")
+    # Under GSPMD the module MATH is the 1-device program (world 1, no
+    # mappings.py collectives); tp lives only in the sharding specs.
+    tpm = 1 if gspmd else tp
     per_stage = layers // L
     H, V, S = hidden, args.vocab_size, args.seq_len
     inner = 4 * H
@@ -218,25 +235,25 @@ def build_parallel_lm(args, policy):
     mesh = Mesh(np.array(devices[:n_dev]).reshape(dp, pp, tp),
                 ("data", "pipe", "model"))
 
-    h_local, d_head = heads // tp, H // heads
+    h_local, d_head = heads // tpm, H // heads
     mdt = policy.model_dtype  # thread into the TP modules (ADVICE round-2)
     # Under SP the column linears all-gather the sequence (dim 0 — hence
     # the recipe's seq-first [s, mb, H] activation layout) and the row
     # linears reduce-scatter it back: the TP allreduce split into its two
     # halves around the seq-sharded LN/residual region (SURVEY §3.3 SP).
     col_qkv = ColumnParallelLinear(input_size=H, output_size=3 * H,
-                                   use_bias=False, world_size=tp, dtype=mdt,
+                                   use_bias=False, world_size=tpm, dtype=mdt,
                                    sequence_parallel_enabled=sp_on)
     row_proj = RowParallelLinear(input_size=H, output_size=H, use_bias=True,
-                                 input_is_parallel=True, world_size=tp,
+                                 input_is_parallel=True, world_size=tpm,
                                  dtype=mdt,
                                  sequence_parallel_enabled=sp_on)
     col_mlp = ColumnParallelLinear(input_size=H, output_size=inner,
-                                   use_bias=False, world_size=tp, dtype=mdt,
+                                   use_bias=False, world_size=tpm, dtype=mdt,
                                    sequence_parallel_enabled=sp_on)
     row_mlp = RowParallelLinear(input_size=inner, output_size=H,
                                 use_bias=True, input_is_parallel=True,
-                                world_size=tp, dtype=mdt,
+                                world_size=tpm, dtype=mdt,
                                 sequence_parallel_enabled=sp_on)
 
     # ---- parameters. TP-sharded leaves ("col") carry an explicit model-
@@ -261,18 +278,18 @@ def build_parallel_lm(args, policy):
             # rank r owns heads [r*h_local, (r+1)*h_local)
             "qkv_k": jnp.stack(
                 [qkv_full[:, :, :, :, r * h_local:(r + 1) * h_local]
-                 .reshape(L, per_stage, H, 3 * H // tp)
-                 for r in range(tp)], axis=1),
+                 .reshape(L, per_stage, H, 3 * H // tpm)
+                 for r in range(tpm)], axis=1),
             "proj_k": jnp.stack(
                 [proj_full[:, :, r * h_local:(r + 1) * h_local]
-                 .reshape(L, per_stage, H // tp, H)
-                 for r in range(tp)], axis=1),
+                 .reshape(L, per_stage, H // tpm, H)
+                 for r in range(tpm)], axis=1),
             "mlp_in_k": jnp.stack(
-                [mlp_in_full[..., r * (inner // tp):(r + 1) * (inner // tp)]
-                 for r in range(tp)], axis=1),
+                [mlp_in_full[..., r * (inner // tpm):(r + 1) * (inner // tpm)]
+                 for r in range(tpm)], axis=1),
             "mlp_out_k": jnp.stack(
-                [mlp_out_full[:, :, r * (inner // tp):(r + 1) * (inner // tp)]
-                 for r in range(tp)], axis=1),
+                [mlp_out_full[:, :, r * (inner // tpm):(r + 1) * (inner // tpm)]
+                 for r in range(tpm)], axis=1),
         }
         rep = {
             "ln1_s": jnp.ones((L, per_stage, H)),
@@ -523,6 +540,12 @@ def build_parallel_lm(args, policy):
                  if size > 1)
     if zero_on:
         sync = ("data",) + sync
+    if gspmd:
+        # one LOGICAL program: the loss is the global-batch mean and the
+        # grads are its true gradients — XLA's SPMD partitioner inserts
+        # the data-parallel reduction itself, and found_inf is a single
+        # global value (no axis to sync over)
+        grad_avg_axis, sync = None, ()
     init_fn, step_fn = amp.make_train_step(
         None, optimizer, policy, grad_fn=grad_fn,
         grad_average_axis=grad_avg_axis,
@@ -534,6 +557,10 @@ def build_parallel_lm(args, policy):
 
     def _keys(path):
         return [getattr(k, "key", getattr(k, "name", None)) for k in path]
+
+    if gspmd:
+        return _finish_gspmd(args, mesh, init_fn, step_fn, params, _keys,
+                             H=H, V=V, inner=inner, tp=tp)
 
     def param_spec(path, _leaf):
         keys = _keys(path)
@@ -594,6 +621,86 @@ def build_parallel_lm(args, policy):
                         in_specs=(sspec, P("data")),
                         out_specs=(sspec, P()), check_vma=False)
     jit_step = jax.jit(sharded, donate_argnums=(0,))
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(params))
+    return mesh, state, jit_step, n_params
+
+
+def _finish_gspmd(args, mesh, init_fn, step_fn, params, _keys, *,
+                  H, V, inner, tp):
+    """The GSPMD/pjit tier (SURVEY §3.3 TP row: "pjit with sharded weight
+    specs — the mappings collapse into sharding constraints").
+
+    The step is the SAME 1-device program build_parallel_lm composed (tp=1
+    module math, no mappings.py collectives, no shard_map); the dp x tp
+    distribution comes ENTIRELY from NamedShardings built from the TP
+    modules' own ``kernel_partition_spec()``: column kernels P(None,
+    'model'), row kernels P('model', None), the embedding table vocab-
+    sharded P('model', None), the LM head as a vocab-column parallel
+    linear, the batch P('data'). XLA's SPMD partitioner inserts the TP
+    all-reduces and the DP grad reduction that the shard_map path spells
+    out explicitly — trajectory parity between the two paths and the
+    1-device oracle is asserted in tests/distributed/
+    test_lm_gspmd.py. fp32 masters ride the same specs as their params;
+    fused_adam's flat m/v superbuffers stay replicated (their sharded
+    layout is the ZeRO tier's job — contrib DistributedFusedAdam on the
+    shard_map path).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from apex_tpu.transformer.tensor_parallel.layers import (
+        ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding)
+
+    B, S = args.batch_size, args.seq_len
+    # the specs come from the MODULES — these four instances are the
+    # single source of truth for how each kernel class shards over tp
+    spec_col = ColumnParallelLinear(
+        input_size=H, output_size=3 * H,
+        world_size=tp).kernel_partition_spec()        # P(None, 'model')
+    spec_row = RowParallelLinear(
+        input_size=inner, output_size=H,
+        world_size=tp).kernel_partition_spec()        # P('model', None)
+    spec_emb = VocabParallelEmbedding(
+        num_embeddings=V, embedding_dim=H,
+        world_size=tp).kernel_partition_spec()        # P('model', None)
+    spec_head = ColumnParallelLinear(
+        input_size=H, output_size=V,
+        world_size=tp).kernel_partition_spec()        # vocab-column head
+
+    matrix_spec = {"qkv_k": spec_col, "mlp_in_k": spec_col,
+                   "proj_k": spec_row, "mlp_out_k": spec_row}
+
+    def extend(spec, ndim):
+        # col leaves are stacked [L=1, shard=1, layers, <matrix dims>]:
+        # the module spec names the trailing matrix dims, leading stack
+        # dims stay replicated
+        return P(*([None] * (ndim - len(spec)) + list(spec)))
+
+    def leaf_spec(path, leaf):
+        keys = _keys(path)
+        ndim = len(getattr(leaf, "shape", ()))
+        if "col" in keys:
+            return extend(matrix_spec[keys[-1]], ndim)
+        if "wte" in keys:
+            return spec_emb
+        if "head" in keys and "kernel" in keys:
+            return spec_head
+        return P()
+
+    state_shapes = jax.eval_shape(init_fn, params)
+    state_sh = jax.tree_util.tree_map_with_path(
+        lambda path, sds: NamedSharding(mesh, leaf_spec(path, sds)),
+        state_shapes)
+    batch_struct = jax.ShapeDtypeStruct((B, S + 1), jnp.int32)
+    batch_sh = NamedSharding(mesh, P("data"))
+    metrics_shapes = jax.eval_shape(step_fn, state_shapes, batch_struct)[1]
+    metrics_sh = jax.tree_util.tree_map(
+        lambda _: NamedSharding(mesh, P()), metrics_shapes)
+
+    state = jax.jit(init_fn, out_shardings=state_sh)(params)
+    jit_step = jax.jit(step_fn, in_shardings=(state_sh, batch_sh),
+                       out_shardings=(state_sh, metrics_sh),
+                       donate_argnums=(0,))
     n_params = sum(int(np.prod(p.shape))
                    for p in jax.tree_util.tree_leaves(params))
     return mesh, state, jit_step, n_params
@@ -697,7 +804,8 @@ def run_parallel(args, policy):
           f"vpp={args.virtual_pipeline}"
           f"{' sp' if args.sequence_parallel else ''}"
           f"{' vocab-parallel' if args.vocab_parallel else ''}"
-          f"{' zero' if args.zero else ''}, "
+          f"{' zero' if args.zero else ''}"
+          f"{' gspmd' if args.partitioning == 'gspmd' else ''}, "
           f"params: {n_params:,}")
     data = None
     if args.data:
@@ -771,6 +879,9 @@ def main(argv=None):
     if (args.data_parallel * args.tensor_parallel
             * args.pipeline_parallel * args.virtual_pipeline) > 1:
         return run_parallel(args, policy)
+    if args.partitioning == "gspmd":
+        raise SystemExit("--partitioning gspmd needs a mesh: pass "
+                         "--data-parallel and/or --tensor-parallel > 1")
 
     model = create_lm(args.size, vocab_size=args.vocab_size,
                       max_seq_len=args.seq_len, remat=args.remat,

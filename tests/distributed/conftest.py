@@ -1,0 +1,32 @@
+"""Shared fixtures for the distributed tier: the LM recipe module,
+exec'd ONCE per session (it is a script, not a package module — the
+importlib dance with sys.modules registration is required for flax's
+dataclass transform)."""
+
+import importlib.util
+import os
+import sys
+
+import pytest
+
+_RECIPE = os.path.join(os.path.dirname(__file__), os.pardir, os.pardir,
+                       "examples", "lm", "main_amp.py")
+
+_LM_CACHE: list = []
+
+
+def load_lm_recipe():
+    """The examples/lm/main_amp.py module, loaded lazily and cached for
+    the whole session (module exec deferred past pytest collection)."""
+    if not _LM_CACHE:
+        spec = importlib.util.spec_from_file_location("lm_recipe", _RECIPE)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules["lm_recipe"] = mod
+        spec.loader.exec_module(mod)
+        _LM_CACHE.append(mod)
+    return _LM_CACHE[0]
+
+
+@pytest.fixture(scope="session")
+def lm():
+    return load_lm_recipe()

@@ -14,7 +14,6 @@ oracle. This is the reference's cross-rank master-param consistency check
 made configuration-invariant.
 """
 
-import importlib.util
 import os
 
 import jax
@@ -24,26 +23,6 @@ import pytest
 
 from apex_tpu import amp
 
-_RECIPE = os.path.join(os.path.dirname(__file__), os.pardir, os.pardir,
-                       "examples", "lm", "main_amp.py")
-
-
-_LM_CACHE: list = []
-
-
-def _lm():
-    """Lazy singleton — module exec deferred past pytest collection."""
-    if not _LM_CACHE:
-        spec = importlib.util.spec_from_file_location("lm_recipe", _RECIPE)
-        mod = importlib.util.module_from_spec(spec)
-        spec.loader.exec_module(mod)
-        _LM_CACHE.append(mod)
-    return _LM_CACHE[0]
-
-
-@pytest.fixture(scope="module")
-def lm():
-    return _lm()
 
 
 BASE = ["--size", "tiny", "--vocab-size", "128", "--seq-len", "16",
@@ -65,10 +44,12 @@ def _canon(lm, m):
     return lm.canonicalize_from_args(m["final_state"].params, m["args"])
 
 
-def _assert_trees_close(*args, **kwargs):
+def _assert_trees_close(lm, *args, **kwargs):
     """Leaf-for-leaf allclose with the failing leaf's key path — the
-    recipe's own helper, shared with the multichip dryrun."""
-    return _lm().assert_trees_close(*args, **kwargs)
+    recipe's own helper, shared with the multichip dryrun. Takes the
+    ``lm`` fixture module (importing conftest directly is unsupported
+    under --import-mode=importlib)."""
+    return lm.assert_trees_close(*args, **kwargs)
 
 
 _BASELINES: dict = {}
@@ -101,7 +82,7 @@ def test_one_command_trains_dp_tp_pp(lm, eight_devices):
     cast = jax.tree_util.tree_map(
         lambda mp, p: jnp.asarray(mp, p.dtype),
         state.master_params, state.params)
-    _assert_trees_close(state.params, cast, rtol=0, atol=0)
+    _assert_trees_close(lm, state.params, cast, rtol=0, atol=0)
 
 
 def test_parallel_trajectory_matches_single_rank_oracle(lm, eight_devices):
@@ -116,7 +97,7 @@ def test_parallel_trajectory_matches_single_rank_oracle(lm, eight_devices):
                       "--pipeline-parallel", "2"])
     np.testing.assert_allclose(float(m_par["loss"]), float(m_seq["loss"]),
                                rtol=2e-4)
-    _assert_trees_close(_canon(lm, m_par), _canon(lm, m_seq))
+    _assert_trees_close(lm, _canon(lm, m_par), _canon(lm, m_seq))
 
 
 def test_interleaved_vpp_trajectory_matches(lm, eight_devices):
@@ -127,7 +108,7 @@ def test_interleaved_vpp_trajectory_matches(lm, eight_devices):
                       "--virtual-pipeline", "2"])
     np.testing.assert_allclose(float(m_vpp["loss"]), float(m_seq["loss"]),
                                rtol=2e-4)
-    _assert_trees_close(_canon(lm, m_vpp), _canon(lm, m_seq))
+    _assert_trees_close(lm, _canon(lm, m_vpp), _canon(lm, m_seq))
 
 
 def test_sequence_parallel_trajectory_matches(lm, eight_devices):
@@ -140,12 +121,12 @@ def test_sequence_parallel_trajectory_matches(lm, eight_devices):
                         "2", "--sequence-parallel"])
     np.testing.assert_allclose(float(m_sp_pp["loss"]), float(m_seq["loss"]),
                                rtol=2e-4)
-    _assert_trees_close(_canon(lm, m_sp_pp), _canon(lm, m_seq))
+    _assert_trees_close(lm, _canon(lm, m_sp_pp), _canon(lm, m_seq))
     m_sp_tp = _run(lm, ["--tensor-parallel", "2", "--pipeline-parallel",
                         "1", "--sequence-parallel"])
     np.testing.assert_allclose(float(m_sp_tp["loss"]), float(m_seq["loss"]),
                                rtol=2e-4)
-    _assert_trees_close(_canon(lm, m_sp_tp), _canon(lm, m_seq))
+    _assert_trees_close(lm, _canon(lm, m_sp_tp), _canon(lm, m_seq))
 
 
 def test_vocab_parallel_head_trajectory_matches(lm, eight_devices):
@@ -157,12 +138,12 @@ def test_vocab_parallel_head_trajectory_matches(lm, eight_devices):
                         "2", "--vocab-parallel"])
     np.testing.assert_allclose(float(m_vp_pp["loss"]), float(m_seq["loss"]),
                                rtol=2e-4)
-    _assert_trees_close(_canon(lm, m_vp_pp), _canon(lm, m_seq))
+    _assert_trees_close(lm, _canon(lm, m_vp_pp), _canon(lm, m_seq))
     m_vp_tp = _run(lm, ["--tensor-parallel", "2", "--pipeline-parallel",
                         "1", "--vocab-parallel"])
     np.testing.assert_allclose(float(m_vp_tp["loss"]), float(m_seq["loss"]),
                                rtol=2e-4)
-    _assert_trees_close(_canon(lm, m_vp_tp), _canon(lm, m_seq))
+    _assert_trees_close(lm, _canon(lm, m_vp_tp), _canon(lm, m_seq))
 
 
 def test_full_combo_dp_tp_pp_vpp_trajectory(lm, eight_devices):
@@ -174,7 +155,7 @@ def test_full_combo_dp_tp_pp_vpp_trajectory(lm, eight_devices):
                       "--virtual-pipeline", "2"])
     np.testing.assert_allclose(float(m_all["loss"]), float(m_seq["loss"]),
                                rtol=2e-4)
-    _assert_trees_close(_canon(lm, m_all), _canon(lm, m_seq))
+    _assert_trees_close(lm, _canon(lm, m_all), _canon(lm, m_seq))
 
 
 def test_zero_sharded_optimizer_trajectory_matches(lm, eight_devices):
@@ -191,7 +172,7 @@ def test_zero_sharded_optimizer_trajectory_matches(lm, eight_devices):
     np.testing.assert_allclose(float(m_zero["loss"]), float(m_adam["loss"]),
                                rtol=2e-4)
     # same configuration on both sides: params trees compare directly
-    _assert_trees_close(m_zero["final_state"].params,
+    _assert_trees_close(lm, m_zero["final_state"].params,
                         m_adam["final_state"].params)
 
     # first moments: fused_adam's global m is the (pipe, model) stack of
@@ -232,7 +213,7 @@ def test_real_data_through_the_parallel_tier(lm, eight_devices):
     np.testing.assert_allclose(m_par["loss_history"], m_seq["loss_history"],
                                rtol=2e-4)
     assert m_par["loss_history"][-1] < m_par["loss_history"][0]
-    _assert_trees_close(_canon(lm, m_par), _canon(lm, m_seq))
+    _assert_trees_close(lm, _canon(lm, m_par), _canon(lm, m_seq))
 
 
 def test_save_resume_continues_trajectory_exactly(lm, eight_devices,
@@ -251,8 +232,8 @@ def test_save_resume_continues_trajectory_exactly(lm, eight_devices,
     np.testing.assert_array_equal(m_res["loss_history"],
                                   m_full["loss_history"][3:])
     full_s, res_s = m_full["final_state"], m_res["final_state"]
-    _assert_trees_close(res_s.params, full_s.params, rtol=0, atol=0)
-    _assert_trees_close(res_s.master_params, full_s.master_params,
+    _assert_trees_close(lm, res_s.params, full_s.params, rtol=0, atol=0)
+    _assert_trees_close(lm, res_s.master_params, full_s.master_params,
                         rtol=0, atol=0)
     np.testing.assert_array_equal(
         np.asarray(res_s.opt_state.m_shard),
