@@ -1,0 +1,94 @@
+"""Compiler-certified overlap evidence on the TPU toolchain (VERDICT
+round-4 missing #3).
+
+These tests AOT-compile the REAL library programs for an 8-chip v5e
+topology (compile-only devices; nothing executes, so they run fine on
+the single attached chip) and assert the latency-hiding claims straight
+off the scheduled HLO — the same move that made the memory contracts
+compiler-certified instead of docstring-asserted:
+
+- the 1F1B schedule's ppermute transport is split into
+  collective-permute-start/done pairs with stage COMPUTE scheduled
+  inside the in-flight window (apex's batch_isend_irecv overlap,
+  schedules.py's claim);
+- the DDP step's per-leaf grad psums are COMBINED into one all-reduce
+  over the whole tuple (apex allreduce_bucket, distributed.py's claim) —
+  plus the honest negative, pinned so it can't silently rot: this
+  toolchain does NOT async-split all-reduce in HLO.
+
+bench_schedule.py prints the same readings as JSON for BASELINE.md.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from apex_tpu.utils.schedule_report import (
+    all_reduce_bucketing, collective_async_pairs, ddp_step_program,
+    pipeline_1f1b_program, scheduled_text, zero_update_program)
+
+
+@pytest.fixture(scope="module")
+def pipeline_txt():
+    fn, avals = pipeline_1f1b_program()
+    return scheduled_text(fn, *avals)
+
+
+def test_1f1b_ppermute_is_async_with_compute_inside(pipeline_txt):
+    pairs = collective_async_pairs(pipeline_txt, "collective-permute")
+    # the scan body rotates activations forward and counter-rotates
+    # cotangents backward: two transports per tick, both must be split
+    assert len(pairs) >= 2, pairs
+    overlapped = [p for p in pairs if p["compute_between"] > 0]
+    assert len(overlapped) == len(pairs), \
+        f"ppermute NOT hidden under compute: {pairs}"
+    # and no synchronous (unsplit) permute remains
+    assert " collective-permute(" not in pipeline_txt
+
+
+def test_ddp_grad_psums_bucketed_into_one_allreduce():
+    fn, avals, n_leaves = ddp_step_program()
+    txt = scheduled_text(fn, *avals)
+    b = all_reduce_bucketing(txt)
+    # every grad leaf rides ONE combined all-reduce (the other ops are
+    # scalar reductions: loss pmean / found_inf)
+    assert max(b["tensors_per_op"]) == n_leaves, b
+    assert b["n_all_reduce_ops"] <= 2, b
+    # honest negative, pinned: this toolchain keeps all-reduce sync in
+    # HLO. If a toolchain bump starts splitting it, this assert flips and
+    # BASELINE.md's overlap table must be re-run (a good problem).
+    assert b["async_split"] == 0, \
+        "toolchain now async-splits all-reduce — update BASELINE.md"
+
+
+def test_zero_collectives_compile_at_schedule_level():
+    fn, avals = zero_update_program()
+    txt = scheduled_text(fn, *avals)
+    # the ZeRO skeleton lowers to real reduce-scatter/all-gather ops
+    # (sync on this toolchain — recorded, same caveat as the all-reduce)
+    assert txt.count("reduce-scatter(") >= 4
+    assert txt.count("all-gather(") >= 4
+
+
+def test_pair_parser_on_canned_schedule():
+    """The pair matcher itself: tuple-typed results, dotted var names,
+    compute counted only strictly inside the window, computation
+    boundaries closing unmatched starts."""
+    txt = "\n".join([
+        "HloModule m, is_scheduled=true",
+        "%body (p: f32[8]) -> f32[8] {",
+        "  %cps.1 = (f32[8], f32[8], u32[], u32[]) "
+        "collective-permute-start(%p), source_target_pairs={{0,1}}",
+        "  %fusion.9 = f32[8] fusion(%p), kind=kLoop, calls=%fc",
+        "  %tuple.0 = (f32[8]) tuple(%fusion.9)",
+        "  ROOT %done.1 = f32[8] collective-permute-done(%cps.1)",
+        "}",
+        "ENTRY %main () -> f32[8] {",
+        "  %cps.2 = (f32[8], f32[8], u32[], u32[]) "
+        "collective-permute-start(%x)",
+        "}",  # unmatched start dies at the boundary
+    ])
+    pairs = collective_async_pairs(txt, "collective-permute")
+    assert len(pairs) == 1
+    assert pairs[0]["compute_between"] == 1      # the fusion, not the tuple
+    assert pairs[0]["ops_between"] == 2
