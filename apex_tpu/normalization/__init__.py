@@ -51,6 +51,9 @@ class FusedLayerNorm(nn.Module):
     normalized_shape: Union[int, Sequence[int]]
     eps: float = 1e-5
     elementwise_affine: bool = True
+    # apex fused_layer_norm.py — memory_efficient: backward keeps the
+    # output (not the input); needs nonzero gamma
+    memory_efficient: bool = False
     dtype: Optional[Any] = None
     param_dtype: Any = jnp.float32
 
@@ -79,7 +82,8 @@ class FusedLayerNorm(nn.Module):
                               self.param_dtype).reshape(hidden)
         else:
             weight = bias = None
-        y = layer_norm(x2, weight, bias, eps=self.eps)
+        y = layer_norm(x2, weight, bias, eps=self.eps,
+                       memory_efficient=self.memory_efficient)
         return y.reshape(orig_shape)
 
 
@@ -89,6 +93,7 @@ class FusedRMSNorm(nn.Module):
     normalized_shape: Union[int, Sequence[int]]
     eps: float = 1e-5
     elementwise_affine: bool = True
+    memory_efficient: bool = False
     dtype: Optional[Any] = None
     param_dtype: Any = jnp.float32
 
@@ -110,7 +115,8 @@ class FusedRMSNorm(nn.Module):
                                 self.param_dtype).reshape(hidden)
         else:
             weight = None
-        y = rms_norm(x2, weight, eps=self.eps)
+        y = rms_norm(x2, weight, eps=self.eps,
+                     memory_efficient=self.memory_efficient)
         return y.reshape(orig_shape)
 
 

@@ -101,9 +101,7 @@ def resume_train_checkpoint(path: str, template: Any, rng, *,
     state, start, extra = load_checkpoint(path, template)
     if "rng" in (extra or {}):
         rng = jax.numpy.asarray(extra["rng"], jax.numpy.uint32)
-        impl = extra.get("rng_impl") or (
-            # pre-impl round-5 checkpoints recorded only a typed/raw bit
-            "threefry2x32" if extra.get("rng_typed") else None)
+        impl = extra.get("rng_impl")
         if impl:
             rng = jax.random.wrap_key_data(rng, impl=impl)
     print(f"=> resumed from {path} (step {start})")

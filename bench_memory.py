@@ -145,6 +145,21 @@ def bench_layer_norm():
         avals)
     emit(row, f"{n}x{hdim}")
 
+    # round 5: the answer to that negative — apex's memory_efficient
+    # flag. Priced fused-vs-fused on a mid-graph input (matmul producer):
+    # "fused" = memory_efficient (save y), "composed" = default (save x).
+    from apex_tpu.utils.memory_report import ln_memory_efficient_contract
+
+    me, default, avals_me, theory = ln_memory_efficient_contract(
+        n, 2048, n_layers=4)
+    row = price_contract("layer_norm_memory_efficient_vs_default",
+                         me, default, avals_me, theory_bytes=theory)
+    row["note"] = ("saved = default-peak - memory_efficient-peak over a "
+                   "4-layer pre-LN stack (x <- LN(x) @ W); theory = the "
+                   "3 droppable [N,H] bf16 input residuals (apex "
+                   "memory_efficient parity)")
+    emit(row, f"L4 {n}x2048 (pre-LN stack)")
+
 
 SUITES = {"xentropy": bench_xentropy, "flash": bench_flash,
           "fused_softmax": bench_fused_softmax, "remat": bench_remat,

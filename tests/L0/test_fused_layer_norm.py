@@ -118,3 +118,54 @@ def test_odd_row_counts_padded_correctly():
     out = layer_norm(x, interpret=True)
     ref = layer_norm_reference(x)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+@pytest.mark.parametrize("rms", [False, True])
+@pytest.mark.parametrize("affine", [True, False])
+def test_memory_efficient_grads_match_default(rms, affine):
+    """memory_efficient=True (save y, reconstruct xhat=(y-beta)/gamma —
+    apex's flag) must compute the SAME gradients as the default
+    save-x path, through the Pallas bwd (interpret) and jnp fallback."""
+    h = 256
+    x = _rand((6, h), jnp.float32)
+    w = (_rand((h,), jnp.float32, 1) * 0.3 + 1.0) if affine else None
+    b = (_rand((h,), jnp.float32, 2) * 0.2) if (affine and not rms) else None
+
+    def run(me, interpret):
+        if rms:
+            fn = lambda x, w: jnp.sum(  # noqa: E731
+                rms_norm(x, w, interpret=interpret,
+                         memory_efficient=me) ** 2)
+            args = (x, w) if affine else (x, None)
+        else:
+            fn = lambda x, w, b: jnp.sum(  # noqa: E731
+                layer_norm(x, w, b, interpret=interpret,
+                           memory_efficient=me) ** 2)
+            args = (x, w, b) if affine else (x, None, None)
+        nargs = 1 if not affine else (2 if rms else 3)
+        return jax.grad(fn, argnums=tuple(range(nargs)))(*args)
+
+    for interpret in (True, False):   # Pallas path and jnp fallback
+        g_me = run(True, interpret)
+        g_df = run(False, interpret)
+        for gm, gd in zip(g_me, g_df):
+            np.testing.assert_allclose(np.asarray(gm), np.asarray(gd),
+                                       atol=2e-4, rtol=2e-4)
+
+
+def test_memory_efficient_module_flag():
+    """The modules expose apex's memory_efficient flag and train the
+    same direction as the default."""
+    from apex_tpu.normalization import FusedLayerNorm
+
+    x = _rand((4, 128), jnp.float32)
+    m = FusedLayerNorm(128, memory_efficient=True)
+    params = m.init(jax.random.PRNGKey(0), x)
+    y, ref = m.apply(params, x), FusedLayerNorm(128).apply(params, x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), atol=1e-6)
+    g = jax.grad(lambda p: jnp.sum(m.apply(p, x) ** 2))(params)
+    gr = jax.grad(lambda p: jnp.sum(
+        FusedLayerNorm(128).apply(p, x) ** 2))(params)
+    for a, b in zip(jax.tree_util.tree_leaves(g),
+                    jax.tree_util.tree_leaves(gr)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)

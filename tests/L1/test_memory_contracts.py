@@ -117,3 +117,42 @@ def test_flash_residual_structure_is_independent_of_masking_flags():
             q, q, q)
         for l in _residual_leaves(res):
             assert l.size < s * s, (causal, l.shape)
+
+
+def test_layer_norm_memory_efficient_residuals_swap_x_for_y():
+    """The structural half of the round-5 LN contract: default saves the
+    INPUT (x, gamma, mean, rstd); memory_efficient saves the OUTPUT
+    (y, gamma, beta, rstd) and NOT x — the output aliases the value the
+    downstream op keeps anyway, so the input can die (apex
+    fused_layer_norm.py memory_efficient semantics)."""
+    import importlib
+
+    # the kernels package re-exports the layer_norm FUNCTION, which
+    # shadows the submodule on attribute-style import
+    lnk = importlib.import_module("apex_tpu.kernels.layer_norm")
+
+    n, h = 64, 256
+    args = (S((n, h), jnp.bfloat16), S((h,), jnp.float32),
+            S((h,), jnp.float32))
+
+    def residuals(me):
+        return jax.eval_shape(
+            lambda x, g, b: lnk._layer_norm_fwd(
+                x, g, b, 1e-5, False, True, me)[1], *args)
+
+    df, me = residuals(False), residuals(True)
+    # default: two [n, 1] stat vectors (mean, rstd) + x + gamma
+    assert sum(1 for l in _residual_leaves(df) if l.shape == (n, 1)) == 2
+    # me: ONE stat vector (rstd only — mean is not needed), y + g + b;
+    # identical [n, h] footprint otherwise (y swapped for x)
+    me_leaves = _residual_leaves(me)
+    assert sum(1 for l in me_leaves if l.shape == (n, 1)) == 1
+    # each variant keeps exactly ONE [n, h] tensor — default the input,
+    # me the output. The byte win is NOT in the leaf sum (y is x-sized;
+    # me additionally carries beta): it is that y ALIASES the value the
+    # downstream op saves anyway, so the input x can die — the compiled
+    # half (tests/tpu/test_memory_contracts_on_silicon.py + bench_memory
+    # layer_norm) prices that sharing at the stack level.
+    for tree in (df, me):
+        assert sum(1 for l in _residual_leaves(tree)
+                   if l.shape == (n, h)) == 1
