@@ -18,6 +18,21 @@ def on_tpu() -> bool:
     return jax.default_backend() == "tpu"
 
 
+def mosaic_dtype_ok(*xs) -> bool:
+    """TPU Mosaic has no fp16 (the MXU/VPU are bf16/fp32 machines): a
+    float16 operand must take the jnp fallback, where XLA upconverts —
+    found by the on-silicon scaler soak, whose fp16 model crashed every
+    fused kernel's real lowering. interpret mode is unaffected (callers
+    keep `or interpret`). Accepts arrays OR bare dtypes; None skipped."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    def dt(x):
+        return np.dtype(getattr(x, "dtype", x))
+
+    return all(dt(x) != jnp.float16 for x in xs if x is not None)
+
+
 from .layer_norm import (  # noqa: E402,F401
     layer_norm, rms_norm, layer_norm_reference, rms_norm_reference)
 from .multi_tensor import (  # noqa: E402,F401

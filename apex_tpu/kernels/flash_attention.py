@@ -31,6 +31,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from apex_tpu.kernels import vmem
+from apex_tpu.kernels import mosaic_dtype_ok
 
 __all__ = ["flash_attention", "mha_reference", "attn_chunk_fwd",
            "attn_chunk_bwd"]
@@ -765,7 +766,8 @@ def attn_chunk_fwd(q3, k3, v3, *, scale, causal,
     if jax.default_backend() == "cpu":
         interpret = True
     if not _pallas_ok(sq, sk, d, bq, bk) or (interpret and _has_vma(q3)) \
-            or (dropout_rate > 0.0 and interpret):
+            or (dropout_rate > 0.0 and interpret) \
+            or (not interpret and not mosaic_dtype_ok(q3, k3, v3)):
         return _ref_chunk_fwd(q3, k3, v3, scale, causal, dropout_rate,
                               dropout_seed)
     o3, lse = _fwd_pallas(q3, k3, v3, None, None, scale, causal, bq, bk,
@@ -787,7 +789,8 @@ def attn_chunk_bwd(q3, k3, v3, do3, lse, delta, *, scale, causal,
     if jax.default_backend() == "cpu":
         interpret = True
     if not _pallas_ok(sq, sk, d, bq, bk) or (interpret and _has_vma(q3)) \
-            or (dropout_rate > 0.0 and interpret):
+            or (dropout_rate > 0.0 and interpret) \
+            or (not interpret and not mosaic_dtype_ok(q3, k3, v3, do3)):
         return _ref_chunk_bwd(q3, k3, v3, do3, lse, delta, scale, causal,
                               dropout_rate, dropout_seed)
     # _bwd_pallas recomputes p from lse and reads delta directly; o3 itself
@@ -909,7 +912,8 @@ def flash_attention(q, k, v, *, causal: bool = False,
     if jax.default_backend() == "cpu":
         interpret = True  # pallas-TPU lowering needs a TPU; CPU interprets
     if not _pallas_ok(sq, sk, d, bq, bk) or (interpret and _has_vma(q)) \
-            or (dropout_rate > 0.0 and interpret):
+            or (dropout_rate > 0.0 and interpret) \
+            or (not interpret and not mosaic_dtype_ok(q, k, v, bias)):
         # interpret mode has no pltpu PRNG lowering → jnp dropout fallback
         return mha_reference(q, k, v, causal=causal, scale=scale,
                              segment_ids=segment_ids, bias=bias,

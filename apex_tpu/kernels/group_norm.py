@@ -277,10 +277,10 @@ def _gn_bwd(groups, eps, act, interpret, res, dy):
 _group_norm.defvjp(_gn_fwd, _gn_bwd)
 
 
-def _pallas_ok(c):
-    from . import on_tpu
+def _pallas_ok(c, dtype=None):
+    from . import mosaic_dtype_ok, on_tpu
 
-    return on_tpu() and c % 128 == 0
+    return on_tpu() and c % 128 == 0 and mosaic_dtype_ok(dtype)
 
 
 def group_norm_nhwc(x, num_groups: int, weight=None, bias=None,
@@ -301,7 +301,7 @@ def group_norm_nhwc(x, num_groups: int, weight=None, bias=None,
             f"channels {c} not divisible by groups {num_groups}")
     act = act if act == "silu" else None
     usable = weight is not None and bias is not None and \
-        (_pallas_ok(c) or interpret)
+        (_pallas_ok(c, x.dtype) or interpret)
     if not usable:
         return group_norm_reference(x, num_groups, weight, bias, eps, act)
     shape = x.shape

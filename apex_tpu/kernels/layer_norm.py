@@ -45,10 +45,10 @@ def _block_rows(n_rows: int, hidden: int, n_bufs: int) -> int:
                            key="layer_norm.block_rows")
 
 
-def _pallas_ok(n: int, h: int) -> bool:
-    from . import on_tpu
+def _pallas_ok(n: int, h: int, dtype=None) -> bool:
+    from . import mosaic_dtype_ok, on_tpu
 
-    return on_tpu() and h % 128 == 0
+    return on_tpu() and h % 128 == 0 and mosaic_dtype_ok(dtype)
 
 
 # ----------------------------------------------------------------- references
@@ -166,7 +166,9 @@ def _ln_fwd_pallas(x2, gamma, beta, eps, rms, interpret):
     rows_p = ((n + bm - 1) // bm) * bm
     xp = _pad_rows(x2, rows_p)
     g2 = (gamma if affine else jnp.zeros((h,), x2.dtype)).reshape(1, h)
-    b2 = (beta if (affine and not rms) else jnp.zeros((h,), x2.dtype)).reshape(1, h)
+    # beta may be None even with a weight (weight-only affine)
+    b2 = (beta if (affine and not rms and beta is not None)
+          else jnp.zeros((h,), x2.dtype)).reshape(1, h)
     grid = (rows_p // bm,)
     kernel = functools.partial(_ln_fwd_kernel, eps=eps, affine=affine, rms=rms)
     y, mean, rstd = pl.pallas_call(
@@ -250,7 +252,7 @@ def _layer_norm(x2, gamma, beta, eps, rms, interpret, mem_eff=False):
 
 def _ln_fwd(x2, gamma, beta, eps, rms, interpret):
     n, h = x2.shape
-    if _pallas_ok(n, h) or interpret:
+    if _pallas_ok(n, h, x2.dtype) or interpret:
         return _ln_fwd_pallas(x2, gamma, beta, eps, rms, interpret)
     # jnp fallback still saves (mean, rstd) so bwd matches
     x32 = x2.astype(jnp.float32)
@@ -294,7 +296,7 @@ def _layer_norm_bwd(eps, rms, interpret, mem_eff, res, dy):
         src2, gamma, aux, rstd = res       # src = the saved input x, aux = mean
     n, h = src2.shape
     affine = gamma is not None
-    if _pallas_ok(n, h) or interpret:
+    if _pallas_ok(n, h, src2.dtype) or interpret:
         dx, dg, db = _ln_bwd_pallas(dy, src2, gamma, aux, rstd, rms,
                                     interpret, mem_eff=mem_eff)
     else:
@@ -303,8 +305,11 @@ def _layer_norm_bwd(eps, rms, interpret, mem_eff, res, dy):
         if mem_eff:
             if affine:
                 g32 = gamma.astype(jnp.float32)
-                xhat = (src32 / g32 if rms
-                        else (src32 - beta.astype(jnp.float32)) / g32)
+                # bias may be None with a weight (public API allows it;
+                # the Pallas branch zero-fills the same way)
+                b32 = (beta.astype(jnp.float32)
+                       if (beta is not None and not rms) else 0.0)
+                xhat = (src32 - b32) / g32
             else:
                 xhat = src32
         else:

@@ -53,10 +53,10 @@ def _grid_rows(rows):
     return bm, rows_p, rows_p // bm
 
 
-def _use_pallas(interpret):
-    from . import on_tpu
+def _use_pallas(interpret, *xs):
+    from . import mosaic_dtype_ok, on_tpu
 
-    return on_tpu() or interpret
+    return interpret or (on_tpu() and mosaic_dtype_ok(*xs))
 
 
 def _row_spec(bm):
@@ -85,7 +85,7 @@ def _scale_kernel(scale_ref, x_ref, out_ref, flag_ref):
 def fused_scale(flat, scale, interpret: bool = False):
     """out = flat * scale, plus found_inf — amp_C.multi_tensor_scale."""
     scale = jnp.asarray(scale, jnp.float32).reshape(1, 1)
-    if not _use_pallas(interpret):
+    if not _use_pallas(interpret, flat):
         x32 = flat.astype(jnp.float32)
         out = (x32 * scale[0, 0]).astype(flat.dtype)
         return out, jnp.logical_not(jnp.all(jnp.isfinite(x32)))
@@ -127,7 +127,7 @@ def fused_axpby(flat_x, flat_y, a, b, interpret: bool = False):
     (grad accumulation fused with unscale)."""
     ab = jnp.stack([jnp.asarray(a, jnp.float32),
                     jnp.asarray(b, jnp.float32)]).reshape(1, 2)
-    if not _use_pallas(interpret):
+    if not _use_pallas(interpret, flat_x, flat_y):
         x32, y32 = flat_x.astype(jnp.float32), flat_y.astype(jnp.float32)
         out = (ab[0, 0] * x32 + ab[0, 1] * y32).astype(flat_x.dtype)
         found = jnp.logical_not(jnp.logical_and(
@@ -167,7 +167,7 @@ def _l2norm_kernel(x_ref, acc_ref):
 def fused_l2norm(flat, interpret: bool = False):
     """||flat||_2 in fp32 — amp_C.multi_tensor_l2norm (used by FusedLAMB's
     global-norm stage and contrib clip_grad)."""
-    if not _use_pallas(interpret):
+    if not _use_pallas(interpret, flat):
         x32 = flat.astype(jnp.float32)
         return jnp.sqrt(jnp.sum(x32 * x32))
     x2, _ = _as_rows(flat)
@@ -234,7 +234,7 @@ def fused_adam_step(flat_p, flat_m, flat_v, flat_g, *, lr, beta1, beta2, eps,
         bc1, bc2,
         jnp.asarray(inv_scale, jnp.float32),
     ]).reshape(1, 8)
-    if not _use_pallas(interpret):
+    if not _use_pallas(interpret, flat_p, flat_g):
         lr_, b1_, b2_, eps_, wd_, bc1, bc2, inv = [scalars[0, i]
                                                    for i in range(8)]
         p = flat_p.astype(jnp.float32)
@@ -313,7 +313,7 @@ def fused_sgd_step(flat_p, flat_buf, flat_g, *, lr, momentum=0.0,
     ]).reshape(1, 4)
     momentum_on = float(momentum) != 0.0 if not hasattr(momentum, "dtype") \
         else True
-    if not _use_pallas(interpret):
+    if not _use_pallas(interpret, flat_p, flat_g):
         lr_, mom, damp, wd_ = [scalars[0, i] for i in range(4)]
         p = flat_p.astype(jnp.float32)
         g = flat_g.astype(jnp.float32)

@@ -162,4 +162,8 @@ def softmax_cross_entropy_loss(logits, labels, smoothing: float = 0.0,
         return xent_reference(logits, labels, smoothing)
     if jax.default_backend() == "cpu":
         interpret = True
+    from . import mosaic_dtype_ok
+
+    if not interpret and not mosaic_dtype_ok(lg2):
+        return xent_reference(logits, labels, smoothing)
     return _xent(lg2, lb, smoothing, interpret).reshape(shape)

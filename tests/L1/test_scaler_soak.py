@@ -1,0 +1,155 @@
+"""Scaler-DYNAMICS soak (VERDICT round-4 weak #7): every other parity
+test runs ≤ a few dozen steps and never sees the scaler move. This one
+trains a real fp16 LM step for hundreds of steps with a SMALL
+scale_window so the full life cycle happens many times —
+growth-at-window, natural overflow at the fp16 boundary,
+hysteresis-buffered backoff, regrowth — and checks the whole loss-scale
+trajectory STEP-FOR-STEP against an independent reference automaton of
+the SURVEY §4.2 schedule (apex scaler.py update_scale + Megatron
+DynamicGradScaler hysteresis), fed only the observed found_inf bits.
+A mid-dynamics checkpoint/resume must continue the cycle bitwise.
+
+The driver lives here so tests/tpu/test_scaler_soak_on_silicon.py can
+run the same soak through the real Mosaic lowerings.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu import amp
+from apex_tpu.amp.scaler import init_scaler
+from apex_tpu.kernels.xentropy import softmax_cross_entropy_loss
+from apex_tpu.models.transformer_lm import create_lm
+from apex_tpu.optimizers import fused_adam
+
+TINY = float(np.finfo(np.float32).tiny)
+
+
+def reference_scaler_trace(found_infs, *, window, hysteresis,
+                           factor=2.0, init=2.0 ** 16,
+                           max_scale=2.0 ** 24):
+    """Pure-python re-derivation of the schedule from first principles
+    (apex amp scaler.py + hysteresis): NOT a call into the library —
+    the soak would otherwise test update_scale against itself."""
+    scale, unskipped, hyst = init, 0, hysteresis
+    out = []
+    for fi in found_infs:
+        if fi:
+            hyst = max(hyst - 1, 0)
+            if hyst <= 0:
+                scale = max(scale / factor, TINY)
+            unskipped = 0
+        else:
+            unskipped += 1
+        if unskipped >= window:
+            scale = min(scale * factor, max_scale)
+            unskipped = 0
+            hyst = hysteresis
+        out.append((scale, unskipped, hyst))
+    return out
+
+
+def build_step(window, hysteresis, lr=3e-3):
+    policy = amp.resolve_policy(opt_level="O2", half_dtype=jnp.float16,
+                                loss_scale="dynamic", verbose=False)
+    model = create_lm("tiny", vocab_size=64, max_seq_len=16,
+                      dtype=policy.model_dtype)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((2, 16), jnp.int32), train=False)["params"]
+
+    def loss_fn(p, batch):
+        logits = model.apply({"params": p}, batch[:, :-1], train=True)
+        return softmax_cross_entropy_loss(
+            jnp.asarray(logits, jnp.float32), batch[:, 1:]).mean()
+
+    init_fn, step_fn = amp.make_train_step(loss_fn, fused_adam(lr),
+                                           policy)
+    state = init_fn(params)
+    state = state.replace(scaler=init_scaler(
+        "dynamic", scale_window=window, hysteresis=hysteresis))
+    return state, jax.jit(step_fn)
+
+
+def batch_at(it):
+    return jax.random.randint(jax.random.PRNGKey(1000 + it), (8, 17),
+                              0, 64)
+
+
+def run_soak(n_steps, window, hysteresis, ckpt_at=None, tmp_path=None):
+    """Run the soak; returns (trace rows, final state, resumed state or
+    None). ``ckpt_at`` saves mid-dynamics and separately resumes to the
+    end for the bitwise comparison."""
+    from apex_tpu.utils.checkpoint import (resume_train_checkpoint,
+                                           save_train_checkpoint)
+
+    state, step = build_step(window, hysteresis)
+    trace = []
+    ckpt, resumed = None, None
+    for it in range(n_steps):
+        if ckpt_at is not None and it == ckpt_at:
+            ckpt = os.path.join(str(tmp_path), "soak.npz")
+            save_train_checkpoint(ckpt, state, it, jax.random.PRNGKey(0))
+        state, metrics = step(state, batch_at(it))
+        trace.append((bool(metrics["found_inf"]),
+                      float(state.scaler.loss_scale),
+                      int(state.scaler.unskipped),
+                      int(state.scaler.hysteresis_left)))
+    if ckpt is not None:
+        re_state, start, _ = resume_train_checkpoint(
+            ckpt, state, jax.random.PRNGKey(0), step_limit=n_steps,
+            limit_flag="--iters")
+        for it in range(start, n_steps):
+            re_state, _ = step(re_state, batch_at(it))
+        resumed = re_state
+    return trace, state, resumed
+
+
+def assert_soak_dynamics(trace, window, hysteresis, min_overflows,
+                         min_growths):
+    found = [t[0] for t in trace]
+    ref = reference_scaler_trace(found, window=window,
+                                 hysteresis=hysteresis)
+    for i, ((fi, scale, unsk, hy), (r_scale, r_unsk, r_hy)) in enumerate(
+            zip(trace, ref)):
+        assert (scale, unsk, hy) == (r_scale, r_unsk, r_hy), (
+            f"step {i}: scaler {(scale, unsk, hy)} != "
+            f"reference {(r_scale, r_unsk, r_hy)} (found_inf={fi}; "
+            f"window={window} hysteresis={hysteresis})")
+    n_overflow = sum(found)
+    scales = [t[1] for t in trace]
+    n_growth = sum(1 for a, b in zip(scales, scales[1:]) if b > a)
+    assert n_overflow >= min_overflows, \
+        f"soak too tame: only {n_overflow} overflows — no dynamics tested"
+    assert n_growth >= min_growths, \
+        f"scale only grew {n_growth} times over {len(trace)} steps"
+
+
+def test_scaler_full_cycle_over_300_steps(tmp_path):
+    """300 fp16 steps, window 8, hysteresis 2: the scale must climb
+    from 2^16, hit the fp16 overflow boundary, back off through the
+    hysteresis budget, and regrow — with every transition matching the
+    reference automaton exactly; params/masters/opt state and the
+    remaining trajectory must survive a step-150 checkpoint bitwise."""
+    window, hysteresis, n = 8, 2, 300
+    trace, state, resumed = run_soak(n, window, hysteresis,
+                                     ckpt_at=150, tmp_path=tmp_path)
+    assert_soak_dynamics(trace, window, hysteresis,
+                         min_overflows=3, min_growths=10)
+    # overflow steps froze the model: loss stayed finite throughout
+    assert all(np.isfinite(t[1]) for t in trace)
+    # mid-dynamics resume: bitwise identical end state, scaler included
+    for a, b in zip(jax.tree_util.tree_leaves(state),
+                    jax.tree_util.tree_leaves(resumed)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_scaler_hysteresis_one_is_classic_apex(tmp_path):
+    """hysteresis=1 (apex amp's classic immediate backoff) follows the
+    same automaton with the tolerance degenerate."""
+    window, n = 6, 150
+    trace, _, _ = run_soak(n, window, hysteresis=1)
+    assert_soak_dynamics(trace, window, 1, min_overflows=2,
+                         min_growths=8)

@@ -263,3 +263,39 @@ def test_group_norm_fwd_bwd(tpu_backend, act):
         argnums=(0, 1, 2))(x, g, b)
     for a, r in zip(gk, gr):
         _close(a, r, 1e-3, atol=1e-3)
+
+
+def test_fp16_inputs_take_the_xla_fallback(tpu_backend):
+    """TPU Mosaic has no fp16: every public fused op must detect float16
+    operands and route to its jnp fallback (where XLA upconverts) instead
+    of crashing the remote compile — found by the on-silicon scaler soak.
+    bf16 stays on the Pallas path."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex_tpu.kernels import fused_scale, layer_norm, rms_norm
+    from apex_tpu.kernels.xentropy import softmax_cross_entropy_loss
+    from apex_tpu.kernels.flash_attention import flash_attention
+    from apex_tpu.kernels.group_norm import group_norm_nhwc
+
+    x16 = jnp.ones((8, 256), jnp.float16)
+    g = jnp.ones((256,), jnp.float32)
+    b = jnp.zeros((256,), jnp.float32)
+    assert layer_norm(x16, g, b).dtype == jnp.float16
+    assert rms_norm(x16, g).dtype == jnp.float16
+    out, found = fused_scale(jnp.ones((300,), jnp.float16), 2.0)
+    assert not bool(found) and float(out[0]) == 2.0
+    lg = jnp.ones((8, 128), jnp.float16)
+    assert np.isfinite(float(softmax_cross_entropy_loss(
+        lg, jnp.zeros((8,), jnp.int32)).mean()))
+    q = jnp.ones((1, 2, 128, 64), jnp.float16)
+    assert jnp.all(jnp.isfinite(jnp.asarray(
+        flash_attention(q, q, q, causal=True), jnp.float32)))
+    xg = jnp.ones((2, 4, 4, 128), jnp.float16)
+    y = group_norm_nhwc(xg, 4, jnp.ones((128,)), jnp.zeros((128,)))
+    assert jnp.all(jnp.isfinite(jnp.asarray(y, jnp.float32)))
+    # grads flow through the fallbacks too
+    dx = jax.grad(lambda x: jnp.sum(jnp.asarray(
+        layer_norm(x, g, b), jnp.float32)))(x16)
+    assert dx.dtype == jnp.float16
