@@ -111,3 +111,25 @@ def test_layer_norm_memory_efficient_drops_input_residuals(tpu_backend):
     row = price_contract("ln_memory_efficient", me, default, avals,
                          theory_bytes=theory)
     assert row["saved_peak_bytes"] >= 0.5 * theory, row
+
+
+def test_north_star_configs_price_and_fit_the_chip(tpu_backend):
+    """Driver configs 2 and 4 at production shape (round 5): the COMPLETE
+    ResNet-50 O2 DDP step (b256/chip over an 8-chip AOT topology) and the
+    BERT-large seq-512 LAMB step must compile, carry their full O2 state
+    (floor sanity: BERT-large LAMB state alone is >4 GB), and peak within
+    the 16 GB v5e chip. Smaller shapes than bench_memory's headline rows
+    to keep the gate fast; `python bench_memory.py configs` prints the
+    production numbers for BASELINE.md."""
+    from apex_tpu.utils.memory_report import (bert_large_lamb_step,
+                                              resnet50_o2_ddp_step)
+
+    fn, avals, floor = resnet50_o2_ddp_step(batch_per_chip=64)
+    m = compiled_memory(fn, *avals)
+    assert m.peak_bytes > floor > 200 * 2**20, (m.peak_bytes, floor)
+    assert m.peak_bytes < 16 * 2**30
+
+    fn, avals, floor = bert_large_lamb_step(batch=2)
+    m = compiled_memory(fn, *avals)
+    assert m.peak_bytes > floor > 4 * 2**30, (m.peak_bytes, floor)
+    assert m.peak_bytes < 16 * 2**30
