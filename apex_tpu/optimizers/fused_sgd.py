@@ -28,6 +28,10 @@ def fused_sgd(learning_rate: ScalarOrSchedule, momentum: float = 0.0,
               dampening: float = 0.0, weight_decay: float = 0.0,
               nesterov: bool = False,
               wd_after_momentum: bool = False) -> optax.GradientTransformation:
+    """Optax-compatible fused SGD (apex/optimizers/fused_sgd.py —
+    FusedSGD defaults: torch-style momentum buffer, optional Nesterov,
+    ``wd_after_momentum`` ordering flag). The update runs through the
+    multi_tensor superbuffer kernel on TPU."""
     if nesterov and (momentum <= 0 or dampening != 0):
         raise ValueError("Nesterov momentum requires a momentum and zero "
                          "dampening")  # torch/apex validation

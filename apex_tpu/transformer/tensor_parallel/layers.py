@@ -111,6 +111,10 @@ class ColumnParallelLinear(nn.Module):
         return y
 
     def kernel_partition_spec(self) -> PartitionSpec:
+        """The GSPMD half of SURVEY §3.3's TP mapping: under plain
+        jit, annotate the FULL kernel with this spec (columns sharded
+        over the TP axis) and XLA inserts the collectives mappings.py
+        spells out. Consumed by examples/lm --partitioning gspmd."""
         return PartitionSpec(None, self.axis_name)
 
 
@@ -165,6 +169,10 @@ class RowParallelLinear(nn.Module):
         return y
 
     def kernel_partition_spec(self) -> PartitionSpec:
+        """GSPMD spec: rows (the contraction dim) sharded over the TP
+        axis — XLA turns the partial products into the all-reduce the
+        explicit path does via reduce_from_tensor_model_parallel_region.
+        """
         return PartitionSpec(self.axis_name, None)
 
 
@@ -211,6 +219,8 @@ class VocabParallelEmbedding(nn.Module):
         return reduce_from_tensor_model_parallel_region(out, self.axis_name)
 
     def kernel_partition_spec(self) -> PartitionSpec:
+        """GSPMD spec: vocab rows sharded over the TP axis; XLA handles
+        the out-of-shard lookups the explicit path masks by hand."""
         return PartitionSpec(self.axis_name, None)
 
 

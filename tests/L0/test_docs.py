@@ -1,0 +1,59 @@
+"""The per-symbol API reference (docs/api/, VERDICT round-4 weak #6)
+must exist, cover the public surface, and be IN SYNC with the
+docstrings — the checked-in pages are regenerated here and diffed, so a
+docstring change without `python docs/gen_api.py` fails CI instead of
+shipping stale docs."""
+
+import importlib.util
+import os
+
+import pytest
+
+_DOCS = os.path.join(os.path.dirname(__file__), os.pardir, os.pardir,
+                     "docs")
+
+
+def _gen():
+    spec = importlib.util.spec_from_file_location(
+        "gen_api", os.path.join(_DOCS, "gen_api.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_api_reference_in_sync_with_docstrings(tmp_path):
+    gen = _gen()
+    gen.main(str(tmp_path))
+    for page in list(gen.PAGES) + ["index"]:
+        fresh = (tmp_path / f"{page}.md").read_text()
+        checked_in = os.path.join(_DOCS, "api", f"{page}.md")
+        assert os.path.exists(checked_in), \
+            f"docs/api/{page}.md missing — run python docs/gen_api.py"
+        with open(checked_in) as f:
+            if f.read() != fresh:
+                pytest.fail(f"docs/api/{page}.md is stale — regenerate "
+                            "with: JAX_PLATFORMS=cpu python docs/gen_api.py")
+
+
+def test_api_reference_covers_the_public_surface():
+    gen = _gen()
+    # every section of SURVEY's layer map has a page, and the flagship
+    # symbols appear with their signatures
+    probes = {
+        "amp": ["make_train_step", "resolve_policy", "class `LossScaler`"],
+        "optimizers": ["fused_adam", "fused_lamb"],
+        "transformer": ["ColumnParallelLinear", "forward_backward_1f1b",
+                        "kernel_partition_spec"],
+        "kernels": ["flash_attention", "memory_efficient",
+                    "softmax_cross_entropy_loss"],
+        "contrib": ["distributed_fused_adam", "SelfMultiheadAttn"],
+        "parallel": ["initialize_distributed", "make_hybrid_mesh",
+                     "SyncBatchNorm"],
+        "utils": ["save_checkpoint", "AsyncCheckpointer"],
+    }
+    for page, names in probes.items():
+        path = os.path.join(_DOCS, "api", f"{page}.md")
+        with open(path) as f:
+            text = f.read()
+        for n in names:
+            assert n in text, f"{n} missing from docs/api/{page}.md"
