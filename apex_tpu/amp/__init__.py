@@ -425,8 +425,12 @@ def make_train_step(loss_fn: Callable, optimizer, policy: Policy,
             # examples/imagenet/main_amp.py — reduce_tensor)
             loss = jax.lax.pmean(loss, grad_average_axis)
             # apex DDP's flat-bucket allreduce-mean, as one psum over the
-            # named axis; XLA's latency-hiding scheduler overlaps it with the
-            # remaining backward the way apex overlaps NCCL with autograd.
+            # named axis. Compiler-certified (bench_schedule.py, BASELINE
+            # overlap table): XLA's combiner buckets every per-leaf psum
+            # into ONE all-reduce — apex's flatten/allreduce_bucket — and
+            # schedules it after the last grad producer; on this
+            # toolchain the op itself stays sync in HLO (honest negative,
+            # pinned by tests/tpu/test_schedule_overlap.py).
             world = jax.lax.psum(1, grad_average_axis)
             pre = gradient_predivide_factor
 

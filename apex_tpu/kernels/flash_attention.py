@@ -11,7 +11,8 @@ Design (SURVEY §6 long-context note): the kernel is blockwise over KV with
 an online-softmax running (m, l) state, so a later ring-attention/context-
 parallel extension only has to rotate KV blocks between chips (ppermute)
 around the same inner kernel. Numerics follow the reference kernels: bf16/
-fp16 I/O allowed, all accumulation in fp32, logsumexp saved for backward.
+half I/O in bf16 (fp16 operands take the jnp fallback on hardware —
+Mosaic has no fp16), all accumulation in fp32, logsumexp saved for backward.
 
 Layout: [batch, heads, seq, head_dim] (q, k, v). ``segment_ids`` gives the
 varlen/packed-sequence masking of fmhalib (tokens attend only within their

@@ -10,9 +10,14 @@ with the rest of backward; options: gradient averaging (÷world),
 
 Why the TPU version is this small: every mechanism above exists to overlap
 communication with eager-mode autograd. Under jit, gradients are values in one
-traced program — a single ``psum`` per pytree is bucketed, scheduled, and
-overlapped by XLA's latency-hiding scheduler automatically. What survives is
-the *semantics*: mean-averaging, predivide factor, any-rank-overflow ⇒
+traced program — a single ``psum`` per pytree is bucketed and scheduled by
+the compiler. That claim is certified, not assumed (bench_schedule.py +
+tests/tpu/test_schedule_overlap.py read the scheduled HLO): XLA's combiner
+merges every per-leaf psum into ONE all-reduce over the whole tuple — the
+flat bucket apex builds by hand — placed after the last grad producer; on
+the current toolchain the all-reduce op itself is synchronous in HLO (the
+honest reading in BASELINE.md's overlap table). What survives here is the
+*semantics*: mean-averaging, predivide factor, any-rank-overflow ⇒
 all-rank skip (handled in amp.make_train_step), and replicated init.
 """
 

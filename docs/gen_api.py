@@ -114,13 +114,25 @@ def _emit_symbol(f, name, obj, level="###"):
                 f.write("Fields: " + ", ".join(f"`{n}`" for n in shown)
                         + "\n\n")
         for mname, m in sorted(vars(obj).items()):
-            if mname.startswith("_") or not callable(m):
+            if mname.startswith("_"):
                 continue
             if fields and mname in fields:
                 continue   # callable dataclass-field DEFAULTS, not methods
-            if inspect.getdoc(m) and inspect.getdoc(m) != inspect.getdoc(
-                    getattr(object, mname, None)):
-                f.write(f"- **`.{mname}{_sig(m)}`** — "
+            # unwrap descriptors so properties and class/staticmethods
+            # document like plain methods (classmethod objects are not
+            # callable; property docs live on fget)
+            tag = ""
+            if isinstance(m, property):
+                m, tag = m.fget, " [property]"
+            elif isinstance(m, classmethod):
+                m, tag = m.__func__, " [classmethod]"
+            elif isinstance(m, staticmethod):
+                m, tag = m.__func__, " [staticmethod]"
+            if m is None or not callable(m):
+                continue
+            if inspect.getdoc(m):
+                sig = "" if tag == " [property]" else _sig(m)
+                f.write(f"- **`.{mname}{sig}`**{tag} — "
                         + _doc(m).splitlines()[0] + "\n")
         f.write("\n")
     elif callable(obj):
@@ -142,7 +154,15 @@ def gen_page(page, modules, out=None):
             moddoc = inspect.getdoc(mod)
             if moddoc:
                 f.write(moddoc.strip() + "\n\n")
+            explicit = hasattr(mod, "__all__")
             for name in _public_names(mod):
+                if explicit and not hasattr(mod, name):
+                    # __all__ is an explicit contract: a stale/typo'd
+                    # entry must fail the build, not silently ship
+                    # docs with the symbol missing
+                    raise SystemExit(
+                        f"{modname}.__all__ lists {name!r} but the "
+                        "module has no such attribute")
                 obj = getattr(mod, name, None)
                 if obj is None or inspect.ismodule(obj):
                     continue

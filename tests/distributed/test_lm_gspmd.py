@@ -110,3 +110,24 @@ def test_gspmd_flag_guards(lm, eight_devices):
                   "--pipeline-parallel", "2"])
     with pytest.raises(SystemExit, match="mesh"):
         lm.main(BASE + ["--partitioning", "gspmd"])
+
+
+def test_gspmd_save_resume_bitwise(lm, eight_devices, tmp_path):
+    """--save/--resume on the GSPMD tier: host-restored arrays re-shard
+    through the jit boundary's NamedShardings, and the resumed
+    trajectory continues the uninterrupted run bitwise (same bar as the
+    shard_map tier's checkpoint test)."""
+    ckpt = str(tmp_path / "gspmd.npz")
+    extra = ["--partitioning", "gspmd", "--data-parallel", "2",
+             "--tensor-parallel", "2"]
+    m_full = _run(lm, extra, opt_level="O2")
+    _run(lm, extra + ["--iters", "3", "--save", ckpt], opt_level="O2")
+    m_res = _run(lm, extra + ["--resume", ckpt], opt_level="O2")
+    np.testing.assert_array_equal(m_res["loss_history"],
+                                  m_full["loss_history"][3:])
+    full_s, res_s = m_full["final_state"], m_res["final_state"]
+    lm.assert_trees_close(res_s.params, full_s.params, rtol=0, atol=0)
+    lm.assert_trees_close(res_s.master_params, full_s.master_params,
+                          rtol=0, atol=0)
+    assert float(res_s.scaler.loss_scale) == \
+        float(full_s.scaler.loss_scale)
