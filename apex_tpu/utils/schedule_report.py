@@ -33,7 +33,8 @@ import numpy as np
 
 __all__ = ["topology_mesh", "scheduled_text", "collective_async_pairs",
            "all_reduce_bucketing", "ddp_step_program",
-           "pipeline_1f1b_program", "zero_update_program"]
+           "pipeline_1f1b_program", "ring_attention_program",
+           "zero_update_program"]
 
 # one compute op between a start/done pair = the transport is riding under
 # real work. On TPU every lowered compute op is one of these HLO forms.
@@ -193,6 +194,33 @@ def pipeline_1f1b_program(pp: int = 8, microbatches: int = 16,
     fn = shard_map(run, mesh=mesh, in_specs=(P(), P(), P()),
                    out_specs=(P(), P()), check_vma=False)
     return fn, (sp, xs, tgt)
+
+
+def ring_attention_program(context: int = 8, b: int = 1, h: int = 4,
+                           local_seq: int = 256, d: int = 128):
+    """The actual ring-attention forward+backward
+    (transformer.context_parallel.ring_attention) over an 8-chip
+    'context' mesh — the long-context tier's KV rotation. Returns
+    (fn, avals)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.transformer.context_parallel import ring_attention
+
+    mesh = topology_mesh({"context": context})
+
+    def run(q, k, v):
+        def loss(q, k, v):
+            o = ring_attention(q, k, v, axis_name="context", causal=True)
+            return jnp.sum(jnp.asarray(o, jnp.float32) ** 2)
+
+        l, grads = jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
+        return l, grads
+
+    aval = jax.ShapeDtypeStruct((b, h, local_seq, d), jnp.bfloat16)
+    fn = shard_map(run, mesh=mesh, in_specs=(P(), P(), P()),
+                   out_specs=(P(), P()), check_vma=False)
+    return fn, (aval, aval, aval)
 
 
 def zero_update_program(width: int = 1024, n_layers: int = 4):

@@ -31,7 +31,8 @@ import sys
 
 from apex_tpu.utils.schedule_report import (
     all_reduce_bucketing, collective_async_pairs, ddp_step_program,
-    pipeline_1f1b_program, scheduled_text, zero_update_program)
+    pipeline_1f1b_program, ring_attention_program, scheduled_text,
+    zero_update_program)
 
 
 def emit(row):
@@ -84,7 +85,27 @@ def bench_zero():
     emit(row)
 
 
-SUITES = {"pipeline": bench_pipeline, "ddp": bench_ddp, "zero": bench_zero}
+def bench_ring():
+    fn, avals = ring_attention_program()
+    txt = scheduled_text(fn, *avals)
+    pairs = collective_async_pairs(txt, "collective-permute")
+    overlapped = [p for p in pairs if p["compute_between"] > 0]
+    emit({
+        "program": "ring_attention_fwd_bwd",
+        "mesh": "context=8", "local_seq": 256,
+        "collective_permute_start_done_pairs": len(pairs),
+        "pairs_with_compute_inside": len(overlapped),
+        "max_compute_inside": max((p["compute_between"] for p in pairs),
+                                  default=0),
+        "sync_permutes": txt.count(" collective-permute("),
+        "evidence": "every KV rotation in flight under attention "
+                    "compute" if pairs and len(overlapped) == len(pairs)
+        else "NO async KV rotation found",
+    })
+
+
+SUITES = {"pipeline": bench_pipeline, "ddp": bench_ddp,
+          "ring": bench_ring, "zero": bench_zero}
 
 
 def main(argv):

@@ -79,7 +79,9 @@ def parse_args(argv=None):
                    help="ZeRO: shard optimizer state over the data axis "
                         "(contrib DistributedFusedAdam — mean-reduce-"
                         "scatter grads, shard-local update, all-gather "
-                        "params; needs dp>1)")
+                        "params; needs dp>1). Under --partitioning "
+                        "gspmd the same sharding is ONE PartitionSpec "
+                        "on the m/v superbuffers — XLA does the rest")
     p.add_argument("--microbatches", type=int, default=None,
                    help="pipeline microbatches (default 2*pp)")
     p.add_argument("--partitioning", default="shard_map",
@@ -89,7 +91,7 @@ def parse_args(argv=None):
                         "jax.jit over the SAME 1-device program with "
                         "NamedShardings built from the TP modules' "
                         "kernel_partition_spec(); XLA's SPMD partitioner "
-                        "inserts the collectives (dp x tp only)")
+                        "inserts the collectives (dp x tp, + --zero)")
     p.add_argument("--save", default=None, metavar="CKPT",
                    help="write the final train state (params, masters, "
                         "optimizer state incl. ZeRO shards, scaler) plus "
@@ -214,11 +216,11 @@ def build_parallel_lm(args, policy):
     zero_on = bool(args.zero)
     if zero_on and dp < 2:
         raise SystemExit("--zero needs --data-parallel > 1")
-    if gspmd and (pp > 1 or vpp > 1 or sp_on or vp_on or zero_on):
+    if gspmd and (pp > 1 or vpp > 1 or sp_on or vp_on):
         raise SystemExit(
-            "--partitioning gspmd drives dp x tp only; pipeline/"
-            "sequence/vocab-parallel and --zero run under the "
-            "(default) shard_map path")
+            "--partitioning gspmd drives dp x tp (optionally --zero); "
+            "pipeline/sequence/vocab-parallel run under the (default) "
+            "shard_map path")
     # Under GSPMD the module MATH is the 1-device program (world 1, no
     # mappings.py collectives); tp lives only in the sharding specs.
     tpm = 1 if gspmd else tp
@@ -510,7 +512,7 @@ def build_parallel_lm(args, policy):
             "head": pack_head_grads(head_g),
         }
 
-    if zero_on:
+    if zero_on and not gspmd:
         _inner_grad_fn = grad_fn
 
         def grad_fn(params, batch, loss_scale):  # noqa: F811
@@ -531,6 +533,9 @@ def build_parallel_lm(args, policy):
             axis_name="data", world_size=dp)
         grad_avg_axis = None
     else:
+        # plain fused_adam — including gspmd --zero, where ZeRO-1 is a
+        # sharding SPEC on the m/v superbuffers (_finish_gspmd), not a
+        # different optimizer
         optimizer = fused_adam(args.lr, weight_decay=args.weight_decay,
                                adam_w_mode=True)
         grad_avg_axis = "data" if dp > 1 else None
@@ -560,7 +565,7 @@ def build_parallel_lm(args, policy):
 
     if gspmd:
         return _finish_gspmd(args, mesh, init_fn, step_fn, params, _keys,
-                             H=H, V=V, inner=inner, tp=tp)
+                             H=H, V=V, inner=inner, tp=tp, zero=zero_on)
 
     def param_spec(path, _leaf):
         keys = _keys(path)
@@ -627,7 +632,7 @@ def build_parallel_lm(args, policy):
 
 
 def _finish_gspmd(args, mesh, init_fn, step_fn, params, _keys, *,
-                  H, V, inner, tp):
+                  H, V, inner, tp, zero=False):
     """The GSPMD/pjit tier (SURVEY §3.3 TP row: "pjit with sharded weight
     specs — the mappings collapse into sharding constraints").
 
@@ -641,10 +646,15 @@ def _finish_gspmd(args, mesh, init_fn, step_fn, params, _keys, *,
     all-reduces and the DP grad reduction that the shard_map path spells
     out explicitly — trajectory parity between the two paths and the
     1-device oracle is asserted in tests/distributed/
-    test_lm_gspmd.py. fp32 masters ride the same specs as their params;
-    fused_adam's flat m/v superbuffers stay replicated (their sharded
-    layout is the ZeRO tier's job — contrib DistributedFusedAdam on the
-    shard_map path).
+    test_lm_gspmd.py. fp32 masters ride the same specs as their params.
+
+    ``zero`` (--zero under gspmd) is ZeRO-1 the GSPMD way: the flat
+    Adam m/v superbuffers get ``P('data')`` — one spec line, no
+    collective code — so each device holds 1/dp of the optimizer state
+    (GSPMD pads non-divisible lengths). The shard_map path implements
+    the same semantics explicitly (contrib DistributedFusedAdam:
+    psum_scatter → shard-local update → all_gather); without ``zero``
+    the superbuffers stay replicated.
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -685,6 +695,11 @@ def _finish_gspmd(args, mesh, init_fn, step_fn, params, _keys, *,
             return spec_emb
         if "head" in keys and "kernel" in keys:
             return spec_head
+        if zero and keys and keys[-1] in ("m", "v") and ndim == 1:
+            # ZeRO-1 as a sharding spec: the flat Adam superbuffers
+            # (FusedAdamState.m/.v, matched by field name like the
+            # shard_map path's state_spec) live 1/dp per device
+            return P("data")
         return P()
 
     state_shapes = jax.eval_shape(init_fn, params)

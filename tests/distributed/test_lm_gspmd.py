@@ -131,3 +131,31 @@ def test_gspmd_save_resume_bitwise(lm, eight_devices, tmp_path):
                           rtol=0, atol=0)
     assert float(res_s.scaler.loss_scale) == \
         float(full_s.scaler.loss_scale)
+
+
+def test_gspmd_zero_is_one_partition_spec(lm, eight_devices):
+    """ZeRO-1 the GSPMD way (--zero under --partitioning gspmd): the
+    flat Adam m/v superbuffers carry P('data') — no collective code —
+    and each device holds 1/dp of the optimizer state. The trajectory
+    must match the unsharded gspmd run (sharding is layout, not
+    numerics), which transitively ties it to the shard_map ZeRO and the
+    1-device oracle already proven equal."""
+    m_plain = _run(lm, ["--partitioning", "gspmd",
+                        "--data-parallel", "2", "--tensor-parallel", "2"])
+    m_zero = _run(lm, ["--partitioning", "gspmd", "--zero",
+                       "--data-parallel", "2", "--tensor-parallel", "2"])
+    np.testing.assert_allclose(m_zero["loss_history"],
+                               m_plain["loss_history"], rtol=2e-4)
+    lm.assert_trees_close(_canon(lm, m_zero), _canon(lm, m_plain))
+
+    m_buf = m_zero["final_state"].opt_state.m
+    assert "data" in tuple(m_buf.sharding.spec), m_buf.sharding
+    # 4 devices in the dp2 x tp2 mesh; 'data' splits the buffer in 2 —
+    # every addressable shard holds half the elements
+    shard_elems = {s.data.size for s in m_buf.addressable_shards}
+    assert shard_elems == {m_buf.size // 2}, \
+        (m_buf.size, shard_elems)
+    # the unsharded run keeps m replicated (full size per device)
+    m_full = m_plain["final_state"].opt_state.m
+    assert {s.data.size for s in m_full.addressable_shards} == \
+        {m_full.size}

@@ -25,7 +25,8 @@ import pytest
 
 from apex_tpu.utils.schedule_report import (
     all_reduce_bucketing, collective_async_pairs, ddp_step_program,
-    pipeline_1f1b_program, scheduled_text, zero_update_program)
+    pipeline_1f1b_program, ring_attention_program, scheduled_text,
+    zero_update_program)
 
 
 @pytest.fixture(scope="module")
@@ -59,6 +60,20 @@ def test_ddp_grad_psums_bucketed_into_one_allreduce():
     # BASELINE.md's overlap table must be re-run (a good problem).
     assert b["async_split"] == 0, \
         "toolchain now async-splits all-reduce — update BASELINE.md"
+
+
+def test_ring_attention_rotations_hidden_under_compute():
+    """The long-context tier's core claim: ring attention's KV-block
+    rotations (fwd ring + bwd counter-ring) are ALL async-split with
+    attention compute scheduled inside every in-flight window — the
+    transport is free when compute per chunk dominates."""
+    fn, avals = ring_attention_program()
+    txt = scheduled_text(fn, *avals)
+    pairs = collective_async_pairs(txt, "collective-permute")
+    assert len(pairs) >= 4, pairs          # fwd + bwd rotations
+    not_hidden = [p for p in pairs if p["compute_between"] == 0]
+    assert not not_hidden, f"rotations NOT hidden: {not_hidden}"
+    assert " collective-permute(" not in txt   # zero sync permutes
 
 
 def test_zero_collectives_compile_at_schedule_level():
