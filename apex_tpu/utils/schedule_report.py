@@ -34,7 +34,7 @@ import numpy as np
 __all__ = ["topology_mesh", "scheduled_text", "collective_async_pairs",
            "all_reduce_bucketing", "ddp_step_program",
            "pipeline_1f1b_program", "ring_attention_program",
-           "zero_update_program"]
+           "ulysses_attention_program", "zero_update_program"]
 
 # one compute op between a start/done pair = the transport is riding under
 # real work. On TPU every lowered compute op is one of these HLO forms.
@@ -216,6 +216,32 @@ def ring_attention_program(context: int = 8, b: int = 1, h: int = 4,
 
         l, grads = jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
         return l, grads
+
+    aval = jax.ShapeDtypeStruct((b, h, local_seq, d), jnp.bfloat16)
+    fn = shard_map(run, mesh=mesh, in_specs=(P(), P(), P()),
+                   out_specs=(P(), P()), check_vma=False)
+    return fn, (aval, aval, aval)
+
+
+def ulysses_attention_program(context: int = 8, b: int = 1, h: int = 8,
+                              local_seq: int = 256, d: int = 128):
+    """The actual Ulysses (all-to-all) sequence-parallel attention
+    fwd+bwd (transformer.context_parallel.ulysses_attention) over an
+    8-chip 'context' mesh. Returns (fn, avals)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.transformer.context_parallel import ulysses_attention
+
+    mesh = topology_mesh({"context": context})
+
+    def run(q, k, v):
+        def loss(q, k, v):
+            o = ulysses_attention(q, k, v, axis_name="context",
+                                  causal=True)
+            return jnp.sum(jnp.asarray(o, jnp.float32) ** 2)
+
+        return jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
 
     aval = jax.ShapeDtypeStruct((b, h, local_seq, d), jnp.bfloat16)
     fn = shard_map(run, mesh=mesh, in_specs=(P(), P(), P()),

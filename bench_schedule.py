@@ -32,7 +32,7 @@ import sys
 from apex_tpu.utils.schedule_report import (
     all_reduce_bucketing, collective_async_pairs, ddp_step_program,
     pipeline_1f1b_program, ring_attention_program, scheduled_text,
-    zero_update_program)
+    ulysses_attention_program, zero_update_program)
 
 
 def emit(row):
@@ -104,8 +104,29 @@ def bench_ring():
     })
 
 
+def bench_ulysses():
+    """Honest row: the all-to-all CP flavor. This toolchain does NOT
+    async-split all-to-all in HLO — Ulysses' transport is a synchronous
+    phase between attention computes (vs ring's fully-hidden
+    rotations). That asymmetry is itself a scheduling argument for the
+    ring layout at long sequence on this compiler generation."""
+    fn, avals = ulysses_attention_program()
+    txt = scheduled_text(fn, *avals)
+    pairs = collective_async_pairs(txt, "all-to-all")
+    emit({
+        "program": "ulysses_attention_fwd_bwd",
+        "mesh": "context=8", "local_seq": 256,
+        "all_to_all_async_pairs": len(pairs),
+        "all_to_all_sync_ops": txt.count(" all-to-all("),
+        "evidence": "all-to-all stays SYNC in this toolchain's HLO — "
+                    "honest negative; ring attention's ppermute "
+                    "transport is the hidden one",
+    })
+
+
 SUITES = {"pipeline": bench_pipeline, "ddp": bench_ddp,
-          "ring": bench_ring, "zero": bench_zero}
+          "ring": bench_ring, "ulysses": bench_ulysses,
+          "zero": bench_zero}
 
 
 def main(argv):

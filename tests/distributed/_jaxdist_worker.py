@@ -11,9 +11,12 @@ over the global mesh with each process feeding only its OWN batch rows via
 ``jax.make_array_from_process_local_data`` — the reference's
 multi-process-per-node NCCL tier (SURVEY §5), TPU-shaped.
 
-Run: ``python _jaxdist_worker.py <rank> <coordinator> <outdir>``; writes
-``rank<r>.npz`` with the final params/masters/scaler for the parent test
-to compare across ranks.
+Run: ``python _jaxdist_worker.py <rank> <coordinator> <outdir> [mode]``;
+``mode`` is ``shard_map`` (default — explicit collectives) or ``gspmd``
+(plain jit + NamedShardings over the same hybrid mesh: the production
+multi-host TPU pattern, where XLA partitions one global program across
+the processes). Writes ``rank<r>.npz`` with the final
+params/masters/scaler for the parent test to compare across ranks.
 """
 
 import os
@@ -23,10 +26,12 @@ N_STEPS = 5
 BATCH = 32
 
 
-def training_setup():
+def training_setup(grad_axes=("data", "model")):
     """ONE copy of the model/optimizer constants, shared by the rank
     worker and the parent test's single-process oracle — hand-synced
-    duplicates would turn a tuning edit into a numeric-mismatch hunt."""
+    duplicates would turn a tuning edit into a numeric-mismatch hunt.
+    ``grad_axes=None`` builds the GSPMD flavor: no explicit grad psum —
+    the loss is the global-batch mean and XLA inserts the reduction."""
     import jax.numpy as jnp
 
     from apex_tpu import amp
@@ -41,8 +46,7 @@ def training_setup():
 
     policy = amp.resolve_policy(opt_level="O2", verbose=False)
     init_fn, step_fn = amp.make_train_step(
-        loss_fn, fused_adam(1e-2), policy,
-        grad_average_axis=("data", "model"))
+        loss_fn, fused_adam(1e-2), policy, grad_average_axis=grad_axes)
     return params, init_fn, step_fn
 
 
@@ -62,6 +66,9 @@ def main():
     rank = int(sys.argv[1])
     coord = sys.argv[2]
     outdir = sys.argv[3]
+    mode = sys.argv[4] if len(sys.argv) > 4 else "shard_map"
+    if mode not in ("shard_map", "gspmd"):
+        raise SystemExit(f"unknown mode {mode!r}")
     repo = os.path.abspath(os.path.join(os.path.dirname(__file__),
                                         os.pardir, os.pardir))
     if repo not in sys.path:
@@ -96,13 +103,25 @@ def main():
     assert mesh.shape == {"data": 2, "model": 4}
     axes = ("data", "model")
 
-    params, init_fn, step_fn = training_setup()
-    state = init_fn(params)
-    step = jax.jit(shard_map(step_fn, mesh=mesh,
-                             in_specs=(P(), (P(axes), P(axes))),
-                             out_specs=(P(), P()), check_vma=False),
-                   donate_argnums=(0,))
-    bsh = NamedSharding(mesh, P(axes))
+    if mode == "gspmd":
+        # one GLOBAL program partitioned by XLA across both processes:
+        # replicated state, batch sharded over every mesh dim, no
+        # explicit collectives anywhere in user code
+        params, init_fn, step_fn = training_setup(grad_axes=None)
+        rep = NamedSharding(mesh, P())
+        bsh = NamedSharding(mesh, P(axes))
+        state_sh = jax.tree_util.tree_map(
+            lambda _: rep, jax.eval_shape(init_fn, params))
+        state = jax.jit(init_fn, out_shardings=state_sh)(params)
+        step = jax.jit(step_fn, in_shardings=(state_sh, (bsh, bsh)))
+    else:
+        params, init_fn, step_fn = training_setup()
+        state = init_fn(params)
+        step = jax.jit(shard_map(step_fn, mesh=mesh,
+                                 in_specs=(P(), (P(axes), P(axes))),
+                                 out_specs=(P(), P()), check_vma=False),
+                       donate_argnums=(0,))
+        bsh = NamedSharding(mesh, P(axes))
     metrics = None
     for it in range(N_STEPS):
         x, y = batch_at(it)
@@ -122,7 +141,8 @@ def main():
         loss=np.asarray(metrics["loss"], np.float32),
         loss_scale=np.asarray(state.scaler.loss_scale, np.float32),
         unskipped=np.asarray(state.scaler.unskipped, np.int32))
-    print(f"RANK_OK {rank} loss={float(metrics['loss']):.6f}", flush=True)
+    print(f"RANK_OK {rank} mode={mode} "
+          f"loss={float(metrics['loss']):.6f}", flush=True)
 
 
 if __name__ == "__main__":

@@ -26,7 +26,7 @@ import pytest
 from apex_tpu.utils.schedule_report import (
     all_reduce_bucketing, collective_async_pairs, ddp_step_program,
     pipeline_1f1b_program, ring_attention_program, scheduled_text,
-    zero_update_program)
+    ulysses_attention_program, zero_update_program)
 
 
 @pytest.fixture(scope="module")
@@ -74,6 +74,17 @@ def test_ring_attention_rotations_hidden_under_compute():
     not_hidden = [p for p in pairs if p["compute_between"] == 0]
     assert not not_hidden, f"rotations NOT hidden: {not_hidden}"
     assert " collective-permute(" not in txt   # zero sync permutes
+
+
+def test_ulysses_all_to_all_sync_pinned():
+    """Honest negative, pinned: this toolchain keeps all-to-all
+    synchronous in scheduled HLO (8 sync ops in the Ulysses fwd+bwd,
+    zero async pairs). If a toolchain bump starts splitting it, this
+    flips and BASELINE.md's overlap table gets a better row."""
+    fn, avals = ulysses_attention_program()
+    txt = scheduled_text(fn, *avals)
+    assert txt.count(" all-to-all(") >= 4
+    assert not collective_async_pairs(txt, "all-to-all"),         "toolchain now async-splits all-to-all — update BASELINE.md"
 
 
 def test_zero_collectives_compile_at_schedule_level():
