@@ -32,7 +32,7 @@ import jax
 import numpy as np
 
 __all__ = ["init", "annotate", "trace", "cost_report", "analyze", "report",
-           "StepTimer"]
+           "device_busy", "StepTimer"]
 
 _enabled = True
 
@@ -159,6 +159,39 @@ def _leaf_spans(evs: List[dict],
     return out
 
 
+def _load_events(trace_dir: str) -> List[tuple]:
+    """All complete ('X') events of the newest dump as (lane_name,
+    file_idx, event) triples. pid namespaces are PER FILE (one dump per
+    host), so each event is classified against its own file's
+    process_name metadata and lanes never mix across files."""
+    events: List[tuple] = []
+    for fi, path in enumerate(_trace_files(trace_dir)):
+        with gzip.open(path, "rt") as f:
+            data = json.load(f)
+        evs = data.get("traceEvents", [])
+        pids = {e["pid"]: e.get("args", {}).get("name", "")
+                for e in evs
+                if e.get("ph") == "M" and e.get("name") == "process_name"}
+        events += [(pids.get(e.get("pid"), ""), fi, e)
+                   for e in evs if e.get("ph") == "X"]
+    return events
+
+
+def _device_ops(events: List[tuple]) -> tuple:
+    """(ops, file_of) for the device lanes of :func:`_load_events` output:
+    per-op HLO events when the backend cost-annotates them
+    (``hlo_category``), else the proper-nesting leaf sweep so region
+    wrappers (jit_fn(...)) don't double-count their children."""
+    file_of = {id(e): fi for _, fi, e in events}
+    dev = [e for lane, _, e in events if lane.startswith("/device:")]
+    ops = [e for e in dev if "hlo_category" in e.get("args", {})]
+    if not ops:
+        ops = _leaf_spans(dev, lane_of=lambda e: (file_of[id(e)],
+                                                  e.get("pid"),
+                                                  e.get("tid")))
+    return ops, file_of
+
+
 def analyze(trace_dir: str, top: Optional[int] = None) -> List[Dict[str, Any]]:
     """Per-op table from a captured trace — the reference's pyprof/parse +
     pyprof/prof stages (nvprof sqlite → per-kernel name/occurrence/ns/
@@ -179,31 +212,12 @@ def analyze(trace_dir: str, top: Optional[int] = None) -> List[Dict[str, Any]]:
     instead — parents that enclose other spans are dropped so region
     wrappers don't double-count their children — with zero flops/bytes.
     """
-    # (lane_name, file_idx, event) triples — pid namespaces are PER FILE
-    # (one dump per host), so classify against each file's own
-    # process_name metadata and never mix lanes across files
-    events: List[tuple] = []
-    for fi, path in enumerate(_trace_files(trace_dir)):
-        with gzip.open(path, "rt") as f:
-            data = json.load(f)
-        evs = data.get("traceEvents", [])
-        pids = {e["pid"]: e.get("args", {}).get("name", "")
-                for e in evs
-                if e.get("ph") == "M" and e.get("name") == "process_name"}
-        events += [(pids.get(e.get("pid"), ""), fi, e)
-                   for e in evs if e.get("ph") == "X"]
-
-    file_of = {id(e): fi for _, fi, e in events}
-    dev = [e for lane, _, e in events if lane.startswith("/device:")]
-    # per-op HLO events carry hlo_category; region/module spans (jit_fn(…))
-    # don't and would double-count their children's time
-    ops = [e for e in dev if "hlo_category" in e.get("args", {})]
+    events = _load_events(trace_dir)
+    ops, file_of = _device_ops(events)
     if not ops:
-        # degraded mode (no cost-annotated device ops): keep only LEAF
-        # spans — a parent region would double-count its children; lanes
-        # keyed per source file so independent hosts can't nest
+        # host-only capture: tabulate the host lanes' leaf spans instead
         ops = _leaf_spans(
-            dev or [e for _, _, e in events],
+            [e for _, _, e in events],
             lane_of=lambda e: (file_of[id(e)], e.get("pid"),
                                e.get("tid")))
 
@@ -228,6 +242,53 @@ def analyze(trace_dir: str, top: Optional[int] = None) -> List[Dict[str, Any]]:
         r["intensity"] = r["flops"] / r["bytes"] if r["bytes"] else 0.0
         r["pct_time"] = 100.0 * r["total_ms"] / total_ms
     return out[:top] if top else out
+
+
+def device_busy(trace_dir: str) -> Dict[str, float]:
+    """Device-time summary of a captured trace — the timing anchor that
+    wall-clock measurement can't provide when dispatch is remote (the
+    reference's equivalent is nvprof's kernel-time column, which times the
+    GPU itself rather than the host loop; SURVEY §6 tracing / §7's
+    "time the device, not the python loop" rule).
+
+    Reads the ``/device:`` lanes' complete events and returns::
+
+        {"busy_ms":  sum of leaf device-op durations (idle gaps excluded),
+         "span_ms":  max over lanes of (last op end − first op start),
+         "n_events": leaf device ops counted,
+         "n_lanes":  device lanes seen}
+
+    All readings come from the single BUSIEST device lane (most leaf-op
+    time): chrome dumps split one device into sub-lanes ("XLA Ops",
+    "Steps", copy streams, …) that mirror the same execution, so summing
+    across lanes would double-count occupancy. ``span_ms`` is that lane's
+    elapsed time, first op start to last op end (inter-op bubbles
+    included); ``busy_ms`` its pure occupancy — ``busy_ms/span_ms`` is
+    the duty cycle (ops overlapping *within* the lane can push it
+    marginally over 1). ``n_lanes`` counts all device lanes seen. All
+    zeros when the dump has no device lanes (host-only backends) —
+    callers must fall back to wall clock.
+    """
+    events = _load_events(trace_dir)
+    ops, file_of = _device_ops(events)
+    if not ops:
+        return {"busy_ms": 0.0, "span_ms": 0.0, "n_events": 0, "n_lanes": 0}
+    n_lanes = len({(file_of[id(e)], e.get("pid"), e.get("tid"))
+                   for lane, _, e in events
+                   if lane.startswith("/device:")})
+    per_lane: Dict[tuple, List[dict]] = {}
+    for e in ops:
+        key = (file_of[id(e)], e.get("pid"), e.get("tid"))
+        per_lane.setdefault(key, []).append(e)
+    lane_ops = max(per_lane.values(),
+                   key=lambda es: sum(float(e.get("dur", 0.0)) for e in es))
+    busy_us = sum(float(e.get("dur", 0.0)) for e in lane_ops)
+    starts = [float(e.get("ts", 0.0)) for e in lane_ops]
+    ends = [float(e.get("ts", 0.0)) + float(e.get("dur", 0.0))
+            for e in lane_ops]
+    span_us = max(ends) - min(starts)
+    return {"busy_ms": busy_us / 1e3, "span_ms": span_us / 1e3,
+            "n_events": len(lane_ops), "n_lanes": n_lanes}
 
 
 def report(rows: List[Dict[str, Any]]) -> str:

@@ -12,22 +12,32 @@ is >=0.9 on real v5e hardware).
 The JSON is self-describing about plausibility (VERDICT round-1 weak #1):
 ``mfu_est`` is the model-FLOPs utilization implied by the measured rate
 against the chip's bf16 peak, and ``implausible: true`` flags any reading
-over 1.0 — on the axon emulator, step time is dispatch-dominated and the
-absolute rate exceeds silicon physics; such readings are regression
-trackers only, never hardware claims.
+over 1.0.
+
+Round 5: the headline ``value`` is anchored on DEVICE time when the
+profiler dump has device lanes (``basis: "device_trace"``). Wall-clock
+timing through the axon tunnel is dispatch-dominated — rounds 1-4 recorded
+physically impossible rates (BENCH_r04: 93.5k img/s = 5.8x the chip's bf16
+peak, 39.5% spread) that carried no hardware signal. The profiler's device
+lanes time the silicon itself (the reference's nvprof kernel-time column —
+SURVEY §6/§7: time the device, not the python loop), so ``value`` becomes
+a real throughput claim: per-window rate = BATCH*STEPS / device span of
+the capture (bubbles included; ``duty_cycle`` reports busy/span). The old
+wall-clock reading stays in ``wall_clock`` for cross-round continuity.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import tempfile
 import time
 
 import jax
 import jax.numpy as jnp
 import optax
 
-from apex_tpu import amp
+from apex_tpu import amp, pyprof
 from apex_tpu.amp.policy import resolve_policy
 from apex_tpu.models.resnet import create_model
 
@@ -66,6 +76,15 @@ STEPS = int(os.environ.get("BENCH_STEPS", "10"))
 # Measure >=3 independent windows and report median + min + spread so one
 # JSON line carries its own noise bars.
 WINDOWS = int(os.environ.get("BENCH_WINDOWS", "3"))
+# Device-anchored windows: profiler captures of STEPS steps each whose
+# device-lane span times the silicon itself (basis: "device_trace").
+TRACE_WINDOWS = int(os.environ.get("BENCH_TRACE_WINDOWS", "3"))
+
+
+def _median(xs):
+    xs = sorted(xs)
+    mid = len(xs) // 2
+    return xs[mid] if len(xs) % 2 else 0.5 * (xs[mid - 1] + xs[mid])
 
 
 def main():
@@ -100,36 +119,70 @@ def main():
         state, _ = jit_step(state, batch)
     jax.block_until_ready(jax.tree_util.tree_leaves(state.params)[0])
 
-    rates = []
+    wall_rates = []
     for _ in range(WINDOWS):
         t0 = time.perf_counter()
         for _ in range(STEPS):
             state, metrics = jit_step(state, batch)
         jax.block_until_ready(metrics["loss"])
         dt = time.perf_counter() - t0
-        rates.append(BATCH * STEPS / dt)
+        wall_rates.append(BATCH * STEPS / dt)
 
-    if not rates:
+    if not wall_rates:
         raise SystemExit("BENCH_WINDOWS must be >= 1")
-    rates.sort()
-    mid = len(rates) // 2
-    img_per_sec = (rates[mid] if len(rates) % 2
-                   else 0.5 * (rates[mid - 1] + rates[mid]))  # true median
+    wall_rates.sort()
+    wall_value = _median(wall_rates)
+    wall_spread = ((wall_rates[-1] - wall_rates[0]) / wall_value
+                   if wall_value else 0.0)
+
+    # Device-anchored windows: each capture's device-lane span times the
+    # silicon (bubbles included). Falls back to wall clock when the
+    # backend writes no device lanes (e.g. CPU smoke runs).
+    dev_rates, duty = [], []
+    for _ in range(TRACE_WINDOWS):
+        with tempfile.TemporaryDirectory() as td:
+            with pyprof.trace(td):
+                for _ in range(STEPS):
+                    state, metrics = jit_step(state, batch)
+                jax.block_until_ready(metrics["loss"])
+            try:
+                d = pyprof.device_busy(td)
+            except FileNotFoundError:
+                d = {"span_ms": 0.0, "busy_ms": 0.0}
+        if d["span_ms"] > 0:
+            dev_rates.append(BATCH * STEPS / (d["span_ms"] / 1e3))
+            duty.append(d["busy_ms"] / d["span_ms"])
+
+    dev_rates.sort()
+    if dev_rates:
+        basis, rates = "device_trace", dev_rates
+    else:
+        basis, rates = "wall_clock", wall_rates
+    img_per_sec = _median(rates)
     spread = (rates[-1] - rates[0]) / img_per_sec if img_per_sec else 0.0
     flop_per_img = RESNET50_TRAIN_FLOP_PER_IMG_224 * (IMAGE / 224.0) ** 2
     mfu = img_per_sec * flop_per_img / peak_flops(jax.devices()[0])
-    print(json.dumps({
+    out = {
         "metric": "resnet50_amp_o2_train_img_per_sec_per_chip",
         "value": round(img_per_sec, 2),
         "unit": "img/s/chip",
         "vs_baseline": round(img_per_sec / V100_O2_IMG_PER_SEC, 4),
+        "basis": basis,
         "windows": [round(r, 2) for r in rates],
         "min": round(rates[0], 2),
         "spread_pct": round(100.0 * spread, 2),
         "mfu_est": round(mfu, 4),
         "implausible": bool(mfu > 1.0),
         "device_kind": getattr(jax.devices()[0], "device_kind", "unknown"),
-    }))
+        "wall_clock": {
+            "value": round(wall_value, 2),
+            "windows": [round(r, 2) for r in wall_rates],
+            "spread_pct": round(100.0 * wall_spread, 2),
+        },
+    }
+    if duty:
+        out["duty_cycle"] = round(_median(duty), 4)
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -136,6 +136,105 @@ def test_pyprof_cli_renders_table(tmp_path, capsys):
         cli([os.path.join(tmp_path, "missing")])
 
 
+def _write_trace_dump(tmp_path, trace_events):
+    """Lay out a chrome-trace dump in the plugins/profile/<run>/ layout
+    that jax.profiler writes, so device_busy/analyze read it like a real
+    capture."""
+    import gzip
+    import json as _json
+
+    run = os.path.join(tmp_path, "plugins", "profile", "run1")
+    os.makedirs(run, exist_ok=True)
+    path = os.path.join(run, "host.trace.json.gz")
+    with gzip.open(path, "wt") as f:
+        _json.dump({"traceEvents": trace_events}, f)
+    return str(tmp_path)
+
+
+def test_device_busy_span_and_occupancy(tmp_path):
+    """pyprof.device_busy — the device-time anchor bench.py's headline
+    rides on: span is last-end minus first-start on the busiest device
+    lane, busy is the leaf-op occupancy, host lanes are ignored."""
+    evs = [
+        {"ph": "M", "pid": 7, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "pid": 9, "name": "process_name",
+         "args": {"name": "/host:CPU"}},
+        # device lane: two leaf ops with a 2us bubble between them
+        {"ph": "X", "pid": 7, "tid": 1, "ts": 10.0, "dur": 4.0,
+         "name": "fusion.1", "args": {"hlo_category": "convolution"}},
+        {"ph": "X", "pid": 7, "tid": 1, "ts": 16.0, "dur": 4.0,
+         "name": "fusion.2", "args": {"hlo_category": "fusion"}},
+        # host lane must not count
+        {"ph": "X", "pid": 9, "tid": 1, "ts": 0.0, "dur": 100.0,
+         "name": "python_loop"},
+    ]
+    d = pyprof.device_busy(_write_trace_dump(tmp_path, evs))
+    assert d["span_ms"] == pytest.approx(10.0 / 1e3)   # 10..20us
+    assert d["busy_ms"] == pytest.approx(8.0 / 1e3)    # 4 + 4
+    assert d["n_events"] == 2
+    assert d["n_lanes"] == 1
+
+
+def test_device_busy_reads_the_busiest_lane_only(tmp_path):
+    """Chrome dumps split one device into mirrored sub-lanes ("XLA Ops",
+    "Steps", copy streams); summing across them would double-count, so
+    device_busy reads only the lane with the most leaf-op time."""
+    evs = [
+        {"ph": "M", "pid": 7, "name": "process_name",
+         "args": {"name": "/device:TPU:0 XLA Ops"}},
+        {"ph": "M", "pid": 8, "name": "process_name",
+         "args": {"name": "/device:TPU:0 Steps"}},
+        # ops lane: 8us of work over a 10us span
+        {"ph": "X", "pid": 7, "tid": 1, "ts": 10.0, "dur": 4.0,
+         "name": "fusion.1", "args": {"hlo_category": "fusion"}},
+        {"ph": "X", "pid": 7, "tid": 1, "ts": 16.0, "dur": 4.0,
+         "name": "fusion.2", "args": {"hlo_category": "fusion"}},
+        # steps lane mirrors the same execution as one big span
+        {"ph": "X", "pid": 8, "tid": 1, "ts": 10.0, "dur": 10.0,
+         "name": "step0", "args": {"hlo_category": "step"}},
+    ]
+    d = pyprof.device_busy(_write_trace_dump(tmp_path, evs))
+    assert d["busy_ms"] == pytest.approx(10.0 / 1e3)   # busiest lane wins
+    assert d["span_ms"] == pytest.approx(10.0 / 1e3)
+    assert d["busy_ms"] <= d["span_ms"] * 1.001        # duty <= 1 here
+    assert d["n_events"] == 1
+    assert d["n_lanes"] == 2
+
+
+def test_device_busy_degraded_mode_drops_parents(tmp_path):
+    """Without hlo_category annotations the leaf-span sweep applies: a
+    region wrapper enclosing its ops must not double busy time."""
+    evs = [
+        {"ph": "M", "pid": 3, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "X", "pid": 3, "tid": 1, "ts": 0.0, "dur": 10.0,
+         "name": "jit_step"},
+        {"ph": "X", "pid": 3, "tid": 1, "ts": 1.0, "dur": 3.0,
+         "name": "op_a"},
+        {"ph": "X", "pid": 3, "tid": 1, "ts": 6.0, "dur": 2.0,
+         "name": "op_b"},
+    ]
+    d = pyprof.device_busy(_write_trace_dump(tmp_path, evs))
+    assert d["busy_ms"] == pytest.approx(5.0 / 1e3)    # 3 + 2, not 15
+    # span covers the LEAF ops' window (1..8), not the dropped wrapper
+    assert d["span_ms"] == pytest.approx(7.0 / 1e3)
+
+
+def test_device_busy_no_device_lanes_is_zero(tmp_path):
+    """Host-only dumps (CPU smoke runs) return zeros so callers fall
+    back to wall clock instead of dividing by a bogus span."""
+    evs = [
+        {"ph": "M", "pid": 9, "name": "process_name",
+         "args": {"name": "/host:CPU"}},
+        {"ph": "X", "pid": 9, "tid": 1, "ts": 0.0, "dur": 50.0,
+         "name": "python_loop"},
+    ]
+    d = pyprof.device_busy(_write_trace_dump(tmp_path, evs))
+    assert d == {"busy_ms": 0.0, "span_ms": 0.0,
+                 "n_events": 0, "n_lanes": 0}
+
+
 def test_leaf_spans_drop_enclosing_parents():
     """Degraded-mode aggregation (no cost-annotated device ops) must not
     double-count: a span enclosing another on the same lane is a parent
