@@ -428,6 +428,18 @@ def _resolve_blocks(block_q, block_k):
     return block_q, block_k
 
 
+def _fit_block(b, s, multiple):
+    """Shrink a (possibly tuned) block until it divides the sequence,
+    keeping the tile alignment. A big tuned block (e.g. block_k=1024 from
+    the v5e sweep) must degrade to a smaller Pallas block at shapes it
+    doesn't divide — never drop the call to the quadratic-memory
+    fallback, which is what _pallas_ok would otherwise do."""
+    b = min(b, s)
+    while b > multiple and s % b:
+        b //= 2
+    return max(multiple, (b // multiple) * multiple)
+
+
 def _pallas_ok(sq, sk, d, bq, bk):
     # bk is the lane dim of the [bq, bk] score tile → multiple of 128;
     # bq is the sublane dim → multiple of 8.
@@ -763,7 +775,7 @@ def attn_chunk_fwd(q3, k3, v3, *, scale, causal,
         raise ValueError("dropout_rate > 0 requires dropout_seed")
     sq, sk, d = q3.shape[1], k3.shape[1], q3.shape[2]
     block_q, block_k = _resolve_blocks(block_q, block_k)
-    bq, bk = min(block_q, sq), min(block_k, sk)
+    bq, bk = _fit_block(block_q, sq, 8), _fit_block(block_k, sk, 128)
     if jax.default_backend() == "cpu":
         interpret = True
     if not _pallas_ok(sq, sk, d, bq, bk) or (interpret and _has_vma(q3)) \
@@ -786,7 +798,7 @@ def attn_chunk_bwd(q3, k3, v3, do3, lse, delta, *, scale, causal,
         raise ValueError("dropout_rate > 0 requires dropout_seed")
     sq, sk, d = q3.shape[1], k3.shape[1], q3.shape[2]
     block_q, block_k = _resolve_blocks(block_q, block_k)
-    bq, bk = min(block_q, sq), min(block_k, sk)
+    bq, bk = _fit_block(block_q, sq, 8), _fit_block(block_k, sk, 128)
     if jax.default_backend() == "cpu":
         interpret = True
     if not _pallas_ok(sq, sk, d, bq, bk) or (interpret and _has_vma(q3)) \
@@ -908,8 +920,8 @@ def flash_attention(q, k, v, *, causal: bool = False,
     # Pallas path rejects, or aligned shapes would crash where unaligned ran
     _validate_bias(bias, q.shape[0], q.shape[1], sq, sk)
     block_q, block_k = _resolve_blocks(block_q, block_k)
-    bq = min(block_q, sq)
-    bk = min(block_k, sk)
+    bq = _fit_block(block_q, sq, 8)
+    bk = _fit_block(block_k, sk, 128)
     if jax.default_backend() == "cpu":
         interpret = True  # pallas-TPU lowering needs a TPU; CPU interprets
     if not _pallas_ok(sq, sk, d, bq, bk) or (interpret and _has_vma(q)) \

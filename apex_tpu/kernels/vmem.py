@@ -42,6 +42,7 @@ def get_override(key, default: int, multiple: int = 1,
     file must only ever cost speed, never a Mosaic lowering error."""
     if key is None:
         return default
+    _auto_load_packaged()
     v = _OVERRIDES.get(key)
     if v is None:
         return default
@@ -63,25 +64,29 @@ def overrides() -> dict:
     return dict(_OVERRIDES)
 
 
-def load_overrides(path: str) -> dict:
-    """Load a ``bench_kernels.py --sweep`` JSON ({key: value}) into the
-    registry; returns the loaded mapping.
-
-    The whole file is validated before any entry is committed, so a bad
-    value (non-integer) leaves the registry untouched rather than
-    partially overwritten (ADVICE r3)."""
+def _validated_file(path: str) -> dict:
+    """Parse + validate a tuned JSON whole-file-first: a bad value (bool,
+    digit string, non-integral float) raises BEFORE anything is
+    committed, so no caller can leave the registry partially overwritten
+    (ADVICE r3)."""
     with open(path) as f:
         data = json.load(f)
     validated = {}
     for k, v in data.items():
-        # ints only: bools, digit strings, and non-integral floats (which
-        # int() would silently truncate) must all fail before the commit
         ok = (isinstance(v, int) and not isinstance(v, bool)) or (
             isinstance(v, float) and math.isfinite(v) and int(v) == v)
         if not ok:
             raise ValueError(
                 f"tuned override {k!r}={v!r} is not an integer")
         validated[str(k)] = int(v)
+    return validated
+
+
+def load_overrides(path: str) -> dict:
+    """Load a ``bench_kernels.py --sweep`` JSON ({key: value}) into the
+    registry; returns the loaded mapping. Validates the whole file before
+    committing any entry."""
+    validated = _validated_file(path)
     _OVERRIDES.update(validated)
     return validated
 
@@ -96,6 +101,45 @@ if os.environ.get("APEX_TPU_TUNED"):
         warnings.warn(
             f"APEX_TPU_TUNED={os.environ['APEX_TPU_TUNED']!r} could not "
             f"be loaded ({_e}); running with heuristic block sizes")
+
+
+# Packaged per-device-kind tuned files (round 5): tuned/<kind>.json,
+# discovered from the sweep on that silicon and checked in, so tuned
+# blocks apply by default — no env var, no user action. Loaded lazily at
+# the first get_override() call (kernels resolve blocks at trace time,
+# when the backend is already up; probing jax.devices() at import would
+# initialize the backend as a side effect of `import apex_tpu`). An
+# explicit APEX_TPU_TUNED file or set_override() call wins: packaged
+# values never clobber keys that are already set.
+_TUNED_DIR = os.path.join(os.path.dirname(__file__), "tuned")
+_auto_load_done = False
+
+
+def _auto_load_packaged() -> None:
+    global _auto_load_done
+    if _auto_load_done:
+        return
+    _auto_load_done = True
+    try:
+        import jax
+
+        kind = getattr(jax.devices()[0], "device_kind", "")
+    except Exception:  # noqa: BLE001 — no backend is a valid state
+        return
+    path = os.path.join(_TUNED_DIR,
+                        kind.lower().replace(" ", "_") + ".json")
+    if not os.path.isfile(path):
+        return
+    try:
+        validated = _validated_file(path)  # whole-file-first (ADVICE r3)
+    except Exception as e:  # noqa: BLE001
+        import warnings
+
+        warnings.warn(f"packaged tuned file {path!r} could not be "
+                      f"loaded ({e}); running with heuristic block sizes")
+        return
+    for k, v in validated.items():
+        _OVERRIDES.setdefault(k, v)
 
 
 def block_rows(n_rows: int, row_bytes: int, n_bufs: int,

@@ -54,6 +54,9 @@ setup(
     description="TPU-native mixed-precision, fused-kernel, and parallelism "
                 "utilities (NVIDIA Apex capability surface on JAX/XLA/Pallas)",
     packages=find_packages(include=["apex_tpu", "apex_tpu.*"]),
+    # per-device-kind tuned block files (kernels/tuned/<kind>.json),
+    # auto-loaded by kernels.vmem at first dispatch
+    package_data={"apex_tpu.kernels": ["tuned/*.json"]},
     ext_modules=ext_modules,
     python_requires=">=3.10",
     install_requires=["jax", "flax", "optax", "numpy"],

@@ -39,6 +39,45 @@ def test_registry_roundtrip(tmp_path):
     assert vmem.get_override("flash.block_q", 128) == 256
 
 
+def test_packaged_tuned_file_autoloads_by_device_kind(tmp_path,
+                                                      monkeypatch):
+    """kernels/tuned/<device_kind>.json applies by default at the first
+    get_override() call — but never clobbers an explicit override, and a
+    corrupt file degrades to heuristics instead of raising."""
+    kind = jax.devices()[0].device_kind.lower().replace(" ", "_")
+    (tmp_path / f"{kind}.json").write_text(
+        json.dumps({"auto.knob": 48, "auto.other": 16}))
+    monkeypatch.setattr(vmem, "_TUNED_DIR", str(tmp_path))
+    monkeypatch.setattr(vmem, "_auto_load_done", False)
+    vmem.set_override("auto.other", 24)      # explicit wins
+    try:
+        assert vmem.get_override("auto.knob", 8) == 48
+        assert vmem.get_override("auto.other", 8) == 24
+    finally:
+        vmem.clear_overrides()
+
+    # corrupt file: warn-and-degrade, registry untouched
+    (tmp_path / f"{kind}.json").write_text("{not json")
+    monkeypatch.setattr(vmem, "_auto_load_done", False)
+    with pytest.warns(UserWarning, match="could not be loaded"):
+        assert vmem.get_override("auto.knob", 8) == 8
+
+    # one bad value: NOTHING commits (whole-file-first, ADVICE r3 — the
+    # same atomicity load_overrides enforces)
+    (tmp_path / f"{kind}.json").write_text(
+        json.dumps({"auto.good": 32, "auto.bad": "32"}))
+    monkeypatch.setattr(vmem, "_auto_load_done", False)
+    with pytest.warns(UserWarning, match="not an integer"):
+        assert vmem.get_override("auto.good", 8) == 8
+    vmem.clear_overrides()
+
+    # no file for this device kind: silent no-op, loaded only once
+    monkeypatch.setattr(vmem, "_TUNED_DIR", str(tmp_path / "nothing"))
+    monkeypatch.setattr(vmem, "_auto_load_done", False)
+    assert vmem.get_override("auto.knob", 8) == 8
+    assert vmem._auto_load_done is True
+
+
 def test_get_override_alignment_and_cap():
     vmem.set_override("k", 100)
     assert vmem.get_override("k", 1, multiple=8) == 96
@@ -135,6 +174,41 @@ def test_flash_block_override_used():
     ref = mha_reference(q, k, v, causal=True, scale=128 ** -0.5)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_flash_fit_block_shrinks_to_divide():
+    """A big tuned block (v5e sweep: block_k=1024) must shrink until it
+    divides the sequence — staying on the Pallas path — instead of
+    failing _pallas_ok and silently taking the quadratic-memory
+    fallback."""
+    from apex_tpu.kernels.flash_attention import _fit_block, _pallas_ok
+
+    assert _fit_block(1024, 1536, 128) == 512     # halve once
+    assert _fit_block(1024, 384, 128) == 384      # clamp to seq
+    assert _fit_block(256, 2048, 8) == 256        # already divides
+    assert _fit_block(1024, 250, 128) == 128      # floor at alignment
+    # the fitted pair passes the Pallas gate at the shrink-needing shape
+    assert _pallas_ok(1536, 1536, 128,
+                      _fit_block(256, 1536, 8), _fit_block(1024, 1536, 128))
+
+
+def test_flash_oversized_tuned_block_stays_correct():
+    """Numerics with the checked-in v5e tuned blocks at a sequence
+    (1536) the tuned block_k=1024 does not divide."""
+    from apex_tpu.kernels.flash_attention import (flash_attention,
+                                                  mha_reference)
+
+    vmem.set_override("flash.block_q", 256)
+    vmem.set_override("flash.block_k", 1024)
+    try:
+        ks = jax.random.split(jax.random.PRNGKey(7), 3)
+        q, k, v = (jax.random.normal(kk, (1, 1, 1536, 128)) for kk in ks)
+        out = flash_attention(q, k, v, causal=True)
+        ref = mha_reference(q, k, v, causal=True, scale=128 ** -0.5)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+    finally:
+        vmem.clear_overrides()
 
 
 def test_load_overrides_atomic_on_bad_value(tmp_path):
