@@ -271,6 +271,102 @@ def fused_adam_step(flat_p, flat_m, flat_v, flat_g, *, lr, beta1, beta2, eps,
             v_new.reshape(-1)[:n])
 
 
+def adam_tree_step(tree_p, tree_m, tree_v, tree_g, *, lr, beta1, beta2, eps,
+                   weight_decay, step, adam_w_mode=True, inv_scale=1.0,
+                   bias_correction=True):
+    """AdamFunctor applied PER LEAF under one jit — the TPU-native layout.
+
+    Same per-element math as :func:`fused_adam_step`'s superbuffer kernel
+    (asserted bitwise-identical in tests/L0/test_multi_tensor.py), but over
+    the parameter pytree directly. The CUDA multi_tensor harness exists to
+    amortize kernel LAUNCHES, which jit does not pay; the superbuffer
+    translation instead pays two whole-model flatten/unflatten copies per
+    step. Measured on v5e at 125M params (BASELINE.md round-5 kernel tier):
+    flat+Pallas 18.7 ms, flat+jnp 15.1 ms, this path 5.2 ms — XLA fuses the
+    per-leaf updates to the HBM roofline. The flat kernels remain for
+    callers whose SHARDING is buffer-level (contrib ZeRO optimizers
+    psum_scatter the superbuffer).
+
+    Returns (new_p tree in param dtype, new_m tree fp32, new_v tree fp32).
+    """
+    step = jnp.asarray(step, jnp.float32)
+    b1 = jnp.asarray(beta1, jnp.float32)
+    b2 = jnp.asarray(beta2, jnp.float32)
+    if bias_correction:
+        bc1, bc2 = 1.0 - b1 ** step, 1.0 - b2 ** step
+    else:
+        bc1 = bc2 = jnp.float32(1.0)
+    lr = jnp.asarray(lr, jnp.float32)
+    eps = jnp.asarray(eps, jnp.float32)
+    wd = jnp.asarray(weight_decay, jnp.float32)
+    inv = jnp.asarray(inv_scale, jnp.float32)
+
+    def leaf(p, m, v, g):
+        p32 = p.astype(jnp.float32)
+        g32 = g.astype(jnp.float32) * inv
+        if not adam_w_mode:
+            g32 = g32 + wd * p32       # ADAM_MODE_0: L2 folded into grad
+        m2 = b1 * m + (1.0 - b1) * g32
+        v2 = b2 * v + (1.0 - b2) * g32 * g32
+        upd = (m2 / bc1) / (jnp.sqrt(v2 / bc2) + eps)
+        if adam_w_mode:
+            upd = upd + wd * p32       # ADAM_MODE_1: decoupled decay
+        return (p32 - lr * upd).astype(p.dtype), m2, v2
+
+    lp, td = jax.tree_util.tree_flatten(tree_p)
+    lm = jax.tree_util.tree_leaves(tree_m)
+    lv = jax.tree_util.tree_leaves(tree_v)
+    lg = jax.tree_util.tree_leaves(tree_g)
+    outs = [leaf(p, m, v, g) for p, m, v, g in zip(lp, lm, lv, lg)]
+
+    def unf(i):
+        return jax.tree_util.tree_unflatten(td, [o[i] for o in outs])
+
+    return unf(0), unf(1), unf(2)
+
+
+def sgd_tree_step(tree_p, tree_buf, tree_g, *, lr, momentum=0.0,
+                  dampening=0.0, weight_decay=0.0, nesterov=False,
+                  wd_after_momentum=False):
+    """SGDFunctor applied PER LEAF under one jit — the TPU-native layout
+    (same rationale and bitwise contract as :func:`adam_tree_step`; the
+    superbuffer's flatten/unflatten copies are the dominant cost of
+    :func:`fused_sgd_step` under jit).
+
+    Returns (new_p tree in param dtype, new_buf tree fp32)."""
+    lr = jnp.asarray(lr, jnp.float32)
+    mom = jnp.asarray(momentum, jnp.float32)
+    damp = jnp.asarray(dampening, jnp.float32)
+    wd = jnp.asarray(weight_decay, jnp.float32)
+    momentum_on = True if hasattr(momentum, "dtype") \
+        else float(momentum) != 0.0
+
+    def leaf(p, buf, g):
+        p32 = p.astype(jnp.float32)
+        g32 = g.astype(jnp.float32)
+        if not wd_after_momentum:
+            g32 = g32 + wd * p32
+        if momentum_on:
+            buf2 = mom * buf + (1 - damp) * g32
+            upd = g32 + mom * buf2 if nesterov else buf2
+        else:
+            buf2 = buf
+            upd = g32
+        if wd_after_momentum:
+            upd = upd + wd * p32
+        return (p32 - lr * upd).astype(p.dtype), buf2
+
+    lp, td = jax.tree_util.tree_flatten(tree_p)
+    lb = jax.tree_util.tree_leaves(tree_buf)
+    lg = jax.tree_util.tree_leaves(tree_g)
+    outs = [leaf(p, b, g) for p, b, g in zip(lp, lb, lg)]
+
+    def unf(i):
+        return jax.tree_util.tree_unflatten(td, [o[i] for o in outs])
+
+    return unf(0), unf(1)
+
+
 # ---------------------------------------------------------------------- sgd
 def _sgd_kernel(sc_ref, p_ref, buf_ref, g_ref, p_out, buf_out, *,
                 momentum_on, nesterov, wd_after_momentum):

@@ -8,35 +8,47 @@ exposed; momentum buffers fp32.
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
 import optax
 
-from ..kernels.multi_tensor import fused_sgd_step
+from ..kernels.multi_tensor import fused_sgd_step, sgd_tree_step
 from ._surface import current_transform, group_property, install_torch_surface
 from .fused_adam import ScalarOrSchedule, _flat32, _lr_at, _unflatten_like
 
 
 class FusedSGDState(NamedTuple):
     count: jnp.ndarray
-    momentum_buf: jnp.ndarray  # flat fp32
+    momentum_buf: Any  # fp32 — pytree (layout="tree", default) or flat
+    #                    array (layout="flat")
 
 
 def fused_sgd(learning_rate: ScalarOrSchedule, momentum: float = 0.0,
               dampening: float = 0.0, weight_decay: float = 0.0,
-              nesterov: bool = False,
-              wd_after_momentum: bool = False) -> optax.GradientTransformation:
+              nesterov: bool = False, wd_after_momentum: bool = False,
+              layout: str = "tree") -> optax.GradientTransformation:
     """Optax-compatible fused SGD (apex/optimizers/fused_sgd.py —
     FusedSGD defaults: torch-style momentum buffer, optional Nesterov,
-    ``wd_after_momentum`` ordering flag). The update runs through the
-    multi_tensor superbuffer kernel on TPU."""
+    ``wd_after_momentum`` ordering flag).
+
+    ``layout``: "tree" (default — per-leaf momentum state, XLA-fused
+    update; see fused_adam's module docstring for the v5e measurement
+    behind the round-5 default) or "flat" (superbuffer through the Pallas
+    multi_tensor kernel). Bitwise-identical trajectories."""
     if nesterov and (momentum <= 0 or dampening != 0):
         raise ValueError("Nesterov momentum requires a momentum and zero "
                          "dampening")  # torch/apex validation
+    if layout not in ("tree", "flat"):
+        raise ValueError(f"layout must be 'tree' or 'flat', got {layout!r}")
 
     def init_fn(params):
+        if layout == "tree":
+            buf = jax.tree_util.tree_map(
+                lambda x: jnp.zeros(x.shape, jnp.float32), params)
+            return FusedSGDState(count=jnp.zeros((), jnp.int32),
+                                 momentum_buf=buf)
         n = sum(x.size for x in jax.tree_util.tree_leaves(params))
         return FusedSGDState(count=jnp.zeros((), jnp.int32),
                              momentum_buf=jnp.zeros((n,), jnp.float32))
@@ -45,9 +57,20 @@ def fused_sgd(learning_rate: ScalarOrSchedule, momentum: float = 0.0,
         if params is None:
             raise ValueError("fused_sgd requires params")
         count = state.count + 1
+        lr = _lr_at(learning_rate, count)
+        if layout == "tree":
+            p32 = jax.tree_util.tree_map(
+                lambda x: x.astype(jnp.float32), params)
+            new_p, new_buf = sgd_tree_step(
+                p32, state.momentum_buf, updates, lr=lr, momentum=momentum,
+                dampening=dampening, weight_decay=weight_decay,
+                nesterov=nesterov, wd_after_momentum=wd_after_momentum)
+            delta = jax.tree_util.tree_map(
+                lambda np_, pp, leaf: (np_ - pp).astype(leaf.dtype),
+                new_p, p32, params)
+            return delta, FusedSGDState(count=count, momentum_buf=new_buf)
         flat_p = _flat32(params)
         flat_g = _flat32(updates)
-        lr = _lr_at(learning_rate, count)
         new_p, new_buf = fused_sgd_step(
             flat_p, state.momentum_buf, flat_g, lr=lr, momentum=momentum,
             dampening=dampening, weight_decay=weight_decay, nesterov=nesterov,

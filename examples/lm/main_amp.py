@@ -82,6 +82,14 @@ def parse_args(argv=None):
                         "params; needs dp>1). Under --partitioning "
                         "gspmd the same sharding is ONE PartitionSpec "
                         "on the m/v superbuffers — XLA does the rest")
+    p.add_argument("--opt-layout", default="tree",
+                   choices=["tree", "flat"],
+                   help="fused_adam state layout: per-leaf 'tree' "
+                        "(default; XLA-fused update at the HBM roofline "
+                        "— BASELINE.md round-5 kernel tier) or the "
+                        "'flat' superbuffer (bitwise-identical; the "
+                        "layout ZeRO shards, forced automatically under "
+                        "gspmd --zero)")
     p.add_argument("--microbatches", type=int, default=None,
                    help="pipeline microbatches (default 2*pp)")
     p.add_argument("--partitioning", default="shard_map",
@@ -535,9 +543,12 @@ def build_parallel_lm(args, policy):
     else:
         # plain fused_adam — including gspmd --zero, where ZeRO-1 is a
         # sharding SPEC on the m/v superbuffers (_finish_gspmd), not a
-        # different optimizer
+        # different optimizer. That spec (P('data') on a 1-D buffer) is
+        # what forces layout="flat" there; every other path defaults to
+        # the per-leaf tree layout (round 5 — 4x less optimizer time).
+        layout = "flat" if (zero_on and gspmd) else args.opt_layout
         optimizer = fused_adam(args.lr, weight_decay=args.weight_decay,
-                               adam_w_mode=True)
+                               adam_w_mode=True, layout=layout)
         grad_avg_axis = "data" if dp > 1 else None
     # stage/col leaves are shard-local to pipe/model: their infs never ride
     # a grad psum, so found_inf must sync explicitly (make_train_step docs)

@@ -155,7 +155,19 @@ def test_gspmd_zero_is_one_partition_spec(lm, eight_devices):
     shard_elems = {s.data.size for s in m_buf.addressable_shards}
     assert shard_elems == {m_buf.size // 2}, \
         (m_buf.size, shard_elems)
-    # the unsharded run keeps m replicated (full size per device)
-    m_full = m_plain["final_state"].opt_state.m
-    assert {s.data.size for s in m_full.addressable_shards} == \
-        {m_full.size}
+    # the non-zero run uses the round-5 TREE layout, where each moment
+    # leaf inherits its parameter's spec through _finish_gspmd's path
+    # rules — TP-sharded weights get TP-sharded moments for free (a
+    # memory property the replicated flat buffer never had); 'data'
+    # stays out of the specs (that split is exactly what --zero adds)
+    import jax as _jax
+
+    p_leaves = _jax.tree_util.tree_leaves_with_path(
+        m_plain["final_state"].params)
+    m_leaves = _jax.tree_util.tree_leaves_with_path(
+        m_plain["final_state"].opt_state.m)
+    assert m_leaves and len(p_leaves) == len(m_leaves)
+    for (p_path, p_leaf), (m_path, m_leaf) in zip(p_leaves, m_leaves):
+        assert m_leaf.sharding.spec == p_leaf.sharding.spec, \
+            (m_path, m_leaf.sharding, p_leaf.sharding)
+        assert "data" not in tuple(m_leaf.sharding.spec), m_path

@@ -165,8 +165,12 @@ def test_zero_sharded_optimizer_trajectory_matches(lm, eight_devices):
     memory layout, not a numerics change. Asserted on the final param
     tree AND the first-moment superbuffers, de-interleaved shard-to-shard.
     """
+    # --opt-layout flat on the plain side: the superbuffer comparison
+    # below de-interleaves FLAT rank-local buffers (the tree default is
+    # bitwise-identical — tests/L0/test_fused_optimizers.py — but stores
+    # per-leaf state this shard arithmetic doesn't address)
     m_adam = _run(lm, ["--data-parallel", "2", "--tensor-parallel", "2",
-                       "--pipeline-parallel", "2"])
+                       "--pipeline-parallel", "2", "--opt-layout", "flat"])
     m_zero = _run(lm, ["--data-parallel", "2", "--tensor-parallel", "2",
                        "--pipeline-parallel", "2", "--zero"])
     np.testing.assert_allclose(float(m_zero["loss"]), float(m_adam["loss"]),
