@@ -100,6 +100,13 @@ def parse_args(argv=None):
                         "NamedShardings built from the TP modules' "
                         "kernel_partition_spec(); XLA's SPMD partitioner "
                         "inserts the collectives (dp x tp, + --zero)")
+    p.add_argument("--prof-device", type=int, default=0, metavar="N",
+                   help="after training, time N extra steps on the "
+                        "DEVICE lanes of a profiler capture and print "
+                        "device tokens/s (the apex recipes' --prof, on "
+                        "the round-5 device-time basis). Observation-"
+                        "only: runs on a copy of the state; prints n/a "
+                        "on backends with no device lanes")
     p.add_argument("--save", default=None, metavar="CKPT",
                    help="write the final train state (params, masters, "
                         "optimizer state incl. ZeRO shards, scaler) plus "
@@ -867,6 +874,7 @@ def run_parallel(args, policy):
         dt = time.perf_counter() - t0
         print(f"throughput: "
               f"{(toks - args.batch_size * args.seq_len) / dt:,.0f} tokens/s")
+    _maybe_prof_device(args, jit_step, state, batch)
     _maybe_save(args, state, rng)
     metrics = dict(metrics)
     metrics["final_state"] = state
@@ -886,6 +894,46 @@ def _maybe_resume(args, state, rng):
     return resume_train_checkpoint(args.resume, state, rng,
                                    step_limit=args.iters,
                                    limit_flag="--iters")
+
+
+def _maybe_prof_device(args, jit_step, state, batch):
+    """--prof-device N: time N extra steps on the profiler's DEVICE lanes
+    and print device tokens/s — the apex recipes' --prof role on the
+    round-5 device-time basis (host wall clock through the remote tunnel
+    times dispatch, not silicon).
+
+    Observation-only: the profiled steps run on a COPY of the train
+    state (jit_step donates its input buffers, so stepping the real
+    state would both advance it past args.iters and invalidate the
+    buffers a later --save / final_state consumer reads), and any
+    profiling failure degrades to an 'n/a' line — a capture nicety must
+    never cost the run its checkpoint."""
+    n = args.prof_device
+    if n <= 0:
+        if n < 0:
+            print(f"device throughput: n/a (--prof-device {n} ignored)")
+        return
+    import tempfile
+
+    from apex_tpu import pyprof
+
+    prof_state = jax.tree_util.tree_map(jnp.copy, state)
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            with pyprof.trace(td):
+                for _ in range(n):
+                    prof_state, metrics = jit_step(prof_state, batch)
+                metrics["loss"].block_until_ready()
+            d = pyprof.device_busy(td)
+    except FileNotFoundError:   # profiling disabled / no dump written
+        d = {"span_ms": 0.0, "busy_ms": 0.0}
+    if d["span_ms"] > 0:
+        tok_s = n * args.batch_size * args.seq_len / (d["span_ms"] / 1e3)
+        print(f"device throughput: {tok_s:,.0f} tokens/s "
+              f"({d['span_ms'] / n:.2f} ms/step, duty "
+              f"{d['busy_ms'] / d['span_ms']:.2f})")
+    else:
+        print("device throughput: n/a (no device lanes on this backend)")
 
 
 def _maybe_save(args, state, rng):
@@ -969,6 +1017,7 @@ def main(argv=None):
               f"{(toks - args.batch_size * args.seq_len) / dt:,.0f} tokens/s")
     if metrics is None:
         return None
+    _maybe_prof_device(args, jit_step, state, batch)
     _maybe_save(args, state, rng)
     metrics = dict(metrics)
     metrics["final_state"] = state
