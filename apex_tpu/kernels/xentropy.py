@@ -129,7 +129,11 @@ def _xent_fwd(logits, labels, smoothing, interpret):
 def _xent_bwd(smoothing, interpret, res, g):
     logits, labels, mlse = res
     n, v = logits.shape
-    br = _block_rows(n, v, n_bufs=8)
+    # 8 buffers only when the residual actually IS fp32 (4*v-byte rows);
+    # half-precision callers keep the full tuned block — their 2*v-byte
+    # residual fits the fwd accounting (bench-verified at 32 rows bf16)
+    br = _block_rows(n, v,
+                     n_bufs=8 if logits.dtype == jnp.float32 else 4)
     kernel = functools.partial(_bwd_kernel, smoothing=smoothing)
     dlogits = pl.pallas_call(
         kernel,

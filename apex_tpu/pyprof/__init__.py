@@ -32,7 +32,7 @@ import jax
 import numpy as np
 
 __all__ = ["init", "annotate", "trace", "cost_report", "analyze", "report",
-           "device_busy", "StepTimer"]
+           "device_busy", "step_device_throughput", "StepTimer"]
 
 _enabled = True
 
@@ -304,6 +304,46 @@ def report(rows: List[Dict[str, Any]]) -> str:
             f"{r['flops'] / 1e9:>10.3f} {r['bytes'] / 1e6:>10.3f} "
             f"{r['intensity']:>8.2f}")
     return "\n".join(lines)
+
+
+def step_device_throughput(step_fn, state, batch, n, items_per_step):
+    """Time ``n`` steps of a ``(state, batch) -> (state, metrics)`` train
+    step on the profiler's DEVICE lanes and return a reading, or ``None``
+    when no reading is possible — the recipes' ``--prof-device`` flag
+    (the apex recipes' --prof role on device time).
+
+    Observation-only by contract: the steps run on a deep COPY of
+    ``state`` (donated input buffers would otherwise be invalidated under
+    the caller's feet and the real state silently advanced past its step
+    count), and EVERY failure — profiler already active, corrupt dump,
+    a crash inside the profiled step — degrades to ``None`` rather than
+    raising, so a timing nicety can never cost the caller its checkpoint.
+
+    Returns ``{"items_per_s", "ms_per_step", "duty"}``.
+    """
+    if n <= 0:
+        return None
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    try:
+        prof_state = jax.tree_util.tree_map(jnp.copy, state)
+        with tempfile.TemporaryDirectory() as td:
+            with trace(td):
+                metrics = None
+                for _ in range(n):
+                    prof_state, metrics = step_fn(prof_state, batch)
+                jax.block_until_ready(metrics)
+            d = device_busy(td)
+    except Exception:  # noqa: BLE001 — observation-only, see docstring
+        return None
+    if d["span_ms"] <= 0:
+        return None
+    return {"items_per_s": n * items_per_step / (d["span_ms"] / 1e3),
+            "ms_per_step": d["span_ms"] / n,
+            "duty": d["busy_ms"] / d["span_ms"]}
 
 
 class StepTimer:

@@ -55,6 +55,11 @@ def parse_args(argv=None):
     p.add_argument("--learning_rate", type=float, default=6e-3)
     p.add_argument("--warmup_proportion", type=float, default=0.2843)
     p.add_argument("--max_steps", type=int, default=30)
+    p.add_argument("--prof-device", type=int, default=0, metavar="N",
+                   help="after training, time N extra steps on the "
+                        "profiler's DEVICE lanes and print device "
+                        "sequences/s (observation-only — runs on a copy "
+                        "of the state; n/a without device lanes)")
     p.add_argument("--opt-level", default="O2")
     p.add_argument("--loss-scale", default="dynamic")
     p.add_argument("--seed", type=int, default=42)
@@ -351,6 +356,21 @@ def main(argv=None):
               f"{(seqs - args.train_batch_size) / dt:,.1f} sequences/s")
     if metrics is None:
         return None
+    if args.prof_device:
+        # device-lane timing via the shared observation-only helper
+        # (copied state, never raises — pyprof.step_device_throughput)
+        from apex_tpu import pyprof
+
+        r = pyprof.step_device_throughput(
+            jit_step, state, batch, args.prof_device,
+            args.train_batch_size)
+        if r is None:
+            print("device throughput: n/a (no device lanes, or "
+                  "profiling unavailable)")
+        else:
+            print(f"device throughput: {r['items_per_s']:,.1f} "
+                  f"sequences/s ({r['ms_per_step']:.1f} ms/step, duty "
+                  f"{r['duty']:.2f})")
     if args.save:
         from apex_tpu.utils.checkpoint import save_train_checkpoint
         save_train_checkpoint(args.save, state, args.max_steps, rng)

@@ -897,43 +897,22 @@ def _maybe_resume(args, state, rng):
 
 
 def _maybe_prof_device(args, jit_step, state, batch):
-    """--prof-device N: time N extra steps on the profiler's DEVICE lanes
-    and print device tokens/s — the apex recipes' --prof role on the
-    round-5 device-time basis (host wall clock through the remote tunnel
-    times dispatch, not silicon).
-
-    Observation-only: the profiled steps run on a COPY of the train
-    state (jit_step donates its input buffers, so stepping the real
-    state would both advance it past args.iters and invalidate the
-    buffers a later --save / final_state consumer reads), and any
-    profiling failure degrades to an 'n/a' line — a capture nicety must
-    never cost the run its checkpoint."""
-    n = args.prof_device
-    if n <= 0:
-        if n < 0:
-            print(f"device throughput: n/a (--prof-device {n} ignored)")
+    """--prof-device N: print device tokens/s for N extra steps via
+    pyprof.step_device_throughput (observation-only — copied state,
+    never raises; see that helper's docstring)."""
+    if not args.prof_device:
         return
-    import tempfile
-
     from apex_tpu import pyprof
 
-    prof_state = jax.tree_util.tree_map(jnp.copy, state)
-    try:
-        with tempfile.TemporaryDirectory() as td:
-            with pyprof.trace(td):
-                for _ in range(n):
-                    prof_state, metrics = jit_step(prof_state, batch)
-                metrics["loss"].block_until_ready()
-            d = pyprof.device_busy(td)
-    except FileNotFoundError:   # profiling disabled / no dump written
-        d = {"span_ms": 0.0, "busy_ms": 0.0}
-    if d["span_ms"] > 0:
-        tok_s = n * args.batch_size * args.seq_len / (d["span_ms"] / 1e3)
-        print(f"device throughput: {tok_s:,.0f} tokens/s "
-              f"({d['span_ms'] / n:.2f} ms/step, duty "
-              f"{d['busy_ms'] / d['span_ms']:.2f})")
+    r = pyprof.step_device_throughput(
+        jit_step, state, batch, args.prof_device,
+        args.batch_size * args.seq_len)
+    if r is None:
+        print("device throughput: n/a (no device lanes, or profiling "
+              "unavailable)")
     else:
-        print("device throughput: n/a (no device lanes on this backend)")
+        print(f"device throughput: {r['items_per_s']:,.0f} tokens/s "
+              f"({r['ms_per_step']:.2f} ms/step, duty {r['duty']:.2f})")
 
 
 def _maybe_save(args, state, rng):
