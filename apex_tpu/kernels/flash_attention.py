@@ -428,6 +428,23 @@ def _resolve_blocks(block_q, block_k):
     return block_q, block_k
 
 
+def _resolve_bwd_blocks(bq, bk, sq, sk, dropout_rate):
+    """Backward-specific tuned blocks (``flash.bwd_block_q``/``_k``),
+    defaulting to the forward's resolved values.
+
+    Only consulted when dropout is OFF: the dropout keep-mask is seeded
+    per (bh, q-block, k-block) FORWARD block, so a backward running on a
+    different geometry could not replay it. The bwd kernels' working set
+    differs from the forward's (dk/dv accumulators + dlog tiles), so its
+    optimum need not match — measured on v5e the bwd prefers a smaller
+    k-block than the forward's 1024 (BASELINE.md round-5 kernel tier)."""
+    if dropout_rate > 0.0:
+        return bq, bk
+    bq2 = vmem.get_override("flash.bwd_block_q", bq, multiple=8)
+    bk2 = vmem.get_override("flash.bwd_block_k", bk, multiple=128)
+    return _fit_block(bq2, sq, 8), _fit_block(bk2, sk, 128)
+
+
 def _fit_block(b, s, multiple):
     """Shrink a (possibly tuned) block until it divides the sequence,
     keeping the tile alignment. A big tuned block (e.g. block_k=1024 from
@@ -797,8 +814,13 @@ def attn_chunk_bwd(q3, k3, v3, do3, lse, delta, *, scale, causal,
     if dropout_rate > 0.0 and dropout_seed is None:
         raise ValueError("dropout_rate > 0 requires dropout_seed")
     sq, sk, d = q3.shape[1], k3.shape[1], q3.shape[2]
+    blocks_explicit = block_q is not None or block_k is not None
     block_q, block_k = _resolve_blocks(block_q, block_k)
     bq, bk = _fit_block(block_q, sq, 8), _fit_block(block_k, sk, 128)
+    if not blocks_explicit:
+        # explicit caller blocks win; only tuned/default geometry may
+        # take the backward-specific knobs
+        bq, bk = _resolve_bwd_blocks(bq, bk, sq, sk, dropout_rate)
     if jax.default_backend() == "cpu":
         interpret = True
     if not _pallas_ok(sq, sk, d, bq, bk) or (interpret and _has_vma(q3)) \
@@ -821,16 +843,19 @@ def attn_chunk_bwd(q3, k3, v3, do3, lse, delta, *, scale, causal,
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11))
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(6, 7, 8, 9, 10, 11, 12))
 def _flash(q, k, v, bias, segment_ids, dropout_seed, causal, scale, block_q,
-           block_k, interpret, dropout_rate):
+           block_k, interpret, dropout_rate, blocks_explicit):
     out, _ = _flash_fwd(q, k, v, bias, segment_ids, dropout_seed, causal,
-                        scale, block_q, block_k, interpret, dropout_rate)
+                        scale, block_q, block_k, interpret, dropout_rate,
+                        blocks_explicit)
     return out
 
 
 def _flash_fwd(q, k, v, bias, segment_ids, dropout_seed, causal, scale,
-               block_q, block_k, interpret, dropout_rate):
+               block_q, block_k, interpret, dropout_rate,
+               blocks_explicit=False):
     b, h, sq, d = q.shape
     q3, k3, v3 = _flatten(q), _flatten(k), _flatten(v)
     segq = segk = None
@@ -846,10 +871,15 @@ def _flash_fwd(q, k, v, bias, segment_ids, dropout_seed, causal, scale,
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, dropout_rate,
-               res, g):
+               blocks_explicit, res, g):
     q3, k3, v3, o3, lse, segq, segk, bias, dropout_seed, b, h = res
     do3 = _flatten(g)
     bh, sq = q3.shape[0], q3.shape[1]
+    if not blocks_explicit:
+        # explicit caller blocks win for BOTH passes; only tuned/default
+        # geometry may take the backward-specific knobs
+        block_q, block_k = _resolve_bwd_blocks(block_q, block_k, sq,
+                                               k3.shape[1], dropout_rate)
     delta = jnp.sum(jnp.asarray(do3, jnp.float32) *
                     jnp.asarray(o3, jnp.float32), axis=-1,
                     keepdims=True).reshape(bh, 1, sq)
@@ -933,4 +963,5 @@ def flash_attention(q, k, v, *, causal: bool = False,
                              dropout_rate=dropout_rate,
                              dropout_seed=dropout_seed)
     return _flash(q, k, v, bias, segment_ids, dropout_seed, causal, scale,
-                  bq, bk, interpret, dropout_rate)
+                  bq, bk, interpret, dropout_rate,
+                  block_q is not None or block_k is not None)

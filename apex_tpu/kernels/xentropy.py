@@ -90,14 +90,13 @@ def _xent(logits, labels, smoothing, interpret):
 def _block_rows(n, v, n_bufs=4):
     # fp32 logits block + ~3 same-size temporaries (exp, iota/onehot,
     # output); shared scoped-VMEM budget lives in kernels/vmem.py.
-    # The BACKWARD passes n_bufs=8: its logits residual arrives in the
-    # caller's dtype — fp32 when the recipe computes the loss in fp32 —
-    # which doubles the real row bytes, and it writes a same-width dlogits
-    # block on top. The round-5 LM run caught the shared n_bufs=4 estimate
-    # overflowing Mosaic's 16MB scoped-VMEM stack (21MB at the tuned
-    # 32-row block, [8192, 32768] fp32) on exactly that path; halving the
-    # bwd block keeps the tuned fwd block at full speed while the bwd
-    # fits at any input dtype.
+    # The BACKWARD passes n_bufs=8 ONLY for fp32 residuals: its logits
+    # residual arrives in the caller's dtype, and at fp32 the 4*v-byte
+    # rows plus the same-width dlogits block overflowed Mosaic's 16MB
+    # scoped-VMEM stack (21MB at the tuned 32-row block, [8192, 32768]
+    # fp32 — caught by the round-5 LM run). Half-precision callers keep
+    # the fwd accounting: their 2*v-byte residual fits the full tuned
+    # block (bench-verified at 32 rows bf16).
     return vmem.block_rows(n, row_bytes=4 * v, n_bufs=n_bufs, max_rows=128,
                            divisor_of=n, key="xentropy.block_rows")
 

@@ -369,12 +369,31 @@ def sweep(out_path="tuned_blocks.json"):
         return timeit(lambda q, k, v: flash_attention(q, k, v, causal=True),
                       q, k, v)
 
+    def flash_bwd_ms():
+        def bwd(q, k, v):
+            return jax.grad(
+                lambda q, k, v: jnp.sum(
+                    flash_attention(q, k, v, causal=True)
+                    .astype(jnp.float32)), argnums=(0, 1, 2))(q, k, v)
+        return timeit(bwd, q, k, v)
+
     _sweep_knob(results, "flash.block_q", (64, 128, 256, 512), flash_ms)
     if "flash.block_q" in results:
         vmem.set_override("flash.block_q", results["flash.block_q"])
     # block_k is lane-aligned to 128 (values below clamp up — see
     # flash_attention._resolve_blocks), so 64 would duplicate 128
     _sweep_knob(results, "flash.block_k", (128, 256, 512, 1024), flash_ms)
+    # backward-specific blocks (flash.bwd_block_q/_k; consulted only when
+    # dropout is off — the fwd mask seeds can't replay on another
+    # geometry), swept with the fwd bests pinned
+    for k_, v_ in results.items():
+        vmem.set_override(k_, v_)
+    _sweep_knob(results, "flash.bwd_block_q", (64, 128, 256, 512),
+                flash_bwd_ms)
+    if "flash.bwd_block_q" in results:
+        vmem.set_override("flash.bwd_block_q", results["flash.bwd_block_q"])
+    _sweep_knob(results, "flash.bwd_block_k", (128, 256, 512, 1024),
+                flash_bwd_ms)
     vmem.clear_overrides()
 
     # layer norm row block

@@ -356,7 +356,10 @@ def main(argv=None):
               f"{(seqs - args.train_batch_size) / dt:,.1f} sequences/s")
     if metrics is None:
         return None
-    if args.prof_device:
+    if args.prof_device < 0:
+        print(f"device throughput: n/a (--prof-device {args.prof_device} "
+              "ignored)")
+    elif args.prof_device:
         # device-lane timing via the shared observation-only helper
         # (copied state, never raises — pyprof.step_device_throughput)
         from apex_tpu import pyprof

@@ -900,6 +900,10 @@ def _maybe_prof_device(args, jit_step, state, batch):
     """--prof-device N: print device tokens/s for N extra steps via
     pyprof.step_device_throughput (observation-only — copied state,
     never raises; see that helper's docstring)."""
+    if args.prof_device < 0:
+        print(f"device throughput: n/a (--prof-device {args.prof_device} "
+              "ignored)")
+        return
     if not args.prof_device:
         return
     from apex_tpu import pyprof

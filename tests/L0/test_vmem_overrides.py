@@ -192,6 +192,57 @@ def test_flash_fit_block_shrinks_to_divide():
                       _fit_block(256, 1536, 8), _fit_block(1024, 1536, 128))
 
 
+def test_flash_bwd_blocks_resolve_and_respect_dropout():
+    """flash.bwd_block_q/_k give the backward its own geometry — except
+    under dropout, where the keep-mask is seeded per FORWARD block and a
+    different bwd geometry could not replay it."""
+    from apex_tpu.kernels.flash_attention import (_resolve_bwd_blocks,
+                                                  flash_attention,
+                                                  mha_reference)
+
+    vmem.set_override("flash.bwd_block_q", 512)
+    vmem.set_override("flash.bwd_block_k", 512)
+    try:
+        assert _resolve_bwd_blocks(256, 1024, 2048, 2048, 0.0) == (512, 512)
+        # dropout ON: forward geometry wins, knobs ignored
+        assert _resolve_bwd_blocks(256, 1024, 2048, 2048, 0.3) == (256, 1024)
+        # and the knobs still fit-to-divide at short sequences
+        assert _resolve_bwd_blocks(256, 1024, 384, 384, 0.0) == (384, 384)
+
+        # EXPLICIT caller blocks win for both passes: grad of a call
+        # pinning block_q/block_k must not consult the bwd knobs (the
+        # custom_vjp threads blocks_explicit through; asserted here via
+        # numerics at a geometry the knobs would reject — bwd knob 512
+        # doesn't divide sq=384, explicit 128 does)
+        ks2 = jax.random.split(jax.random.PRNGKey(12), 3)
+        q2, k2, v2 = (jax.random.normal(kk, (1, 1, 384, 128)) for kk in ks2)
+        g2 = jax.grad(lambda q: jnp.sum(
+            flash_attention(q, k2, v2, causal=True, block_q=128,
+                            block_k=128).astype(jnp.float32)))(q2)
+        assert g2.shape == q2.shape
+
+        # numerics under distinct fwd/bwd geometry stay exact
+        ks = jax.random.split(jax.random.PRNGKey(11), 3)
+        q, k, v = (jax.random.normal(kk, (1, 2, 512, 128)) for kk in ks)
+
+        def loss_k(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=True)
+                           .astype(jnp.float32))
+
+        def loss_r(q, k, v):
+            return jnp.sum(mha_reference(q, k, v, causal=True,
+                                         scale=128 ** -0.5)
+                           .astype(jnp.float32))
+
+        gk = jax.grad(loss_k, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gk, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-4)
+    finally:
+        vmem.clear_overrides()
+
+
 def test_flash_oversized_tuned_block_stays_correct():
     """Numerics with the checked-in v5e tuned blocks at a sequence
     (1536) the tuned block_k=1024 does not divide."""
