@@ -949,6 +949,10 @@ def flash_attention(q, k, v, *, causal: bool = False,
     # validated on EVERY path: the jnp fallback must reject exactly what the
     # Pallas path rejects, or aligned shapes would crash where unaligned ran
     _validate_bias(bias, q.shape[0], q.shape[1], sq, sk)
+    # explicitness MUST be read before _resolve_blocks overwrites the
+    # Nones — computed after, the flag is always True and the bwd knobs
+    # are dead (caught by code review + the gating test)
+    blocks_explicit = block_q is not None or block_k is not None
     block_q, block_k = _resolve_blocks(block_q, block_k)
     bq = _fit_block(block_q, sq, 8)
     bk = _fit_block(block_k, sk, 128)
@@ -963,5 +967,4 @@ def flash_attention(q, k, v, *, causal: bool = False,
                              dropout_rate=dropout_rate,
                              dropout_seed=dropout_seed)
     return _flash(q, k, v, bias, segment_ids, dropout_seed, causal, scale,
-                  bq, bk, interpret, dropout_rate,
-                  block_q is not None or block_k is not None)
+                  bq, bk, interpret, dropout_rate, blocks_explicit)

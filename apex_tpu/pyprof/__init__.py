@@ -32,7 +32,8 @@ import jax
 import numpy as np
 
 __all__ = ["init", "annotate", "trace", "cost_report", "analyze", "report",
-           "device_busy", "step_device_throughput", "StepTimer"]
+           "device_busy", "step_device_throughput",
+           "device_throughput_line", "StepTimer"]
 
 _enabled = True
 
@@ -344,6 +345,25 @@ def step_device_throughput(step_fn, state, batch, n, items_per_step):
     return {"items_per_s": n * items_per_step / (d["span_ms"] / 1e3),
             "ms_per_step": d["span_ms"] / n,
             "duty": d["busy_ms"] / d["span_ms"]}
+
+
+def device_throughput_line(step_fn, state, batch, n, items_per_step,
+                           unit):
+    """The recipes' shared ``--prof-device`` rendering: one formatted
+    line for the reading of :func:`step_device_throughput`, ``None``
+    when the flag is off (``n == 0`` — print nothing). Negative ``n``
+    gets its own diagnostic so a typo isn't misread as a backend
+    problem. Never raises (same contract as the underlying helper)."""
+    if n == 0:
+        return None
+    if n < 0:
+        return f"device throughput: n/a (--prof-device {n} ignored)"
+    r = step_device_throughput(step_fn, state, batch, n, items_per_step)
+    if r is None:
+        return ("device throughput: n/a (no device lanes, or profiling "
+                "unavailable)")
+    return (f"device throughput: {r['items_per_s']:,.1f} {unit} "
+            f"({r['ms_per_step']:.2f} ms/step, duty {r['duty']:.2f})")
 
 
 class StepTimer:

@@ -442,6 +442,15 @@ def sweep(out_path="tuned_blocks.json"):
                 lambda: timeit(
                     functools.partial(causal_softmax, scale=0.125), xs))
 
+    # masked softmax q block (v5e: 128->256 closed its gap to XLA parity)
+    from apex_tpu.kernels.masked_softmax import masked_softmax
+    xm = jax.random.normal(jax.random.PRNGKey(6), (4, 8, 1024, 1024),
+                           jnp.bfloat16)
+    mm = jax.random.bernoulli(jax.random.PRNGKey(7), 0.9, (4, 1, 1024, 1024))
+    _sweep_knob(results, "masked_softmax.block_q", (32, 64, 128, 256, 512),
+                lambda: timeit(
+                    functools.partial(masked_softmax, scale=0.125), xm, mm))
+
     with open(out_path, "w") as f:
         json.dump(results, f, indent=1, sort_keys=True)
     print(json.dumps({"sweep_best": results, "written": out_path}),

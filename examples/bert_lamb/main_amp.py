@@ -356,24 +356,15 @@ def main(argv=None):
               f"{(seqs - args.train_batch_size) / dt:,.1f} sequences/s")
     if metrics is None:
         return None
-    if args.prof_device < 0:
-        print(f"device throughput: n/a (--prof-device {args.prof_device} "
-              "ignored)")
-    elif args.prof_device:
-        # device-lane timing via the shared observation-only helper
-        # (copied state, never raises — pyprof.step_device_throughput)
+    if args.prof_device:
+        # shared observation-only rendering (copied state, never raises)
         from apex_tpu import pyprof
 
-        r = pyprof.step_device_throughput(
+        line = pyprof.device_throughput_line(
             jit_step, state, batch, args.prof_device,
-            args.train_batch_size)
-        if r is None:
-            print("device throughput: n/a (no device lanes, or "
-                  "profiling unavailable)")
-        else:
-            print(f"device throughput: {r['items_per_s']:,.1f} "
-                  f"sequences/s ({r['ms_per_step']:.1f} ms/step, duty "
-                  f"{r['duty']:.2f})")
+            args.train_batch_size, "sequences/s")
+        if line:
+            print(line)
     if args.save:
         from apex_tpu.utils.checkpoint import save_train_checkpoint
         save_train_checkpoint(args.save, state, args.max_steps, rng)

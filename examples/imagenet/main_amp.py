@@ -62,6 +62,11 @@ def parse_args(argv=None):
     p.add_argument("--loss-scale", default=None)
     p.add_argument("--keep-batchnorm-fp32", default=None)
     p.add_argument("--sync_bn", action="store_true")
+    p.add_argument("--prof-device", type=int, default=0, metavar="N",
+                   help="after training, time N extra steps on the "
+                        "profiler's DEVICE lanes and print device img/s "
+                        "(observation-only — runs on a copy of the "
+                        "state; n/a without device lanes)")
     p.add_argument("--prof", type=int, default=0)
     p.add_argument("--deterministic", action="store_true")
     p.add_argument("--resume", default=None,
@@ -378,6 +383,7 @@ def main(argv=None):
             return iter(_val)
 
     best_prec1 = 0.0
+    last_batch = None          # for --prof-device after the loops
     for epoch in range(start_epoch, args.epochs):
         t0 = None
         imgs = 0
@@ -406,6 +412,7 @@ def main(argv=None):
                     batch = jax.device_put(batch, batch_sharding)
             if args.prof and it == 5:
                 jax.profiler.start_trace("/tmp/apex_tpu_trace")
+            last_batch = batch
             state, metrics = jit_step(state, batch)
             if args.prof and it == 5 + args.prof:
                 metrics["loss"].block_until_ready()
@@ -436,6 +443,20 @@ def main(argv=None):
             print(f"=> saved {path}")
     if ckpt is not None:
         ckpt.wait()
+    if args.prof_device:
+        # shared observation-only rendering (copied state, never raises).
+        # A zero-iteration run (--epochs 0, or a resume already at the
+        # epoch limit) never bound a batch — report n/a, don't crash.
+        from apex_tpu import pyprof
+
+        if last_batch is None:
+            print("device throughput: n/a (no training step ran)")
+        else:
+            line = pyprof.device_throughput_line(
+                jit_step, state, last_batch, args.prof_device,
+                args.batch_size, "img/s")
+            if line:
+                print(line)
     print(f"=> best Prec@1 {best_prec1:.3f}")
     return state
 

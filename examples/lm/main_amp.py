@@ -898,25 +898,15 @@ def _maybe_resume(args, state, rng):
 
 def _maybe_prof_device(args, jit_step, state, batch):
     """--prof-device N: print device tokens/s for N extra steps via
-    pyprof.step_device_throughput (observation-only — copied state,
-    never raises; see that helper's docstring)."""
-    if args.prof_device < 0:
-        print(f"device throughput: n/a (--prof-device {args.prof_device} "
-              "ignored)")
-        return
-    if not args.prof_device:
-        return
+    pyprof.device_throughput_line (observation-only — copied state,
+    never raises; see pyprof.step_device_throughput's docstring)."""
     from apex_tpu import pyprof
 
-    r = pyprof.step_device_throughput(
+    line = pyprof.device_throughput_line(
         jit_step, state, batch, args.prof_device,
-        args.batch_size * args.seq_len)
-    if r is None:
-        print("device throughput: n/a (no device lanes, or profiling "
-              "unavailable)")
-    else:
-        print(f"device throughput: {r['items_per_s']:,.0f} tokens/s "
-              f"({r['ms_per_step']:.2f} ms/step, duty {r['duty']:.2f})")
+        args.batch_size * args.seq_len, "tokens/s")
+    if line:
+        print(line)
 
 
 def _maybe_save(args, state, rng):

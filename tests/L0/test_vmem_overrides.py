@@ -209,17 +209,39 @@ def test_flash_bwd_blocks_resolve_and_respect_dropout():
         # and the knobs still fit-to-divide at short sequences
         assert _resolve_bwd_blocks(256, 1024, 384, 384, 0.0) == (384, 384)
 
-        # EXPLICIT caller blocks win for both passes: grad of a call
-        # pinning block_q/block_k must not consult the bwd knobs (the
-        # custom_vjp threads blocks_explicit through; asserted here via
-        # numerics at a geometry the knobs would reject — bwd knob 512
-        # doesn't divide sq=384, explicit 128 does)
-        ks2 = jax.random.split(jax.random.PRNGKey(12), 3)
-        q2, k2, v2 = (jax.random.normal(kk, (1, 1, 384, 128)) for kk in ks2)
-        g2 = jax.grad(lambda q: jnp.sum(
-            flash_attention(q, k2, v2, causal=True, block_q=128,
-                            block_k=128).astype(jnp.float32)))(q2)
-        assert g2.shape == q2.shape
+        # EXPLICIT caller blocks win for both passes: the custom_vjp
+        # threads blocks_explicit through, and the backward consults
+        # _resolve_bwd_blocks ONLY when the caller left geometry unset.
+        # Numerics are block-invariant, so observe the gating directly
+        # by instrumenting the resolver (this polarity was once shipped
+        # inverted — blocks_explicit computed AFTER _resolve_blocks
+        # overwrote the Nones — and only this style of test can see it).
+        import apex_tpu.kernels.flash_attention as fa
+
+        calls = []
+        orig = fa._resolve_bwd_blocks
+
+        def spy(bq, bk, sq, sk, rate):
+            calls.append((bq, bk))
+            return orig(bq, bk, sq, sk, rate)
+
+        fa._resolve_bwd_blocks = spy
+        try:
+            ks2 = jax.random.split(jax.random.PRNGKey(12), 3)
+            q2, k2, v2 = (jax.random.normal(kk, (1, 1, 512, 128))
+                          for kk in ks2)
+
+            def gsum(q, **kw):
+                return jax.grad(lambda q: jnp.sum(
+                    flash_attention(q, k2, v2, causal=True, **kw)
+                    .astype(jnp.float32)))(q)
+
+            gsum(q2, block_q=128, block_k=128)
+            assert calls == [], "explicit blocks must skip the bwd knobs"
+            gsum(q2)
+            assert calls, "default geometry must consult the bwd knobs"
+        finally:
+            fa._resolve_bwd_blocks = orig
 
         # numerics under distinct fwd/bwd geometry stay exact
         ks = jax.random.split(jax.random.PRNGKey(11), 3)
