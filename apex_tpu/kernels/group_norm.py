@@ -143,9 +143,13 @@ def _bwd_dx_kernel(dy_ref, x_ref, mean_ref, rstd_ref, g_ref, b_ref,
 
 
 # ------------------------------------------------------------------ plumbing
-def _block_spatial(srows, c, nbufs):
+def _block_spatial(srows, c, nbufs, key="group_norm.block_spatial"):
+    # fwd and bwd carry separate tuned keys: on v5e the forward wants the
+    # largest block that fits (fewer grid steps over the Welford state)
+    # while the backward — five live buffers and two reduction outputs —
+    # prefers a small one (swept readings in BASELINE.md round-5 tier)
     return vmem.block_rows(srows, row_bytes=4 * c, n_bufs=nbufs,
-                           max_rows=256)
+                           max_rows=256, key=key)
 
 
 def _pad_s(x3, sp):
@@ -228,7 +232,7 @@ def _gn_fwd(x3, gamma, beta, groups, eps, act, interpret):
 def _gn_bwd(groups, eps, act, interpret, res, dy):
     x3, gamma, beta, mean_c, rstd_c = res
     n, s, c = x3.shape
-    bs = _block_spatial(s, c, 5)
+    bs = _block_spatial(s, c, 5, key="group_norm.bwd_block_spatial")
     sp = ((s + bs - 1) // bs) * bs
     xp, dyp = _pad_s(x3, sp), _pad_s(dy, sp)
     grid = (n, sp // bs)

@@ -451,6 +451,27 @@ def sweep(out_path="tuned_blocks.json"):
                 lambda: timeit(
                     functools.partial(masked_softmax, scale=0.125), xm, mm))
 
+    # group norm spatial blocks — fwd and bwd separately (on v5e they
+    # want opposite extremes: fwd 1024, bwd 128)
+    from apex_tpu.kernels.group_norm import group_norm_nhwc
+    xg = jax.random.normal(jax.random.PRNGKey(8), (8, 64, 64, 512),
+                           jnp.bfloat16)
+    gg, gb = jnp.ones((512,)), jnp.zeros((512,))
+    _sweep_knob(results, "group_norm.block_spatial",
+                (128, 256, 512, 1024, 2048),
+                lambda: timeit(lambda x: group_norm_nhwc(
+                    x, 32, gg, gb, act="silu"), xg))
+
+    def gn_bwd_ms():
+        def bwd(x, g_, b_):
+            return jax.grad(lambda x, g_, b_: jnp.sum(
+                group_norm_nhwc(x, 32, g_, b_, act="silu")
+                .astype(jnp.float32)), argnums=(0, 1, 2))(x, g_, b_)
+        return timeit(bwd, xg, gg, gb)
+
+    _sweep_knob(results, "group_norm.bwd_block_spatial",
+                (64, 128, 256, 512), gn_bwd_ms)
+
     with open(out_path, "w") as f:
         json.dump(results, f, indent=1, sort_keys=True)
     print(json.dumps({"sweep_best": results, "written": out_path}),
