@@ -267,6 +267,34 @@ def test_step_device_throughput_observation_only():
     assert step_device_throughput(exploding, state, batch, 2, 4) is None
 
 
+def test_device_throughput_line_rendering():
+    """pyprof.device_throughput_line — the recipes' shared --prof-device
+    rendering: None when off, its own diagnostic for negative N, the n/a
+    line when no reading is possible, a formatted reading otherwise."""
+    from apex_tpu.pyprof import device_throughput_line
+
+    @jax.jit
+    def step(state, batch):
+        return jax.tree_util.tree_map(lambda x: x + batch.sum(), state), {}
+
+    state = {"w": jnp.ones((64,))}
+    batch = jnp.ones((4,))
+    assert device_throughput_line(step, state, batch, 0, 4, "u/s") is None
+    line = device_throughput_line(step, state, batch, -2, 4, "u/s")
+    assert line == "device throughput: n/a (--prof-device -2 ignored)"
+
+    def exploding(state, batch):
+        raise RuntimeError("boom")
+
+    line = device_throughput_line(exploding, state, batch, 2, 4, "u/s")
+    assert line.startswith("device throughput: n/a")
+
+    line = device_throughput_line(step, state, batch, 2, 4, "u/s")
+    assert line.startswith("device throughput: ")
+    if "n/a" not in line:        # CPU dumps usually carry device lanes
+        assert "u/s" in line and "ms/step" in line and "duty" in line
+
+
 def test_leaf_spans_drop_enclosing_parents():
     """Degraded-mode aggregation (no cost-annotated device ops) must not
     double-count: a span enclosing another on the same lane is a parent
