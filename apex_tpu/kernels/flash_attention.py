@@ -446,15 +446,21 @@ def _resolve_bwd_blocks(bq, bk, sq, sk, dropout_rate):
 
 
 def _fit_block(b, s, multiple):
-    """Shrink a (possibly tuned) block until it divides the sequence,
-    keeping the tile alignment. A big tuned block (e.g. block_k=1024 from
+    """Shrink a (possibly tuned) block to the LARGEST aligned divisor of
+    the sequence that is <= b. A big tuned block (e.g. block_q=1024 from
     the v5e sweep) must degrade to a smaller Pallas block at shapes it
     doesn't divide — never drop the call to the quadratic-memory
-    fallback, which is what _pallas_ok would otherwise do."""
+    fallback, which is what _pallas_ok would otherwise do.
+
+    Divisor scan, not repeated halving: halving a non-divisor like 768
+    at s=1024 bottoms out at 8 (every halving step misses 512), and
+    near-degenerate blocks are both slow and fragile in Mosaic; the
+    scan finds 512. Trace-time only, <= b/multiple iterations."""
     b = min(b, s)
+    b -= b % multiple
     while b > multiple and s % b:
-        b //= 2
-    return max(multiple, (b // multiple) * multiple)
+        b -= multiple
+    return max(multiple, b)
 
 
 def _pallas_ok(sq, sk, d, bq, bk):

@@ -75,6 +75,35 @@ def test_overflow_skips_step_and_halves_scale():
     assert int(new_state.scaler.unskipped) == 0
 
 
+def test_overflow_freezes_stateful_optimizer_bitwise():
+    """Regression for the cond→select skip rewrite: with a STATEFUL
+    optimizer (adam mu/nu + count), an overflow step must leave every
+    opt-state leaf bitwise frozen — the select path computes the update
+    on inf/NaN grads and must discard all of it, count increment
+    included. sgd-based overflow tests can't see this (no state leaves)."""
+    policy = resolve_policy("O2", half_dtype=jnp.float16, verbose=False,
+                            loss_scale=256.0)
+    opt = optax.adam(1e-2)
+    init_fn, step_fn = make_train_step(_loss_fn, opt, policy)
+    state = init_fn({"w": jnp.ones((4, 2), jnp.float32),
+                     "b": jnp.zeros((2,), jnp.float32)})
+    step = jax.jit(step_fn)
+    x = jnp.ones((8, 4), jnp.float32)
+    y = jnp.zeros((8, 2), jnp.float32)
+    state, m = step(state, (x, y))           # clean step: state advances
+    assert not bool(m["found_inf"])
+    bad = (x.at[0, 0].set(jnp.float32(1e30)), y)
+    new_state, m = step(state, bad)
+    assert bool(m["found_inf"])
+    before = jax.tree_util.tree_leaves(state.opt_state)
+    after = jax.tree_util.tree_leaves(new_state.opt_state)
+    assert before and len(before) == len(after)
+    for a, b in zip(before, after):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(np.asarray(new_state.master_params["w"]),
+                                  np.asarray(state.master_params["w"]))
+
+
 def test_clean_steps_grow_scale():
     policy = resolve_policy("O2", half_dtype=jnp.float16, verbose=False)
     opt = optax.sgd(1e-4)

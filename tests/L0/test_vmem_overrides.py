@@ -183,10 +183,14 @@ def test_flash_fit_block_shrinks_to_divide():
     fallback."""
     from apex_tpu.kernels.flash_attention import _fit_block, _pallas_ok
 
-    assert _fit_block(1024, 1536, 128) == 512     # halve once
+    assert _fit_block(1024, 1536, 128) == 768     # largest divisor <= b
     assert _fit_block(1024, 384, 128) == 384      # clamp to seq
     assert _fit_block(256, 2048, 8) == 256        # already divides
     assert _fit_block(1024, 250, 128) == 128      # floor at alignment
+    # divisor scan, not repeated halving: halving 768 at s=1024 misses 512
+    # and bottoms out at a near-degenerate block that Mosaic rejects
+    assert _fit_block(768, 1024, 8) == 512
+    assert _fit_block(768, 1024, 128) == 512
     # the fitted pair passes the Pallas gate at the shrink-needing shape
     assert _pallas_ok(1536, 1536, 128,
                       _fit_block(256, 1536, 8), _fit_block(1024, 1536, 128))
