@@ -455,7 +455,11 @@ def _fit_block(b, s, multiple):
     Divisor scan, not repeated halving: halving a non-divisor like 768
     at s=1024 bottoms out at 8 (every halving step misses 512), and
     near-degenerate blocks are both slow and fragile in Mosaic; the
-    scan finds 512. Trace-time only, <= b/multiple iterations."""
+    scan finds 512. When s has NO aligned divisor >= multiple (e.g.
+    s=250 at multiple=128) the floor `multiple` itself is returned even
+    though it does not divide s — callers must keep the _pallas_ok gate,
+    which rejects that case into the jnp fallback. Trace-time only,
+    <= b/multiple iterations."""
     b = min(b, s)
     b -= b % multiple
     while b > multiple and s % b:

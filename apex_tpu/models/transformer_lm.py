@@ -120,7 +120,8 @@ class TransformerLM(nn.Module):
     param_dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, tokens, *, train: bool = True):
+    def __call__(self, tokens, *, train: bool = True,
+                 features_only: bool = False):
         from apex_tpu.amp.autocast import resolve_dtype
         dense_dtype = resolve_dtype(self.dtype, "linear", jnp.float32)
         B, S = tokens.shape
@@ -140,6 +141,11 @@ class TransformerLM(nn.Module):
                           name=f"block_{i}")(x, train)
         x = FusedLayerNorm(normalized_shape=self.hidden, dtype=self.dtype,
                            name="ln_f")(x)
+        if features_only:
+            # pre-head hidden states [B, S, H] for callers fusing the
+            # tied head into the loss (kernels/lm_head_loss.py — the
+            # head weight is params["wte"]["embedding"], vocab-major)
+            return x
         # tied LM head; logits in fp32
         logits = jnp.dot(jnp.asarray(x, jnp.float32),
                          jnp.asarray(embed.embedding, jnp.float32).T)

@@ -44,6 +44,47 @@ def test_lm_trains_on_pretokenized_npy():
     assert len(m_syn["loss_history"]) == len(hist)
 
 
+def test_lm_fused_head_trains_and_resumes_bitwise(tmp_path):
+    """--fused-head (kernels/lm_head_loss.py wired into the recipe):
+    same learnability bar as the default path, deterministic, and
+    bitwise save/resume — the fused tail must not perturb the recipe's
+    checkpoint/restart contract."""
+    import jax
+
+    from examples.lm import main_amp as lm
+
+    data = os.path.join(_DATA, "tiny_lm_tokens.npy")
+    ckpt = os.path.join(tmp_path, "lm_fused.npz")
+    common = ["--size", "tiny", "--vocab-size", "128", "--seq-len", "32",
+              "-b", "8", "--deterministic", "--opt-level", "O2",
+              "--lr", "3e-3", "--data", data, "--fused-head"]
+    m_full = lm.main(common + ["--iters", "8"])
+    hist = m_full["loss_history"]
+    assert all(np.isfinite(hist)), hist
+    assert hist[-1] < hist[0] - 0.1, hist
+    lm.main(common + ["--iters", "4", "--save", ckpt])
+    m_res = lm.main(common + ["--iters", "8", "--resume", ckpt])
+    np.testing.assert_array_equal(m_res["loss_history"],
+                                  m_full["loss_history"][4:])
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        m_res["final_state"].params, m_full["final_state"].params)
+
+
+def test_lm_fused_head_rejects_parallel():
+    """The flag is single-chip only; the parallel tiers keep the vocab-
+    parallel loss (their trajectory is the oracle contract)."""
+    import pytest
+
+    from examples.lm import main_amp as lm
+
+    with pytest.raises(SystemExit, match="single-chip"):
+        lm.main(["--size", "tiny", "--vocab-size", "128", "--seq-len",
+                 "32", "--iters", "1", "--fused-head",
+                 "--data-parallel", "2"])
+
+
 def test_lm_single_chip_save_resume_bitwise(tmp_path):
     """--save/--resume on the single-chip path too (review r4: the flags
     must not be parallel-only): interrupted-at-4 + resumed reproduces
