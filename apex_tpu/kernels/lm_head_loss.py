@@ -58,13 +58,15 @@ def lm_head_xent_reference(x, kernel, labels, smoothing: float = 0.0,
 
 
 def _pick_chunk(v: int, chunk: int) -> int:
-    """Largest lane-aligned divisor of ``v`` that is <= chunk (0 when the
-    vocab has none — caller falls back to the unfused composition)."""
-    c = min(chunk, v)
-    c -= c % 128
-    while c >= 128 and v % c:
-        c -= 128
-    return c if c >= 128 else 0
+    """The requested chunk, lane-aligned (floor to a multiple of 128,
+    min 128) and clamped to the padded vocab. Vocabs that don't divide
+    are handled by padding the weight to ``ceil(v/c)*c`` rows and
+    masking the pad columns out of the logsumexp — NOT by shrinking the
+    chunk to a divisor: GPT-2's padded 50304 = 128*3*131 has no
+    lane-aligned divisor above 384, and 131 unrolled 384-wide tiles is
+    both a compile blowup and slower than unfused (review round-5)."""
+    c = max(128, min(chunk, v + (-v) % 128))
+    return c - c % 128
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
@@ -79,25 +81,42 @@ def _chunk_logits(xc, wc):
                                preferred_element_type=jnp.float32)
 
 
+def _pad_rows(kernel, chunk, compute_dtype):
+    """[V, H] weight in compute dtype, zero-padded to a chunk multiple,
+    reshaped to [nc, chunk, H] for the scans."""
+    v, h = kernel.shape
+    nc = -(-v // chunk)
+    wc = jnp.asarray(kernel, compute_dtype)
+    pad = nc * chunk - v
+    if pad:
+        wc = jnp.pad(wc, ((0, pad), (0, 0)))
+    return wc.reshape(nc, chunk, h), nc
+
+
 def _fused_fwd(x, kernel, labels, smoothing, chunk, compute_dtype):
     n, h = x.shape
     v = kernel.shape[0]
-    nc = v // chunk
     xc = jnp.asarray(x, compute_dtype)
-    wr = jnp.asarray(kernel, compute_dtype).reshape(nc, chunk, h)
+    wr, nc = _pad_rows(kernel, chunk, compute_dtype)
+    padded = nc * chunk != v
     offsets = jnp.arange(nc, dtype=jnp.int32) * chunk
 
     def body(carry, inp):
         m, s, zy, slg = carry
         wc, off = inp
         lg = _chunk_logits(xc, wc)                        # [N, C] fp32
+        cols = off + jax.lax.broadcasted_iota(jnp.int32, lg.shape, 1)
+        if padded:
+            # pad columns are x @ 0 = 0, which would pollute the
+            # logsumexp — mask them to -inf (exp -> 0) before any reduce
+            lg = jnp.where(cols < v, lg, -jnp.inf)
         m2 = jnp.maximum(m, jnp.max(lg, axis=-1))
         s = s * jnp.exp(m - m2) + jnp.sum(
             jnp.exp(lg - m2[:, None]), axis=-1)
-        cols = off + jax.lax.broadcasted_iota(jnp.int32, lg.shape, 1)
         zy = zy + jnp.sum(
             jnp.where(cols == labels[:, None], lg, 0.0), axis=-1)
-        slg = slg + jnp.sum(lg, axis=-1)
+        slg = slg + jnp.sum(jnp.where(cols < v, lg, 0.0), axis=-1) \
+            if padded else slg + jnp.sum(lg, axis=-1)
         return (m2, s, zy, slg), None
 
     init = (jnp.full((n,), -jnp.inf, jnp.float32),
@@ -119,20 +138,25 @@ def _fused_bwd(smoothing, chunk, compute_dtype, res, g):
     x, kernel, labels, lse = res
     n, h = x.shape
     v = kernel.shape[0]
-    nc = v // chunk
     xc = jnp.asarray(x, compute_dtype)
-    wr = jnp.asarray(kernel, compute_dtype).reshape(nc, chunk, h)
+    wr, nc = _pad_rows(kernel, chunk, compute_dtype)
+    padded = nc * chunk != v
     offsets = jnp.arange(nc, dtype=jnp.int32) * chunk
     g32 = jnp.asarray(g, jnp.float32)
 
     def body(dx, inp):
         wc, off = inp
         lg = _chunk_logits(xc, wc)                        # recompute [N, C]
-        p = jnp.exp(lg - lse[:, None])
         cols = off + jax.lax.broadcasted_iota(jnp.int32, lg.shape, 1)
+        if padded:
+            lg = jnp.where(cols < v, lg, -jnp.inf)        # p -> 0 at pads
+        p = jnp.exp(lg - lse[:, None])
         onehot = (cols == labels[:, None]).astype(jnp.float32)
         if smoothing > 0.0:
             target = (1.0 - smoothing) * onehot + smoothing / v
+            if padded:
+                # the smoothing/v floor must not leak into pad columns
+                target = jnp.where(cols < v, target, 0.0)
         else:
             target = onehot
         dl = (p - target) * g32[:, None]                  # [N, C] fp32
@@ -147,7 +171,7 @@ def _fused_bwd(smoothing, chunk, compute_dtype, res, g):
 
     dx, dws = jax.lax.scan(body, jnp.zeros((n, h), jnp.float32),
                            (wr, offsets), unroll=True)
-    dw = dws.reshape(v, h)
+    dw = dws.reshape(nc * chunk, h)[:v]
     return (jnp.asarray(dx, x.dtype), jnp.asarray(dw, kernel.dtype), None)
 
 
@@ -164,11 +188,13 @@ def lm_head_xentropy(x, kernel, labels, *, smoothing: float = 0.0,
 
     ``smoothing`` matches :func:`kernels.xentropy.xent_reference` (apex
     SoftmaxCrossEntropyLoss semantics). ``chunk`` is the vocab tile the
-    scan streams (fitted down to a lane-aligned divisor of V; vocabs with
-    no 128-multiple divisor fall back to the unfused composition).
-    ``compute_dtype`` sets the GEMM input dtype (default: ``x.dtype``;
-    pass the amp half dtype for MXU-rate GEMMs) — accumulation and all
-    loss math stay fp32 on every path.
+    scan streams (lane-aligned; vocabs that don't divide — GPT-2's
+    50257 included — are zero-padded to a chunk multiple with the pad
+    columns masked to -inf out of the logsumexp and sliced off dW, so
+    every vocab gets full-width tiles). ``compute_dtype`` sets the GEMM
+    input dtype (default: ``x.dtype``; pass the amp half dtype for
+    MXU-rate GEMMs) — accumulation and all loss math stay fp32 on every
+    path.
     """
     if not 0.0 <= smoothing < 1.0:
         raise ValueError(f"smoothing must be in [0, 1), got {smoothing}")
@@ -186,15 +212,7 @@ def lm_head_xentropy(x, kernel, labels, *, smoothing: float = 0.0,
     n = 1
     for s_ in shape:
         n *= s_
-    if c == 0 or n == 0:
-        if c == 0 and n:
-            import warnings
-            warnings.warn(
-                f"lm_head_xentropy: vocab {v} has no 128-multiple divisor "
-                f"<= chunk={chunk}; falling back to the UNFUSED path (full "
-                f"[N, V] logits in HBM). Pad the vocab to a multiple of "
-                f"128 (e.g. GPT-2's 50257 -> 50304) to keep the fusion.",
-                stacklevel=2)
+    if n == 0:
         return lm_head_xent_reference(x, kernel, labels, smoothing, cd)
     loss = _fused(x.reshape(n, h), kernel, labels.reshape(n).astype(jnp.int32),
                   smoothing, c, jnp.dtype(cd))

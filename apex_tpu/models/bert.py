@@ -88,7 +88,10 @@ class BertLayer(nn.Module):
                            dtype=self.dtype, name="ln_attn")(x + out)
         h = nn.Dense(cfg.intermediate_size, dtype=dense_dtype,
                      param_dtype=self.param_dtype, name="mlp_in")(x)
-        h = nn.gelu(jnp.asarray(h, jnp.float32), approximate=False)
+        # tanh GELU — google-research/bert's own gelu() is the tanh
+        # formulation, and it fuses into the TPU GEMM epilogue where
+        # exact erf costs VPU time (see models/transformer_lm.py)
+        h = nn.gelu(jnp.asarray(h, jnp.float32), approximate=True)
         h = nn.Dense(H, dtype=dense_dtype, param_dtype=self.param_dtype,
                      name="mlp_out")(jnp.asarray(h, dense_dtype))
         if cfg.hidden_dropout_prob > 0.0:
@@ -191,7 +194,7 @@ class BertForPreTraining(nn.Module):
             seq, masked_lm_positions[..., None].astype(jnp.int32), axis=1)
         h = nn.Dense(H, dtype=dense_dtype, param_dtype=self.param_dtype,
                      name="mlm_transform")(gathered)
-        h = nn.gelu(jnp.asarray(h, jnp.float32), approximate=False)
+        h = nn.gelu(jnp.asarray(h, jnp.float32), approximate=True)
         h = FusedLayerNorm(normalized_shape=H, eps=cfg.layer_norm_eps,
                            name="mlm_ln")(h)
         # tied decoder: h @ embedding.T + bias, logits fp32 (Embed.attend is

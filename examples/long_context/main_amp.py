@@ -109,7 +109,7 @@ class RingBlock(nn.Module):
         x = x + nn.Dense(H, dtype=dtype, name="proj")(out)
         h = FusedLayerNorm(normalized_shape=H, name="ln_mlp")(x)
         h = nn.Dense(4 * H, dtype=dtype, name="mlp_in")(h)
-        h = nn.gelu(jnp.asarray(h, jnp.float32), approximate=False)
+        h = nn.gelu(jnp.asarray(h, jnp.float32), approximate=True)
         h = nn.Dense(H, dtype=dtype, name="mlp_out")(
             jnp.asarray(h, dtype))
         return x + h

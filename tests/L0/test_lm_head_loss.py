@@ -80,20 +80,32 @@ def test_batched_leading_dims():
                                np.asarray(flat), rtol=1e-6)
 
 
-def test_unaligned_vocab_falls_back_with_warning():
-    """V with no 128-multiple divisor must still give reference answers
-    (the unfused fallback), not crash or misindex — and must WARN, since
-    the caller asked for fusion and is silently not getting it (GPT-2's
-    real vocab 50257 is prime)."""
+@pytest.mark.parametrize("v", [130, 1000, 257])
+@pytest.mark.parametrize("smoothing", [0.0, 0.1])
+def test_unaligned_vocab_pads_and_masks(v, smoothing):
+    """Vocabs that don't divide the chunk (GPT-2's 50257 is prime) stay
+    FUSED: the weight pads to a chunk multiple and the pad columns are
+    masked out of the logsumexp, the smoothing floor, and dW. Loss and
+    both cotangents must match the unpadded reference exactly."""
     rng = jax.random.PRNGKey(3)
-    v = 130
     x = jax.random.normal(rng, (8, H))
     w = jax.random.normal(jax.random.fold_in(rng, 1), (v, H)) * 0.1
     y = jax.random.randint(jax.random.fold_in(rng, 2), (8,), 0, v)
-    with pytest.warns(UserWarning, match="no 128-multiple divisor"):
-        got = lm_head_xentropy(x, w, y)
-    want = lm_head_xent_reference(x, w, y)
-    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5)
+    got = lm_head_xentropy(x, w, y, smoothing=smoothing, chunk=128)
+    want = lm_head_xent_reference(x, w, y, smoothing)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+    gx_f, gw_f = jax.grad(
+        lambda x, w: lm_head_xentropy(x, w, y, smoothing=smoothing,
+                                      chunk=128).mean(),
+        argnums=(0, 1))(x, w)
+    gx_c, gw_c = jax.grad(
+        lambda x, w: lm_head_xent_reference(x, w, y, smoothing).mean(),
+        argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(gx_f), np.asarray(gx_c),
+                               rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(gw_f), np.asarray(gw_c),
+                               rtol=2e-4, atol=2e-5)
 
 
 def test_validation_errors():
