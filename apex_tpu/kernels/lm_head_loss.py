@@ -69,9 +69,10 @@ def _pick_chunk(v: int, chunk: int) -> int:
     return c - c % 128
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _fused(x, kernel, labels, smoothing, chunk, compute_dtype):
-    loss, _ = _fused_fwd(x, kernel, labels, smoothing, chunk, compute_dtype)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _fused(x, kernel, labels, smoothing, chunk, compute_dtype, axis_name):
+    loss, _ = _fused_fwd(x, kernel, labels, smoothing, chunk, compute_dtype,
+                         axis_name)
     return loss
 
 
@@ -93,9 +94,20 @@ def _pad_rows(kernel, chunk, compute_dtype):
     return wc.reshape(nc, chunk, h), nc
 
 
-def _fused_fwd(x, kernel, labels, smoothing, chunk, compute_dtype):
+def _shard_offset(v_local, axis_name):
+    """(global col offset of this rank's vocab shard, global vocab)."""
+    if axis_name is None:
+        return 0, v_local
+    idx = jax.lax.axis_index(axis_name)
+    size = jax.lax.axis_size(axis_name)
+    return idx * v_local, v_local * size
+
+
+def _fused_fwd(x, kernel, labels, smoothing, chunk, compute_dtype,
+               axis_name):
     n, h = x.shape
-    v = kernel.shape[0]
+    v = kernel.shape[0]                       # LOCAL shard rows
+    off0, v_glob = _shard_offset(v, axis_name)
     xc = jnp.asarray(x, compute_dtype)
     wr, nc = _pad_rows(kernel, chunk, compute_dtype)
     padded = nc * chunk != v
@@ -105,17 +117,24 @@ def _fused_fwd(x, kernel, labels, smoothing, chunk, compute_dtype):
         m, s, zy, slg = carry
         wc, off = inp
         lg = _chunk_logits(xc, wc)                        # [N, C] fp32
-        cols = off + jax.lax.broadcasted_iota(jnp.int32, lg.shape, 1)
+        lcols = off + jax.lax.broadcasted_iota(jnp.int32, lg.shape, 1)
         if padded:
             # pad columns are x @ 0 = 0, which would pollute the
             # logsumexp — mask them to -inf (exp -> 0) before any reduce
-            lg = jnp.where(cols < v, lg, -jnp.inf)
+            lg = jnp.where(lcols < v, lg, -jnp.inf)
+        cols = off0 + lcols                               # GLOBAL ids
         m2 = jnp.maximum(m, jnp.max(lg, axis=-1))
         s = s * jnp.exp(m - m2) + jnp.sum(
             jnp.exp(lg - m2[:, None]), axis=-1)
-        zy = zy + jnp.sum(
-            jnp.where(cols == labels[:, None], lg, 0.0), axis=-1)
-        slg = slg + jnp.sum(jnp.where(cols < v, lg, 0.0), axis=-1) \
+        hit = cols == labels[:, None]
+        if padded:
+            # pad columns carry global ids that ALIAS the next shard's
+            # real vocab rows (off0 + lcols, lcols >= v) — without this
+            # gate a label owned by the next shard matches the -inf pad
+            # logit here and zy psums to -inf (loss = +inf)
+            hit = hit & (lcols < v)
+        zy = zy + jnp.sum(jnp.where(hit, lg, 0.0), axis=-1)
+        slg = slg + jnp.sum(jnp.where(lcols < v, lg, 0.0), axis=-1) \
             if padded else slg + jnp.sum(lg, axis=-1)
         return (m2, s, zy, slg), None
 
@@ -124,20 +143,30 @@ def _fused_fwd(x, kernel, labels, smoothing, chunk, compute_dtype):
             jnp.zeros((n,), jnp.float32),
             jnp.zeros((n,), jnp.float32))
     (m, s, zy, slg), _ = jax.lax.scan(body, init, (wr, offsets), unroll=True)
+    if axis_name is not None:
+        # cross-shard online-softmax combine: global max, sums rebased to
+        # it; zy/slg are exact psums (each label/col owned by one shard).
+        # Identical on every rank afterwards — the loss is replicated.
+        m_g = jax.lax.pmax(m, axis_name)
+        s = jax.lax.psum(s * jnp.exp(m - m_g), axis_name)
+        zy = jax.lax.psum(zy, axis_name)
+        slg = jax.lax.psum(slg, axis_name)
+        m = m_g
     lse = m + jnp.log(s)
     nll = lse - zy
     if smoothing > 0.0:
-        mean_logp = slg / v - lse
+        mean_logp = slg / v_glob - lse
         loss = (1.0 - smoothing) * nll - smoothing * mean_logp
     else:
         loss = nll
     return loss, (x, kernel, labels, lse)
 
 
-def _fused_bwd(smoothing, chunk, compute_dtype, res, g):
+def _fused_bwd(smoothing, chunk, compute_dtype, axis_name, res, g):
     x, kernel, labels, lse = res
     n, h = x.shape
-    v = kernel.shape[0]
+    v = kernel.shape[0]                       # LOCAL shard rows
+    off0, v_glob = _shard_offset(v, axis_name)
     xc = jnp.asarray(x, compute_dtype)
     wr, nc = _pad_rows(kernel, chunk, compute_dtype)
     padded = nc * chunk != v
@@ -147,16 +176,24 @@ def _fused_bwd(smoothing, chunk, compute_dtype, res, g):
     def body(dx, inp):
         wc, off = inp
         lg = _chunk_logits(xc, wc)                        # recompute [N, C]
-        cols = off + jax.lax.broadcasted_iota(jnp.int32, lg.shape, 1)
+        lcols = off + jax.lax.broadcasted_iota(jnp.int32, lg.shape, 1)
         if padded:
-            lg = jnp.where(cols < v, lg, -jnp.inf)        # p -> 0 at pads
-        p = jnp.exp(lg - lse[:, None])
-        onehot = (cols == labels[:, None]).astype(jnp.float32)
+            lg = jnp.where(lcols < v, lg, -jnp.inf)       # p -> 0 at pads
+        cols = off0 + lcols
+        p = jnp.exp(lg - lse[:, None])                    # lse is GLOBAL
+        hit = cols == labels[:, None]
+        if padded:
+            # same pad-alias gate as the forward: without it, a
+            # next-shard label would put -g into this shard's pad dl
+            # column (harmless for dW only because pad rows are sliced
+            # off, but it corrupts the dx psum)
+            hit = hit & (lcols < v)
+        onehot = hit.astype(jnp.float32)
         if smoothing > 0.0:
-            target = (1.0 - smoothing) * onehot + smoothing / v
+            target = (1.0 - smoothing) * onehot + smoothing / v_glob
             if padded:
-                # the smoothing/v floor must not leak into pad columns
-                target = jnp.where(cols < v, target, 0.0)
+                # the smoothing floor must not leak into pad columns
+                target = jnp.where(lcols < v, target, 0.0)
         else:
             target = onehot
         dl = (p - target) * g32[:, None]                  # [N, C] fp32
@@ -171,6 +208,11 @@ def _fused_bwd(smoothing, chunk, compute_dtype, res, g):
 
     dx, dws = jax.lax.scan(body, jnp.zeros((n, h), jnp.float32),
                            (wr, offsets), unroll=True)
+    if axis_name is not None:
+        # every shard's chunks contribute to the full dL/dx — the
+        # Megatron parallel-head rule (copy_to's psum-bwd), emitted
+        # directly here so callers never double-reduce
+        dx = jax.lax.psum(dx, axis_name)
     dw = dws.reshape(nc * chunk, h)[:v]
     return (jnp.asarray(dx, x.dtype), jnp.asarray(dw, kernel.dtype), None)
 
@@ -179,7 +221,8 @@ _fused.defvjp(_fused_fwd, _fused_bwd)
 
 
 def lm_head_xentropy(x, kernel, labels, *, smoothing: float = 0.0,
-                     chunk: int = 8192, compute_dtype=None):
+                     chunk: int = 8192, compute_dtype=None,
+                     axis_name=None):
     """Per-example CE of ``softmax(x @ kernel.T)`` without materializing
     logits. ``x: [..., H]`` hidden states, ``kernel: [V, H]`` vocab-major
     head weight (the embedding table itself for tied-weight GPT models),
@@ -195,6 +238,20 @@ def lm_head_xentropy(x, kernel, labels, *, smoothing: float = 0.0,
     input dtype (default: ``x.dtype``; pass the amp half dtype for
     MXU-rate GEMMs) — accumulation and all loss math stay fp32 on every
     path.
+
+    ``axis_name`` makes the op VOCAB-PARALLEL inside ``shard_map``: each
+    rank passes its row shard of the head (global vocab = shard rows ×
+    axis size, rank ``i`` owning rows ``[i·V_loc, (i+1)·V_loc)``) and
+    the GLOBAL labels. The forward combines the per-shard online
+    logsumexp with one pmax + three psums (the Megatron
+    vocab_parallel_cross_entropy reductions, fused with the head GEMM);
+    the backward psums dx itself — callers must NOT wrap the head input
+    in ``copy_to_tensor_model_parallel_region`` or dL/dx double-counts.
+    The returned loss is replicated across the axis. Take grads INSIDE
+    the shard_map (the recipes' pattern); differentiating THROUGH a
+    shard_map whose out_spec replicates the loss hands each rank a
+    cotangent pre-divided by the axis size (shard_map's transpose
+    convention), scaling the shard-local dW by 1/size.
     """
     if not 0.0 <= smoothing < 1.0:
         raise ValueError(f"smoothing must be in [0, 1), got {smoothing}")
@@ -213,7 +270,9 @@ def lm_head_xentropy(x, kernel, labels, *, smoothing: float = 0.0,
     for s_ in shape:
         n *= s_
     if n == 0:
+        if axis_name is not None:
+            raise ValueError("axis_name with an empty batch is ambiguous")
         return lm_head_xent_reference(x, kernel, labels, smoothing, cd)
     loss = _fused(x.reshape(n, h), kernel, labels.reshape(n).astype(jnp.int32),
-                  smoothing, c, jnp.dtype(cd))
+                  smoothing, c, jnp.dtype(cd), axis_name)
     return loss.reshape(shape)
