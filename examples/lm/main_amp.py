@@ -58,10 +58,12 @@ def parse_args(argv=None):
                         "(kernels/lm_head_loss.py): logits never hit HBM "
                         "and the head GEMMs run in the amp half dtype — "
                         "measured 1.4x faster at the GPT-2 tail shape with "
-                        "the [B,S,V] logits residual gone. Single-chip "
-                        "path only (the parallel tiers keep the vocab-"
-                        "parallel loss); off by default so the default "
-                        "trajectory stays the parallel tiers' oracle")
+                        "the [B,S,V] logits residual gone. Single-chip, "
+                        "or with --vocab-parallel under shard_map (the "
+                        "op's axis_name mode fuses Megatron's CE "
+                        "reductions into the sharded head GEMM); off by "
+                        "default so the default trajectory stays the "
+                        "parallel tiers' oracle")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--deterministic", action="store_true")
     p.add_argument("--remat", action="store_true",
@@ -405,6 +407,19 @@ def build_parallel_lm(args, policy):
         # copy_to's psum-bwd here would double-count the LN grads.
         hh = layer_norm(y.reshape(-1, H), head["ln_s"], head["ln_b"])
         if vp_on:
+            if args.fused_head:
+                # fused vocab-parallel tail (kernels/lm_head_loss.py
+                # axis_name mode): the op emits copy_to's psum-bwd on
+                # dx itself and fuses Megatron's CE reductions into the
+                # chunked head GEMM — the [S*mb, V_loc] logits never
+                # materialize. head["kernel"] is [H, V_loc]; the .T
+                # view fuses into the chunk GEMMs' dimension numbers.
+                from apex_tpu.kernels.lm_head_loss import lm_head_xentropy
+                losses = lm_head_xentropy(
+                    hh, head["kernel"].T, tgt.reshape(-1),
+                    smoothing=args.smoothing, compute_dtype=y.dtype,
+                    axis_name="model")
+                return losses.mean()
             # Megatron parallel-LM-head rule (P23): the head input goes
             # through copy_to (identity fwd, psum bwd) so every vocab
             # shard back-props the FULL dL/dh; the local logits block
@@ -942,10 +957,18 @@ def main(argv=None):
     print(policy.banner())
     if (args.data_parallel * args.tensor_parallel
             * args.pipeline_parallel * args.virtual_pipeline) > 1:
-        if args.fused_head:
-            raise SystemExit("--fused-head is single-chip only: the "
-                             "parallel tiers compute the loss vocab-"
-                             "parallel (tensor_parallel/cross_entropy)")
+        if args.fused_head and not args.vocab_parallel:
+            raise SystemExit("--fused-head under the parallel tiers "
+                             "needs --vocab-parallel AND "
+                             "--tensor-parallel >= 2 (the fused op's "
+                             "axis_name mode shards the head over "
+                             "'model'); without them the replicated-"
+                             "head tail keeps the materialized loss")
+        if args.fused_head and getattr(args, "partitioning",
+                                       "shard_map") == "gspmd":
+            raise SystemExit("--fused-head is shard_map-only under "
+                             "parallelism (gspmd keeps the materialized "
+                             "vocab-parallel loss)")
         return run_parallel(args, policy)
     if args.partitioning == "gspmd":
         raise SystemExit("--partitioning gspmd needs a mesh: pass "

@@ -72,14 +72,15 @@ def test_lm_fused_head_trains_and_resumes_bitwise(tmp_path):
         m_res["final_state"].params, m_full["final_state"].params)
 
 
-def test_lm_fused_head_rejects_parallel():
-    """The flag is single-chip only; the parallel tiers keep the vocab-
-    parallel loss (their trajectory is the oracle contract)."""
+def test_lm_fused_head_parallel_needs_vocab_parallel():
+    """Under the parallel tiers the flag rides the op's axis_name mode,
+    which needs the head sharded over 'model' — plain dp/tp without
+    --vocab-parallel is rejected with the pointer."""
     import pytest
 
     from examples.lm import main_amp as lm
 
-    with pytest.raises(SystemExit, match="single-chip"):
+    with pytest.raises(SystemExit, match="vocab-parallel"):
         lm.main(["--size", "tiny", "--vocab-size", "128", "--seq-len",
                  "32", "--iters", "1", "--fused-head",
                  "--data-parallel", "2"])

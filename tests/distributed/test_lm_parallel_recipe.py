@@ -146,6 +146,25 @@ def test_vocab_parallel_head_trajectory_matches(lm, eight_devices):
     _assert_trees_close(lm, _canon(lm, m_vp_tp), _canon(lm, m_seq))
 
 
+def test_vocab_parallel_fused_head_trajectory_matches(lm, eight_devices):
+    """--vocab-parallel --fused-head (the kernels/lm_head_loss axis_name
+    mode replacing copy_to + materialized logits + parallel CE) stays on
+    the SAME trajectory as the oracle and the unfused vp path — the
+    fused reductions are the same math, reassociated."""
+    m_seq = _baseline(lm)
+    m_f_tp = _run(lm, ["--tensor-parallel", "2", "--pipeline-parallel",
+                       "1", "--vocab-parallel", "--fused-head"])
+    np.testing.assert_allclose(float(m_f_tp["loss"]), float(m_seq["loss"]),
+                               rtol=2e-4)
+    _assert_trees_close(lm, _canon(lm, m_f_tp), _canon(lm, m_seq))
+    # and through pp2, where the head lives on the last stage
+    m_f_pp = _run(lm, ["--tensor-parallel", "2", "--pipeline-parallel",
+                       "2", "--vocab-parallel", "--fused-head"])
+    np.testing.assert_allclose(float(m_f_pp["loss"]), float(m_seq["loss"]),
+                               rtol=2e-4)
+    _assert_trees_close(lm, _canon(lm, m_f_pp), _canon(lm, m_seq))
+
+
 def test_full_combo_dp_tp_pp_vpp_trajectory(lm, eight_devices):
     """Every axis at once — dp2 x tp2 x pp2 with vpp2 (8 devices, 4 logical
     stages) reproduces the single-device trajectory, whole param tree."""
