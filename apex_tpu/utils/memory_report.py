@@ -36,7 +36,8 @@ from typing import Any, Callable, Optional, Sequence
 import jax
 
 __all__ = ["MemoryStats", "compiled_memory", "price_contract",
-           "xentropy_contract", "flash_contract", "remat_mlp_contract",
+           "xentropy_contract", "lm_head_contract", "flash_contract",
+           "remat_mlp_contract",
            "causal_softmax_contract", "masked_softmax_contract",
            "lm_step_remat_contract", "ln_memory_efficient_contract",
            "resnet50_o2_ddp_step", "bert_large_lamb_step"]
@@ -86,6 +87,31 @@ def xentropy_contract(n: int, v: int):
         lambda lg, lb: jnp.sum(softmax_cross_entropy_loss(lg, lb)))
     composed = jax.value_and_grad(
         lambda lg, lb: jnp.sum(xent_reference(lg, lb)))
+    return fused, composed, avals, n * v * 4
+
+
+def lm_head_contract(n: int, h: int, v: int, chunk: int = 8192):
+    """Fused LM-head+CE pricing setup: (fused_fn, composed_fn, avals,
+    theory_bytes). Theory = the [N, V] fp32 logits the composed tail
+    materializes forward AND saves as the CE residual (the fused op's
+    residual is a length-N lse; its chunk working set is O(chunk·N)).
+    The saving requires chunk < v — at chunk >= v the single chunk IS
+    the full logits and the op prices identical to composed."""
+    import jax.numpy as jnp
+
+    from apex_tpu.kernels.lm_head_loss import (lm_head_xent_reference,
+                                               lm_head_xentropy)
+
+    avals = [jax.ShapeDtypeStruct((n, h), jnp.float32),
+             jax.ShapeDtypeStruct((v, h), jnp.float32),
+             jax.ShapeDtypeStruct((n,), jnp.int32)]
+    fused = jax.value_and_grad(
+        lambda x, w, y: jnp.sum(lm_head_xentropy(
+            x, w, y, chunk=chunk, compute_dtype=jnp.bfloat16)),
+        argnums=(0, 1))
+    composed = jax.value_and_grad(
+        lambda x, w, y: jnp.sum(lm_head_xent_reference(
+            x, w, y, compute_dtype=jnp.bfloat16)), argnums=(0, 1))
     return fused, composed, avals, n * v * 4
 
 

@@ -52,6 +52,16 @@ def bench_xentropy():
                             theory_bytes=theory), f"{n}x{v}")
 
 
+def bench_lm_head():
+    from apex_tpu.utils.memory_report import (lm_head_contract,
+                                              price_contract)
+
+    for n, h, v in ((8184, 768, 32768), (8184, 768, 50257)):
+        fused, composed, avals, theory = lm_head_contract(n, h, v)
+        emit(price_contract("lm_head_xentropy_fwd_bwd", fused, composed,
+                            avals, theory_bytes=theory), f"{n}x{h}x{v}")
+
+
 def bench_flash():
     from apex_tpu.utils.memory_report import flash_contract, price_contract
 
@@ -190,7 +200,8 @@ def bench_configs():
          "large b8 s512 pred80 (phase-2 shape)")
 
 
-SUITES = {"xentropy": bench_xentropy, "flash": bench_flash,
+SUITES = {"xentropy": bench_xentropy, "lm_head": bench_lm_head,
+          "flash": bench_flash,
           "fused_softmax": bench_fused_softmax, "remat": bench_remat,
           "layer_norm": bench_layer_norm, "configs": bench_configs}
 

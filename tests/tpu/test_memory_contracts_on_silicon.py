@@ -27,6 +27,7 @@ BASELINE.md come from ``python bench_memory.py``.
 """
 
 from apex_tpu.utils.memory_report import (compiled_memory, flash_contract,
+                                          lm_head_contract,
                                           price_contract,
                                           remat_mlp_contract,
                                           xentropy_contract)
@@ -44,6 +45,21 @@ def test_xentropy_saves_nv_softmax_residual(tpu_backend):
     assert row["saved_peak_bytes"] >= 0.9 * theory, row
     # and the fused overhead really is "losses + mlse"-scale, not [N, V]
     assert row["fused_overhead_bytes"] < n * v, row
+
+
+def test_lm_head_fused_saves_nv_logits(tpu_backend):
+    """The fused LM head+CE (kernels/lm_head_loss.py) drops the [N, V]
+    fp32 logits residual the composed tail saves for backward. Priced
+    at the GPT-2 tail shape the recipe actually runs (the unrolled
+    chunks' scheduler liveness is a few chunk buffers, so the win only
+    dominates when V >> chunk — at toy shapes with nc*chunk ~ V the
+    overlap eats the saving, measured 14% at n2048/v8192/chunk1024).
+    Compile-only pricing: the 2.3 GB composed peak never executes."""
+    n, h, v = 8184, 768, 32768
+    fused, composed, avals, theory = lm_head_contract(n, h, v)
+    row = price_contract("lm_head_xentropy_fwd_bwd", fused, composed,
+                         avals, theory_bytes=theory)
+    assert row["saved_peak_bytes"] >= 0.9 * theory, row
 
 
 def test_flash_fwd_never_materializes_s2_probabilities(tpu_backend):
