@@ -202,6 +202,39 @@ def bench_xentropy():
         timeit(bwd_k, logits), timeit(bwd_x, logits), gbytes=3 * gb)
 
 
+# ------------------------------------------------------------ lm head
+def bench_lm_head():
+    """Fused LM-head+CE vs the composed tail (head GEMM + fused CE
+    kernel — the exact pair the recipe's --fused-head replaces), both
+    differentiated through x and the head weight."""
+    from apex_tpu.kernels.lm_head_loss import lm_head_xentropy
+    from apex_tpu.kernels.xentropy import softmax_cross_entropy_loss
+
+    n, h, v = 8184, 768, 32768
+    x = jax.random.normal(jax.random.PRNGKey(4), (n, h), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(5), (v, h), jnp.float32) * 0.02
+    y = jax.random.randint(jax.random.PRNGKey(6), (n,), 0, v)
+
+    def fused(x, w):
+        return jax.grad(lambda x, w: lm_head_xentropy(
+            x, w, y, compute_dtype=jnp.bfloat16).mean(),
+            argnums=(0, 1))(x, w)
+
+    def composed(x, w):
+        def loss(x, w):
+            logits = jax.lax.dot_general(
+                x.astype(jnp.bfloat16), w.astype(jnp.bfloat16),
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return softmax_cross_entropy_loss(logits, y).mean()
+        return jax.grad(loss, argnums=(0, 1))(x, w)
+
+    # compute floor: 4 GEMM-equivalents (fwd + recomputed fwd + dW + dx)
+    gf = 4 * 2 * n * h * v / 1e9
+    row("lm_head_fused_vs_composed_f_b", f"{n}x{h} V{v}",
+        timeit(fused, x, w), timeit(composed, x, w), gflops=gf)
+
+
 # ------------------------------------------------------------ multi-tensor
 def bench_adam():
     # big-tensor case: few large leaves (optax's per-leaf chain is already
@@ -305,6 +338,7 @@ def bench_group_norm():
 
 
 SUITES = {"flash": bench_flash, "ln": bench_ln, "xentropy": bench_xentropy,
+          "lm_head": bench_lm_head,
           "adam": bench_adam, "causal_softmax": bench_causal_softmax,
           "masked_softmax": bench_masked_softmax,
           "group_norm": bench_group_norm}
@@ -486,6 +520,10 @@ def main(argv):
         sweep(out)
         return
     names = argv or list(SUITES)
+    bad = [n for n in names if n not in SUITES]
+    if bad:
+        raise SystemExit(f"unknown suite(s) {', '.join(map(repr, bad))}; "
+                         f"pick from {', '.join(sorted(SUITES))}")
     print(json.dumps({"device": str(jax.devices()[0]),
                       "backend": jax.default_backend()}), flush=True)
     for name in names:

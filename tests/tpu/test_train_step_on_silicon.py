@@ -77,6 +77,42 @@ def test_lm_train_step_with_fused_xentropy(tpu_backend):
     assert float(metrics["loss"]) < l0   # flash + xentropy + fused adam
 
 
+def test_lm_train_step_with_fused_head(tpu_backend):
+    """The --fused-head tail on silicon: features_only hidden states into
+    kernels/lm_head_loss.py's chunked online-logsumexp against the tied
+    embedding, composed with amp O2 masters + dynamic scaler + fused
+    adam — the recipe's fused single-chip step end-to-end on hardware."""
+    from apex_tpu import amp
+    from apex_tpu.amp.autocast import resolve_dtype
+    from apex_tpu.kernels.lm_head_loss import lm_head_xentropy
+    from apex_tpu.models import create_lm
+    from apex_tpu.optimizers.fused_adam import fused_adam
+
+    policy = amp.resolve_policy(opt_level="O2", loss_scale="dynamic",
+                                verbose=False)
+    model = create_lm("tiny", vocab_size=128, max_seq_len=32,
+                      dtype=policy.model_dtype)
+    tokens = jnp.ones((2, 32), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens, train=False)["params"]
+    hd = resolve_dtype(policy.model_dtype, "linear", jnp.float32)
+
+    def loss_fn(p, batch):
+        hidden = model.apply({"params": p}, batch[:, :-1], train=False,
+                             features_only=True)
+        return lm_head_xentropy(hidden, p["wte"]["embedding"],
+                                batch[:, 1:], compute_dtype=hd).mean()
+
+    init_fn, step_fn = amp.make_train_step(loss_fn, fused_adam(1e-3), policy)
+    state = init_fn(params)
+    jit_step = jax.jit(step_fn)
+    l0 = None
+    for _ in range(3):
+        state, metrics = jit_step(state, tokens)
+        l0 = l0 if l0 is not None else float(metrics["loss"])
+    assert np.isfinite(float(metrics["loss"]))
+    assert float(metrics["loss"]) < l0
+
+
 def test_bert_lamb_train_step(tpu_backend):
     """VERDICT round-2 weak #7: the BERT-LAMB step on chip — FusedLAMB's
     l2norm + trust-ratio multi_tensor path lowered and composed with amp
