@@ -19,6 +19,10 @@ capability surface layered on JAX/XLA/Pallas:
 - ``apex_tpu.contrib``        — fused cross-entropy, multihead attention, flash
   attention, distributed (ZeRO-style) optimizers, sparsity, etc.
   (reference: apex/contrib/).
+- ``apex_tpu.telemetry``      — structured in-jit training telemetry: metrics
+  registry, JSONL/stdout sinks, one-callback-per-step emission from the amp
+  train step, comm-health counters, run-summary CLI (no reference
+  counterpart — apex observes with NVTX + recipe prints only).
 
 Unlike the reference, everything here is functional and jit-first: policies are
 dtype rules applied at trace time (not monkey-patches), the loss scaler is a
@@ -27,6 +31,10 @@ mesh (not NCCL).
 """
 
 from importlib import import_module as _import_module
+
+# package-wide logging surface (promoted from transformer/log_util.py);
+# stdlib-only, so the eager import costs nothing
+from .log_util import get_logger, set_logging_level
 
 __version__ = "0.1.0"
 
@@ -38,6 +46,7 @@ _SUBMODULES = (
     "fp16_utils",
     "fused_dense",
     "kernels",
+    "log_util",
     "mlp",
     "models",
     "multi_tensor_apply",
@@ -46,11 +55,12 @@ _SUBMODULES = (
     "parallel",
     "pyprof",
     "reparameterization",
+    "telemetry",
     "transformer",
     "utils",
 )
 
-__all__ = list(_SUBMODULES)
+__all__ = list(_SUBMODULES) + ["get_logger", "set_logging_level"]
 
 
 def __getattr__(name):

@@ -29,19 +29,20 @@ from typing import Any, Callable, NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 
+from ..log_util import get_logger
 from . import lists  # noqa: F401
 from .autocast import (active_policy, autocast, cast_op_inputs,
                        op_compute_dtype, resolve_dtype, trace_token)
 from .policy import Policy, default_is_norm_param, opt_levels, resolve_policy
 from .scaler import (LossScaler, ScalerState, init_scaler, scale_loss as
-                     _scale_loss_fn, unscale, unscale_with_stashed,
-                     update_scale)
+                     _scale_loss_fn, scaler_metrics, unscale,
+                     unscale_with_stashed, update_scale)
 
 __all__ = [
     "Policy", "LossScaler", "ScalerState", "opt_levels", "resolve_policy",
     "initialize", "scale_loss", "master_params", "state_dict",
-    "load_state_dict", "init_scaler", "unscale", "unscale_with_stashed",
-    "update_scale", "make_train_step", "AmpState",
+    "load_state_dict", "init_scaler", "scaler_metrics", "unscale",
+    "unscale_with_stashed", "update_scale", "make_train_step", "AmpState",
     "half_function", "float_function", "promote_function",
     "register_half_function", "register_float_function",
     "register_promote_function",
@@ -61,10 +62,14 @@ class _AmpState:
 _amp_state = _AmpState()
 
 
+_logger = get_logger("amp")
+
+
 def maybe_print(msg, verbosity_level=1):
-    """apex/amp/_amp_state.py — maybe_print."""
+    """apex/amp/_amp_state.py — maybe_print, routed through the package
+    logger (apex_tpu.get_logger) rather than stdout."""
     if _amp_state.verbosity >= verbosity_level:
-        print(msg)
+        _logger.info(msg)
 
 
 # ------------------------------------------------------------------ imperative
@@ -304,7 +309,8 @@ def make_train_step(loss_fn: Callable, optimizer, policy: Policy,
                     gradient_predivide_factor: float = 1.0,
                     grad_average_mask=None,
                     overflow_sync_axes=None,
-                    grad_fn: Optional[Callable] = None):
+                    grad_fn: Optional[Callable] = None,
+                    telemetry=False):
     """Build ``(init_fn, step_fn)`` implementing the apex iteration (§4.2 of
     the survey) as one jitted function.
 
@@ -356,6 +362,17 @@ def make_train_step(loss_fn: Callable, optimizer, policy: Policy,
     master-weight copy, scaler schedule) applies unchanged. When given,
     ``loss_fn`` is ignored and may be None; incompatible with ``has_aux``
     and ``with_model_state``.
+
+    ``telemetry``: truthy bakes structured in-jit telemetry into the
+    step — ONE ``jax.debug.callback`` per executed step streams the
+    metrics dict plus the fp32 grad norm and the scaler trajectory
+    (``apex_tpu.telemetry.scaler_metrics``) to the telemetry registry
+    under tag ``"amp"`` (no extra device syncs; the host sink also
+    stamps ``step_time_s``). Pass ``True`` to use the process-default
+    registry — resolved at CALLBACK time, so sinks can be reconfigured
+    without retracing — or a ``telemetry.MetricsRegistry`` to pin one.
+    Read at TRACE time (docs/telemetry.md): flip before the first call
+    of the jitted step.
     """
     if grad_fn is not None and (has_aux or with_model_state):
         raise ValueError("grad_fn is incompatible with has_aux/"
@@ -420,6 +437,20 @@ def make_train_step(loss_fn: Callable, optimizer, policy: Policy,
                 grads, (loss, aux, new_model_state) = jax.grad(
                     scaled_loss_fn, has_aux=True)(state.params)
         if grad_average_axis is not None:
+            # comm health: this inlined DDP reduction is the step's bucket
+            # allreduce — account bytes/leaves at trace time. With a
+            # grad_average_mask, mask=False leaves never ride a
+            # collective (scaled locally below), so only the True leaves
+            # count toward the allreduce payload.
+            from apex_tpu import telemetry as _tele_acct
+
+            if grad_average_mask is None:
+                _tele_acct.account_collective("ddp.allreduce", grads)
+            else:
+                _tele_acct.account_collective("ddp.allreduce", [
+                    g for g, m in zip(
+                        jax.tree_util.tree_leaves(grads),
+                        jax.tree_util.tree_leaves(grad_average_mask)) if m])
             # the reported loss is the global-batch mean, not one shard's
             # local value (the reference recipe all-reduces its metrics:
             # examples/imagenet/main_amp.py — reduce_tensor)
@@ -524,6 +555,18 @@ def make_train_step(loss_fn: Callable, optimizer, policy: Policy,
                              new_scaler, new_model_state)
         metrics = {"loss": loss, "found_inf": found_inf,
                    "loss_scale": scaler.loss_scale}
+        if telemetry:
+            from apex_tpu import telemetry as _telemetry
+
+            reg = telemetry \
+                if isinstance(telemetry, _telemetry.MetricsRegistry) \
+                else None
+            record = dict(metrics)
+            # fp32 grad norm off the already-unscaled master grads (one
+            # fused reduction, no extra transfers) + the scale trajectory
+            record["grad_norm"] = _telemetry.global_norm(master_grads)
+            record.update(scaler_metrics(scaler))
+            _telemetry.emit_metrics(record, tag="amp", registry=reg)
         if has_aux:
             metrics["aux"] = aux
         return new_state, metrics

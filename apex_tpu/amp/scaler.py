@@ -94,6 +94,20 @@ def scale_loss(loss, state: ScalerState):
     return loss * jnp.asarray(state.loss_scale, loss.dtype)
 
 
+def scaler_metrics(state: ScalerState):
+    """Telemetry view of the scale trajectory (SURVEY §6's loss-scaler
+    health signals): current scale, schedule position, cumulative
+    overflow count. Safe inside jit — plain reads of the pytree state,
+    consumed by ``amp.make_train_step(telemetry=...)``'s per-step
+    emission."""
+    return {
+        "loss_scale": state.loss_scale,
+        "scale_unskipped": state.unskipped,
+        "scale_steps": state.steps,
+        "overflows": state.overflows,
+    }
+
+
 def _tree_found_inf(tree):
     leaves = [l for l in jax.tree_util.tree_leaves(tree)
               if jnp.issubdtype(jnp.asarray(l).dtype, jnp.floating)]
@@ -221,6 +235,14 @@ class LossScaler:
         self._state = update_scale(self._state, jnp.bool_(self._has_overflow))
         had = self._has_overflow
         self._has_overflow = False
+        if had:
+            # host-side overflow-event counter for the imperative path
+            # (the jitted path counts via emit_metrics' found_inf)
+            from apex_tpu import telemetry
+
+            if telemetry.enabled():
+                telemetry.get_registry().counter_inc(
+                    "amp.scaler.overflow_events")
         return had
 
     # -- checkpointing (apex/amp/frontend.py — state_dict serializes scalers)

@@ -198,8 +198,20 @@ def axis_size(axis_name: str, mesh: Optional[Mesh] = None) -> int:
 # bound; under plain jit they raise NameError from XLA, matching the reference
 # where dist.all_reduce without init_process_group raises.
 
+def _account(op: str, tree) -> None:
+    """Comm-health accounting (apex_tpu.telemetry): bytes/calls/leaves
+    counters per collective. Runs at TRACE time — once per compiled
+    program, so the counters read what ONE execution moves (see
+    telemetry.account_collective). Lazy import keeps this module
+    importable standalone and the disabled path one dict lookup."""
+    from apex_tpu import telemetry
+
+    telemetry.account_collective(op, tree)
+
+
 def all_reduce(x, axis_name: str, op: str = "sum"):
     """dist.all_reduce equivalent. op: sum|mean|max|min."""
+    _account("all_reduce", x)
     if op == "sum":
         return jax.lax.psum(x, axis_name)
     if op == "mean":
@@ -212,16 +224,19 @@ def all_reduce(x, axis_name: str, op: str = "sum"):
 
 
 def all_reduce_max(x, axis_name: str):
+    _account("all_reduce", x)
     return jax.lax.pmax(x, axis_name)
 
 
 def all_gather(x, axis_name: str, axis: int = 0, tiled: bool = True):
     """dist.all_gather equivalent (concatenate along ``axis``)."""
+    _account("all_gather", x)
     return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
 
 
 def reduce_scatter(x, axis_name: str, axis: int = 0):
     """dist.reduce_scatter equivalent (sum + scatter along ``axis``)."""
+    _account("reduce_scatter", x)
     return jax.lax.psum_scatter(x, axis_name, scatter_dimension=axis,
                                 tiled=True)
 
@@ -230,6 +245,7 @@ def ppermute(x, axis_name: str, perm):
     """Point-to-point collective permute — the TPU stand-in for every
     send/recv pattern in the reference (pipeline p2p_communication._communicate
     and the halo exchanges of contrib peer_memory/nccl_p2p)."""
+    _account("ppermute", x)
     return jax.lax.ppermute(x, axis_name, perm)
 
 
@@ -247,6 +263,7 @@ def broadcast_from(x, axis_name: str, src: int = 0):
     One-to-many can't be a single ppermute (sources must be unique); the
     SPMD form is mask + psum, which XLA lowers to a broadcast from src.
     """
+    _account("broadcast", x)
     x = jnp.asarray(x)
     idx = jax.lax.axis_index(axis_name)
     masked = jnp.where(idx == src, x, jnp.zeros_like(x))

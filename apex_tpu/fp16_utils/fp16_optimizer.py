@@ -86,8 +86,11 @@ class FP16_Optimizer:
         self.loss_scaler.update_scale(self.overflow)
         if self.overflow:
             if self.verbose:
-                print(f"OVERFLOW! Skipping step. Reducing loss scale to "
-                      f"{self.loss_scaler.loss_scale}")
+                from apex_tpu.log_util import get_logger
+
+                get_logger("fp16_utils").warning(
+                    "OVERFLOW! Skipping step. Reducing loss scale to %s",
+                    self.loss_scaler.loss_scale)
             return model_params
 
         master_grads = model_grads_to_master_grads(model_grads)

@@ -1,0 +1,45 @@
+"""Package-wide logging — the promotion of transformer/log_util.py.
+
+Reference: apex/transformer/log_util.py — get_transformer_logger /
+set_logging_level, which apex scopes to the transformer subtree only.
+Here the same two-function surface owns the whole ``apex_tpu`` logger
+namespace, so every subsystem (telemetry, checkpointing, amp, fp16_utils)
+shares one diagnostics path instead of bare ``print`` — enforced by
+tests/L0/test_no_stray_prints.py. The transformer helpers survive as thin
+aliases (apex_tpu/transformer/log_util.py).
+"""
+
+from __future__ import annotations
+
+import logging
+
+__all__ = ["get_logger", "set_logging_level"]
+
+_ROOT = "apex_tpu"
+
+# Visible-by-default diagnostics: the reference apex prints its banners
+# ("=> saved step ...", overflow warnings) unconditionally, and Python's
+# unconfigured logging would swallow anything below WARNING — so the
+# package logger gets one stderr handler at INFO unless the embedding
+# application already installed its own. Silence with
+# set_logging_level(logging.WARNING) or replace the handler; propagation
+# stays off so an app-level basicConfig doesn't double-print.
+_root_logger = logging.getLogger(_ROOT)
+if not _root_logger.handlers:
+    _handler = logging.StreamHandler()
+    _handler.setFormatter(logging.Formatter("%(name)s: %(message)s"))
+    _root_logger.addHandler(_handler)
+    _root_logger.setLevel(logging.INFO)
+    _root_logger.propagate = False
+
+
+def get_logger(name: str = "") -> logging.Logger:
+    """Logger under the ``apex_tpu`` namespace: ``get_logger("amp")`` →
+    ``apex_tpu.amp``; no argument → the root package logger."""
+    return logging.getLogger(f"{_ROOT}.{name}" if name else _ROOT)
+
+
+def set_logging_level(verbosity) -> None:
+    """Set the package root logger level (ints or level names, same as
+    the reference's set_logging_level)."""
+    logging.getLogger(_ROOT).setLevel(verbosity)

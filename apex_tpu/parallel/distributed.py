@@ -39,7 +39,16 @@ def average_gradients(grads, axis_name: str = "data",
     by ``world/predivide`` after, so the result is the mean; with
     ``gradient_average=False`` it is the raw sum (apex's
     gradient_average=False path).
+
+    Comm health: the whole-pytree reduction is accounted to the
+    ``comm.ddp.allreduce.*`` telemetry counters (bytes/calls/leaves, at
+    trace time — apex's ``allreduce_bucket`` size accounting; the leaves
+    counter is the bucketing input XLA's combiner coalesces into one op,
+    bench_schedule.py ddp).
     """
+    from apex_tpu import telemetry
+
+    telemetry.account_collective("ddp.allreduce", grads)
     world = jax.lax.psum(1, axis_name)
     pre = gradient_predivide_factor
 

@@ -1,0 +1,71 @@
+"""Run-summary CLI:
+
+    python -m apex_tpu.telemetry summarize run.jsonl [--tag T] [--json]
+                                                      [--trace DIR]
+
+Renders per-metric count/mean/p50/p95/p99 aggregates of a telemetry
+JSONL run file; ``--trace`` additionally joins a ``pyprof.trace``
+capture into a device step-time breakdown (ms/step per HLO category,
+collective-op latency). ``--json`` emits the machine form instead of the
+tables.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .summarize import (load_records, render_breakdown, render_summary,
+                        summarize_records, trace_breakdown)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="python -m apex_tpu.telemetry",
+        description="apex_tpu telemetry tools")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    s = sub.add_parser("summarize",
+                       help="aggregate a telemetry JSONL run file")
+    s.add_argument("run", help="JSONL file a JsonlSink wrote")
+    s.add_argument("--tag", default=None,
+                   help="only records with this tag (default: all)")
+    s.add_argument("--trace", default=None, metavar="DIR",
+                   help="join a pyprof.trace capture: device step-time "
+                        "breakdown + collective latency")
+    s.add_argument("--json", action="store_true",
+                   help="machine-readable output instead of tables")
+    args = p.parse_args(argv)
+
+    try:
+        records = load_records(args.run)
+    except OSError as e:
+        raise SystemExit(str(e))
+    if not records:
+        raise SystemExit(f"no telemetry records in {args.run!r}")
+    summary = summarize_records(records, tag=args.tag)
+
+    breakdown = None
+    if args.trace:
+        n_steps = max(summary["steps"].values(), default=0) \
+            if summary["steps"] else 0
+        try:
+            breakdown = trace_breakdown(args.trace, n_steps)
+        except FileNotFoundError as e:
+            raise SystemExit(str(e))
+
+    if args.json:
+        out = dict(summary)
+        if breakdown is not None:
+            out["device_breakdown"] = breakdown
+        print(json.dumps(out))
+    else:
+        print(render_summary(summary))
+        if breakdown is not None:
+            print()
+            print(render_breakdown(breakdown))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,235 @@
+"""Metrics core: counters, gauges, streaming histograms, step records.
+
+The reference apex has no structured telemetry at all — its observability
+is NVTX ranges (pyprof) and ad-hoc ``print`` in the recipes. This core is
+the missing piece SURVEY §6 calls out: one process-local registry that
+owns every metric a training run produces (host- or device-originated),
+an in-memory ring of per-step records, and pluggable sinks
+(:mod:`apex_tpu.telemetry.sinks`) that stream each record out as it
+lands. Device-side values arrive through
+:func:`apex_tpu.telemetry.emit_metrics` (one ``jax.debug.callback`` per
+step); host-side values through :meth:`MetricsRegistry.counter_inc` /
+``gauge_set`` / ``observe`` directly.
+
+Everything here is plain Python on the host — no jax imports — so the
+registry can absorb callbacks from the runtime's callback threads
+(hence the lock) and be unit-tested without a backend.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from .sinks import Sink
+
+__all__ = ["StreamingHistogram", "StepRecord", "MetricsRegistry"]
+
+#: reserved keys a StepRecord carries besides the caller's metrics
+META_KEYS = ("tag", "seq", "time", "step_time_s")
+
+#: a StepRecord is one JSON-able dict: META_KEYS + the step's metrics
+StepRecord = Dict[str, Any]
+
+
+class StreamingHistogram:
+    """Bounded-memory distribution sketch: exact count/sum/min/max plus a
+    seeded reservoir sample for quantiles (p50/p95/p99 within reservoir
+    sampling error — ample for step-time/latency series of any length).
+
+    Deterministic by construction (fixed-seed RNG per instance) so golden
+    tests and re-runs of ``summarize`` agree bit-for-bit.
+    """
+
+    def __init__(self, reservoir_size: int = 4096, seed: int = 0):
+        if reservoir_size < 1:
+            raise ValueError("reservoir_size must be >= 1")
+        self.reservoir_size = int(reservoir_size)
+        self._rng = random.Random(seed)
+        self._sample: List[float] = []
+        self.count = 0
+        self.total = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+
+    def observe(self, value) -> None:
+        v = float(value)
+        if not math.isfinite(v):
+            # NaN/inf would poison mean/max/quantiles forever — and the
+            # dynamic scaler GUARANTEES an inf grad_norm roughly every
+            # scale_window steps (the growth-probe overflow). Those events
+            # are counted by the found_inf/overflow series; histograms
+            # track the finite distribution only.
+            return
+        self.count += 1
+        self.total += v
+        self.min = min(self.min, v)
+        self.max = max(self.max, v)
+        if len(self._sample) < self.reservoir_size:
+            self._sample.append(v)
+        else:  # Vitter's algorithm R
+            j = self._rng.randrange(self.count)
+            if j < self.reservoir_size:
+                self._sample[j] = v
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+    def quantile(self, q: float) -> float:
+        """Linear-interpolated quantile of the reservoir, q in [0, 1]."""
+        if not self._sample:
+            return 0.0
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"q must be in [0, 1], got {q}")
+        xs = sorted(self._sample)
+        pos = q * (len(xs) - 1)
+        lo = int(math.floor(pos))
+        hi = int(math.ceil(pos))
+        if lo == hi:
+            return xs[lo]
+        frac = pos - lo
+        return xs[lo] * (1.0 - frac) + xs[hi] * frac
+
+    def summary(self) -> Dict[str, float]:
+        if not self.count:
+            return {"count": 0}
+        return {
+            "count": self.count,
+            "mean": self.mean,
+            "p50": self.quantile(0.50),
+            "p95": self.quantile(0.95),
+            "p99": self.quantile(0.99),
+            "min": self.min,
+            "max": self.max,
+        }
+
+
+def _jsonable_scalar(v):
+    """Host scalar for a metric value: numpy/jax 0-d arrays collapse via
+    .item(); bools become 0/1 so every series is numeric in the JSONL.
+    Multi-element arrays fall back to a plain list (JSON-able, kept in
+    the record but not histogrammed) — a raise here would kill the whole
+    step record inside the runtime's callback thread."""
+    if hasattr(v, "item"):
+        try:
+            v = v.item()
+        except (TypeError, ValueError):
+            tolist = getattr(v, "tolist", None)
+            v = tolist() if tolist is not None else float(v)
+    if isinstance(v, bool):
+        return int(v)
+    return v
+
+
+class MetricsRegistry:
+    """Process-local metrics owner: counters, gauges, histograms, a ring
+    of the last ``ring_size`` step records, and the sink fan-out.
+
+    Thread-safe: device callbacks (``jax.debug.callback``) may land on
+    runtime threads while the training loop reads counters from the main
+    thread.
+    """
+
+    def __init__(self, ring_size: int = 1024,
+                 sinks: Optional[List[Sink]] = None,
+                 reservoir_size: int = 4096):
+        from collections import deque
+
+        self._lock = threading.Lock()
+        self.counters: Dict[str, float] = {}
+        self.gauges: Dict[str, float] = {}
+        self.histograms: Dict[str, StreamingHistogram] = {}
+        self.records = deque(maxlen=int(ring_size))
+        self.sinks: List[Sink] = list(sinks or [])
+        self._reservoir_size = reservoir_size
+        self._seq = 0
+        self._last_time: Dict[str, float] = {}   # per-tag, for step_time_s
+
+    # ---------------------------------------------------------- primitives
+    def counter_inc(self, name: str, value: float = 1.0) -> float:
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0.0) + value
+            return self.counters[name]
+
+    def gauge_set(self, name: str, value: float) -> None:
+        with self._lock:
+            self.gauges[name] = float(value)
+
+    def observe(self, name: str, value: float) -> None:
+        """Record one observation into the named histogram."""
+        with self._lock:
+            self._observe_locked(name, value)
+
+    def _observe_locked(self, name: str, value) -> None:
+        h = self.histograms.get(name)
+        if h is None:
+            h = self.histograms[name] = StreamingHistogram(
+                self._reservoir_size)
+        h.observe(value)
+
+    # ---------------------------------------------------------- step records
+    def record_step(self, metrics: Dict[str, Any],
+                    tag: str = "train") -> StepRecord:
+        """Absorb one step's metric dict: stamp host time + sequence,
+        derive ``step_time_s`` (host delta since this tag's previous
+        record — the wall-time-per-step series), feed every numeric value
+        into its histogram, count overflow events, append to the ring,
+        and fan out to the sinks."""
+        now = time.time()
+        with self._lock:
+            rec: StepRecord = {"tag": tag, "seq": self._seq, "time": now}
+            self._seq += 1
+            prev = self._last_time.get(tag)
+            self._last_time[tag] = now
+            if prev is not None:
+                rec["step_time_s"] = now - prev
+                self._observe_locked(f"{tag}.step_time_s",
+                                     rec["step_time_s"])
+            for k, v in metrics.items():
+                v = _jsonable_scalar(v)
+                rec[k] = v
+                if isinstance(v, (int, float)):
+                    self._observe_locked(f"{tag}.{k}", v)
+            # the scaler's found_inf is the overflow-event signal
+            # (SURVEY §6: scale trajectory + overflow events)
+            if rec.get("found_inf"):
+                self.counters["overflow_events"] = \
+                    self.counters.get("overflow_events", 0.0) + 1.0
+            self.records.append(rec)
+            sinks = list(self.sinks)
+        for s in sinks:
+            s.emit(rec)
+        return rec
+
+    # ---------------------------------------------------------- summaries
+    def snapshot(self) -> Dict[str, Any]:
+        """Point-in-time view: counters, gauges, histogram summaries."""
+        with self._lock:
+            return {
+                "counters": dict(self.counters),
+                "gauges": dict(self.gauges),
+                "histograms": {k: h.summary()
+                               for k, h in self.histograms.items()},
+            }
+
+    def emit_snapshot(self, tag: str = "summary") -> StepRecord:
+        """Write the snapshot to the sinks as one final self-describing
+        record (the run's comm-health / aggregate line)."""
+        snap = self.snapshot()
+        rec: StepRecord = {"tag": tag, "seq": self._seq,
+                           "time": time.time(), **snap}
+        with self._lock:
+            self._seq += 1
+            self.records.append(rec)
+            sinks = list(self.sinks)
+        for s in sinks:
+            s.emit(rec)
+        return rec
+
+    def close(self) -> None:
+        for s in self.sinks:
+            s.close()

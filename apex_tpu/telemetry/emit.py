@@ -1,0 +1,115 @@
+"""Device→host metric emission and trace-time comm accounting.
+
+:func:`emit_metrics` is the in-jit primitive: called inside a jitted train
+step it schedules exactly ONE ``jax.debug.callback`` per executed step,
+carrying every metric scalar in a single host transfer — no extra device
+syncs, no per-metric callbacks. The host side lands the bundle in the
+:class:`~apex_tpu.telemetry.MetricsRegistry` (ring buffer + histograms +
+sinks) via ``record_step``.
+
+Trace-time caveat (same rule as ``pyprof.init``): whether telemetry is
+enabled is read when the step is TRACED and baked into the cached
+executable. Flip :func:`apex_tpu.telemetry.enable` (or pass
+``telemetry=`` to ``amp.make_train_step``) before the first call of a
+jitted function, or ``jax.clear_caches()`` after flipping. The sinks and
+the registry, by contrast, are resolved at CALLBACK time, so they can be
+swapped between steps without retracing.
+
+Under ``shard_map``/``pmap`` the callback fires once per mesh shard (each
+rank reports its local values); the one-callback-per-step contract is a
+per-device statement there.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["emit_metrics", "account_collective", "collective_bytes",
+           "global_norm"]
+
+
+def emit_metrics(metrics: Dict[str, Any], tag: str = "train",
+                 registry=None) -> None:
+    """Emit ``{name: scalar}`` from inside (or outside) jit to the
+    registry — one host callback per executed step.
+
+    Values may be traced jax scalars, concrete arrays, or Python numbers.
+    ``registry=None`` resolves the process default at callback time.
+    No-op (nothing staged into the trace at all) while telemetry is
+    disabled at trace time.
+    """
+    import apex_tpu.telemetry as _t
+
+    if not _t.enabled():
+        return
+    names = tuple(sorted(metrics))
+    vals = [jnp.asarray(metrics[k]) for k in names]
+
+    def _land(*host_vals):
+        reg = registry if registry is not None else _t.get_registry()
+        reg.record_step(dict(zip(names, host_vals)), tag=tag)
+
+    jax.debug.callback(_land, *vals)
+
+
+def global_norm(tree) -> jnp.ndarray:
+    """fp32 global L2 norm over a pytree's floating leaves — the
+    grad-norm series the reference recipes compute ad hoc (and apex's
+    ``clip_grad_norm`` computes internally), as one fused reduction."""
+    leaves = [leaf for leaf in jax.tree_util.tree_leaves(tree)
+              if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.inexact)]
+    if not leaves:
+        return jnp.float32(0.0)
+    total = sum(jnp.sum(jnp.square(jnp.asarray(leaf, jnp.float32)))
+                for leaf in leaves)
+    return jnp.sqrt(total)
+
+
+def collective_bytes(tree) -> int:
+    """Payload bytes of one execution of a collective over ``tree`` —
+    computed from static shapes/dtypes, so it works on tracers during
+    jit tracing with zero runtime cost."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            arr = np.asarray(leaf)
+            shape, dtype = arr.shape, arr.dtype
+        total += int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+    return total
+
+
+def account_collective(op: str, tree, registry: Optional[Any] = None) -> None:
+    """Comm-health accounting for one collective call site.
+
+    Counters written (names prefixed ``comm.``):
+
+    - ``comm.<op>.calls``  — traced call sites. Incremented at TRACE
+      time: under jit this counts once per compilation, so after a train
+      step is traced the counter reads the collectives of ONE step's
+      program, not calls × steps.
+    - ``comm.<op>.bytes``  — payload bytes those calls move per
+      execution of their traced program.
+    - ``comm.<op>.leaves`` — pytree leaves handed to the op (bucketing
+      evidence: XLA's combiner merges per-leaf psums — see
+      bench_schedule.py ddp).
+
+    Per-execution device LATENCY for the same ops comes from the
+    profiler join: ``python -m apex_tpu.telemetry summarize run.jsonl
+    --trace DIR`` aggregates the device-lane spans of collective
+    categories into latency stats (docs/telemetry.md §comm health).
+    """
+    import apex_tpu.telemetry as _t
+
+    if not _t.enabled():
+        return
+    reg = registry if registry is not None else _t.get_registry()
+    leaves = jax.tree_util.tree_leaves(tree)
+    reg.counter_inc(f"comm.{op}.calls")
+    reg.counter_inc(f"comm.{op}.bytes", collective_bytes(tree))
+    reg.counter_inc(f"comm.{op}.leaves", len(leaves))

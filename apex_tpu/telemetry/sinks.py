@@ -1,0 +1,135 @@
+"""Pluggable record sinks — where :class:`~apex_tpu.telemetry.MetricsRegistry`
+streams each step record.
+
+The sink protocol is two methods: ``emit(record: dict)`` (called once per
+record, possibly from a runtime callback thread — implementations must be
+self-synchronizing or append-only) and ``close()``. Records are plain
+JSON-able dicts (see ``core.StepRecord``).
+
+Built-ins:
+
+- :class:`JsonlSink`   — one ``json.dumps`` line per record (the run file
+  ``python -m apex_tpu.telemetry summarize`` consumes).
+- :class:`StdoutSink`  — human-greppable ``key=value`` line protocol.
+- :class:`NullSink`    — swallow everything (telemetry structurally wired
+  but a run that wants zero output).
+- :class:`MemorySink`  — append to a list; the test spy that counts
+  callbacks per step.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+from typing import Any, Dict, List
+
+__all__ = ["Sink", "JsonlSink", "StdoutSink", "NullSink", "MemorySink",
+           "make_sink"]
+
+
+class Sink:
+    """Protocol base; subclasses override :meth:`emit`."""
+
+    def emit(self, record: Dict[str, Any]) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class NullSink(Sink):
+    def emit(self, record: Dict[str, Any]) -> None:
+        pass
+
+
+class MemorySink(Sink):
+    """Append-only in-memory sink — the test spy."""
+
+    def __init__(self):
+        self.records: List[Dict[str, Any]] = []
+
+    def emit(self, record: Dict[str, Any]) -> None:
+        self.records.append(record)
+
+
+def _strict_jsonable(v):
+    """Spec-valid JSON values only: Python's json module would emit bare
+    ``Infinity``/``NaN`` tokens (which jq/pandas and every strict JSONL
+    consumer reject), and the dynamic scaler guarantees an inf grad_norm
+    on growth-probe overflow steps — so non-finite floats become
+    ``null``."""
+    if isinstance(v, float) and (v != v or v in (float("inf"),
+                                                 float("-inf"))):
+        return None
+    if isinstance(v, dict):
+        return {k: _strict_jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_strict_jsonable(x) for x in v]
+    return v
+
+
+class JsonlSink(Sink):
+    """One JSON line per record. ``path_or_file`` is a filesystem path
+    (opened for append so crash-guarded reruns accumulate) or any
+    writable file object. Flushes every line by default — the contract is
+    that a crashed run's file is readable up to its last completed step."""
+
+    def __init__(self, path_or_file, flush_every: int = 1):
+        if hasattr(path_or_file, "write"):
+            self._f = path_or_file
+            self._owns = False
+        else:
+            self._f = open(path_or_file, "a")
+            self._owns = True
+        self._flush_every = max(int(flush_every), 1)
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def emit(self, record: Dict[str, Any]) -> None:
+        line = json.dumps(_strict_jsonable(record), default=str)
+        with self._lock:
+            self._f.write(line + "\n")
+            self._n += 1
+            if self._n % self._flush_every == 0:
+                self._f.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            self._f.flush()
+            if self._owns:
+                self._f.close()
+                self._owns = False
+
+
+class StdoutSink(Sink):
+    """Line protocol on stdout: ``telemetry tag=train seq=3 loss=2.31 ...``
+    — greppable live view without a file. (Writes through
+    ``sys.stdout.write``; telemetry sinks and logging are the library's
+    sanctioned output paths, see tests/L0/test_no_stray_prints.py.)"""
+
+    def __init__(self, stream=None):
+        self._stream = stream
+
+    def emit(self, record: Dict[str, Any]) -> None:
+        stream = self._stream or sys.stdout
+        parts = ["telemetry"]
+        for k, v in record.items():
+            if isinstance(v, float):
+                parts.append(f"{k}={v:.6g}")
+            elif isinstance(v, dict):
+                parts.append(f"{k}={json.dumps(v, default=str)}")
+            else:
+                parts.append(f"{k}={v}")
+        stream.write(" ".join(parts) + "\n")
+        stream.flush()
+
+
+def make_sink(spec: str) -> Sink:
+    """Sink from a CLI/env spec: ``"stdout"`` → :class:`StdoutSink`,
+    ``"null"`` → :class:`NullSink`, anything else is a JSONL path."""
+    if spec == "stdout":
+        return StdoutSink()
+    if spec == "null":
+        return NullSink()
+    return JsonlSink(spec)

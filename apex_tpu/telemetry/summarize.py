@@ -1,0 +1,165 @@
+"""Run-file aggregation behind ``python -m apex_tpu.telemetry summarize``.
+
+Consumes the JSONL a :class:`~apex_tpu.telemetry.JsonlSink` wrote (one
+record per step + optional snapshot records) and renders per-metric
+aggregates — count/mean/p50/p95/p99/min/max, through the same
+:class:`~apex_tpu.telemetry.StreamingHistogram` the live registry uses,
+so offline and online numbers agree.
+
+With ``--trace DIR`` it joins a ``pyprof.trace`` capture: the device
+lanes' per-op spans (``pyprof.analyze``) are grouped by HLO category into
+a step-time breakdown (ms/step per category, using the run's step count),
+and collective categories are split out as device-side comm latency —
+the latency half of the comm-health story whose bytes half lives in the
+``comm.*`` counters.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+from .core import META_KEYS, StreamingHistogram
+
+__all__ = ["load_records", "summarize_records", "render_summary",
+           "trace_breakdown", "render_breakdown"]
+
+#: hlo_category substrings that identify collective/communication ops
+COMM_CATEGORIES = ("all-reduce", "all-gather", "all-to-all",
+                   "reduce-scatter", "collective", "copy", "send", "recv")
+
+
+def load_records(path: str) -> List[Dict[str, Any]]:
+    """Parse a telemetry JSONL file; non-JSON and non-dict lines are
+    skipped (a crashed run may end mid-write — the contract is that every
+    complete line is usable)."""
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict):
+                records.append(rec)
+    return records
+
+
+def _is_snapshot(rec: Dict[str, Any]) -> bool:
+    return "counters" in rec or "histograms" in rec
+
+
+def summarize_records(records: List[Dict[str, Any]],
+                      tag: Optional[str] = None) -> Dict[str, Any]:
+    """Aggregate step records into per-metric summaries.
+
+    Returns ``{"metrics": {"<tag>.<name>": summary_dict},
+    "counters": {...}, "steps": {tag: n}}``. ``step_time_s`` (stamped by
+    the registry host-side) aggregates like any other series. Counters
+    come from the LAST snapshot record, if the run emitted one."""
+    hists: Dict[str, StreamingHistogram] = {}
+    steps: Dict[str, int] = {}
+    counters: Dict[str, float] = {}
+    for rec in records:
+        if _is_snapshot(rec):
+            counters = dict(rec.get("counters", {}))
+            continue
+        rtag = rec.get("tag", "train")
+        if tag is not None and rtag != tag:
+            continue
+        steps[rtag] = steps.get(rtag, 0) + 1
+        for k, v in rec.items():
+            if k in META_KEYS and k != "step_time_s":
+                continue
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                continue
+            key = f"{rtag}.{k}"
+            h = hists.get(key)
+            if h is None:
+                h = hists[key] = StreamingHistogram()
+            h.observe(v)
+    return {
+        "metrics": {k: hists[k].summary() for k in sorted(hists)},
+        "counters": counters,
+        "steps": steps,
+    }
+
+
+def render_summary(summary: Dict[str, Any]) -> str:
+    """Aligned text table of :func:`summarize_records` output."""
+    lines = []
+    steps = summary.get("steps", {})
+    if steps:
+        lines.append("steps: " + ", ".join(
+            f"{t}={n}" for t, n in sorted(steps.items())))
+        lines.append("")
+    hdr = (f"{'metric':<32} {'count':>7} {'mean':>12} {'p50':>12} "
+           f"{'p95':>12} {'p99':>12} {'min':>12} {'max':>12}")
+    lines += [hdr, "-" * len(hdr)]
+    for name, s in summary["metrics"].items():
+        if s.get("count", 0) == 0:
+            continue
+        lines.append(
+            f"{name[:32]:<32} {s['count']:>7} {s['mean']:>12.6g} "
+            f"{s['p50']:>12.6g} {s['p95']:>12.6g} {s['p99']:>12.6g} "
+            f"{s['min']:>12.6g} {s['max']:>12.6g}")
+    if summary.get("counters"):
+        lines += ["", f"{'counter':<48} {'value':>14}"]
+        lines.append("-" * 63)
+        for name in sorted(summary["counters"]):
+            v = summary["counters"][name]
+            lines.append(f"{name[:48]:<48} {v:>14,.0f}")
+    return "\n".join(lines)
+
+
+def trace_breakdown(trace_dir: str, n_steps: int) -> Dict[str, Any]:
+    """Join a ``pyprof.trace`` capture with a run's step count: device
+    time per HLO category (total and ms/step) plus per-op latency stats
+    for the collective categories."""
+    from apex_tpu import pyprof
+
+    rows = pyprof.analyze(trace_dir)
+    by_cat: Dict[str, Dict[str, float]] = {}
+    comm_ops = []
+    for r in rows:
+        cat = r.get("category") or "(uncategorized)"
+        c = by_cat.setdefault(cat, {"total_ms": 0.0, "occurrences": 0})
+        c["total_ms"] += r["total_ms"]
+        c["occurrences"] += r["occurrences"]
+        if any(s in cat.lower() or s in r["name"].lower()
+               for s in COMM_CATEGORIES):
+            comm_ops.append({"name": r["name"], "category": cat,
+                             "occurrences": r["occurrences"],
+                             "mean_ms": r["mean_ms"],
+                             "total_ms": r["total_ms"]})
+    total = sum(c["total_ms"] for c in by_cat.values()) or 1.0
+    cats = [{"category": k, "total_ms": v["total_ms"],
+             "occurrences": v["occurrences"],
+             "ms_per_step": v["total_ms"] / max(n_steps, 1),
+             "pct": 100.0 * v["total_ms"] / total}
+            for k, v in by_cat.items()]
+    cats.sort(key=lambda c: -c["total_ms"])
+    comm_ops.sort(key=lambda c: -c["total_ms"])
+    return {"n_steps": n_steps, "categories": cats, "comm_ops": comm_ops}
+
+
+def render_breakdown(bd: Dict[str, Any]) -> str:
+    lines = [f"device step-time breakdown ({bd['n_steps']} steps):"]
+    hdr = (f"{'category':<28} {'n':>7} {'total_ms':>12} "
+           f"{'ms/step':>10} {'%':>6}")
+    lines += [hdr, "-" * len(hdr)]
+    for c in bd["categories"]:
+        lines.append(f"{c['category'][:28]:<28} {c['occurrences']:>7} "
+                     f"{c['total_ms']:>12.3f} {c['ms_per_step']:>10.4f} "
+                     f"{c['pct']:>6.1f}")
+    if bd["comm_ops"]:
+        lines += ["", "comm op device latency:"]
+        hdr = f"{'op':<44} {'n':>7} {'mean_ms':>10} {'total_ms':>12}"
+        lines += [hdr, "-" * len(hdr)]
+        for c in bd["comm_ops"]:
+            lines.append(f"{c['name'][:44]:<44} {c['occurrences']:>7} "
+                         f"{c['mean_ms']:>10.4f} {c['total_ms']:>12.3f}")
+    return "\n".join(lines)

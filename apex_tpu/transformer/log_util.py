@@ -1,12 +1,18 @@
-"""Transformer logging utilities.
+"""Transformer logging utilities — thin aliases over the package-wide
+surface (apex_tpu.log_util — get_logger / set_logging_level).
 
 Reference: apex/transformer/log_util.py — get_transformer_logger,
-set_logging_level. Same tiny surface on stdlib logging.
+set_logging_level. The reference scopes these to the transformer subtree;
+the real implementation now lives at the package root and this module
+keeps the transformer-scoped names (and the ``apex_tpu.transformer``
+logger namespace) for API parity.
 """
 
 from __future__ import annotations
 
 import logging
+
+from ..log_util import get_logger
 
 __all__ = ["get_transformer_logger", "set_logging_level"]
 
@@ -15,10 +21,10 @@ _ROOT = "apex_tpu.transformer"
 
 def get_transformer_logger(name: str = "") -> logging.Logger:
     """Namespaced logger (reference: get_transformer_logger(__name__))."""
-    return logging.getLogger(f"{_ROOT}.{name}" if name else _ROOT)
+    return get_logger(f"transformer.{name}" if name else "transformer")
 
 
 def set_logging_level(verbosity) -> None:
     """Set the shared transformer logger level (reference:
     set_logging_level; accepts ints or level names)."""
-    logging.getLogger(_ROOT).setLevel(verbosity)
+    get_logger("transformer").setLevel(verbosity)

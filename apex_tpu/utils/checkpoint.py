@@ -33,9 +33,13 @@ from typing import Any, Optional, Tuple
 import jax
 import numpy as np
 
+from ..log_util import get_logger
+
 __all__ = ["save_checkpoint", "load_checkpoint", "latest_checkpoint",
            "save_train_checkpoint", "resume_train_checkpoint",
            "AsyncCheckpointer"]
+
+_logger = get_logger("utils.checkpoint")
 
 _META_KEY = "__apex_tpu_meta__"
 
@@ -87,7 +91,7 @@ def save_train_checkpoint(path: str, state: Any, step: int, rng) -> str:
     out = save_checkpoint(path, state, step=step,
                           extra={"rng": np.asarray(rng).tolist(),
                                  "rng_impl": impl})
-    print(f"=> saved step {step} to {path}")
+    _logger.info("=> saved step %s to %s", step, path)
     return out
 
 
@@ -104,7 +108,7 @@ def resume_train_checkpoint(path: str, template: Any, rng, *,
         impl = extra.get("rng_impl")
         if impl:
             rng = jax.random.wrap_key_data(rng, impl=impl)
-    print(f"=> resumed from {path} (step {start})")
+    _logger.info("=> resumed from %s (step %s)", path, start)
     if start >= step_limit:
         raise SystemExit(
             f"--resume checkpoint is at step {start}; {limit_flag} "

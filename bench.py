@@ -41,6 +41,8 @@ from apex_tpu import amp, pyprof
 from apex_tpu.amp.policy import resolve_policy
 from apex_tpu.models.resnet import create_model
 
+METRIC = "resnet50_amp_o2_train_img_per_sec_per_chip"
+
 V100_O2_IMG_PER_SEC = 820.0
 
 # Analytic ResNet-50 cost: ~4.1 GMACs forward per 224x224 image = ~8.2
@@ -88,6 +90,12 @@ def _median(xs):
 
 
 def main():
+    # APEX_TPU_TELEMETRY=run.jsonl|stdout streams per-step telemetry
+    # (loss/grad_norm/scaler trajectory + step_time_s) from inside the
+    # jitted step; unset costs nothing (telemetry baked out at trace time)
+    from apex_tpu import telemetry
+    tele = telemetry.from_env()
+
     model = create_model("resnet50", num_classes=1000, dtype=jnp.bfloat16)
     rng = jax.random.PRNGKey(0)
     x_init = jnp.ones((BATCH, IMAGE, IMAGE, 3), jnp.float32)
@@ -107,7 +115,8 @@ def main():
         return loss, updated["batch_stats"]
 
     init_fn, step_fn = amp.make_train_step(loss_fn, optimizer, policy,
-                                           with_model_state=True)
+                                           with_model_state=True,
+                                           telemetry=tele is not None)
     state = init_fn(params, batch_stats)
     jit_step = jax.jit(step_fn, donate_argnums=(0,))
 
@@ -163,7 +172,7 @@ def main():
     flop_per_img = RESNET50_TRAIN_FLOP_PER_IMG_224 * (IMAGE / 224.0) ** 2
     mfu = img_per_sec * flop_per_img / peak_flops(jax.devices()[0])
     out = {
-        "metric": "resnet50_amp_o2_train_img_per_sec_per_chip",
+        "metric": METRIC,
         "value": round(img_per_sec, 2),
         "unit": "img/s/chip",
         "vs_baseline": round(img_per_sec / V100_O2_IMG_PER_SEC, 4),
@@ -182,8 +191,15 @@ def main():
     }
     if duty:
         out["duty_cycle"] = round(_median(duty), 4)
+    if tele is not None:
+        jax.effects_barrier()      # flush in-flight step callbacks
+        tele.emit_snapshot()
+        tele.close()
     print(json.dumps(out))
 
 
 if __name__ == "__main__":
-    main()
+    # crash contract: any failure still ends in one parseable JSON line
+    # ({"metric", "error", "rc": 1}) — no more "parsed": null bench rows
+    from apex_tpu.telemetry import guard_bench_main
+    guard_bench_main(main, METRIC)

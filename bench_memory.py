@@ -218,4 +218,7 @@ def main(argv):
 
 
 if __name__ == "__main__":
-    main(sys.argv[1:])
+    # crash contract: any failure still ends in one parseable JSON
+    # line ({"metric", "error", "rc": 1}) instead of a bare traceback
+    from apex_tpu.telemetry import guard_bench_main
+    guard_bench_main(lambda: main(sys.argv[1:]), "bench_memory")

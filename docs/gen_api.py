@@ -50,6 +50,8 @@ PAGES = {
               "apex_tpu.utils.sharded_checkpoint", "apex_tpu.utils.pytree",
               "apex_tpu.utils.memory_report",
               "apex_tpu.utils.schedule_report", "apex_tpu.pyprof"],
+    "telemetry": ["apex_tpu.telemetry", "apex_tpu.telemetry.sinks",
+                  "apex_tpu.telemetry.summarize", "apex_tpu.log_util"],
     "contrib": [
         "apex_tpu.contrib.bottleneck", "apex_tpu.contrib.clip_grad",
         "apex_tpu.contrib.conv_bias_relu", "apex_tpu.contrib.cudnn_gbn",

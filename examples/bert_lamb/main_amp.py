@@ -90,6 +90,11 @@ def parse_args(argv=None):
     p.add_argument("--resume", default=None, metavar="CKPT",
                    help="restore a --save checkpoint (full state) and "
                         "continue the same phase")
+    p.add_argument("--telemetry", default=None, metavar="SPEC",
+                   help="stream per-step telemetry (loss, grad norm, "
+                        "scaler trajectory, step time) from inside the "
+                        "jitted step: JSONL path, 'stdout', or 'null'; "
+                        "summarize with python -m apex_tpu.telemetry")
     p.add_argument("--init-checkpoint", default=None, metavar="CKPT",
                    help="DeepLearningExamples --init_checkpoint: load "
                         "ONLY the model params from a --save checkpoint; "
@@ -264,10 +269,16 @@ def main(argv=None):
         nsp_loss = softmax_cross_entropy_loss(nsp_logits, nsp_labels).mean()
         return mlm_loss + nsp_loss
 
+    tele = None
+    if args.telemetry:
+        from apex_tpu import telemetry
+        tele = telemetry.start_run(args.telemetry)
+
     dp = args.data_parallel
     init_fn, step_fn = amp.make_train_step(
         loss_fn, optimizer, policy,
-        grad_average_axis="data" if dp > 1 else None)
+        grad_average_axis="data" if dp > 1 else None,
+        telemetry=tele is not None)
     start_it = 0
     if args.init_checkpoint:
         params = _phase_handoff_params(args.init_checkpoint, init_fn,
@@ -350,6 +361,10 @@ def main(argv=None):
                       f"{float(metrics['loss']):.4f} "
                       f"loss_scale {float(metrics['loss_scale']):g}")
     jax.tree_util.tree_leaves(state.params)[0].block_until_ready()
+    if tele is not None:
+        jax.effects_barrier()      # flush in-flight step callbacks
+        tele.emit_snapshot()       # final aggregate + comm-health line
+        tele.close()
     if t0 is not None and args.max_steps - start_it > 5:
         dt = time.perf_counter() - t0
         print(f"throughput: "

@@ -68,6 +68,11 @@ def parse_args(argv=None):
                         "(observation-only — runs on a copy of the "
                         "state; n/a without device lanes)")
     p.add_argument("--prof", type=int, default=0)
+    p.add_argument("--telemetry", default=None, metavar="SPEC",
+                   help="stream per-step telemetry (loss, grad norm, "
+                        "scaler trajectory, step time) from inside the "
+                        "jitted step: JSONL path, 'stdout', or 'null'; "
+                        "summarize with python -m apex_tpu.telemetry")
     p.add_argument("--deterministic", action="store_true")
     p.add_argument("--resume", default=None,
                    help="checkpoint file (or dir: newest ckpt) to resume")
@@ -312,9 +317,15 @@ def main(argv=None):
         optax.sgd(schedule, momentum=args.momentum),
     )
 
+    tele = None
+    if args.telemetry:
+        from apex_tpu import telemetry
+        tele = telemetry.start_run(args.telemetry)
+
     init_fn, step_fn = amp.make_train_step(
         make_loss_fn(model), optimizer, policy, has_aux=True,
-        with_model_state=True, grad_average_axis=axis_name)
+        with_model_state=True, grad_average_axis=axis_name,
+        telemetry=tele is not None)
     state = init_fn(params, model_state)
 
     if axis_name is not None:
@@ -457,6 +468,10 @@ def main(argv=None):
                 args.batch_size, "img/s")
             if line:
                 print(line)
+    if tele is not None:
+        jax.effects_barrier()      # flush in-flight step callbacks
+        tele.emit_snapshot()       # final aggregate + comm-health line
+        tele.close()
     print(f"=> best Prec@1 {best_prec1:.3f}")
     return state
 

@@ -129,6 +129,12 @@ def parse_args(argv=None):
     p.add_argument("--layers", type=int, default=None,
                    help="override the size preset's layer count (parallel "
                         "path; must divide by pp*vpp)")
+    p.add_argument("--telemetry", default=None, metavar="SPEC",
+                   help="stream per-step telemetry (loss, grad norm, "
+                        "scaler trajectory, step time) from inside the "
+                        "jitted step: JSONL path, 'stdout', or 'null'; "
+                        "summarize with python -m apex_tpu.telemetry "
+                        "(sharded paths emit one record per rank)")
     return p.parse_args(argv)
 
 
@@ -599,7 +605,8 @@ def build_parallel_lm(args, policy):
     init_fn, step_fn = amp.make_train_step(
         None, optimizer, policy, grad_fn=grad_fn,
         grad_average_axis=grad_avg_axis,
-        overflow_sync_axes=sync or None)
+        overflow_sync_axes=sync or None,
+        telemetry=bool(args.telemetry))
 
     params = init_params(jax.random.PRNGKey(args.seed))
     params["stages"] = jax.tree_util.tree_map(
@@ -863,6 +870,7 @@ def run_parallel(args, policy):
         raise SystemExit("--remat is not supported on the model-parallel "
                          "path (the 1F1B schedule already recomputes "
                          "in-backward); drop the flag")
+    tele = _maybe_telemetry(args)   # sink must exist before the first step
     mesh, state, jit_step, n_params = build_parallel_lm(args, policy)
     print(f"=> LM {args.size} dp={args.data_parallel} "
           f"tp={args.tensor_parallel} pp={args.pipeline_parallel} "
@@ -908,12 +916,30 @@ def run_parallel(args, policy):
               f"{(toks - args.batch_size * args.seq_len) / dt:,.0f} tokens/s")
     _maybe_prof_device(args, jit_step, state, batch)
     _maybe_save(args, state, rng)
+    _finish_telemetry(tele)
     metrics = dict(metrics)
     metrics["final_state"] = state
     # one device-to-host transfer for the whole history
     metrics["loss_history"] = np.asarray(jnp.stack(loss_history),
                                          np.float32).tolist()
     return metrics
+
+
+def _maybe_telemetry(args):
+    """--telemetry SPEC: fresh default registry + sink (JSONL path,
+    'stdout', 'null'); the step's in-jit emission lands there."""
+    if not args.telemetry:
+        return None
+    from apex_tpu import telemetry
+    return telemetry.start_run(args.telemetry)
+
+
+def _finish_telemetry(tele):
+    if tele is None:
+        return
+    jax.effects_barrier()      # flush in-flight step callbacks
+    tele.emit_snapshot()       # final aggregate + comm-health line
+    tele.close()
 
 
 def _maybe_resume(args, state, rng):
@@ -1007,7 +1033,9 @@ def main(argv=None):
                                                 smoothing=args.smoothing)
             return losses.mean()
 
-    init_fn, step_fn = amp.make_train_step(loss_fn, optimizer, policy)
+    tele = _maybe_telemetry(args)
+    init_fn, step_fn = amp.make_train_step(loss_fn, optimizer, policy,
+                                           telemetry=tele is not None)
     state = init_fn(params)
     jit_step = jax.jit(step_fn, donate_argnums=(0,))
 
@@ -1049,9 +1077,11 @@ def main(argv=None):
         print(f"throughput: "
               f"{(toks - args.batch_size * args.seq_len) / dt:,.0f} tokens/s")
     if metrics is None:
+        _finish_telemetry(tele)
         return None
     _maybe_prof_device(args, jit_step, state, batch)
     _maybe_save(args, state, rng)
+    _finish_telemetry(tele)
     metrics = dict(metrics)
     metrics["final_state"] = state
     # one device-to-host transfer for the whole history

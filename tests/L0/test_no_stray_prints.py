@@ -1,0 +1,61 @@
+"""Library output discipline: no bare ``print(`` statements inside
+``apex_tpu/`` outside the CLI entry points.
+
+Telemetry sinks and ``apex_tpu.get_logger`` are the sanctioned output
+paths — a library that prints can't be silenced, redirected, or parsed
+(the reference apex prints freely; this port routes everything through
+logging/telemetry). Only the CLI ``__main__.py`` modules, whose job IS
+stdout, may print.
+"""
+
+import os
+import re
+
+import pytest
+
+pytestmark = pytest.mark.telemetry
+
+#: the sanctioned CLI entry points, relative to apex_tpu/
+CLI_ALLOWLIST = {
+    os.path.join("pyprof", "__main__.py"),
+    os.path.join("telemetry", "__main__.py"),
+    os.path.join("parallel", "multiproc.py"),
+}
+
+# statement-position print: start of line (any indent) — excludes
+# docstring examples (">>> print("), methods (.print_exc), and comments
+_PRINT_RE = re.compile(r"^\s*print\(", re.MULTILINE)
+
+
+def _package_root():
+    import apex_tpu
+    return os.path.dirname(os.path.abspath(apex_tpu.__file__))
+
+
+def test_no_bare_print_outside_cli_entry_points():
+    root = _package_root()
+    offenders = []
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, root)
+            if rel in CLI_ALLOWLIST:
+                continue
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+            for m in _PRINT_RE.finditer(src):
+                line_no = src.count("\n", 0, m.start()) + 1
+                offenders.append(f"{rel}:{line_no}")
+    assert not offenders, (
+        "bare print( in library code (use apex_tpu.get_logger or a "
+        "telemetry sink; only CLI __main__ modules may print): "
+        + ", ".join(offenders))
+
+
+def test_allowlist_entries_exist():
+    """The allowlist must not rot: every sanctioned path is a real file."""
+    root = _package_root()
+    for rel in CLI_ALLOWLIST:
+        assert os.path.isfile(os.path.join(root, rel)), rel

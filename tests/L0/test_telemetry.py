@@ -1,0 +1,360 @@
+"""apex_tpu.telemetry — registry/histogram math, JSONL round-trip, the
+one-callback-per-step contract under jit, overflow-event emission from a
+forced inf grad, comm accounting, the bench crash contract, and the
+summarize CLI on a golden run file."""
+
+import json
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import apex_tpu.telemetry as telemetry
+from apex_tpu.amp import init_scaler, make_train_step, resolve_policy
+from apex_tpu.telemetry import (JsonlSink, MemorySink, MetricsRegistry,
+                                StreamingHistogram)
+from apex_tpu.telemetry.summarize import (load_records, render_summary,
+                                          summarize_records)
+
+pytestmark = pytest.mark.telemetry
+
+
+@pytest.fixture
+def spy_registry():
+    """Fresh default registry with a MemorySink spy; the previous default
+    is restored afterwards so tests don't leak sinks into each other."""
+    old = telemetry.get_registry()
+    spy = MemorySink()
+    reg = telemetry.configure(sinks=[spy])
+    yield reg, spy
+    telemetry.set_registry(old)
+
+
+# --------------------------------------------------------------- histogram
+
+def test_streaming_histogram_exact_stats_and_quantiles():
+    h = StreamingHistogram()
+    for v in range(1, 101):          # 1..100, all inside the reservoir
+        h.observe(float(v))
+    s = h.summary()
+    assert s["count"] == 100
+    assert s["min"] == 1.0 and s["max"] == 100.0
+    assert s["mean"] == pytest.approx(50.5)
+    # exact linear-interpolated quantiles of 1..100
+    assert s["p50"] == pytest.approx(50.5)
+    assert s["p95"] == pytest.approx(95.05)
+    assert s["p99"] == pytest.approx(99.01)
+
+
+def test_streaming_histogram_reservoir_bounded_and_deterministic():
+    a = StreamingHistogram(reservoir_size=64)
+    b = StreamingHistogram(reservoir_size=64)
+    for v in range(10_000):
+        a.observe(v)
+        b.observe(v)
+    assert len(a._sample) == 64
+    assert a.count == 10_000 and a.total == b.total
+    # fixed-seed RNG: two identically-fed instances agree bit-for-bit
+    assert a.summary() == b.summary()
+    # the reservoir median of uniform 0..9999 lands near the middle
+    assert 2000 < a.quantile(0.5) < 8000
+
+
+def test_streaming_histogram_skips_nan_counts_real():
+    h = StreamingHistogram()
+    h.observe(1.0)
+    h.observe(float("nan"))
+    h.observe(3.0)
+    assert h.count == 2
+    assert h.mean == pytest.approx(2.0)
+    assert not math.isnan(h.quantile(0.5))
+
+
+# ---------------------------------------------------------------- registry
+
+def test_registry_counters_gauges_and_ring():
+    reg = MetricsRegistry(ring_size=4)
+    assert reg.counter_inc("n") == 1.0
+    assert reg.counter_inc("n", 2.5) == 3.5
+    reg.gauge_set("g", 7)
+    for i in range(10):
+        reg.record_step({"loss": float(i)})
+    assert len(reg.records) == 4                       # ring evicts oldest
+    assert [r["loss"] for r in reg.records] == [6.0, 7.0, 8.0, 9.0]
+    snap = reg.snapshot()
+    assert snap["counters"]["n"] == 3.5
+    assert snap["gauges"]["g"] == 7.0
+    assert snap["histograms"]["train.loss"]["count"] == 10
+
+
+def test_registry_step_time_and_overflow_counter():
+    reg = MetricsRegistry()
+    reg.record_step({"found_inf": 0})
+    rec = reg.record_step({"found_inf": True})
+    assert "step_time_s" in rec and rec["step_time_s"] >= 0.0
+    assert rec["found_inf"] == 1                       # bool → numeric
+    reg.record_step({"found_inf": np.bool_(True)})
+    assert reg.counters["overflow_events"] == 2.0
+    assert reg.histograms["train.step_time_s"].count == 2
+
+
+def test_registry_snapshot_record_reaches_sinks():
+    spy = MemorySink()
+    reg = MetricsRegistry(sinks=[spy])
+    reg.record_step({"loss": 1.0})
+    reg.counter_inc("comm.all_reduce.bytes", 4096)
+    final = reg.emit_snapshot()
+    assert spy.records[-1] is final
+    assert final["counters"]["comm.all_reduce.bytes"] == 4096
+    assert final["tag"] == "summary"
+
+
+# ------------------------------------------------------------------- JSONL
+
+def test_jsonl_sink_round_trip(tmp_path):
+    path = tmp_path / "run.jsonl"
+    sink = JsonlSink(str(path))
+    reg = MetricsRegistry(sinks=[sink])
+    for i in range(3):
+        reg.record_step({"loss": float(i), "loss_scale": 256.0})
+    reg.emit_snapshot()
+    reg.close()
+    records = load_records(str(path))
+    assert len(records) == 4
+    assert [r["loss"] for r in records[:3]] == [0.0, 1.0, 2.0]
+    assert records[3]["histograms"]["train.loss"]["count"] == 3
+    # a crashed run's truncated last line is skipped, not fatal
+    with open(path, "a") as f:
+        f.write('{"loss": 9, "tru')
+    assert len(load_records(str(path))) == 4
+
+
+# ------------------------------------------------- in-jit emission contract
+
+def _amp_setup(telemetry_opt):
+    def loss_fn(params, batch):
+        x, y = batch
+        pred = x @ params["w"].astype(x.dtype)
+        return jnp.mean((pred.astype(jnp.float32) - y) ** 2)
+
+    policy = resolve_policy("O2", half_dtype=jnp.float16, verbose=False)
+    init_fn, step_fn = make_train_step(loss_fn, optax.sgd(0.1), policy,
+                                       telemetry=telemetry_opt)
+    state = init_fn({"w": jnp.ones((4, 2), jnp.float32)})
+    state = state.replace(scaler=init_scaler("dynamic", init_scale=256.0))
+    x = jnp.ones((8, 4), jnp.float32)
+    y = jnp.zeros((8, 2), jnp.float32)
+    return jax.jit(step_fn), state, (x, y)
+
+
+def test_amp_step_exactly_one_callback_per_step(spy_registry):
+    """The acceptance contract: N executed steps of the jitted amp O2
+    train step produce exactly N host callbacks (== N spy records), each
+    bundling >= 5 distinct metric series."""
+    reg, spy = spy_registry
+    step, state, batch = _amp_setup(True)
+    n = 7
+    for _ in range(n):
+        state, _ = step(state, batch)
+    jax.effects_barrier()
+    assert len(spy.records) == n
+    series = set(spy.records[0]) - {"tag", "seq", "time", "step_time_s"}
+    assert {"loss", "grad_norm", "loss_scale", "found_inf",
+            "overflows"} <= series
+    assert all(r["tag"] == "amp" for r in spy.records)
+    # host-side wall time per step rides along from the second record on
+    assert all("step_time_s" in r for r in spy.records[1:])
+    assert reg.histograms["amp.loss"].count == n
+
+
+def test_amp_step_telemetry_off_stages_nothing(spy_registry):
+    _, spy = spy_registry
+    step, state, batch = _amp_setup(False)
+    for _ in range(3):
+        state, _ = step(state, batch)
+    jax.effects_barrier()
+    assert spy.records == []
+
+
+def test_amp_step_pinned_registry_bypasses_default(spy_registry):
+    _, default_spy = spy_registry
+    pinned_spy = MemorySink()
+    pinned = MetricsRegistry(sinks=[pinned_spy])
+    step, state, batch = _amp_setup(pinned)
+    state, _ = step(state, batch)
+    jax.effects_barrier()
+    assert len(pinned_spy.records) == 1
+    assert default_spy.records == []
+
+
+def test_forced_inf_grad_emits_overflow_event(spy_registry):
+    reg, spy = spy_registry
+    step, state, batch = _amp_setup(True)
+    x, y = batch
+    state, _ = step(state, (x, y))                       # clean step
+    bad = (x.at[0, 0].set(jnp.float32(1e30)), y)         # overflows f16
+    state, metrics = step(state, bad)
+    jax.effects_barrier()
+    assert bool(metrics["found_inf"])
+    clean, overflowed = spy.records
+    assert clean["found_inf"] == 0 and overflowed["found_inf"] == 1
+    # record_step counted the event and the scaler trajectory moved
+    assert reg.counters["overflow_events"] == 1.0
+    assert overflowed["loss_scale"] == 256.0             # scale AT the step
+    assert float(state.scaler.loss_scale) == 128.0       # halved after
+
+
+def test_emit_metrics_outside_jit(spy_registry):
+    reg, spy = spy_registry
+    telemetry.emit_metrics({"x": jnp.float32(2.0), "y": 3}, tag="eager")
+    jax.effects_barrier()
+    (rec,) = spy.records
+    assert rec["tag"] == "eager" and rec["x"] == 2.0 and rec["y"] == 3
+
+
+# ------------------------------------------------------------- comm health
+
+def test_account_collective_counters(spy_registry):
+    reg, _ = spy_registry
+    from apex_tpu import comm
+
+    tree = {"a": jnp.zeros((8, 4), jnp.float32),
+            "b": jnp.zeros((16,), jnp.bfloat16)}
+    telemetry.account_collective("ddp.allreduce", tree)
+    assert reg.counters["comm.ddp.allreduce.calls"] == 1.0
+    assert reg.counters["comm.ddp.allreduce.bytes"] == 8 * 4 * 4 + 16 * 2
+    assert reg.counters["comm.ddp.allreduce.leaves"] == 2.0
+
+    # the comm collectives account at trace time — once per compilation
+    mesh_devs = jax.devices()[:2]
+    if len(mesh_devs) == 2:
+        from jax.sharding import Mesh, PartitionSpec as P
+        from jax.experimental.shard_map import shard_map
+
+        mesh = Mesh(np.array(mesh_devs), ("data",))
+        f = shard_map(lambda x: comm.all_reduce(x, "data"), mesh=mesh,
+                      in_specs=P("data"), out_specs=P())
+        jax.jit(f)(jnp.ones((2, 3), jnp.float32))
+        assert reg.counters["comm.all_reduce.calls"] == 1.0
+        assert reg.counters["comm.all_reduce.bytes"] == 1 * 3 * 4
+
+
+def test_timed_context_manager(spy_registry):
+    reg, _ = spy_registry
+    with telemetry.timed("ckpt.save"):
+        pass
+    assert reg.counters["ckpt.save.calls"] == 1.0
+    assert reg.histograms["ckpt.save"].count == 1
+
+
+# ------------------------------------------------------ bench crash contract
+
+def test_guard_bench_main_failure_ends_in_json_line(capsys):
+    def exploding_main():
+        raise RuntimeError("backend init failed")
+
+    with pytest.raises(SystemExit) as exc:
+        telemetry.guard_bench_main(exploding_main, "resnet50_img_per_sec")
+    assert exc.value.code == 1
+    last = capsys.readouterr().out.strip().splitlines()[-1]
+    parsed = json.loads(last)
+    assert parsed == {"metric": "resnet50_img_per_sec",
+                      "error": "RuntimeError: backend init failed",
+                      "rc": 1}
+
+
+def test_guard_bench_main_success_passes_through(capsys):
+    assert telemetry.guard_bench_main(lambda: 42, "m") == 42
+    with pytest.raises(SystemExit) as exc:      # clean exits untouched
+        telemetry.guard_bench_main(lambda: (_ for _ in ()).throw(
+            SystemExit(0)), "m")
+    assert exc.value.code == 0
+
+
+# -------------------------------------------------------------- summarize
+
+GOLDEN = os.path.join(os.path.dirname(__file__), os.pardir, "data",
+                      "telemetry_golden.jsonl")
+
+
+def test_summarize_golden_run_file():
+    records = load_records(GOLDEN)
+    summary = summarize_records(records)
+    assert summary["steps"] == {"amp": 8}
+    loss = summary["metrics"]["amp.loss"]
+    assert loss["count"] == 8
+    assert loss["mean"] == pytest.approx(4.5)
+    assert loss["p50"] == pytest.approx(4.5)
+    assert loss["p95"] == pytest.approx(7.65)
+    # counters come from the run's final snapshot record
+    assert summary["counters"]["overflow_events"] == 1
+    text = render_summary(summary)
+    assert "amp.loss" in text and "p95" in text and "overflow_events" in text
+
+
+def test_summarize_cli_on_golden_file(capsys):
+    from apex_tpu.telemetry.__main__ import main
+
+    assert main(["summarize", GOLDEN]) == 0
+    out = capsys.readouterr().out
+    for col in ("count", "mean", "p50", "p95"):
+        assert col in out
+    assert "amp.loss" in out and "steps: amp=8" in out
+
+    assert main(["summarize", GOLDEN, "--json"]) == 0
+    machine = json.loads(capsys.readouterr().out)
+    assert machine["metrics"]["amp.loss"]["count"] == 8
+
+
+def test_summarize_cli_rejects_empty_file(tmp_path):
+    from apex_tpu.telemetry.__main__ import main
+
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    with pytest.raises(SystemExit):
+        main(["summarize", str(empty)])
+
+
+# ------------------------------------------------------------ env opt-in
+
+def test_from_env_unset_is_noop(monkeypatch):
+    monkeypatch.delenv(telemetry.ENV_VAR, raising=False)
+    before = telemetry.get_registry()
+    assert telemetry.from_env() is None
+    assert telemetry.get_registry() is before
+
+
+def test_from_env_starts_run(monkeypatch, tmp_path):
+    old = telemetry.get_registry()
+    path = tmp_path / "env.jsonl"
+    monkeypatch.setenv(telemetry.ENV_VAR, str(path))
+    try:
+        reg = telemetry.from_env()
+        assert reg is telemetry.get_registry() and reg is not old
+        reg.record_step({"loss": 1.0})
+        reg.close()
+        assert len(load_records(str(path))) == 1
+    finally:
+        telemetry.set_registry(old)
+
+
+# ------------------------------------------------------- logging promotion
+
+def test_get_logger_namespace_and_transformer_alias():
+    import logging
+
+    import apex_tpu
+    from apex_tpu.transformer.log_util import (get_transformer_logger,
+                                               set_logging_level)
+
+    assert apex_tpu.get_logger("amp").name == "apex_tpu.amp"
+    assert apex_tpu.get_logger().name == "apex_tpu"
+    # the transformer helpers are thin aliases over the same namespace
+    assert get_transformer_logger("x").name == "apex_tpu.transformer.x"
+    set_logging_level(logging.DEBUG)
+    assert logging.getLogger("apex_tpu.transformer").level == logging.DEBUG
+    set_logging_level(logging.WARNING)
