@@ -42,7 +42,8 @@ __all__ = [
     "Policy", "LossScaler", "ScalerState", "opt_levels", "resolve_policy",
     "initialize", "scale_loss", "master_params", "state_dict",
     "load_state_dict", "init_scaler", "scaler_metrics", "unscale",
-    "unscale_with_stashed", "update_scale", "make_train_step", "AmpState",
+    "unscale_with_stashed", "update_scale", "make_train_step",
+    "to_microbatches", "AmpState",
     "half_function", "float_function", "promote_function",
     "register_half_function", "register_float_function",
     "register_promote_function",
@@ -231,7 +232,19 @@ def scale_loss(loss, optimizer=None, loss_id=0, model=None,
 
     Yields the scaled loss; user differentiates it however they like and later
     calls ``scaler.unscale``/``update_scale`` — or, preferably, uses
-    :func:`make_train_step` which does all of this inside jit.
+    :func:`make_train_step` which does all of this inside jit
+    (``accum_steps=N`` for the compiled equivalent of the pattern below).
+
+    ``delay_unscale=True`` is apex's gradient-accumulation handshake: the
+    scaler schedule does NOT advance on exit, and the caller defers
+    unscaling by stashing grads across iterations —
+    ``stash = scaler.unscale(grads)`` on the first microbatch, then
+    ``stash = scaler.unscale_with_stashed(grads, stash)`` (the
+    ``amp_C.multi_tensor_axpby`` fusion; flat 1-D buffers route through
+    ``kernels.multi_tensor.fused_axpby``) on the rest. Overflow flags
+    OR-accumulate across the window, so ``update_scale()`` on the final
+    (``delay_unscale=False``) iteration skips/backs off once per window —
+    stashed-grad parity with apex's delayed path.
     """
     if not _amp_state.loss_scalers:
         _amp_state.loss_scalers = [LossScaler("dynamic")]
@@ -301,6 +314,35 @@ class AmpState:
         return AmpState(**vals)
 
 
+def to_microbatches(batch, accum_steps: int):
+    """Reshape every array leaf ``[B, ...]`` → ``[N, B/N, ...]`` — the
+    leading microbatch scan axis :func:`make_train_step`'s
+    ``accum_steps=N`` expects. Works on jax and numpy leaves alike (host
+    pipelines can reshape before ``device_put``); identity at ``N=1`` so
+    data paths stay shape-stable. Leaves whose leading dim doesn't
+    divide raise. PRNG keys are leaves too: exclude them and split
+    per-microbatch instead (``jax.random.split(key, N)``) — a reshape
+    would duplicate, not fork, the randomness."""
+    accum_steps = int(accum_steps)
+    if accum_steps == 1:
+        return batch
+
+    def one(a):
+        if not getattr(a, "ndim", 0):
+            raise ValueError(
+                "to_microbatches needs a leading batch dim on every "
+                f"leaf; got a scalar leaf {a!r} — reshape only the "
+                "batched leaves (and split PRNG keys) yourself")
+        b = a.shape[0]
+        if b % accum_steps:
+            raise ValueError(
+                f"leading batch dim {b} does not divide by "
+                f"accum_steps {accum_steps}")
+        return a.reshape((accum_steps, b // accum_steps) + a.shape[1:])
+
+    return jax.tree_util.tree_map(one, batch)
+
+
 def make_train_step(loss_fn: Callable, optimizer, policy: Policy,
                     has_aux: bool = False,
                     is_norm_param: Optional[Callable] = None,
@@ -310,7 +352,9 @@ def make_train_step(loss_fn: Callable, optimizer, policy: Policy,
                     grad_average_mask=None,
                     overflow_sync_axes=None,
                     grad_fn: Optional[Callable] = None,
-                    telemetry=False):
+                    telemetry=False,
+                    accum_steps: int = 1,
+                    accum_dtype=jnp.float32):
     """Build ``(init_fn, step_fn)`` implementing the apex iteration (§4.2 of
     the survey) as one jitted function.
 
@@ -352,6 +396,17 @@ def make_train_step(loss_fn: Callable, optimizer, policy: Policy,
     skipped step (apex/amp/_process_optimizer.py skips ``optimizer.step``
     entirely), and the loss scale halves via the scaler schedule.
 
+    Skip-on-overflow is implemented as a scalar-predicate select, so the
+    INVARIANT a swapped-in optimizer must honor is: ``optimizer.update``
+    must be TOTAL on non-finite grads — it is evaluated unconditionally
+    (both sides of the select exist in the traced program), and an update
+    that raised, asserted, or produced side effects on inf/NaN inputs
+    would fire on every overflow step even though its result is
+    discarded. Every optax/apex_tpu optimizer satisfies this (pure
+    arithmetic: garbage in, discarded garbage out); a custom
+    transformation with host callbacks or value-dependent python control
+    flow would not.
+
     ``grad_fn``: custom loss+gradient producer replacing the internal
     ``jax.grad`` — the composition point for hand-scheduled backward passes
     (pipeline 1F1B). Signature
@@ -360,8 +415,42 @@ def make_train_step(loss_fn: Callable, optimizer, policy: Policy,
     (exactly what ``forward_backward_1f1b(..., loss_scale=...)`` returns) —
     everything downstream (grad averaging, unscale, found_inf skip-step,
     master-weight copy, scaler schedule) applies unchanged. When given,
-    ``loss_fn`` is ignored and may be None; incompatible with ``has_aux``
-    and ``with_model_state``.
+    ``loss_fn`` is ignored and may be None; incompatible with ``has_aux``,
+    ``with_model_state``, and ``accum_steps`` (see below).
+
+    ``accum_steps``: microbatch gradient accumulation INSIDE the jitted
+    step — apex's large-batch recipe (``amp.scale_loss(...,
+    delay_unscale=True)`` + ``amp_C.multi_tensor_axpby``), compiled. With
+    ``accum_steps=N > 1`` the step takes a batch whose every leaf carries
+    a leading microbatch axis of size N (``[N, B/N, ...]``) and runs a
+    ``lax.scan`` over the N microbatches, accumulating the SCALED grads
+    into an ``accum_dtype`` accumulator (fp32 by default; pass the model
+    dtype to halve accumulator HBM at apex-O3-style risk). Grad
+    averaging (the ``grad_average_axis`` psum), unscale + ``found_inf``,
+    the overflow-skip select, the optimizer update, and the scaler
+    schedule then run ONCE per window — cutting DDP allreduce traffic
+    and scaler/unscale arithmetic N× per optimizer step (certified by
+    the ``comm.ddp.allreduce.*`` trace-time counters and the
+    ``bench_schedule.py ddp_accum`` scheduled-HLO leg). Semantics:
+
+    - the reported/optimized ``loss`` is the MEAN over the window's
+      microbatches (grads are averaged by N before unscale), so a window
+      equals one step on the concatenated batch up to reduction order;
+    - a non-finite grad in ANY microbatch poisons the accumulator
+      (inf/NaN survive summation), so the WHOLE window is skipped with
+      optimizer state frozen and the scale backed off once —
+      ``delay_unscale=True``'s deferred overflow check;
+    - the scaler schedule advances once per WINDOW (``scale_window``
+      counts optimizer steps, not microbatches), identical to apex
+      skipping ``update_scale`` on delayed iterations;
+    - ``model_state`` threads through the scan carry (microbatch i+1
+      sees microbatch i's BatchNorm stats); under ``has_aux`` the aux is
+      stacked over the window (leading axis N);
+    - telemetry emits ONE callback per window, with ``accum_steps`` in
+      the record;
+    - incompatible with ``grad_fn``: hand-scheduled producers (1F1B)
+      stream their own microbatches — compose accumulation OUTSIDE such
+      a producer by summing its scaled grads across windows yourself.
 
     ``telemetry``: truthy bakes structured in-jit telemetry into the
     step — ONE ``jax.debug.callback`` per executed step streams the
@@ -378,6 +467,15 @@ def make_train_step(loss_fn: Callable, optimizer, policy: Policy,
         raise ValueError("grad_fn is incompatible with has_aux/"
                          "with_model_state — the custom producer returns "
                          "only (loss, grads)")
+    accum_steps = int(accum_steps)
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    if grad_fn is not None and accum_steps > 1:
+        raise ValueError(
+            "accum_steps is incompatible with grad_fn — hand-scheduled "
+            "producers (pipeline 1F1B) already stream their own "
+            "microbatches; to accumulate across windows, sum the SCALED "
+            "grads your grad_fn returns outside this step instead")
 
     def init_fn(params, model_state=None):
         params32 = jax.tree_util.tree_map(
@@ -407,22 +505,28 @@ def make_train_step(loss_fn: Callable, optimizer, policy: Policy,
             # the model in the compute dtype; int leaves untouched.
             batch = policy.cast_to_compute(batch)
 
-        def scaled_loss_fn(p):
+        def scaled_loss_fn(p, mstate, mb):
             if with_model_state:
-                out = loss_fn(p, state.model_state, batch)
+                out = loss_fn(p, mstate, mb)
                 if has_aux:
-                    loss, (mstate, aux) = out
+                    loss, (ms, aux) = out
                 else:
-                    loss, mstate = out
+                    loss, ms = out
                     aux = None
             else:
-                out = loss_fn(p, batch)
+                out = loss_fn(p, mb)
                 if has_aux:
                     loss, aux = out
                 else:
                     loss, aux = out, None
-                mstate = None
-            return _scale_loss_fn(loss, scaler), (loss, aux, mstate)
+                ms = None
+            return _scale_loss_fn(loss, scaler), (loss, aux, ms)
+
+        def mb_grads(mstate, mb):
+            """SCALED grads + (unscaled loss, aux, new model_state) of one
+            microbatch — the per-iteration backward of apex's recipe."""
+            return jax.grad(lambda p: scaled_loss_fn(p, mstate, mb),
+                            has_aux=True)(state.params)
 
         # O1 engine active for the whole traced forward+backward: FP32_FUNCS
         # ops (softmax/norms/losses) lift themselves to fp32, FP16_FUNCS
@@ -433,9 +537,53 @@ def make_train_step(loss_fn: Callable, optimizer, policy: Policy,
                 loss, grads = grad_fn(state.params, batch,
                                       scaler.loss_scale)
                 aux, new_model_state = None, None
+            elif accum_steps == 1:
+                grads, (loss, aux, new_model_state) = mb_grads(
+                    state.model_state, batch)
             else:
-                grads, (loss, aux, new_model_state) = jax.grad(
-                    scaled_loss_fn, has_aux=True)(state.params)
+                # apex delay_unscale=True, compiled: SCALED grads
+                # accumulate across the window (axpby with a=b=1 here;
+                # the single 1/scale pass comes after the loop), losses
+                # average, and every per-step reduction below this scan
+                # — psum, unscale, found_inf, optimizer, scaler — runs
+                # once per WINDOW. A non-finite microbatch grad survives
+                # the summation (inf+x=inf, inf-inf=nan), so the
+                # deferred overflow check still catches it.
+                def _zero(p):
+                    p = jnp.asarray(p)
+                    dt = accum_dtype if jnp.issubdtype(p.dtype,
+                                                       jnp.floating) \
+                        else p.dtype
+                    return jnp.zeros(p.shape, dt)
+
+                def _add(a, g):
+                    if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating):
+                        return a + jnp.asarray(g, a.dtype)
+                    return jnp.asarray(g)
+
+                def body(carry, mb):
+                    acc, mstate, loss_sum = carry
+                    g, (loss, aux, ms) = mb_grads(mstate, mb)
+                    acc = jax.tree_util.tree_map(_add, acc, g)
+                    return (acc, ms,
+                            loss_sum + jnp.asarray(loss, jnp.float32)), aux
+
+                init = (jax.tree_util.tree_map(_zero, state.params),
+                        state.model_state, jnp.float32(0.0))
+                (grads, new_model_state, loss_sum), aux = jax.lax.scan(
+                    body, init, batch, length=accum_steps)
+                loss = loss_sum / accum_steps
+                # grads hold the SUM of scaled microbatch grads; average
+                # so the window optimizes the mean microbatch loss (one
+                # elementwise pass — kept separate from unscale's 1/scale
+                # so accum_steps=N stays bitwise-comparable to a manual
+                # sum-then-divide accumulation)
+                grads = jax.tree_util.tree_map(
+                    lambda g: g / accum_steps
+                    if jnp.issubdtype(jnp.asarray(g).dtype, jnp.floating)
+                    else g, grads)
+                if not has_aux:
+                    aux = None
         if grad_average_axis is not None:
             # comm health: this inlined DDP reduction is the step's bucket
             # allreduce — account bytes/leaves at trace time. With a
@@ -566,6 +714,9 @@ def make_train_step(loss_fn: Callable, optimizer, policy: Policy,
             # fused reduction, no extra transfers) + the scale trajectory
             record["grad_norm"] = _telemetry.global_norm(master_grads)
             record.update(scaler_metrics(scaler))
+            # one callback per OPTIMIZER step: under accumulation that is
+            # one per window, with the window size in the record
+            record["accum_steps"] = accum_steps
             _telemetry.emit_metrics(record, tag="amp", registry=reg)
         if has_aux:
             metrics["aux"] = aux

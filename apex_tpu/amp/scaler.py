@@ -134,14 +134,35 @@ def unscale(grads, state: ScalerState, out_dtype=jnp.float32):
     return jax.tree_util.tree_map(one, grads), found
 
 
+def _is_flat_buffer(x):
+    """One 1-D floating array — the multi_tensor superbuffer layout."""
+    import numpy as np
+
+    return isinstance(x, (jax.Array, np.ndarray)) and x.ndim == 1 \
+        and jnp.issubdtype(x.dtype, jnp.floating)
+
+
 def unscale_with_stashed(new_grads, stashed, state: ScalerState,
                          out_dtype=jnp.float32):
-    """out = new/scale + stashed — grad accumulation across iterations.
+    """out = new/scale + stashed — grad accumulation across iterations
+    (the ``delay_unscale=True`` window's per-iteration fusion).
 
     Equivalent of scaler.py — unscale_with_stashed →
-    amp_C.multi_tensor_axpby(a=1/scale, b=1).
+    amp_C.multi_tensor_axpby(a=1/scale, b=1). When both operands are flat
+    1-D buffers (the multi_tensor superbuffer layout) the call routes
+    through :func:`kernels.multi_tensor.fused_axpby` — the ported axpby
+    kernel doing accumulate-with-unscale and the overflow check in ONE
+    pass; pytrees take the per-leaf path (same math, XLA-fused).
     """
     inv = (1.0 / state.loss_scale).astype(jnp.float32)
+
+    if _is_flat_buffer(new_grads) and _is_flat_buffer(stashed):
+        from apex_tpu.kernels.multi_tensor import fused_axpby
+
+        out, found = fused_axpby(jnp.asarray(new_grads, jnp.float32),
+                                 jnp.asarray(stashed, jnp.float32),
+                                 inv, 1.0)
+        return jnp.asarray(out, out_dtype), found
 
     def one(g, s):
         g32 = jnp.asarray(g, jnp.float32)
@@ -221,14 +242,18 @@ class LossScaler:
         return scale_loss(loss, self._state)
 
     def unscale(self, grads, out_dtype=jnp.float32):
+        # OR-accumulate, don't overwrite: across a delay_unscale window
+        # (N unscale/unscale_with_stashed calls before one update_scale)
+        # an overflow in ANY iteration must skip the whole window —
+        # apex's _overflow_buf accumulating across multi_tensor launches.
         out, found = unscale(grads, self._state, out_dtype)
-        self._has_overflow = bool(found)
+        self._has_overflow = self._has_overflow or bool(found)
         return out
 
     def unscale_with_stashed(self, new_grads, stashed, out_dtype=jnp.float32):
         out, found = unscale_with_stashed(new_grads, stashed, self._state,
                                           out_dtype)
-        self._has_overflow = bool(found)
+        self._has_overflow = self._has_overflow or bool(found)
         return out
 
     def update_scale(self):

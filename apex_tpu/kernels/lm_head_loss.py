@@ -40,8 +40,11 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.kernels.xentropy import xent_reference
+from apex_tpu.log_util import get_logger
 
 __all__ = ["lm_head_xentropy", "lm_head_xent_reference"]
+
+_logger = get_logger("kernels.lm_head_loss")
 
 
 def lm_head_xent_reference(x, kernel, labels, smoothing: float = 0.0,
@@ -57,6 +60,20 @@ def lm_head_xent_reference(x, kernel, labels, smoothing: float = 0.0,
     return xent_reference(logits, labels, smoothing)
 
 
+# Both scans are unroll=True (module docstring), so the chunk COUNT is
+# straight-line GEMM count: hundreds of iterations are a compile-time
+# blowup AND slower than unfused (the while-loop pathology the unroll
+# avoids comes back as schedule bloat). 64 tiles keeps GPT-2's padded
+# 50304 vocab at a >= 786-wide chunk — comfortably MXU-efficient.
+_MAX_UNROLLED_CHUNKS = 64
+# ... but widening is itself a memory lever: the per-iteration [N, C]
+# fp32 logits block (plus the backward's recompute) grows linearly with
+# the chunk, so the auto-widening never exceeds this width beyond what
+# the caller already asked for. 8192 is the op's default chunk — the
+# known-memory-sane tile at standard vocabs.
+_MAX_WIDENED_CHUNK = 8192
+
+
 def _pick_chunk(v: int, chunk: int) -> int:
     """The requested chunk, lane-aligned (floor to a multiple of 128,
     min 128) and clamped to the padded vocab. Vocabs that don't divide
@@ -64,9 +81,48 @@ def _pick_chunk(v: int, chunk: int) -> int:
     masking the pad columns out of the logsumexp — NOT by shrinking the
     chunk to a divisor: GPT-2's padded 50304 = 128*3*131 has no
     lane-aligned divisor above 384, and 131 unrolled 384-wide tiles is
-    both a compile blowup and slower than unfused (review round-5)."""
+    both a compile blowup and slower than unfused (review round-5).
+
+    The unrolled chunk COUNT is additionally clamped to
+    ``_MAX_UNROLLED_CHUNKS``: a small ``chunk`` at large vocab (e.g. 128
+    at 50k = 393 straight-line GEMM iterations) silently compiles
+    forever and runs slower than unfused, so the chunk is raised (with a
+    warning) to the smallest lane-aligned width keeping the count sane
+    (ADVICE r5 #2). The widening respects the caller's memory intent: it
+    never exceeds ``max(chunk, _MAX_WIDENED_CHUNK)`` — the per-iteration
+    [N, C] logits block is the op's memory knob, and an extreme vocab
+    (e.g. 10M-row retrieval head) where no sane width keeps the count
+    under the cap gets the capped width and a louder warning instead of
+    a silent HBM blowup."""
     c = max(128, min(chunk, v + (-v) % 128))
-    return c - c % 128
+    c -= c % 128
+    nc = -(-v // c)
+    if nc > _MAX_UNROLLED_CHUNKS:
+        c_min = -(-v // _MAX_UNROLLED_CHUNKS)
+        widened = c_min + (-c_min) % 128
+        ceiling = max(c, _MAX_WIDENED_CHUNK)
+        if widened <= ceiling:
+            _logger.warning(
+                "lm_head_xentropy chunk=%d at vocab %d would unroll %d "
+                "GEMM scan iterations (unroll=True: straight-line code); "
+                "raising the chunk to %d (%d iterations). Pass chunk>=%d "
+                "explicitly to silence.", c, v, nc, widened,
+                -(-v // widened), widened)
+            c = widened
+        else:
+            # vocab so large that bounding the unroll would need a chunk
+            # beyond the memory-sane ceiling: take the ceiling, keep the
+            # count honest, and say so — vocab-parallel (axis_name) is
+            # the real answer at this scale
+            _logger.warning(
+                "lm_head_xentropy vocab %d cannot keep the unrolled GEMM "
+                "count <= %d at any memory-sane chunk (would need %d-wide "
+                "tiles); using chunk=%d (%d iterations). Expect long "
+                "compiles — shard the head with axis_name= "
+                "(vocab-parallel) instead.", v, _MAX_UNROLLED_CHUNKS,
+                widened, ceiling, -(-v // ceiling))
+            c = ceiling
+    return c
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -159,6 +215,14 @@ def _fused_fwd(x, kernel, labels, smoothing, chunk, compute_dtype,
         loss = (1.0 - smoothing) * nll - smoothing * mean_logp
     else:
         loss = nll
+    # out-of-range labels (ignore-index -100, vocab overshoot): no column
+    # matches, so zy stays 0 and the loss would silently read as lse —
+    # finite but WRONG. xent_reference masks such rows to NaN explicitly
+    # (a raw gather would numpy-wrap -100 onto token V-100); match it
+    # exactly so the fused op stays a drop-in and bad labels are loud
+    # (ADVICE r5 #1).
+    valid = (labels >= 0) & (labels < v_glob)
+    loss = jnp.where(valid, loss, jnp.float32(jnp.nan))
     return loss, (x, kernel, labels, lse)
 
 
@@ -172,6 +236,11 @@ def _fused_bwd(smoothing, chunk, compute_dtype, axis_name, res, g):
     padded = nc * chunk != v
     offsets = jnp.arange(nc, dtype=jnp.int32) * chunk
     g32 = jnp.asarray(g, jnp.float32)
+    # out-of-range labels: the reference drops the onehot cotangent (its
+    # NaN-masked nll contributes nothing) but keeps the smoothing
+    # mean-logp path flowing — d/dlogits of -s*mean_logp is
+    # s*(p - 1/V). Match exactly.
+    valid = (labels >= 0) & (labels < v_glob)
 
     def body(dx, inp):
         wc, off = inp
@@ -194,9 +263,14 @@ def _fused_bwd(smoothing, chunk, compute_dtype, axis_name, res, g):
             if padded:
                 # the smoothing floor must not leak into pad columns
                 target = jnp.where(lcols < v, target, 0.0)
+            inv_dl = smoothing * (p - 1.0 / v_glob)
+            if padded:
+                inv_dl = jnp.where(lcols < v, inv_dl, 0.0)
         else:
             target = onehot
-        dl = (p - target) * g32[:, None]                  # [N, C] fp32
+            inv_dl = jnp.float32(0.0)
+        dl = jnp.where(valid[:, None], p - target, inv_dl) \
+            * g32[:, None]                                # [N, C] fp32
         dlc = jnp.asarray(dl, compute_dtype)
         # dW chunk written once (no cross-chunk accumulation): [C, H]
         dwc = jax.lax.dot_general(dlc, xc, (((0,), (0,)), ((), ())),
@@ -234,10 +308,23 @@ def lm_head_xentropy(x, kernel, labels, *, smoothing: float = 0.0,
     scan streams (lane-aligned; vocabs that don't divide — GPT-2's
     50257 included — are zero-padded to a chunk multiple with the pad
     columns masked to -inf out of the logsumexp and sliced off dW, so
-    every vocab gets full-width tiles). ``compute_dtype`` sets the GEMM
-    input dtype (default: ``x.dtype``; pass the amp half dtype for
-    MXU-rate GEMMs) — accumulation and all loss math stay fp32 on every
-    path.
+    every vocab gets full-width tiles). The unrolled chunk COUNT is
+    clamped: a small ``chunk`` at large vocab that would unroll more
+    than 64 straight-line GEMM iterations is widened with a warning
+    (compile blowup + slower than unfused otherwise). ``compute_dtype``
+    sets the GEMM input dtype (default: ``x.dtype``; pass the amp half
+    dtype for MXU-rate GEMMs) — accumulation and all loss math stay
+    fp32 on every path.
+
+    Out-of-range labels (the ignore-index convention's ``-100``, or ids
+    ``>= V``) follow ``xent_reference`` exactly: the loss is NaN (the
+    reference masks such rows explicitly — a raw gather would wrap
+    ``-100`` onto a real token) and the backward drops
+    the onehot cotangent for those rows (zero grad at ``smoothing=0``;
+    only the smoothing mean-logp term flows otherwise). To IGNORE such
+    positions, mask the returned per-example losses before reducing —
+    ``jnp.where(labels != -100, losses, 0.0)`` — which also zeroes
+    their cotangents; this op never silently trains on a clamped token.
 
     ``axis_name`` makes the op VOCAB-PARALLEL inside ``shard_map``: each
     rank passes its row shard of the head (global vocab = shard rows ×

@@ -124,7 +124,13 @@ def _axpby_kernel(ab_ref, x_ref, y_ref, out_ref, flag_ref):
 
 def fused_axpby(flat_x, flat_y, a, b, interpret: bool = False):
     """out = a*x + b*y with overflow check — amp_C.multi_tensor_axpby
-    (grad accumulation fused with unscale)."""
+    (grad accumulation fused with unscale).
+
+    Hot-path wiring: ``amp.scaler.unscale_with_stashed`` routes flat 1-D
+    buffer pairs here with ``a=1/scale, b=1`` — the delayed-unscale
+    accumulate-with-unscale primitive for the superbuffer layout (the
+    in-jit ``make_train_step(accum_steps=N)`` path accumulates per-leaf
+    trees instead and lets XLA fuse the equivalent axpby)."""
     ab = jnp.stack([jnp.asarray(a, jnp.float32),
                     jnp.asarray(b, jnp.float32)]).reshape(1, 2)
     if not _use_pallas(interpret, flat_x, flat_y):

@@ -30,10 +30,21 @@ __all__ = ["softmax_cross_entropy_loss", "xent_reference"]
 
 def xent_reference(logits, labels, smoothing: float = 0.0):
     """fp32 composed reference (the reference tests compare against
-    F.log_softmax + nll with manual smoothing)."""
+    F.log_softmax + nll with manual smoothing).
+
+    Out-of-range labels (ignore-index ``-100``, ids ``>= V``) produce a
+    NaN loss and drop the onehot cotangent — explicitly, for EVERY
+    out-of-range id: a raw ``take_along_axis`` would numpy-wrap
+    negatives in ``[-V, -1]`` onto real vocab rows (``-100`` at
+    ``V > 100`` silently trains on token ``V-100``), which torch's
+    ``nll_loss`` would never do (it raises). NaN is the loud jax-side
+    equivalent; mask the returned losses to ignore such positions."""
     lg = jnp.asarray(logits, jnp.float32)
     logp = jax.nn.log_softmax(lg, axis=-1)
-    nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    valid = (labels >= 0) & (labels < lg.shape[-1])
+    safe = jnp.where(valid, labels, 0)
+    nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    nll = jnp.where(valid, nll, jnp.float32(jnp.nan))
     if smoothing > 0.0:
         mean_logp = jnp.mean(logp, axis=-1)
         return (1.0 - smoothing) * nll - smoothing * mean_logp
@@ -51,7 +62,12 @@ def _fwd_kernel(lg_ref, lb_ref, loss_ref, mlse_ref, *, smoothing):
     cols = jax.lax.broadcasted_iota(jnp.int32, lg.shape, 1)
     onehot_logit = jnp.sum(
         jnp.where(cols == labels[:, None], lg, 0.0), axis=-1, keepdims=True)
-    nll = lse - onehot_logit                        # [br, 1]
+    # out-of-range labels (ignore-index -100, ids >= V): the masked
+    # reduction matches no column, so nll would silently read as lse —
+    # finite but WRONG. Match xent_reference: NaN, loudly.
+    valid = (labels >= 0) & (labels < lg.shape[-1])
+    nll = jnp.where(valid[:, None], lse - onehot_logit,
+                    jnp.float32(jnp.nan))           # [br, 1]
     if smoothing > 0.0:
         mean_logp = jnp.mean(lg - lse, axis=-1, keepdims=True)
         loss = (1.0 - smoothing) * nll - smoothing * mean_logp
@@ -70,11 +86,19 @@ def _bwd_kernel(lg_ref, lb_ref, mlse_ref, g_ref, out_ref, *, smoothing):
     softmax = jnp.exp(lg - lse)
     cols = jax.lax.broadcasted_iota(jnp.int32, softmax.shape, 1)
     onehot = (cols == labels[:, None]).astype(jnp.float32)
+    # out-of-range labels: the reference drops the onehot cotangent (its
+    # NaN-masked nll contributes nothing) but keeps the smoothing
+    # mean-logp path flowing — d/dlogits of -s*mean_logp is
+    # s*(softmax - 1/V). Same algebra as lm_head_loss._fused_bwd.
+    valid = (labels >= 0) & (labels < V)
     if smoothing > 0.0:
         target = (1.0 - smoothing) * onehot + smoothing / V
+        inv_dl = smoothing * (softmax - 1.0 / V)
     else:
         target = onehot
-    out_ref[:] = ((softmax - target) * g).astype(out_ref.dtype)
+        inv_dl = jnp.float32(0.0)
+    dl = jnp.where(valid[:, None], softmax - target, inv_dl)
+    out_ref[:] = (dl * g).astype(out_ref.dtype)
 
 
 def _col(x, n):
@@ -160,6 +184,12 @@ def softmax_cross_entropy_loss(logits, labels, smoothing: float = 0.0,
 
     Reference: apex/contrib/xentropy/softmax_xentropy.py —
     SoftmaxCrossEntropyLoss(logits, labels, smoothing).
+
+    Out-of-range labels (ignore-index ``-100``, ids ``>= V``) follow
+    :func:`xent_reference` on EVERY dispatch path (Pallas kernel and jnp
+    fallback alike): NaN loss, onehot cotangent dropped. To ignore such
+    positions, mask the returned per-example losses before reducing —
+    ``jnp.where(labels != -100, losses, 0.0)``.
     """
     shape = logits.shape[:-1]
     v = logits.shape[-1]

@@ -19,6 +19,15 @@ the current toolchain the all-reduce op itself is synchronous in HLO (the
 honest reading in BASELINE.md's overlap table). What survives here is the
 *semantics*: mean-averaging, predivide factor, any-rank-overflow ⇒
 all-rank skip (handled in amp.make_train_step), and replicated init.
+
+Allreduce FREQUENCY is the other lever apex's recipes pull
+(gradient_accumulation_steps + ``scale_loss(delay_unscale=True)``: N
+backwards, one reduction): ``amp.make_train_step(accum_steps=N)`` scans
+N microbatches inside the jitted step and runs this whole-tree reduction
+ONCE per optimizer window — N× fewer comm bytes per optimizer step,
+certified from scheduled HLO by bench_schedule.py's ddp_accum leg and at
+trace time by the ``comm.ddp.allreduce.calls`` counter (docs/amp.md
+§Microbatch gradient accumulation).
 """
 
 from __future__ import annotations

@@ -144,16 +144,52 @@ def timed(name: str, registry: Optional[MetricsRegistry] = None):
             reg.counter_inc(f"{name}.calls")
 
 
-def guard_bench_main(main, metric: str):
+# Error-text markers of TRANSIENT infrastructure failures (tunnel drops,
+# remote-compile hiccups, backend races) — worth one retry before the
+# failure line erases a canonical perf record. Substring-matched,
+# case-insensitive, against ``{type}: {message}``.
+_TRANSIENT_MARKERS = (
+    "remote_compile", "read body", "unavailable", "deadline_exceeded",
+    "deadline exceeded", "connection reset", "connection refused",
+    "broken pipe", "socket closed", "transient", "temporarily",
+)
+
+
+def _is_transient_error(err: str) -> bool:
+    low = err.lower()
+    return any(m in low for m in _TRANSIENT_MARKERS)
+
+
+def guard_bench_main(main, metric: str, retries: int = 1):
     """Run a bench driver's ``main`` so that EVERY outcome ends in a final
     parseable JSON line on stdout.
 
     Success: ``main`` already printed its metric line — pass through.
     Any failure (backend init, compile, OOM, bad argv): the traceback
     goes to stderr, and the LAST stdout line is
-    ``{"metric": ..., "error": "...", "rc": 1}`` so harnesses that parse
-    the final line (BENCH_r0*.json) never record ``"parsed": null``
-    again. Exits 1 on failure; KeyboardInterrupt passes through.
+    ``{"metric": ..., "error": "...", "rc": 1, "transient": ...}`` so
+    harnesses that parse the final line (BENCH_r0*.json) never record
+    ``"parsed": null`` again. Exits 1 on failure; KeyboardInterrupt
+    passes through.
+
+    Resilience (VERDICT r5 next-round #1): an error whose text matches a
+    transient-infrastructure marker (``remote_compile: read body``,
+    UNAVAILABLE, connection resets — :data:`_TRANSIENT_MARKERS`) gets
+    ``retries`` fresh attempts of ``main`` before the failure line is
+    emitted, so one tunnel flake cannot erase the round's canonical perf
+    record. The final failure line carries ``"transient": true/false``
+    — true means the retries were exhausted on flake-shaped errors and
+    the record should be read as infrastructure noise, not a perf
+    regression; deterministic failures (bad argv, OOM, real compile
+    errors) never retry and tag false.
+
+    A retry re-runs ``main`` FROM SCRATCH, so a multi-row driver
+    (bench_schedule.py) that emitted rows before the flake emits them
+    again on the retry. Before each retry a marker line
+    ``{"metric": ..., "event": "transient_retry", "discard_preceding":
+    true, ...}`` is written to stdout so row-aggregating harnesses can
+    drop the partial first attempt; final-line parsers are unaffected
+    (the marker is never last — a real row or the failure line follows).
     """
     import traceback
 
@@ -170,21 +206,37 @@ def guard_bench_main(main, metric: str):
         except BaseException:
             pass
         _logger.error("bench %s failed: %s", metric, err)
-        line = json.dumps({"metric": metric, "error": err, "rc": 1})
+        line = json.dumps({"metric": metric, "error": err, "rc": 1,
+                           "transient": _is_transient_error(err)})
         sys.stdout.write(line + "\n")
         sys.stdout.flush()
         raise SystemExit(1)
 
-    try:
-        return main()
-    except KeyboardInterrupt:
-        raise
-    except SystemExit as e:
-        if e.code in (None, 0):
+    attempts_left = int(retries)
+    while True:
+        try:
+            return main()
+        except KeyboardInterrupt:
             raise
-        traceback.print_exc(file=sys.stderr)
-        _fail(str(e.code) if not isinstance(e.code, int)
-              else f"SystemExit: {e.code}")
-    except BaseException as e:  # noqa: BLE001 — the contract is total
-        traceback.print_exc(file=sys.stderr)
-        _fail(f"{type(e).__name__}: {e}")
+        except SystemExit as e:
+            if e.code in (None, 0):
+                raise
+            traceback.print_exc(file=sys.stderr)
+            err = str(e.code) if not isinstance(e.code, int) \
+                else f"SystemExit: {e.code}"
+        except BaseException as e:  # noqa: BLE001 — the contract is total
+            traceback.print_exc(file=sys.stderr)
+            err = f"{type(e).__name__}: {e}"
+        if attempts_left > 0 and _is_transient_error(err):
+            attempts_left -= 1
+            _logger.warning("bench %s hit a transient error (%s); "
+                            "retrying — %d retry(ies) remain after this",
+                            metric, err, attempts_left)
+            # multi-row drivers re-emit their rows on the retry: mark the
+            # boundary so row aggregators can discard the partial attempt
+            sys.stdout.write(json.dumps({
+                "metric": metric, "event": "transient_retry",
+                "error": err, "discard_preceding": True}) + "\n")
+            sys.stdout.flush()
+            continue
+        _fail(err)

@@ -33,8 +33,9 @@ import numpy as np
 
 __all__ = ["topology_mesh", "scheduled_text", "collective_async_pairs",
            "all_reduce_bucketing", "ddp_step_program",
-           "pipeline_1f1b_program", "ring_attention_program",
-           "ulysses_attention_program", "zero_update_program"]
+           "ddp_accum_step_program", "pipeline_1f1b_program",
+           "ring_attention_program", "ulysses_attention_program",
+           "zero_update_program"]
 
 # one compute op between a start/done pair = the transport is riding under
 # real work. On TPU every lowered compute op is one of these HLO forms.
@@ -130,12 +131,20 @@ def all_reduce_bucketing(txt: str) -> Dict[str, Any]:
 # structure the claims are about.
 
 def ddp_step_program(n_layers: int = 6, width: int = 512,
-                     batch: int = 64):
+                     batch: int = 64, accum_steps: int = 1):
     """The actual amp O2 DDP train step (make_train_step +
     grad_average_axis='data' + fused_adam), shard_mapped over an 8-chip
     'data' mesh. Returns (fn, avals, n_grad_leaves) — the leaf count is
     what the bucketing evidence is checked against (unlike the 2-tuple
-    sibling builders)."""
+    sibling builders).
+
+    ``accum_steps=N > 1`` builds the SAME model/mesh/global batch under
+    in-jit microbatch accumulation: the batch carries a leading
+    microbatch axis of size N (per-microbatch rows sharded over 'data')
+    and the grads accumulate through a lax.scan BEFORE the psum — one
+    parameter, so the N=1 baseline and the accumulation program can
+    never drift apart while their all-reduce counts are being compared
+    (bench_schedule.py ddp_accum, tests/tpu)."""
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
@@ -154,16 +163,34 @@ def ddp_step_program(n_layers: int = 6, width: int = 512,
     policy = amp.resolve_policy(opt_level="O2", verbose=False)
     init_fn, step_fn = amp.make_train_step(loss_fn, fused_adam(1e-3),
                                            policy,
-                                           grad_average_axis="data")
+                                           grad_average_axis="data",
+                                           accum_steps=accum_steps)
     params = [jax.ShapeDtypeStruct((width, width), jnp.float32)
               for _ in range(n_layers)]
     state = jax.eval_shape(init_fn, params)
-    bat = (jax.ShapeDtypeStruct((batch, width), jnp.bfloat16),
-           jax.ShapeDtypeStruct((batch, width), jnp.float32))
+    if accum_steps == 1:
+        shape, bspec = (batch, width), P("data")
+    else:
+        shape = (accum_steps, batch // accum_steps, width)
+        bspec = P(None, "data")
+    bat = (jax.ShapeDtypeStruct(shape, jnp.bfloat16),
+           jax.ShapeDtypeStruct(shape, jnp.float32))
     fn = shard_map(step_fn, mesh=mesh,
-                   in_specs=(P(), (P("data"), P("data"))),
+                   in_specs=(P(), (bspec, bspec)),
                    out_specs=(P(), P()), check_vma=False)
     return fn, (state, bat), n_layers
+
+
+def ddp_accum_step_program(n_layers: int = 6, width: int = 512,
+                           batch: int = 64, accum_steps: int = 4):
+    """:func:`ddp_step_program` at ``accum_steps=N`` — the scheduled HLO
+    must show the same ONE bucketed grad all-reduce per optimizer window
+    as the plain step, not N of them (the acceptance certificate for the
+    accumulation tentpole: allreduce traffic per optimizer step cut N×).
+    Returns (fn, avals, n_grad_leaves, accum_steps)."""
+    fn, avals, n_leaves = ddp_step_program(n_layers, width, batch,
+                                           accum_steps)
+    return fn, avals, n_leaves, accum_steps
 
 
 def pipeline_1f1b_program(pp: int = 8, microbatches: int = 16,

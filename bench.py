@@ -81,6 +81,12 @@ WINDOWS = int(os.environ.get("BENCH_WINDOWS", "3"))
 # Device-anchored windows: profiler captures of STEPS steps each whose
 # device-lane span times the silicon itself (basis: "device_trace").
 TRACE_WINDOWS = int(os.environ.get("BENCH_TRACE_WINDOWS", "3"))
+# In-jit microbatch accumulation (amp.make_train_step accum_steps):
+# BENCH_ACCUM_STEPS=N scans N microbatches of BATCH/N per optimizer step,
+# paying ONE unscale + optimizer + scaler pass per window — the
+# delay_unscale recipe's throughput leg. Each jit_step still consumes
+# BATCH images, so img/s stays directly comparable to the N=1 rows.
+ACCUM_STEPS = int(os.environ.get("BENCH_ACCUM_STEPS", "1"))
 
 
 def _median(xs):
@@ -114,15 +120,20 @@ def main():
             jnp.asarray(logits, jnp.float32), labels).mean()
         return loss, updated["batch_stats"]
 
+    if ACCUM_STEPS < 1 or BATCH % ACCUM_STEPS:
+        raise SystemExit(f"BENCH_ACCUM_STEPS={ACCUM_STEPS} must be >= 1 "
+                         f"and divide BENCH_BATCH={BATCH}")
     init_fn, step_fn = amp.make_train_step(loss_fn, optimizer, policy,
                                            with_model_state=True,
-                                           telemetry=tele is not None)
+                                           telemetry=tele is not None,
+                                           accum_steps=ACCUM_STEPS)
     state = init_fn(params, batch_stats)
     jit_step = jax.jit(step_fn, donate_argnums=(0,))
 
     images = jax.random.normal(rng, (BATCH, IMAGE, IMAGE, 3), jnp.float32)
     labels = jax.random.randint(rng, (BATCH,), 0, 1000)
     batch = (images, labels)
+    batch = amp.to_microbatches(batch, ACCUM_STEPS)
 
     for _ in range(WARMUP):
         state, _ = jit_step(state, batch)
@@ -183,6 +194,7 @@ def main():
         "mfu_est": round(mfu, 4),
         "implausible": bool(mfu > 1.0),
         "device_kind": getattr(jax.devices()[0], "device_kind", "unknown"),
+        "accum_steps": ACCUM_STEPS,
         "wall_clock": {
             "value": round(wall_value, 2),
             "windows": [round(r, 2) for r in wall_rates],

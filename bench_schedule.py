@@ -30,9 +30,9 @@ import json
 import sys
 
 from apex_tpu.utils.schedule_report import (
-    all_reduce_bucketing, collective_async_pairs, ddp_step_program,
-    pipeline_1f1b_program, ring_attention_program, scheduled_text,
-    ulysses_attention_program, zero_update_program)
+    all_reduce_bucketing, collective_async_pairs, ddp_accum_step_program,
+    ddp_step_program, pipeline_1f1b_program, ring_attention_program,
+    scheduled_text, ulysses_attention_program, zero_update_program)
 
 
 def emit(row):
@@ -56,10 +56,24 @@ def bench_pipeline():
     })
 
 
+_DDP_BASELINE = None
+
+
+def _ddp_baseline():
+    """The plain DDP step's bucketing, AOT-scheduled ONCE per process —
+    bench_ddp and bench_ddp_accum share it (scheduling the 8-chip O2
+    step twice per default run doubles the dominant compile cost for no
+    extra information)."""
+    global _DDP_BASELINE
+    if _DDP_BASELINE is None:
+        fn, avals, n_leaves = ddp_step_program()
+        _DDP_BASELINE = (all_reduce_bucketing(scheduled_text(fn, *avals)),
+                         n_leaves)
+    return _DDP_BASELINE
+
+
 def bench_ddp():
-    fn, avals, n_leaves = ddp_step_program()
-    txt = scheduled_text(fn, *avals)
-    b = all_reduce_bucketing(txt)
+    b, n_leaves = _ddp_baseline()
     emit({
         "program": "ddp_o2_step",
         "mesh": "data=8", "grad_leaves": n_leaves,
@@ -69,6 +83,34 @@ def bench_ddp():
                      "(apex allreduce_bucket analogue); async_split=0 is "
                      "an honest negative — this toolchain schedules "
                      "all-reduce synchronously in HLO"),
+    })
+
+
+def bench_ddp_accum():
+    """The accumulation tentpole's acceptance leg: with accum_steps=N the
+    window's grads must ride the SAME one bucketed all-reduce as the
+    plain DDP step — the reduction sits after the microbatch scan, so
+    allreduce count per optimizer step does NOT scale with N."""
+    fn, avals, n_leaves, accum = ddp_accum_step_program(accum_steps=4)
+    txt = scheduled_text(fn, *avals)
+    b = all_reduce_bucketing(txt)
+    base, _ = _ddp_baseline()
+    per_window_ok = b["n_all_reduce_ops"] == base["n_all_reduce_ops"]
+    emit({
+        "program": "ddp_o2_accum_step",
+        "mesh": "data=8", "accum_steps": accum, "grad_leaves": n_leaves,
+        **b,
+        "baseline_n_all_reduce_ops": base["n_all_reduce_ops"],
+        "one_grad_psum_per_window": per_window_ok,
+        "evidence": (f"accum_steps={accum} schedules "
+                     f"{b['n_all_reduce_ops']} all-reduce op(s) per "
+                     f"optimizer window — same as the plain DDP step "
+                     f"({base['n_all_reduce_ops']}): comm bytes per "
+                     f"optimizer step cut {accum}x")
+        if per_window_ok else
+        (f"REGRESSION: accumulation scheduled {b['n_all_reduce_ops']} "
+         f"all-reduce ops vs baseline {base['n_all_reduce_ops']} — a "
+         f"reduction leaked inside the microbatch scan"),
     })
 
 
@@ -125,6 +167,7 @@ def bench_ulysses():
 
 
 SUITES = {"pipeline": bench_pipeline, "ddp": bench_ddp,
+          "ddp_accum": bench_ddp_accum,
           "ring": bench_ring, "ulysses": bench_ulysses,
           "zero": bench_zero}
 

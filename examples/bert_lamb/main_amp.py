@@ -90,6 +90,14 @@ def parse_args(argv=None):
     p.add_argument("--resume", default=None, metavar="CKPT",
                    help="restore a --save checkpoint (full state) and "
                         "continue the same phase")
+    p.add_argument("--accum-steps", type=int, default=1, metavar="N",
+                   help="in-jit microbatch gradient accumulation "
+                        "(amp.make_train_step accum_steps): each LAMB "
+                        "step scans N microbatches of batch-size/N, "
+                        "paying ONE grad allreduce + unscale + scaler "
+                        "update per window — the reference recipe's "
+                        "gradient_accumulation_steps, compiled. Composes "
+                        "with --data-parallel")
     p.add_argument("--telemetry", default=None, metavar="SPEC",
                    help="stream per-step telemetry (loss, grad norm, "
                         "scaler trajectory, step time) from inside the "
@@ -216,6 +224,14 @@ def main(argv=None):
         raise SystemExit(f"--train_batch_size {args.train_batch_size} "
                          f"must divide by --data-parallel "
                          f"{args.data_parallel}")
+    if args.accum_steps < 1:
+        raise SystemExit("--accum-steps must be >= 1")
+    if args.train_batch_size % (args.accum_steps
+                                * max(args.data_parallel, 1)):
+        raise SystemExit(
+            f"--train_batch_size {args.train_batch_size} must divide by "
+            f"--accum-steps x --data-parallel "
+            f"({args.accum_steps} x {max(args.data_parallel, 1)})")
     if args.resume and args.init_checkpoint:
         raise SystemExit("--resume (continue the phase) and "
                          "--init-checkpoint (fresh phase from saved "
@@ -278,7 +294,17 @@ def main(argv=None):
     init_fn, step_fn = amp.make_train_step(
         loss_fn, optimizer, policy,
         grad_average_axis="data" if dp > 1 else None,
-        telemetry=tele is not None)
+        telemetry=tele is not None, accum_steps=args.accum_steps)
+
+    def to_microbatches(batch):
+        """amp.to_microbatches on the ARRAY leaves; the dropout key stays
+        scalar — it is split into per-microbatch keys inside the step,
+        after any per-rank fold."""
+        if args.accum_steps == 1:
+            return batch
+        *arrays, drop = batch
+        return amp.to_microbatches(tuple(arrays),
+                                   args.accum_steps) + (drop,)
     start_it = 0
     if args.init_checkpoint:
         params = _phase_handoff_params(args.init_checkpoint, init_fn,
@@ -304,18 +330,29 @@ def main(argv=None):
         def sharded_step(state, batch):
             *arrays, drop = batch
             drop = jax.random.fold_in(drop, jax.lax.axis_index("data"))
+            if args.accum_steps > 1:
+                drop = jax.random.split(drop, args.accum_steps)
             return step_fn(state, tuple(arrays) + (drop,))
 
+        # with accumulation the leading axis is the microbatch scan axis
+        # (replicated); the data mesh shards the per-microbatch rows
+        bspec = P("data") if args.accum_steps == 1 else P(None, "data")
         jit_step = jax.jit(shard_map(
             sharded_step, mesh=mesh,
-            in_specs=(P(), (P("data"), P("data"), P("data"), P("data"),
-                            P("data"), P("data"), P())),
+            in_specs=(P(), (bspec,) * 6 + (P(),)),
             out_specs=(P(), P()), check_vma=False),
             donate_argnums=(0,))
         ctx = mesh
     else:
         import contextlib
-        jit_step = jax.jit(step_fn, donate_argnums=(0,))
+        if args.accum_steps > 1:
+            def local_step(state, batch):
+                *arrays, drop = batch
+                drop = jax.random.split(drop, args.accum_steps)
+                return step_fn(state, tuple(arrays) + (drop,))
+            jit_step = jax.jit(local_step, donate_argnums=(0,))
+        else:
+            jit_step = jax.jit(step_fn, donate_argnums=(0,))
         ctx = contextlib.nullcontext()
 
     n_params = sum(int(np.prod(p.shape))
@@ -349,6 +386,7 @@ def main(argv=None):
                                              args.max_seq_length,
                                              args.max_predictions_per_seq,
                                              cfg.vocab_size) + (drop,)
+            batch = to_microbatches(batch)
             state, metrics = jit_step(state, batch)
             loss_history.append(metrics["loss"])
             if it == start_it + 4:

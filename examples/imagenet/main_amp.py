@@ -68,6 +68,14 @@ def parse_args(argv=None):
                         "(observation-only — runs on a copy of the "
                         "state; n/a without device lanes)")
     p.add_argument("--prof", type=int, default=0)
+    p.add_argument("--accum-steps", type=int, default=1, metavar="N",
+                   help="in-jit microbatch gradient accumulation "
+                        "(amp.make_train_step accum_steps): each optimizer "
+                        "step scans N microbatches of batch-size/N, paying "
+                        "ONE grad allreduce + unscale + scaler update per "
+                        "window — apex's delay_unscale recipe, compiled. "
+                        "Composes with --data-parallel (the microbatch "
+                        "rows shard over the data mesh)")
     p.add_argument("--telemetry", default=None, metavar="SPEC",
                    help="stream per-step telemetry (loss, grad norm, "
                         "scaler trajectory, step time) from inside the "
@@ -279,6 +287,16 @@ class data_prefetcher:
 
 def main(argv=None):
     args = parse_args(argv)
+    if args.accum_steps < 1:
+        raise SystemExit("--accum-steps must be >= 1")
+    if args.batch_size % args.accum_steps:
+        raise SystemExit(f"--batch-size {args.batch_size} must divide by "
+                         f"--accum-steps {args.accum_steps}")
+    if args.data_parallel > 1 and \
+            (args.batch_size // args.accum_steps) % args.data_parallel:
+        raise SystemExit(
+            f"microbatch rows {args.batch_size // args.accum_steps} must "
+            f"divide by --data-parallel {args.data_parallel}")
     policy = build_policy(args)
     print(policy.banner())
 
@@ -325,21 +343,30 @@ def main(argv=None):
     init_fn, step_fn = amp.make_train_step(
         make_loss_fn(model), optimizer, policy, has_aux=True,
         with_model_state=True, grad_average_axis=axis_name,
-        telemetry=tele is not None)
+        telemetry=tele is not None, accum_steps=args.accum_steps)
     state = init_fn(params, model_state)
+
+    def to_microbatches(batch):
+        """amp.to_microbatches bound to --accum-steps: the leading
+        microbatch axis the step scans over (identity at N=1, so every
+        data path below stays shape-stable)."""
+        return amp.to_microbatches(batch, args.accum_steps)
 
     if axis_name is not None:
         from apex_tpu import comm
         mesh = comm.make_mesh({"data": args.data_parallel})
         from jax.sharding import NamedSharding, PartitionSpec as P
-        batch_sharding = (NamedSharding(mesh, P("data")),
-                          NamedSharding(mesh, P("data")))
+        # with accumulation the leading axis is the microbatch scan axis
+        # (replicated); the data mesh shards the per-microbatch rows
+        bspec = P("data") if args.accum_steps == 1 else P(None, "data")
+        batch_sharding = (NamedSharding(mesh, bspec),
+                          NamedSharding(mesh, bspec))
         replicated = NamedSharding(mesh, P())
         state = jax.device_put(state, replicated)
         jit_step = jax.jit(
             jax.shard_map(
                 step_fn, mesh=mesh,
-                in_specs=(P(), (P("data"), P("data"))),
+                in_specs=(P(), (bspec, bspec)),
                 out_specs=P(),
                 check_vma=False))
     else:
@@ -400,13 +427,17 @@ def main(argv=None):
         imgs = 0
         prefetcher = None
         if dataset is not None:
+            # microbatch reshape happens on HOST, before the prefetcher's
+            # device_put lays the batch out per batch_sharding
             prefetcher = data_prefetcher(
-                file_batches(*dataset["train"], args.batch_size,
-                             seed=args.seed + epoch),
+                map(to_microbatches,
+                    file_batches(*dataset["train"], args.batch_size,
+                                 seed=args.seed + epoch)),
                 sharding=batch_sharding)
         elif args.host_data:
             prefetcher = data_prefetcher(
-                host_batches(args.seed + epoch, args.iters),
+                map(to_microbatches,
+                    host_batches(args.seed + epoch, args.iters)),
                 sharding=batch_sharding)
         for it in range(args.iters):
             if prefetcher is not None:
@@ -417,8 +448,9 @@ def main(argv=None):
                 rng, sub = jax.random.split(rng)
                 if args.deterministic:
                     sub = jax.random.PRNGKey(it)
-                batch = synthetic_batch(sub, args.batch_size,
-                                        args.image_size, args.num_classes)
+                batch = to_microbatches(
+                    synthetic_batch(sub, args.batch_size,
+                                    args.image_size, args.num_classes))
                 if batch_sharding is not None:
                     batch = jax.device_put(batch, batch_sharding)
             if args.prof and it == 5:

@@ -68,6 +68,15 @@ def parse_args(argv=None):
     p.add_argument("--deterministic", action="store_true")
     p.add_argument("--remat", action="store_true",
                    help="activation checkpointing per block (memory lever)")
+    p.add_argument("--accum-steps", type=int, default=1, metavar="N",
+                   help="in-jit microbatch gradient accumulation "
+                        "(amp.make_train_step accum_steps): the step "
+                        "scans N microbatches of batch-size/N, paying "
+                        "ONE unscale + optimizer + scaler update per "
+                        "window — apex's delay_unscale recipe, compiled. "
+                        "Single-chip path only: the parallel tiers' "
+                        "1F1B/no-pipelining schedules already accumulate "
+                        "over --microbatches")
     # ---- model-parallel tier (SURVEY P22-P24): dp x tp x pp over a
     # ('data','pipe','model') mesh; any value > 1 selects the parallel path
     p.add_argument("--data-parallel", type=int, default=1, metavar="DP",
@@ -978,11 +987,22 @@ def main(argv=None):
     args = parse_args(argv)
     if args.iters < 1:
         raise SystemExit("--iters must be >= 1")
+    if args.accum_steps < 1:
+        raise SystemExit("--accum-steps must be >= 1")
+    if args.batch_size % args.accum_steps:
+        raise SystemExit(f"--batch-size {args.batch_size} must divide by "
+                         f"--accum-steps {args.accum_steps}")
     policy = amp.resolve_policy(opt_level=args.opt_level,
                                 loss_scale=args.loss_scale)
     print(policy.banner())
     if (args.data_parallel * args.tensor_parallel
             * args.pipeline_parallel * args.virtual_pipeline) > 1:
+        if args.accum_steps > 1:
+            raise SystemExit(
+                "--accum-steps composes with the single-chip path only: "
+                "the parallel tiers drive amp via grad_fn (1F1B / "
+                "no-pipelining schedules), which already accumulate over "
+                "--microbatches — raise --microbatches there instead")
         if args.fused_head and not args.vocab_parallel:
             raise SystemExit("--fused-head under the parallel tiers "
                              "needs --vocab-parallel AND "
@@ -1035,7 +1055,8 @@ def main(argv=None):
 
     tele = _maybe_telemetry(args)
     init_fn, step_fn = amp.make_train_step(loss_fn, optimizer, policy,
-                                           telemetry=tele is not None)
+                                           telemetry=tele is not None,
+                                           accum_steps=args.accum_steps)
     state = init_fn(params)
     jit_step = jax.jit(step_fn, donate_argnums=(0,))
 
@@ -1061,6 +1082,9 @@ def main(argv=None):
         else:
             batch = synthetic_tokens(sub, args.batch_size, args.seq_len,
                                      args.vocab_size)
+        # [B, S+1] → [N, B/N, S+1]: the microbatch scan axis of
+        # make_train_step(accum_steps=N); identity at N=1
+        batch = amp.to_microbatches(batch, args.accum_steps)
         state, metrics = jit_step(state, batch)
         loss_history.append(metrics["loss"])
         if it == start_it + 4:

@@ -108,6 +108,110 @@ def test_unaligned_vocab_pads_and_masks(v, smoothing):
                                rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.parametrize("smoothing", [0.0, 0.1])
+def test_out_of_range_labels_match_reference_nan(smoothing):
+    """ADVICE r5 #1: ignore-index −100 (and ids >= V) must NOT silently
+    read as a finite loss on a wrong column — both paths return NaN at
+    exactly the invalid positions and stay correct everywhere else."""
+    x, w, y = _setup()
+    y = y.at[0].set(-100).at[3].set(-1).at[5].set(V).at[7].set(V + 9)
+    got = np.asarray(lm_head_xentropy(x, w, y, smoothing=smoothing,
+                                      chunk=128))
+    want = np.asarray(lm_head_xent_reference(x, w, y, smoothing))
+    invalid = np.asarray((y < 0) | (y >= V))
+    assert np.isnan(got[invalid]).all() and np.isnan(want[invalid]).all()
+    np.testing.assert_allclose(got[~invalid], want[~invalid],
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("smoothing", [0.0, 0.1])
+def test_out_of_range_labels_grads_match_reference(smoothing):
+    """Backward parity on bad labels: masking the returned losses (the
+    documented ignore-index recipe) zeroes invalid rows' cotangents, and
+    both paths must produce IDENTICAL finite grads — the onehot term
+    drops while at smoothing>0 the mean-logp term still flows for rows
+    that keep a nonzero cotangent."""
+    x, w, y = _setup()
+    y = y.at[0].set(-100).at[5].set(V + 1)
+    valid = (y >= 0) & (y < V)
+
+    def masked(losses):
+        return jnp.sum(jnp.where(valid, losses, 0.0)) / jnp.sum(valid)
+
+    gx_f, gw_f = jax.grad(
+        lambda x, w: masked(lm_head_xentropy(x, w, y, smoothing=smoothing,
+                                             chunk=128)),
+        argnums=(0, 1))(x, w)
+    gx_c, gw_c = jax.grad(
+        lambda x, w: masked(lm_head_xent_reference(x, w, y, smoothing)),
+        argnums=(0, 1))(x, w)
+    assert np.isfinite(np.asarray(gx_f)).all()
+    np.testing.assert_allclose(np.asarray(gx_f), np.asarray(gx_c),
+                               rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(gw_f), np.asarray(gw_c),
+                               rtol=2e-4, atol=2e-5)
+    # invalid rows' dx vanishes: their loss was masked out of the sum
+    np.testing.assert_allclose(np.asarray(gx_f)[~np.asarray(valid)], 0.0,
+                               atol=1e-7)
+
+
+def test_pick_chunk_clamps_unrolled_count_and_warns(caplog):
+    """ADVICE r5 #2: a small chunk at large vocab must not unroll
+    hundreds of straight-line GEMM iterations — the chunk is widened
+    (with a warning) so the count stays <= _MAX_UNROLLED_CHUNKS."""
+    import logging
+
+    from apex_tpu.kernels.lm_head_loss import (_MAX_UNROLLED_CHUNKS,
+                                               _pick_chunk)
+
+    # the package root logger is propagate=False (log_util installs its
+    # own stderr handler) — re-enable propagation so caplog sees records
+    apex_root = logging.getLogger("apex_tpu")
+    old_propagate = apex_root.propagate
+    apex_root.propagate = True
+    try:
+        with caplog.at_level(logging.WARNING,
+                             logger="apex_tpu.kernels.lm_head_loss"):
+            c = _pick_chunk(50304, 128)    # 393 iterations unclamped
+        n_chunks = -(-50304 // c)
+        assert n_chunks <= _MAX_UNROLLED_CHUNKS
+        assert c % 128 == 0
+        assert any("unroll" in r.message for r in caplog.records)
+
+        # sane requests pass through untouched, silently
+        caplog.clear()
+        with caplog.at_level(logging.WARNING,
+                             logger="apex_tpu.kernels.lm_head_loss"):
+            assert _pick_chunk(512, 128) == 128
+            assert _pick_chunk(50304, 8192) == 8192
+        assert not caplog.records
+
+        # extreme vocab (10M retrieval head): the widening honors the
+        # caller's memory intent — capped at max(chunk, 8192), warned,
+        # never silently blown up to a 156k-wide logits block
+        caplog.clear()
+        with caplog.at_level(logging.WARNING,
+                             logger="apex_tpu.kernels.lm_head_loss"):
+            assert _pick_chunk(10_000_000, 8192) == 8192
+            assert _pick_chunk(10_000_000, 16384) == 16384
+        assert all("vocab-parallel" in r.message for r in caplog.records)
+    finally:
+        apex_root.propagate = old_propagate
+
+
+def test_clamped_chunk_still_matches_reference():
+    """The widened chunk is a perf guard, not a semantics change."""
+    v = 16384                              # 128 chunks at chunk=128 → clamped
+    rng = jax.random.PRNGKey(7)
+    x = jax.random.normal(rng, (4, H))
+    w = jax.random.normal(jax.random.fold_in(rng, 1), (v, H)) * 0.05
+    y = jax.random.randint(jax.random.fold_in(rng, 2), (4,), 0, v)
+    got = lm_head_xentropy(x, w, y, chunk=128)
+    want = lm_head_xent_reference(x, w, y)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
 def test_validation_errors():
     x, w, y = _setup()
     with pytest.raises(ValueError, match="smoothing"):
@@ -116,6 +220,43 @@ def test_validation_errors():
         lm_head_xentropy(x, w.T, y)
     with pytest.raises(ValueError, match="labels"):
         lm_head_xentropy(x, w, y[:-1])
+
+
+@pytest.mark.parametrize("smoothing", [0.0, 0.1])
+def test_pallas_xentropy_out_of_range_labels_match_reference(smoothing):
+    """The PALLAS dispatch path of softmax_cross_entropy_loss (aligned
+    vocab → the in-kernel masked-reduction gather, interpret-mode on
+    CPU) must agree with xent_reference on out-of-range labels too: NaN
+    loss, onehot cotangent dropped — not the silently-finite lse the
+    unmasked kernel used to return."""
+    from apex_tpu.kernels.xentropy import (softmax_cross_entropy_loss,
+                                           xent_reference)
+
+    x, w, y = _setup()
+    logits = jax.lax.dot_general(x, w, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+    y = y.at[0].set(-100).at[3].set(V).at[5].set(-1)
+    assert logits.shape[-1] % 128 == 0          # Pallas path, not fallback
+    got = np.asarray(softmax_cross_entropy_loss(logits, y, smoothing))
+    want = np.asarray(xent_reference(logits, y, smoothing))
+    invalid = np.asarray((y < 0) | (y >= V))
+    assert np.isnan(got[invalid]).all() and np.isnan(want[invalid]).all()
+    np.testing.assert_allclose(got[~invalid], want[~invalid],
+                               rtol=1e-5, atol=1e-5)
+
+    valid = jnp.asarray(~invalid)
+
+    def masked(fn):
+        def run(lg):
+            losses = fn(lg, y, smoothing)
+            return jnp.sum(jnp.where(valid, losses, 0.0)) / jnp.sum(valid)
+        return run
+
+    g_f = jax.grad(masked(softmax_cross_entropy_loss))(logits)
+    g_c = jax.grad(masked(xent_reference))(logits)
+    assert np.isfinite(np.asarray(g_f)).all()
+    np.testing.assert_allclose(np.asarray(g_f), np.asarray(g_c),
+                               rtol=2e-4, atol=2e-5)
 
 
 def test_matches_onchip_xentropy_composition():

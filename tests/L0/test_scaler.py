@@ -99,6 +99,64 @@ def test_unscale_with_stashed_accumulates():
     assert jnp.allclose(out["w"], jnp.asarray([11.0]))
 
 
+def test_unscale_with_stashed_flat_buffer_routes_fused_axpby():
+    """The multi_tensor superbuffer layout: flat 1-D operand pairs take
+    the ported amp_C.multi_tensor_axpby kernel (fused_axpby, a=1/scale,
+    b=1) — same math as the per-leaf path, overflow flag included."""
+    state = init_scaler(4.0)
+    new = jnp.asarray([4.0, 8.0, -2.0], jnp.float32)
+    stash = jnp.asarray([10.0, 0.0, 1.0], jnp.float32)
+    out, found = unscale_with_stashed(new, stash, state)
+    assert not bool(found)
+    assert jnp.allclose(out, jnp.asarray([11.0, 2.0, 0.5]))
+    # overflow in either operand raises the flag (axpby checks both)
+    _, found = unscale_with_stashed(
+        jnp.asarray([jnp.inf, 1.0], jnp.float32),
+        jnp.zeros((2,), jnp.float32), state)
+    assert bool(found)
+    _, found = unscale_with_stashed(
+        jnp.ones((2,), jnp.float32),
+        jnp.asarray([jnp.nan, 1.0], jnp.float32), state)
+    assert bool(found)
+
+
+def test_facade_overflow_or_accumulates_across_delay_window():
+    """delay_unscale window parity (apex's _overflow_buf accumulating
+    across multi_tensor launches): an overflow in ANY unscale of the
+    window must back the scale off at the single closing update_scale —
+    a later clean unscale_with_stashed cannot overwrite the flag."""
+    s = LossScaler("dynamic", init_scale=256.0)
+    stash = s.unscale({"w": jnp.asarray([jnp.inf], jnp.float16)})  # mb 0: inf
+    s.unscale_with_stashed({"w": jnp.asarray([1.0], jnp.float16)},
+                           stash)                                  # mb 1: clean
+    assert s.update_scale() is True          # window skipped as a whole
+    assert s.loss_scale() == 128.0
+
+    # clean window afterwards: flag was reset by update_scale
+    stash = s.unscale({"w": jnp.asarray([1.0], jnp.float16)})
+    s.unscale_with_stashed({"w": jnp.asarray([1.0], jnp.float16)}, stash)
+    assert s.update_scale() is False
+    assert s.loss_scale() == 128.0
+
+
+def test_scale_loss_delay_unscale_keeps_schedule_frozen():
+    """amp.scale_loss(delay_unscale=True) must not advance the scaler
+    schedule on exit — only the window-closing (delay_unscale=False)
+    iteration calls update_scale (apex handle.py's delayed path)."""
+    from apex_tpu import amp as amp_mod
+
+    amp_mod._amp_state.loss_scalers = [LossScaler(128.0)]
+    scaler = amp_mod._amp_state.loss_scalers[0]
+    before = int(scaler._state.steps)
+    with amp_mod.scale_loss(jnp.float32(1.0), delay_unscale=True) as sl:
+        assert float(sl) == 128.0
+    assert int(scaler._state.steps) == before            # frozen
+    with amp_mod.scale_loss(jnp.float32(1.0)) as sl:
+        pass
+    assert int(scaler._state.steps) == before + 1        # window closed
+    amp_mod._amp_state.loss_scalers = []
+
+
 def test_scale_loss_dtype_preserved():
     state = init_scaler(1024.0)
     loss16 = jnp.float16(2.0)

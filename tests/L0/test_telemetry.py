@@ -216,6 +216,34 @@ def test_emit_metrics_outside_jit(spy_registry):
     assert rec["tag"] == "eager" and rec["x"] == 2.0 and rec["y"] == 3
 
 
+def test_accum_window_emits_one_callback_with_window_size(spy_registry):
+    """Under accum_steps=N the callback contract is per OPTIMIZER window:
+    W executed windows (each scanning N microbatches) produce exactly W
+    host callbacks, and every record carries the window size."""
+    reg, spy = spy_registry
+    n, windows = 4, 3
+
+    def loss_fn(params, batch):
+        x, y = batch
+        pred = x @ params["w"].astype(x.dtype)
+        return jnp.mean((pred.astype(jnp.float32) - y) ** 2)
+
+    policy = resolve_policy("O2", half_dtype=jnp.float16, verbose=False)
+    init_fn, step_fn = make_train_step(loss_fn, optax.sgd(0.1), policy,
+                                       telemetry=True, accum_steps=n)
+    state = init_fn({"w": jnp.ones((4, 2), jnp.float32)})
+    state = state.replace(scaler=init_scaler("dynamic", init_scale=256.0))
+    step = jax.jit(step_fn)
+    batch = (jnp.ones((n, 2, 4), jnp.float32),
+             jnp.zeros((n, 2, 2), jnp.float32))
+    for _ in range(windows):
+        state, _ = step(state, batch)
+    jax.effects_barrier()
+    assert len(spy.records) == windows          # one per window, not per mb
+    assert all(r["accum_steps"] == n for r in spy.records)
+    assert reg.histograms["amp.loss"].count == windows
+
+
 # ------------------------------------------------------------- comm health
 
 def test_account_collective_counters(spy_registry):
@@ -264,7 +292,7 @@ def test_guard_bench_main_failure_ends_in_json_line(capsys):
     parsed = json.loads(last)
     assert parsed == {"metric": "resnet50_img_per_sec",
                       "error": "RuntimeError: backend init failed",
-                      "rc": 1}
+                      "rc": 1, "transient": False}
 
 
 def test_guard_bench_main_success_passes_through(capsys):
@@ -273,6 +301,74 @@ def test_guard_bench_main_success_passes_through(capsys):
         telemetry.guard_bench_main(lambda: (_ for _ in ()).throw(
             SystemExit(0)), "m")
     assert exc.value.code == 0
+
+
+def test_guard_bench_main_retries_transient_then_succeeds(capsys):
+    """VERDICT r5 next-round #1: one tunnel flake (remote_compile read
+    body) must not erase the perf record — the retry recovers it and no
+    failure JSON is emitted."""
+    calls = []
+
+    def flaky_main():
+        calls.append(1)
+        if len(calls) == 1:
+            raise RuntimeError("remote_compile: read body")
+        return 42
+
+    assert telemetry.guard_bench_main(flaky_main, "m") == 42
+    assert len(calls) == 2
+    out = capsys.readouterr().out
+    assert "rc" not in out                       # no failure line printed
+    # the retry boundary is marked so row aggregators can discard the
+    # partial first attempt of a multi-row driver
+    marker = json.loads(out.strip().splitlines()[0])
+    assert marker["event"] == "transient_retry"
+    assert marker["discard_preceding"] is True
+
+
+def test_guard_bench_main_persistent_transient_tags_true(capsys):
+    calls = []
+
+    def always_flaky():
+        calls.append(1)
+        raise RuntimeError("remote_compile: read body")
+
+    with pytest.raises(SystemExit) as exc:
+        telemetry.guard_bench_main(always_flaky, "m", retries=1)
+    assert exc.value.code == 1
+    assert len(calls) == 2                       # original + one retry
+    parsed = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert parsed["transient"] is True
+    assert parsed["rc"] == 1
+
+
+def test_guard_bench_main_deterministic_error_never_retries(capsys):
+    calls = []
+
+    def broken_main():
+        calls.append(1)
+        raise ValueError("BENCH_WINDOWS must be >= 1")
+
+    with pytest.raises(SystemExit):
+        telemetry.guard_bench_main(broken_main, "m", retries=3)
+    assert len(calls) == 1                       # no retry on real bugs
+    parsed = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert parsed["transient"] is False
+
+
+def test_guard_bench_main_transient_systemexit_retries():
+    """SystemExit with a transient message string retries too (some
+    drivers wrap backend errors in SystemExit)."""
+    calls = []
+
+    def flaky_exit():
+        calls.append(1)
+        if len(calls) == 1:
+            raise SystemExit("UNAVAILABLE: connection reset by peer")
+        return "ok"
+
+    assert telemetry.guard_bench_main(flaky_exit, "m") == "ok"
+    assert len(calls) == 2
 
 
 # -------------------------------------------------------------- summarize

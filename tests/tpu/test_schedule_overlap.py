@@ -24,9 +24,9 @@ import numpy as np
 import pytest
 
 from apex_tpu.utils.schedule_report import (
-    all_reduce_bucketing, collective_async_pairs, ddp_step_program,
-    pipeline_1f1b_program, ring_attention_program, scheduled_text,
-    ulysses_attention_program, zero_update_program)
+    all_reduce_bucketing, collective_async_pairs, ddp_accum_step_program,
+    ddp_step_program, pipeline_1f1b_program, ring_attention_program,
+    scheduled_text, ulysses_attention_program, zero_update_program)
 
 
 @pytest.fixture(scope="module")
@@ -47,10 +47,16 @@ def test_1f1b_ppermute_is_async_with_compute_inside(pipeline_txt):
     assert " collective-permute(" not in pipeline_txt
 
 
-def test_ddp_grad_psums_bucketed_into_one_allreduce():
+@pytest.fixture(scope="module")
+def ddp_baseline():
+    """(bucketing, n_leaves) of the plain DDP step — scheduled once,
+    shared by the bucketing test and the accumulation-window test."""
     fn, avals, n_leaves = ddp_step_program()
-    txt = scheduled_text(fn, *avals)
-    b = all_reduce_bucketing(txt)
+    return all_reduce_bucketing(scheduled_text(fn, *avals)), n_leaves
+
+
+def test_ddp_grad_psums_bucketed_into_one_allreduce(ddp_baseline):
+    b, n_leaves = ddp_baseline
     # every grad leaf rides ONE combined all-reduce (the other ops are
     # scalar reductions: loss pmean / found_inf)
     assert max(b["tensors_per_op"]) == n_leaves, b
@@ -60,6 +66,21 @@ def test_ddp_grad_psums_bucketed_into_one_allreduce():
     # BASELINE.md's overlap table must be re-run (a good problem).
     assert b["async_split"] == 0, \
         "toolchain now async-splits all-reduce — update BASELINE.md"
+
+
+def test_accum_window_schedules_one_grad_allreduce(ddp_baseline):
+    """The accumulation tentpole's scheduled-HLO certificate: with
+    accum_steps=N the whole-tree grad psum sits AFTER the microbatch
+    scan — the compiled window schedules exactly as many all-reduce ops
+    as the plain DDP step (one bucketed grad op + scalar reductions),
+    never N of them."""
+    fn, avals, n_leaves, accum = ddp_accum_step_program(accum_steps=4)
+    txt = scheduled_text(fn, *avals)
+    b = all_reduce_bucketing(txt)
+    base, _ = ddp_baseline
+    assert b["n_all_reduce_ops"] == base["n_all_reduce_ops"], (b, base)
+    # the grad tuple still rides one combined op, full leaf count
+    assert max(b["tensors_per_op"]) == n_leaves, b
 
 
 def test_ring_attention_rotations_hidden_under_compute():
