@@ -23,6 +23,10 @@ capability surface layered on JAX/XLA/Pallas:
   registry, JSONL/stdout sinks, one-callback-per-step emission from the amp
   train step, comm-health counters, run-summary CLI (no reference
   counterpart — apex observes with NVTX + recipe prints only).
+- ``apex_tpu.serving``        — compiled KV-cache inference: slot cache in the
+  amp half dtype, one jitted prefill + one jitted decode-step program, and a
+  continuous-batching scheduler with bounded-queue backpressure (no reference
+  counterpart — apex is training-only).
 
 Unlike the reference, everything here is functional and jit-first: policies are
 dtype rules applied at trace time (not monkey-patches), the loss scaler is a
@@ -55,6 +59,7 @@ _SUBMODULES = (
     "parallel",
     "pyprof",
     "reparameterization",
+    "serving",
     "telemetry",
     "transformer",
     "utils",

@@ -37,3 +37,5 @@ from .layer_norm import (  # noqa: E402,F401
     layer_norm, rms_norm, layer_norm_reference, rms_norm_reference)
 from .multi_tensor import (  # noqa: E402,F401
     fused_scale, fused_axpby, fused_l2norm, fused_adam_step, fused_sgd_step)
+from .decode_attention import (  # noqa: E402,F401
+    decode_attention, decode_attention_reference)

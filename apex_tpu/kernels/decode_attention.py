@@ -1,0 +1,203 @@
+"""Cached-K/V decode attention — the serving tier's single-token kernel.
+
+Training attention (:mod:`apex_tpu.kernels.flash_attention`) answers
+"every query attends to every earlier key"; decode answers a different
+question: ONE new query per sequence against a **preallocated KV cache**
+of which only the first ``lengths[b]`` positions are valid. This is the
+same move the flash-attention kernel lineage makes from training kernels
+to cached inference: the blockwise online-softmax inner loop is
+unchanged, but the query block degenerates to a single row and the
+causal-block skip becomes a *length* skip — KV blocks entirely past the
+sequence's valid length are never touched, so a request of length 37 in
+a 1024-slot cache pays for ceil(38/block_k) blocks, not 8.
+
+Layouts (matching the serving cache, one slot per batch row):
+
+- ``q``: ``[batch, heads, head_dim]`` — the current token's query.
+- ``k``/``v``: ``[batch, heads, max_len, head_dim]`` — the cache view.
+- ``lengths``: ``[batch]`` int32 — valid positions per row (the current
+  token's K/V must already be written at ``lengths-1``).
+
+Numerics follow the kernel tier's contract: fp32 accumulation regardless
+of I/O dtype (the cache is normally bf16 via the amp cast policies), and
+a pure-jnp reference that doubles as the CPU/unaligned fallback and the
+test oracle. Rows with ``lengths == 0`` return zeros (a defined value for
+inactive serving slots — their output is discarded by the engine).
+
+Block geometry rides the shared tuned-override registry
+(:mod:`apex_tpu.kernels.vmem`) under new ``decode.*`` keys:
+``decode.block_k`` (KV positions per grid step, lane-multiple 128) here,
+and ``decode.prefill_block_q``/``decode.prefill_block_k`` consumed by
+``serving.Engine`` for its prefill flash-attention geometry (prefill
+shapes — short sequences, single-request batch — want different blocks
+than the training sweep).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from apex_tpu.kernels import mosaic_dtype_ok, vmem
+
+__all__ = ["decode_attention", "decode_attention_reference"]
+
+_NEG_INF = -1e30
+DEFAULT_BLOCK_K = 256
+
+
+# --------------------------------------------------------------- jnp reference
+def decode_attention_reference(q, k, v, lengths, *, scale: float = 1.0):
+    """fp32-math oracle: masked softmax over the valid cache prefix.
+
+    ``q`` [b, h, d]; ``k``/``v`` [b, h, L, d]; ``lengths`` [b] int32.
+    Returns [b, h, d] in ``q.dtype``; rows with ``lengths == 0`` are 0.
+    """
+    out_dtype = q.dtype
+    q32, k32, v32 = (jnp.asarray(t, jnp.float32) for t in (q, k, v))
+    s = jnp.einsum("bhd,bhld->bhl", q32, k32) * scale
+    L = k.shape[2]
+    valid = (jnp.arange(L, dtype=jnp.int32)[None, None, :]
+             < lengths[:, None, None])
+    s = jnp.where(valid, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhl,bhld->bhd", p, v32)
+    live = (lengths > 0)[:, None, None]
+    return jnp.asarray(jnp.where(live, out, 0.0), out_dtype)
+
+
+# -------------------------------------------------------------------- kernel
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
+                   l_ref, *, scale, block_k):
+    """Grid (bh, nk): one batch·head row, blockwise over cached KV.
+
+    Online softmax identical to the training forward kernel's (m, l)
+    recurrence, with the causal tile-skip replaced by a length skip:
+    a block whose first position is already past this row's valid
+    length contributes nothing and is skipped entirely.
+    """
+    b = pl.program_id(0)
+    ki = pl.program_id(1)
+    nk = pl.num_programs(1)
+    length = len_ref[b]
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    @pl.when(ki * block_k < length)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)                      # [1, d]
+        k = k_ref[0].astype(jnp.float32)                      # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale       # [1, bk]
+        cols = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1)
+        s = jnp.where(cols < length, s, _NEG_INF)
+        m_prev = m_ref[:1, :1]                                # [1, 1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                                # [1, bk]
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:1, :1] = alpha * l_ref[:1, :1] + jnp.sum(
+            p, axis=-1, keepdims=True)
+        acc_ref[:1, :] = acc_ref[:1, :] * alpha + jax.lax.dot_general(
+            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:1, :1] = m_new
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        l = l_ref[:1, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:1, :] / l_safe).astype(o_ref.dtype)
+
+
+def _decode_pallas(q3, k3, v3, len3, scale, bk, interpret):
+    bh, d = q3.shape
+    L = k3.shape[1]
+    kernel = functools.partial(_decode_kernel, scale=scale, block_k=bk)
+    out = pl.pallas_call(
+        kernel,
+        grid=(bh, L // bk),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),                # lengths
+            pl.BlockSpec((1, 1, d), lambda b, j: (b, 0, 0)),      # q
+            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),     # k
+            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),     # v
+        ],
+        out_specs=pl.BlockSpec((1, 1, d), lambda b, j: (b, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, 1, d), q3.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((8, d), jnp.float32),      # acc (row 0 live)
+            pltpu.VMEM((8, 128), jnp.float32),    # m
+            pltpu.VMEM((8, 128), jnp.float32),    # l
+        ],
+        interpret=interpret,
+    )(len3, q3.reshape(bh, 1, d), k3, v3)
+    return out.reshape(bh, d)
+
+
+# ------------------------------------------------------------------ dispatch
+def _resolve_block(block_k):
+    if block_k is None:
+        block_k = vmem.get_override("decode.block_k", DEFAULT_BLOCK_K,
+                                    multiple=128)
+    return block_k
+
+
+def decode_attention(q, k, v, lengths, *, scale: Optional[float] = None,
+                     block_k: Optional[int] = None,
+                     interpret: bool = False):
+    """Single-token attention against a length-masked KV cache.
+
+    ``q`` [batch, heads, head_dim]; ``k``/``v`` [batch, heads, max_len,
+    head_dim] (the serving cache's per-layer view); ``lengths`` [batch]
+    int32 — positions ``[0, lengths[b])`` are attended, everything past
+    is masked. The current token's own K/V must already be written at
+    position ``lengths[b] - 1`` (the serving engine's write-then-attend
+    order). ``scale`` defaults to ``1/sqrt(head_dim)``.
+
+    Inference-only (no VJP — decode never backprops). The Pallas path
+    skips KV blocks past ``lengths[b]`` entirely, so short sequences in
+    a long cache cost O(length), not O(max_len); unaligned shapes and
+    non-Mosaic dtypes fall back to the jnp reference, which XLA fuses
+    acceptably at decode's tiny per-step footprint.
+
+    Tuned geometry: ``decode.block_k`` in the
+    :mod:`apex_tpu.kernels.vmem` override registry (lane-multiple 128,
+    clamped to the largest aligned divisor of ``max_len``).
+    """
+    b, h, d = q.shape
+    L = k.shape[2]
+    if k.shape != (b, h, L, d) or v.shape != k.shape:
+        raise ValueError(f"decode_attention: k/v {k.shape}/{v.shape} do "
+                         f"not match q {q.shape} + max_len")
+    if lengths.shape != (b,):
+        raise ValueError(f"decode_attention: lengths {lengths.shape} must "
+                         f"be [{b}]")
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    from apex_tpu.kernels.flash_attention import _fit_block, _has_vma
+    bk = _fit_block(_resolve_block(block_k), L, 128)
+    if jax.default_backend() == "cpu":
+        interpret = True
+    pallas_ok = (L % bk == 0 and d % 8 == 0 and bk % 128 == 0)
+    if not pallas_ok or (interpret and _has_vma(q)) \
+            or (not interpret and not mosaic_dtype_ok(q, k, v)):
+        return decode_attention_reference(q, k, v, lengths, scale=scale)
+    q3 = q.reshape(b * h, d)
+    k3 = k.reshape(b * h, L, d)
+    v3 = v.reshape(b * h, L, d)
+    len3 = jnp.repeat(jnp.asarray(lengths, jnp.int32), h)
+    out = _decode_pallas(q3, k3, v3, len3, scale, bk, interpret)
+    live = (lengths > 0)[:, None, None]
+    return jnp.where(live, out.reshape(b, h, d), 0).astype(q.dtype)

@@ -33,34 +33,88 @@ __all__ = ["TransformerLM", "TransformerBlock", "create_lm"]
 
 
 class SelfAttention(nn.Module):
+    """Causal MHA with three modes sharing one set of weights:
+
+    - **train/eval** (default): full-sequence flash attention.
+    - **prefill** (``return_kv=True``): same forward, additionally
+      returning this layer's ``(k, v)`` ``[B, h, S, d]`` for the serving
+      engine to write into its KV cache.
+    - **decode** (``cache=(k_cache, v_cache)`` + ``positions``): ``S``
+      must be 1; the token's K/V is scattered into the cache at
+      ``positions[b]`` and attention runs against the cached prefix via
+      :func:`apex_tpu.kernels.decode_attention.decode_attention`
+      (length-masked, fp32 accumulation), returning
+      ``(out, (k_cache', v_cache'))``.
+
+    ``inference_dtype`` is the decode path's storage/compute dtype: when
+    set, Q/K/V leave the qkv GEMM in that dtype (normally the amp half —
+    pure-bf16 decode needs no fp32 master weights anywhere); when None
+    the training-policy ``dense_dtype`` governs, as before.
+    """
+
     hidden: int
     num_heads: int
     dropout: float = 0.0
     dtype: Optional[Any] = None
     param_dtype: Any = jnp.float32
+    inference_dtype: Optional[Any] = None
 
     @nn.compact
-    def __call__(self, x, train: bool):
+    def __call__(self, x, train: bool, cache=None, positions=None,
+                 return_kv: bool = False):
         # dtype=None → O1 engine: GEMMs are FP16_FUNCS 'linear'
         from apex_tpu.amp.autocast import resolve_dtype
         dense_dtype = resolve_dtype(self.dtype, "linear", jnp.float32)
+        if self.inference_dtype is not None and not train:
+            dense_dtype = self.inference_dtype
         B, S, H = x.shape
         d = self.hidden // self.num_heads
         qkv = nn.Dense(3 * self.hidden, dtype=dense_dtype,
                        param_dtype=self.param_dtype, name="qkv")(x)
-        qkv = qkv.reshape(B, S, 3, self.num_heads, d)
-        q, k, v = (jnp.moveaxis(qkv[:, :, i], 1, 2) for i in range(3))
-        out = flash_attention(q, k, v, causal=True)  # [B, h, S, d]
-        out = jnp.moveaxis(out, 1, 2).reshape(B, S, self.hidden)
+        # one transpose to [3, B, h, S, d], then three views — no
+        # throwaway generator re-indexing qkv[:, :, i] three times
+        qkv = qkv.reshape(B, S, 3, self.num_heads, d).transpose(2, 0, 3, 1, 4)
+        q, k, v = qkv[0], qkv[1], qkv[2]             # [B, h, S, d]
+        if cache is not None:
+            from apex_tpu.kernels.decode_attention import decode_attention
+            if S != 1:
+                raise ValueError(
+                    f"decode mode is single-token: got S={S} with a cache "
+                    "(prefill runs cache-less with return_kv=True)")
+            k_cache, v_cache = cache                 # [B, h, L, d]
+            pos = jnp.clip(jnp.asarray(positions, jnp.int32), 0,
+                           k_cache.shape[2] - 1)
+            bidx = jnp.arange(B)
+            k_cache = k_cache.at[bidx, :, pos].set(
+                jnp.asarray(k[:, :, 0], k_cache.dtype))
+            v_cache = v_cache.at[bidx, :, pos].set(
+                jnp.asarray(v[:, :, 0], v_cache.dtype))
+            # write-then-attend: the token sees its own (cached) K/V
+            ctx = decode_attention(q[:, :, 0], k_cache, v_cache, pos + 1)
+            out = ctx.reshape(B, 1, self.hidden)
+        else:
+            out = flash_attention(q, k, v, causal=True)  # [B, h, S, d]
+            out = jnp.moveaxis(out, 1, 2).reshape(B, S, self.hidden)
         out = nn.Dense(self.hidden, dtype=dense_dtype,
                        param_dtype=self.param_dtype, name="proj")(out)
         if self.dropout > 0.0:
             out = nn.Dropout(rate=self.dropout, deterministic=not train)(out)
+        if cache is not None:
+            return out, (k_cache, v_cache)
+        if return_kv:
+            return out, (k, v)
         return out
 
 
 class TransformerBlock(nn.Module):
-    """Pre-LN block: x + attn(LN(x)); x + mlp(LN(x))."""
+    """Pre-LN block: x + attn(LN(x)); x + mlp(LN(x)).
+
+    ``cache``/``positions``/``return_kv`` thread straight through to
+    :class:`SelfAttention` (see its docstring for the three modes); with
+    either inference mode on, the block returns ``(x, aux)`` where aux is
+    the updated layer cache (decode) or this layer's ``(k, v)``
+    (prefill).
+    """
 
     hidden: int
     num_heads: int
@@ -68,18 +122,29 @@ class TransformerBlock(nn.Module):
     dropout: float = 0.0
     dtype: Optional[Any] = None
     param_dtype: Any = jnp.float32
+    inference_dtype: Optional[Any] = None
 
     @nn.compact
-    def __call__(self, x, train: bool):
+    def __call__(self, x, train: bool, cache=None, positions=None,
+                 return_kv: bool = False):
         # FusedLayerNorm resolves 'layer_norm' (FP32) itself from the raw
         # self.dtype; the Dense sites resolve 'linear' (FP16) here
         from apex_tpu.amp.autocast import resolve_dtype
         dense_dtype = resolve_dtype(self.dtype, "linear", jnp.float32)
+        if self.inference_dtype is not None and not train:
+            dense_dtype = self.inference_dtype
         h = FusedLayerNorm(normalized_shape=self.hidden, dtype=self.dtype,
                            name="ln_attn")(x)
-        x = x + SelfAttention(self.hidden, self.num_heads, self.dropout,
-                              self.dtype, self.param_dtype,
-                              name="attn")(h, train=train)
+        aux = None
+        attn_out = SelfAttention(self.hidden, self.num_heads, self.dropout,
+                                 self.dtype, self.param_dtype,
+                                 self.inference_dtype,
+                                 name="attn")(h, train=train, cache=cache,
+                                              positions=positions,
+                                              return_kv=return_kv)
+        if cache is not None or return_kv:
+            attn_out, aux = attn_out
+        x = x + attn_out
         h = FusedLayerNorm(normalized_shape=self.hidden, dtype=self.dtype,
                            name="ln_mlp")(x)
         inner = self.mlp_ratio * self.hidden
@@ -97,6 +162,8 @@ class TransformerBlock(nn.Module):
                      name="mlp_out")(jnp.asarray(h, dense_dtype))
         if self.dropout > 0.0:
             h = nn.Dropout(rate=self.dropout, deterministic=not train)(h)
+        if aux is not None:
+            return x + h, aux
         return x + h
 
 
@@ -106,6 +173,23 @@ class TransformerLM(nn.Module):
     ``__call__(tokens[B, S], train) -> logits[B, S, vocab]`` (logits fp32 —
     loss math never runs in half, matching amp's FP32_FUNCS policy for
     softmax/loss: apex/amp/lists/functional_overrides.py).
+
+    Inference modes (the ``apex_tpu.serving`` engine's two compiled
+    programs — see :class:`SelfAttention`):
+
+    - **prefill**: ``__call__(tokens[B, S], train=False, return_kv=True)
+      -> (logits, (k, v))`` with ``k``/``v`` stacked per layer
+      ``[layers, B, h, S, d]`` — the engine writes them into its slot
+      cache.
+    - **decode**: ``__call__(tokens[B, 1], train=False,
+      cache=(k, v), positions=lengths) -> (logits, (k', v'))`` — the
+      single new token per batch row is embedded at ``positions[b]``,
+      its K/V scattered into the cache, and attention runs length-masked
+      against the cached prefix.
+
+    ``inference_dtype`` (normally the amp half dtype) pins the
+    eval-mode GEMM/cache dtype independently of the training policy, so
+    a pure-bf16 serving engine needs no fp32 master weights.
     """
 
     vocab_size: int
@@ -122,27 +206,54 @@ class TransformerLM(nn.Module):
     remat: bool = False
     dtype: Optional[Any] = None
     param_dtype: Any = jnp.float32
+    inference_dtype: Optional[Any] = None
 
     @nn.compact
     def __call__(self, tokens, *, train: bool = True,
-                 features_only: bool = False):
+                 features_only: bool = False, cache=None, positions=None,
+                 return_kv: bool = False):
         from apex_tpu.amp.autocast import resolve_dtype
         dense_dtype = resolve_dtype(self.dtype, "linear", jnp.float32)
+        if self.inference_dtype is not None and not train:
+            dense_dtype = self.inference_dtype
+        if cache is not None and return_kv:
+            raise ValueError("cache (decode) and return_kv (prefill) are "
+                             "exclusive modes")
         B, S = tokens.shape
         embed = nn.Embed(self.vocab_size, self.hidden,
                          param_dtype=self.param_dtype, name="wte")
         pos = self.param("wpe", nn.initializers.normal(stddev=0.02),
                          (self.max_seq_len, self.hidden), self.param_dtype)
-        x = jnp.asarray(embed(tokens) + pos[:S][None], dense_dtype)
+        if cache is not None:
+            # decode: the token lives at positions[b], not at 0
+            ppos = jnp.clip(jnp.asarray(positions, jnp.int32), 0,
+                            self.max_seq_len - 1)
+            x = jnp.asarray(embed(tokens) + pos[ppos][:, None, :],
+                            dense_dtype)
+        else:
+            x = jnp.asarray(embed(tokens) + pos[:S][None], dense_dtype)
         if self.dropout > 0.0:
             x = nn.Dropout(rate=self.dropout, deterministic=not train)(x)
         block_cls = TransformerBlock
-        if self.remat:
+        if self.remat and cache is None and not return_kv:
             block_cls = nn.remat(TransformerBlock, static_argnums=(2,))
+        kv_out = ([], [])
         for i in range(self.num_layers):
-            x = block_cls(self.hidden, self.num_heads, self.mlp_ratio,
-                          self.dropout, self.dtype, self.param_dtype,
-                          name=f"block_{i}")(x, train)
+            block = block_cls(self.hidden, self.num_heads, self.mlp_ratio,
+                              self.dropout, self.dtype, self.param_dtype,
+                              self.inference_dtype, name=f"block_{i}")
+            if cache is not None:
+                x, (lk, lv) = block(x, train, cache=(cache[0][i],
+                                                     cache[1][i]),
+                                    positions=positions)
+                kv_out[0].append(lk)
+                kv_out[1].append(lv)
+            elif return_kv:
+                x, (lk, lv) = block(x, train, return_kv=True)
+                kv_out[0].append(lk)
+                kv_out[1].append(lv)
+            else:
+                x = block(x, train)
         x = FusedLayerNorm(normalized_shape=self.hidden, dtype=self.dtype,
                            name="ln_f")(x)
         if features_only:
@@ -153,6 +264,8 @@ class TransformerLM(nn.Module):
         # tied LM head; logits in fp32
         logits = jnp.dot(jnp.asarray(x, jnp.float32),
                          jnp.asarray(embed.embedding, jnp.float32).T)
+        if cache is not None or return_kv:
+            return logits, (jnp.stack(kv_out[0]), jnp.stack(kv_out[1]))
         return logits
 
 
@@ -168,11 +281,13 @@ _LM_SIZES = {
 def create_lm(size: str = "small", vocab_size: int = 32768,
               max_seq_len: int = 1024, dropout: float = 0.0,
               remat: bool = False, dtype: Optional[Any] = None,
-              param_dtype: Any = jnp.float32) -> TransformerLM:
+              param_dtype: Any = jnp.float32,
+              inference_dtype: Optional[Any] = None) -> TransformerLM:
     if size not in _LM_SIZES:
         raise ValueError(f"unknown LM size {size!r}; one of {sorted(_LM_SIZES)}")
     hidden, layers, heads = _LM_SIZES[size]
     return TransformerLM(vocab_size=vocab_size, hidden=hidden,
                          num_layers=layers, num_heads=heads,
                          max_seq_len=max_seq_len, dropout=dropout,
-                         remat=remat, dtype=dtype, param_dtype=param_dtype)
+                         remat=remat, dtype=dtype, param_dtype=param_dtype,
+                         inference_dtype=inference_dtype)

@@ -1,0 +1,45 @@
+"""apex_tpu.serving — compiled KV-cache inference with continuous batching.
+
+The training stack (amp cast policies, Pallas attention, telemetry)
+stops at the optimizer step; this subsystem opens the inference
+workload the north star calls for — serving a stream of variable-length
+generation requests from a fixed set of compiled programs:
+
+- :class:`KVCache` (:mod:`.kv_cache`) — preallocated
+  ``[layers, slots, heads, max_len, head_dim]`` slot cache with
+  per-slot lengths, stored in the amp half dtype.
+- :class:`Engine` (:mod:`.engine`) — exactly two XLA executables
+  (jitted prefill + jitted decode step, fixed shapes, traced
+  slot/length/temperature scalars), greedy / temperature / top-k
+  sampling compiled in; decode attention through
+  :func:`apex_tpu.kernels.decode_attention.decode_attention`
+  (length-masked, ``decode.*`` tuned-block keys).
+- :class:`Scheduler` (:mod:`.scheduler`) — continuous batching:
+  admit-into-free-slots between decode steps, EOS/max-token/timeout
+  eviction, bounded-queue :class:`QueueFull` backpressure, and
+  slot-occupancy / padding-waste / TTFT / tokens-per-sec telemetry
+  through the shared :class:`~apex_tpu.telemetry.MetricsRegistry`.
+
+Quick start::
+
+    from apex_tpu import serving
+    from apex_tpu.models.transformer_lm import create_lm
+
+    model = create_lm("small", vocab_size=32768, max_seq_len=512)
+    engine = serving.Engine(model, params, slots=8, max_len=512,
+                            prefill_len=128)
+    sched = serving.Scheduler(engine, eos_id=0)
+    done = sched.run([serving.Request(prompt=[17, 23, 5],
+                                      max_new_tokens=64)])
+    generated = done[0].output_tokens
+
+Exercised end-to-end by ``bench_serving.py`` and
+``examples/lm/main_amp.py --generate``.
+"""
+
+from .engine import Engine, sample_tokens
+from .kv_cache import KVCache
+from .scheduler import QueueFull, Request, Scheduler
+
+__all__ = ["Engine", "KVCache", "QueueFull", "Request", "Scheduler",
+           "sample_tokens"]

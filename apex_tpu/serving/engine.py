@@ -1,0 +1,280 @@
+"""Compiled inference engine: one prefill program, one decode program.
+
+The engine owns the two — and exactly two — XLA executables a serving
+process needs, both traced once at fixed shapes:
+
+- **prefill**: ``[1, prefill_len]`` tokens (prompt right-padded) → the
+  model's full causal forward (``return_kv=True``), prompt K/V written
+  into one cache slot, first token sampled from the logits at the true
+  prompt's last position. Slot index, prompt length, temperature and the
+  PRNG key are *traced* scalars, so requests of any length or slot land
+  in the same executable — no per-request recompiles.
+- **decode step**: ``[slots, 1]`` tokens (every slot's latest token) →
+  single-token cached forward, one new token per slot. Inactive slots
+  compute too (their output is discarded and their length frozen) —
+  that padding waste is the price of a fixed-shape program, and the
+  scheduler reports it.
+
+Sampling runs inside the compiled programs: greedy when a slot's
+temperature is 0, else temperature softmax over logits optionally
+truncated to the engine's static ``top_k``. Temperatures are per-slot
+traced values; ``top_k`` is static (a different ``top_k`` is a new
+engine).
+
+Weights are cast ONCE at construction through the amp cast-policy
+machinery (default: pure-half O3 — bf16 storage, no fp32 masters, the
+cache in the same dtype); pass ``policy=amp.resolve_policy("O0")`` for
+an exact-fp32 engine (the decode-parity tests' configuration).
+
+Trace accounting: the python bodies of both programs run only when jax
+traces them, so ``prefill_traces``/``decode_traces`` count compiles —
+the serving test tier pins both to exactly 1 across a multi-request,
+variable-length run.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.kernels import vmem
+from apex_tpu.log_util import get_logger
+
+from .kv_cache import KVCache
+
+__all__ = ["Engine", "sample_tokens"]
+
+_logger = get_logger("serving")
+
+
+def sample_tokens(logits, temperature, key, top_k: int = 0):
+    """Sample one token per row of ``logits`` [N, V] (inside jit).
+
+    ``temperature`` [N]: 0 → greedy (argmax), > 0 → softmax sampling at
+    that temperature. ``top_k`` (static): when > 0, logits outside each
+    row's top-k are masked before sampling. Greedy rows ignore top_k
+    (argmax is already top-1)."""
+    logits = jnp.asarray(logits, jnp.float32)
+    temperature = jnp.asarray(temperature, jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if top_k and top_k > 0 and top_k < logits.shape[-1]:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+    sampled = jax.random.categorical(key, scaled).astype(jnp.int32)
+    return jnp.where(temperature > 0, sampled, greedy)
+
+
+class Engine:
+    """KV-cache inference engine over a ``TransformerLM``-shaped model.
+
+    Parameters
+    ----------
+    model:
+        A flax module with the cache-threading contract of
+        :class:`apex_tpu.models.transformer_lm.TransformerLM`
+        (``return_kv`` prefill, ``cache``/``positions`` decode) and the
+        geometry attributes ``num_layers``/``num_heads``/``hidden``/
+        ``max_seq_len``.
+    params:
+        The model's parameter pytree (e.g. a train state's params).
+        Cast once through ``policy.cast_params`` — by default to the
+        pure-half O3 shape.
+    slots:
+        Concurrent sequences per decode step (the continuous-batching
+        width).
+    max_len:
+        Cache positions per slot (prompt + generation budget); must not
+        exceed the model's ``max_seq_len``.
+    prefill_len:
+        Fixed padded prompt capacity of the prefill program
+        (``<= max_len``). Longer prompts are rejected at submit time.
+    policy:
+        An :class:`apex_tpu.amp.Policy` governing weight/cache storage;
+        default ``resolve_policy("O3", verbose=False)`` (pure bf16).
+    top_k:
+        Static top-k truncation for sampled (non-greedy) slots; 0 = off.
+    registry:
+        Optional :class:`apex_tpu.telemetry.MetricsRegistry`; when set,
+        the engine observes ``serving.decode.step_s`` and
+        ``serving.prefill.s`` latencies and counts generated tokens.
+
+    Prefill attention geometry honours the tuned-override registry keys
+    ``decode.prefill_block_q``/``decode.prefill_block_k`` (0/absent →
+    the flash kernel's own ``flash.*`` resolution).
+    """
+
+    def __init__(self, model, params, *, slots: int, max_len: int,
+                 prefill_len: Optional[int] = None, policy=None,
+                 top_k: int = 0, seed: int = 0, registry=None):
+        from apex_tpu.amp.policy import resolve_policy
+
+        if policy is None:
+            policy = resolve_policy("O3", verbose=False)
+        self.policy = policy
+        half = policy.compute_dtype
+        max_seq = int(getattr(model, "max_seq_len", max_len))
+        if max_len > max_seq:
+            raise ValueError(f"max_len {max_len} exceeds the model's "
+                             f"max_seq_len {max_seq}")
+        if prefill_len is None:
+            prefill_len = max_len
+        if not 0 < prefill_len <= max_len:
+            raise ValueError(f"prefill_len {prefill_len} must be in "
+                             f"(0, max_len={max_len}]")
+        if slots < 1:
+            raise ValueError("slots must be >= 1")
+        self.slots = int(slots)
+        self.max_len = int(max_len)
+        self.prefill_len = int(prefill_len)
+        self.top_k = int(top_k)
+        # pin the eval dtype on the module itself so decode GEMMs and
+        # the cache agree (pure-half: no fp32 masters anywhere)
+        try:
+            self._model = model.clone(inference_dtype=half)
+        except TypeError:  # model without the inference_dtype field
+            self._model = model
+        self.params = policy.cast_params(params)
+        hidden = int(model.hidden)
+        heads = int(model.num_heads)
+        self.cache = KVCache.create(
+            layers=int(model.num_layers), slots=self.slots, heads=heads,
+            max_len=self.max_len, head_dim=hidden // heads, dtype=half)
+        self._registry = registry
+        self._key = jax.random.PRNGKey(seed)
+        self.prefill_traces = 0
+        self.decode_traces = 0
+        self.tokens_generated = 0
+        # prefill flash-attention geometry: decode.* tuned keys beat the
+        # training sweep's flash.* defaults when present
+        self._pf_bq = vmem.get_override("decode.prefill_block_q", 0,
+                                        multiple=8) or None
+        self._pf_bk = vmem.get_override("decode.prefill_block_k", 0,
+                                        multiple=128) or None
+        self._jit_prefill = jax.jit(self._prefill_impl,
+                                    donate_argnums=(1,))
+        self._jit_decode = jax.jit(self._decode_impl, donate_argnums=(1,))
+        _logger.info(
+            "serving engine: %d slots x %d positions, prefill_len=%d, "
+            "cache %s (%.1f MiB), top_k=%d", self.slots, self.max_len,
+            self.prefill_len, np.dtype(half).name,
+            self.cache.nbytes() / 2**20, self.top_k)
+
+    # ------------------------------------------------------ compiled bodies
+    def _prefill_impl(self, params, cache, tokens, length, slot,
+                      temperature, key):
+        self.prefill_traces += 1    # python body runs at trace time only
+        logits, (k_new, v_new) = self._model.apply(
+            {"params": params}, tokens, train=False, return_kv=True)
+        cache = cache.insert(slot, k_new, v_new, length)
+        last = jax.lax.dynamic_index_in_dim(logits[0], length - 1,
+                                            keepdims=False)        # [V]
+        token = sample_tokens(last[None], temperature[None], key,
+                              self.top_k)[0]
+        return cache, token
+
+    def _decode_impl(self, params, cache, last_tokens, active,
+                     temperature, key):
+        self.decode_traces += 1     # python body runs at trace time only
+        positions = jnp.minimum(cache.lengths, self.max_len - 1)
+        logits, (k2, v2) = self._model.apply(
+            {"params": params}, last_tokens[:, None], train=False,
+            cache=cache.model_view(), positions=positions)
+        tokens = sample_tokens(logits[:, 0, :], temperature, key,
+                               self.top_k)
+        return cache.advance(k2, v2, active), tokens
+
+    # ------------------------------------------------------------- host API
+    def _next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def prefill(self, slot: int, prompt: Sequence[int],
+                temperature: float = 0.0) -> int:
+        """Prefill ``prompt`` into ``slot`` and return the first sampled
+        token (host int). Blocks until the token is on the host — the
+        time-to-first-token boundary."""
+        n = len(prompt)
+        if not 0 < n <= self.prefill_len:
+            raise ValueError(f"prompt length {n} not in (0, "
+                             f"prefill_len={self.prefill_len}]")
+        if not 0 <= slot < self.slots:
+            raise ValueError(f"slot {slot} not in [0, {self.slots})")
+        tokens = np.zeros((1, self.prefill_len), np.int32)
+        tokens[0, :n] = np.asarray(prompt, np.int32)
+        t0 = time.perf_counter()
+        self.cache, token = self._with_prefill_blocks(
+            lambda: self._jit_prefill(
+                self.params, self.cache, jnp.asarray(tokens), np.int32(n),
+                np.int32(slot), np.float32(temperature), self._next_key()))
+        token = int(token)
+        if self._registry is not None:
+            self._registry.observe("serving.prefill.s",
+                                   time.perf_counter() - t0)
+            self._registry.counter_inc("serving.prefill.calls")
+            self._registry.counter_inc("serving.tokens_generated")
+        self.tokens_generated += 1
+        return token
+
+    def _with_prefill_blocks(self, fn):
+        """Run ``fn`` with the ``decode.prefill_block_q``/``_k`` tuned
+        keys temporarily installed as the flash-attention geometry.
+        Blocks resolve at TRACE time, so this bites exactly once — on
+        the call that traces the prefill program — and the training
+        ``flash.*`` values are restored before anything else traces."""
+        if self._pf_bq is None and self._pf_bk is None:
+            return fn()
+        keys = ("flash.block_q", "flash.block_k")
+        saved = {k: vmem.overrides().get(k) for k in keys}
+        for k, v in zip(keys, (self._pf_bq, self._pf_bk)):
+            if v:
+                vmem.set_override(k, v)
+        try:
+            return fn()
+        finally:
+            for k in keys:
+                if saved[k] is None:
+                    vmem.remove_override(k)
+                else:
+                    vmem.set_override(k, saved[k])
+
+    def decode_step(self, last_tokens, active, temperatures) -> np.ndarray:
+        """One decode step over every slot: ``last_tokens`` [slots] int
+        (each slot's most recent token), ``active`` [slots] bool,
+        ``temperatures`` [slots] float. Returns the next token per slot
+        (host int32 array; inactive rows are noise to discard)."""
+        t0 = time.perf_counter()
+        self.cache, tokens = self._jit_decode(
+            self.params, self.cache,
+            jnp.asarray(last_tokens, jnp.int32),
+            jnp.asarray(active, bool),
+            jnp.asarray(temperatures, jnp.float32), self._next_key())
+        out = np.asarray(tokens)            # device sync: step latency
+        n_active = int(np.sum(np.asarray(active, bool)))
+        self.tokens_generated += n_active
+        if self._registry is not None:
+            dt = time.perf_counter() - t0
+            self._registry.observe("serving.decode.step_s", dt)
+            self._registry.counter_inc("serving.decode.steps")
+            self._registry.counter_inc("serving.tokens_generated",
+                                       n_active)
+        return out
+
+    def lengths(self) -> np.ndarray:
+        """Host view of per-slot cache lengths."""
+        return np.asarray(self.cache.lengths)
+
+    def set_registry(self, registry) -> None:
+        """Swap the telemetry registry (e.g. after a compile-warmup pass,
+        so first-trace latency never poisons the serving histograms)."""
+        self._registry = registry
+
+    def reset(self) -> None:
+        """Zero the cache lengths (slot table wipe; K/V left in place —
+        length masking makes stale data unreachable)."""
+        self.cache = self.cache.replace(
+            lengths=jnp.zeros((self.slots,), jnp.int32))

@@ -1,0 +1,142 @@
+"""Preallocated slot KV cache — the serving engine's only mutable state.
+
+One device-resident pytree holds every request's attention history:
+
+- ``k``/``v``: ``[layers, slots, heads, max_len, head_dim]`` — slot ``s``
+  owns row ``[:, s]``; positions ``[0, lengths[s])`` are valid.
+- ``lengths``: ``[slots]`` int32 — valid positions per slot (0 = free).
+
+Storage dtype comes from the amp cast policies (bf16 by default — the
+same ``half_dtype`` the O2/O3 tables resolve), halving HBM versus fp32
+and feeding the decode kernel the dtype it upcasts per-tile anyway.
+
+Slot semantics (the continuous-batching contract):
+
+- **prefill** writes a request's prompt K/V into ``[0, P)`` of a free
+  slot and sets its length; positions past the true prompt length hold
+  pad garbage that is *never attended* (length masking) and is
+  overwritten position-by-position as decode advances.
+- **decode** writes each slot's new token at ``lengths[s]`` and then
+  attends ``[0, lengths[s]]`` — write-then-attend, so garbage can never
+  enter a softmax.
+- **eviction** is free: a finished slot is just marked length-0 on the
+  host; the next prefill overwrites it. No device-side compaction.
+
+Everything is functional: updates return a new :class:`KVCache` whose
+buffers alias the old ones under jit donation (the engine donates the
+cache to both of its compiled programs).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["KVCache"]
+
+
+@flax.struct.dataclass
+class KVCache:
+    """Slot-major KV cache pytree (see module docstring for semantics)."""
+
+    k: jnp.ndarray        # [layers, slots, heads, max_len, head_dim]
+    v: jnp.ndarray        # [layers, slots, heads, max_len, head_dim]
+    lengths: jnp.ndarray  # [slots] int32
+
+    # ------------------------------------------------------------- geometry
+    @property
+    def layers(self) -> int:
+        return self.k.shape[0]
+
+    @property
+    def slots(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def heads(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def max_len(self) -> int:
+        return self.k.shape[3]
+
+    @property
+    def head_dim(self) -> int:
+        return self.k.shape[4]
+
+    @property
+    def dtype(self):
+        return self.k.dtype
+
+    def nbytes(self) -> int:
+        """Device bytes held by the cache (both K and V)."""
+        return int(self.k.size * self.k.dtype.itemsize * 2)
+
+    # -------------------------------------------------------------- updates
+    @classmethod
+    def create(cls, *, layers: int, slots: int, heads: int, max_len: int,
+               head_dim: int, dtype: Any = jnp.bfloat16) -> "KVCache":
+        """Allocate a zeroed cache. ``dtype`` is normally the amp half
+        dtype (``policy.half_dtype`` / ``compute_dtype`` — the serving
+        engine resolves it from its policy)."""
+        shape = (layers, slots, heads, max_len, head_dim)
+        return cls(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
+                   lengths=jnp.zeros((slots,), jnp.int32))
+
+    def insert(self, slot, k_new, v_new, length) -> "KVCache":
+        """Write a prefilled request into ``slot``: ``k_new``/``v_new``
+        are the model's stacked prefill K/V ``[layers, 1, heads, P, d]``
+        (``P <= max_len``); the slot's length becomes ``length`` (the
+        true prompt length — pad positions in ``[length, P)`` are masked
+        by it). ``slot``/``length`` may be traced int32 scalars — the
+        jitted prefill program is slot- and length-agnostic."""
+        if k_new.ndim != 5 or k_new.shape[1] != 1:
+            raise ValueError(f"insert expects [layers, 1, heads, P, d] "
+                             f"prefill K/V, got {k_new.shape}")
+        P = k_new.shape[3]
+        if P > self.max_len:
+            raise ValueError(f"prefill length {P} exceeds cache max_len "
+                             f"{self.max_len}")
+        slot = jnp.asarray(slot, jnp.int32)
+        start = (jnp.int32(0), slot, jnp.int32(0), jnp.int32(0),
+                 jnp.int32(0))
+        k = jax.lax.dynamic_update_slice(
+            self.k, jnp.asarray(k_new, self.k.dtype), start)
+        v = jax.lax.dynamic_update_slice(
+            self.v, jnp.asarray(v_new, self.v.dtype), start)
+        lengths = self.lengths.at[slot].set(jnp.asarray(length, jnp.int32))
+        return self.replace(k=k, v=v, lengths=lengths)
+
+    def model_view(self):
+        """The ``(k, v)`` pair the model's decode path consumes
+        (``[layers, slots, heads, max_len, head_dim]`` — already the
+        cache layout; slots are the decode batch)."""
+        return self.k, self.v
+
+    def advance(self, k, v, active) -> "KVCache":
+        """Absorb a decode step: ``k``/``v`` are the model-returned
+        stacks (each slot's new token written at its old length) and
+        ``active`` [slots] bool marks slots whose length advances —
+        inactive slots keep their length so their (discarded) write is
+        re-overwritten by the next real occupant."""
+        grow = jnp.asarray(active, bool) & (self.lengths < self.max_len)
+        return self.replace(k=k, v=v,
+                            lengths=jnp.where(grow, self.lengths + 1,
+                                              self.lengths))
+
+    # ------------------------------------------------------------ reporting
+    def occupancy(self, active=None) -> float:
+        """Fraction of slots in use (host-side; by active mask when
+        given, else by nonzero length)."""
+        if active is not None:
+            return float(np.mean(np.asarray(active, bool)))
+        return float(np.mean(np.asarray(self.lengths) > 0))
+
+    def padding_waste(self, active=None) -> float:
+        """Fraction of the decode batch spent on empty slots — the
+        continuous-batching inefficiency signal (1 - occupancy)."""
+        return 1.0 - self.occupancy(active)

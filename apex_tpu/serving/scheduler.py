@@ -1,0 +1,267 @@
+"""Continuous-batching scheduler: requests in, token streams out.
+
+The naive way to serve N requests is static batching — pad them to one
+shape, decode until the LAST one finishes, waste every slot that
+finished early. Continuous batching instead treats the engine's decode
+step as a steady heartbeat and moves requests through slots between
+beats:
+
+1. **expire** — queued or running requests past their deadline finish
+   with status ``"timeout"`` (their slot frees immediately);
+2. **admit** — while a slot is free and the queue is non-empty, pop the
+   oldest request, prefill it into the slot (its first token = the
+   time-to-first-token mark), or finish it right there if the first
+   token is already EOS;
+3. **decode** — one fixed-shape engine step over all slots; each active
+   slot appends its token and finishes on EOS / ``max_new_tokens`` /
+   cache ``max_len``.
+
+Backpressure instead of OOM: the queue is bounded (``max_queue``);
+:meth:`submit` raises :class:`QueueFull` when it is at capacity, so a
+caller that outruns the engine gets a typed rejection to retry/shed —
+never an unbounded host-side pileup. (:meth:`run` absorbs the same
+signal by stepping the engine until space frees.)
+
+Telemetry (through the shared :class:`~apex_tpu.telemetry
+.MetricsRegistry`): ``serving.ttft_s`` and the engine's
+``serving.decode.step_s`` histograms (p50/p95/p99 via the streaming
+reservoir), ``serving.slot_occupancy`` / ``serving.padding_waste`` per
+step, request outcome counters, and a final ``serving.tokens_per_s``
+gauge from :meth:`run`.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import itertools
+import time
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from apex_tpu.log_util import get_logger
+
+__all__ = ["Request", "QueueFull", "Scheduler"]
+
+_logger = get_logger("serving")
+
+_uid = itertools.count()
+
+
+class QueueFull(RuntimeError):
+    """Raised by :meth:`Scheduler.submit` when the bounded request queue
+    is at capacity — the backpressure signal (shed or retry later)."""
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request and, after serving, its outcome.
+
+    Inputs: ``prompt`` (token ids), ``max_new_tokens``, ``temperature``
+    (0 = greedy), optional ``timeout_s`` (else the scheduler default).
+
+    Outputs (filled by the scheduler): ``output_tokens``, ``status``
+    (``"done"`` / ``"timeout"``), ``finish_reason`` (``"eos"`` /
+    ``"max_new_tokens"`` / ``"max_len"`` / ``"timeout"``), ``ttft_s``,
+    ``latency_s``.
+    """
+
+    prompt: Sequence[int]
+    max_new_tokens: int = 16
+    temperature: float = 0.0
+    timeout_s: Optional[float] = None
+    uid: int = dataclasses.field(default_factory=lambda: next(_uid))
+
+    # filled in by the scheduler
+    output_tokens: List[int] = dataclasses.field(default_factory=list)
+    status: str = "new"
+    finish_reason: Optional[str] = None
+    ttft_s: Optional[float] = None
+    latency_s: Optional[float] = None
+    _t_submit: Optional[float] = dataclasses.field(default=None,
+                                                   repr=False)
+
+
+class Scheduler:
+    """Continuous-batching front of an :class:`~apex_tpu.serving.Engine`
+    (see module docstring for the step anatomy)."""
+
+    def __init__(self, engine, *, max_queue: int = 64,
+                 default_timeout_s: Optional[float] = None,
+                 eos_id: Optional[int] = None, registry=None):
+        if max_queue < 1:
+            raise ValueError("max_queue must be >= 1")
+        self.engine = engine
+        self.max_queue = int(max_queue)
+        self.default_timeout_s = default_timeout_s
+        self.eos_id = eos_id
+        self.registry = registry if registry is not None \
+            else getattr(engine, "_registry", None)
+        self._queue: collections.deque = collections.deque()
+        self._running: List[Optional[Request]] = [None] * engine.slots
+        self._last_tokens = np.zeros(engine.slots, np.int32)
+        self._temps = np.zeros(engine.slots, np.float32)
+        self.completed: List[Request] = []
+
+    # ------------------------------------------------------------ ingestion
+    def submit(self, request: Request) -> Request:
+        """Queue ``request``; raises :class:`QueueFull` at capacity and
+        ``ValueError`` for prompts the engine can never serve."""
+        n = len(request.prompt)
+        if not 0 < n <= self.engine.prefill_len:
+            raise ValueError(
+                f"prompt length {n} not in (0, prefill_len="
+                f"{self.engine.prefill_len}] — the fixed-shape prefill "
+                "program cannot admit it")
+        if request.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if len(self._queue) >= self.max_queue:
+            if self.registry is not None:
+                self.registry.counter_inc("serving.requests.rejected")
+            raise QueueFull(
+                f"request queue at capacity ({self.max_queue}); retry "
+                "after a step() or shed load")
+        request.status = "queued"
+        request._t_submit = time.perf_counter()
+        self._queue.append(request)
+        if self.registry is not None:
+            self.registry.counter_inc("serving.requests.submitted")
+        return request
+
+    # ----------------------------------------------------------- accounting
+    def _finish(self, request: Request, reason: str,
+                slot: Optional[int] = None) -> None:
+        request.finish_reason = reason
+        request.status = "timeout" if reason == "timeout" else "done"
+        if request._t_submit is not None:
+            request.latency_s = time.perf_counter() - request._t_submit
+        if slot is not None:
+            self._running[slot] = None
+            self._temps[slot] = 0.0
+        self.completed.append(request)
+        if self.registry is not None:
+            key = ("serving.requests.timeout" if reason == "timeout"
+                   else "serving.requests.completed")
+            self.registry.counter_inc(key)
+
+    def _deadline(self, request: Request) -> Optional[float]:
+        t = request.timeout_s if request.timeout_s is not None \
+            else self.default_timeout_s
+        if t is None or request._t_submit is None:
+            return None
+        return request._t_submit + t
+
+    def _expire(self, now: float) -> None:
+        for r in [r for r in self._queue
+                  if (d := self._deadline(r)) is not None and now > d]:
+            self._queue.remove(r)
+            self._finish(r, "timeout")
+        for slot, r in enumerate(self._running):
+            if r is None:
+                continue
+            d = self._deadline(r)
+            if d is not None and now > d:
+                self._finish(r, "timeout", slot)
+
+    # ------------------------------------------------------------ admission
+    def _admit(self) -> None:
+        for slot in range(self.engine.slots):
+            if self._running[slot] is not None:
+                continue
+            # keep filling THIS slot: a request that finishes right at
+            # prefill (instant EOS / budget 1) leaves it free for the next
+            while self._queue and self._running[slot] is None:
+                r = self._queue.popleft()
+                token = self.engine.prefill(slot, list(r.prompt),
+                                            temperature=r.temperature)
+                r.ttft_s = time.perf_counter() - r._t_submit
+                if self.registry is not None:
+                    self.registry.observe("serving.ttft_s", r.ttft_s)
+                r.output_tokens.append(token)
+                r.status = "running"
+                if self.eos_id is not None and token == self.eos_id:
+                    self._finish(r, "eos")
+                elif r.max_new_tokens <= 1:
+                    self._finish(r, "max_new_tokens")
+                elif len(r.prompt) >= self.engine.max_len:
+                    # cache already full: a decode step would overwrite
+                    # the last prompt position's K/V (the engine clamps
+                    # its write to max_len-1) and emit a corrupted token
+                    self._finish(r, "max_len")
+                else:
+                    self._running[slot] = r
+                    self._last_tokens[slot] = token
+                    self._temps[slot] = r.temperature
+
+    # ------------------------------------------------------------- stepping
+    def step(self) -> bool:
+        """One scheduler beat: expire → admit → decode. Returns True if
+        a decode step ran (i.e. any slot was active)."""
+        self._expire(time.perf_counter())
+        self._admit()
+        active = np.array([r is not None for r in self._running])
+        if self.registry is not None:
+            occ = float(active.mean())
+            self.registry.gauge_set("serving.slot_occupancy", occ)
+            self.registry.observe("serving.slot_occupancy", occ)
+            self.registry.observe("serving.padding_waste", 1.0 - occ)
+        if not active.any():
+            return False
+        tokens = self.engine.decode_step(self._last_tokens, active,
+                                         self._temps)
+        lengths = self.engine.lengths()
+        for slot, r in enumerate(self._running):
+            if r is None:
+                continue
+            token = int(tokens[slot])
+            r.output_tokens.append(token)
+            self._last_tokens[slot] = token
+            if self.eos_id is not None and token == self.eos_id:
+                self._finish(r, "eos", slot)
+            elif len(r.output_tokens) >= r.max_new_tokens:
+                self._finish(r, "max_new_tokens", slot)
+            elif int(lengths[slot]) >= self.engine.max_len:
+                # cache exhausted: the NEXT token would have nowhere to
+                # attend from
+                self._finish(r, "max_len", slot)
+        return True
+
+    @property
+    def pending(self) -> int:
+        """Queued + running request count (drain target)."""
+        return len(self._queue) + sum(r is not None
+                                      for r in self._running)
+
+    # ---------------------------------------------------------------- runs
+    def run(self, requests: Sequence[Request] = (),
+            max_steps: int = 100000) -> List[Request]:
+        """Submit ``requests`` (stepping through :class:`QueueFull`
+        backpressure rather than surfacing it) and drain until every
+        request finishes. Returns them in completion order and records
+        the run's ``serving.tokens_per_s`` gauge."""
+        t0 = time.perf_counter()
+        tok0 = self.engine.tokens_generated
+        done0 = len(self.completed)
+        for r in requests:
+            while True:
+                try:
+                    self.submit(r)
+                    break
+                except QueueFull:
+                    # a step admits queued work into slots (and decodes),
+                    # freeing queue capacity — backpressure absorbed here
+                    if not self.step() and not self._queue:
+                        raise    # nothing active yet queue full: no drain
+        steps = 0
+        while self.pending and steps < max_steps:
+            self.step()
+            steps += 1
+        dt = time.perf_counter() - t0
+        toks = self.engine.tokens_generated - tok0
+        if self.registry is not None and dt > 0:
+            self.registry.gauge_set("serving.tokens_per_s", toks / dt)
+        _logger.info("served %d request(s): %d tokens in %.3fs "
+                     "(%.1f tok/s)", len(self.completed) - done0, toks,
+                     dt, toks / dt if dt > 0 else float("inf"))
+        return self.completed[done0:]

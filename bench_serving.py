@@ -1,0 +1,136 @@
+"""Serving benchmark: continuous-batching decode throughput (tokens/s).
+
+Exercises the full ``apex_tpu.serving`` stack — compiled prefill +
+decode-step programs over a bf16 slot KV cache, continuous-batching
+scheduler — on a stream of synthetic variable-length requests, and
+prints ONE JSON line::
+
+  {"metric": "serving_decode_tokens_per_sec", "value": N,
+   "unit": "tokens/s", ...}
+
+Methodology matches bench.py: a warmup window (compiles both programs;
+discarded), then >= BENCH_SERVING_WINDOWS measured windows reported as
+median + min + spread so one line carries its own noise bars. The line
+also carries the latency layer the issue asks for: time-to-first-token
+p50/p95/p99 and per-decode-step p50/p95/p99 from the telemetry
+registry's streaming histograms, plus mean slot occupancy / padding
+waste (the continuous-batching efficiency signal).
+
+Wrapped in ``guard_bench_main`` — EVERY outcome (backend init failure,
+OOM, bad env) still ends in a parseable JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+METRIC = "serving_decode_tokens_per_sec"
+
+SIZE = os.environ.get("BENCH_SERVING_SIZE", "small")
+VOCAB = int(os.environ.get("BENCH_SERVING_VOCAB", "32768"))
+SLOTS = int(os.environ.get("BENCH_SERVING_SLOTS", "8"))
+MAX_LEN = int(os.environ.get("BENCH_SERVING_MAX_LEN", "512"))
+PREFILL_LEN = int(os.environ.get("BENCH_SERVING_PREFILL", "128"))
+REQUESTS = int(os.environ.get("BENCH_SERVING_REQUESTS", "24"))
+NEW_TOKENS = int(os.environ.get("BENCH_SERVING_NEW_TOKENS", "64"))
+WINDOWS = int(os.environ.get("BENCH_SERVING_WINDOWS", "3"))
+TOP_K = int(os.environ.get("BENCH_SERVING_TOP_K", "0"))
+
+
+def _median(xs):
+    xs = sorted(xs)
+    mid = len(xs) // 2
+    return xs[mid] if len(xs) % 2 else 0.5 * (xs[mid - 1] + xs[mid])
+
+
+def _requests(rng):
+    from apex_tpu.serving import Request
+
+    reqs = []
+    for _ in range(REQUESTS):
+        n = int(rng.integers(1, PREFILL_LEN + 1))
+        budget = max(1, min(NEW_TOKENS, MAX_LEN - n))
+        reqs.append(Request(
+            prompt=rng.integers(1, VOCAB, size=n).tolist(),
+            max_new_tokens=budget))
+    return reqs
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu import serving, telemetry
+    from apex_tpu.models.transformer_lm import create_lm
+
+    tele = telemetry.from_env()     # APEX_TPU_TELEMETRY streams per-run
+    reg = tele if tele is not None else telemetry.MetricsRegistry()
+
+    model = create_lm(SIZE, vocab_size=VOCAB, max_seq_len=MAX_LEN)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32),
+                        train=False)["params"]
+    engine = serving.Engine(model, params, slots=SLOTS, max_len=MAX_LEN,
+                            prefill_len=PREFILL_LEN, top_k=TOP_K)
+
+    rng = np.random.default_rng(0)
+    rates = []
+    for w in range(WINDOWS + 1):          # window 0 = compile warmup
+        engine.reset()
+        if w == 1:
+            # attach telemetry only after warmup: first-trace compile
+            # latency must not poison the TTFT/step histograms
+            engine.set_registry(reg)
+        sched = serving.Scheduler(engine, max_queue=max(REQUESTS, 1))
+        t0 = time.perf_counter()
+        tok0 = engine.tokens_generated
+        done = sched.run(_requests(rng))
+        dt = time.perf_counter() - t0
+        toks = engine.tokens_generated - tok0
+        assert len(done) == REQUESTS
+        if w > 0:
+            rates.append(toks / dt)
+
+    snap = reg.snapshot()
+    ttft = snap["histograms"].get("serving.ttft_s", {})
+    step = snap["histograms"].get("serving.decode.step_s", {})
+    occ = snap["histograms"].get("serving.slot_occupancy", {})
+    value = _median(rates)
+    spread = (max(rates) - min(rates)) / value * 100.0 if value else 0.0
+    print(json.dumps({
+        "metric": METRIC,
+        "value": round(value, 2),
+        "unit": "tokens/s",
+        "min": round(min(rates), 2),
+        "spread_pct": round(spread, 1),
+        "windows": WINDOWS,
+        "compiled_programs": engine.prefill_traces + engine.decode_traces,
+        "model": SIZE,
+        "slots": SLOTS,
+        "max_len": MAX_LEN,
+        "prefill_len": PREFILL_LEN,
+        "requests_per_window": REQUESTS,
+        "cache_dtype": np.dtype(engine.cache.dtype).name,
+        "cache_mib": round(engine.cache.nbytes() / 2**20, 2),
+        "ttft_p50_ms": round(ttft.get("p50", 0.0) * 1e3, 3),
+        "ttft_p95_ms": round(ttft.get("p95", 0.0) * 1e3, 3),
+        "ttft_p99_ms": round(ttft.get("p99", 0.0) * 1e3, 3),
+        "decode_step_p50_ms": round(step.get("p50", 0.0) * 1e3, 3),
+        "decode_step_p95_ms": round(step.get("p95", 0.0) * 1e3, 3),
+        "decode_step_p99_ms": round(step.get("p99", 0.0) * 1e3, 3),
+        "slot_occupancy_mean": round(occ.get("mean", 0.0), 3),
+        "padding_waste_mean": round(1.0 - occ.get("mean", 0.0), 3),
+        "backend": jax.default_backend(),
+    }))
+    if tele is not None:
+        tele.emit_snapshot()
+        tele.close()
+
+
+if __name__ == "__main__":
+    from apex_tpu.telemetry import guard_bench_main
+    guard_bench_main(main, METRIC)

@@ -39,6 +39,7 @@ PAGES = {
                     "apex_tpu.transformer.context_parallel",
                     "apex_tpu.transformer.moe"],
     "kernels": ["apex_tpu.kernels", "apex_tpu.kernels.flash_attention",
+                "apex_tpu.kernels.decode_attention",
                 "apex_tpu.kernels.layer_norm", "apex_tpu.kernels.xentropy",
                 "apex_tpu.kernels.lm_head_loss",
                 "apex_tpu.kernels.multi_tensor",
@@ -52,6 +53,8 @@ PAGES = {
               "apex_tpu.utils.schedule_report", "apex_tpu.pyprof"],
     "telemetry": ["apex_tpu.telemetry", "apex_tpu.telemetry.sinks",
                   "apex_tpu.telemetry.summarize", "apex_tpu.log_util"],
+    "serving": ["apex_tpu.serving", "apex_tpu.serving.kv_cache",
+                "apex_tpu.serving.engine", "apex_tpu.serving.scheduler"],
     "contrib": [
         "apex_tpu.contrib.bottleneck", "apex_tpu.contrib.clip_grad",
         "apex_tpu.contrib.conv_bias_relu", "apex_tpu.contrib.cudnn_gbn",

@@ -144,6 +144,27 @@ def parse_args(argv=None):
                         "jitted step: JSONL path, 'stdout', or 'null'; "
                         "summarize with python -m apex_tpu.telemetry "
                         "(sharded paths emit one record per rank)")
+    # ---- serving tier (apex_tpu.serving): generate after training
+    p.add_argument("--generate", type=int, default=0, metavar="N",
+                   help="after training, serve N-token generations from "
+                        "synthetic prompts through the apex_tpu.serving "
+                        "engine (compiled KV-cache prefill + decode-step "
+                        "programs, continuous batching) and print "
+                        "tokens/s + time-to-first-token. Single-chip "
+                        "path only")
+    p.add_argument("--gen-prompts", type=int, default=8, metavar="K",
+                   help="number of synthetic prompts to serve (their "
+                        "lengths vary to exercise continuous batching)")
+    p.add_argument("--gen-slots", type=int, default=4,
+                   help="concurrent decode slots (batch width of the "
+                        "compiled decode step)")
+    p.add_argument("--gen-prompt-len", type=int, default=32,
+                   help="prefill program capacity (prompts are sampled "
+                        "at 1..this many tokens)")
+    p.add_argument("--gen-temperature", type=float, default=0.0,
+                   help="sampling temperature (0 = greedy)")
+    p.add_argument("--gen-top-k", type=int, default=0,
+                   help="top-k truncation for sampled decode (0 = off)")
     return p.parse_args(argv)
 
 
@@ -983,12 +1004,57 @@ def _maybe_save(args, state, rng):
     save_train_checkpoint(args.save, state, args.iters, rng)
 
 
+def _maybe_generate(args, model, params, tele):
+    """--generate N: serve synthetic variable-length prompts through the
+    compiled KV-cache engine (apex_tpu.serving) with the just-trained
+    params — the recipe's end-to-end inference leg. Returns the
+    completed requests (for callers/tests inspecting the outputs)."""
+    if not args.generate:
+        return None
+    import numpy as _np
+
+    from apex_tpu import serving
+
+    plen = min(args.gen_prompt_len, args.seq_len - 1)
+    max_len = min(args.seq_len, plen + args.generate)
+    engine = serving.Engine(model, params, slots=args.gen_slots,
+                            max_len=max_len, prefill_len=plen,
+                            top_k=args.gen_top_k, registry=tele)
+    sched = serving.Scheduler(engine, registry=tele,
+                              max_queue=max(args.gen_prompts, 1))
+    rng = _np.random.default_rng(args.seed)
+    reqs = [serving.Request(
+        prompt=rng.integers(1, args.vocab_size,
+                            size=int(rng.integers(1, plen + 1))).tolist(),
+        max_new_tokens=args.generate, temperature=args.gen_temperature)
+        for _ in range(args.gen_prompts)]
+    t0 = time.perf_counter()
+    done = sched.run(reqs)
+    dt = time.perf_counter() - t0
+    toks = sum(len(r.output_tokens) for r in done)
+    ttfts = [r.ttft_s for r in done if r.ttft_s is not None]
+    print(f"=> generate: {len(done)} requests, {toks} tokens in "
+          f"{dt:.2f}s ({toks / dt:,.0f} tokens/s), "
+          f"ttft p50 {sorted(ttfts)[len(ttfts) // 2] * 1e3:.1f} ms, "
+          f"compiled programs: "
+          f"{engine.prefill_traces + engine.decode_traces}")
+    preview = done[0]
+    print(f"   sample [{preview.finish_reason}]: "
+          f"{list(preview.prompt)[:8]}... -> "
+          f"{preview.output_tokens[:16]}")
+    return done
+
+
 def main(argv=None):
     args = parse_args(argv)
     if args.iters < 1:
         raise SystemExit("--iters must be >= 1")
     if args.accum_steps < 1:
         raise SystemExit("--accum-steps must be >= 1")
+    if args.generate and (args.gen_prompts < 1 or args.gen_slots < 1
+                          or args.gen_prompt_len < 1):
+        raise SystemExit("--generate needs --gen-prompts, --gen-slots and "
+                         "--gen-prompt-len all >= 1")
     if args.batch_size % args.accum_steps:
         raise SystemExit(f"--batch-size {args.batch_size} must divide by "
                          f"--accum-steps {args.accum_steps}")
@@ -997,6 +1063,12 @@ def main(argv=None):
     print(policy.banner())
     if (args.data_parallel * args.tensor_parallel
             * args.pipeline_parallel * args.virtual_pipeline) > 1:
+        if args.generate:
+            raise SystemExit(
+                "--generate runs on the single-chip path only (the "
+                "serving engine consumes the flax param tree, not the "
+                "parallel tiers' scattered stage layout); drop the "
+                "parallelism flags or serve from a --save checkpoint")
         if args.accum_steps > 1:
             raise SystemExit(
                 "--accum-steps composes with the single-chip path only: "
@@ -1105,6 +1177,7 @@ def main(argv=None):
         return None
     _maybe_prof_device(args, jit_step, state, batch)
     _maybe_save(args, state, rng)
+    _maybe_generate(args, model, state.params, tele)
     _finish_telemetry(tele)
     metrics = dict(metrics)
     metrics["final_state"] = state

@@ -220,56 +220,6 @@ def test_migration_detects_dict_keyed_field(tmp_path):
     assert int(restored["extras"]["hysteresis_left"]) == 2  # template fill
 
 
-# ---------------------------------------------------------------- fuzzing
-from hypothesis import given, settings, strategies as st  # noqa: E402
-
-
-@st.composite
-def _pytrees(draw, depth=0):
-    """Random nested dict pytrees over the dtypes train states carry."""
-    if depth >= 2 or (depth > 0 and draw(st.booleans())):
-        dtype = draw(st.sampled_from(
-            [jnp.float32, jnp.bfloat16, jnp.float16, jnp.int32,
-             jnp.uint32, jnp.bool_]))
-        shape = tuple(draw(st.lists(st.integers(1, 4), min_size=0,
-                                    max_size=3)))
-        seed = draw(st.integers(0, 2**31 - 1))
-        rng = np.random.RandomState(seed)
-        if dtype == jnp.bool_:
-            arr = rng.rand(*shape) > 0.5
-        elif jnp.issubdtype(dtype, jnp.integer):
-            arr = rng.randint(0, 1000, size=shape)
-        else:
-            arr = rng.randn(*shape) * draw(st.sampled_from([1e-4, 1.0,
-                                                            1e4]))
-        return jnp.asarray(arr, dtype)
-    n = draw(st.integers(1, 3))
-    keys = draw(st.lists(st.text(alphabet="abcdef_", min_size=1,
-                                 max_size=6), min_size=n, max_size=n,
-                         unique=True))
-    return {k: draw(_pytrees(depth + 1)) for k in keys}
-
-
-@given(_pytrees(), st.integers(0, 10**6))
-@settings(max_examples=25, deadline=None)
-def test_checkpoint_roundtrip_any_pytree(tmp_path_factory, tree, step):
-    """Property: save→load is bitwise over ARBITRARY nested pytrees and
-    every dtype a train state carries (fp32, bf16 — which rides npz as
-    fp32 and must cast back bit-faithfully — fp16, ints, bools), with
-    dtype and step preserved exactly."""
-    path = os.path.join(tmp_path_factory.mktemp("fuzz"), "t.npz")
-    save_checkpoint(path, tree, step=step)
-    template = jax.tree_util.tree_map(jnp.zeros_like, tree)
-    restored, got_step, _ = load_checkpoint(path, template)
-    assert got_step == step
-
-    def check(a, b):
-        assert a.dtype == b.dtype, (a.dtype, b.dtype)
-        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
-
-    jax.tree_util.tree_map(check, restored, tree)
-
-
 def test_abstract_template_restores_without_materializing(tmp_path):
     """jax.eval_shape output works as the load template (shapes/dtypes
     validated, nothing allocated) — unless migration needs real values,

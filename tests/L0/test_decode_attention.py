@@ -1,0 +1,131 @@
+"""Decode-attention kernel: Pallas path vs jnp oracle (interpret/CPU).
+
+Mirrors the flash-attention test strategy: the reference implementation
+is the oracle (never golden files), the Pallas path runs in interpret
+mode on CPU, and the unaligned/fallback dispatch must agree with the
+aligned path on what it accepts."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.kernels import vmem
+from apex_tpu.kernels.decode_attention import (decode_attention,
+                                               decode_attention_reference)
+
+pytestmark = pytest.mark.serving
+
+
+def _rand(rng, shape, dtype=jnp.float32):
+    return jnp.asarray(rng.standard_normal(shape), dtype)
+
+
+@pytest.mark.parametrize("lengths", [[1, 5, 256], [0, 37, 128],
+                                     [256, 256, 256]])
+def test_pallas_matches_reference_aligned(lengths):
+    rng = np.random.default_rng(0)
+    B, h, L, d = 3, 4, 256, 64
+    q = _rand(rng, (B, h, d))
+    k = _rand(rng, (B, h, L, d))
+    v = _rand(rng, (B, h, L, d))
+    lens = jnp.asarray(lengths, jnp.int32)
+    ref = decode_attention_reference(q, k, v, lens, scale=1.0 / d ** 0.5)
+    out = decode_attention(q, k, v, lens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_zero_length_rows_are_zero():
+    rng = np.random.default_rng(1)
+    B, h, L, d = 2, 2, 128, 8
+    q = _rand(rng, (B, h, d))
+    k = _rand(rng, (B, h, L, d))
+    v = _rand(rng, (B, h, L, d))
+    lens = jnp.asarray([0, 4], jnp.int32)
+    out = np.asarray(decode_attention(q, k, v, lens))
+    assert np.all(out[0] == 0.0)
+    assert np.any(out[1] != 0.0)
+
+
+def test_masking_ignores_positions_past_length():
+    """Garbage K/V past a row's length must not move its output — the
+    write-then-attend cache contract depends on it."""
+    rng = np.random.default_rng(2)
+    B, h, L, d = 2, 4, 256, 16
+    q = _rand(rng, (B, h, d))
+    k = _rand(rng, (B, h, L, d))
+    v = _rand(rng, (B, h, L, d))
+    lens = jnp.asarray([9, 200], jnp.int32)
+    base = np.asarray(decode_attention(q, k, v, lens))
+    k2 = k.at[0, :, 9:].set(1e4)   # poison past-length positions, row 0
+    v2 = v.at[0, :, 9:].set(-1e4)
+    pert = np.asarray(decode_attention(q, k2, v2, lens))
+    np.testing.assert_allclose(pert[0], base[0], rtol=1e-6, atol=1e-6)
+
+
+def test_unaligned_falls_back_and_matches_reference():
+    rng = np.random.default_rng(3)
+    B, h, L, d = 2, 3, 100, 12     # L%128 != 0, d%8 != 0
+    q = _rand(rng, (B, h, d))
+    k = _rand(rng, (B, h, L, d))
+    v = _rand(rng, (B, h, L, d))
+    lens = jnp.asarray([10, 100], jnp.int32)
+    out = decode_attention(q, k, v, lens)
+    ref = decode_attention_reference(q, k, v, lens, scale=1.0 / d ** 0.5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_bf16_io_close_to_fp32_oracle():
+    rng = np.random.default_rng(4)
+    B, h, L, d = 2, 4, 256, 32
+    q = _rand(rng, (B, h, d))
+    k = _rand(rng, (B, h, L, d))
+    v = _rand(rng, (B, h, L, d))
+    lens = jnp.asarray([17, 256], jnp.int32)
+    ref = decode_attention_reference(q, k, v, lens, scale=1.0 / d ** 0.5)
+    out = decode_attention(q.astype(jnp.bfloat16), k.astype(jnp.bfloat16),
+                           v.astype(jnp.bfloat16), lens)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref), rtol=0.05, atol=0.05)
+
+
+def test_tuned_block_override_changes_nothing_numerically():
+    rng = np.random.default_rng(5)
+    B, h, L, d = 2, 2, 512, 16
+    q = _rand(rng, (B, h, d))
+    k = _rand(rng, (B, h, L, d))
+    v = _rand(rng, (B, h, L, d))
+    lens = jnp.asarray([3, 400], jnp.int32)
+    base = np.asarray(decode_attention(q, k, v, lens))
+    vmem.set_override("decode.block_k", 128)
+    try:
+        tuned = np.asarray(decode_attention(q, k, v, lens))
+    finally:
+        vmem.remove_override("decode.block_k")
+    np.testing.assert_allclose(tuned, base, rtol=2e-5, atol=2e-5)
+
+
+def test_shape_validation():
+    q = jnp.zeros((2, 2, 8))
+    k = jnp.zeros((2, 2, 16, 8))
+    with pytest.raises(ValueError, match="lengths"):
+        decode_attention(q, k, k, jnp.zeros((3,), jnp.int32))
+    with pytest.raises(ValueError, match="do not match"):
+        decode_attention(q, k[:, :1], k, jnp.zeros((2,), jnp.int32))
+
+
+def test_jit_and_explicit_block_k():
+    rng = np.random.default_rng(6)
+    B, h, L, d = 1, 2, 256, 8
+    q = _rand(rng, (B, h, d))
+    k = _rand(rng, (B, h, L, d))
+    v = _rand(rng, (B, h, L, d))
+    lens = jnp.asarray([129], jnp.int32)
+    out = jax.jit(lambda *a: decode_attention(*a, block_k=128))(q, k, v,
+                                                                lens)
+    ref = decode_attention_reference(q, k, v, lens, scale=1.0 / d ** 0.5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)

@@ -8,7 +8,10 @@ files)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
-from hypothesis import given, settings, strategies as st
+import pytest
+
+pytest.importorskip("hypothesis")   # optional dev dep: skip, don't error
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from apex_tpu.kernels.layer_norm import (layer_norm, layer_norm_reference,
                                          rms_norm, rms_norm_reference)

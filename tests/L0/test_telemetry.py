@@ -281,6 +281,24 @@ def test_timed_context_manager(spy_registry):
 
 # ------------------------------------------------------ bench crash contract
 
+def test_every_bench_driver_routes_through_guard_bench_main():
+    """Every bench_*.py entry point must end in a parseable JSON line on
+    ANY outcome — i.e. wrap its main in guard_bench_main. A new bench
+    leg that forgets the guard reintroduces the '"parsed": null' failure
+    mode this contract exists to kill."""
+    import glob
+
+    root = os.path.join(os.path.dirname(__file__), os.pardir, os.pardir)
+    drivers = sorted(glob.glob(os.path.join(root, "bench*.py")))
+    assert len(drivers) >= 5        # bench, kernels, memory, schedule, serving
+    for path in drivers:
+        with open(path) as f:
+            src = f.read()
+        assert "guard_bench_main(" in src, \
+            f"{os.path.basename(path)} does not route through " \
+            "guard_bench_main"
+
+
 def test_guard_bench_main_failure_ends_in_json_line(capsys):
     def exploding_main():
         raise RuntimeError("backend init failed")
