@@ -1,0 +1,223 @@
+"""Chunked-prefill attention — a block of queries against the KV cache.
+
+:mod:`~apex_tpu.kernels.decode_attention` answers "ONE new token per
+sequence against the cached prefix"; chunked prefill (Sarathi-style)
+asks the in-between question: a CHUNK of ``C`` consecutive prompt tokens
+per sequence, already written into the cache at positions
+``[offset, offset + C)``, each attending causally over everything before
+and including itself. The serving engine runs one such chunk per decode
+heartbeat, so in-flight decodes never wait for a whole prompt — the
+monolithic ``[1, prefill_len]`` prefill's head-of-line blocking becomes
+at most one chunk of latency.
+
+Geometry is the flash kernel's blockwise online softmax with the causal
+diagonal shifted by a per-row *cache offset*: query row ``i`` of batch
+row ``b`` sits at global position ``offsets[b] + i`` and attends cache
+positions ``[0, offsets[b] + i]``. KV blocks entirely past the chunk's
+last query position skip their compute (the decode kernel's length
+skip, lifted to a q-block × k-block skip), so an early chunk of a long
+prompt pays MXU work for the prefix it can see, not for ``max_len``
+(the block pipeline still streams the full cache row through VMEM —
+bounding the DMA extent too needs a trace-time cap on offsets, a
+future lever).
+
+Layouts (matching the serving cache, one slot per batch row):
+
+- ``q``: ``[batch, heads, C, d]`` — the chunk's queries.
+- ``k``/``v``: ``[batch, heads, max_len, d]`` — the cache view; the
+  chunk's own K/V must already be written at ``[offset, offset + C)``
+  (the serving tier's write-then-attend order).
+- ``offsets``: ``[batch]`` int32 — valid cache positions before the
+  chunk (= the slot's pre-chunk length).
+
+Numerics follow the kernel tier's contract: fp32 accumulation regardless
+of I/O dtype, and a pure-jnp reference that doubles as the CPU/unaligned
+fallback and the test oracle. Pad rows of a final partial chunk compute
+garbage that the engine never samples from.
+
+Block geometry rides the shared tuned-override registry
+(:mod:`apex_tpu.kernels.vmem`) under ``decode.chunk_block_q``
+(sublane-multiple 8) and ``decode.chunk_block_k`` (lane-multiple 128).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from apex_tpu.kernels import mosaic_dtype_ok, vmem
+
+__all__ = ["prefill_attention", "prefill_attention_reference"]
+
+_NEG_INF = -1e30
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 256
+
+
+# --------------------------------------------------------------- jnp reference
+def prefill_attention_reference(q, k, v, offsets, *, scale: float = 1.0):
+    """fp32-math oracle: per-row shifted-causal softmax over the cache.
+
+    ``q`` [b, h, C, d]; ``k``/``v`` [b, h, L, d]; ``offsets`` [b] int32.
+    Query row ``i`` attends cache positions ``j <= offsets[b] + i``.
+    Returns [b, h, C, d] in ``q.dtype``.
+    """
+    out_dtype = q.dtype
+    q32, k32, v32 = (jnp.asarray(t, jnp.float32) for t in (q, k, v))
+    s = jnp.einsum("bhqd,bhld->bhql", q32, k32) * scale
+    C, L = q.shape[2], k.shape[2]
+    rows = (offsets[:, None, None, None]
+            + jnp.arange(C, dtype=jnp.int32)[None, None, :, None])
+    cols = jnp.arange(L, dtype=jnp.int32)[None, None, None, :]
+    s = jnp.where(cols <= rows, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.asarray(jnp.einsum("bhql,bhld->bhqd", p, v32), out_dtype)
+
+
+# -------------------------------------------------------------------- kernel
+def _prefill_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
+                    l_ref, *, scale, block_q, block_k):
+    """Grid (bh, nq, nk): one batch·head row, q-blocked chunk, blockwise
+    over cached KV. The (m, l) recurrence is the flash forward kernel's;
+    the causal skip/mask runs on GLOBAL query positions ``offset + row``
+    instead of chunk-local ones, which is the whole difference between
+    training attention and chunked prefill."""
+    b = pl.program_id(0)
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+    offset = off_ref[b]
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # skip KV blocks entirely past this q-block's LAST global position
+    @pl.when(ki * block_k <= offset + qi * block_q + block_q - 1)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)                     # [bq, d]
+        k = k_ref[0].astype(jnp.float32)                     # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale      # [bq, bk]
+        rows = offset + qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        cols = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(cols <= rows, s, _NEG_INF)
+        m_prev = m_ref[:, :1]                                # [bq, 1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                               # [bq, bk]
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        # every row attends at least its own position, so l > 0 always;
+        # the guard only keeps a mis-called kernel finite
+        l = l_ref[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+
+
+def _prefill_pallas(q3, k3, v3, off3, scale, bq, bk, interpret):
+    bh, C, d = q3.shape
+    L = k3.shape[1]
+    kernel = functools.partial(_prefill_kernel, scale=scale, block_q=bq,
+                               block_k=bk)
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, C // bq, L // bk),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),                 # offsets
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),   # q
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),   # k
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),   # v
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, C, d), q3.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),      # acc
+            pltpu.VMEM((bq, 128), jnp.float32),    # m (col 0 live)
+            pltpu.VMEM((bq, 128), jnp.float32),    # l (col 0 live)
+        ],
+        interpret=interpret,
+    )(off3, q3, k3, v3)
+
+
+# ------------------------------------------------------------------ dispatch
+def _resolve_blocks(block_q, block_k):
+    if block_q is None:
+        block_q = vmem.get_override("decode.chunk_block_q",
+                                    DEFAULT_BLOCK_Q, multiple=8)
+    if block_k is None:
+        block_k = vmem.get_override("decode.chunk_block_k",
+                                    DEFAULT_BLOCK_K, multiple=128)
+    return block_q, block_k
+
+
+def prefill_attention(q, k, v, offsets, *, scale: Optional[float] = None,
+                      block_q: Optional[int] = None,
+                      block_k: Optional[int] = None,
+                      interpret: bool = False):
+    """Chunk-of-queries attention against a cached, offset prefix.
+
+    ``q`` [batch, heads, C, head_dim] — C consecutive prompt tokens whose
+    K/V are already written into the cache at ``[offsets[b],
+    offsets[b] + C)``; ``k``/``v`` [batch, heads, max_len, head_dim] (the
+    serving cache's per-layer view); ``offsets`` [batch] int32. Query
+    row ``i`` attends cache positions ``[0, offsets[b] + i]`` — the
+    shifted-causal mask of chunked prefill. ``scale`` defaults to
+    ``1/sqrt(head_dim)``.
+
+    Inference-only (no VJP — prefill never backprops). The Pallas path
+    skips the compute of KV blocks past each q-block's last global
+    position, so chunk ``n`` of a prompt costs O(offset + C) MXU work
+    rather than O(max_len) (block DMA still covers the cache row);
+    unaligned shapes and non-Mosaic dtypes fall back to the jnp
+    reference.
+
+    Tuned geometry: ``decode.chunk_block_q`` / ``decode.chunk_block_k``
+    in the :mod:`apex_tpu.kernels.vmem` override registry (clamped to
+    aligned divisors of the chunk / cache lengths).
+    """
+    b, h, C, d = q.shape
+    L = k.shape[2]
+    if k.shape != (b, h, L, d) or v.shape != k.shape:
+        raise ValueError(f"prefill_attention: k/v {k.shape}/{v.shape} do "
+                         f"not match q {q.shape} + max_len")
+    if offsets.shape != (b,):
+        raise ValueError(f"prefill_attention: offsets {offsets.shape} "
+                         f"must be [{b}]")
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    from apex_tpu.kernels.flash_attention import _fit_block, _has_vma
+    bq, bk = _resolve_blocks(block_q, block_k)
+    bq = _fit_block(bq, C, 8)
+    bk = _fit_block(bk, L, 128)
+    if jax.default_backend() == "cpu":
+        interpret = True
+    pallas_ok = (C % bq == 0 and L % bk == 0 and d % 8 == 0
+                 and bq % 8 == 0 and bk % 128 == 0)
+    if not pallas_ok or (interpret and _has_vma(q)) \
+            or (not interpret and not mosaic_dtype_ok(q, k, v)):
+        return prefill_attention_reference(q, k, v, offsets, scale=scale)
+    q3 = q.reshape(b * h, C, d)
+    k3 = k.reshape(b * h, L, d)
+    v3 = v.reshape(b * h, L, d)
+    off3 = jnp.repeat(jnp.asarray(offsets, jnp.int32), h)
+    out = _prefill_pallas(q3, k3, v3, off3, scale, bq, bk, interpret)
+    return out.reshape(b, h, C, d).astype(q.dtype)

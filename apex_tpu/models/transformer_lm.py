@@ -24,6 +24,7 @@ from __future__ import annotations
 from typing import Any, Optional
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from apex_tpu.kernels.flash_attention import flash_attention
@@ -33,18 +34,24 @@ __all__ = ["TransformerLM", "TransformerBlock", "create_lm"]
 
 
 class SelfAttention(nn.Module):
-    """Causal MHA with three modes sharing one set of weights:
+    """Causal MHA with four modes sharing one set of weights:
 
     - **train/eval** (default): full-sequence flash attention.
     - **prefill** (``return_kv=True``): same forward, additionally
       returning this layer's ``(k, v)`` ``[B, h, S, d]`` for the serving
       engine to write into its KV cache.
-    - **decode** (``cache=(k_cache, v_cache)`` + ``positions``): ``S``
-      must be 1; the token's K/V is scattered into the cache at
-      ``positions[b]`` and attention runs against the cached prefix via
+    - **decode** (``cache=(k_cache, v_cache)`` + ``positions``, S == 1):
+      the token's K/V is scattered into the cache at ``positions[b]``
+      and attention runs against the cached prefix via
       :func:`apex_tpu.kernels.decode_attention.decode_attention`
       (length-masked, fp32 accumulation), returning
       ``(out, (k_cache', v_cache'))``.
+    - **chunked prefill** (``cache`` + ``positions``, S > 1): S
+      consecutive prompt tokens starting at cache position
+      ``positions[b]`` — their K/V is written at ``[positions[b],
+      positions[b] + S)`` and each attends the cached prefix up to and
+      including itself (write-then-attend, shifted-causal) via
+      :func:`apex_tpu.kernels.prefill_attention.prefill_attention`.
 
     ``inference_dtype`` is the decode path's storage/compute dtype: when
     set, Q/K/V leave the qkv GEMM in that dtype (normally the amp half —
@@ -76,22 +83,40 @@ class SelfAttention(nn.Module):
         qkv = qkv.reshape(B, S, 3, self.num_heads, d).transpose(2, 0, 3, 1, 4)
         q, k, v = qkv[0], qkv[1], qkv[2]             # [B, h, S, d]
         if cache is not None:
-            from apex_tpu.kernels.decode_attention import decode_attention
-            if S != 1:
-                raise ValueError(
-                    f"decode mode is single-token: got S={S} with a cache "
-                    "(prefill runs cache-less with return_kv=True)")
             k_cache, v_cache = cache                 # [B, h, L, d]
+            # clip is a traced-value safety net only: an out-of-range
+            # offset would RELOCATE the S-wide write over earlier cache
+            # rows, so callers must bound positions host-side (the
+            # serving engine validates offset + chunk_len <= max_len)
             pos = jnp.clip(jnp.asarray(positions, jnp.int32), 0,
-                           k_cache.shape[2] - 1)
-            bidx = jnp.arange(B)
-            k_cache = k_cache.at[bidx, :, pos].set(
-                jnp.asarray(k[:, :, 0], k_cache.dtype))
-            v_cache = v_cache.at[bidx, :, pos].set(
-                jnp.asarray(v[:, :, 0], v_cache.dtype))
-            # write-then-attend: the token sees its own (cached) K/V
-            ctx = decode_attention(q[:, :, 0], k_cache, v_cache, pos + 1)
-            out = ctx.reshape(B, 1, self.hidden)
+                           k_cache.shape[2] - S)
+            if S == 1:
+                from apex_tpu.kernels.decode_attention import \
+                    decode_attention
+                bidx = jnp.arange(B)
+                k_cache = k_cache.at[bidx, :, pos].set(
+                    jnp.asarray(k[:, :, 0], k_cache.dtype))
+                v_cache = v_cache.at[bidx, :, pos].set(
+                    jnp.asarray(v[:, :, 0], v_cache.dtype))
+                # write-then-attend: the token sees its own (cached) K/V
+                ctx = decode_attention(q[:, :, 0], k_cache, v_cache,
+                                       pos + 1)
+            else:
+                from apex_tpu.kernels.prefill_attention import \
+                    prefill_attention
+
+                # chunked prefill: S tokens land at [pos, pos + S) of
+                # each row's cache (vmapped so per-row offsets differ)
+                def _write(row, new, p):
+                    return jax.lax.dynamic_update_slice(row, new,
+                                                        (0, p, 0))
+                k_cache = jax.vmap(_write)(
+                    k_cache, jnp.asarray(k, k_cache.dtype), pos)
+                v_cache = jax.vmap(_write)(
+                    v_cache, jnp.asarray(v, v_cache.dtype), pos)
+                ctx = prefill_attention(q, k_cache, v_cache, pos)
+            out = jnp.moveaxis(ctx.reshape(B, self.num_heads, S, d),
+                               1, 2).reshape(B, S, self.hidden)
         else:
             out = flash_attention(q, k, v, causal=True)  # [B, h, S, d]
             out = jnp.moveaxis(out, 1, 2).reshape(B, S, self.hidden)
@@ -174,7 +199,7 @@ class TransformerLM(nn.Module):
     loss math never runs in half, matching amp's FP32_FUNCS policy for
     softmax/loss: apex/amp/lists/functional_overrides.py).
 
-    Inference modes (the ``apex_tpu.serving`` engine's two compiled
+    Inference modes (the ``apex_tpu.serving`` engine's compiled
     programs — see :class:`SelfAttention`):
 
     - **prefill**: ``__call__(tokens[B, S], train=False, return_kv=True)
@@ -186,6 +211,11 @@ class TransformerLM(nn.Module):
       single new token per batch row is embedded at ``positions[b]``,
       its K/V scattered into the cache, and attention runs length-masked
       against the cached prefix.
+    - **chunked prefill**: same signature with ``tokens[B, C]`` (C > 1)
+      — C consecutive prompt tokens per row, embedded at ``positions[b]
+      + s``, K/V written to cache ``[positions[b], positions[b] + C)``,
+      shifted-causal attention over the cached prefix (the engine's
+      chunk-prefill program; one chunk per decode heartbeat).
 
     ``inference_dtype`` (normally the amp half dtype) pins the
     eval-mode GEMM/cache dtype independently of the training policy, so
@@ -225,11 +255,11 @@ class TransformerLM(nn.Module):
         pos = self.param("wpe", nn.initializers.normal(stddev=0.02),
                          (self.max_seq_len, self.hidden), self.param_dtype)
         if cache is not None:
-            # decode: the token lives at positions[b], not at 0
-            ppos = jnp.clip(jnp.asarray(positions, jnp.int32), 0,
-                            self.max_seq_len - 1)
-            x = jnp.asarray(embed(tokens) + pos[ppos][:, None, :],
-                            dense_dtype)
+            # decode/chunk: token s of row b lives at positions[b] + s
+            ppos = jnp.clip(jnp.asarray(positions, jnp.int32)[:, None]
+                            + jnp.arange(S, dtype=jnp.int32)[None, :],
+                            0, self.max_seq_len - 1)          # [B, S]
+            x = jnp.asarray(embed(tokens) + pos[ppos], dense_dtype)
         else:
             x = jnp.asarray(embed(tokens) + pos[:S][None], dense_dtype)
         if self.dropout > 0.0:

@@ -8,17 +8,23 @@ generation requests from a fixed set of compiled programs:
 - :class:`KVCache` (:mod:`.kv_cache`) — preallocated
   ``[layers, slots, heads, max_len, head_dim]`` slot cache with
   per-slot lengths, stored in the amp half dtype.
-- :class:`Engine` (:mod:`.engine`) — exactly two XLA executables
-  (jitted prefill + jitted decode step, fixed shapes, traced
-  slot/length/temperature scalars), greedy / temperature / top-k
-  sampling compiled in; decode attention through
-  :func:`apex_tpu.kernels.decode_attention.decode_attention`
+- :class:`Engine` (:mod:`.engine`) — exactly three XLA executables
+  (jitted chunk-prefill + jitted decode step + the legacy monolithic
+  prefill baseline, fixed shapes, traced slot/offset/length/temperature
+  scalars), greedy / temperature / top-k sampling compiled in; decode
+  attention through
+  :func:`apex_tpu.kernels.decode_attention.decode_attention` and chunk
+  attention through
+  :func:`apex_tpu.kernels.prefill_attention.prefill_attention`
   (length-masked, ``decode.*`` tuned-block keys).
-- :class:`Scheduler` (:mod:`.scheduler`) — continuous batching:
-  admit-into-free-slots between decode steps, EOS/max-token/timeout
-  eviction, bounded-queue :class:`QueueFull` backpressure, and
-  slot-occupancy / padding-waste / TTFT / tokens-per-sec telemetry
-  through the shared :class:`~apex_tpu.telemetry.MetricsRegistry`.
+- :class:`Scheduler` (:mod:`.scheduler`) — continuous batching with
+  chunked prefill fused into the decode heartbeat: admit-into-free-slots,
+  at most ``chunk_budget`` compiled chunk-prefill steps per tick (so
+  in-flight decodes never wait more than one chunk for a new admit),
+  EOS/max-token/timeout eviction, bounded-queue :class:`QueueFull`
+  backpressure, and slot-occupancy / padding-waste / decomposed-TTFT /
+  chunks-per-prompt / tokens-per-sec telemetry through the shared
+  :class:`~apex_tpu.telemetry.MetricsRegistry`.
 
 Quick start::
 

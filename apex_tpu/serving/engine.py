@@ -1,19 +1,30 @@
-"""Compiled inference engine: one prefill program, one decode program.
+"""Compiled inference engine: chunk-prefill, decode, monolithic prefill.
 
-The engine owns the two — and exactly two — XLA executables a serving
-process needs, both traced once at fixed shapes:
+The engine owns the three — and exactly three — XLA executables a
+serving process needs, each traced once at fixed shapes:
 
-- **prefill**: ``[1, prefill_len]`` tokens (prompt right-padded) → the
-  model's full causal forward (``return_kv=True``), prompt K/V written
-  into one cache slot, first token sampled from the logits at the true
-  prompt's last position. Slot index, prompt length, temperature and the
-  PRNG key are *traced* scalars, so requests of any length or slot land
-  in the same executable — no per-request recompiles.
+- **chunk prefill** (the scheduler's ingestion path): ``[1, chunk_len]``
+  tokens (one chunk of a prompt, right-padded on the final partial
+  chunk) → the model's chunked-prefill forward against ONE cache slot
+  (:meth:`KVCache.slot_view`), K/V written at ``[offset, offset +
+  chunk_len)``, shifted-causal attention over the slot's existing
+  prefix, a token sampled from the last *valid* row (the request's
+  first token when the chunk is final; discarded otherwise). Slot,
+  offset, valid-count, temperature and the PRNG key are *traced*
+  scalars — every chunk of every prompt lands in this one executable,
+  and the scheduler runs at most one between decode steps, so in-flight
+  decodes never wait more than one chunk for a new admit.
 - **decode step**: ``[slots, 1]`` tokens (every slot's latest token) →
   single-token cached forward, one new token per slot. Inactive slots
   compute too (their output is discarded and their length frozen) —
   that padding waste is the price of a fixed-shape program, and the
   scheduler reports it.
+- **monolithic prefill** (legacy/baseline): ``[1, prefill_len]`` tokens
+  → full causal forward (``return_kv=True``), whole prompt in one call.
+  Kept as the chunked path's bitwise-parity oracle and the
+  head-of-line-blocking baseline (``Scheduler(chunked=False)``,
+  ``bench_serving.py --mixed-prompts``); it stalls every active decode
+  slot for the full prompt, which is exactly what chunking removes.
 
 Sampling runs inside the compiled programs: greedy when a slot's
 temperature is 0, else temperature softmax over logits optionally
@@ -26,10 +37,11 @@ machinery (default: pure-half O3 — bf16 storage, no fp32 masters, the
 cache in the same dtype); pass ``policy=amp.resolve_policy("O0")`` for
 an exact-fp32 engine (the decode-parity tests' configuration).
 
-Trace accounting: the python bodies of both programs run only when jax
-traces them, so ``prefill_traces``/``decode_traces`` count compiles —
-the serving test tier pins both to exactly 1 across a multi-request,
-variable-length run.
+Trace accounting: the python bodies of the programs run only when jax
+traces them, so ``chunk_traces``/``decode_traces``/``prefill_traces``
+count compiles — the serving test tier pins the engine to exactly three
+compiled programs across a multi-request, variable-length run that
+exercises all three paths.
 """
 
 from __future__ import annotations
@@ -91,8 +103,14 @@ class Engine:
         Cache positions per slot (prompt + generation budget); must not
         exceed the model's ``max_seq_len``.
     prefill_len:
-        Fixed padded prompt capacity of the prefill program
+        Fixed padded prompt capacity of the prefill programs
         (``<= max_len``). Longer prompts are rejected at submit time.
+    chunk_len:
+        Tokens per chunk-prefill step (default ``min(prefill_len,
+        256)``). Smaller chunks bound the stall a prefill imposes on
+        in-flight decodes more tightly but pay more per-chunk overhead;
+        lane-aligned values (multiples of 128) keep the chunk kernel on
+        its Pallas path.
     policy:
         An :class:`apex_tpu.amp.Policy` governing weight/cache storage;
         default ``resolve_policy("O3", verbose=False)`` (pure bf16).
@@ -109,7 +127,8 @@ class Engine:
     """
 
     def __init__(self, model, params, *, slots: int, max_len: int,
-                 prefill_len: Optional[int] = None, policy=None,
+                 prefill_len: Optional[int] = None,
+                 chunk_len: Optional[int] = None, policy=None,
                  top_k: int = 0, seed: int = 0, registry=None):
         from apex_tpu.amp.policy import resolve_policy
 
@@ -128,9 +147,34 @@ class Engine:
                              f"(0, max_len={max_len}]")
         if slots < 1:
             raise ValueError("slots must be >= 1")
+        if chunk_len is None:
+            chunk_len = min(int(prefill_len), 256)
+            if -(-int(prefill_len) // chunk_len) * chunk_len > max_len:
+                # the defaulted geometry must always be servable: when
+                # the rounded-up window would spill past the cache
+                # (prefill_len just over a chunk multiple with little
+                # decode headroom), degrade to single-chunk ingestion
+                chunk_len = int(prefill_len)
+        if not 0 < chunk_len <= prefill_len:
+            raise ValueError(f"chunk_len {chunk_len} must be in "
+                             f"(0, prefill_len={prefill_len}]")
+        # every chunk writes a full chunk_len-wide K/V slice (the final
+        # partial chunk is padded), so the LAST chunk's window must fit
+        # the cache: otherwise the model's position clip would silently
+        # relocate the write over earlier prompt K/V (cache corruption,
+        # not an error). Reject the geometry loudly at construction.
+        n_chunks = -(-int(prefill_len) // int(chunk_len))
+        if n_chunks * int(chunk_len) > max_len:
+            raise ValueError(
+                f"chunk_len {chunk_len}: the final chunk window "
+                f"[{(n_chunks - 1) * chunk_len}, {n_chunks * chunk_len})"
+                f" of a prefill_len={prefill_len} prompt exceeds "
+                f"max_len={max_len}; pick a chunk_len with "
+                f"ceil(prefill_len/chunk_len)*chunk_len <= max_len")
         self.slots = int(slots)
         self.max_len = int(max_len)
         self.prefill_len = int(prefill_len)
+        self.chunk_len = int(chunk_len)
         self.top_k = int(top_k)
         # pin the eval dtype on the module itself so decode GEMMs and
         # the cache agree (pure-half: no fp32 masters anywhere)
@@ -148,6 +192,7 @@ class Engine:
         self._key = jax.random.PRNGKey(seed)
         self.prefill_traces = 0
         self.decode_traces = 0
+        self.chunk_traces = 0
         self.tokens_generated = 0
         # prefill flash-attention geometry: decode.* tuned keys beat the
         # training sweep's flash.* defaults when present
@@ -158,11 +203,20 @@ class Engine:
         self._jit_prefill = jax.jit(self._prefill_impl,
                                     donate_argnums=(1,))
         self._jit_decode = jax.jit(self._decode_impl, donate_argnums=(1,))
+        self._jit_chunk = jax.jit(self._chunk_impl, donate_argnums=(1,))
         _logger.info(
             "serving engine: %d slots x %d positions, prefill_len=%d, "
-            "cache %s (%.1f MiB), top_k=%d", self.slots, self.max_len,
-            self.prefill_len, np.dtype(half).name,
-            self.cache.nbytes() / 2**20, self.top_k)
+            "chunk_len=%d, cache %s (%.1f MiB), top_k=%d", self.slots,
+            self.max_len, self.prefill_len, self.chunk_len,
+            np.dtype(half).name, self.cache.nbytes() / 2**20, self.top_k)
+
+    @property
+    def compiled_programs(self) -> int:
+        """Distinct XLA executables traced so far (the compile-count
+        discipline the serving tests pin to exactly three across a run
+        that exercises chunk prefill, decode, and the monolithic
+        baseline)."""
+        return self.chunk_traces + self.decode_traces + self.prefill_traces
 
     # ------------------------------------------------------ compiled bodies
     def _prefill_impl(self, params, cache, tokens, length, slot,
@@ -172,6 +226,24 @@ class Engine:
             {"params": params}, tokens, train=False, return_kv=True)
         cache = cache.insert(slot, k_new, v_new, length)
         last = jax.lax.dynamic_index_in_dim(logits[0], length - 1,
+                                            keepdims=False)        # [V]
+        token = sample_tokens(last[None], temperature[None], key,
+                              self.top_k)[0]
+        return cache, token
+
+    def _chunk_impl(self, params, cache, tokens, slot, offset, n_valid,
+                    temperature, key):
+        self.chunk_traces += 1      # python body runs at trace time only
+        k_slot, v_slot = cache.slot_view(slot)
+        offset = jnp.asarray(offset, jnp.int32)
+        logits, (k2, v2) = self._model.apply(
+            {"params": params}, tokens, train=False,
+            cache=(k_slot, v_slot), positions=offset[None])
+        cache = cache.write_slot(slot, k2, v2, offset + n_valid)
+        # sample at the last VALID row: the request's first token when
+        # this is the prompt's final chunk, discarded by the host
+        # otherwise (one program either way — finality is not traced)
+        last = jax.lax.dynamic_index_in_dim(logits[0], n_valid - 1,
                                             keepdims=False)        # [V]
         token = sample_tokens(last[None], temperature[None], key,
                               self.top_k)[0]
@@ -195,9 +267,14 @@ class Engine:
 
     def prefill(self, slot: int, prompt: Sequence[int],
                 temperature: float = 0.0) -> int:
-        """Prefill ``prompt`` into ``slot`` and return the first sampled
-        token (host int). Blocks until the token is on the host — the
-        time-to-first-token boundary."""
+        """Monolithic prefill: the whole ``prompt`` into ``slot`` in one
+        compiled call; returns the first sampled token (host int) and
+        blocks until it is on the host. This is the legacy/baseline path
+        — it stalls the caller (and any decode heartbeat) for the full
+        prompt; production serving ingests through :meth:`prefill_chunk`
+        one chunk per scheduler tick instead. Kept compiled because it
+        is the chunked path's bitwise-parity oracle and the
+        head-of-line-blocking baseline (``Scheduler(chunked=False)``)."""
         n = len(prompt)
         if not 0 < n <= self.prefill_len:
             raise ValueError(f"prompt length {n} not in (0, "
@@ -219,6 +296,81 @@ class Engine:
             self._registry.counter_inc("serving.tokens_generated")
         self.tokens_generated += 1
         return token
+
+    def prefill_chunk(self, slot: int, chunk: Sequence[int], offset: int,
+                      temperature: float = 0.0, *,
+                      final: bool = True) -> int:
+        """Ingest one chunk of a prompt into ``slot`` at cache position
+        ``offset`` and return the token sampled at the chunk's last
+        valid row (host int). The token is the request's first output
+        token when ``final`` is True (the time-to-first-token boundary);
+        for mid-prompt chunks it is a throwaway — the program samples
+        unconditionally so finality never retraces.
+
+        ``final`` is host-side accounting only (tokens_generated and the
+        telemetry counters tick once per request, on the real token).
+        """
+        n = len(chunk)
+        if not 0 < n <= self.chunk_len:
+            raise ValueError(f"chunk length {n} not in (0, "
+                             f"chunk_len={self.chunk_len}]")
+        if not 0 <= slot < self.slots:
+            raise ValueError(f"slot {slot} not in [0, {self.slots})")
+        if not 0 <= offset <= self.prefill_len - n:
+            raise ValueError(
+                f"chunk [{offset}, {offset + n}) exceeds prefill_len="
+                f"{self.prefill_len}")
+        if offset + self.chunk_len > self.max_len:
+            # the program writes the PADDED chunk window; past max_len
+            # the model's position clip would relocate it over earlier
+            # K/V — reject instead of corrupting (scheduler offsets are
+            # chunk multiples, which the constructor already bounds;
+            # this guards direct callers at arbitrary offsets)
+            raise ValueError(
+                f"padded chunk window [{offset}, "
+                f"{offset + self.chunk_len}) exceeds max_len="
+                f"{self.max_len}")
+        tokens = np.zeros((1, self.chunk_len), np.int32)
+        tokens[0, :n] = np.asarray(chunk, np.int32)
+        t0 = time.perf_counter()
+        self.cache, token = self._jit_chunk(
+            self.params, self.cache, jnp.asarray(tokens),
+            np.int32(slot), np.int32(offset), np.int32(n),
+            np.float32(temperature), self._next_key())
+        token = int(token)
+        if self._registry is not None:
+            self._registry.observe("serving.prefill_chunk_s",
+                                   time.perf_counter() - t0)
+            self._registry.counter_inc("serving.prefill.chunks")
+            if final:
+                self._registry.counter_inc("serving.tokens_generated")
+        if final:
+            self.tokens_generated += 1
+        return token
+
+    def prefill_chunked(self, slot: int, prompt: Sequence[int],
+                        temperature: float = 0.0) -> int:
+        """Drain a whole prompt through the chunk-prefill program
+        back-to-back and return the first sampled token — the chunked
+        counterpart of :meth:`prefill` for callers without a scheduler
+        (warmup, parity tests, ``--generate``). Production serving
+        interleaves the same chunks with decode steps instead
+        (:class:`~apex_tpu.serving.Scheduler`)."""
+        n = len(prompt)
+        if not 0 < n <= self.prefill_len:
+            raise ValueError(f"prompt length {n} not in (0, "
+                             f"prefill_len={self.prefill_len}]")
+        token = None
+        for lo in range(0, n, self.chunk_len):
+            hi = min(lo + self.chunk_len, n)
+            token = self.prefill_chunk(slot, list(prompt[lo:hi]), lo,
+                                       temperature, final=hi == n)
+        return token
+
+    def chunks_for(self, prompt_len: int) -> int:
+        """Chunk-prefill steps a prompt of ``prompt_len`` costs
+        (``ceil(prompt_len / chunk_len)``)."""
+        return -(-int(prompt_len) // self.chunk_len)
 
     def _with_prefill_blocks(self, fn):
         """Run ``fn`` with the ``decode.prefill_block_q``/``_k`` tuned

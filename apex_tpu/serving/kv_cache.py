@@ -16,6 +16,10 @@ Slot semantics (the continuous-batching contract):
   slot and sets its length; positions past the true prompt length hold
   pad garbage that is *never attended* (length masking) and is
   overwritten position-by-position as decode advances.
+- **chunked prefill** ingests a prompt one chunk per decode heartbeat:
+  :meth:`slot_view` hands the model one slot as a batch-of-one cache,
+  the chunk's K/V lands at ``[offset, offset + C)``, and
+  :meth:`write_slot` commits the view back with the grown length.
 - **decode** writes each slot's new token at ``lengths[s]`` and then
   attends ``[0, lengths[s]]`` — write-then-attend, so garbage can never
   enter a softmax.
@@ -108,6 +112,37 @@ class KVCache:
             self.k, jnp.asarray(k_new, self.k.dtype), start)
         v = jax.lax.dynamic_update_slice(
             self.v, jnp.asarray(v_new, self.v.dtype), start)
+        lengths = self.lengths.at[slot].set(jnp.asarray(length, jnp.int32))
+        return self.replace(k=k, v=v, lengths=lengths)
+
+    def slot_view(self, slot):
+        """The one-slot ``(k, v)`` pair (``[layers, 1, heads, max_len,
+        head_dim]``) the model's chunk-prefill path consumes — slot ``s``
+        as a batch-of-one cache. ``slot`` may be a traced int32 scalar
+        (the jitted chunk-prefill program is slot-agnostic)."""
+        slot = jnp.asarray(slot, jnp.int32)
+        return (jax.lax.dynamic_slice_in_dim(self.k, slot, 1, axis=1),
+                jax.lax.dynamic_slice_in_dim(self.v, slot, 1, axis=1))
+
+    def write_slot(self, slot, k_slot, v_slot, length) -> "KVCache":
+        """Write an updated :meth:`slot_view` back (``[layers, 1, heads,
+        max_len, head_dim]``) and set the slot's length — the second half
+        of a chunk-prefill step (``length`` = positions ingested so far;
+        mid-prompt chunks leave it short of the true prompt length, so
+        decode-side garbage writes past it are overwritten by the next
+        chunk before anything can attend them)."""
+        want = (self.layers, 1, self.heads, self.max_len, self.head_dim)
+        if k_slot.shape != want or v_slot.shape != want:
+            raise ValueError(f"write_slot expects full slot views "
+                             f"{want}, got k {k_slot.shape} / "
+                             f"v {v_slot.shape}")
+        slot = jnp.asarray(slot, jnp.int32)
+        start = (jnp.int32(0), slot, jnp.int32(0), jnp.int32(0),
+                 jnp.int32(0))
+        k = jax.lax.dynamic_update_slice(
+            self.k, jnp.asarray(k_slot, self.k.dtype), start)
+        v = jax.lax.dynamic_update_slice(
+            self.v, jnp.asarray(v_slot, self.v.dtype), start)
         lengths = self.lengths.at[slot].set(jnp.asarray(length, jnp.int32))
         return self.replace(k=k, v=v, lengths=lengths)
 

@@ -9,12 +9,28 @@ beats:
 1. **expire** — queued or running requests past their deadline finish
    with status ``"timeout"`` (their slot frees immediately);
 2. **admit** — while a slot is free and the queue is non-empty, pop the
-   oldest request, prefill it into the slot (its first token = the
-   time-to-first-token mark), or finish it right there if the first
-   token is already EOS;
-3. **decode** — one fixed-shape engine step over all slots; each active
-   slot appends its token and finishes on EOS / ``max_new_tokens`` /
-   cache ``max_len``.
+   oldest request into the slot as *prefilling* (its queue wait ends
+   here — the first half of the TTFT decomposition);
+3. **chunk prefill** — at most ``chunk_budget`` (default 1) compiled
+   chunk-prefill steps across the prefilling slots, round-robin. A
+   prompt of P tokens ingests over ``ceil(P / chunk_len)`` heartbeats;
+   the final chunk samples the request's first token (the TTFT mark)
+   and flips the slot to decoding. The budget bounds the stall imposed
+   on IN-FLIGHT decodes — while nothing is decoding there is nothing
+   to stall, so a cold queue bursts chunk-after-chunk (stopping the
+   moment a slot flips to decoding) instead of idling between beats;
+4. **decode** — one fixed-shape engine step over all slots; each
+   decoding slot appends its token and finishes on EOS /
+   ``max_new_tokens`` / cache ``max_len``.
+
+Step 3 is the head-of-line fix (Orca-style continuous batching +
+Sarathi-style chunked prefill): the monolithic alternative — pause the
+heartbeat and run a whole ``[1, prefill_len]`` prefill at admit time —
+stalls every in-flight decode for the full prompt length. Chunking
+bounds that stall at one chunk, and short prompts stop paying full
+``prefill_len`` padding compute. The monolithic path is kept behind
+``chunked=False`` as the measurable baseline
+(``bench_serving.py --mixed-prompts`` prints the two side by side).
 
 Backpressure instead of OOM: the queue is bounded (``max_queue``);
 :meth:`submit` raises :class:`QueueFull` when it is at capacity, so a
@@ -23,11 +39,14 @@ never an unbounded host-side pileup. (:meth:`run` absorbs the same
 signal by stepping the engine until space frees.)
 
 Telemetry (through the shared :class:`~apex_tpu.telemetry
-.MetricsRegistry`): ``serving.ttft_s`` and the engine's
+.MetricsRegistry`): ``serving.ttft_s`` decomposed into
+``serving.queue_wait_s`` (submit → admission) + per-chunk
+``serving.prefill_chunk_s`` (the engine observes the latter),
 ``serving.decode.step_s`` histograms (p50/p95/p99 via the streaming
 reservoir), ``serving.slot_occupancy`` / ``serving.padding_waste`` per
-step, request outcome counters, and a final ``serving.tokens_per_s``
-gauge from :meth:`run`.
+step, request outcome counters, one ``serving.request``-tagged
+completion record per request (with ``chunks_per_prompt``), and a final
+``serving.tokens_per_s`` gauge from :meth:`run`.
 """
 
 from __future__ import annotations
@@ -62,9 +81,12 @@ class Request:
     (0 = greedy), optional ``timeout_s`` (else the scheduler default).
 
     Outputs (filled by the scheduler): ``output_tokens``, ``status``
-    (``"done"`` / ``"timeout"``), ``finish_reason`` (``"eos"`` /
-    ``"max_new_tokens"`` / ``"max_len"`` / ``"timeout"``), ``ttft_s``,
-    ``latency_s``.
+    (``"done"`` / ``"timeout"``; transiently ``"queued"`` /
+    ``"prefilling"`` / ``"running"``), ``finish_reason`` (``"eos"`` /
+    ``"max_new_tokens"`` / ``"max_len"`` / ``"timeout"``), ``ttft_s``
+    and its decomposition ``queue_wait_s`` (submit → admission) +
+    ``prefill_s`` (summed chunk/prefill compute), ``chunks`` (prefill
+    steps the prompt took; 1 on the monolithic path), ``latency_s``.
     """
 
     prompt: Sequence[int]
@@ -78,9 +100,13 @@ class Request:
     status: str = "new"
     finish_reason: Optional[str] = None
     ttft_s: Optional[float] = None
+    queue_wait_s: Optional[float] = None
+    prefill_s: float = 0.0
+    chunks: int = 0
     latency_s: Optional[float] = None
     _t_submit: Optional[float] = dataclasses.field(default=None,
                                                    repr=False)
+    _prefill_pos: int = dataclasses.field(default=0, repr=False)
 
 
 class Scheduler:
@@ -89,19 +115,25 @@ class Scheduler:
 
     def __init__(self, engine, *, max_queue: int = 64,
                  default_timeout_s: Optional[float] = None,
-                 eos_id: Optional[int] = None, registry=None):
+                 eos_id: Optional[int] = None, registry=None,
+                 chunked: bool = True, chunk_budget: int = 1):
         if max_queue < 1:
             raise ValueError("max_queue must be >= 1")
+        if chunk_budget < 1:
+            raise ValueError("chunk_budget must be >= 1")
         self.engine = engine
         self.max_queue = int(max_queue)
         self.default_timeout_s = default_timeout_s
         self.eos_id = eos_id
+        self.chunked = bool(chunked)
+        self.chunk_budget = int(chunk_budget)
         self.registry = registry if registry is not None \
             else getattr(engine, "_registry", None)
         self._queue: collections.deque = collections.deque()
         self._running: List[Optional[Request]] = [None] * engine.slots
         self._last_tokens = np.zeros(engine.slots, np.int32)
         self._temps = np.zeros(engine.slots, np.float32)
+        self._pf_rr = 0           # round-robin start for chunk budgeting
         self.completed: List[Request] = []
 
     # ------------------------------------------------------------ ingestion
@@ -144,6 +176,22 @@ class Scheduler:
             key = ("serving.requests.timeout" if reason == "timeout"
                    else "serving.requests.completed")
             self.registry.counter_inc(key)
+            # one completion record per request: the TTFT decomposition
+            # and chunk count ride the ring/sinks alongside the
+            # aggregate histograms (observe=False: uid is not a series
+            # and the latencies already live in dedicated serving.*
+            # histograms — don't grow junk reservoirs per request)
+            self.registry.record_step({
+                "uid": request.uid,
+                "finish_reason": reason,
+                "prompt_tokens": len(request.prompt),
+                "output_tokens": len(request.output_tokens),
+                "chunks_per_prompt": request.chunks,
+                "queue_wait_s": request.queue_wait_s,
+                "prefill_s": request.prefill_s,
+                "ttft_s": request.ttft_s,
+                "latency_s": request.latency_s,
+            }, tag="serving.request", observe=False)
 
     def _deadline(self, request: Request) -> Optional[float]:
         t = request.timeout_s if request.timeout_s is not None \
@@ -166,6 +214,27 @@ class Scheduler:
 
     # ------------------------------------------------------------ admission
     def _admit(self) -> None:
+        if not self.chunked:
+            return self._admit_monolithic()
+        for slot in range(self.engine.slots):
+            if self._running[slot] is not None or not self._queue:
+                continue
+            r = self._queue.popleft()
+            # admission ends the queue wait; prefill compute is paid one
+            # chunk per heartbeat from here (_prefill_tick)
+            r.queue_wait_s = time.perf_counter() - r._t_submit
+            if self.registry is not None:
+                self.registry.observe("serving.queue_wait_s",
+                                      r.queue_wait_s)
+            r.status = "prefilling"
+            r._prefill_pos = 0
+            self._running[slot] = r
+            self._temps[slot] = r.temperature
+
+    def _admit_monolithic(self) -> None:
+        """Legacy admit (``chunked=False``): whole-prompt prefill at
+        admission — the head-of-line-blocking baseline the chunked path
+        is benchmarked against."""
         for slot in range(self.engine.slots):
             if self._running[slot] is not None:
                 continue
@@ -173,8 +242,15 @@ class Scheduler:
             # prefill (instant EOS / budget 1) leaves it free for the next
             while self._queue and self._running[slot] is None:
                 r = self._queue.popleft()
+                r.queue_wait_s = time.perf_counter() - r._t_submit
+                if self.registry is not None:
+                    self.registry.observe("serving.queue_wait_s",
+                                          r.queue_wait_s)
+                t0 = time.perf_counter()
                 token = self.engine.prefill(slot, list(r.prompt),
                                             temperature=r.temperature)
+                r.prefill_s = time.perf_counter() - t0
+                r.chunks = 1
                 r.ttft_s = time.perf_counter() - r._t_submit
                 if self.registry is not None:
                     self.registry.observe("serving.ttft_s", r.ttft_s)
@@ -194,25 +270,86 @@ class Scheduler:
                     self._last_tokens[slot] = token
                     self._temps[slot] = r.temperature
 
+    def _prefill_tick(self) -> int:
+        """Run at most ``chunk_budget`` chunk-prefill steps across the
+        prefilling slots, round-robin from a rotating start so no slot
+        starves. Returns the number of chunks run."""
+        ran = 0
+        slots = self.engine.slots
+        start = self._pf_rr
+        for i in range(slots):
+            if ran >= self.chunk_budget:
+                break
+            slot = (start + i) % slots
+            r = self._running[slot]
+            if r is None or r.status != "prefilling":
+                continue
+            lo = r._prefill_pos
+            hi = min(lo + self.engine.chunk_len, len(r.prompt))
+            final = hi == len(r.prompt)
+            t0 = time.perf_counter()
+            token = self.engine.prefill_chunk(
+                slot, list(r.prompt[lo:hi]), lo, r.temperature,
+                final=final)
+            r.prefill_s += time.perf_counter() - t0
+            r._prefill_pos = hi
+            r.chunks += 1
+            ran += 1
+            # next tick resumes AFTER the last slot served, so slots
+            # separated by gaps still ingest at the same rate (a +1
+            # bump would serve the slot after a gap twice as often)
+            self._pf_rr = (slot + 1) % slots
+            if not final:
+                continue
+            r.ttft_s = time.perf_counter() - r._t_submit
+            if self.registry is not None:
+                self.registry.observe("serving.ttft_s", r.ttft_s)
+            r.output_tokens.append(token)
+            if self.eos_id is not None and token == self.eos_id:
+                self._finish(r, "eos", slot)
+            elif r.max_new_tokens <= 1:
+                self._finish(r, "max_new_tokens", slot)
+            elif len(r.prompt) >= self.engine.max_len:
+                # cache already full: a decode step would overwrite the
+                # last prompt position's K/V and emit a corrupted token
+                self._finish(r, "max_len", slot)
+            else:
+                r.status = "running"
+                self._last_tokens[slot] = token
+        return ran
+
     # ------------------------------------------------------------- stepping
     def step(self) -> bool:
-        """One scheduler beat: expire → admit → decode. Returns True if
-        a decode step ran (i.e. any slot was active)."""
+        """One scheduler beat: expire → admit → chunk prefill → decode.
+        Returns True if any forward progress was made (a decode step ran
+        or a prefill chunk was ingested)."""
         self._expire(time.perf_counter())
         self._admit()
-        active = np.array([r is not None for r in self._running])
+        chunks = self._prefill_tick() if self.chunked else 0
+        # the chunk budget bounds the stall imposed ON in-flight
+        # decodes; while nothing is decoding there is nothing to stall,
+        # so keep ingesting back-to-back (cold-start/queue-drain bursts
+        # reach full slot occupancy without idle heartbeats)
+        while chunks and not any(r is not None and r.status == "running"
+                                 for r in self._running):
+            more = self._prefill_tick()
+            if not more:
+                break
+            chunks += more
+        active = np.array([r is not None and r.status == "running"
+                           for r in self._running])
         if self.registry is not None:
             occ = float(active.mean())
             self.registry.gauge_set("serving.slot_occupancy", occ)
             self.registry.observe("serving.slot_occupancy", occ)
             self.registry.observe("serving.padding_waste", 1.0 - occ)
         if not active.any():
-            return False
+            return chunks > 0
         tokens = self.engine.decode_step(self._last_tokens, active,
                                          self._temps)
         lengths = self.engine.lengths()
         for slot, r in enumerate(self._running):
-            if r is None:
+            if r is None or r.status != "running":
                 continue
             token = int(tokens[slot])
             r.output_tokens.append(token)

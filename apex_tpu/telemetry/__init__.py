@@ -160,7 +160,28 @@ def _is_transient_error(err: str) -> bool:
     return any(m in low for m in _TRANSIENT_MARKERS)
 
 
-def guard_bench_main(main, metric: str, retries: int = 1):
+# Backoff before each transient retry: _RETRY_BACKOFF_S * 2**n, capped.
+# Module-level so tests (and desperate operators) can zero it.
+_RETRY_BACKOFF_S = 0.5
+_RETRY_BACKOFF_CAP_S = 8.0
+
+
+def _env_retries(default: int = 1) -> int:
+    """``APEX_TPU_BENCH_RETRIES`` (>= 0), or ``default``. A malformed
+    value must degrade to the default, never crash the bench before its
+    guard is even armed."""
+    raw = os.environ.get("APEX_TPU_BENCH_RETRIES")
+    if raw is None or not raw.strip():
+        return default
+    try:
+        return max(0, int(raw))
+    except ValueError:
+        _logger.warning("APEX_TPU_BENCH_RETRIES=%r is not an integer; "
+                        "using %d", raw, default)
+        return default
+
+
+def guard_bench_main(main, metric: str, retries: Optional[int] = None):
     """Run a bench driver's ``main`` so that EVERY outcome ends in a final
     parseable JSON line on stdout.
 
@@ -190,8 +211,19 @@ def guard_bench_main(main, metric: str, retries: int = 1):
     true, ...}`` is written to stdout so row-aggregating harnesses can
     drop the partial first attempt; final-line parsers are unaffected
     (the marker is never last — a real row or the failure line follows).
+
+    ``retries`` defaults from ``APEX_TPU_BENCH_RETRIES`` (else 1), so a
+    flaky round can be re-driven with more attempts without touching
+    every bench driver (BENCH_r05 burned its single retry on
+    back-to-back ``remote_compile`` resets). Retries sleep a short
+    exponential backoff first (0.5 s, 1 s, 2 s, ... capped at 8 s) —
+    back-to-back retries land inside the same infrastructure hiccup;
+    a beat of patience is what actually clears tunnel resets.
     """
     import traceback
+
+    if retries is None:
+        retries = _env_retries()
 
     def _fail(err: str):
         # drain in-flight debug callbacks BEFORE writing the line that
@@ -229,9 +261,15 @@ def guard_bench_main(main, metric: str, retries: int = 1):
             err = f"{type(e).__name__}: {e}"
         if attempts_left > 0 and _is_transient_error(err):
             attempts_left -= 1
+            n_retried = int(retries) - attempts_left - 1
+            delay = min(_RETRY_BACKOFF_CAP_S,
+                        _RETRY_BACKOFF_S * (2 ** n_retried))
             _logger.warning("bench %s hit a transient error (%s); "
-                            "retrying — %d retry(ies) remain after this",
-                            metric, err, attempts_left)
+                            "retrying in %.1fs — %d retry(ies) remain "
+                            "after this", metric, err, delay,
+                            attempts_left)
+            if delay > 0:
+                time.sleep(delay)
             # multi-row drivers re-emit their rows on the retry: mark the
             # boundary so row aggregators can discard the partial attempt
             sys.stdout.write(json.dumps({

@@ -173,26 +173,33 @@ class MetricsRegistry:
 
     # ---------------------------------------------------------- step records
     def record_step(self, metrics: Dict[str, Any],
-                    tag: str = "train") -> StepRecord:
+                    tag: str = "train", observe: bool = True) -> StepRecord:
         """Absorb one step's metric dict: stamp host time + sequence,
         derive ``step_time_s`` (host delta since this tag's previous
         record — the wall-time-per-step series), feed every numeric value
         into its histogram, count overflow events, append to the ring,
-        and fan out to the sinks."""
+        and fan out to the sinks.
+
+        ``observe=False`` keeps the record out of the histogram layer
+        (no per-value reservoirs, no ``step_time_s`` series) — for
+        EVENT-shaped records (e.g. the serving tier's per-request
+        completion records) whose ids/latencies either are not series or
+        already land in dedicated histograms; they still ride the ring
+        and the sinks."""
         now = time.time()
         with self._lock:
             rec: StepRecord = {"tag": tag, "seq": self._seq, "time": now}
             self._seq += 1
             prev = self._last_time.get(tag)
             self._last_time[tag] = now
-            if prev is not None:
+            if prev is not None and observe:
                 rec["step_time_s"] = now - prev
                 self._observe_locked(f"{tag}.step_time_s",
                                      rec["step_time_s"])
             for k, v in metrics.items():
                 v = _jsonable_scalar(v)
                 rec[k] = v
-                if isinstance(v, (int, float)):
+                if observe and isinstance(v, (int, float)):
                     self._observe_locked(f"{tag}.{k}", v)
             # the scaler's found_inf is the overflow-event signal
             # (SURVEY §6: scale trajectory + overflow events)

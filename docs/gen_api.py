@@ -40,6 +40,7 @@ PAGES = {
                     "apex_tpu.transformer.moe"],
     "kernels": ["apex_tpu.kernels", "apex_tpu.kernels.flash_attention",
                 "apex_tpu.kernels.decode_attention",
+                "apex_tpu.kernels.prefill_attention",
                 "apex_tpu.kernels.layer_norm", "apex_tpu.kernels.xentropy",
                 "apex_tpu.kernels.lm_head_loss",
                 "apex_tpu.kernels.multi_tensor",

@@ -1036,8 +1036,7 @@ def _maybe_generate(args, model, params, tele):
     print(f"=> generate: {len(done)} requests, {toks} tokens in "
           f"{dt:.2f}s ({toks / dt:,.0f} tokens/s), "
           f"ttft p50 {sorted(ttfts)[len(ttfts) // 2] * 1e3:.1f} ms, "
-          f"compiled programs: "
-          f"{engine.prefill_traces + engine.decode_traces}")
+          f"compiled programs: {engine.compiled_programs}")
     preview = done[0]
     print(f"   sample [{preview.finish_reason}]: "
           f"{list(preview.prompt)[:8]}... -> "

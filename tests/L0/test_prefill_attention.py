@@ -1,0 +1,139 @@
+"""apex_tpu.kernels.prefill_attention — chunked-prefill attention kernel.
+
+Kernel-vs-oracle parity (the Pallas path runs interpreted on CPU; Mosaic
+lowering is the tests/tpu tier's job), the shifted-causal mask and block
+skip, dtype handling, tuned-override plumbing, and the two consistency
+contracts that anchor the serving tier:
+
+- offset 0 over the chunk's own K/V == plain causal attention (the
+  monolithic prefill's math);
+- each chunk row == the decode kernel run token-by-token at the same
+  cache state (chunked prefill is N decode steps fused per heartbeat).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.kernels import vmem
+from apex_tpu.kernels.decode_attention import decode_attention_reference
+from apex_tpu.kernels.flash_attention import mha_reference
+from apex_tpu.kernels.prefill_attention import (prefill_attention,
+                                                prefill_attention_reference)
+
+pytestmark = pytest.mark.serving
+
+
+def _rand(shape, dtype=jnp.float32, seed=0):
+    return jnp.asarray(
+        np.random.default_rng(seed).standard_normal(shape), dtype)
+
+
+# ------------------------------------------------------------------- oracle
+def test_reference_offset_zero_is_plain_causal_attention():
+    """With the cache holding exactly the chunk's K/V at offset 0, the
+    shifted-causal mask degenerates to the training causal mask."""
+    B, h, C, d = 2, 3, 8, 16
+    q, k, v = (_rand((B, h, C, d), seed=s) for s in (1, 2, 3))
+    scale = d ** -0.5
+    got = prefill_attention_reference(q, k, v,
+                                      jnp.zeros((B,), jnp.int32),
+                                      scale=scale)
+    want = mha_reference(q, k, v, causal=True, scale=scale)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5)
+
+
+def test_reference_rows_match_sequential_decode():
+    """Row i of a chunk == one decode step at cache length off + i + 1:
+    the fused chunk is exactly N single-token steps."""
+    B, h, C, L, d = 2, 2, 6, 32, 8
+    q = _rand((B, h, C, d), seed=4)
+    k = _rand((B, h, L, d), seed=5)
+    v = _rand((B, h, L, d), seed=6)
+    off = jnp.asarray([0, 11], jnp.int32)
+    scale = d ** -0.5
+    chunk = prefill_attention_reference(q, k, v, off, scale=scale)
+    for i in range(C):
+        step = decode_attention_reference(q[:, :, i], k, v, off + i + 1,
+                                          scale=scale)
+        np.testing.assert_allclose(np.asarray(chunk[:, :, i]),
+                                   np.asarray(step), atol=1e-5,
+                                   err_msg=f"row {i}")
+
+
+# ------------------------------------------------------------------- kernel
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_kernel_matches_oracle(dtype):
+    B, h, C, L, d = 2, 2, 16, 256, 8
+    q = _rand((B, h, C, d), dtype, seed=7)
+    k = _rand((B, h, L, d), dtype, seed=8)
+    v = _rand((B, h, L, d), dtype, seed=9)
+    off = jnp.asarray([0, 37], jnp.int32)
+    want = prefill_attention_reference(q, k, v, off, scale=d ** -0.5)
+    got = prefill_attention(q, k, v, off, block_q=8, block_k=128)
+    atol = 2e-2 if dtype == jnp.bfloat16 else 2e-6
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32), atol=atol)
+    assert got.dtype == dtype
+
+
+def test_kernel_never_attends_past_the_row(seed=10):
+    """Cache positions beyond every row's reach hold huge poison; the
+    mask (and the block skip) must keep them out of the softmax."""
+    B, h, C, L, d = 2, 2, 8, 256, 8
+    q = _rand((B, h, C, d), seed=seed)
+    k = _rand((B, h, L, d), seed=seed + 1)
+    v = _rand((B, h, L, d), seed=seed + 2)
+    off = jnp.asarray([0, 64], jnp.int32)
+    want = prefill_attention(q, k, v, off, block_q=8, block_k=128)
+    # poison everything past the farthest reachable position (max offset
+    # + C - 1); both k and v, so a leak shows as a blowup either way
+    reach = int(off.max()) + C
+    kp = k.at[:, :, reach:].set(1e30)
+    vp = v.at[:, :, reach:].set(-1e30)
+    got = prefill_attention(q, kp, vp, off, block_q=8, block_k=128)
+    assert bool(jnp.isfinite(got).all())
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5)
+
+
+def test_unaligned_shapes_fall_back_to_reference():
+    B, h, C, L, d = 1, 2, 5, 250, 12       # nothing lane/sublane aligned
+    q = _rand((B, h, C, d), seed=13)
+    k = _rand((B, h, L, d), seed=14)
+    v = _rand((B, h, L, d), seed=15)
+    off = jnp.asarray([99], jnp.int32)
+    got = prefill_attention(q, k, v, off)
+    want = prefill_attention_reference(q, k, v, off, scale=d ** -0.5)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-6)
+
+
+def test_tuned_chunk_block_overrides_change_no_math():
+    B, h, C, L, d = 1, 2, 16, 256, 8
+    q = _rand((B, h, C, d), seed=16)
+    k = _rand((B, h, L, d), seed=17)
+    v = _rand((B, h, L, d), seed=18)
+    off = jnp.asarray([21], jnp.int32)
+    base = prefill_attention(q, k, v, off)
+    vmem.set_override("decode.chunk_block_q", 16)
+    vmem.set_override("decode.chunk_block_k", 128)
+    try:
+        tuned = prefill_attention(q, k, v, off)
+    finally:
+        vmem.remove_override("decode.chunk_block_q")
+        vmem.remove_override("decode.chunk_block_k")
+    np.testing.assert_allclose(np.asarray(base), np.asarray(tuned),
+                               atol=1e-6)
+
+
+def test_shape_validation():
+    q = _rand((1, 2, 8, 8))
+    k = _rand((1, 2, 32, 8))
+    with pytest.raises(ValueError, match="do not match"):
+        prefill_attention(q, k, _rand((1, 2, 16, 8)),
+                          jnp.zeros((1,), jnp.int32))
+    with pytest.raises(ValueError, match="offsets"):
+        prefill_attention(q, k, k, jnp.zeros((2,), jnp.int32))

@@ -1,6 +1,7 @@
 """apex_tpu.serving — KV-cache engine + continuous batching, hermetic.
 
-The acceptance bar from the subsystem's issue, as tests:
+The acceptance bar from the subsystem's issues (PR 3 + PR 4's chunked
+prefill), as tests:
 
 - greedy KV-cache decode is token-exact against the full-recompute
   forward's argmax for >= 64 generated tokens (teacher-forcing form:
@@ -8,13 +9,22 @@ The acceptance bar from the subsystem's issue, as tests:
   argmax, so both paths are compared through identical programs — the
   shared-program discipline of test_amp_train_step.py, avoiding 64
   separately-fused eager forwards);
-- a stream of variable-length requests is served by exactly 2 compiled
-  programs (prefill + decode step), pinned by trace counters;
-- telemetry records tokens/sec, time-to-first-token and slot occupancy.
+- chunked prefill is token-exact (bitwise argmax) against BOTH the
+  monolithic-prefill path and full recompute, for prompt lengths
+  shorter than / equal to / straddling a chunk boundary;
+- a variable-length request stream exercising chunked serving plus the
+  monolithic baseline is served by exactly 3 compiled programs (chunk
+  prefill + decode step + legacy monolithic prefill), pinned by trace
+  counters;
+- chunk-prefill steps interleave with the decode heartbeat: an
+  in-flight decode gains a token on EVERY tick of a long admit (the
+  head-of-line-blocking fix);
+- telemetry records tokens/sec, the TTFT decomposition (queue wait +
+  prefill chunks), chunks-per-prompt, and slot occupancy.
 
 Everything runs on CPU with a tiny model; the engine's Pallas decode
-kernel takes its interpret/reference path here (the Mosaic lowering is
-the tests/tpu tier's job).
+and chunk-prefill kernels take their interpret/reference paths here
+(the Mosaic lowering is the tests/tpu tier's job).
 """
 
 import time
@@ -116,7 +126,13 @@ def test_greedy_decode_token_exact_vs_full_recompute(fp32_engine,
                                                      lm_and_params):
     """>= 64 greedy tokens from the KV-cache engine == the argmax chain
     of one full-recompute forward over the final sequence (causality
-    makes teacher-forcing re-derivation exact for greedy decode)."""
+    makes teacher-forcing re-derivation exact for greedy decode).
+
+    The default scheduler now admits through CHUNKED prefill, so this is
+    also the PR 4 acceptance pin: the chunked path is token-exact for
+    >= 64 generated tokens against full-recompute argmax (the
+    chunk-boundary sweep lives in
+    test_chunked_prefill_token_exact_vs_monolithic_and_recompute)."""
     m, params = lm_and_params
     eng = fp32_engine
     sched = Scheduler(eng)
@@ -134,24 +150,220 @@ def test_greedy_decode_token_exact_vs_full_recompute(fp32_engine,
             f"divergence at generated token {i}"
 
 
-def test_exactly_two_compiled_programs(fp32_engine):
-    """Variable-length, variable-budget request stream → exactly one
-    prefill trace and one decode-step trace (the fixed-shape contract:
-    no per-token or per-request recompiles)."""
-    eng = fp32_engine
-    base_p, base_d = eng.prefill_traces, eng.decode_traces
+# --------------------------------------------------------- chunked prefill
+@pytest.fixture(scope="module")
+def chunk_engines(lm_and_params):
+    """Two identical O0 engines (chunk_len=8) — one serves the chunked
+    path, one the monolithic baseline, for output comparisons."""
+    m, params = lm_and_params
+    mk = lambda: Engine(m, params, slots=3, max_len=128, prefill_len=24,
+                        chunk_len=8,
+                        policy=resolve_policy("O0", verbose=False),
+                        seed=5)
+    return mk(), mk()
+
+
+def _greedy_reqs():
+    rng = np.random.default_rng(42)
+    # shorter than (5), equal to (8), straddling one (13) and two (21)
+    # chunk boundaries at chunk_len=8 (the >= 64-token stream lives in
+    # test_greedy_decode_token_exact_vs_full_recompute — same chunked
+    # admission path — keeping this sweep fast)
+    return [Request(prompt=list(rng.integers(1, VOCAB, size=n)),
+                    max_new_tokens=b)
+            for n, b in [(5, 12), (8, 4), (13, 4), (21, 4)]]
+
+
+def test_exactly_three_compiled_programs(chunk_engines):
+    """Variable-length, variable-budget, variable-chunk-count request
+    stream through the chunked scheduler PLUS the monolithic-baseline
+    prefill → exactly one chunk-prefill trace, one decode-step trace and
+    one monolithic-prefill trace (the fixed-shape contract: no
+    per-token, per-request, per-offset or per-chunk-count recompiles).
+    Runs first on the module's shared engine, so the pin covers every
+    later test on it too."""
+    eng, _ = chunk_engines
     sched = Scheduler(eng)
     rng = np.random.default_rng(0)
+    # prompt lengths span 1-3 chunks, including exact chunk multiples
     reqs = [Request(prompt=list(rng.integers(1, VOCAB, size=n)),
                     max_new_tokens=mnt, temperature=t)
-            for n, mnt, t in [(1, 3, 0.0), (7, 9, 0.0), (16, 5, 0.7),
-                              (4, 12, 0.0), (11, 2, 1.3)]]
+            for n, mnt, t in [(1, 3, 0.0), (8, 9, 0.0), (17, 5, 0.7),
+                              (24, 12, 0.0), (11, 2, 1.3), (5, 4, 0.0)]]
     done = sched.run(reqs)
-    assert len(done) == 5
-    assert eng.prefill_traces - base_p <= 1
-    assert eng.decode_traces - base_d <= 1
-    # the fixture's earlier users already compiled both programs once
-    assert eng.prefill_traces == 1 and eng.decode_traces == 1
+    assert len(done) == 6
+    assert [r.chunks for r in reqs] == [eng.chunks_for(len(r.prompt))
+                                        for r in reqs]
+    # the monolithic baseline path still compiles (and only once)
+    eng.reset()
+    eng.prefill(0, [5, 9, 2])
+    eng.prefill(1, list(range(1, 20)))
+    assert (eng.chunk_traces, eng.decode_traces, eng.prefill_traces) \
+        == (1, 1, 1)
+    assert eng.compiled_programs == 3
+
+
+def test_chunked_prefill_token_exact_vs_monolithic_and_recompute(
+        chunk_engines, lm_and_params):
+    """The PR 4 acceptance bar: greedy decode after chunked prefill is
+    bitwise-argmax identical to the monolithic-prefill path AND to one
+    teacher-forcing full recompute, across chunk-boundary prompt
+    lengths."""
+    m, params = lm_and_params
+    eng_c, eng_m = chunk_engines
+    eng_c.reset()
+    eng_m.reset()
+    reqs_c, reqs_m = _greedy_reqs(), _greedy_reqs()
+    Scheduler(eng_c, chunked=True).run(reqs_c)
+    Scheduler(eng_m, chunked=False).run(reqs_m)
+    for rc, rm in zip(reqs_c, reqs_m):
+        assert rc.output_tokens == rm.output_tokens, \
+            f"chunked vs monolithic diverged (prompt len {len(rc.prompt)})"
+        assert rc.chunks == eng_c.chunks_for(len(rc.prompt))
+        assert rm.chunks == 1
+        # teacher-forcing: one full forward re-derives every greedy step
+        seq = jnp.asarray([list(rc.prompt) + rc.output_tokens], jnp.int32)
+        full = m.apply({"params": params}, seq, train=False)
+        want = np.asarray(jnp.argmax(full[0], axis=-1))
+        for i, tok in enumerate(rc.output_tokens):
+            assert tok == int(want[len(rc.prompt) - 1 + i]), \
+                f"prompt len {len(rc.prompt)}: divergence at token {i}"
+
+
+def test_chunked_prefill_interleaves_with_decode(chunk_engines):
+    """The head-of-line fix, observed at token granularity: while a
+    3-chunk prompt ingests (one chunk per heartbeat), the in-flight
+    decode gains a token on EVERY tick — the monolithic path would
+    stall it for the whole prefill."""
+    eng, _ = chunk_engines
+    eng.reset()
+    sched = Scheduler(eng)
+    a = Request(prompt=[3, 1, 4], max_new_tokens=50)
+    sched.submit(a)
+    sched.step()                      # admit + single final chunk + decode
+    assert a.status == "running" and len(a.output_tokens) == 2
+    b = Request(prompt=list(range(1, 25)), max_new_tokens=4)  # 3 chunks
+    sched.submit(b)
+    for tick in range(1, 4):
+        n_before = len(a.output_tokens)
+        sched.step()
+        assert len(a.output_tokens) == n_before + 1, \
+            f"decode stalled at tick {tick} during b's prefill"
+        assert b.chunks == tick
+    # b's final-chunk tick yields its first token AND a decode token —
+    # the fresh slot joins the same heartbeat it finished prefilling in
+    assert b.status == "running" and len(b.output_tokens) == 2
+    assert b.ttft_s is not None and b.chunks == 3
+    # the budget caps chunk work per heartbeat at one chunk
+    assert eng.chunks_for(len(b.prompt)) == 3
+
+
+def test_chunked_ttft_decomposition_and_request_records(chunk_engines):
+    """serving.queue_wait_s and serving.prefill_chunk_s land as separate
+    histograms from serving.ttft_s, and every completion emits a
+    serving.request record carrying chunks_per_prompt."""
+    reg = telemetry.MetricsRegistry()
+    eng, _ = chunk_engines
+    eng.reset()
+    eng.set_registry(reg)
+    sched = Scheduler(eng, registry=reg)
+    reqs = [Request(prompt=[1, 2, 3], max_new_tokens=4),
+            Request(prompt=list(range(1, 20)), max_new_tokens=3)]
+    try:
+        sched.run(reqs)
+    finally:
+        eng.set_registry(None)
+    snap = reg.snapshot()
+    h = snap["histograms"]
+    assert h["serving.queue_wait_s"]["count"] == 2
+    assert h["serving.prefill_chunk_s"]["count"] == 1 + 3   # 1 + 3 chunks
+    assert h["serving.ttft_s"]["count"] == 2
+    assert snap["counters"]["serving.prefill.chunks"] == 4
+    for r in reqs:
+        assert r.queue_wait_s is not None and r.prefill_s > 0
+        assert r.ttft_s >= r.queue_wait_s
+    # event-shaped records stay OUT of the histogram layer: no junk
+    # per-request reservoirs for uid / duplicated latencies
+    assert not any(k.startswith("serving.request.") for k in h)
+    recs = [rec for rec in reg.records
+            if rec.get("tag") == "serving.request"]
+    assert len(recs) == 2
+    by_uid = {rec["uid"]: rec for rec in recs}
+    assert by_uid[reqs[0].uid]["chunks_per_prompt"] == 1
+    assert by_uid[reqs[1].uid]["chunks_per_prompt"] == 3
+    for rec in recs:
+        assert rec["finish_reason"] == "max_new_tokens"
+        assert rec["queue_wait_s"] is not None
+        assert rec["ttft_s"] is not None
+
+
+def test_prefill_chunk_validation(lm_and_params, chunk_engines):
+    m, params = lm_and_params
+    with pytest.raises(ValueError, match="chunk_len"):
+        Engine(m, params, slots=1, max_len=32, prefill_len=8,
+               chunk_len=16)
+    eng, _ = chunk_engines                     # chunk_len=8, prefill 24
+    with pytest.raises(ValueError, match="chunk length"):
+        eng.prefill_chunk(0, list(range(1, 10)), 0)
+    with pytest.raises(ValueError, match="slot"):
+        eng.prefill_chunk(5, [1], 0)
+    with pytest.raises(ValueError, match="exceeds prefill_len"):
+        eng.prefill_chunk(0, [1, 2, 3, 4], 21)
+    with pytest.raises(ValueError, match="prompt length"):
+        eng.prefill_chunked(0, list(range(25)))
+    with pytest.raises(ValueError, match="chunk_budget"):
+        Scheduler(eng, chunk_budget=0)
+    # the final PADDED chunk window must fit max_len: a geometry whose
+    # last chunk would spill past the cache (and be silently relocated
+    # by the model's position clip, corrupting earlier prompt K/V) is
+    # rejected at construction, not discovered as wrong tokens
+    with pytest.raises(ValueError, match="final chunk window"):
+        Engine(m, params, slots=1, max_len=20, prefill_len=20,
+               chunk_len=8)
+    # ... and direct prefill_chunk callers at arbitrary offsets hit the
+    # same wall per call
+    eng24 = Engine(m, params, slots=1, max_len=24, prefill_len=24,
+                   chunk_len=8)
+    with pytest.raises(ValueError, match="exceeds max_len"):
+        eng24.prefill_chunk(0, [1, 2], 18)
+
+
+def test_chunk_budget_caps_ingestion_only_while_decoding(chunk_engines):
+    """The budget bounds the stall imposed ON in-flight decodes: with a
+    decode active, at most chunk_budget chunks run per tick; with
+    nothing decoding there is nothing to stall, so a cold queue bursts
+    straight to full ingestion instead of idling between heartbeats."""
+    eng, _ = chunk_engines
+    eng.reset()
+    sched = Scheduler(eng, chunk_budget=2)
+    c = Request(prompt=[1, 2], max_new_tokens=50)
+    sched.submit(c)
+    sched.step()                               # c: 1 chunk → decoding
+    assert c.status == "running"
+    a = Request(prompt=list(range(1, 17)), max_new_tokens=3)   # 2 chunks
+    b = Request(prompt=list(range(2, 18)), max_new_tokens=3)   # 2 chunks
+    sched.submit(a)
+    sched.submit(b)
+    sched.step()
+    assert a.chunks == 1 and b.chunks == 1     # one chunk EACH this tick
+    sched.step()
+    assert a.chunks == 2 and b.chunks == 2
+    assert a.status == "running" and b.status == "running"
+
+
+def test_cold_queue_bursts_to_full_ingestion(chunk_engines):
+    eng, _ = chunk_engines
+    eng.reset()
+    sched = Scheduler(eng)                     # chunk_budget=1
+    a = Request(prompt=list(range(1, 24)), max_new_tokens=4)   # 3 chunks
+    sched.submit(a)
+    sched.step()
+    # nothing was decoding, so one tick burst through ALL 3 chunks
+    # (instead of idling two heartbeats) and ran the first decode; the
+    # burst stops the moment a slot flips to decoding, so the budget
+    # bound on in-flight stalls is never violated
+    assert a.chunks == 3
+    assert a.status == "running" and len(a.output_tokens) == 2
 
 
 # ----------------------------------------------------------------- engine

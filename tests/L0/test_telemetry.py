@@ -281,6 +281,15 @@ def test_timed_context_manager(spy_registry):
 
 # ------------------------------------------------------ bench crash contract
 
+@pytest.fixture(autouse=True)
+def _no_retry_backoff(monkeypatch):
+    """Guard retries sleep an exponential backoff in production; zero it
+    here so the transient-retry tests stay instant (the backoff itself
+    is covered by test_guard_bench_main_backoff_schedule, which restores
+    a nonzero base)."""
+    monkeypatch.setattr(telemetry, "_RETRY_BACKOFF_S", 0.0)
+
+
 def test_every_bench_driver_routes_through_guard_bench_main():
     """Every bench_*.py entry point must end in a parseable JSON line on
     ANY outcome — i.e. wrap its main in guard_bench_main. A new bench
@@ -387,6 +396,59 @@ def test_guard_bench_main_transient_systemexit_retries():
 
     assert telemetry.guard_bench_main(flaky_exit, "m") == "ok"
     assert len(calls) == 2
+
+
+def test_guard_bench_main_retries_default_from_env(monkeypatch, capsys):
+    """APEX_TPU_BENCH_RETRIES raises the retry budget without touching
+    any bench driver (PR 4 satellite: BENCH_r05 exhausted its single
+    retry on back-to-back remote_compile resets)."""
+    monkeypatch.setenv("APEX_TPU_BENCH_RETRIES", "3")
+    calls = []
+
+    def triple_flaky():
+        calls.append(1)
+        if len(calls) <= 3:
+            raise RuntimeError("remote_compile: read body")
+        return 42
+
+    assert telemetry.guard_bench_main(triple_flaky, "m") == 42
+    assert len(calls) == 4                       # original + 3 retries
+
+
+def test_guard_bench_main_env_retries_zero_and_malformed(monkeypatch):
+    monkeypatch.setenv("APEX_TPU_BENCH_RETRIES", "0")
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        raise RuntimeError("connection reset")
+
+    with pytest.raises(SystemExit):
+        telemetry.guard_bench_main(flaky, "m")
+    assert len(calls) == 1                       # env 0 → no retry
+    # malformed env degrades to the default of 1, never crashes
+    monkeypatch.setenv("APEX_TPU_BENCH_RETRIES", "yes please")
+    assert telemetry._env_retries() == 1
+    monkeypatch.setenv("APEX_TPU_BENCH_RETRIES", "-2")
+    assert telemetry._env_retries() == 0         # clamped, not negative
+    monkeypatch.delenv("APEX_TPU_BENCH_RETRIES")
+    assert telemetry._env_retries() == 1
+
+
+def test_guard_bench_main_backoff_schedule(monkeypatch):
+    """Transient retries back off exponentially (0.5, 1, 2, ... capped)
+    instead of hammering the same mid-hiccup infrastructure."""
+    monkeypatch.setattr(telemetry, "_RETRY_BACKOFF_S", 0.5)
+    sleeps = []
+    monkeypatch.setattr(telemetry.time, "sleep",
+                        lambda s: sleeps.append(s))
+
+    def always_flaky():
+        raise RuntimeError("remote_compile: read body")
+
+    with pytest.raises(SystemExit):
+        telemetry.guard_bench_main(always_flaky, "m", retries=6)
+    assert sleeps == [0.5, 1.0, 2.0, 4.0, 8.0, 8.0]   # capped at 8 s
 
 
 # -------------------------------------------------------------- summarize

@@ -1,0 +1,96 @@
+"""Tuned-key lint: every block knob the kernel/serving tier references
+must exist in the packaged tuned tables (or be explicitly allowlisted).
+
+The override registry (:mod:`apex_tpu.kernels.vmem`) is stringly typed:
+``get_override("decode.blokc_k", ...)`` is not an error, it is a silent
+fall-through to the untuned default — a typo'd key costs real tokens/s
+on silicon and nothing ever flags it. This lint closes the loop: the
+set of key literals referenced by ``apex_tpu/kernels/`` and
+``apex_tpu/serving/`` source must be a subset of the union of keys
+across ``apex_tpu/kernels/tuned/*.json`` plus the documented
+``EXPLICITLY_DEFAULTED`` set, and the tables must not carry keys no
+code consumes (a stale table row is a sweep that no longer tunes
+anything).
+"""
+
+import glob
+import json
+import os
+import re
+
+import pytest
+
+pytestmark = pytest.mark.serving
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.abspath(os.path.join(HERE, os.pardir, os.pardir))
+TUNED_DIR = os.path.join(ROOT, "apex_tpu", "kernels", "tuned")
+SCAN_DIRS = [os.path.join(ROOT, "apex_tpu", "kernels"),
+             os.path.join(ROOT, "apex_tpu", "serving")]
+
+# Keys a call site may reference without a packaged tuned value: add an
+# entry here ONLY with a comment saying why the heuristic default is the
+# intended production value.
+EXPLICITLY_DEFAULTED: set = set()
+
+
+def _table_keys():
+    keys = set()
+    files = glob.glob(os.path.join(TUNED_DIR, "*.json"))
+    assert files, f"no tuned tables under {TUNED_DIR}"
+    for path in files:
+        with open(path) as f:
+            keys |= set(json.load(f))
+    return keys
+
+
+def _referenced_keys(prefixes):
+    """Quoted ``family.knob`` literals in the scanned sources, filtered
+    to known tuned-key families so einsum specs / file names / metric
+    names never false-positive."""
+    pat = re.compile(r'["\']([a-z0-9_]+\.[a-z0-9_]+)["\']')
+    refs = {}
+    for d in SCAN_DIRS:
+        for path in glob.glob(os.path.join(d, "**", "*.py"),
+                              recursive=True):
+            with open(path) as f:
+                for key in pat.findall(f.read()):
+                    if key.split(".", 1)[0] in prefixes:
+                        refs.setdefault(key, []).append(
+                            os.path.relpath(path, ROOT))
+    return refs
+
+
+def test_every_referenced_tuned_key_exists_in_the_tables():
+    table = _table_keys()
+    prefixes = {k.split(".", 1)[0] for k in table}
+    refs = _referenced_keys(prefixes)
+    assert refs, "lint found no tuned-key references at all — the " \
+        "regex or scan dirs are broken, not the code"
+    missing = {k: v for k, v in refs.items()
+               if k not in table and k not in EXPLICITLY_DEFAULTED}
+    assert not missing, (
+        f"tuned keys referenced in code but absent from every table in "
+        f"{TUNED_DIR} (typo, or add the key to the tables / "
+        f"EXPLICITLY_DEFAULTED): {missing}")
+
+
+def test_no_stale_table_keys():
+    table = _table_keys()
+    prefixes = {k.split(".", 1)[0] for k in table}
+    refs = set(_referenced_keys(prefixes))
+    stale = table - refs
+    assert not stale, (
+        f"tuned tables carry keys no kernel/serving code references "
+        f"(dead sweep rows — delete them or wire a consumer): {stale}")
+
+
+def test_chunk_prefill_keys_are_tuned():
+    """The chunked-prefill kernel's knobs ship tuned values (the PR 4
+    satellite): a fresh engine on v5e silicon must not fall back to
+    emulator-era defaults for its hottest new program."""
+    table = _table_keys()
+    for key in ("decode.chunk_block_q", "decode.chunk_block_k",
+                "decode.block_k", "decode.prefill_block_q",
+                "decode.prefill_block_k"):
+        assert key in table, f"{key} missing from the tuned tables"
