@@ -155,7 +155,9 @@ def _shard_offset(v_local, axis_name):
     if axis_name is None:
         return 0, v_local
     idx = jax.lax.axis_index(axis_name)
-    size = jax.lax.axis_size(axis_name)
+    # psum of a literal 1 is static under shard_map and exists on every
+    # jax this library targets (jax.lax.axis_size does not)
+    size = jax.lax.psum(1, axis_name)
     return idx * v_local, v_local * size
 
 

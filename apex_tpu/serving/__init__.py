@@ -8,22 +8,31 @@ generation requests from a fixed set of compiled programs:
 - :class:`KVCache` (:mod:`.kv_cache`) — preallocated
   ``[layers, slots, heads, max_len, head_dim]`` slot cache with
   per-slot lengths, stored in the amp half dtype.
-- :class:`Engine` (:mod:`.engine`) — exactly three XLA executables
+- :class:`Engine` (:mod:`.engine`) — exactly four XLA executables
   (jitted chunk-prefill + jitted decode step + the legacy monolithic
-  prefill baseline, fixed shapes, traced slot/offset/length/temperature
-  scalars), greedy / temperature / top-k sampling compiled in; decode
-  attention through
+  prefill baseline + the prefix-reuse KV row-copy, fixed shapes, traced
+  slot/offset/length/temperature scalars), greedy / temperature / top-k
+  sampling compiled in; decode attention through
   :func:`apex_tpu.kernels.decode_attention.decode_attention` and chunk
   attention through
   :func:`apex_tpu.kernels.prefill_attention.prefill_attention`
   (length-masked, ``decode.*`` tuned-block keys).
+- :class:`PrefixCache` (:mod:`.prefix_cache`) — content-addressed
+  prompt-prefix reuse: retained prefixes keyed by a rolling hash over
+  ``chunk_len``-aligned token blocks, held in ``prefix_pool`` cache
+  rows with refcount pinning + LRU eviction; an admission hit restores
+  the longest cached prefix by one row-copy and skips
+  ``matched_len / chunk_len`` chunks of prefill compute, bitwise
+  token-exact vs. the cold path.
 - :class:`Scheduler` (:mod:`.scheduler`) — continuous batching with
   chunked prefill fused into the decode heartbeat: admit-into-free-slots,
   at most ``chunk_budget`` compiled chunk-prefill steps per tick (so
   in-flight decodes never wait more than one chunk for a new admit),
   EOS/max-token/timeout eviction, bounded-queue :class:`QueueFull`
-  backpressure, and slot-occupancy / padding-waste / decomposed-TTFT /
-  chunks-per-prompt / tokens-per-sec telemetry through the shared
+  backpressure, opt-in prefix retention (``retain_prefixes=True``:
+  consult-on-admit, register-on-prefill-completion), and slot-occupancy
+  / padding-waste / decomposed-TTFT / chunks-per-prompt /
+  ``serving.prefix.*`` / tokens-per-sec telemetry through the shared
   :class:`~apex_tpu.telemetry.MetricsRegistry`.
 
 Quick start::
@@ -45,7 +54,8 @@ Exercised end-to-end by ``bench_serving.py`` and
 
 from .engine import Engine, sample_tokens
 from .kv_cache import KVCache
+from .prefix_cache import PrefixCache, PrefixMatch
 from .scheduler import QueueFull, Request, Scheduler
 
-__all__ = ["Engine", "KVCache", "QueueFull", "Request", "Scheduler",
-           "sample_tokens"]
+__all__ = ["Engine", "KVCache", "PrefixCache", "PrefixMatch", "QueueFull",
+           "Request", "Scheduler", "sample_tokens"]

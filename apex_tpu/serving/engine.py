@@ -1,6 +1,6 @@
-"""Compiled inference engine: chunk-prefill, decode, monolithic prefill.
+"""Compiled inference engine: chunk-prefill, decode, prefill, KV copy.
 
-The engine owns the three — and exactly three — XLA executables a
+The engine owns the four — and exactly four — XLA executables a
 serving process needs, each traced once at fixed shapes:
 
 - **chunk prefill** (the scheduler's ingestion path): ``[1, chunk_len]``
@@ -25,6 +25,15 @@ serving process needs, each traced once at fixed shapes:
   head-of-line-blocking baseline (``Scheduler(chunked=False)``,
   ``bench_serving.py --mixed-prompts``); it stalls every active decode
   slot for the full prompt, which is exactly what chunking removes.
+- **KV row copy** (prefix reuse): donor slot → destination slot via
+  dynamic slices (the :meth:`KVCache.slot_view`/:meth:`KVCache
+  .write_slot` pattern), traced source/destination/length scalars. One
+  program serves both directions of content-addressed prompt caching —
+  registering a completed prefix into a pool row and restoring a
+  matched prefix into a freshly admitted slot — after which the
+  remaining suffix flows through the *existing* chunk-prefill program
+  starting at the matched offset, skipping ``matched_len / chunk_len``
+  chunks of attention+MLP compute outright.
 
 Sampling runs inside the compiled programs: greedy when a slot's
 temperature is 0, else temperature softmax over logits optionally
@@ -38,10 +47,10 @@ cache in the same dtype); pass ``policy=amp.resolve_policy("O0")`` for
 an exact-fp32 engine (the decode-parity tests' configuration).
 
 Trace accounting: the python bodies of the programs run only when jax
-traces them, so ``chunk_traces``/``decode_traces``/``prefill_traces``
-count compiles — the serving test tier pins the engine to exactly three
-compiled programs across a multi-request, variable-length run that
-exercises all three paths.
+traces them, so ``chunk_traces``/``decode_traces``/``prefill_traces``/
+``copy_traces`` count compiles — the serving test tier pins the engine
+to exactly four compiled programs across a multi-request,
+variable-length, hit/miss/evict run that exercises all four paths.
 """
 
 from __future__ import annotations
@@ -57,6 +66,7 @@ from apex_tpu.kernels import vmem
 from apex_tpu.log_util import get_logger
 
 from .kv_cache import KVCache
+from .prefix_cache import PrefixCache
 
 __all__ = ["Engine", "sample_tokens"]
 
@@ -114,6 +124,14 @@ class Engine:
     policy:
         An :class:`apex_tpu.amp.Policy` governing weight/cache storage;
         default ``resolve_policy("O3", verbose=False)`` (pure bf16).
+    prefix_pool:
+        Cache rows reserved past the serving slots for content-addressed
+        prompt-prefix reuse (0 = off). When > 0 the engine allocates
+        ``slots + prefix_pool`` rows, compiles the fourth (KV row-copy)
+        program lazily on first use, and exposes a
+        :class:`~apex_tpu.serving.PrefixCache` as ``prefix_cache``
+        (consulted by ``Scheduler(retain_prefixes=True)``). The decode
+        batch stays ``[slots, 1]`` — pool rows are never computed over.
     top_k:
         Static top-k truncation for sampled (non-greedy) slots; 0 = off.
     registry:
@@ -129,7 +147,8 @@ class Engine:
     def __init__(self, model, params, *, slots: int, max_len: int,
                  prefill_len: Optional[int] = None,
                  chunk_len: Optional[int] = None, policy=None,
-                 top_k: int = 0, seed: int = 0, registry=None):
+                 prefix_pool: int = 0, top_k: int = 0, seed: int = 0,
+                 registry=None):
         from apex_tpu.amp.policy import resolve_policy
 
         if policy is None:
@@ -171,10 +190,13 @@ class Engine:
                 f" of a prefill_len={prefill_len} prompt exceeds "
                 f"max_len={max_len}; pick a chunk_len with "
                 f"ceil(prefill_len/chunk_len)*chunk_len <= max_len")
+        if prefix_pool < 0:
+            raise ValueError("prefix_pool must be >= 0")
         self.slots = int(slots)
         self.max_len = int(max_len)
         self.prefill_len = int(prefill_len)
         self.chunk_len = int(chunk_len)
+        self.prefix_pool = int(prefix_pool)
         self.top_k = int(top_k)
         # pin the eval dtype on the module itself so decode GEMMs and
         # the cache agree (pure-half: no fp32 masters anywhere)
@@ -185,14 +207,22 @@ class Engine:
         self.params = policy.cast_params(params)
         hidden = int(model.hidden)
         heads = int(model.num_heads)
+        # pool rows ride the same arrays as the serving slots so ONE
+        # copy program (traced src/dst rows, same shapes) serves both
+        # directions of prefix reuse; decode slices them back out
         self.cache = KVCache.create(
-            layers=int(model.num_layers), slots=self.slots, heads=heads,
+            layers=int(model.num_layers),
+            slots=self.slots + self.prefix_pool, heads=heads,
             max_len=self.max_len, head_dim=hidden // heads, dtype=half)
+        self.prefix_cache = None if self.prefix_pool == 0 else PrefixCache(
+            block_len=self.chunk_len,
+            pool_rows=range(self.slots, self.slots + self.prefix_pool))
         self._registry = registry
         self._key = jax.random.PRNGKey(seed)
         self.prefill_traces = 0
         self.decode_traces = 0
         self.chunk_traces = 0
+        self.copy_traces = 0
         self.tokens_generated = 0
         # prefill flash-attention geometry: decode.* tuned keys beat the
         # training sweep's flash.* defaults when present
@@ -204,19 +234,23 @@ class Engine:
                                     donate_argnums=(1,))
         self._jit_decode = jax.jit(self._decode_impl, donate_argnums=(1,))
         self._jit_chunk = jax.jit(self._chunk_impl, donate_argnums=(1,))
+        self._jit_copy = jax.jit(self._copy_impl, donate_argnums=(0,))
         _logger.info(
             "serving engine: %d slots x %d positions, prefill_len=%d, "
-            "chunk_len=%d, cache %s (%.1f MiB), top_k=%d", self.slots,
-            self.max_len, self.prefill_len, self.chunk_len,
-            np.dtype(half).name, self.cache.nbytes() / 2**20, self.top_k)
+            "chunk_len=%d, prefix_pool=%d, cache %s (%.1f MiB), top_k=%d",
+            self.slots, self.max_len, self.prefill_len, self.chunk_len,
+            self.prefix_pool, np.dtype(half).name,
+            self.cache.nbytes() / 2**20, self.top_k)
 
     @property
     def compiled_programs(self) -> int:
         """Distinct XLA executables traced so far (the compile-count
-        discipline the serving tests pin to exactly three across a run
+        discipline the serving tests pin: exactly three across a run
         that exercises chunk prefill, decode, and the monolithic
-        baseline)."""
-        return self.chunk_traces + self.decode_traces + self.prefill_traces
+        baseline; exactly four once prefix reuse exercises the KV
+        row-copy too)."""
+        return (self.chunk_traces + self.decode_traces
+                + self.prefill_traces + self.copy_traces)
 
     # ------------------------------------------------------ compiled bodies
     def _prefill_impl(self, params, cache, tokens, length, slot,
@@ -252,13 +286,23 @@ class Engine:
     def _decode_impl(self, params, cache, last_tokens, active,
                      temperature, key):
         self.decode_traces += 1     # python body runs at trace time only
-        positions = jnp.minimum(cache.lengths, self.max_len - 1)
+        # prefix-pool rows sit past the serving slots in the same
+        # arrays: slice them out (static) so the decode batch stays
+        # [slots, 1] — retained prefixes cost storage, not compute.
+        # With prefix_pool == 0 the front IS the whole cache and this
+        # degenerates bitwise to a model_view()/advance decode.
+        positions = jnp.minimum(cache.lengths[:self.slots],
+                                self.max_len - 1)
         logits, (k2, v2) = self._model.apply(
             {"params": params}, last_tokens[:, None], train=False,
-            cache=cache.model_view(), positions=positions)
+            cache=cache.front_view(self.slots), positions=positions)
         tokens = sample_tokens(logits[:, 0, :], temperature, key,
                                self.top_k)
-        return cache.advance(k2, v2, active), tokens
+        return cache.advance_front(k2, v2, active), tokens
+
+    def _copy_impl(self, cache, src, dst, length):
+        self.copy_traces += 1       # python body runs at trace time only
+        return cache.copy_slot(src, dst, length)
 
     # ------------------------------------------------------------- host API
     def _next_key(self):
@@ -372,6 +416,43 @@ class Engine:
         (``ceil(prompt_len / chunk_len)``)."""
         return -(-int(prompt_len) // self.chunk_len)
 
+    def copy_kv(self, src: int, dst: int, length: int) -> None:
+        """The fourth compiled program: copy row ``src``'s K/V into row
+        ``dst`` and set ``dst``'s length to ``length`` (traced scalars —
+        one executable serves every donor/destination/length triple).
+        Rows address serving slots AND prefix-pool rows, so registration
+        (slot → pool row) and restoration (pool row → admitted slot) are
+        the same program. Cheap by construction: one ``[layers, heads,
+        max_len, head_dim]`` device-to-device copy, no attention or MLP
+        compute."""
+        rows = self.slots + self.prefix_pool
+        if not 0 <= src < rows or not 0 <= dst < rows:
+            raise ValueError(f"copy rows ({src} -> {dst}) must be in "
+                             f"[0, {rows})")
+        if src == dst:
+            raise ValueError("copy source and destination must differ")
+        if not 0 < length <= self.max_len:
+            raise ValueError(f"copy length {length} not in (0, "
+                             f"max_len={self.max_len}]")
+        t0 = time.perf_counter()
+        self.cache = self._jit_copy(self.cache, np.int32(src),
+                                    np.int32(dst), np.int32(length))
+        if self._registry is not None:
+            self._registry.observe("serving.prefix.copy_s",
+                                   time.perf_counter() - t0)
+
+    def restore_prefix(self, slot: int, row: int, length: int) -> None:
+        """Admission-time prefix hit: pool row ``row``'s first
+        ``length`` positions become serving ``slot``'s cache prefix; the
+        scheduler then resumes chunk prefill at offset ``length``."""
+        self.copy_kv(row, slot, length)
+
+    def store_prefix(self, row: int, slot: int, length: int) -> None:
+        """Registration: retain serving ``slot``'s first ``length``
+        positions (a completed, block-aligned prompt prefix) in pool row
+        ``row``."""
+        self.copy_kv(slot, row, length)
+
     def _with_prefill_blocks(self, fn):
         """Run ``fn`` with the ``decode.prefill_block_q``/``_k`` tuned
         keys temporarily installed as the flash-attention geometry.
@@ -425,8 +506,18 @@ class Engine:
         so first-trace latency never poisons the serving histograms)."""
         self._registry = registry
 
-    def reset(self) -> None:
-        """Zero the cache lengths (slot table wipe; K/V left in place —
-        length masking makes stale data unreachable)."""
-        self.cache = self.cache.replace(
-            lengths=jnp.zeros((self.slots,), jnp.int32))
+    def reset(self, clear_prefixes: bool = False) -> None:
+        """Zero the serving-slot lengths (slot table wipe; K/V left in
+        place — length masking makes stale data unreachable). Retained
+        prefixes SURVIVE a reset by default (they are warm state, not
+        per-request state — a bench window reset must not throw away the
+        cache it is measuring); pass ``clear_prefixes=True`` to drop
+        them too."""
+        lengths = self.cache.lengths
+        if clear_prefixes:
+            lengths = jnp.zeros_like(lengths)
+            if self.prefix_cache is not None:
+                self.prefix_cache.clear()
+        else:
+            lengths = lengths.at[:self.slots].set(0)
+        self.cache = self.cache.replace(lengths=lengths)

@@ -25,6 +25,12 @@ Slot semantics (the continuous-batching contract):
   enter a softmax.
 - **eviction** is free: a finished slot is just marked length-0 on the
   host; the next prefill overwrites it. No device-side compaction.
+- **prefix pool**: an engine built with ``prefix_pool=N`` allocates N
+  extra rows past its serving slots to retain popular prompt prefixes;
+  :meth:`copy_slot` is the one compiled row-copy both directions share
+  (register: slot → pool row; hit: pool row → fresh slot) and
+  :meth:`front_view`/:meth:`advance_front` keep the decode batch off
+  the pool rows.
 
 Everything is functional: updates return a new :class:`KVCache` whose
 buffers alias the old ones under jit donation (the engine donates the
@@ -146,11 +152,55 @@ class KVCache:
         lengths = self.lengths.at[slot].set(jnp.asarray(length, jnp.int32))
         return self.replace(k=k, v=v, lengths=lengths)
 
+    def copy_slot(self, src, dst, length) -> "KVCache":
+        """Row copy for prefix reuse: slot ``src``'s full K/V row →
+        slot ``dst``, whose length becomes ``length``. ``src``/``dst``/
+        ``length`` may be traced int32 scalars — the engine's one
+        compiled copy program serves every (donor, destination, matched
+        length) triple. The copy is the full ``max_len`` window (slice
+        sizes must be static under jit); positions past ``length`` carry
+        donor garbage that is never attended (length masking) and is
+        overwritten as chunk prefill resumes at ``length`` — the same
+        contract prefill padding already lives by. ``src``'s own length
+        is untouched."""
+        k_row, v_row = self.slot_view(src)
+        dst = jnp.asarray(dst, jnp.int32)
+        start = (jnp.int32(0), dst, jnp.int32(0), jnp.int32(0),
+                 jnp.int32(0))
+        k = jax.lax.dynamic_update_slice(self.k, k_row, start)
+        v = jax.lax.dynamic_update_slice(self.v, v_row, start)
+        lengths = self.lengths.at[dst].set(jnp.asarray(length, jnp.int32))
+        return self.replace(k=k, v=v, lengths=lengths)
+
     def model_view(self):
         """The ``(k, v)`` pair the model's decode path consumes
         (``[layers, slots, heads, max_len, head_dim]`` — already the
         cache layout; slots are the decode batch)."""
         return self.k, self.v
+
+    def front_view(self, n: int):
+        """The first ``n`` slot rows as a decode cache (``[layers, n,
+        heads, max_len, head_dim]``; ``n`` static). An engine with a
+        prefix pool reserves rows ``[n, slots)`` for retained prefixes —
+        the decode batch must neither compute over nor advance them."""
+        return self.k[:, :n], self.v[:, :n]
+
+    def advance_front(self, k_front, v_front, active) -> "KVCache":
+        """:meth:`advance` over the first ``k_front.shape[1]`` rows
+        only: commit the model-returned decode stacks back into the full
+        arrays (prefix-pool rows untouched) and grow the active front
+        lengths."""
+        n = k_front.shape[1]
+        start = (jnp.int32(0),) * 5
+        k = jax.lax.dynamic_update_slice(
+            self.k, jnp.asarray(k_front, self.k.dtype), start)
+        v = jax.lax.dynamic_update_slice(
+            self.v, jnp.asarray(v_front, self.v.dtype), start)
+        front = self.lengths[:n]
+        grow = jnp.asarray(active, bool) & (front < self.max_len)
+        lengths = self.lengths.at[:n].set(
+            jnp.where(grow, front + 1, front))
+        return self.replace(k=k, v=v, lengths=lengths)
 
     def advance(self, k, v, active) -> "KVCache":
         """Absorb a decode step: ``k``/``v`` are the model-returned

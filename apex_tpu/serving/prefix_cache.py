@@ -1,0 +1,295 @@
+"""Content-addressed KV prefix reuse — the serving engine's prompt cache.
+
+Real traffic is dominated by shared prompt prefixes (system prompts,
+few-shot templates, multi-turn history); without reuse every request
+re-runs chunk prefill over tokens whose K/V already sit byte-identical
+in another row of the cache. This module is the host-side index that
+eliminates that recompute:
+
+- **Content addressing**: a retained prefix is keyed by a *rolling hash
+  over token blocks* — block ``i``'s key folds block ``i-1``'s, so key
+  ``H_i`` identifies the entire ``(i+1)``-block prefix and matching a
+  new prompt is one incremental walk over its blocks. Blocks are
+  ``block_len`` tokens, aligned to the engine's ``chunk_len``: a match
+  always ends on a chunk boundary, so the remaining suffix drops
+  straight into the *existing* per-row-offset chunk-prefill program at
+  the matched offset — reuse composes with chunked prefill and the
+  chunk computations that produced the donor K/V are bitwise identical
+  to the ones the cold path would run.
+- **Storage**: matched prefixes live in *pool rows* — cache rows the
+  engine reserves past its serving slots (``Engine(prefix_pool=N)``).
+  Registration copies a completed prompt's block-aligned K/V from its
+  serving slot into a pool row through the engine's one compiled
+  row-copy program; a hit copies it back into the admitted slot the
+  same way.
+- **Refcounts + LRU**: every hit pins its donor entry (``acquire``)
+  until the request leaves its slot (``release``); eviction is
+  least-recently-used over entries at refcount 0 only — a prefix in use
+  by a live slot is never evicted. When every entry is pinned and the
+  pool is full, registration degrades gracefully: the request is served
+  cold and a ``pool_full`` tick is counted, nothing crashes.
+- **Exactness**: hash keys are a lookup accelerator, not the source of
+  truth — every match is verified token-for-token against the entry's
+  retained tokens before it is trusted, so a hash collision can only
+  cost a miss, never a wrong-token hit. Matches are additionally capped
+  below the full prompt (``aligned(n - 1)``): at least the final block
+  always runs through chunk prefill, because that program — not the
+  copy — samples the request's first output token.
+
+The class is pure host bookkeeping (dicts and counters); all device
+work happens in the engine's copy program, injected per call as
+``copy_fn``. Telemetry is the caller's job (the scheduler mirrors
+:meth:`stats` into ``serving.prefix.*``); the raw counters here keep the
+class importable without a registry.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from apex_tpu.log_util import get_logger
+
+__all__ = ["PrefixCache", "PrefixMatch"]
+
+_logger = get_logger("serving")
+
+
+def _roll(h: int, block: Tuple[int, ...]) -> int:
+    """One step of the rolling block hash: fold the previous blocks'
+    key with this block's tokens. Host-local (python ``hash``), so it
+    needs no cross-process stability — collisions are tolerated because
+    every lookup is verified against the entry's retained tokens."""
+    return hash((h,) + block)
+
+
+@dataclasses.dataclass
+class _Entry:
+    """One retained prefix: ``tokens`` (the full block-aligned prefix)
+    living in cache row ``row``; ``refcount`` pins it against eviction
+    while a live slot's admission copied from it."""
+
+    row: int
+    tokens: Tuple[int, ...]
+    n_blocks: int
+    refcount: int = 0
+    last_used: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class PrefixMatch:
+    """A verified admission-time hit: copy ``length`` positions from
+    cache row ``row`` (then :meth:`PrefixCache.acquire` it for the
+    request's slot lifetime)."""
+
+    row: int
+    length: int
+
+
+class PrefixCache:
+    """Host-side index of retained prompt prefixes (see module
+    docstring). ``block_len`` must equal the engine's ``chunk_len``;
+    ``pool_rows`` are the cache row ids reserved for retained prefixes
+    (the engine hands over ``[slots, slots + prefix_pool)``)."""
+
+    def __init__(self, *, block_len: int, pool_rows: Sequence[int]):
+        if block_len < 1:
+            raise ValueError("block_len must be >= 1")
+        self.block_len = int(block_len)
+        self.pool_rows: List[int] = list(pool_rows)
+        if len(set(self.pool_rows)) != len(self.pool_rows):
+            raise ValueError("pool_rows must be distinct")
+        self._free: List[int] = list(self.pool_rows)
+        self._entries: Dict[int, _Entry] = {}        # row -> entry
+        self._index: Dict[int, Tuple[int, int]] = {}  # key -> (row, blocks)
+        self._clock = itertools.count(1)
+        # raw counters (the scheduler mirrors them into serving.prefix.*)
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.pool_full = 0
+        self.tokens_reused = 0
+        self.registrations = 0
+
+    # ------------------------------------------------------------- geometry
+    @property
+    def capacity(self) -> int:
+        return len(self.pool_rows)
+
+    @property
+    def size(self) -> int:
+        return len(self._entries)
+
+    @property
+    def hit_rate(self) -> float:
+        """Hits over admissions consulted so far (0.0 before the first)."""
+        n = self.hits + self.misses
+        return self.hits / n if n else 0.0
+
+    # -------------------------------------------------------------- hashing
+    def block_keys(self, tokens: Sequence[int], n_blocks: int) -> List[int]:
+        """The first ``n_blocks`` rolling keys of ``tokens`` — ``H_i``
+        covers blocks ``[0, i]`` (``(i+1) * block_len`` tokens)."""
+        keys, h = [], 0
+        for i in range(n_blocks):
+            block = tuple(int(t) for t in
+                          tokens[i * self.block_len:(i + 1) * self.block_len])
+            h = _roll(h, block)
+            keys.append(h)
+        return keys
+
+    # ------------------------------------------------------------- matching
+    def match(self, prompt: Sequence[int]) -> Optional[PrefixMatch]:
+        """Longest cached block-aligned prefix of ``prompt``, verified
+        token-for-token; None on a miss. The match never covers the
+        whole prompt (cap ``aligned(n - 1)``): the final block must run
+        through chunk prefill so its logits produce the request's first
+        token. Counts toward :attr:`hit_rate` either way."""
+        n = len(prompt)
+        max_blocks = (n - 1) // self.block_len       # strictly < n tokens
+        best: Optional[PrefixMatch] = None
+        h = 0
+        for i in range(max_blocks):
+            block = tuple(int(t) for t in
+                          prompt[i * self.block_len:(i + 1) * self.block_len])
+            h = _roll(h, block)
+            hit = self._index.get(h)
+            if hit is None:
+                continue
+            row, blocks = hit
+            entry = self._entries.get(row)
+            length = blocks * self.block_len
+            if entry is None or len(entry.tokens) < length:
+                continue
+            # hash keys accelerate, tokens decide: a collision (or an
+            # entry the key outlived) can only cost a miss here
+            if tuple(entry.tokens[:length]) != tuple(
+                    int(t) for t in prompt[:length]):
+                continue
+            best = PrefixMatch(row=row, length=length)
+        if best is None:
+            self.misses += 1
+            return None
+        self.hits += 1
+        self.tokens_reused += best.length
+        entry = self._entries[best.row]
+        entry.last_used = next(self._clock)
+        return best
+
+    # ------------------------------------------------------------ refcounts
+    def acquire(self, match: PrefixMatch) -> None:
+        """Pin the matched entry while the admitted request occupies its
+        slot (the scheduler releases on request finish/eviction)."""
+        self._entries[match.row].refcount += 1
+
+    def release(self, match: PrefixMatch) -> None:
+        entry = self._entries.get(match.row)
+        if entry is not None and entry.refcount > 0:
+            entry.refcount -= 1
+
+    # ---------------------------------------------------------- registration
+    def register(self, prompt: Sequence[int],
+                 copy_fn: Callable[[int, int], None]) -> str:
+        """Retain ``prompt``'s block-aligned prefix. ``copy_fn(row,
+        length)`` runs the engine's row-copy program (serving slot →
+        pool row ``row``) and is called at most once, only after a row
+        is secured. Returns the outcome:
+
+        - ``"registered"`` — a pool row was (re)filled with the prefix;
+        - ``"duplicate"`` — the exact prefix is already retained (LRU
+          refreshed, no copy);
+        - ``"too_short"`` — the prompt spans no full block;
+        - ``"pool_full"`` — every row is held by a pinned (refcount > 0)
+          entry: the graceful-degradation path, nothing was evicted.
+        """
+        n_blocks = len(prompt) // self.block_len
+        if n_blocks == 0:
+            return "too_short"
+        length = n_blocks * self.block_len
+        keys = self.block_keys(prompt, n_blocks)
+        hit = self._index.get(keys[-1])
+        if hit is not None:
+            row, blocks = hit
+            entry = self._entries.get(row)
+            if entry is not None and blocks == n_blocks and tuple(
+                    entry.tokens[:length]) == tuple(
+                    int(t) for t in prompt[:length]):
+                entry.last_used = next(self._clock)
+                return "duplicate"
+        row = self._take_row()
+        if row is None:
+            self.pool_full += 1
+            return "pool_full"
+        try:
+            copy_fn(row, length)
+        except BaseException:
+            self._free.append(row)       # don't leak the row on a failed copy
+            raise
+        entry = _Entry(row=row, tokens=tuple(int(t) for t in prompt[:length]),
+                       n_blocks=n_blocks, last_used=next(self._clock))
+        self._entries[row] = entry
+        for i, key in enumerate(keys):
+            # shorter-prefix keys already owned by another entry keep
+            # their owner (it is just as valid a donor); this entry
+            # claims every depth not yet addressed
+            if key not in self._index:
+                self._index[key] = (row, i + 1)
+        self.registrations += 1
+        return "registered"
+
+    def _take_row(self) -> Optional[int]:
+        """A free pool row, evicting the least-recently-used refcount-0
+        entry when none is free; None when every entry is pinned."""
+        if self._free:
+            return self._free.pop()
+        victims = [e for e in self._entries.values() if e.refcount == 0]
+        if not victims:
+            return None
+        victim = min(victims, key=lambda e: e.last_used)
+        self._evict(victim)
+        return victim.row
+
+    def _evict(self, entry: _Entry) -> None:
+        del self._entries[entry.row]
+        for key, (_, blocks) in [(k, v) for k, v in self._index.items()
+                                 if v[0] == entry.row]:
+            # a shorter shared prefix the victim addressed may still be
+            # resident inside a surviving longer entry — rebind instead
+            # of orphaning the depth (keeps "longest cached prefix"
+            # true after churn)
+            heir = next(
+                (e for e in self._entries.values()
+                 if e.n_blocks >= blocks and e.tokens[:blocks
+                    * self.block_len] == entry.tokens[:blocks
+                    * self.block_len]), None)
+            if heir is None:
+                del self._index[key]
+            else:
+                self._index[key] = (heir.row, blocks)
+        self.evictions += 1
+        _logger.debug("prefix cache evicted %d-block prefix from row %d",
+                      entry.n_blocks, entry.row)
+
+    # ------------------------------------------------------------- lifecycle
+    def clear(self) -> None:
+        """Drop every entry and index key (counters survive — they are
+        run-scoped, not cache-scoped)."""
+        self._entries.clear()
+        self._index.clear()
+        self._free = list(self.pool_rows)
+
+    def stats(self) -> dict:
+        """One host-side snapshot of the cache's counters and occupancy
+        (the scheduler mirrors this into ``serving.prefix.*``)."""
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "hit_rate": self.hit_rate,
+            "tokens_reused": self.tokens_reused,
+            "evictions": self.evictions,
+            "pool_full": self.pool_full,
+            "registrations": self.registrations,
+            "entries": self.size,
+            "capacity": self.capacity,
+        }

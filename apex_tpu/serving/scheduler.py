@@ -10,7 +10,13 @@ beats:
    with status ``"timeout"`` (their slot frees immediately);
 2. **admit** — while a slot is free and the queue is non-empty, pop the
    oldest request into the slot as *prefilling* (its queue wait ends
-   here — the first half of the TTFT decomposition);
+   here — the first half of the TTFT decomposition). With
+   ``retain_prefixes=True`` admission first consults the engine's
+   :class:`~apex_tpu.serving.PrefixCache`: the longest cached
+   block-aligned prefix of the prompt is restored into the slot by one
+   compiled KV row-copy, the donor entry is refcount-pinned for the
+   slot's lifetime, and chunk prefill resumes at the matched offset —
+   every matched chunk is attention+MLP compute that never runs;
 3. **chunk prefill** — at most ``chunk_budget`` (default 1) compiled
    chunk-prefill steps across the prefilling slots, round-robin. A
    prompt of P tokens ingests over ``ceil(P / chunk_len)`` heartbeats;
@@ -38,6 +44,14 @@ caller that outruns the engine gets a typed rejection to retry/shed —
 never an unbounded host-side pileup. (:meth:`run` absorbs the same
 signal by stepping the engine until space frees.)
 
+Prefix registration is the write half: when a retained-prefix run's
+prompt finishes chunk prefill, its block-aligned K/V is copied into a
+pool row (capacity-bounded; LRU eviction only at refcount 0; a full,
+fully-pinned pool degrades gracefully to the cold path — the request is
+served, just without retention). Both halves are chunked-path only:
+``retain_prefixes=True`` requires ``chunked=True`` (monolithic prefill
+cannot resume mid-prompt) and an engine built with ``prefix_pool > 0``.
+
 Telemetry (through the shared :class:`~apex_tpu.telemetry
 .MetricsRegistry`): ``serving.ttft_s`` decomposed into
 ``serving.queue_wait_s`` (submit → admission) + per-chunk
@@ -45,8 +59,12 @@ Telemetry (through the shared :class:`~apex_tpu.telemetry
 ``serving.decode.step_s`` histograms (p50/p95/p99 via the streaming
 reservoir), ``serving.slot_occupancy`` / ``serving.padding_waste`` per
 step, request outcome counters, one ``serving.request``-tagged
-completion record per request (with ``chunks_per_prompt``), and a final
-``serving.tokens_per_s`` gauge from :meth:`run`.
+completion record per request (with ``chunks_per_prompt`` and
+``reused_tokens``), a final ``serving.tokens_per_s`` gauge from
+:meth:`run`, and the prefix-reuse layer: ``serving.prefix.hits`` /
+``.misses`` / ``.hit_rate`` (gauge), ``serving.prefix.tokens_reused``,
+``serving.prefix.chunks_skipped``, ``serving.prefix.evictions``,
+``serving.prefix.registrations`` and ``serving.prefix.pool_full``.
 """
 
 from __future__ import annotations
@@ -86,7 +104,10 @@ class Request:
     ``"max_new_tokens"`` / ``"max_len"`` / ``"timeout"``), ``ttft_s``
     and its decomposition ``queue_wait_s`` (submit → admission) +
     ``prefill_s`` (summed chunk/prefill compute), ``chunks`` (prefill
-    steps the prompt took; 1 on the monolithic path), ``latency_s``.
+    steps the prompt took; 1 on the monolithic path),
+    ``reused_tokens`` (prompt positions restored from the prefix cache
+    instead of prefilled; 0 on a miss or with retention off),
+    ``latency_s``.
     """
 
     prompt: Sequence[int]
@@ -103,6 +124,7 @@ class Request:
     queue_wait_s: Optional[float] = None
     prefill_s: float = 0.0
     chunks: int = 0
+    reused_tokens: int = 0
     latency_s: Optional[float] = None
     _t_submit: Optional[float] = dataclasses.field(default=None,
                                                    repr=False)
@@ -116,17 +138,29 @@ class Scheduler:
     def __init__(self, engine, *, max_queue: int = 64,
                  default_timeout_s: Optional[float] = None,
                  eos_id: Optional[int] = None, registry=None,
-                 chunked: bool = True, chunk_budget: int = 1):
+                 chunked: bool = True, chunk_budget: int = 1,
+                 retain_prefixes: bool = False):
         if max_queue < 1:
             raise ValueError("max_queue must be >= 1")
         if chunk_budget < 1:
             raise ValueError("chunk_budget must be >= 1")
+        if retain_prefixes:
+            if not chunked:
+                raise ValueError(
+                    "retain_prefixes requires chunked=True: prefix reuse"
+                    " resumes prefill mid-prompt, which the monolithic "
+                    "program cannot do")
+            if getattr(engine, "prefix_cache", None) is None:
+                raise ValueError(
+                    "retain_prefixes requires an engine built with "
+                    "prefix_pool > 0 (no pool rows to retain into)")
         self.engine = engine
         self.max_queue = int(max_queue)
         self.default_timeout_s = default_timeout_s
         self.eos_id = eos_id
         self.chunked = bool(chunked)
         self.chunk_budget = int(chunk_budget)
+        self.retain_prefixes = bool(retain_prefixes)
         self.registry = registry if registry is not None \
             else getattr(engine, "_registry", None)
         self._queue: collections.deque = collections.deque()
@@ -134,6 +168,8 @@ class Scheduler:
         self._last_tokens = np.zeros(engine.slots, np.int32)
         self._temps = np.zeros(engine.slots, np.float32)
         self._pf_rr = 0           # round-robin start for chunk budgeting
+        # per-slot pinned prefix match (released when the slot frees)
+        self._slot_prefix: List[Optional[object]] = [None] * engine.slots
         self.completed: List[Request] = []
 
     # ------------------------------------------------------------ ingestion
@@ -171,6 +207,10 @@ class Scheduler:
         if slot is not None:
             self._running[slot] = None
             self._temps[slot] = 0.0
+            if self._slot_prefix[slot] is not None:
+                # the slot no longer reads from its donor prefix: unpin
+                self.engine.prefix_cache.release(self._slot_prefix[slot])
+                self._slot_prefix[slot] = None
         self.completed.append(request)
         if self.registry is not None:
             key = ("serving.requests.timeout" if reason == "timeout"
@@ -187,6 +227,7 @@ class Scheduler:
                 "prompt_tokens": len(request.prompt),
                 "output_tokens": len(request.output_tokens),
                 "chunks_per_prompt": request.chunks,
+                "reused_tokens": request.reused_tokens,
                 "queue_wait_s": request.queue_wait_s,
                 "prefill_s": request.prefill_s,
                 "ttft_s": request.ttft_s,
@@ -228,8 +269,37 @@ class Scheduler:
                                       r.queue_wait_s)
             r.status = "prefilling"
             r._prefill_pos = 0
+            if self.retain_prefixes:
+                self._consult_prefix_cache(r, slot)
             self._running[slot] = r
             self._temps[slot] = r.temperature
+
+    def _consult_prefix_cache(self, r: Request, slot: int) -> None:
+        """Admission-time read path: restore the longest cached
+        block-aligned prefix of ``r.prompt`` into ``slot`` (one compiled
+        row-copy) and pin the donor entry for the slot's lifetime; chunk
+        prefill then resumes at the matched offset. A miss changes
+        nothing — the request prefills cold from offset 0."""
+        pcache = self.engine.prefix_cache
+        m = pcache.match(r.prompt)
+        if m is not None:
+            self.engine.restore_prefix(slot, m.row, m.length)
+            pcache.acquire(m)
+            self._slot_prefix[slot] = m
+            r._prefill_pos = m.length
+            r.reused_tokens = m.length
+        if self.registry is not None:
+            if m is None:
+                self.registry.counter_inc("serving.prefix.misses")
+            else:
+                self.registry.counter_inc("serving.prefix.hits")
+                self.registry.counter_inc("serving.prefix.tokens_reused",
+                                          m.length)
+                self.registry.counter_inc(
+                    "serving.prefix.chunks_skipped",
+                    m.length // self.engine.chunk_len)
+            self.registry.gauge_set("serving.prefix.hit_rate",
+                                    pcache.hit_rate)
 
     def _admit_monolithic(self) -> None:
         """Legacy admit (``chunked=False``): whole-prompt prefill at
@@ -301,6 +371,8 @@ class Scheduler:
             self._pf_rr = (slot + 1) % slots
             if not final:
                 continue
+            if self.retain_prefixes:
+                self._register_prefix(r, slot)
             r.ttft_s = time.perf_counter() - r._t_submit
             if self.registry is not None:
                 self.registry.observe("serving.ttft_s", r.ttft_s)
@@ -317,6 +389,29 @@ class Scheduler:
                 r.status = "running"
                 self._last_tokens[slot] = token
         return ran
+
+    def _register_prefix(self, r: Request, slot: int) -> None:
+        """Write path, at prompt-ingestion completion: retain the
+        prompt's block-aligned K/V prefix (now fully resident in
+        ``slot``) in a pool row via the same compiled row-copy.
+        Capacity-bounded: a full pool evicts its LRU refcount-0 entry;
+        a fully-pinned pool skips retention (graceful degradation — the
+        request is unaffected)."""
+        pcache = self.engine.prefix_cache
+        before = pcache.evictions
+        outcome = pcache.register(
+            r.prompt,
+            lambda row, length: self.engine.store_prefix(row, slot,
+                                                         length))
+        if self.registry is not None:
+            evicted = pcache.evictions - before
+            if evicted:
+                self.registry.counter_inc("serving.prefix.evictions",
+                                          evicted)
+            if outcome == "registered":
+                self.registry.counter_inc("serving.prefix.registrations")
+            elif outcome == "pool_full":
+                self.registry.counter_inc("serving.prefix.pool_full")
 
     # ------------------------------------------------------------- stepping
     def step(self) -> bool:

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 
 __all__ = ["get_ltor_masks_and_position_ids", "listify_model"]
@@ -67,8 +68,11 @@ def get_ltor_masks_and_position_ids(
         # or 0 in the first document.
         pos = jnp.arange(seq)[None, :]
         prev_is_eod = jnp.pad(is_eod[:, :-1], ((0, 0), (1, 0)))
-        doc_start = jnp.maximum.accumulate(
-            jnp.where(prev_is_eod, pos, 0), axis=-1)
+        # lax.cummax == jnp.maximum.accumulate, but exists on every jax
+        # this library targets (the ufunc .accumulate methods do not);
+        # axis must be non-negative for the primitive
+        doc_start = jax.lax.cummax(
+            jnp.where(prev_is_eod, pos, 0), axis=1)
         position_ids = position_ids - doc_start
 
     return attention_mask, loss_mask, position_ids

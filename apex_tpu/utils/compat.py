@@ -1,0 +1,59 @@
+"""jax version compatibility shims.
+
+The axon TPU toolchain ships a jax with top-level :func:`jax.shard_map`
+whose keyword for disabling the varying-manual-axes check is
+``check_vma``; older hermetic jax builds (e.g. 0.4.x CPU containers)
+only have ``jax.experimental.shard_map.shard_map`` and spell the same
+switch ``check_rep``. Library, bench and test call sites all use the
+axon idiom (``check_vma=False``); this module resolves ONE callable at
+import time that accepts it everywhere:
+
+- ``jax.shard_map`` exists → returned untouched (the axon fast path).
+- only the experimental fallback exists → wrapped so ``check_vma=`` is
+  translated to ``check_rep=`` when the signature has it, or silently
+  dropped when it has neither.
+
+Route module-level imports through here instead of ``from jax import
+shard_map`` — on a jax without the top-level symbol that import is an
+ImportError at *collection* time, which is how 13 test files used to
+error out before running a single test.
+
+Usage::
+
+    from apex_tpu.utils.compat import shard_map
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+
+__all__ = ["shard_map"]
+
+
+def _resolve_shard_map():
+    import jax
+
+    fn = getattr(jax, "shard_map", None)
+    if fn is None:
+        from jax.experimental.shard_map import shard_map as fn
+    try:
+        params = inspect.signature(fn).parameters
+    except (TypeError, ValueError):    # C-accelerated / unsignaturable
+        return fn
+    if "check_vma" in params:
+        return fn
+    translate = "check_rep" in params
+
+    @functools.wraps(fn)
+    def _shard_map(*args, **kwargs):
+        if "check_vma" in kwargs:
+            vma = kwargs.pop("check_vma")
+            if translate and "check_rep" not in kwargs:
+                kwargs["check_rep"] = vma
+        return fn(*args, **kwargs)
+
+    return _shard_map
+
+
+shard_map = _resolve_shard_map()

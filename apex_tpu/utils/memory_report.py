@@ -267,7 +267,7 @@ def resnet50_o2_ddp_step(batch_per_chip: int = 256, n_chips: int = 8,
     import jax.numpy as jnp
     import optax
 
-    from jax import shard_map
+    from apex_tpu.utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from apex_tpu import amp

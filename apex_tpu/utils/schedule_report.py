@@ -145,7 +145,7 @@ def ddp_step_program(n_layers: int = 6, width: int = 512,
     parameter, so the N=1 baseline and the accumulation program can
     never drift apart while their all-reduce counts are being compared
     (bench_schedule.py ddp_accum, tests/tpu)."""
-    from jax import shard_map
+    from apex_tpu.utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from apex_tpu import amp
@@ -198,7 +198,7 @@ def pipeline_1f1b_program(pp: int = 8, microbatches: int = 16,
     """The actual hand-scheduled 1F1B (pipeline_parallel.schedules.
     forward_backward_1f1b) over an 8-stage 'pipe' mesh. Returns
     (fn, avals)."""
-    from jax import shard_map
+    from apex_tpu.utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from apex_tpu.transformer import pipeline_parallel as pp_mod
@@ -229,7 +229,7 @@ def ring_attention_program(context: int = 8, b: int = 1, h: int = 4,
     (transformer.context_parallel.ring_attention) over an 8-chip
     'context' mesh — the long-context tier's KV rotation. Returns
     (fn, avals)."""
-    from jax import shard_map
+    from apex_tpu.utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from apex_tpu.transformer.context_parallel import ring_attention
@@ -255,7 +255,7 @@ def ulysses_attention_program(context: int = 8, b: int = 1, h: int = 8,
     """The actual Ulysses (all-to-all) sequence-parallel attention
     fwd+bwd (transformer.context_parallel.ulysses_attention) over an
     8-chip 'context' mesh. Returns (fn, avals)."""
-    from jax import shard_map
+    from apex_tpu.utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from apex_tpu.transformer.context_parallel import ulysses_attention
@@ -280,7 +280,7 @@ def zero_update_program(width: int = 1024, n_layers: int = 4):
     """The contrib ZeRO update's collective skeleton (psum_scatter the
     grads, shard-local math, all_gather the params) over an 8-way 'data'
     mesh. Returns (fn, avals)."""
-    from jax import shard_map
+    from apex_tpu.utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     mesh = topology_mesh({"data": 8})

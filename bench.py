@@ -33,13 +33,15 @@ import os
 import tempfile
 import time
 
-import jax
-import jax.numpy as jnp
-import optax
-
-from apex_tpu import amp, pyprof
-from apex_tpu.amp.policy import resolve_policy
-from apex_tpu.models.resnet import create_model
+# NOTHING heavy imports at module level: the guard contract (every run,
+# including one that exhausts its transient retries, ends in a parseable
+# JSON line) only holds for failures raised INSIDE guarded main() — a
+# module-level jax/optax import crash or a malformed BENCH_* env value
+# parsed at import time dies before the guard is armed and leaves a raw
+# traceback as the last output (the BENCH_r05 '"parsed": null' shape).
+# Heavy imports and env parsing therefore live in main(); a retry re-runs
+# them from scratch, which is exactly what a transient backend hiccup
+# needs.
 
 METRIC = "resnet50_amp_o2_train_img_per_sec_per_chip"
 
@@ -66,27 +68,40 @@ def peak_flops(device) -> float:
             return peak
     return 394e12
 
-# 256/chip is the apex-recipe production batch for ResNet-50 amp O2 (NVIDIA
-# DeepLearningExamples uses 256/V100-32G; a v5e's 16GB holds it in bf16) and
-# large enough that step time is compute- rather than dispatch-bound.
-BATCH = int(os.environ.get("BENCH_BATCH", "256"))
-IMAGE = int(os.environ.get("BENCH_IMAGE", "224"))
-WARMUP = int(os.environ.get("BENCH_WARMUP", "2"))
-STEPS = int(os.environ.get("BENCH_STEPS", "10"))
-# VERDICT round-2 weak #1: a single 10-step sample carried no variance
-# information, so a 16.6% tracker move between rounds was unexplainable.
-# Measure >=3 independent windows and report median + min + spread so one
-# JSON line carries its own noise bars.
-WINDOWS = int(os.environ.get("BENCH_WINDOWS", "3"))
-# Device-anchored windows: profiler captures of STEPS steps each whose
-# device-lane span times the silicon itself (basis: "device_trace").
-TRACE_WINDOWS = int(os.environ.get("BENCH_TRACE_WINDOWS", "3"))
-# In-jit microbatch accumulation (amp.make_train_step accum_steps):
-# BENCH_ACCUM_STEPS=N scans N microbatches of BATCH/N per optimizer step,
-# paying ONE unscale + optimizer + scaler pass per window — the
-# delay_unscale recipe's throughput leg. Each jit_step still consumes
-# BATCH images, so img/s stays directly comparable to the N=1 rows.
-ACCUM_STEPS = int(os.environ.get("BENCH_ACCUM_STEPS", "1"))
+def _env_int(name: str, default: str) -> int:
+    """BENCH_* env knob as int; a malformed value becomes a clean
+    SystemExit INSIDE the guard (one parseable failure line) instead of
+    an import-time ValueError before the guard is armed."""
+    raw = os.environ.get(name, default)
+    try:
+        return int(raw)
+    except ValueError:
+        raise SystemExit(f"{name}={raw!r} is not an integer")
+
+
+def _read_env() -> dict:
+    """All BENCH_* knobs, parsed at main() time (guarded, retry-fresh).
+
+    BENCH_BATCH default 256/chip: the apex-recipe production batch for
+    ResNet-50 amp O2 (NVIDIA DeepLearningExamples uses 256/V100-32G; a
+    v5e's 16GB holds it in bf16) and large enough that step time is
+    compute- rather than dispatch-bound. BENCH_WINDOWS >=3 independent
+    windows reported as median+min+spread (VERDICT round-2 weak #1: one
+    10-step sample carried no variance information).
+    BENCH_TRACE_WINDOWS: device-anchored profiler captures (basis:
+    "device_trace"). BENCH_ACCUM_STEPS=N scans N microbatches of
+    BATCH/N per optimizer step (amp.make_train_step accum_steps) —
+    each jit_step still consumes BATCH images, so img/s stays directly
+    comparable to the N=1 rows."""
+    return {
+        "BATCH": _env_int("BENCH_BATCH", "256"),
+        "IMAGE": _env_int("BENCH_IMAGE", "224"),
+        "WARMUP": _env_int("BENCH_WARMUP", "2"),
+        "STEPS": _env_int("BENCH_STEPS", "10"),
+        "WINDOWS": _env_int("BENCH_WINDOWS", "3"),
+        "TRACE_WINDOWS": _env_int("BENCH_TRACE_WINDOWS", "3"),
+        "ACCUM_STEPS": _env_int("BENCH_ACCUM_STEPS", "1"),
+    }
 
 
 def _median(xs):
@@ -96,6 +111,20 @@ def _median(xs):
 
 
 def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from apex_tpu import amp, pyprof
+    from apex_tpu.amp.policy import resolve_policy
+    from apex_tpu.models.resnet import create_model
+
+    env = _read_env()
+    BATCH, IMAGE, WARMUP, STEPS = (env["BATCH"], env["IMAGE"],
+                                   env["WARMUP"], env["STEPS"])
+    WINDOWS, TRACE_WINDOWS = env["WINDOWS"], env["TRACE_WINDOWS"]
+    ACCUM_STEPS = env["ACCUM_STEPS"]
+
     # APEX_TPU_TELEMETRY=run.jsonl|stdout streams per-step telemetry
     # (loss/grad_norm/scaler trajectory + step_time_s) from inside the
     # jitted step; unset costs nothing (telemetry baked out at trace time)
@@ -212,6 +241,22 @@ def main():
 
 if __name__ == "__main__":
     # crash contract: any failure still ends in one parseable JSON line
-    # ({"metric", "error", "rc": 1}) — no more "parsed": null bench rows
-    from apex_tpu.telemetry import guard_bench_main
+    # ({"metric", "error", "rc": 1}) — no more "parsed": null bench rows.
+    # Arming the guard must itself be failure-proof: if importing the
+    # telemetry package dies (broken env, half-installed deps), fall back
+    # to a stdlib-only failure line so the contract holds even then.
+    try:
+        from apex_tpu.telemetry import guard_bench_main
+    except KeyboardInterrupt:
+        raise
+    except BaseException as e:  # noqa: BLE001 — the contract is total
+        import sys
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        sys.stdout.write(json.dumps({
+            "metric": METRIC, "error": f"{type(e).__name__}: {e}",
+            "rc": 1, "transient": False}) + "\n")
+        sys.stdout.flush()
+        raise SystemExit(1)
     guard_bench_main(main, METRIC)

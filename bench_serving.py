@@ -38,6 +38,20 @@ the TPU rows'. ``BENCH_SERVING_CHUNK_BUDGET`` (default 1) trades the
 per-tick stall bound against admission throughput (Sarathi's
 token-budget knob).
 
+``--shared-prefix`` runs the prefix-caching leg: a repeated-system-
+prompt stream (every request opens with the same
+``BENCH_SERVING_SHARED_PREFIX``-token prefix — the shape of real
+templated traffic) served twice on identical engine geometry — cold
+(``retain_prefixes=False``) vs cached (``retain_prefixes=True``,
+``BENCH_SERVING_PREFIX_POOL`` pool rows) — emitting one row per mode
+and a final line whose payoff fields are ``prefix_hit_rate``,
+``prefill_chunks_skipped_pct`` (telemetry-counted chunk-prefill steps
+that never executed — a compute count, honest on the CPU fallback,
+unlike the decode-regime claims), TTFT p50/p99 both modes, and
+``token_mismatched_requests`` (both modes are greedy, and the copied
+prefix K/V is byte-identical to freshly prefilled K/V, so the expected
+reading is 0 — bitwise, not approximately).
+
 Wrapped in ``guard_bench_main`` — EVERY outcome (backend init failure,
 OOM, bad env) still ends in a parseable JSON line.
 """
@@ -53,19 +67,60 @@ import numpy as np
 
 METRIC = "serving_decode_tokens_per_sec"
 MIXED_METRIC = "serving_mixed_prompts_tokens_per_sec"
+SHARED_METRIC = "serving_shared_prefix_tokens_per_sec"
 
-SIZE = os.environ.get("BENCH_SERVING_SIZE", "small")
-VOCAB = int(os.environ.get("BENCH_SERVING_VOCAB", "32768"))
-SLOTS = int(os.environ.get("BENCH_SERVING_SLOTS", "8"))
-MAX_LEN = int(os.environ.get("BENCH_SERVING_MAX_LEN", "512"))
-PREFILL_LEN = int(os.environ.get("BENCH_SERVING_PREFILL", "128"))
-CHUNK_LEN = int(os.environ.get("BENCH_SERVING_CHUNK", "0"))  # 0 = default
-REQUESTS = int(os.environ.get("BENCH_SERVING_REQUESTS", "24"))
-NEW_TOKENS = int(os.environ.get("BENCH_SERVING_NEW_TOKENS", "64"))
-WINDOWS = int(os.environ.get("BENCH_SERVING_WINDOWS", "3"))
-TOP_K = int(os.environ.get("BENCH_SERVING_TOP_K", "0"))
-SHORT_LEN = int(os.environ.get("BENCH_SERVING_SHORT", "16"))
-CHUNK_BUDGET = int(os.environ.get("BENCH_SERVING_CHUNK_BUDGET", "1"))
+# Literal defaults at import time; the BENCH_SERVING_* env overrides are
+# parsed by _load_env() INSIDE each guarded main, so a malformed value
+# becomes guard_bench_main's parseable failure line, not an import-time
+# traceback (the same contract bench.py holds).
+SIZE = "small"
+VOCAB = 32768
+SLOTS = 8
+MAX_LEN = 512
+PREFILL_LEN = 128
+CHUNK_LEN = 0                   # 0 = engine default
+REQUESTS = 24
+NEW_TOKENS = 64
+WINDOWS = 3
+TOP_K = 0
+SHORT_LEN = 16
+CHUNK_BUDGET = 1
+# --shared-prefix leg: shared-system-prompt length (block-aligned reuse
+# wants it a multiple of the chunk), prefix-pool rows, and a chunk_len
+# small enough that one prompt spans several chunks (reuse is counted
+# in whole chunks; the leg defaults chunk to PREFILL/4 when unset)
+SHARED_PREFIX = 96
+PREFIX_POOL = 4
+
+_ENV_KNOBS = {
+    "VOCAB": "BENCH_SERVING_VOCAB", "SLOTS": "BENCH_SERVING_SLOTS",
+    "MAX_LEN": "BENCH_SERVING_MAX_LEN",
+    "PREFILL_LEN": "BENCH_SERVING_PREFILL",
+    "CHUNK_LEN": "BENCH_SERVING_CHUNK",
+    "REQUESTS": "BENCH_SERVING_REQUESTS",
+    "NEW_TOKENS": "BENCH_SERVING_NEW_TOKENS",
+    "WINDOWS": "BENCH_SERVING_WINDOWS", "TOP_K": "BENCH_SERVING_TOP_K",
+    "SHORT_LEN": "BENCH_SERVING_SHORT",
+    "CHUNK_BUDGET": "BENCH_SERVING_CHUNK_BUDGET",
+    "SHARED_PREFIX": "BENCH_SERVING_SHARED_PREFIX",
+    "PREFIX_POOL": "BENCH_SERVING_PREFIX_POOL",
+}
+
+
+def _load_env():
+    """Apply BENCH_SERVING_* overrides (first statement of every guarded
+    main): malformed values die as a clean SystemExit the guard turns
+    into its failure JSON line."""
+    g = globals()
+    g["SIZE"] = os.environ.get("BENCH_SERVING_SIZE", SIZE)
+    for name, var in _ENV_KNOBS.items():
+        raw = os.environ.get(var)
+        if raw is None or not raw.strip():
+            continue
+        try:
+            g[name] = int(raw)
+        except ValueError:
+            raise SystemExit(f"{var}={raw!r} is not an integer")
 
 
 def _median(xs):
@@ -107,7 +162,7 @@ def _mixed_requests(rng):
     return reqs
 
 
-def _build_engine(registry=None):
+def _build_engine(registry=None, prefix_pool=0, chunk_len=None):
     import jax
     import jax.numpy as jnp
 
@@ -120,12 +175,16 @@ def _build_engine(registry=None):
                         train=False)["params"]
     return serving.Engine(model, params, slots=SLOTS, max_len=MAX_LEN,
                           prefill_len=PREFILL_LEN,
-                          chunk_len=CHUNK_LEN or None, top_k=TOP_K,
+                          chunk_len=chunk_len if chunk_len is not None
+                          else (CHUNK_LEN or None),
+                          prefix_pool=prefix_pool, top_k=TOP_K,
                           registry=registry)
 
 
 def main():
     import jax
+
+    _load_env()
 
     from apex_tpu import serving, telemetry
 
@@ -237,6 +296,8 @@ def _ttft_percentiles(reqs, short: bool):
 def main_mixed():
     import jax
 
+    _load_env()
+
     rows = {}
     outputs = {}
     for mode, chunked in (("monolithic", False), ("chunked", True)):
@@ -300,10 +361,155 @@ def main_mixed():
     }))
 
 
+def _shared_prefix_requests(rng):
+    """Repeated-system-prompt arrivals: every prompt opens with THE SAME
+    shared prefix (drawn once per leg from the mode-independent seed)
+    followed by a short unique tail — the traffic shape where
+    content-addressed prefix reuse pays."""
+    from apex_tpu.serving import Request
+
+    shared = _SHARED_TOKENS
+    reqs = []
+    for _ in range(REQUESTS):
+        tail = max(1, PREFILL_LEN - len(shared))
+        n = int(rng.integers(1, tail + 1))
+        prompt = shared + rng.integers(1, VOCAB, size=n).tolist()
+        budget = max(1, min(NEW_TOKENS, MAX_LEN - len(prompt)))
+        reqs.append(Request(prompt=prompt, max_new_tokens=budget))
+    return reqs
+
+
+_SHARED_TOKENS: list = []
+
+
+def _serve_shared(retain: bool, chunk_len: int):
+    """WINDOWS measured windows (plus compile warmup) of the shared-
+    prefix stream; IDENTICAL engine geometry in both modes (the pool is
+    allocated either way) so cold vs cached compare the same compiled
+    programs — only the scheduler's retain_prefixes flag differs."""
+    from apex_tpu import serving, telemetry
+
+    reg = telemetry.MetricsRegistry()
+    engine = _build_engine(prefix_pool=PREFIX_POOL, chunk_len=chunk_len)
+    rng = np.random.default_rng(2)
+    rates, all_reqs, warm_stats = [], [], {}
+    for w in range(WINDOWS + 1):
+        engine.reset()          # retained prefixes survive (warm cache)
+        if w == 1:
+            engine.set_registry(reg)
+            # measured-window accounting starts here: the compile-warmup
+            # window populated the cache (its misses/registrations are
+            # cache construction, not serving behaviour), so the
+            # reported prefix stats are deltas past this snapshot
+            warm_stats = dict(engine.prefix_cache.stats())
+        sched = serving.Scheduler(engine, max_queue=max(REQUESTS, 1),
+                                  registry=reg if w else None,
+                                  chunk_budget=CHUNK_BUDGET,
+                                  retain_prefixes=retain)
+        reqs = _shared_prefix_requests(rng)
+        t0 = time.perf_counter()
+        tok0 = engine.tokens_generated
+        done = sched.run(reqs)
+        dt = time.perf_counter() - t0
+        toks = engine.tokens_generated - tok0
+        assert len(done) == REQUESTS
+        if w > 0:
+            rates.append(toks / dt)
+            all_reqs.extend(reqs)
+    end = engine.prefix_cache.stats()
+    delta = {k: end[k] - warm_stats.get(k, 0)
+             for k in ("hits", "misses", "tokens_reused", "evictions",
+                       "pool_full", "registrations")}
+    consulted = delta["hits"] + delta["misses"]
+    delta["hit_rate"] = delta["hits"] / consulted if consulted else 0.0
+    return _median(rates), all_reqs, engine, delta
+
+
+def main_shared():
+    import jax
+
+    _load_env()
+
+    global _SHARED_TOKENS
+    chunk_len = CHUNK_LEN or max(1, PREFILL_LEN // 4)
+    rng0 = np.random.default_rng(7)
+    # every prompt = shared prefix + >=1 unique token, so the prefix
+    # must leave tail room inside the fixed prefill window
+    shared_len = min(SHARED_PREFIX, PREFILL_LEN - 1)
+    _SHARED_TOKENS = rng0.integers(1, VOCAB, size=shared_len).tolist()
+    rows, outputs = {}, {}
+    for mode, retain in (("cold", False), ("cached", True)):
+        rate, reqs, engine, stats = _serve_shared(retain, chunk_len)
+        ttfts = [r.ttft_s for r in reqs if r.ttft_s]
+        # every field in this row measures the SAME window set (warmup
+        # excluded): chunks/reused summed over measured requests,
+        # hit/miss/eviction stats as deltas past the warmup snapshot —
+        # so tokens_reused == prefill_chunks_skipped * chunk_len holds
+        # by construction (reuse is block-aligned)
+        chunks_run = sum(r.chunks for r in reqs)
+        reused = sum(r.reused_tokens for r in reqs)
+        skipped = reused // engine.chunk_len
+        rows[mode] = {
+            "metric": f"{SHARED_METRIC}.{mode}",
+            "value": round(rate, 2),
+            "unit": "tokens/s",
+            "ttft_p50_ms": round(
+                float(np.percentile(ttfts, 50)) * 1e3, 3) if ttfts else 0.0,
+            "ttft_p99_ms": round(
+                float(np.percentile(ttfts, 99)) * 1e3, 3) if ttfts else 0.0,
+            "prefill_chunks_run": chunks_run,
+            "prefill_chunks_skipped": skipped,
+            "prefix_hit_rate": round(stats["hit_rate"], 4),
+            "tokens_reused": stats["tokens_reused"],
+            "evictions": stats["evictions"],
+            "pool_full": stats["pool_full"],
+            "compiled_programs": engine.compiled_programs,
+            "chunk_len": engine.chunk_len,
+            "prefix_pool": PREFIX_POOL,
+        }
+        print(json.dumps(rows[mode]))
+        # all-greedy stream from a mode-independent seed: the cached
+        # run restores byte-identical K/V through the same compiled
+        # programs, so outputs must match the cold run token-for-token
+        outputs[mode] = [list(r.output_tokens) for r in reqs]
+    mismatches = sum(a != b for a, b in zip(outputs["cached"],
+                                            outputs["cold"]))
+    cold, cached = rows["cold"], rows["cached"]
+    total = cached["prefill_chunks_run"] + cached["prefill_chunks_skipped"]
+    print(json.dumps({
+        "metric": SHARED_METRIC,
+        "value": cached["value"],
+        "unit": "tokens/s",
+        "baseline_tokens_per_s": cold["value"],
+        "prefix_hit_rate": cached["prefix_hit_rate"],
+        "prefill_chunks_skipped_pct": round(
+            100.0 * cached["prefill_chunks_skipped"] / total, 1)
+        if total else 0.0,
+        "tokens_reused": cached["tokens_reused"],
+        "ttft_p50_ms": cached["ttft_p50_ms"],
+        "ttft_p99_ms": cached["ttft_p99_ms"],
+        "ttft_p50_ms_cold": cold["ttft_p50_ms"],
+        "ttft_p99_ms_cold": cold["ttft_p99_ms"],
+        "token_exact_vs_cold": mismatches == 0,
+        "token_mismatched_requests": mismatches,
+        "windows": WINDOWS,
+        "requests_per_window": REQUESTS,
+        "shared_prefix_len": shared_len,
+        "prefill_len": PREFILL_LEN,
+        "chunk_len": cached["chunk_len"],
+        "prefix_pool": PREFIX_POOL,
+        "slots": SLOTS,
+        "model": SIZE,
+        "backend": jax.default_backend(),
+    }))
+
+
 if __name__ == "__main__":
     from apex_tpu.telemetry import guard_bench_main
 
     if "--mixed-prompts" in sys.argv[1:]:
         guard_bench_main(main_mixed, MIXED_METRIC)
+    elif "--shared-prefix" in sys.argv[1:]:
+        guard_bench_main(main_shared, SHARED_METRIC)
     else:
         guard_bench_main(main, METRIC)

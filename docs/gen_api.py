@@ -51,11 +51,14 @@ PAGES = {
     "utils": ["apex_tpu.utils", "apex_tpu.utils.checkpoint",
               "apex_tpu.utils.sharded_checkpoint", "apex_tpu.utils.pytree",
               "apex_tpu.utils.memory_report",
-              "apex_tpu.utils.schedule_report", "apex_tpu.pyprof"],
+              "apex_tpu.utils.schedule_report", "apex_tpu.utils.compat",
+              "apex_tpu.pyprof"],
     "telemetry": ["apex_tpu.telemetry", "apex_tpu.telemetry.sinks",
                   "apex_tpu.telemetry.summarize", "apex_tpu.log_util"],
     "serving": ["apex_tpu.serving", "apex_tpu.serving.kv_cache",
-                "apex_tpu.serving.engine", "apex_tpu.serving.scheduler"],
+                "apex_tpu.serving.engine",
+                "apex_tpu.serving.prefix_cache",
+                "apex_tpu.serving.scheduler"],
     "contrib": [
         "apex_tpu.contrib.bottleneck", "apex_tpu.contrib.clip_grad",
         "apex_tpu.contrib.conv_bias_relu", "apex_tpu.contrib.cudnn_gbn",

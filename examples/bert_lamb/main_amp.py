@@ -319,7 +319,7 @@ def main(argv=None):
         # reference shape: apex DDP over the batch + FusedLAMB — here one
         # grad psum over the 'data' axis (examples/imagenet's pattern);
         # the dropout rng is folded per-rank so masks differ across shards
-        from jax import shard_map
+        from apex_tpu.utils.compat import shard_map
         from jax.sharding import Mesh, PartitionSpec as P
 
         from apex_tpu import comm

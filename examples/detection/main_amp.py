@@ -40,6 +40,7 @@ import flax.linen as nn
 
 from apex_tpu import amp
 from apex_tpu.parallel import SyncBatchNorm
+from apex_tpu.utils.compat import shard_map
 
 
 def parse_args(argv=None):
@@ -170,7 +171,7 @@ def main(argv=None):
         from apex_tpu import comm
         mesh = comm.make_mesh({"data": args.data_parallel})
         state = jax.device_put(state, NamedSharding(mesh, P()))
-        jit_step = jax.jit(jax.shard_map(
+        jit_step = jax.jit(shard_map(
             step_fn, mesh=mesh,
             in_specs=(P(), (P("data"), P("data"))),
             out_specs=P(), check_vma=False))

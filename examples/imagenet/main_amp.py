@@ -42,6 +42,7 @@ import optax
 
 from apex_tpu import amp
 from apex_tpu.models import create_model
+from apex_tpu.utils.compat import shard_map
 
 
 def parse_args(argv=None):
@@ -364,7 +365,7 @@ def main(argv=None):
         replicated = NamedSharding(mesh, P())
         state = jax.device_put(state, replicated)
         jit_step = jax.jit(
-            jax.shard_map(
+            shard_map(
                 step_fn, mesh=mesh,
                 in_specs=(P(), (bspec, bspec)),
                 out_specs=P(),

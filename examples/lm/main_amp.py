@@ -234,7 +234,7 @@ def build_parallel_lm(args, policy):
     ``[B, seq_len+1]``, sharded over 'data' by the step itself.
     """
     from jax.sharding import Mesh, PartitionSpec as P
-    from jax import shard_map
+    from apex_tpu.utils.compat import shard_map
 
     from apex_tpu import comm
     from apex_tpu.kernels.layer_norm import layer_norm

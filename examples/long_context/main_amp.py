@@ -40,6 +40,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 import flax.linen as nn
 
 from apex_tpu import amp, comm
+from apex_tpu.utils.compat import shard_map
 from apex_tpu.kernels.xentropy import softmax_cross_entropy_loss
 from apex_tpu.normalization import FusedLayerNorm
 from apex_tpu.transformer.context_parallel import (ring_attention,
@@ -225,7 +226,7 @@ def main(argv=None):
         loss_fn, fused_adam(args.lr), policy,
         grad_average_axis=("data", "context"))
 
-    @functools.partial(jax.shard_map, mesh=mesh,
+    @functools.partial(shard_map, mesh=mesh,
                        in_specs=(P(), (P("data", "context"),
                                        P("data", "context"),
                                        P("data", "context"))),
@@ -239,7 +240,7 @@ def main(argv=None):
     # on every rank — same key, rank-independent shapes)
     s_local = S // n
 
-    @functools.partial(jax.shard_map, mesh=mesh,
+    @functools.partial(shard_map, mesh=mesh,
                        in_specs=(P("data", "context"), P("data", "context")),
                        out_specs=P(), check_vma=False)
     def init_params(toks, pos):

@@ -29,6 +29,7 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from apex_tpu import amp, comm
+from apex_tpu.utils.compat import shard_map
 
 
 def manual_ddp_loop(mesh, n, model, params, iters=10):
@@ -73,7 +74,7 @@ def manual_ddp_loop(mesh, n, model, params, iters=10):
         return params2, opt2, update_scale(scaler, found_inf), \
             jax.lax.pmean(loss, "data")
 
-    jit_step = jax.jit(jax.shard_map(
+    jit_step = jax.jit(shard_map(
         step, mesh=mesh,
         in_specs=(P(), P(), P(), (P("data"), P("data"))),
         out_specs=(P(), P(), P(), P()), check_vma=False))
@@ -118,7 +119,7 @@ def main():
         loss_fn, optax.sgd(0.1), policy, grad_average_axis="data")
     state = init_fn(params)
 
-    jit_step = jax.jit(jax.shard_map(
+    jit_step = jax.jit(shard_map(
         step_fn, mesh=mesh, in_specs=(P(), (P("data"), P("data"))),
         out_specs=P(), check_vma=False))
 
@@ -139,7 +140,7 @@ def main():
     policy0 = amp.resolve_policy(opt_level="O0", loss_scale="dynamic")
     init0, step0 = amp.make_train_step(loss_fn, optax.sgd(0.1), policy0,
                                        grad_average_axis="data")
-    jit0 = jax.jit(jax.shard_map(
+    jit0 = jax.jit(shard_map(
         step0, mesh=mesh, in_specs=(P(), (P("data"), P("data"))),
         out_specs=P(), check_vma=False))
     rng0 = np.random.RandomState(0)

@@ -16,12 +16,19 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from apex_tpu.utils.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from apex_tpu import amp
 from apex_tpu.optimizers import fused_adam
 from apex_tpu.transformer import ring_attention
+
+# Heavy multi-device CPU-emulation tier: inert at the seed (shard_map
+# import errors) until the apex_tpu.utils.compat shim made this file
+# runnable on the hermetic jax, but too costly for the tier-1 wall-time
+# budget. Deselect from the fast tier; run with -m slow (or on the axon
+# toolchain, whose jax these tests target first).
+pytestmark = pytest.mark.slow
 
 B, H_HEADS, S_LOCAL, D, HID = 2, 4, 16, 8, 32
 
@@ -117,12 +124,12 @@ def test_ring_attention_dropout_deterministic_and_unbiased():
             q_, k_, v_ = (jnp.take(t, order, axis=2) for t in (q, k, v))
         else:
             q_, k_, v_ = q, k, v
-        fn = jax.jit(jax.shard_map(
+        fn = jax.jit(shard_map(
             lambda q, k, v, s: ring_attention(
                 q, k, v, causal=True, layout=layout,
                 dropout_rate=0.3, dropout_seed=s),
             mesh=mesh, in_specs=(spec,) * 3 + (P(),), out_specs=spec))
-        base_fn = jax.jit(jax.shard_map(
+        base_fn = jax.jit(shard_map(
             functools.partial(ring_attention, causal=True, layout=layout),
             mesh=mesh, in_specs=(spec,) * 3, out_specs=spec))
 
@@ -155,7 +162,7 @@ def test_ring_attention_dropout_grads_finite_and_deterministic():
     ks = jax.random.split(jax.random.PRNGKey(1), 3)
     q, k, v = (jax.random.normal(kk, (B, H, S, D), jnp.float32) for kk in ks)
     spec = P(None, None, "context", None)
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(shard_map(
         lambda q, k, v: ring_attention(q, k, v, causal=True,
                                        dropout_rate=0.2,
                                        dropout_seed=jnp.int32(9)),
@@ -170,7 +177,7 @@ def test_ring_attention_dropout_grads_finite_and_deterministic():
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
         assert np.isfinite(np.asarray(a)).all()
     # dropout must actually change the grads vs the clean path
-    fn0 = jax.jit(jax.shard_map(
+    fn0 = jax.jit(shard_map(
         lambda q, k, v: ring_attention(q, k, v, causal=True),
         mesh=mesh, in_specs=(spec,) * 3, out_specs=spec))
 
@@ -190,13 +197,13 @@ def test_ring_attention_dropout_rate_validation():
     mesh = _M(np.array(devs[:n]), ("context",))
     q = jnp.zeros((1, 1, 4 * 8, 8))
     spec = P(None, None, "context", None)
-    fn_bad = jax.shard_map(
+    fn_bad = shard_map(
         lambda q: ring_attention(q, q, q, dropout_rate=1.0,
                                  dropout_seed=jnp.int32(0)),
         mesh=mesh, in_specs=(spec,), out_specs=spec)
     with pytest.raises(ValueError, match="dropout_rate"):
         jax.jit(fn_bad)(q)
-    fn_noseed = jax.shard_map(
+    fn_noseed = shard_map(
         lambda q: ring_attention(q, q, q, dropout_rate=0.5),
         mesh=mesh, in_specs=(spec,), out_specs=spec)
     with pytest.raises(ValueError, match="dropout_seed"):

@@ -13,6 +13,7 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
 
+from apex_tpu.utils.compat import shard_map
 from apex_tpu.transformer.moe import MoEMLP, top1_routing
 
 H, I, E, T = 16, 32, 8, 64
@@ -60,7 +61,7 @@ def test_moe_rejects_indivisible_experts(eight_devices):
     x = jnp.zeros((8, T, H))
 
     with pytest.raises(ValueError, match="divisible"):
-        jax.jit(jax.shard_map(
+        jax.jit(shard_map(
             lambda x: m.init(jax.random.PRNGKey(0), x[0]),
             mesh=mesh, in_specs=P("expert"), out_specs=P("expert"),
             check_vma=False))(x)
@@ -97,7 +98,7 @@ def test_moe_expert_parallel_matches_single_device(eight_devices):
         y, aux = sharded.apply({"params": p}, x[0])
         return y[None], aux
 
-    y, aux = jax.jit(jax.shard_map(
+    y, aux = jax.jit(shard_map(
         step, mesh=mesh,
         in_specs=(specs, P("expert")),
         out_specs=(P("expert"), P()),
@@ -126,7 +127,7 @@ def test_moe_expert_parallel_grads_flow(eight_devices):
         l = loss(p, x)
         return jax.lax.pmean(l, "expert")
 
-    g = jax.jit(jax.shard_map(
+    g = jax.jit(shard_map(
         jax.grad(shard_loss), mesh=mesh,
         in_specs=(specs, P("expert")), out_specs=specs,
         check_vma=False))(params, x_all)
@@ -229,7 +230,7 @@ def test_moe_top2_expert_parallel_matches_single_device(eight_devices):
 
     @jax.jit
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        shard_map, mesh=mesh,
         in_specs=({"router": P(), "w1": P("expert"), "b1": P("expert"),
                    "w2": P("expert"), "b2": P("expert")}, P()),
         out_specs=(P(), P()), check_vma=False)

@@ -358,9 +358,9 @@ def test_accum_one_psum_per_window_trace_time():
     import apex_tpu.telemetry as telemetry
     from jax.sharding import Mesh, PartitionSpec as P
     # the hermetic env's jax has no top-level jax.shard_map (the axon
-    # toolchain's newer jax does — schedule_report.py targets that); the
-    # experimental path is the one that exists on both
-    from jax.experimental.shard_map import shard_map
+    # toolchain's newer jax does); the compat shim resolves whichever
+    # exists and translates check_vma= when needed
+    from apex_tpu.utils.compat import shard_map
 
     old = telemetry.get_registry()
     reg = telemetry.configure(sinks=[])

@@ -229,7 +229,7 @@ def test_tensor_parallel_layers_consult_engine(eight_devices):
     """Column/RowParallelLinear run half under O1 when dtype=None, fp32
     otherwise — the Megatron path honors the same tables as the rest."""
     import functools
-    from jax import shard_map
+    from apex_tpu.utils.compat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
     from apex_tpu.transformer.tensor_parallel import (ColumnParallelLinear,
                                                       RowParallelLinear)

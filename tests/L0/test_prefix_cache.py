@@ -1,0 +1,409 @@
+"""Content-addressed KV prefix reuse (PR 5), hermetic.
+
+The acceptance bar from the issue, as tests:
+
+- a prefix-cache HIT is bitwise token-exact against BOTH the cold
+  chunked path and one teacher-forcing full recompute, for shared
+  prefixes below / at / straddling a block boundary (and for a prompt
+  that is entirely cached, where the final block must still prefill —
+  the copy program produces no logits to sample from);
+- a request stream exercising hit, miss, eviction AND the monolithic
+  baseline is served by exactly FOUR compiled programs (chunk prefill +
+  decode + monolithic prefill + the KV row-copy), pinned by trace
+  counters;
+- LRU eviction with refcount pinning: a prefix in use by a live slot is
+  never evicted, and a full, fully-pinned pool degrades gracefully to
+  the cold path (request served, retention skipped, ``pool_full``
+  counted);
+- telemetry carries ``serving.prefix.*`` and the per-request completion
+  record carries ``reused_tokens``.
+
+Everything runs on CPU with a tiny model at policy O0 (exact fp32), the
+same shared-program discipline as test_serving.py: the hit path and the
+cold path literally execute the same XLA programs, so exactness is
+bitwise, not approximately.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import telemetry
+from apex_tpu.amp.policy import resolve_policy
+from apex_tpu.models.transformer_lm import TransformerLM
+from apex_tpu.serving import (Engine, PrefixCache, PrefixMatch, Request,
+                              Scheduler)
+
+pytestmark = pytest.mark.serving
+
+VOCAB = 101
+CHUNK = 8
+
+
+def _tiny_lm(max_seq_len=128, **kw):
+    return TransformerLM(vocab_size=VOCAB, hidden=32, num_layers=2,
+                         num_heads=4, max_seq_len=max_seq_len, **kw)
+
+
+@pytest.fixture(scope="module")
+def lm_and_params():
+    m = _tiny_lm()
+    params = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32),
+                    train=False)["params"]
+    return m, params
+
+
+def _mk_engine(lm_and_params, *, pool=2, slots=3, seed=5):
+    m, params = lm_and_params
+    return Engine(m, params, slots=slots, max_len=128, prefill_len=24,
+                  chunk_len=CHUNK, prefix_pool=pool,
+                  policy=resolve_policy("O0", verbose=False), seed=seed)
+
+
+@pytest.fixture(scope="module")
+def pool2_pair(lm_and_params):
+    """One retain-capable engine + one cold-reference engine (identical
+    geometry, pool=2), shared across the e2e tests — each test starts
+    from reset(clear_prefixes=True), and jit caching means the compile
+    cost is paid once for the whole module."""
+    return _mk_engine(lm_and_params), _mk_engine(lm_and_params)
+
+
+@pytest.fixture(scope="module")
+def pool1_engine(lm_and_params):
+    """Shared 1-row-pool engine (eviction/pool-full pressure tests)."""
+    return _mk_engine(lm_and_params, pool=1)
+
+
+# --------------------------------------------------- host-side PrefixCache
+def _pc(pool=2):
+    return PrefixCache(block_len=4, pool_rows=range(8, 8 + pool))
+
+
+def test_match_is_block_aligned_and_capped_below_the_prompt():
+    pc = _pc()
+    copies = []
+    assert pc.register(list(range(1, 11)),
+                       lambda row, n: copies.append((row, n))) \
+        == "registered"
+    assert copies == [(9, 8)]       # 10 tokens -> 2 full blocks retained
+    # identical 8-token prefix, longer prompt: match the 2 blocks
+    m = pc.match(list(range(1, 9)) + [77, 78, 79])
+    assert (m.row, m.length) == (9, 8)
+    # the whole prompt cached (exact 8 tokens): cap at aligned(7) = 4 —
+    # the final block must prefill to produce the first token's logits
+    m = pc.match(list(range(1, 9)))
+    assert m.length == 4
+    # shares only one block
+    assert pc.match([1, 2, 3, 4] + [9, 9, 9, 9, 9]).length == 4
+    # diverges inside the first block: miss
+    assert pc.match([1, 2, 3, 99, 5, 6, 7, 8, 9]) is None
+    # shorter than one block + 1: nothing block-aligned to reuse
+    assert pc.match([1, 2, 3, 4]) is None
+    assert pc.hits == 3 and pc.misses == 2
+    assert pc.tokens_reused == 8 + 4 + 4
+
+
+def test_register_dedupes_and_rejects_too_short():
+    pc = _pc()
+    calls = []
+    fn = lambda row, n: calls.append((row, n))
+    assert pc.register([1, 2, 3], fn) == "too_short"
+    assert pc.register(list(range(1, 10)), fn) == "registered"
+    # same aligned prefix again (different tail): no second copy
+    assert pc.register(list(range(1, 9)) + [55], fn) == "duplicate"
+    assert len(calls) == 1 and pc.registrations == 1
+
+
+def test_lru_eviction_prefers_least_recently_used():
+    pc = _pc(pool=2)
+    fn = lambda row, n: None
+    a, b, c = ([1] * 8), ([2] * 8), ([3] * 8)
+    assert pc.register(a, fn) == "registered"
+    assert pc.register(b, fn) == "registered"
+    assert pc.match(a + [7]) is not None       # refresh A
+    assert pc.register(c, fn) == "registered"  # pool full -> evict LRU: B
+    assert pc.evictions == 1
+    assert pc.match(b + [7]) is None           # B gone
+    assert pc.match(a + [7]) is not None       # A survived (recently used)
+    assert pc.match(c + [7]) is not None
+
+
+def test_refcount_pins_against_eviction_and_degrades_when_all_pinned():
+    pc = _pc(pool=2)
+    fn = lambda row, n: None
+    a, b, c, d = ([1] * 8), ([2] * 8), ([3] * 8), ([4] * 8)
+    pc.register(a, fn)
+    pc.register(b, fn)
+    ma = pc.match(a + [7])
+    mb = pc.match(b + [7])
+    pc.acquire(ma)                  # A pinned by a live slot
+    assert pc.register(c, fn) == "registered"   # evicts B (refcount 0)
+    assert pc.match(a + [7]) is not None, "pinned entry was evicted"
+    mc = pc.match(c + [7])
+    pc.acquire(mc)                  # now A and C both pinned
+    assert pc.register(d, fn) == "pool_full"    # graceful degradation
+    assert pc.pool_full == 1 and pc.evictions == 1
+    pc.release(ma)
+    assert pc.register(d, fn) == "registered"   # A evictable again
+    assert pc.match(a + [7]) is None
+    pc.release(mb)                  # releasing an evicted match: no-op
+
+
+def test_eviction_rebinds_shared_shorter_prefix_keys():
+    """A shorter shared prefix addressed by an evicted entry is still
+    resident inside a surviving longer entry — eviction must rebind the
+    key, not orphan the depth."""
+    pc = _pc(pool=2)
+    fn = lambda row, n: None
+    base = [5, 5, 5, 5]
+    pc.register(base + [1, 1, 1, 1], fn)        # owns H_1 (base)
+    pc.register(base + [2, 2, 2, 2], fn)        # same H_1 kept by first
+    pc.register([9] * 8, fn)                    # evicts the LRU (first)
+    m = pc.match(base + [7, 7, 7, 7, 7])
+    assert m is not None and m.length == 4, \
+        "depth-1 key orphaned by eviction despite a surviving cover"
+
+
+def test_hash_collision_cannot_fake_a_hit(monkeypatch):
+    import apex_tpu.serving.prefix_cache as mod
+
+    monkeypatch.setattr(mod, "_roll", lambda h, block: 42)  # all collide
+    pc = _pc(pool=2)
+    pc.register([1] * 8, lambda row, n: None)
+    assert pc.match([2] * 9) is None    # same key, different tokens
+    m = pc.match([1] * 9)
+    assert m is not None                # real content still matches
+
+
+def test_copy_failure_does_not_leak_the_pool_row():
+    pc = _pc(pool=1)
+
+    def boom(row, n):
+        raise RuntimeError("device fell over")
+
+    with pytest.raises(RuntimeError):
+        pc.register([1] * 8, boom)
+    assert pc.register([1] * 8, lambda row, n: None) == "registered"
+
+
+def test_prefix_cache_validates():
+    with pytest.raises(ValueError, match="block_len"):
+        PrefixCache(block_len=0, pool_rows=[1])
+    with pytest.raises(ValueError, match="distinct"):
+        PrefixCache(block_len=4, pool_rows=[1, 1])
+
+
+# -------------------------------------------------------- engine + copy
+def test_engine_copy_kv_validation(lm_and_params, pool2_pair):
+    eng, _ = pool2_pair                 # 3 slots + 2 pool rows
+    with pytest.raises(ValueError, match="copy rows"):
+        eng.copy_kv(0, 5, 4)
+    with pytest.raises(ValueError, match="must differ"):
+        eng.copy_kv(2, 2, 4)
+    with pytest.raises(ValueError, match="copy length"):
+        eng.copy_kv(0, 3, 0)
+    with pytest.raises(ValueError, match="copy length"):
+        eng.copy_kv(0, 3, 129)
+    with pytest.raises(ValueError, match="prefix_pool"):
+        _mk_engine(lm_and_params, pool=-1)
+
+
+def test_scheduler_retain_prefixes_validation(lm_and_params, pool2_pair):
+    eng_no_pool = _mk_engine(lm_and_params, pool=0)   # never traced: cheap
+    with pytest.raises(ValueError, match="prefix_pool"):
+        Scheduler(eng_no_pool, retain_prefixes=True)
+    with pytest.raises(ValueError, match="chunked"):
+        Scheduler(pool2_pair[0], retain_prefixes=True, chunked=False)
+
+
+# --------------------------------------------------- end-to-end exactness
+def _cases():
+    """(shared_prefix_len, expected_reuse_on_hit) for prefixes below /
+    at / straddling one block boundary and spanning two blocks, at
+    CHUNK=8. Tails are 3 tokens, so e.g. pre=13 registers aligned(16)=16
+    donor tokens of which only the first block matches the next prompt."""
+    rng = np.random.default_rng(42)
+    out = []
+    for pre_len, want in [(5, 0), (8, 8), (13, 8), (16, 16)]:
+        pre = list(rng.integers(1, VOCAB, size=pre_len))
+        tail_a = list(rng.integers(1, VOCAB, size=3))
+        tail_b = list(rng.integers(1, VOCAB, size=3))
+        out.append((pre + tail_a, pre + tail_b, want))
+    return out
+
+
+def test_prefix_hit_bitwise_exact_vs_cold_and_recompute(lm_and_params,
+                                                        pool2_pair):
+    """The tentpole acceptance bar: after request A registers its
+    prefix, request B (same shared prefix, different tail) is served
+    from the cache — and its greedy tokens are bitwise identical to a
+    retention-off engine's AND to one teacher-forcing full recompute."""
+    m, params = lm_and_params
+    eng_hot, eng_cold = pool2_pair
+    eng_hot.reset(clear_prefixes=True)
+    eng_cold.reset()
+    sched_hot = Scheduler(eng_hot, retain_prefixes=True)
+    sched_cold = Scheduler(eng_cold, retain_prefixes=False)
+    for prompt_a, prompt_b, want_reuse in _cases():
+        (ra,) = sched_hot.run([Request(prompt=prompt_a, max_new_tokens=6)])
+        (rb,) = sched_hot.run([Request(prompt=prompt_b, max_new_tokens=6)])
+        assert rb.reused_tokens == want_reuse, \
+            f"prefix len {len(prompt_a) - 3}: reused {rb.reused_tokens}"
+        assert ra.reused_tokens == 0
+        (cb,) = sched_cold.run([Request(prompt=prompt_b,
+                                        max_new_tokens=6)])
+        assert rb.output_tokens == cb.output_tokens, \
+            f"hit path diverged from cold (prefix len {len(prompt_a) - 3})"
+        # skipped chunks are real: the hit ran fewer prefill steps
+        assert rb.chunks == eng_hot.chunks_for(len(prompt_b)) \
+            - want_reuse // CHUNK
+        # teacher-forcing recompute: one full forward re-derives every
+        # greedy step (identical-program discipline of test_serving.py)
+        seq = jnp.asarray([list(prompt_b) + rb.output_tokens], jnp.int32)
+        full = m.apply({"params": params}, seq, train=False)
+        want = np.asarray(jnp.argmax(full[0], axis=-1))
+        for i, tok in enumerate(rb.output_tokens):
+            assert tok == int(want[len(prompt_b) - 1 + i]), \
+                f"recompute divergence at token {i}"
+
+
+def test_fully_cached_prompt_still_prefills_its_final_block(pool2_pair):
+    """A prompt whose every token is cached must still run >= 1 chunk:
+    the copy program moves K/V but samples nothing — the first output
+    token's logits only exist if the last block goes through chunk
+    prefill. The cap (aligned(n-1)) enforces exactly that."""
+    eng, eng_cold = pool2_pair
+    eng.reset(clear_prefixes=True)
+    eng_cold.reset()
+    sched = Scheduler(eng, retain_prefixes=True)
+    prompt = list(np.random.default_rng(3).integers(1, VOCAB, size=16))
+    sched.run([Request(prompt=prompt, max_new_tokens=4)])
+    (r2,) = sched.run([Request(prompt=list(prompt), max_new_tokens=4)])
+    assert r2.reused_tokens == 8            # aligned(15), not 16
+    assert r2.chunks == 1
+    (cold,) = Scheduler(eng_cold, retain_prefixes=False).run(
+        [Request(prompt=list(prompt), max_new_tokens=4)])
+    assert r2.output_tokens == cold.output_tokens
+
+
+def test_exactly_four_compiled_programs_over_hit_miss_evict(pool1_engine):
+    """The compiled-program pin, one up from PR 4's three: a stream
+    driving hits, misses, registrations and LRU evictions through a
+    1-row pool, plus the monolithic baseline, traces exactly one chunk-
+    prefill + one decode + one monolithic prefill + one KV row-copy
+    program — the copy is slot-, direction- and length-agnostic."""
+    eng = pool1_engine
+    eng.reset(clear_prefixes=True)
+    pc = eng.prefix_cache
+    hits0, miss0, evic0 = pc.hits, pc.misses, pc.evictions
+    sched = Scheduler(eng, retain_prefixes=True)
+    rng = np.random.default_rng(1)
+    pre1 = list(rng.integers(1, VOCAB, size=8))
+    pre2 = list(rng.integers(1, VOCAB, size=16))
+    stream = [
+        pre1 + [7, 8],            # miss, registers pre1
+        pre1 + [9],               # hit (copy pool->slot)
+        pre2 + [3],               # miss, registers pre2 (evicts pre1)
+        pre1[:5] + [5, 6],        # miss (evicted; too short to register)
+        pre2 + [1, 2, 3],         # hit at 16
+    ]
+    for p in stream:
+        sched.run([Request(prompt=p, max_new_tokens=3)])
+    assert (pc.hits - hits0, pc.misses - miss0) == (2, 3)
+    assert pc.evictions - evic0 >= 1
+    eng.prefill(0, [5, 9, 2])     # the monolithic baseline still compiles
+    assert (eng.chunk_traces, eng.decode_traces, eng.prefill_traces,
+            eng.copy_traces) == (1, 1, 1, 1)
+    assert eng.compiled_programs == 4
+
+
+def test_pool_full_with_live_pins_degrades_to_cold_path(pool1_engine):
+    """Every pool row pinned by a live slot: a new registration is
+    skipped (pool_full), nothing is evicted, and the request itself is
+    served normally — graceful degradation, not an error."""
+    eng = pool1_engine
+    eng.reset(clear_prefixes=True)
+    pool_full0, evic0 = eng.prefix_cache.pool_full, \
+        eng.prefix_cache.evictions
+    reg = telemetry.MetricsRegistry()
+    sched = Scheduler(eng, retain_prefixes=True, registry=reg)
+    rng = np.random.default_rng(9)
+    pre = list(rng.integers(1, VOCAB, size=8))
+    sched.run([Request(prompt=pre + [1], max_new_tokens=2)])
+    # b hits pre and stays live (big budget, stepped manually): its pin
+    # holds the only pool row
+    b = Request(prompt=pre + [2], max_new_tokens=50)
+    sched.submit(b)
+    while b.status != "running":
+        sched.step()
+    assert b.reused_tokens == 8
+    other = list(rng.integers(1, VOCAB, size=9))
+    c = Request(prompt=other, max_new_tokens=2)
+    sched.submit(c)
+    while c.status not in ("done", "timeout"):   # b (budget 50) outlives c
+        sched.step()
+    assert b.status == "running", "pin holder must still be live"
+    assert c.status == "done" and len(c.output_tokens) == 2
+    pc = eng.prefix_cache
+    assert pc.pool_full - pool_full0 >= 1 and pc.evictions == evic0
+    assert pc.match(pre + [3]) is not None, "pinned entry evicted"
+    assert reg.snapshot()["counters"]["serving.prefix.pool_full"] >= 1
+    # draining b releases the pin; the next registration may now evict
+    while sched.pending:
+        sched.step()
+    (d,) = sched.run([Request(prompt=other, max_new_tokens=2)])
+    assert eng.prefix_cache.evictions == evic0 + 1
+
+
+def test_prefix_telemetry_and_request_records(pool2_pair):
+    reg = telemetry.MetricsRegistry()
+    eng, _ = pool2_pair
+    eng.reset(clear_prefixes=True)
+    eng.set_registry(reg)
+    sched = Scheduler(eng, retain_prefixes=True, registry=reg)
+    rng = np.random.default_rng(11)
+    pre = list(rng.integers(1, VOCAB, size=16))
+    reqs = [Request(prompt=pre + [1], max_new_tokens=3),
+            Request(prompt=pre + [2, 3], max_new_tokens=3)]
+    try:
+        sched.run([reqs[0]])
+        sched.run([reqs[1]])
+    finally:
+        eng.set_registry(None)
+    snap = reg.snapshot()
+    c = snap["counters"]
+    assert c["serving.prefix.hits"] == 1
+    assert c["serving.prefix.misses"] == 1
+    assert c["serving.prefix.tokens_reused"] == 16
+    assert c["serving.prefix.chunks_skipped"] == 2
+    assert c["serving.prefix.registrations"] == 1   # second is duplicate
+    # the gauge tracks the cache's cumulative rate (shared engine: the
+    # pcache's counters span the module, the registry's are this test's)
+    assert snap["gauges"]["serving.prefix.hit_rate"] \
+        == pytest.approx(eng.prefix_cache.hit_rate)
+    assert snap["histograms"]["serving.prefix.copy_s"]["count"] >= 2
+    recs = {rec["uid"]: rec for rec in reg.records
+            if rec.get("tag") == "serving.request"}
+    assert recs[reqs[0].uid]["reused_tokens"] == 0
+    assert recs[reqs[1].uid]["reused_tokens"] == 16
+    assert recs[reqs[1].uid]["chunks_per_prompt"] == 1
+
+
+def test_reset_keeps_warm_prefixes_unless_cleared(pool2_pair):
+    eng, _ = pool2_pair
+    eng.reset(clear_prefixes=True)
+    sched = Scheduler(eng, retain_prefixes=True)
+    pre = list(np.random.default_rng(13).integers(1, VOCAB, size=8))
+    sched.run([Request(prompt=pre + [1], max_new_tokens=2)])
+    eng.reset()
+    assert eng.lengths()[:eng.slots].tolist() == [0, 0, 0]
+    (r,) = Scheduler(eng, retain_prefixes=True).run(
+        [Request(prompt=pre + [2], max_new_tokens=2)])
+    assert r.reused_tokens == 8, "reset() must not drop warm prefixes"
+    eng.reset(clear_prefixes=True)
+    assert eng.prefix_cache.size == 0
+    (r2,) = Scheduler(eng, retain_prefixes=True).run(
+        [Request(prompt=pre + [3], max_new_tokens=2)])
+    assert r2.reused_tokens == 0

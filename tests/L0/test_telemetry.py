@@ -308,6 +308,30 @@ def test_every_bench_driver_routes_through_guard_bench_main():
             "guard_bench_main"
 
 
+@pytest.mark.slow          # subprocess re-imports jax: ~15s of wall
+def test_bench_py_emits_json_line_even_when_env_parsing_fails():
+    """The PR 5 satellite: bench.py's guard contract must hold for
+    failures that used to fire BEFORE the guard was armed (module-level
+    env parsing / heavy imports — the BENCH_r05 '"parsed": null' shape).
+    A poisoned BENCH_* value now dies inside guarded main(): the LAST
+    stdout line is the parseable failure JSON, rc 1."""
+    import subprocess
+    import sys
+
+    root = os.path.join(os.path.dirname(__file__), os.pardir, os.pardir)
+    env = dict(os.environ, BENCH_BATCH="banana", JAX_PLATFORMS="cpu",
+               APEX_TPU_BENCH_RETRIES="0")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "bench.py")],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert proc.returncode == 1
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
+    parsed = json.loads(lines[-1])          # the contract: LAST line parses
+    assert parsed["rc"] == 1 and "BENCH_BATCH" in parsed["error"]
+    assert parsed["metric"] == "resnet50_amp_o2_train_img_per_sec_per_chip"
+    assert parsed["transient"] is False
+
+
 def test_guard_bench_main_failure_ends_in_json_line(capsys):
     def exploding_main():
         raise RuntimeError("backend init failed")

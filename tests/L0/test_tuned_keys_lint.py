@@ -94,3 +94,27 @@ def test_chunk_prefill_keys_are_tuned():
                 "decode.block_k", "decode.prefill_block_q",
                 "decode.prefill_block_k"):
         assert key in table, f"{key} missing from the tuned tables"
+
+
+def test_prefix_copy_sources_are_linted_and_carry_no_tuned_keys():
+    """The PR 5 prefix-reuse satellite: the KV row-copy program is pure
+    data movement (one dynamic-slice pair, no Pallas kernel), so it
+    deliberately introduces NO ``decode.copy_*`` tuned keys — pin that
+    the tables carry none (a ``decode.copy_*`` row would be a dead
+    sweep, caught here by name rather than only via the generic stale
+    check), and that the lint's scan really covers the new
+    ``serving/prefix_cache.py`` source so any key a future copy kernel
+    DOES reference gets the existence/staleness treatment
+    automatically."""
+    table = _table_keys()
+    stale_copy = {k for k in table if k.startswith("decode.copy_")}
+    assert not stale_copy, (
+        f"tuned tables carry decode.copy_* keys but the KV row-copy "
+        f"consumes no tuned knobs: {stale_copy}")
+    scanned = {os.path.relpath(p, ROOT)
+               for d in SCAN_DIRS
+               for p in glob.glob(os.path.join(d, "**", "*.py"),
+                                  recursive=True)}
+    assert os.path.join("apex_tpu", "serving",
+                        "prefix_cache.py") in scanned
+    assert os.path.join("apex_tpu", "serving", "engine.py") in scanned

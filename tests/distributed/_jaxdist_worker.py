@@ -79,7 +79,15 @@ def main():
     # config (not env): the axon sitecustomize pins jax_platforms at
     # interpreter start, overriding JAX_PLATFORMS (see comm.ensure_devices)
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 4)
+    try:
+        jax.config.update("jax_num_cpu_devices", 4)
+    except AttributeError:
+        # older jax has no such config option; the XLA flag does the
+        # same and still bites here (backends are uninitialized)
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=4").strip()
 
     from apex_tpu import comm
 
@@ -91,7 +99,7 @@ def main():
         sys.exit(42)
 
     import numpy as np
-    from jax import shard_map
+    from apex_tpu.utils.compat import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     assert jax.process_count() == 2, jax.process_count()
@@ -103,33 +111,42 @@ def main():
     assert mesh.shape == {"data": 2, "model": 4}
     axes = ("data", "model")
 
-    if mode == "gspmd":
-        # one GLOBAL program partitioned by XLA across both processes:
-        # replicated state, batch sharded over every mesh dim, no
-        # explicit collectives anywhere in user code
-        params, init_fn, step_fn = training_setup(grad_axes=None)
-        rep = NamedSharding(mesh, P())
-        bsh = NamedSharding(mesh, P(axes))
-        state_sh = jax.tree_util.tree_map(
-            lambda _: rep, jax.eval_shape(init_fn, params))
-        state = jax.jit(init_fn, out_shardings=state_sh)(params)
-        step = jax.jit(step_fn, in_shardings=(state_sh, (bsh, bsh)))
-    else:
-        params, init_fn, step_fn = training_setup()
-        state = init_fn(params)
-        step = jax.jit(shard_map(step_fn, mesh=mesh,
-                                 in_specs=(P(), (P(axes), P(axes))),
-                                 out_specs=(P(), P()), check_vma=False),
-                       donate_argnums=(0,))
-        bsh = NamedSharding(mesh, P(axes))
     metrics = None
-    for it in range(N_STEPS):
-        x, y = batch_at(it)
-        # this process contributes ONLY its own half of the global batch
-        lo, hi = rank * BATCH // 2, (rank + 1) * BATCH // 2
-        xg = jax.make_array_from_process_local_data(bsh, x[lo:hi])
-        yg = jax.make_array_from_process_local_data(bsh, y[lo:hi])
-        state, metrics = step(state, (xg, yg))
+    try:
+        if mode == "gspmd":
+            # one GLOBAL program partitioned by XLA across both processes:
+            # replicated state, batch sharded over every mesh dim, no
+            # explicit collectives anywhere in user code
+            params, init_fn, step_fn = training_setup(grad_axes=None)
+            rep = NamedSharding(mesh, P())
+            bsh = NamedSharding(mesh, P(axes))
+            state_sh = jax.tree_util.tree_map(
+                lambda _: rep, jax.eval_shape(init_fn, params))
+            state = jax.jit(init_fn, out_shardings=state_sh)(params)
+            step = jax.jit(step_fn, in_shardings=(state_sh, (bsh, bsh)))
+        else:
+            params, init_fn, step_fn = training_setup()
+            state = init_fn(params)
+            step = jax.jit(shard_map(step_fn, mesh=mesh,
+                                     in_specs=(P(), (P(axes), P(axes))),
+                                     out_specs=(P(), P()), check_vma=False),
+                           donate_argnums=(0,))
+            bsh = NamedSharding(mesh, P(axes))
+        for it in range(N_STEPS):
+            x, y = batch_at(it)
+            # this process contributes ONLY its own half of the global batch
+            lo, hi = rank * BATCH // 2, (rank + 1) * BATCH // 2
+            xg = jax.make_array_from_process_local_data(bsh, x[lo:hi])
+            yg = jax.make_array_from_process_local_data(bsh, y[lo:hi])
+            state, metrics = step(state, (xg, yg))
+    except Exception as e:  # noqa: BLE001 — env gap, not a logic failure
+        if "Multiprocess computations aren't implemented" in str(e):
+            # this jax's CPU backend cannot RUN cross-process programs
+            # even though bootstrap succeeded — same environment
+            # limitation as a refused bootstrap, so same skip signal
+            print(f"BOOTSTRAP_FAILED: {type(e).__name__}: {e}", flush=True)
+            sys.exit(42)
+        raise
 
     # half params (bf16) round-trip npz as raw void bytes; fp32 holds
     # every bf16 exactly, so the cast keeps the cross-rank check bitwise

@@ -8,6 +8,13 @@ import os
 import numpy as np
 import pytest
 
+# Heavy multi-device CPU-emulation tier: inert at the seed (shard_map
+# import errors) until the apex_tpu.utils.compat shim made this file
+# runnable on the hermetic jax, but too costly for the tier-1 wall-time
+# budget. Deselect from the fast tier; run with -m slow (or on the axon
+# toolchain, whose jax these tests target first).
+pytestmark = pytest.mark.slow
+
 _RECIPE = os.path.join(os.path.dirname(__file__), os.pardir, os.pardir,
                        "examples", "bert_lamb", "main_amp.py")
 

@@ -11,13 +11,20 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 import pytest
-from jax import shard_map
+from apex_tpu.utils.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from apex_tpu import amp
 from apex_tpu.amp import init_scaler, unscale, update_scale
 from apex_tpu.amp.scaler import scale_loss as scale_loss_fn
 from apex_tpu.parallel import DistributedDataParallel
+
+# Heavy multi-device CPU-emulation tier: inert at the seed (shard_map
+# import errors) until the apex_tpu.utils.compat shim made this file
+# runnable on the hermetic jax, but too costly for the tier-1 wall-time
+# budget. Deselect from the fast tier; run with -m slow (or on the axon
+# toolchain, whose jax these tests target first).
+pytestmark = pytest.mark.slow
 
 
 @pytest.fixture()

@@ -23,6 +23,13 @@ import pytest
 
 from apex_tpu import amp
 
+# Heavy multi-device CPU-emulation tier: inert at the seed (shard_map
+# import errors) until the apex_tpu.utils.compat shim made this file
+# runnable on the hermetic jax, but too costly for the tier-1 wall-time
+# budget. Deselect from the fast tier; run with -m slow (or on the axon
+# toolchain, whose jax these tests target first).
+pytestmark = pytest.mark.slow
+
 
 
 BASE = ["--size", "tiny", "--vocab-size", "128", "--seq-len", "16",

@@ -25,6 +25,13 @@ import sys
 import numpy as np
 import pytest
 
+# Heavy multi-device CPU-emulation tier: inert at the seed (shard_map
+# import errors) until the apex_tpu.utils.compat shim made this file
+# runnable on the hermetic jax, but too costly for the tier-1 wall-time
+# budget. Deselect from the fast tier; run with -m slow (or on the axon
+# toolchain, whose jax these tests target first).
+pytestmark = pytest.mark.slow
+
 _WORKER = os.path.join(os.path.dirname(__file__), "_jaxdist_worker.py")
 
 _ORACLE_CACHE: list = []
@@ -39,7 +46,7 @@ def _single_process_oracle():
     import importlib.util as _ilu
 
     import jax
-    from jax import shard_map
+    from apex_tpu.utils.compat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     spec = _ilu.spec_from_file_location("_jaxdist_worker", _WORKER)
