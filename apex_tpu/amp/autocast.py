@@ -36,23 +36,39 @@ _tls = threading.local()
 
 # jit-cache salt: a jax user context carrying the active policy — part of
 # the tracing/lowering/compilation cache key, so jit distinguishes traces
-# made under different ambient policies. Gated for older jax without the
-# API: the fallback is thread-local state only, with trace_token() for
+# made under different ambient policies. Older jax has no
+# make_user_context; there the salt rides the XLA-metadata context
+# instead (``xla_metadata_context_manager`` sits in ``trace_context()``
+# on every jax this repo supports), carrying a content fingerprint of
+# the policy as a frontend attribute — semantics-free HLO metadata whose
+# only load-bearing property is membership in the jit cache key. Last
+# resort (neither API): thread-local state only, with trace_token() for
 # manual static-arg salting.
 try:
     import jax as _jax
 
     _policy_state = _jax.make_user_context(default_value=None)
-except AttributeError:  # pragma: no cover - jax without make_user_context
-    import warnings
+except AttributeError:
+    try:
+        from jax.experimental.xla_metadata import \
+            set_xla_metadata as _set_xla_metadata
 
-    warnings.warn(
-        "this jax has no make_user_context: the ambient amp policy cannot "
-        "be salted into the jit cache key, so a function YOU jit and call "
-        "under different autocast policies will silently reuse its first "
-        "trace's cast decisions. Re-jit per policy, or upgrade jax.",
-        stacklevel=2)
-    _policy_state = None
+        def _policy_state(policy):
+            # repr of the frozen Policy dataclass: a stable CONTENT
+            # fingerprint (two equal policies share one trace; id()
+            # would retrace per object and could alias after gc)
+            return _set_xla_metadata(apex_tpu_amp_policy=repr(policy))
+    except ImportError:  # pragma: no cover - jax without either API
+        import warnings
+
+        warnings.warn(
+            "this jax has neither make_user_context nor xla_metadata: "
+            "the ambient amp policy cannot be salted into the jit cache "
+            "key, so a function YOU jit and call under different "
+            "autocast policies will silently reuse its first trace's "
+            "cast decisions. Re-jit per policy, or upgrade jax.",
+            stacklevel=2)
+        _policy_state = None
 
 
 def active_policy():

@@ -31,6 +31,19 @@ and ``decode.prefill_block_q``/``decode.prefill_block_k`` consumed by
 ``serving.Engine`` for its prefill flash-attention geometry (prefill
 shapes — short sequences, single-request batch — want different blocks
 than the training sweep).
+
+**Paged variant** (:func:`paged_decode_attention`): the serving tier's
+block-table refactor replaces the per-slot cache row with a dense pool
+of fixed-size pages plus a ``[batch, max_pages]`` page table. The
+kernel is the same online-softmax recurrence with ONE structural
+change: the KV block index is no longer an affine function of the grid
+position — block ``j`` of batch row ``b`` lives wherever
+``page_table[b, j]`` says. Pallas expresses exactly that through
+scalar-prefetch block index maps (``PrefetchScalarGridSpec``): the page
+table rides SMEM ahead of the grid, and each (b, h, j) step DMAs pool
+page ``page_table[b, j]`` instead of row offset ``j``. The length skip
+is unchanged — pages wholly past ``lengths[b]`` are masked to the
+sentinel page and their compute skipped.
 """
 
 from __future__ import annotations
@@ -45,7 +58,9 @@ from jax.experimental.pallas import tpu as pltpu
 
 from apex_tpu.kernels import mosaic_dtype_ok, vmem
 
-__all__ = ["decode_attention", "decode_attention_reference"]
+__all__ = ["decode_attention", "decode_attention_reference",
+           "paged_decode_attention", "paged_decode_attention_reference",
+           "gather_pages"]
 
 _NEG_INF = -1e30
 DEFAULT_BLOCK_K = 256
@@ -201,3 +216,163 @@ def decode_attention(q, k, v, lengths, *, scale: Optional[float] = None,
     out = _decode_pallas(q3, k3, v3, len3, scale, bk, interpret)
     live = (lengths > 0)[:, None, None]
     return jnp.where(live, out.reshape(b, h, d), 0).astype(q.dtype)
+
+
+# ------------------------------------------------------------ paged variant
+def gather_pages(pool, page_table):
+    """Materialise a contiguous per-row cache view from a paged pool:
+    ``pool`` [num_pages, heads, page_len, d] + ``page_table``
+    [batch, max_pages] int32 -> [batch, heads, max_pages * page_len, d].
+
+    The paged kernels' oracle building block (and the CPU/unaligned
+    fallback's first step): positions ``[j*page_len, (j+1)*page_len)``
+    of row ``b`` are pool page ``page_table[b, j]``. Entries past a
+    row's allocated pages point at the sentinel page — garbage the
+    length/causal masks keep out of every softmax."""
+    B, P = page_table.shape
+    h, page_len, d = pool.shape[1], pool.shape[2], pool.shape[3]
+    gathered = pool[page_table]              # [B, P, h, page_len, d]
+    return gathered.transpose(0, 2, 1, 3, 4).reshape(
+        B, h, P * page_len, d)
+
+
+def paged_decode_attention_reference(q, k_pool, v_pool, page_table,
+                                     lengths, *, scale: float = 1.0):
+    """fp32-math oracle: gather the page-table view, then the exact
+    contiguous decode reference. ``q`` [b, h, d]; pools
+    [num_pages, h, page_len, d]; ``page_table`` [b, max_pages];
+    ``lengths`` [b] int32."""
+    k = gather_pages(k_pool, page_table)
+    v = gather_pages(v_pool, page_table)
+    return decode_attention_reference(q, k, v, lengths, scale=scale)
+
+
+def _paged_decode_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                         acc_ref, m_ref, l_ref, *, scale, page_len):
+    """Grid (b, h, max_pages): one batch row x head, one pool page per
+    step. The (m, l) recurrence is :func:`_decode_kernel`'s; the page
+    the DMA fetched was chosen by the scalar-prefetch index map
+    (``pt_ref[b, j]``), so the kernel body only needs the length skip/
+    mask on GLOBAL positions ``j * page_len + lane``."""
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    nj = pl.num_programs(2)
+    length = len_ref[b]
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    @pl.when(j * page_len < length)
+    def _body():
+        q = q_ref[0, 0][None, :].astype(jnp.float32)          # [1, d]
+        k = k_ref[0, 0].astype(jnp.float32)                   # [pl, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale       # [1, pl]
+        cols = j * page_len + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_len), 1)
+        s = jnp.where(cols < length, s, _NEG_INF)
+        m_prev = m_ref[:1, :1]                                # [1, 1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                                # [1, pl]
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:1, :1] = alpha * l_ref[:1, :1] + jnp.sum(
+            p, axis=-1, keepdims=True)
+        acc_ref[:1, :] = acc_ref[:1, :] * alpha + jax.lax.dot_general(
+            p, v_ref[0, 0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:1, :1] = m_new
+
+    @pl.when(j == nj - 1)
+    def _finish():
+        l = l_ref[:1, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[:1, :] / l_safe)[0].astype(o_ref.dtype)
+
+
+def _paged_decode_pallas(q, k_pool, v_pool, pt, lengths, scale, interpret):
+    B, h, d = q.shape
+    page_len = k_pool.shape[2]
+    max_pages = pt.shape[1]
+    kernel = functools.partial(_paged_decode_kernel, scale=scale,
+                               page_len=page_len)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,                   # page_table, lengths
+        grid=(B, h, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, 1, d), lambda b, hh, j, pt, ln: (b, hh, 0)),
+            pl.BlockSpec((1, 1, page_len, d),
+                         lambda b, hh, j, pt, ln: (pt[b, j], hh, 0, 0)),
+            pl.BlockSpec((1, 1, page_len, d),
+                         lambda b, hh, j, pt, ln: (pt[b, j], hh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, d),
+                               lambda b, hh, j, pt, ln: (b, hh, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((8, d), jnp.float32),      # acc (row 0 live)
+            pltpu.VMEM((8, 128), jnp.float32),    # m
+            pltpu.VMEM((8, 128), jnp.float32),    # l
+        ],
+    )
+    return pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, h, d), q.dtype),
+        interpret=interpret,
+    )(pt, lengths, q, k_pool, v_pool)
+
+
+def paged_decode_attention(q, k_pool, v_pool, page_table, lengths, *,
+                           scale: Optional[float] = None,
+                           interpret: bool = False):
+    """Single-token attention against a PAGED, length-masked KV pool.
+
+    ``q`` [batch, heads, head_dim]; ``k_pool``/``v_pool``
+    [num_pages, heads, page_len, head_dim] (one layer of the serving
+    pool — pages are shared across batch rows); ``page_table``
+    [batch, max_pages] int32 maps row ``b``'s logical block ``j`` to a
+    pool page (sentinel ids for unallocated blocks — masked, never
+    attended); ``lengths`` [batch] int32 as in
+    :func:`decode_attention`. The current token's K/V must already be
+    written at logical position ``lengths[b] - 1`` of its row's pages.
+    ``scale`` defaults to ``1/sqrt(head_dim)``.
+
+    Inference-only. The Pallas path walks each row's page list through
+    scalar-prefetch index maps — one pool-page DMA per grid step, with
+    pages past ``lengths[b]`` skipping their compute — so a short
+    request in a big pool costs O(length) MXU work exactly like the
+    contiguous kernel, while the pool itself stays dense and shared.
+    Unaligned shapes and non-Mosaic dtypes fall back to the
+    gather-then-reference oracle.
+    """
+    B, h, d = q.shape
+    P, hp, page_len, dp = k_pool.shape
+    if v_pool.shape != k_pool.shape or hp != h or dp != d:
+        raise ValueError(f"paged_decode_attention: pools "
+                         f"{k_pool.shape}/{v_pool.shape} do not match q "
+                         f"{q.shape}")
+    if page_table.ndim != 2 or page_table.shape[0] != B:
+        raise ValueError(f"paged_decode_attention: page_table "
+                         f"{page_table.shape} must be [{B}, max_pages]")
+    if lengths.shape != (B,):
+        raise ValueError(f"paged_decode_attention: lengths "
+                         f"{lengths.shape} must be [{B}]")
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    from apex_tpu.kernels.flash_attention import _has_vma
+    if jax.default_backend() == "cpu":
+        interpret = True
+    pallas_ok = (d % 8 == 0 and page_len % 128 == 0)
+    if not pallas_ok or (interpret and _has_vma(q)) \
+            or (not interpret and not mosaic_dtype_ok(q, k_pool, v_pool)):
+        return paged_decode_attention_reference(
+            q, k_pool, v_pool, page_table, lengths, scale=scale)
+    pt = jnp.asarray(page_table, jnp.int32)
+    len32 = jnp.asarray(lengths, jnp.int32)
+    out = _paged_decode_pallas(q, k_pool, v_pool, pt, len32, scale,
+                               interpret)
+    live = (lengths > 0)[:, None, None]
+    return jnp.where(live, out, 0).astype(q.dtype)

@@ -38,6 +38,16 @@ garbage that the engine never samples from.
 Block geometry rides the shared tuned-override registry
 (:mod:`apex_tpu.kernels.vmem`) under ``decode.chunk_block_q``
 (sublane-multiple 8) and ``decode.chunk_block_k`` (lane-multiple 128).
+
+**Paged variant** (:func:`paged_prefill_attention`): the block-table
+refactor's chunk-ingestion kernel. Same shifted-causal online softmax,
+but K/V arrive from a dense page pool through a ``[batch, max_pages]``
+page table rather than a contiguous cache row: the KV grid dimension
+walks the row's page list via scalar-prefetch block index maps (page
+``j`` of row ``b`` DMAs pool page ``page_table[b, j]``), the q-block ×
+page skip runs on global positions exactly as the contiguous kernel's
+q-block × k-block skip. The q-block knob is ``decode.page_block_q``
+(the KV block is pinned to one page — the pool's DMA granule).
 """
 
 from __future__ import annotations
@@ -52,7 +62,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 from apex_tpu.kernels import mosaic_dtype_ok, vmem
 
-__all__ = ["prefill_attention", "prefill_attention_reference"]
+__all__ = ["prefill_attention", "prefill_attention_reference",
+           "paged_prefill_attention", "paged_prefill_attention_reference"]
 
 _NEG_INF = -1e30
 DEFAULT_BLOCK_Q = 128
@@ -221,3 +232,165 @@ def prefill_attention(q, k, v, offsets, *, scale: Optional[float] = None,
     off3 = jnp.repeat(jnp.asarray(offsets, jnp.int32), h)
     out = _prefill_pallas(q3, k3, v3, off3, scale, bq, bk, interpret)
     return out.reshape(b, h, C, d).astype(q.dtype)
+
+
+# ------------------------------------------------------------ paged variant
+def paged_prefill_attention_reference(q, k_pool, v_pool, page_table,
+                                      offsets, *, scale: float = 1.0):
+    """fp32-math oracle: gather the page-table view, then the exact
+    contiguous chunk-prefill reference. ``q`` [b, h, C, d]; pools
+    [num_pages, h, page_len, d]; ``page_table`` [b, max_pages];
+    ``offsets`` [b] int32."""
+    from apex_tpu.kernels.decode_attention import gather_pages
+
+    k = gather_pages(k_pool, page_table)
+    v = gather_pages(v_pool, page_table)
+    return prefill_attention_reference(q, k, v, offsets, scale=scale)
+
+
+def _paged_prefill_kernel(pt_ref, off_ref, q_ref, k_ref, v_ref, o_ref,
+                          acc_ref, m_ref, l_ref, *, scale, block_q,
+                          page_len):
+    """Grid (b, h, nq, max_pages): one batch row x head, q-blocked
+    chunk, one pool page per KV step. :func:`_prefill_kernel`'s (m, l)
+    recurrence and global-position shifted-causal mask; the page the
+    DMA fetched was chosen by the scalar-prefetch index map."""
+    b = pl.program_id(0)
+    qi = pl.program_id(2)
+    ji = pl.program_id(3)
+    nj = pl.num_programs(3)
+    offset = off_ref[b]
+
+    @pl.when(ji == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # skip pages entirely past this q-block's LAST global position
+    @pl.when(ji * page_len <= offset + qi * block_q + block_q - 1)
+    def _body():
+        q = q_ref[0, 0].astype(jnp.float32)                  # [bq, d]
+        k = k_ref[0, 0].astype(jnp.float32)                  # [pl, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale      # [bq, pl]
+        rows = offset + qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, page_len), 0)
+        cols = ji * page_len + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, page_len), 1)
+        s = jnp.where(cols <= rows, s, _NEG_INF)
+        m_prev = m_ref[:, :1]                                # [bq, 1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                               # [bq, pl]
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v_ref[0, 0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ji == nj - 1)
+    def _finish():
+        l = l_ref[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+
+
+def _paged_prefill_pallas(q, k_pool, v_pool, pt, offsets, scale, bq,
+                          interpret):
+    B, h, C, d = q.shape
+    page_len = k_pool.shape[2]
+    max_pages = pt.shape[1]
+    kernel = functools.partial(_paged_prefill_kernel, scale=scale,
+                               block_q=bq, page_len=page_len)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,                   # page_table, offsets
+        grid=(B, h, C // bq, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d),
+                         lambda b, hh, i, j, pt, off: (b, hh, i, 0)),
+            pl.BlockSpec((1, 1, page_len, d),
+                         lambda b, hh, i, j, pt, off: (pt[b, j], hh, 0, 0)),
+            pl.BlockSpec((1, 1, page_len, d),
+                         lambda b, hh, i, j, pt, off: (pt[b, j], hh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, d),
+                               lambda b, hh, i, j, pt, off: (b, hh, i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),      # acc
+            pltpu.VMEM((bq, 128), jnp.float32),    # m (col 0 live)
+            pltpu.VMEM((bq, 128), jnp.float32),    # l (col 0 live)
+        ],
+    )
+    return pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, h, C, d), q.dtype),
+        interpret=interpret,
+    )(pt, offsets, q, k_pool, v_pool)
+
+
+def _resolve_page_block_q(block_q):
+    if block_q is None:
+        block_q = vmem.get_override("decode.page_block_q",
+                                    DEFAULT_BLOCK_Q, multiple=8)
+    return block_q
+
+
+def paged_prefill_attention(q, k_pool, v_pool, page_table, offsets, *,
+                            scale: Optional[float] = None,
+                            block_q: Optional[int] = None,
+                            interpret: bool = False):
+    """Chunk-of-queries attention against a PAGED cached prefix.
+
+    ``q`` [batch, heads, C, head_dim] — C consecutive prompt tokens
+    whose K/V are already written into the pool at logical positions
+    ``[offsets[b], offsets[b] + C)`` of row ``b``'s pages; ``k_pool``/
+    ``v_pool`` [num_pages, heads, page_len, head_dim] (one layer of the
+    serving pool); ``page_table`` [batch, max_pages] int32;
+    ``offsets`` [batch] int32. Query row ``i`` attends logical cache
+    positions ``[0, offsets[b] + i]`` — the shifted-causal mask of
+    chunked prefill, unchanged by the paging. ``scale`` defaults to
+    ``1/sqrt(head_dim)``.
+
+    Inference-only. The Pallas path walks each row's page list via
+    scalar-prefetch index maps and skips pages past each q-block's last
+    global position — O(offset + C) MXU work per chunk, same as the
+    contiguous kernel, over a pool that is dense and shared instead of
+    slot-partitioned. Unaligned shapes and non-Mosaic dtypes fall back
+    to the gather-then-reference oracle.
+
+    Tuned geometry: ``decode.page_block_q`` in the
+    :mod:`apex_tpu.kernels.vmem` override registry (the KV block is one
+    pool page by construction).
+    """
+    B, h, C, d = q.shape
+    P, hp, page_len, dp = k_pool.shape
+    if v_pool.shape != k_pool.shape or hp != h or dp != d:
+        raise ValueError(f"paged_prefill_attention: pools "
+                         f"{k_pool.shape}/{v_pool.shape} do not match q "
+                         f"{q.shape}")
+    if page_table.ndim != 2 or page_table.shape[0] != B:
+        raise ValueError(f"paged_prefill_attention: page_table "
+                         f"{page_table.shape} must be [{B}, max_pages]")
+    if offsets.shape != (B,):
+        raise ValueError(f"paged_prefill_attention: offsets "
+                         f"{offsets.shape} must be [{B}]")
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    from apex_tpu.kernels.flash_attention import _fit_block, _has_vma
+    bq = _fit_block(_resolve_page_block_q(block_q), C, 8)
+    if jax.default_backend() == "cpu":
+        interpret = True
+    pallas_ok = (C % bq == 0 and bq % 8 == 0 and d % 8 == 0
+                 and page_len % 128 == 0)
+    if not pallas_ok or (interpret and _has_vma(q)) \
+            or (not interpret and not mosaic_dtype_ok(q, k_pool, v_pool)):
+        return paged_prefill_attention_reference(
+            q, k_pool, v_pool, page_table, offsets, scale=scale)
+    pt = jnp.asarray(page_table, jnp.int32)
+    off32 = jnp.asarray(offsets, jnp.int32)
+    return _paged_prefill_pallas(q, k_pool, v_pool, pt, off32, scale, bq,
+                                 interpret).astype(q.dtype)

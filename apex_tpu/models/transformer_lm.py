@@ -52,6 +52,14 @@ class SelfAttention(nn.Module):
       positions[b] + S)`` and each attends the cached prefix up to and
       including itself (write-then-attend, shifted-causal) via
       :func:`apex_tpu.kernels.prefill_attention.prefill_attention`.
+    - **paged decode / chunked prefill** (``cache=(k_pool, v_pool,
+      page_table)``): same two modes over the serving engine's paged
+      pool — K/V scatter by page id (``page_table[b, pos // page_len]``
+      at in-page offset ``pos % page_len``) and attention gathers
+      through the table via the ``paged_*`` kernel variants. The
+      returned aux is the UPDATED POOL pair (pages are shared across
+      rows), not per-row caches; chunk writes must be page-aligned and
+      whole-page (the engine enforces ``chunk_len % page_len == 0``).
 
     ``inference_dtype`` is the decode path's storage/compute dtype: when
     set, Q/K/V leave the qkv GEMM in that dtype (normally the amp half —
@@ -83,38 +91,92 @@ class SelfAttention(nn.Module):
         qkv = qkv.reshape(B, S, 3, self.num_heads, d).transpose(2, 0, 3, 1, 4)
         q, k, v = qkv[0], qkv[1], qkv[2]             # [B, h, S, d]
         if cache is not None:
-            k_cache, v_cache = cache                 # [B, h, L, d]
+            paged = len(cache) == 3
+            if paged:
+                # paged layout: (k_pool, v_pool, page_table) — pool
+                # [num_pages, h, page_len, d] shared across rows, table
+                # [B, max_pages] int32 mapping logical blocks to pages.
+                # Writes scatter by page id; attention gathers through
+                # the table (the serving engine's block-table refactor).
+                k_cache, v_cache, page_table = cache
+                page_len = k_cache.shape[2]
+                L = page_table.shape[1] * page_len
+            else:
+                k_cache, v_cache = cache             # [B, h, L, d]
+                L = k_cache.shape[2]
             # clip is a traced-value safety net only: an out-of-range
             # offset would RELOCATE the S-wide write over earlier cache
             # rows, so callers must bound positions host-side (the
             # serving engine validates offset + chunk_len <= max_len)
-            pos = jnp.clip(jnp.asarray(positions, jnp.int32), 0,
-                           k_cache.shape[2] - S)
+            pos = jnp.clip(jnp.asarray(positions, jnp.int32), 0, L - S)
             if S == 1:
-                from apex_tpu.kernels.decode_attention import \
-                    decode_attention
-                bidx = jnp.arange(B)
-                k_cache = k_cache.at[bidx, :, pos].set(
-                    jnp.asarray(k[:, :, 0], k_cache.dtype))
-                v_cache = v_cache.at[bidx, :, pos].set(
-                    jnp.asarray(v[:, :, 0], v_cache.dtype))
-                # write-then-attend: the token sees its own (cached) K/V
-                ctx = decode_attention(q[:, :, 0], k_cache, v_cache,
-                                       pos + 1)
+                from apex_tpu.kernels.decode_attention import (
+                    decode_attention, paged_decode_attention)
+                if paged:
+                    # the write page: logical block pos // page_len of
+                    # each row. Inactive slots' tables point at the
+                    # sentinel page, so their (discarded) write can
+                    # never corrupt a live row; a live slot's write
+                    # page is uniquely owned (shared pages are always
+                    # full — copy-on-write by construction).
+                    page_ids = jnp.take_along_axis(
+                        page_table, (pos // page_len)[:, None],
+                        axis=1)[:, 0]
+                    off = pos % page_len
+                    k_cache = k_cache.at[page_ids, :, off].set(
+                        jnp.asarray(k[:, :, 0], k_cache.dtype))
+                    v_cache = v_cache.at[page_ids, :, off].set(
+                        jnp.asarray(v[:, :, 0], v_cache.dtype))
+                    ctx = paged_decode_attention(
+                        q[:, :, 0], k_cache, v_cache, page_table,
+                        pos + 1)
+                else:
+                    bidx = jnp.arange(B)
+                    k_cache = k_cache.at[bidx, :, pos].set(
+                        jnp.asarray(k[:, :, 0], k_cache.dtype))
+                    v_cache = v_cache.at[bidx, :, pos].set(
+                        jnp.asarray(v[:, :, 0], v_cache.dtype))
+                    # write-then-attend: the token sees its own K/V
+                    ctx = decode_attention(q[:, :, 0], k_cache, v_cache,
+                                           pos + 1)
             else:
-                from apex_tpu.kernels.prefill_attention import \
-                    prefill_attention
-
-                # chunked prefill: S tokens land at [pos, pos + S) of
-                # each row's cache (vmapped so per-row offsets differ)
-                def _write(row, new, p):
-                    return jax.lax.dynamic_update_slice(row, new,
-                                                        (0, p, 0))
-                k_cache = jax.vmap(_write)(
-                    k_cache, jnp.asarray(k, k_cache.dtype), pos)
-                v_cache = jax.vmap(_write)(
-                    v_cache, jnp.asarray(v, v_cache.dtype), pos)
-                ctx = prefill_attention(q, k_cache, v_cache, pos)
+                from apex_tpu.kernels.prefill_attention import (
+                    prefill_attention, paged_prefill_attention)
+                if paged:
+                    # chunk writes must cover whole pages: the serving
+                    # engine pins chunk_len % page_len == 0 and page-
+                    # aligned offsets, so the chunk's S positions are
+                    # exactly S // page_len freshly-allocated pages
+                    if S % page_len:
+                        raise ValueError(
+                            f"paged chunk prefill needs S ({S}) to be "
+                            f"a multiple of page_len ({page_len})")
+                    npg = S // page_len
+                    idx = (pos // page_len)[:, None] + jnp.arange(
+                        npg, dtype=jnp.int32)[None, :]
+                    chunk_pages = jnp.take_along_axis(page_table, idx,
+                                                      axis=1)  # [B, npg]
+                    def _pages(x, dtype):
+                        return jnp.asarray(x, dtype).reshape(
+                            B, self.num_heads, npg, page_len, d
+                        ).transpose(0, 2, 1, 3, 4)   # [B, npg, h, pl, d]
+                    k_cache = k_cache.at[chunk_pages].set(
+                        _pages(k, k_cache.dtype))
+                    v_cache = v_cache.at[chunk_pages].set(
+                        _pages(v, v_cache.dtype))
+                    ctx = paged_prefill_attention(q, k_cache, v_cache,
+                                                  page_table, pos)
+                else:
+                    # chunked prefill: S tokens land at [pos, pos + S)
+                    # of each row's cache (vmapped per-row offsets)
+                    def _write(row, new, p):
+                        return jax.lax.dynamic_update_slice(row, new,
+                                                            (0, p, 0))
+                    k_cache = jax.vmap(_write)(
+                        k_cache, jnp.asarray(k, k_cache.dtype), pos)
+                    v_cache = jax.vmap(_write)(
+                        v_cache, jnp.asarray(v, v_cache.dtype), pos)
+                    ctx = prefill_attention(q, k_cache, v_cache, pos)
             out = jnp.moveaxis(ctx.reshape(B, self.num_heads, S, d),
                                1, 2).reshape(B, S, self.hidden)
         else:
@@ -273,8 +335,13 @@ class TransformerLM(nn.Module):
                               self.dropout, self.dtype, self.param_dtype,
                               self.inference_dtype, name=f"block_{i}")
             if cache is not None:
-                x, (lk, lv) = block(x, train, cache=(cache[0][i],
-                                                     cache[1][i]),
+                # 2-tuple: per-slot rows [layers, B, h, L, d]; 3-tuple:
+                # paged pools [layers, P, h, page_len, d] + one shared
+                # [B, max_pages] page table (see SelfAttention)
+                layer_cache = (cache[0][i], cache[1][i])
+                if len(cache) == 3:
+                    layer_cache = layer_cache + (cache[2],)
+                x, (lk, lv) = block(x, train, cache=layer_cache,
                                     positions=positions)
                 kv_out[0].append(lk)
                 kv_out[1].append(lv)

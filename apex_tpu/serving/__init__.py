@@ -5,25 +5,33 @@ stops at the optimizer step; this subsystem opens the inference
 workload the north star calls for — serving a stream of variable-length
 generation requests from a fixed set of compiled programs:
 
-- :class:`KVCache` (:mod:`.kv_cache`) — preallocated
-  ``[layers, slots, heads, max_len, head_dim]`` slot cache with
-  per-slot lengths, stored in the amp half dtype.
-- :class:`Engine` (:mod:`.engine`) — exactly four XLA executables
-  (jitted chunk-prefill + jitted decode step + the legacy monolithic
-  prefill baseline + the prefix-reuse KV row-copy, fixed shapes, traced
-  slot/offset/length/temperature scalars), greedy / temperature / top-k
-  sampling compiled in; decode attention through
-  :func:`apex_tpu.kernels.decode_attention.decode_attention` and chunk
-  attention through
-  :func:`apex_tpu.kernels.prefill_attention.prefill_attention`
-  (length-masked, ``decode.*`` tuned-block keys).
+- :class:`PagedKVCache` + :class:`PagePool` (:mod:`.kv_cache`) — the
+  DEFAULT cache layout: a dense ``[layers, num_pages, heads, page_len,
+  head_dim]`` page pool plus a host-side allocator (free list, page
+  refcounts, admission reservations). Requests own page lists, not
+  rows: short prompts stop paying ``max_len`` HBM, freed pages return
+  to the pool immediately, and prefix hits are copy-on-write page
+  shares (refcount bump — zero data movement). :class:`KVCache` keeps
+  the original contiguous per-slot-row layout as the parity oracle and
+  measurable baseline (``Engine(paged=False)``).
+- :class:`Engine` (:mod:`.engine`) — exactly THREE XLA executables on
+  the paged path (jitted chunk-prefill + decode step + the legacy
+  monolithic prefill baseline, each gathering K/V through a
+  ``[slots, max_pages]`` page-table operand; traced offset/length/
+  temperature scalars), four on the contiguous path (+ the prefix KV
+  row-copy, retired from the paged hit path); greedy / temperature /
+  top-k sampling compiled in; attention through the ``decode.*``-tuned
+  kernels of :mod:`apex_tpu.kernels.decode_attention` /
+  :mod:`apex_tpu.kernels.prefill_attention` and their ``paged_*``
+  page-table variants.
 - :class:`PrefixCache` (:mod:`.prefix_cache`) — content-addressed
   prompt-prefix reuse: retained prefixes keyed by a rolling hash over
-  ``chunk_len``-aligned token blocks, held in ``prefix_pool`` cache
-  rows with refcount pinning + LRU eviction; an admission hit restores
-  the longest cached prefix by one row-copy and skips
-  ``matched_len / chunk_len`` chunks of prefill compute, bitwise
-  token-exact vs. the cold path.
+  ``chunk_len``-aligned token blocks. Paged: entries record the page
+  ids already holding the prefix (registration and hits are refcount
+  bumps; LRU eviction under pool pressure only). Contiguous: entries
+  own ``prefix_pool`` cache rows with refcount pinning + LRU eviction,
+  hits restored by one row-copy. Both skip ``matched_len / chunk_len``
+  chunks of prefill compute, token-exact vs. the cold path.
 - :class:`Scheduler` (:mod:`.scheduler`) — continuous batching with
   chunked prefill fused into the decode heartbeat: admit-into-free-slots,
   at most ``chunk_budget`` compiled chunk-prefill steps per tick (so
@@ -53,9 +61,10 @@ Exercised end-to-end by ``bench_serving.py`` and
 """
 
 from .engine import Engine, sample_tokens
-from .kv_cache import KVCache
+from .kv_cache import KVCache, PagedKVCache, PagePool
 from .prefix_cache import PrefixCache, PrefixMatch
 from .scheduler import QueueFull, Request, Scheduler
 
-__all__ = ["Engine", "KVCache", "PrefixCache", "PrefixMatch", "QueueFull",
-           "Request", "Scheduler", "sample_tokens"]
+__all__ = ["Engine", "KVCache", "PagedKVCache", "PagePool",
+           "PrefixCache", "PrefixMatch", "QueueFull", "Request",
+           "Scheduler", "sample_tokens"]

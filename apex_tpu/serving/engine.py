@@ -1,7 +1,23 @@
-"""Compiled inference engine: chunk-prefill, decode, prefill, KV copy.
+"""Compiled inference engine: chunk-prefill, decode, prefill (+ KV copy).
 
-The engine owns the four — and exactly four — XLA executables a
-serving process needs, each traced once at fixed shapes:
+Two cache layouts share this one class:
+
+- **paged** (the default): a dense pool of fixed-size pages
+  (:class:`~apex_tpu.serving.PagedKVCache`) addressed through per-slot
+  page tables (:class:`~apex_tpu.serving.PagePool` host allocator).
+  THREE compiled programs — chunk prefill, decode, monolithic prefill —
+  each taking a ``[.., max_pages]`` int32 page-table operand next to
+  the tokens; lengths live host-side. Prefix reuse is copy-on-write:
+  a hit SHARES the donor's pages (refcount bump, zero data movement),
+  so the fourth program of the contiguous layout — the KV row copy —
+  is retired from the hit path and never compiles here.
+- **contiguous** (``paged=False``): the original per-slot-row layout,
+  kept verbatim as the paged path's parity oracle and the measurable
+  baseline — exactly as the monolithic prefill is kept inside the
+  chunked scheduler. Its program set is the original four.
+
+The contiguous engine owns the four — and exactly four — XLA
+executables a serving process needs, each traced once at fixed shapes:
 
 - **chunk prefill** (the scheduler's ingestion path): ``[1, chunk_len]``
   tokens (one chunk of a prompt, right-padded on the final partial
@@ -48,13 +64,33 @@ an exact-fp32 engine (the decode-parity tests' configuration).
 
 Trace accounting: the python bodies of the programs run only when jax
 traces them, so ``chunk_traces``/``decode_traces``/``prefill_traces``/
-``copy_traces`` count compiles — the serving test tier pins the engine
-to exactly four compiled programs across a multi-request,
-variable-length, hit/miss/evict run that exercises all four paths.
+``copy_traces`` count compiles — the serving test tier pins the
+contiguous engine to exactly four compiled programs across a
+multi-request, variable-length, hit/miss/evict run that exercises all
+four paths, and the paged engine to exactly THREE across the same
+stream (copy-on-write sharing is host bookkeeping, not a program).
+
+Paged-mode host bookkeeping (all numpy, no device work):
+
+- ``page_len`` positions per page (``decode.page_len`` tuned key,
+  degraded to divide ``chunk_len`` — chunk writes must cover whole
+  pages so shared pages are never written);
+- a ``[slots, max_pages]`` page table mirrored to the device as an
+  operand of every call; page 0 is the sentinel the fixed-shape decode
+  program's inactive-slot writes land on;
+- worst-case page **reservation** at admission
+  (:meth:`Engine.try_reserve_slot` — the scheduler's admit gate), so an
+  admitted request can always grow to its token budget: pool pressure
+  queues requests, evicts LRU prefix entries, and ultimately surfaces
+  as submit-side ``QueueFull`` — never a mid-decode failure;
+- prefix retention/hits as page sharing (:meth:`Engine.retain_prefix` /
+  :meth:`Engine.attach_prefix`) with refcounts in the
+  :class:`~apex_tpu.serving.PagePool`.
 """
 
 from __future__ import annotations
 
+import math
 import time
 from typing import Any, Optional, Sequence
 
@@ -65,12 +101,35 @@ import numpy as np
 from apex_tpu.kernels import vmem
 from apex_tpu.log_util import get_logger
 
-from .kv_cache import KVCache
+from .kv_cache import KVCache, PagedKVCache, PagePool
 from .prefix_cache import PrefixCache
 
-__all__ = ["Engine", "sample_tokens"]
+__all__ = ["Engine", "resolve_page_len", "sample_tokens"]
 
 _logger = get_logger("serving")
+
+
+def resolve_page_len(chunk_len: int, page_len: Optional[int] = None) -> int:
+    """The paged engine's page-size resolution, exposed so external
+    sizers (``bench_serving.paged_capacity_stats``) compute pool
+    geometry with the SAME value the constructor will: an explicit
+    ``page_len`` must divide ``chunk_len`` (chunk writes must cover
+    whole pages — the copy-on-write invariant); the default is the
+    ``decode.page_len`` tuned key, else ``min(chunk_len, 128)``,
+    degraded to the largest common divisor of ``chunk_len``."""
+    chunk_len = int(chunk_len)
+    if page_len is None:
+        page_len = vmem.get_override("decode.page_len", 0) \
+            or min(chunk_len, 128)
+        if chunk_len % page_len:
+            page_len = math.gcd(page_len, chunk_len)
+    page_len = int(page_len)
+    if page_len < 1 or chunk_len % page_len:
+        raise ValueError(
+            f"page_len {page_len} must divide chunk_len {chunk_len} "
+            f"(chunk writes must cover whole pages — a partially-"
+            f"written shared page would break copy-on-write)")
+    return page_len
 
 
 def sample_tokens(logits, temperature, key, top_k: int = 0):
@@ -132,6 +191,24 @@ class Engine:
         :class:`~apex_tpu.serving.PrefixCache` as ``prefix_cache``
         (consulted by ``Scheduler(retain_prefixes=True)``). The decode
         batch stays ``[slots, 1]`` — pool rows are never computed over.
+    paged:
+        True (default) = paged pool + page-table indirection (three
+        compiled programs, copy-on-write prefix sharing); False = the
+        original contiguous per-slot-row layout (four programs, prefix
+        reuse by compiled row copy) — kept as the parity oracle and
+        measurable baseline.
+    page_len:
+        Positions per page (paged only). Default: the ``decode.page_len``
+        tuned key, else ``min(chunk_len, 128)``, degraded to the largest
+        common divisor of ``chunk_len`` — a page is the unit of sharing
+        and must be covered whole by every chunk write. An explicit
+        value that does not divide ``chunk_len`` is rejected.
+    num_pages:
+        Physical pool pages INCLUDING the page-0 sentinel (paged only).
+        Default: ``(slots + prefix_pool) * ceil(max_len / page_len) + 1``
+        — the same HBM the contiguous layout would spend on full-length
+        rows; size it down for denser sharing or up for more retained
+        prefixes.
     top_k:
         Static top-k truncation for sampled (non-greedy) slots; 0 = off.
     registry:
@@ -148,7 +225,9 @@ class Engine:
                  prefill_len: Optional[int] = None,
                  chunk_len: Optional[int] = None, policy=None,
                  prefix_pool: int = 0, top_k: int = 0, seed: int = 0,
-                 registry=None):
+                 registry=None, paged: bool = True,
+                 page_len: Optional[int] = None,
+                 num_pages: Optional[int] = None):
         from apex_tpu.amp.policy import resolve_policy
 
         if policy is None:
@@ -207,16 +286,58 @@ class Engine:
         self.params = policy.cast_params(params)
         hidden = int(model.hidden)
         heads = int(model.num_heads)
-        # pool rows ride the same arrays as the serving slots so ONE
-        # copy program (traced src/dst rows, same shapes) serves both
-        # directions of prefix reuse; decode slices them back out
-        self.cache = KVCache.create(
-            layers=int(model.num_layers),
-            slots=self.slots + self.prefix_pool, heads=heads,
-            max_len=self.max_len, head_dim=hidden // heads, dtype=half)
-        self.prefix_cache = None if self.prefix_pool == 0 else PrefixCache(
-            block_len=self.chunk_len,
-            pool_rows=range(self.slots, self.slots + self.prefix_pool))
+        layers = int(model.num_layers)
+        head_dim = hidden // heads
+        self.paged = bool(paged)
+        if self.paged:
+            self.page_len = page_len = resolve_page_len(self.chunk_len,
+                                                        page_len)
+            self.max_pages = -(-self.max_len // page_len)
+            if num_pages is None:
+                # same budget the contiguous layout would spend on
+                # (slots + prefix_pool) full-length rows, plus the
+                # sentinel — the win is that short requests no longer
+                # CONSUME their row's worth
+                num_pages = (self.slots + self.prefix_pool) \
+                    * self.max_pages + 1
+            num_pages = int(num_pages)
+            if num_pages < self.max_pages + 1:
+                raise ValueError(
+                    f"num_pages {num_pages} cannot hold even one "
+                    f"max_len request ({self.max_pages} pages) plus "
+                    f"the sentinel page")
+            self.num_pages = num_pages
+            self.cache = PagedKVCache.create(
+                layers=layers, num_pages=num_pages, heads=heads,
+                page_len=page_len, head_dim=head_dim, dtype=half)
+            self.pool = PagePool(num_pages, page_len)
+            self._page_table = np.zeros((self.slots, self.max_pages),
+                                        np.int32)
+            self._n_pages = np.zeros(self.slots, np.int32)
+            self._host_len = np.zeros(self.slots, np.int32)
+            self._slot_reserved = np.zeros(self.slots, np.int32)
+            # paged prefix reuse needs no reserved rows — retained
+            # prefixes share the one pool; prefix_pool sizes the EXTRA
+            # capacity set aside for them in the num_pages default and
+            # gates the feature on, exactly as before
+            self.prefix_cache = None if self.prefix_pool == 0 else \
+                PrefixCache(block_len=self.chunk_len, pool_rows=(),
+                            on_evict=self.pool.release)
+        else:
+            self.pool = None
+            # pool rows ride the same arrays as the serving slots so
+            # ONE copy program (traced src/dst rows, same shapes)
+            # serves both directions of prefix reuse; decode slices
+            # them back out
+            self.cache = KVCache.create(
+                layers=layers, slots=self.slots + self.prefix_pool,
+                heads=heads, max_len=self.max_len, head_dim=head_dim,
+                dtype=half)
+            self.prefix_cache = None if self.prefix_pool == 0 else \
+                PrefixCache(
+                    block_len=self.chunk_len,
+                    pool_rows=range(self.slots,
+                                    self.slots + self.prefix_pool))
         self._registry = registry
         self._key = jax.random.PRNGKey(seed)
         self.prefill_traces = 0
@@ -230,17 +351,38 @@ class Engine:
                                         multiple=8) or None
         self._pf_bk = vmem.get_override("decode.prefill_block_k", 0,
                                         multiple=128) or None
-        self._jit_prefill = jax.jit(self._prefill_impl,
-                                    donate_argnums=(1,))
-        self._jit_decode = jax.jit(self._decode_impl, donate_argnums=(1,))
-        self._jit_chunk = jax.jit(self._chunk_impl, donate_argnums=(1,))
-        self._jit_copy = jax.jit(self._copy_impl, donate_argnums=(0,))
-        _logger.info(
-            "serving engine: %d slots x %d positions, prefill_len=%d, "
-            "chunk_len=%d, prefix_pool=%d, cache %s (%.1f MiB), top_k=%d",
-            self.slots, self.max_len, self.prefill_len, self.chunk_len,
-            self.prefix_pool, np.dtype(half).name,
-            self.cache.nbytes() / 2**20, self.top_k)
+        if self.paged:
+            self._jit_prefill = jax.jit(self._paged_prefill_impl,
+                                        donate_argnums=(1,))
+            self._jit_decode = jax.jit(self._paged_decode_impl,
+                                       donate_argnums=(1,))
+            self._jit_chunk = jax.jit(self._paged_chunk_impl,
+                                      donate_argnums=(1,))
+            self._jit_copy = None      # retired: hits share pages
+            _logger.info(
+                "serving engine (paged): %d slots x %d positions, "
+                "prefill_len=%d, chunk_len=%d, page_len=%d, %d pages "
+                "(+1 sentinel in count), prefix_pool=%d, cache %s "
+                "(%.1f MiB), top_k=%d",
+                self.slots, self.max_len, self.prefill_len,
+                self.chunk_len, self.page_len, self.num_pages,
+                self.prefix_pool, np.dtype(half).name,
+                self.cache.nbytes() / 2**20, self.top_k)
+        else:
+            self._jit_prefill = jax.jit(self._prefill_impl,
+                                        donate_argnums=(1,))
+            self._jit_decode = jax.jit(self._decode_impl,
+                                       donate_argnums=(1,))
+            self._jit_chunk = jax.jit(self._chunk_impl,
+                                      donate_argnums=(1,))
+            self._jit_copy = jax.jit(self._copy_impl, donate_argnums=(0,))
+            _logger.info(
+                "serving engine: %d slots x %d positions, prefill_len=%d,"
+                " chunk_len=%d, prefix_pool=%d, cache %s (%.1f MiB), "
+                "top_k=%d",
+                self.slots, self.max_len, self.prefill_len,
+                self.chunk_len, self.prefix_pool, np.dtype(half).name,
+                self.cache.nbytes() / 2**20, self.top_k)
 
     @property
     def compiled_programs(self) -> int:
@@ -304,6 +446,69 @@ class Engine:
         self.copy_traces += 1       # python body runs at trace time only
         return cache.copy_slot(src, dst, length)
 
+    # -------------------------------------------- compiled bodies (paged)
+    def _paged_prefill_impl(self, params, cache, tokens, pt_row, length,
+                            temperature, key):
+        self.prefill_traces += 1    # python body runs at trace time only
+        logits, (k_new, v_new) = self._model.apply(
+            {"params": params}, tokens, train=False, return_kv=True)
+        # scatter the padded [0, prefill_len) window into the slot's
+        # pages: m whole pages, ids from the (traced) page-table row
+        pl_ = self.page_len
+        m = -(-self.prefill_len // pl_)
+        pad = m * pl_ - self.prefill_len
+        pages = jax.lax.dynamic_slice_in_dim(pt_row[0], 0, m)    # [m]
+
+        def _scatter(pool, new):
+            new = jnp.asarray(new, pool.dtype)
+            if pad:
+                new = jnp.pad(new, ((0, 0), (0, 0), (0, 0), (0, pad),
+                                    (0, 0)))
+            # [layers, 1, h, m*pl, d] -> [layers, m, h, pl, d]
+            new = new[:, 0].reshape(cache.layers, cache.heads, m, pl_,
+                                    cache.head_dim).transpose(0, 2, 1, 3,
+                                                              4)
+            return pool.at[:, pages].set(new)
+
+        cache = cache.replace(k=_scatter(cache.k, k_new),
+                              v=_scatter(cache.v, v_new))
+        last = jax.lax.dynamic_index_in_dim(logits[0], length - 1,
+                                            keepdims=False)        # [V]
+        token = sample_tokens(last[None], temperature[None], key,
+                              self.top_k)[0]
+        return cache, token
+
+    def _paged_chunk_impl(self, params, cache, tokens, pt_row, offset,
+                          n_valid, temperature, key):
+        self.chunk_traces += 1      # python body runs at trace time only
+        offset = jnp.asarray(offset, jnp.int32)
+        logits, (k2, v2) = self._model.apply(
+            {"params": params}, tokens, train=False,
+            cache=(cache.k, cache.v, pt_row), positions=offset[None])
+        cache = cache.replace(k=k2, v=v2)
+        # sample at the last VALID row (see _chunk_impl)
+        last = jax.lax.dynamic_index_in_dim(logits[0], n_valid - 1,
+                                            keepdims=False)        # [V]
+        token = sample_tokens(last[None], temperature[None], key,
+                              self.top_k)[0]
+        return cache, token
+
+    def _paged_decode_impl(self, params, cache, last_tokens, page_table,
+                           lengths, temperature, key):
+        self.decode_traces += 1     # python body runs at trace time only
+        # lengths are HOST state in the paged layout (the allocator owns
+        # them); the program is a pure function of the operands. Length
+        # growth happens host-side after the call — inactive slots'
+        # tables point at the sentinel page, so their discarded write
+        # cannot land on a live request's page.
+        positions = jnp.minimum(lengths, self.max_len - 1)
+        logits, (k2, v2) = self._model.apply(
+            {"params": params}, last_tokens[:, None], train=False,
+            cache=(cache.k, cache.v, page_table), positions=positions)
+        tokens = sample_tokens(logits[:, 0, :], temperature, key,
+                               self.top_k)
+        return cache.replace(k=k2, v=v2), tokens
+
     # ------------------------------------------------------------- host API
     def _next_key(self):
         self._key, sub = jax.random.split(self._key)
@@ -328,10 +533,27 @@ class Engine:
         tokens = np.zeros((1, self.prefill_len), np.int32)
         tokens[0, :n] = np.asarray(prompt, np.int32)
         t0 = time.perf_counter()
-        self.cache, token = self._with_prefill_blocks(
-            lambda: self._jit_prefill(
-                self.params, self.cache, jnp.asarray(tokens), np.int32(n),
-                np.int32(slot), np.float32(temperature), self._next_key()))
+        if self.paged:
+            # monolithic prefill writes the full padded window: the
+            # slot restarts cold (stale pages released, the admission
+            # reservation — if the scheduler made one — kept so the
+            # fresh pages draw it down rather than eating into other
+            # slots' promises) with enough pages to hold it
+            self.release_slot(slot, keep_reservation=True)
+            self._grow_slot(slot, -(-self.prefill_len // self.page_len))
+            self.cache, token = self._with_prefill_blocks(
+                lambda: self._jit_prefill(
+                    self.params, self.cache, jnp.asarray(tokens),
+                    jnp.asarray(self._page_table[slot:slot + 1]),
+                    np.int32(n), np.float32(temperature),
+                    self._next_key()))
+            self._host_len[slot] = n
+        else:
+            self.cache, token = self._with_prefill_blocks(
+                lambda: self._jit_prefill(
+                    self.params, self.cache, jnp.asarray(tokens),
+                    np.int32(n), np.int32(slot), np.float32(temperature),
+                    self._next_key()))
         token = int(token)
         if self._registry is not None:
             self._registry.observe("serving.prefill.s",
@@ -377,10 +599,31 @@ class Engine:
         tokens = np.zeros((1, self.chunk_len), np.int32)
         tokens[0, :n] = np.asarray(chunk, np.int32)
         t0 = time.perf_counter()
-        self.cache, token = self._jit_chunk(
-            self.params, self.cache, jnp.asarray(tokens),
-            np.int32(slot), np.int32(offset), np.int32(n),
-            np.float32(temperature), self._next_key())
+        if self.paged:
+            if offset % self.page_len:
+                raise ValueError(
+                    f"paged chunk offset {offset} must be page-aligned "
+                    f"(page_len={self.page_len})")
+            if offset == 0:
+                # cold start on a (possibly re-used) slot: stale pages
+                # back to the pool, the admission reservation kept (the
+                # fresh pages must draw it down, not eat into other
+                # slots' promises). A prefix hit instead enters through
+                # attach_prefix, which resumes at a non-zero offset.
+                self.release_slot(slot, keep_reservation=True)
+            self._grow_slot(
+                slot, -(-(offset + self.chunk_len) // self.page_len))
+            self.cache, token = self._jit_chunk(
+                self.params, self.cache, jnp.asarray(tokens),
+                jnp.asarray(self._page_table[slot:slot + 1]),
+                np.int32(offset), np.int32(n), np.float32(temperature),
+                self._next_key())
+            self._host_len[slot] = offset + n
+        else:
+            self.cache, token = self._jit_chunk(
+                self.params, self.cache, jnp.asarray(tokens),
+                np.int32(slot), np.int32(offset), np.int32(n),
+                np.float32(temperature), self._next_key())
         token = int(token)
         if self._registry is not None:
             self._registry.observe("serving.prefill_chunk_s",
@@ -417,14 +660,23 @@ class Engine:
         return -(-int(prompt_len) // self.chunk_len)
 
     def copy_kv(self, src: int, dst: int, length: int) -> None:
-        """The fourth compiled program: copy row ``src``'s K/V into row
-        ``dst`` and set ``dst``'s length to ``length`` (traced scalars —
-        one executable serves every donor/destination/length triple).
-        Rows address serving slots AND prefix-pool rows, so registration
-        (slot → pool row) and restoration (pool row → admitted slot) are
-        the same program. Cheap by construction: one ``[layers, heads,
-        max_len, head_dim]`` device-to-device copy, no attention or MLP
-        compute."""
+        """The contiguous layout's fourth compiled program: copy row
+        ``src``'s K/V into row ``dst`` and set ``dst``'s length to
+        ``length`` (traced scalars — one executable serves every
+        donor/destination/length triple). Rows address serving slots AND
+        prefix-pool rows, so registration (slot → pool row) and
+        restoration (pool row → admitted slot) are the same program.
+        Cheap by construction: one ``[layers, heads, max_len, head_dim]``
+        device-to-device copy, no attention or MLP compute. RETIRED on
+        the paged path — prefix reuse there is a page-refcount bump
+        (:meth:`attach_prefix` / :meth:`retain_prefix`), zero data
+        movement — so a paged engine refuses to compile it."""
+        if self.paged:
+            raise RuntimeError(
+                "copy_kv is retired on the paged engine: prefix hits "
+                "share pages (copy-on-write) instead of copying rows — "
+                "use attach_prefix/retain_prefix, or build "
+                "Engine(paged=False) for the contiguous baseline")
         rows = self.slots + self.prefix_pool
         if not 0 <= src < rows or not 0 <= dst < rows:
             raise ValueError(f"copy rows ({src} -> {dst}) must be in "
@@ -475,18 +727,185 @@ class Engine:
                 else:
                     vmem.set_override(k, saved[k])
 
+    # ------------------------------------------------- paged host bookkeeping
+    def _require_paged(self, what: str) -> None:
+        if not self.paged:
+            raise RuntimeError(f"{what} is a paged-engine operation; "
+                               "this engine was built with paged=False")
+
+    def _alloc_page(self, slot: int) -> int:
+        """One fresh page for ``slot`` (drawing down its admission
+        reservation when it has one). Pool pressure first evicts LRU
+        prefix entries — retained prefixes are a cache, live requests
+        are not — then fails loudly: with scheduler-driven admission the
+        reservation makes this unreachable; a direct caller that
+        overcommits gets an exception, not silent corruption."""
+        reserved = self._slot_reserved[slot] > 0
+        page = self.pool.alloc(reserved=reserved)
+        while page is None and self.prefix_cache is not None \
+                and self.prefix_cache.evict_lru():
+            page = self.pool.alloc(reserved=reserved)
+        if page is None:
+            raise RuntimeError(
+                f"KV page pool exhausted ({self.num_pages} pages, "
+                f"page_len={self.page_len}) — admit through the "
+                "scheduler (page reservation) or build a larger pool")
+        if reserved:
+            self._slot_reserved[slot] -= 1
+        return page
+
+    def _grow_slot(self, slot: int, n_pages: int) -> None:
+        """Ensure ``slot`` owns at least ``n_pages`` pages (appending
+        fresh ones to its table row)."""
+        have = int(self._n_pages[slot])
+        for i in range(have, min(int(n_pages), self.max_pages)):
+            self._page_table[slot, i] = self._alloc_page(slot)
+            self._n_pages[slot] = i + 1
+
+    def release_slot(self, slot: int,
+                     keep_reservation: bool = False) -> None:
+        """Return ``slot``'s pages to the pool (refcounts decide whether
+        each is truly freed — pages shared with a retained prefix or
+        another slot live on) and reset its table row to the sentinel.
+        The scheduler calls this the moment a request finishes — paged
+        reclamation is immediate, not deferred to the next overwrite.
+        ``keep_reservation`` preserves the slot's admission reservation
+        (the cold-start path inside an admitted request)."""
+        self._require_paged("release_slot")
+        n = int(self._n_pages[slot])
+        if n:
+            self.pool.release(self._page_table[slot, :n].tolist())
+        self._page_table[slot, :] = 0
+        self._n_pages[slot] = 0
+        self._host_len[slot] = 0
+        if not keep_reservation and self._slot_reserved[slot]:
+            self.pool.unreserve(int(self._slot_reserved[slot]))
+            self._slot_reserved[slot] = 0
+
+    def pages_required(self, prompt_len: int, max_new_tokens: int,
+                       monolithic: bool = False) -> int:
+        """Worst-case pages a request can touch: the padded prefill
+        extent (whole chunks — or the whole ``prefill_len`` window on
+        the monolithic path) or the decode growth to its token budget,
+        whichever reaches further, all capped at ``max_len``. The
+        scheduler reserves this at admission so mid-decode allocation
+        can never fail. Deliberately ignores any prefix-hit discount —
+        conservative admission keeps the hit/miss counters exact (the
+        match runs only for requests that actually admitted)."""
+        self._require_paged("pages_required")
+        if monolithic:
+            prefill_extent = self.prefill_len
+        else:
+            prefill_extent = min(self.chunks_for(prompt_len)
+                                 * self.chunk_len, self.max_len)
+        occupied = min(int(prompt_len) + int(max_new_tokens),
+                       self.max_len)
+        return self.pool.pages_for(max(prefill_extent, occupied))
+
+    def try_reserve_slot(self, slot: int, n_pages: int) -> bool:
+        """The scheduler's admission gate: set aside ``n_pages`` for
+        ``slot``, evicting LRU prefix entries while the pool cannot
+        cover the promise. False (nothing changed) when even a fully
+        drained prefix cache leaves the pool short — the request stays
+        queued."""
+        self._require_paged("try_reserve_slot")
+        n_pages = int(n_pages)
+        while self.pool.available < n_pages:
+            if self.prefix_cache is None \
+                    or not self.prefix_cache.evict_lru():
+                return False
+        if not self.pool.reserve(n_pages):
+            return False            # unreachable given the loop above
+        self._slot_reserved[slot] += n_pages
+        return True
+
+    def attach_prefix(self, slot: int, match) -> None:
+        """Admission-time prefix hit, paged style: the matched entry's
+        pages become the head of ``slot``'s page table by refcount bump
+        — ZERO data movement (the contiguous layout paid a compiled
+        row-copy here). Chunk prefill then resumes at the matched
+        offset; the first write past the share lands on a fresh page by
+        construction (matches are chunk-aligned, chunks cover whole
+        pages). Pages the hit shares are refunded from the slot's
+        conservative admission reservation."""
+        self._require_paged("attach_prefix")
+        pages = list(match.pages)
+        if match.length != len(pages) * self.page_len:
+            raise ValueError(
+                f"prefix match length {match.length} does not cover "
+                f"whole pages (page_len={self.page_len})")
+        self.release_slot(slot, keep_reservation=True)
+        self.pool.share(pages)
+        self._page_table[slot, :len(pages)] = pages
+        self._n_pages[slot] = len(pages)
+        self._host_len[slot] = match.length
+        refund = min(len(pages), int(self._slot_reserved[slot]))
+        if refund:
+            self._slot_reserved[slot] -= refund
+            self.pool.unreserve(refund)
+
+    def retain_prefix(self, slot: int, prompt: Sequence[int]) -> str:
+        """Registration, paged style: retain ``prompt``'s block-aligned
+        prefix by SHARING the pages that already hold it in ``slot`` —
+        no copy, no reserved rows. Returns the
+        :meth:`PrefixCache.register` outcome; on ``"registered"`` the
+        entry holds its own refcount on each page (released at entry
+        eviction), so the prefix survives the slot."""
+        self._require_paged("retain_prefix")
+        if self.prefix_cache is None:
+            raise RuntimeError("engine built without a prefix cache "
+                               "(prefix_pool=0)")
+        n_blocks = len(prompt) // self.chunk_len
+        length = n_blocks * self.chunk_len
+        n_pages = length // self.page_len
+        pages = tuple(int(p) for p in self._page_table[slot, :n_pages])
+        outcome = self.prefix_cache.register(prompt, pages=pages)
+        if outcome == "registered":
+            self.pool.share(pages)
+        return outcome
+
+    def pool_stats(self) -> dict:
+        """Paged-pool telemetry snapshot: allocator counters plus the
+        per-slot fragmentation view (allocated-but-invalid positions
+        over allocated positions)."""
+        self._require_paged("pool_stats")
+        stats = self.pool.stats()
+        stats["fragmentation"] = self.pool.fragmentation(
+            self._host_len, self._n_pages)
+        return stats
+
     def decode_step(self, last_tokens, active, temperatures) -> np.ndarray:
         """One decode step over every slot: ``last_tokens`` [slots] int
         (each slot's most recent token), ``active`` [slots] bool,
         ``temperatures`` [slots] float. Returns the next token per slot
         (host int32 array; inactive rows are noise to discard)."""
         t0 = time.perf_counter()
-        self.cache, tokens = self._jit_decode(
-            self.params, self.cache,
-            jnp.asarray(last_tokens, jnp.int32),
-            jnp.asarray(active, bool),
-            jnp.asarray(temperatures, jnp.float32), self._next_key())
-        out = np.asarray(tokens)            # device sync: step latency
+        if self.paged:
+            act = np.asarray(active, bool)
+            # write-then-attend writes at host_len: make sure each
+            # active slot's write page exists BEFORE the program runs
+            # (reservation at admission guarantees the pool can cover
+            # it; a slot at max_len clamps onto its last page)
+            for s in np.flatnonzero(act):
+                pos = int(self._host_len[s])
+                if pos < self.max_len:
+                    self._grow_slot(s, self.pool.pages_for(pos + 1))
+            self.cache, tokens = self._jit_decode(
+                self.params, self.cache,
+                jnp.asarray(last_tokens, jnp.int32),
+                jnp.asarray(self._page_table),
+                jnp.asarray(self._host_len),
+                jnp.asarray(temperatures, jnp.float32), self._next_key())
+            out = np.asarray(tokens)        # device sync: step latency
+            grow = act & (self._host_len < self.max_len)
+            self._host_len[grow] += 1
+        else:
+            self.cache, tokens = self._jit_decode(
+                self.params, self.cache,
+                jnp.asarray(last_tokens, jnp.int32),
+                jnp.asarray(active, bool),
+                jnp.asarray(temperatures, jnp.float32), self._next_key())
+            out = np.asarray(tokens)        # device sync: step latency
         n_active = int(np.sum(np.asarray(active, bool)))
         self.tokens_generated += n_active
         if self._registry is not None:
@@ -498,7 +917,10 @@ class Engine:
         return out
 
     def lengths(self) -> np.ndarray:
-        """Host view of per-slot cache lengths."""
+        """Host view of per-slot cache lengths (host state on the paged
+        path; a device read on the contiguous one)."""
+        if self.paged:
+            return self._host_len[:self.slots].copy()
         return np.asarray(self.cache.lengths)
 
     def set_registry(self, registry) -> None:
@@ -512,7 +934,17 @@ class Engine:
         prefixes SURVIVE a reset by default (they are warm state, not
         per-request state — a bench window reset must not throw away the
         cache it is measuring); pass ``clear_prefixes=True`` to drop
-        them too."""
+        them too. On the paged path the wipe also returns every slot's
+        pages to the pool (retained prefixes keep theirs via their own
+        refcounts)."""
+        if self.paged:
+            for s in range(self.slots):
+                self.release_slot(s)
+            if clear_prefixes and self.prefix_cache is not None:
+                # entry eviction releases each entry's page refs through
+                # the pool (the on_evict hook)
+                self.prefix_cache.clear()
+            return
         lengths = self.cache.lengths
         if clear_prefixes:
             lengths = jnp.zeros_like(lengths)

@@ -35,18 +35,52 @@ Slot semantics (the continuous-batching contract):
 Everything is functional: updates return a new :class:`KVCache` whose
 buffers alias the old ones under jit donation (the engine donates the
 cache to both of its compiled programs).
+
+**Paged layout** (the serving engine's default since the block-table
+refactor): :class:`PagedKVCache` replaces the per-slot rows with a
+dense pool of fixed-size pages ``[layers, num_pages, heads, page_len,
+head_dim]`` plus a host-side :class:`PagePool` allocator. A request
+owns a *page list* instead of a row: its logical positions ``[0, L)``
+live on pages ``table[0] .. table[ceil(L/page_len)-1]`` at in-page
+offsets ``pos % page_len``. The engine materialises the per-slot lists
+as a ``[slots, max_pages]`` int32 page-table operand each call; the
+attention kernels gather K/V through it. What the indirection buys:
+
+- **no per-slot max_len reservation** — a 40-token request holds
+  ``ceil(40/page_len)`` pages, not ``max_len`` positions, so the same
+  pool bytes serve far more logical requests;
+- **copy-on-write prefix sharing** — a prefix-cache hit bumps the
+  refcount of the donor's pages and writes their ids into the new
+  slot's table: zero data movement (the contiguous layout's compiled
+  ``copy_kv`` program is retired from the hit path). Shares are always
+  whole-page (matches are chunk-aligned and ``chunk_len % page_len ==
+  0``), so a shared page is never written: the first write past the
+  shared prefix lands on a freshly allocated page by construction;
+- **immediate reclamation** — a finished request's pages return to the
+  free list the moment its slot is released (refcount permitting), not
+  when the next prefill overwrites the row.
+
+Page 0 is the **sentinel/garbage page**: never allocated, it absorbs
+the fixed-shape decode program's writes for inactive slots (their page
+tables point at it) so a dead slot's discarded write can never land on
+a live request's page. Allocation is all-or-nothing with a reservation
+ledger (:meth:`PagePool.reserve`): the scheduler reserves a request's
+worst-case page demand at admission, so a request that was admitted can
+always grow to its budget — pool pressure is absorbed at the admission
+boundary (requests queue; prefix entries are evicted LRU-first), never
+mid-decode.
 """
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Iterable, List, Optional, Sequence
 
 import flax.struct
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["KVCache"]
+__all__ = ["KVCache", "PagedKVCache", "PagePool"]
 
 
 @flax.struct.dataclass
@@ -225,3 +259,209 @@ class KVCache:
         """Fraction of the decode batch spent on empty slots — the
         continuous-batching inefficiency signal (1 - occupancy)."""
         return 1.0 - self.occupancy(active)
+
+
+@flax.struct.dataclass
+class PagedKVCache:
+    """Paged KV pool pytree: ``[layers, num_pages, heads, page_len,
+    head_dim]`` K and V. Pure device storage — lengths and page tables
+    are host state (the engine's :class:`PagePool` + numpy tables,
+    passed as per-call operands), so the donated pytree is exactly the
+    two hot arrays."""
+
+    k: jnp.ndarray        # [layers, num_pages, heads, page_len, head_dim]
+    v: jnp.ndarray        # [layers, num_pages, heads, page_len, head_dim]
+
+    # ------------------------------------------------------------- geometry
+    @property
+    def layers(self) -> int:
+        return self.k.shape[0]
+
+    @property
+    def num_pages(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def heads(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def page_len(self) -> int:
+        return self.k.shape[3]
+
+    @property
+    def head_dim(self) -> int:
+        return self.k.shape[4]
+
+    @property
+    def dtype(self):
+        return self.k.dtype
+
+    def nbytes(self) -> int:
+        """Device bytes held by the pool (both K and V)."""
+        return int(self.k.size * self.k.dtype.itemsize * 2)
+
+    @classmethod
+    def create(cls, *, layers: int, num_pages: int, heads: int,
+               page_len: int, head_dim: int,
+               dtype: Any = jnp.bfloat16) -> "PagedKVCache":
+        """Allocate a zeroed pool (``dtype`` normally the amp half
+        dtype). ``num_pages`` INCLUDES the page-0 sentinel, so the
+        usable capacity is ``(num_pages - 1) * page_len`` positions."""
+        if num_pages < 2:
+            raise ValueError("num_pages must be >= 2 (page 0 is the "
+                             "sentinel/garbage page)")
+        shape = (layers, num_pages, heads, page_len, head_dim)
+        return cls(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+    def layer_view(self):
+        """The ``(k, v)`` pool pair the paged model path consumes."""
+        return self.k, self.v
+
+
+class PagePool:
+    """Host-side page allocator for a :class:`PagedKVCache`.
+
+    Three pieces of state, all numpy/python (no device work ever):
+
+    - a **free list** of allocatable page ids (page 0 — the sentinel —
+      is never on it);
+    - **refcounts** per page: a page is held once per slot whose table
+      references it plus once per prefix-cache entry retaining it;
+      :meth:`release` returns it to the free list only at refcount 0 —
+      a shared page is never freed while anything can still read it;
+    - a **reservation ledger**: :meth:`reserve` sets aside capacity
+      without naming pages, so the scheduler can guarantee at admission
+      that a request's worst-case growth (prompt + ``max_new_tokens``)
+      will find pages mid-decode. :meth:`alloc` draws down the caller's
+      reservation when one exists.
+
+    ``cow_shares`` (pages with refcount > 1) is the copy-on-write
+    telemetry signal: every such page is serving >= 2 readers for the
+    price of one.
+    """
+
+    def __init__(self, num_pages: int, page_len: int):
+        if num_pages < 2:
+            raise ValueError("num_pages must be >= 2 (page 0 is the "
+                             "sentinel/garbage page)")
+        if page_len < 1:
+            raise ValueError("page_len must be >= 1")
+        self.num_pages = int(num_pages)
+        self.page_len = int(page_len)
+        self.refcount = np.zeros(self.num_pages, np.int32)
+        # LIFO free list: recently-freed pages are re-used first (their
+        # HBM is most likely still warm in whatever cache hierarchy sits
+        # above it); ids descend so fresh pools allocate low pages first
+        self._free: List[int] = list(range(self.num_pages - 1, 0, -1))
+        self.reserved_total = 0
+
+    # ------------------------------------------------------------- capacity
+    @property
+    def free_pages(self) -> int:
+        """Pages on the free list (ignores reservations)."""
+        return len(self._free)
+
+    @property
+    def available(self) -> int:
+        """Pages an admission may still reserve: free minus already-
+        promised reservations (never negative)."""
+        return max(0, len(self._free) - self.reserved_total)
+
+    @property
+    def pages_in_use(self) -> int:
+        """Allocatable pages currently referenced (excludes sentinel)."""
+        return self.num_pages - 1 - len(self._free)
+
+    @property
+    def cow_shares(self) -> int:
+        """Pages shared by more than one reader — each is a prefix-cache
+        copy the paged layout never had to materialise."""
+        return int(np.sum(self.refcount > 1))
+
+    def pages_for(self, positions: int) -> int:
+        """Pages covering ``positions`` logical positions."""
+        return -(-int(positions) // self.page_len)
+
+    # ----------------------------------------------------------- allocation
+    def reserve(self, n: int) -> bool:
+        """Promise ``n`` pages to a future caller (no pages named yet).
+        False — and no state change — when the pool cannot cover the
+        promise on top of existing reservations."""
+        n = int(n)
+        if n < 0:
+            raise ValueError("reserve expects n >= 0")
+        if n > self.available:
+            return False
+        self.reserved_total += n
+        return True
+
+    def unreserve(self, n: int) -> None:
+        """Return unused reservation (a finished request rarely used its
+        worst case)."""
+        self.reserved_total = max(0, self.reserved_total - int(n))
+
+    def alloc(self, *, reserved: bool = False) -> Optional[int]:
+        """One page off the free list (refcount -> 1), or None when the
+        list is empty. ``reserved=True`` draws down the ledger — the
+        caller is consuming a promise made at admission."""
+        if not self._free:
+            return None
+        page = self._free.pop()
+        self.refcount[page] = 1
+        if reserved:
+            self.reserved_total = max(0, self.reserved_total - 1)
+        return page
+
+    def share(self, pages: Iterable[int]) -> None:
+        """One more reader per page (copy-on-write: a prefix hit or a
+        prefix-cache registration shares pages instead of copying)."""
+        for p in pages:
+            p = int(p)
+            if not 0 < p < self.num_pages:
+                raise ValueError(f"page {p} out of range (1, "
+                                 f"{self.num_pages})")
+            if self.refcount[p] <= 0:
+                raise ValueError(f"page {p} is free — cannot share")
+            self.refcount[p] += 1
+
+    def release(self, pages: Iterable[int]) -> None:
+        """One fewer reader per page; pages reaching refcount 0 return
+        to the free list immediately (the paged layout's instant
+        reclamation)."""
+        for p in pages:
+            p = int(p)
+            if not 0 < p < self.num_pages:
+                raise ValueError(f"page {p} out of range (1, "
+                                 f"{self.num_pages})")
+            if self.refcount[p] <= 0:
+                raise ValueError(f"page {p} already free")
+            self.refcount[p] -= 1
+            if self.refcount[p] == 0:
+                self._free.append(p)
+
+    # ------------------------------------------------------------ reporting
+    def fragmentation(self, lengths: Sequence[int],
+                      pages_per_slot: Sequence[int]) -> float:
+        """Internal fragmentation: the fraction of allocated SLOT
+        positions holding no valid token (last-page slack + padded
+        prefill windows). Prefix-entry pages held at refcount but
+        referenced by no slot are the caller's to exclude — this is the
+        per-slot view."""
+        alloc = int(np.sum(np.asarray(pages_per_slot, np.int64))) \
+            * self.page_len
+        if alloc == 0:
+            return 0.0
+        used = int(np.sum(np.asarray(lengths, np.int64)))
+        return max(0.0, 1.0 - used / alloc)
+
+    def stats(self) -> dict:
+        """Snapshot for telemetry / bench rows."""
+        return {
+            "num_pages": self.num_pages,
+            "page_len": self.page_len,
+            "pages_in_use": self.pages_in_use,
+            "pages_free": self.free_pages,
+            "pages_reserved": self.reserved_total,
+            "cow_shares": self.cow_shares,
+        }

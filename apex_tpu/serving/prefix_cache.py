@@ -41,6 +41,20 @@ work happens in the engine's copy program, injected per call as
 ``copy_fn``. Telemetry is the caller's job (the scheduler mirrors
 :meth:`stats` into ``serving.prefix.*``); the raw counters here keep the
 class importable without a registry.
+
+**Paged entries** (the block-table engine): construct with
+``pool_rows=()`` and an ``on_evict`` hook, and register with
+``pages=(...)`` instead of ``copy_fn``. A paged entry retains no pool
+row and copies nothing — it records the page ids that already hold the
+prefix (the engine bumps their refcounts on ``"registered"``), and
+eviction hands them back through ``on_evict`` (the engine wires
+:meth:`PagePool.release`, so a page still shared with a live slot
+survives its entry). Two consequences replace the contiguous pinning
+story: registration can never be ``pool_full`` (sharing costs zero new
+pages — capacity pressure moves to the engine's admission reservation,
+which calls :meth:`evict_lru` instead), and hits need no
+acquire/release (the pages protect themselves via refcounts; evicting
+a donor entry mid-request is harmless).
 """
 
 from __future__ import annotations
@@ -67,24 +81,31 @@ def _roll(h: int, block: Tuple[int, ...]) -> int:
 @dataclasses.dataclass
 class _Entry:
     """One retained prefix: ``tokens`` (the full block-aligned prefix)
-    living in cache row ``row``; ``refcount`` pins it against eviction
-    while a live slot's admission copied from it."""
+    living in cache row ``row`` (contiguous layout) or on pool pages
+    ``pages`` (paged layout; ``row`` is then a synthetic negative key);
+    ``refcount`` pins a contiguous entry against eviction while a live
+    slot's admission copied from it (paged entries need no pin — their
+    pages carry their own refcounts in the engine's page pool)."""
 
     row: int
     tokens: Tuple[int, ...]
     n_blocks: int
     refcount: int = 0
     last_used: int = 0
+    pages: Optional[Tuple[int, ...]] = None
 
 
 @dataclasses.dataclass(frozen=True)
 class PrefixMatch:
     """A verified admission-time hit: copy ``length`` positions from
     cache row ``row`` (then :meth:`PrefixCache.acquire` it for the
-    request's slot lifetime)."""
+    request's slot lifetime) — or, for a paged entry, share ``pages``
+    into the admitted slot's page table (``row`` is the entry's
+    synthetic key; no acquire needed)."""
 
     row: int
     length: int
+    pages: Optional[Tuple[int, ...]] = None
 
 
 class PrefixCache:
@@ -93,7 +114,9 @@ class PrefixCache:
     ``pool_rows`` are the cache row ids reserved for retained prefixes
     (the engine hands over ``[slots, slots + prefix_pool)``)."""
 
-    def __init__(self, *, block_len: int, pool_rows: Sequence[int]):
+    def __init__(self, *, block_len: int, pool_rows: Sequence[int] = (),
+                 on_evict: Optional[Callable[[Tuple[int, ...]],
+                                             None]] = None):
         if block_len < 1:
             raise ValueError("block_len must be >= 1")
         self.block_len = int(block_len)
@@ -101,9 +124,13 @@ class PrefixCache:
         if len(set(self.pool_rows)) != len(self.pool_rows):
             raise ValueError("pool_rows must be distinct")
         self._free: List[int] = list(self.pool_rows)
-        self._entries: Dict[int, _Entry] = {}        # row -> entry
+        self._entries: Dict[int, _Entry] = {}        # row/key -> entry
         self._index: Dict[int, Tuple[int, int]] = {}  # key -> (row, blocks)
         self._clock = itertools.count(1)
+        # paged entries: synthetic negative keys (never collide with
+        # cache row ids) + the page-release hook eviction fires
+        self._paged_key = itertools.count(-1, -1)
+        self._on_evict = on_evict
         # raw counters (the scheduler mirrors them into serving.prefix.*)
         self.hits = 0
         self.misses = 0
@@ -167,7 +194,15 @@ class PrefixCache:
             if tuple(entry.tokens[:length]) != tuple(
                     int(t) for t in prompt[:length]):
                 continue
-            best = PrefixMatch(row=row, length=length)
+            if entry.pages is None:
+                pages = None
+            else:
+                # the entry's page_len: its tokens spread evenly over
+                # its pages (both block- and page-aligned by the
+                # engine's registration contract)
+                page_len = len(entry.tokens) // len(entry.pages)
+                pages = entry.pages[:length // page_len]
+            best = PrefixMatch(row=row, length=length, pages=pages)
         if best is None:
             self.misses += 1
             return None
@@ -190,19 +225,30 @@ class PrefixCache:
 
     # ---------------------------------------------------------- registration
     def register(self, prompt: Sequence[int],
-                 copy_fn: Callable[[int, int], None]) -> str:
-        """Retain ``prompt``'s block-aligned prefix. ``copy_fn(row,
-        length)`` runs the engine's row-copy program (serving slot →
-        pool row ``row``) and is called at most once, only after a row
-        is secured. Returns the outcome:
+                 copy_fn: Optional[Callable[[int, int], None]] = None,
+                 *, pages: Optional[Sequence[int]] = None) -> str:
+        """Retain ``prompt``'s block-aligned prefix. Contiguous layout:
+        ``copy_fn(row, length)`` runs the engine's row-copy program
+        (serving slot → pool row ``row``) and is called at most once,
+        only after a row is secured. Paged layout: pass ``pages``
+        instead — the page ids already holding the prefix; no copy, no
+        row, and the CALLER bumps the pages' refcounts iff the outcome
+        is ``"registered"`` (eviction releases them through
+        ``on_evict``). Returns the outcome:
 
-        - ``"registered"`` — a pool row was (re)filled with the prefix;
+        - ``"registered"`` — a pool row was (re)filled with the prefix
+          (contiguous) / the prefix's pages were recorded (paged);
         - ``"duplicate"`` — the exact prefix is already retained (LRU
-          refreshed, no copy);
+          refreshed, no copy, no extra refcounts);
         - ``"too_short"`` — the prompt spans no full block;
-        - ``"pool_full"`` — every row is held by a pinned (refcount > 0)
-          entry: the graceful-degradation path, nothing was evicted.
+        - ``"pool_full"`` — contiguous only: every row is held by a
+          pinned (refcount > 0) entry — graceful degradation, nothing
+          evicted. Paged registration never hits this (sharing costs
+          zero new pages).
         """
+        if (copy_fn is None) == (pages is None):
+            raise ValueError("register takes exactly one of copy_fn "
+                             "(contiguous) or pages (paged)")
         n_blocks = len(prompt) // self.block_len
         if n_blocks == 0:
             return "too_short"
@@ -217,17 +263,29 @@ class PrefixCache:
                     int(t) for t in prompt[:length]):
                 entry.last_used = next(self._clock)
                 return "duplicate"
-        row = self._take_row()
-        if row is None:
-            self.pool_full += 1
-            return "pool_full"
-        try:
-            copy_fn(row, length)
-        except BaseException:
-            self._free.append(row)       # don't leak the row on a failed copy
-            raise
-        entry = _Entry(row=row, tokens=tuple(int(t) for t in prompt[:length]),
-                       n_blocks=n_blocks, last_used=next(self._clock))
+        if pages is not None:
+            if length % len(pages):
+                raise ValueError(
+                    f"{len(pages)} pages cannot evenly hold a "
+                    f"{length}-token prefix")
+            row = next(self._paged_key)
+            entry = _Entry(row=row,
+                           tokens=tuple(int(t) for t in prompt[:length]),
+                           n_blocks=n_blocks, last_used=next(self._clock),
+                           pages=tuple(int(p) for p in pages))
+        else:
+            row = self._take_row()
+            if row is None:
+                self.pool_full += 1
+                return "pool_full"
+            try:
+                copy_fn(row, length)
+            except BaseException:
+                self._free.append(row)   # don't leak the row on a failed copy
+                raise
+            entry = _Entry(row=row,
+                           tokens=tuple(int(t) for t in prompt[:length]),
+                           n_blocks=n_blocks, last_used=next(self._clock))
         self._entries[row] = entry
         for i, key in enumerate(keys):
             # shorter-prefix keys already owned by another entry keep
@@ -250,6 +308,17 @@ class PrefixCache:
         self._evict(victim)
         return victim.row
 
+    def evict_lru(self) -> bool:
+        """Evict the least-recently-used refcount-0 entry (pool-pressure
+        valve: the paged engine calls this when an admission reservation
+        cannot be covered — retained prefixes are a cache, the admitted
+        request is not). False when nothing is evictable."""
+        victims = [e for e in self._entries.values() if e.refcount == 0]
+        if not victims:
+            return False
+        self._evict(min(victims, key=lambda e: e.last_used))
+        return True
+
     def _evict(self, entry: _Entry) -> None:
         del self._entries[entry.row]
         for key, (_, blocks) in [(k, v) for k, v in self._index.items()
@@ -268,13 +337,22 @@ class PrefixCache:
             else:
                 self._index[key] = (heir.row, blocks)
         self.evictions += 1
+        if entry.pages is not None and self._on_evict is not None:
+            # hand the entry's page refcounts back (a page still shared
+            # with a live slot survives — the pool frees it at zero)
+            self._on_evict(entry.pages)
         _logger.debug("prefix cache evicted %d-block prefix from row %d",
                       entry.n_blocks, entry.row)
 
     # ------------------------------------------------------------- lifecycle
     def clear(self) -> None:
         """Drop every entry and index key (counters survive — they are
-        run-scoped, not cache-scoped)."""
+        run-scoped, not cache-scoped). Paged entries hand their page
+        refcounts back through ``on_evict`` so the pool reclaims them."""
+        if self._on_evict is not None:
+            for entry in self._entries.values():
+                if entry.pages is not None:
+                    self._on_evict(entry.pages)
         self._entries.clear()
         self._index.clear()
         self._free = list(self.pool_rows)

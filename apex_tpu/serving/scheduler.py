@@ -10,13 +10,25 @@ beats:
    with status ``"timeout"`` (their slot frees immediately);
 2. **admit** — while a slot is free and the queue is non-empty, pop the
    oldest request into the slot as *prefilling* (its queue wait ends
-   here — the first half of the TTFT decomposition). With
-   ``retain_prefixes=True`` admission first consults the engine's
+   here — the first half of the TTFT decomposition). On a PAGED engine
+   admission is gated on the page pool first: the head request's
+   worst-case page demand (padded prefill extent or prompt + token
+   budget, whichever is larger) must be reservable —
+   :meth:`Engine.try_reserve_slot` evicts LRU prefix entries under
+   pressure, and when even that cannot cover the demand the request
+   simply stays queued (FIFO holds; backpressure surfaces as
+   :class:`QueueFull` at submit once the queue itself fills). The
+   reservation is what makes mid-decode allocation infallible. With
+   ``retain_prefixes=True`` admission then consults the engine's
    :class:`~apex_tpu.serving.PrefixCache`: the longest cached
-   block-aligned prefix of the prompt is restored into the slot by one
-   compiled KV row-copy, the donor entry is refcount-pinned for the
-   slot's lifetime, and chunk prefill resumes at the matched offset —
-   every matched chunk is attention+MLP compute that never runs;
+   block-aligned prefix of the prompt is attached to the slot — on the
+   paged path by refcount-bumping the donor's pages into the slot's
+   page table (copy-on-write: ZERO data movement, and the matched
+   pages are refunded from the reservation), on the contiguous path by
+   one compiled KV row-copy with the donor entry refcount-pinned for
+   the slot's lifetime — and chunk prefill resumes at the matched
+   offset — every matched chunk is attention+MLP compute that never
+   runs;
 3. **chunk prefill** — at most ``chunk_budget`` (default 1) compiled
    chunk-prefill steps across the prefilling slots, round-robin. A
    prompt of P tokens ingests over ``ceil(P / chunk_len)`` heartbeats;
@@ -184,6 +196,10 @@ class Scheduler:
                 "program cannot admit it")
         if request.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        # paged note: no page-demand check is needed here — a request's
+        # worst case is capped at ceil(max_len / page_len) pages, which
+        # the Engine constructor guarantees every pool can hold, so the
+        # queue head always admits eventually as running slots drain
         if len(self._queue) >= self.max_queue:
             if self.registry is not None:
                 self.registry.counter_inc("serving.requests.rejected")
@@ -211,6 +227,12 @@ class Scheduler:
                 # the slot no longer reads from its donor prefix: unpin
                 self.engine.prefix_cache.release(self._slot_prefix[slot])
                 self._slot_prefix[slot] = None
+            if getattr(self.engine, "paged", False):
+                # immediate reclamation: the slot's pages (and any
+                # unused admission reservation) go back to the pool NOW
+                # — on the contiguous layout the row is only reclaimed
+                # by the next prefill overwriting it
+                self.engine.release_slot(slot)
         self.completed.append(request)
         if self.registry is not None:
             key = ("serving.requests.timeout" if reason == "timeout"
@@ -260,6 +282,12 @@ class Scheduler:
         for slot in range(self.engine.slots):
             if self._running[slot] is not None or not self._queue:
                 continue
+            if not self._reserve_pages(slot, self._queue[0]):
+                # pool exhausted for the HEAD request: stop admitting
+                # (FIFO — later, smaller requests must not starve it);
+                # finishing requests release pages, so the next beat
+                # retries
+                break
             r = self._queue.popleft()
             # admission ends the queue wait; prefill compute is paid one
             # chunk per heartbeat from here (_prefill_tick)
@@ -274,18 +302,40 @@ class Scheduler:
             self._running[slot] = r
             self._temps[slot] = r.temperature
 
+    def _reserve_pages(self, slot: int, r: Request,
+                       monolithic: bool = False) -> bool:
+        """Paged admission gate: reserve ``r``'s worst-case page demand
+        for ``slot`` (True on a contiguous engine — rows are
+        preallocated there). Counts ``serving.pool.admit_blocked`` when
+        the pool turns an admission away."""
+        if not getattr(self.engine, "paged", False):
+            return True
+        need = self.engine.pages_required(len(r.prompt),
+                                          r.max_new_tokens,
+                                          monolithic=monolithic)
+        ok = self.engine.try_reserve_slot(slot, need)
+        if not ok and self.registry is not None:
+            self.registry.counter_inc("serving.pool.admit_blocked")
+        return ok
+
     def _consult_prefix_cache(self, r: Request, slot: int) -> None:
-        """Admission-time read path: restore the longest cached
-        block-aligned prefix of ``r.prompt`` into ``slot`` (one compiled
-        row-copy) and pin the donor entry for the slot's lifetime; chunk
-        prefill then resumes at the matched offset. A miss changes
-        nothing — the request prefills cold from offset 0."""
+        """Admission-time read path: attach the longest cached
+        block-aligned prefix of ``r.prompt`` to ``slot`` — paged: share
+        the donor's pages into the slot's table (copy-on-write, zero
+        data movement, no pin needed: page refcounts outlive the
+        entry); contiguous: one compiled row-copy with the donor entry
+        pinned for the slot's lifetime. Chunk prefill then resumes at
+        the matched offset. A miss changes nothing — the request
+        prefills cold from offset 0."""
         pcache = self.engine.prefix_cache
         m = pcache.match(r.prompt)
         if m is not None:
-            self.engine.restore_prefix(slot, m.row, m.length)
-            pcache.acquire(m)
-            self._slot_prefix[slot] = m
+            if getattr(self.engine, "paged", False):
+                self.engine.attach_prefix(slot, m)
+            else:
+                self.engine.restore_prefix(slot, m.row, m.length)
+                pcache.acquire(m)
+                self._slot_prefix[slot] = m
             r._prefill_pos = m.length
             r.reused_tokens = m.length
         if self.registry is not None:
@@ -311,6 +361,9 @@ class Scheduler:
             # keep filling THIS slot: a request that finishes right at
             # prefill (instant EOS / budget 1) leaves it free for the next
             while self._queue and self._running[slot] is None:
+                if not self._reserve_pages(slot, self._queue[0],
+                                           monolithic=True):
+                    return          # pool exhausted: keep FIFO, retry later
                 r = self._queue.popleft()
                 r.queue_wait_s = time.perf_counter() - r._t_submit
                 if self.registry is not None:
@@ -339,6 +392,11 @@ class Scheduler:
                     self._running[slot] = r
                     self._last_tokens[slot] = token
                     self._temps[slot] = r.temperature
+                if self._running[slot] is None \
+                        and getattr(self.engine, "paged", False):
+                    # finished right at prefill (_finish saw no slot):
+                    # free the pages + leftover reservation now
+                    self.engine.release_slot(slot)
 
     def _prefill_tick(self) -> int:
         """Run at most ``chunk_budget`` chunk-prefill steps across the
@@ -393,16 +451,21 @@ class Scheduler:
     def _register_prefix(self, r: Request, slot: int) -> None:
         """Write path, at prompt-ingestion completion: retain the
         prompt's block-aligned K/V prefix (now fully resident in
-        ``slot``) in a pool row via the same compiled row-copy.
-        Capacity-bounded: a full pool evicts its LRU refcount-0 entry;
-        a fully-pinned pool skips retention (graceful degradation — the
-        request is unaffected)."""
+        ``slot``). Paged: share the slot's pages into a cache entry —
+        zero copies, zero new pages (capacity pressure is the admission
+        gate's job). Contiguous: one compiled row-copy into a pool row;
+        a full pool evicts its LRU refcount-0 entry and a fully-pinned
+        pool skips retention (graceful degradation — the request is
+        unaffected)."""
         pcache = self.engine.prefix_cache
         before = pcache.evictions
-        outcome = pcache.register(
-            r.prompt,
-            lambda row, length: self.engine.store_prefix(row, slot,
-                                                         length))
+        if getattr(self.engine, "paged", False):
+            outcome = self.engine.retain_prefix(slot, r.prompt)
+        else:
+            outcome = pcache.register(
+                r.prompt,
+                lambda row, length: self.engine.store_prefix(row, slot,
+                                                             length))
         if self.registry is not None:
             evicted = pcache.evictions - before
             if evicted:
@@ -438,6 +501,20 @@ class Scheduler:
             self.registry.gauge_set("serving.slot_occupancy", occ)
             self.registry.observe("serving.slot_occupancy", occ)
             self.registry.observe("serving.padding_waste", 1.0 - occ)
+            if getattr(self.engine, "paged", False):
+                # the paged pool's per-step health: HBM pressure
+                # (pages_in_use/free), sharing efficiency (cow_shares —
+                # pages serving >1 reader for one page of HBM) and
+                # internal fragmentation (allocated-but-invalid slack)
+                ps = self.engine.pool_stats()
+                self.registry.gauge_set("serving.pool.pages_in_use",
+                                        float(ps["pages_in_use"]))
+                self.registry.gauge_set("serving.pool.pages_free",
+                                        float(ps["pages_free"]))
+                self.registry.gauge_set("serving.pool.cow_shares",
+                                        float(ps["cow_shares"]))
+                self.registry.gauge_set("serving.pool.fragmentation",
+                                        float(ps["fragmentation"]))
         if not active.any():
             return chunks > 0
         tokens = self.engine.decode_step(self._last_tokens, active,

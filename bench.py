@@ -24,6 +24,14 @@ SURVEY §6/§7: time the device, not the python loop), so ``value`` becomes
 a real throughput claim: per-window rate = BATCH*STEPS / device span of
 the capture (bubbles included; ``duty_cycle`` reports busy/span). The old
 wall-clock reading stays in ``wall_clock`` for cross-round continuity.
+
+The line also carries a ``serving`` sub-object (BENCH_SERVING_LEG=0 to
+drop it): a smoke-sized paged-vs-contiguous serving capacity
+measurement via ``bench_serving.paged_capacity_stats`` — tokens/s,
+max-concurrent-requests vs contiguous rows, and HBM-bytes-per-request
+reduction — so the serving stack finally has rows in the tracked
+BENCH_* trajectory (ROADMAP's "Recent" gap). Failure-isolated: a broken
+serving stack puts {"error": ...} there, never kills the ResNet row.
 """
 
 from __future__ import annotations
@@ -101,6 +109,8 @@ def _read_env() -> dict:
         "WINDOWS": _env_int("BENCH_WINDOWS", "3"),
         "TRACE_WINDOWS": _env_int("BENCH_TRACE_WINDOWS", "3"),
         "ACCUM_STEPS": _env_int("BENCH_ACCUM_STEPS", "1"),
+        # BENCH_SERVING_LEG=0 drops the embedded serving capacity row
+        "SERVING_LEG": _env_int("BENCH_SERVING_LEG", "1"),
     }
 
 
@@ -108,6 +118,43 @@ def _median(xs):
     xs = sorted(xs)
     mid = len(xs) // 2
     return xs[mid] if len(xs) % 2 else 0.5 * (xs[mid - 1] + xs[mid])
+
+
+# Smoke geometry for the embedded serving leg: a tiny paged-vs-
+# contiguous capacity measurement (~seconds, CPU-safe). Any exported
+# BENCH_SERVING_* knob overrides a field (bench_serving._load_env's
+# env-beats-smoke contract), so TPU rows can size it up without code
+# changes.
+_SERVING_SMOKE = {
+    "SIZE": "tiny", "VOCAB": 512, "SLOTS": 4, "MAX_LEN": 128,
+    "PREFILL_LEN": 32, "REQUESTS": 12, "NEW_TOKENS": 8, "WINDOWS": 1,
+}
+
+
+def _serving_leg() -> dict:
+    """The serving trajectory row (ROADMAP: bench_serving.py had no
+    BENCH_* row): serve a short-prompt stream on the paged engine vs
+    the contiguous baseline at identical pool bytes and fold the
+    headline fields — tokens/s, max concurrent requests vs rows,
+    HBM-bytes-per-request reduction — into bench.py's one JSON line.
+    Failure-isolated: a broken serving stack yields {"error": ...}
+    here, never a lost ResNet row."""
+    try:
+        import bench_serving
+
+        bench_serving._load_env(smoke=dict(_SERVING_SMOKE))
+        _, summary = bench_serving.paged_capacity_stats()
+        return {k: summary[k] for k in (
+            "value", "unit", "baseline_tokens_per_s",
+            "max_concurrent_requests", "contiguous_slots",
+            "logical_concurrency_exceeds_rows",
+            "hbm_bytes_per_request", "hbm_bytes_per_request_contiguous",
+            "hbm_bytes_per_request_reduction_pct", "pool_mib",
+            "token_mismatched_requests", "model")}
+    except KeyboardInterrupt:
+        raise
+    except BaseException as e:  # noqa: BLE001 — the row must not die here
+        return {"error": f"{type(e).__name__}: {e}"}
 
 
 def main():
@@ -232,6 +279,10 @@ def main():
     }
     if duty:
         out["duty_cycle"] = round(_median(duty), 4)
+    if env["SERVING_LEG"]:
+        # the serving trajectory row (tokens/s + HBM-bytes-per-request
+        # finally land in the tracked BENCH_* JSON, per ROADMAP)
+        out["serving"] = _serving_leg()
     if tele is not None:
         jax.effects_barrier()      # flush in-flight step callbacks
         tele.emit_snapshot()

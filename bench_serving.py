@@ -48,9 +48,35 @@ and a final line whose payoff fields are ``prefix_hit_rate``,
 ``prefill_chunks_skipped_pct`` (telemetry-counted chunk-prefill steps
 that never executed — a compute count, honest on the CPU fallback,
 unlike the decode-regime claims), TTFT p50/p99 both modes, and
-``token_mismatched_requests`` (both modes are greedy, and the copied
+``token_mismatched_requests`` (both modes are greedy, and the reused
 prefix K/V is byte-identical to freshly prefilled K/V, so the expected
 reading is 0 — bitwise, not approximately).
+
+**Shared-prefix presets**: with no ``BENCH_SERVING_*`` env set the leg
+runs the SMOKE geometry (8 requests x 16 new tokens x 2 windows —
+minutes, not half-hours, on this box's CPU); the full geometry the PR 5
+rows were measured at is one export away::
+
+  # full (the historical default; >25 min on CPU, sized for TPU)
+  BENCH_SERVING_REQUESTS=24 BENCH_SERVING_NEW_TOKENS=64 \
+  BENCH_SERVING_WINDOWS=3 python bench_serving.py --shared-prefix
+
+``--paged-pool`` runs the block-table capacity leg: the SAME
+short-prompt stream served by the contiguous engine (``paged=False``,
+``BENCH_SERVING_SLOTS`` slots, the pool bytes of ``slots`` full
+``max_len`` rows) and by the paged engine given the SAME physical pool
+bytes but ``BENCH_SERVING_PAGED_SLOTS`` (default ``4 x slots``) decode
+slots — possible only because requests hold pages, not rows. One row
+per mode plus a final line whose payoff fields are
+``max_concurrent_requests`` (must exceed the contiguous ``slots`` —
+the logical-concurrency unlock), ``hbm_bytes_per_request`` both modes
+and the reduction pct (worst-case reservation bytes — an accounting
+claim, honest on CPU), peak ``pages_in_use``, and
+``token_mismatched_requests`` vs the contiguous baseline (greedy; the
+expected reading is 0). Throughput regime note: the paged engine's
+wider decode batch costs MORE per step on the CPU fallback (the
+reference decode attends every slot) — judge tokens/s on TPU rows; the
+capacity and bytes columns are the leg's claim.
 
 Wrapped in ``guard_bench_main`` — EVERY outcome (backend init failure,
 OOM, bad env) still ends in a parseable JSON line.
@@ -68,6 +94,7 @@ import numpy as np
 METRIC = "serving_decode_tokens_per_sec"
 MIXED_METRIC = "serving_mixed_prompts_tokens_per_sec"
 SHARED_METRIC = "serving_shared_prefix_tokens_per_sec"
+PAGED_METRIC = "serving_paged_pool_tokens_per_sec"
 
 # Literal defaults at import time; the BENCH_SERVING_* env overrides are
 # parsed by _load_env() INSIDE each guarded main, so a malformed value
@@ -91,6 +118,17 @@ CHUNK_BUDGET = 1
 # in whole chunks; the leg defaults chunk to PREFILL/4 when unset)
 SHARED_PREFIX = 96
 PREFIX_POOL = 4
+# --shared-prefix SMOKE preset (applied only to knobs the env leaves
+# unset — the full geometry is one export away, see module docstring):
+# the historical 24-req/64-token/3-window default needs >25 min on this
+# box's CPU, far too long for a smoke signal
+SHARED_SMOKE = {"REQUESTS": 8, "NEW_TOKENS": 16, "WINDOWS": 2}
+# --paged-pool leg: paged decode width over the same pool bytes as the
+# contiguous baseline's SLOTS rows (0 -> 4x), and the short-prompt
+# stream's max length (short prompts are where row-granularity HBM
+# waste is worst)
+PAGED_SLOTS = 0
+PAGED_PROMPT = 32
 
 _ENV_KNOBS = {
     "VOCAB": "BENCH_SERVING_VOCAB", "SLOTS": "BENCH_SERVING_SLOTS",
@@ -104,15 +142,22 @@ _ENV_KNOBS = {
     "CHUNK_BUDGET": "BENCH_SERVING_CHUNK_BUDGET",
     "SHARED_PREFIX": "BENCH_SERVING_SHARED_PREFIX",
     "PREFIX_POOL": "BENCH_SERVING_PREFIX_POOL",
+    "PAGED_SLOTS": "BENCH_SERVING_PAGED_SLOTS",
+    "PAGED_PROMPT": "BENCH_SERVING_PAGED_PROMPT",
 }
 
 
-def _load_env():
+def _load_env(smoke: dict = None):
     """Apply BENCH_SERVING_* overrides (first statement of every guarded
     main): malformed values die as a clean SystemExit the guard turns
-    into its failure JSON line."""
+    into its failure JSON line. ``smoke`` maps knob names to the
+    calling leg's smoke-preset values, applied ONLY where the env is
+    silent — an exported knob always wins, so the full geometry stays
+    one export away."""
     g = globals()
-    g["SIZE"] = os.environ.get("BENCH_SERVING_SIZE", SIZE)
+    for name, value in (smoke or {}).items():
+        g[name] = value
+    g["SIZE"] = os.environ.get("BENCH_SERVING_SIZE", g["SIZE"])
     for name, var in _ENV_KNOBS.items():
         raw = os.environ.get(var)
         if raw is None or not raw.strip():
@@ -162,7 +207,8 @@ def _mixed_requests(rng):
     return reqs
 
 
-def _build_engine(registry=None, prefix_pool=0, chunk_len=None):
+def _build_engine(registry=None, prefix_pool=0, chunk_len=None,
+                  slots=None, **engine_kw):
     import jax
     import jax.numpy as jnp
 
@@ -173,12 +219,13 @@ def _build_engine(registry=None, prefix_pool=0, chunk_len=None):
     params = model.init(jax.random.PRNGKey(0),
                         jnp.zeros((1, 8), jnp.int32),
                         train=False)["params"]
-    return serving.Engine(model, params, slots=SLOTS, max_len=MAX_LEN,
-                          prefill_len=PREFILL_LEN,
+    return serving.Engine(model, params,
+                          slots=slots if slots is not None else SLOTS,
+                          max_len=MAX_LEN, prefill_len=PREFILL_LEN,
                           chunk_len=chunk_len if chunk_len is not None
                           else (CHUNK_LEN or None),
                           prefix_pool=prefix_pool, top_k=TOP_K,
-                          registry=registry)
+                          registry=registry, **engine_kw)
 
 
 def main():
@@ -428,7 +475,7 @@ def _serve_shared(retain: bool, chunk_len: int):
 def main_shared():
     import jax
 
-    _load_env()
+    _load_env(smoke=SHARED_SMOKE)
 
     global _SHARED_TOKENS
     chunk_len = CHUNK_LEN or max(1, PREFILL_LEN // 4)
@@ -504,6 +551,171 @@ def main_shared():
     }))
 
 
+def _short_requests(rng):
+    """Short-prompt arrivals — the stream where row-granularity HBM
+    waste is worst: a 512-position contiguous row holds a <= 32-token
+    prompt plus a small budget, >90% of the row dead."""
+    from apex_tpu.serving import Request
+
+    reqs = []
+    for _ in range(REQUESTS):
+        n = int(rng.integers(1, min(PAGED_PROMPT, PREFILL_LEN) + 1))
+        budget = max(1, min(NEW_TOKENS, MAX_LEN - n))
+        reqs.append(Request(
+            prompt=rng.integers(1, VOCAB, size=n).tolist(),
+            max_new_tokens=budget))
+    return reqs
+
+
+def _serve_paged_leg(paged: bool, slots: int, num_pages=None):
+    """One mode of the --paged-pool leg: WINDOWS measured windows (plus
+    compile warmup) of the short-prompt stream, tracking the peak
+    number of in-flight (prefilling + running) requests per window and,
+    on the paged engine, peak pages_in_use."""
+    from apex_tpu import serving, telemetry
+
+    reg = telemetry.MetricsRegistry()
+    kw = {"paged": paged}
+    if paged and num_pages is not None:
+        kw["num_pages"] = num_pages
+    engine = _build_engine(slots=slots, **kw)
+    rng = np.random.default_rng(3)
+    rates, all_reqs = [], []
+    peak_inflight = peak_pages = 0
+    for w in range(WINDOWS + 1):
+        engine.reset()
+        if w == 1:
+            engine.set_registry(reg)
+        sched = serving.Scheduler(engine, max_queue=max(REQUESTS, 1),
+                                  registry=reg if w else None,
+                                  chunk_budget=CHUNK_BUDGET)
+        reqs = _short_requests(rng)
+        t0 = time.perf_counter()
+        tok0 = engine.tokens_generated
+        for r in reqs:
+            sched.submit(r)
+        while sched.pending:
+            sched.step()
+            if w > 0:
+                inflight = sum(r.status in ("prefilling", "running")
+                               for r in reqs)
+                peak_inflight = max(peak_inflight, inflight)
+                if paged:
+                    peak_pages = max(peak_pages,
+                                     engine.pool_stats()["pages_in_use"])
+        dt = time.perf_counter() - t0
+        toks = engine.tokens_generated - tok0
+        assert len(sched.completed) >= len(reqs)
+        if w > 0:
+            rates.append(toks / dt)
+            all_reqs.extend(reqs)
+    return _median(rates), all_reqs, engine, peak_inflight, peak_pages
+
+
+def paged_capacity_stats():
+    """The --paged-pool measurement, reusable by bench.py's serving
+    trajectory leg: serve the short-prompt stream on the contiguous
+    engine (SLOTS rows) and on the paged engine given the SAME physical
+    pool bytes but 4x the decode slots; return the two rows plus the
+    headline comparison dict. Token streams are greedy and compared
+    request-for-request."""
+    from apex_tpu.serving.engine import resolve_page_len
+
+    # replicate the Engine's chunk_len default EXACTLY (incl. the
+    # spill-to-single-chunk degrade) so the page size below is the one
+    # the constructed engine will actually use
+    chunk = CHUNK_LEN or min(PREFILL_LEN, 256)
+    if not CHUNK_LEN and -(-PREFILL_LEN // chunk) * chunk > MAX_LEN:
+        chunk = PREFILL_LEN
+    paged_slots = PAGED_SLOTS or SLOTS * 4
+    # identical pool bytes: the paged pool spends the contiguous
+    # layout's slots * max_len positions, sentinel INCLUDED in the
+    # count (the paged engine measurably holds one page less).
+    # resolve_page_len is the Engine's own resolution (tuned
+    # decode.page_len key included) — sizing with anything else would
+    # silently hand the paged engine a different byte budget
+    page_len = resolve_page_len(chunk)
+    num_pages = SLOTS * MAX_LEN // page_len
+    rows, outputs = {}, {}
+    for mode, paged in (("contiguous", False), ("paged", True)):
+        rate, reqs, engine, peak_inflight, peak_pages = _serve_paged_leg(
+            paged, paged_slots if paged else SLOTS,
+            num_pages if paged else None)
+        if paged:
+            # worst-case reservation per request (what admission holds)
+            # -> HBM bytes the request can ever touch
+            per_pos = engine.cache.nbytes() \
+                / (engine.num_pages * engine.page_len)
+            demands = [engine.pages_required(len(r.prompt),
+                                             r.max_new_tokens)
+                       * engine.page_len for r in reqs]
+            bytes_per_req = float(np.mean(demands)) * per_pos
+        else:
+            per_pos = engine.cache.nbytes() \
+                / ((engine.slots + engine.prefix_pool) * engine.max_len)
+            bytes_per_req = engine.max_len * per_pos   # a whole row
+        rows[mode] = {
+            "metric": f"{PAGED_METRIC}.{mode}",
+            "value": round(rate, 2),
+            "unit": "tokens/s",
+            "slots": engine.slots,
+            "max_concurrent_requests": peak_inflight,
+            "hbm_bytes_per_request": round(bytes_per_req),
+            "pool_mib": round(engine.cache.nbytes() / 2**20, 2),
+            "compiled_programs": engine.compiled_programs,
+        }
+        if paged:
+            rows[mode]["page_len"] = engine.page_len
+            rows[mode]["num_pages"] = engine.num_pages
+            rows[mode]["peak_pages_in_use"] = peak_pages
+            rows[mode]["copy_programs"] = engine.copy_traces
+        outputs[mode] = [list(r.output_tokens) for r in reqs]
+    mismatches = sum(a != b for a, b in zip(outputs["paged"],
+                                            outputs["contiguous"]))
+    con, pag = rows["contiguous"], rows["paged"]
+    reduction = (1.0 - pag["hbm_bytes_per_request"]
+                 / con["hbm_bytes_per_request"]) * 100.0 \
+        if con["hbm_bytes_per_request"] else 0.0
+    summary = {
+        "metric": PAGED_METRIC,
+        "value": pag["value"],
+        "unit": "tokens/s",
+        "baseline_tokens_per_s": con["value"],
+        "max_concurrent_requests": pag["max_concurrent_requests"],
+        "max_concurrent_requests_contiguous":
+            con["max_concurrent_requests"],
+        "contiguous_slots": con["slots"],
+        "logical_concurrency_exceeds_rows":
+            pag["max_concurrent_requests"] > con["slots"],
+        "hbm_bytes_per_request": pag["hbm_bytes_per_request"],
+        "hbm_bytes_per_request_contiguous":
+            con["hbm_bytes_per_request"],
+        "hbm_bytes_per_request_reduction_pct": round(reduction, 1),
+        "pool_mib": pag["pool_mib"],
+        "pool_mib_contiguous": con["pool_mib"],
+        "peak_pages_in_use": pag["peak_pages_in_use"],
+        "token_exact_vs_contiguous": mismatches == 0,
+        "token_mismatched_requests": mismatches,
+        "windows": WINDOWS,
+        "requests_per_window": REQUESTS,
+        "short_prompt_max": min(PAGED_PROMPT, PREFILL_LEN),
+        "model": SIZE,
+    }
+    return rows, summary
+
+
+def main_paged():
+    import jax
+
+    _load_env()
+
+    rows, summary = paged_capacity_stats()
+    for mode in ("contiguous", "paged"):
+        print(json.dumps(rows[mode]))
+    summary["backend"] = jax.default_backend()
+    print(json.dumps(summary))
+
+
 if __name__ == "__main__":
     from apex_tpu.telemetry import guard_bench_main
 
@@ -511,5 +723,7 @@ if __name__ == "__main__":
         guard_bench_main(main_mixed, MIXED_METRIC)
     elif "--shared-prefix" in sys.argv[1:]:
         guard_bench_main(main_shared, SHARED_METRIC)
+    elif "--paged-pool" in sys.argv[1:]:
+        guard_bench_main(main_paged, PAGED_METRIC)
     else:
         guard_bench_main(main, METRIC)

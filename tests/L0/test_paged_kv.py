@@ -1,0 +1,511 @@
+"""Paged KV cache — block-table pool + copy-on-write sharing, hermetic.
+
+The acceptance bar from the block-table issue, as tests:
+
+- the paged kernels (``paged_decode_attention`` /
+  ``paged_prefill_attention``) match their jnp oracles, and the oracles
+  are BITWISE identical to the contiguous references over the gathered
+  page view (same math, indirected storage);
+- the paged engine is token-exact against the contiguous baseline
+  (greedy, identical geometry) over a mixed hit/miss/evict request
+  stream with prompt lengths below / at / straddling page boundaries;
+- a prefix-cache hit on the paged path performs ZERO KV data movement:
+  the engine compiles exactly THREE programs (chunk prefill + decode +
+  monolithic prefill) across a stream that includes hits — the
+  contiguous layout's fourth (row-copy) program never traces, pinned by
+  trace counters and by ``copy_kv`` refusing to run at all;
+- copy-on-write refcount pinning: a shared page is never freed while
+  any slot or prefix entry references it, and the first write past a
+  shared prefix lands on a freshly allocated page (never the donor's);
+- pool-exhaustion degradation: admission blocks (requests queue, FIFO
+  holds, ``serving.pool.admit_blocked`` counts) instead of failing
+  mid-decode, prefix entries are LRU-evicted under reservation
+  pressure, and the engine constructor refuses pools too small for one
+  ``max_len`` request — so the drain loop can never deadlock;
+- the ``serving.pool.*`` telemetry gauges (pages_in_use / pages_free /
+  cow_shares / fragmentation) land in the registry every step.
+
+Everything runs on CPU with a tiny model at policy O0 (exact fp32);
+the paged kernels take their interpret/reference paths here (Mosaic
+lowering is the tests/tpu tier's job).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import telemetry
+from apex_tpu.amp.policy import resolve_policy
+from apex_tpu.kernels.decode_attention import (
+    decode_attention_reference, gather_pages, paged_decode_attention,
+    paged_decode_attention_reference)
+from apex_tpu.kernels.prefill_attention import (
+    paged_prefill_attention, paged_prefill_attention_reference,
+    prefill_attention_reference)
+from apex_tpu.models.transformer_lm import TransformerLM
+from apex_tpu.serving import (Engine, PagedKVCache, PagePool, Request,
+                              Scheduler)
+
+pytestmark = pytest.mark.serving
+
+VOCAB = 101
+CHUNK = 8     # engine chunk_len == page_len below: every chunk is 1 page
+
+
+# ------------------------------------------------------------ page pool
+def test_page_pool_alloc_share_release_refcounts():
+    pool = PagePool(num_pages=5, page_len=8)
+    assert pool.free_pages == 4 and pool.pages_in_use == 0   # page 0 = sentinel
+    a, b = pool.alloc(), pool.alloc()
+    assert a != b and 0 not in (a, b)
+    assert pool.pages_in_use == 2 and pool.cow_shares == 0
+    pool.share([a])                       # second reader: COW share
+    assert pool.cow_shares == 1
+    pool.release([a])                     # first reader gone: page lives
+    assert pool.pages_in_use == 2 and pool.cow_shares == 0
+    pool.release([a, b])                  # last readers: both freed
+    assert pool.pages_in_use == 0 and pool.free_pages == 4
+    with pytest.raises(ValueError, match="already free"):
+        pool.release([a])
+    with pytest.raises(ValueError, match="cannot share"):
+        pool.share([a])
+    with pytest.raises(ValueError, match="out of range"):
+        pool.share([0])                   # the sentinel is never shared
+
+
+def test_page_pool_reservation_ledger():
+    pool = PagePool(num_pages=6, page_len=4)      # 5 usable
+    assert pool.available == 5
+    assert pool.reserve(3)
+    assert pool.available == 2 and pool.free_pages == 5
+    assert not pool.reserve(3)                    # over-promise refused
+    assert pool.reserve(2) and pool.available == 0
+    # a reserved alloc draws the ledger down with the page
+    p = pool.alloc(reserved=True)
+    assert p is not None and pool.reserved_total == 4
+    pool.unreserve(4)
+    assert pool.available == pool.free_pages == 4
+    # exhaustion returns None, never raises
+    for _ in range(4):
+        assert pool.alloc() is not None
+    assert pool.alloc() is None
+    assert pool.pages_for(0) == 0 and pool.pages_for(1) == 1
+    assert pool.pages_for(4) == 1 and pool.pages_for(5) == 2
+
+
+def test_page_pool_fragmentation_and_validation():
+    pool = PagePool(num_pages=4, page_len=8)
+    # 2 slots, 3 pages allocated, 20/24 positions valid
+    assert pool.fragmentation([12, 8], [2, 1]) == pytest.approx(1 - 20 / 24)
+    assert pool.fragmentation([], []) == 0.0
+    with pytest.raises(ValueError, match="sentinel"):
+        PagePool(num_pages=1, page_len=8)
+    with pytest.raises(ValueError, match="page_len"):
+        PagePool(num_pages=4, page_len=0)
+    with pytest.raises(ValueError, match="sentinel"):
+        PagedKVCache.create(layers=1, num_pages=1, heads=1, page_len=8,
+                            head_dim=4)
+
+
+def test_paged_kv_cache_geometry():
+    c = PagedKVCache.create(layers=2, num_pages=5, heads=3, page_len=16,
+                            head_dim=8, dtype=jnp.bfloat16)
+    assert (c.layers, c.num_pages, c.heads, c.page_len, c.head_dim) \
+        == (2, 5, 3, 16, 8)
+    assert c.dtype == jnp.bfloat16
+    assert c.nbytes() == 2 * 5 * 3 * 16 * 8 * 2 * 2
+
+
+# -------------------------------------------------------- paged kernels
+def test_paged_decode_kernel_matches_oracle_and_contiguous_reference():
+    rng = np.random.default_rng(0)
+    B, H, D, PL, NP, MAXP = 3, 2, 16, 128, 7, 4
+    scale = 1.0 / D ** 0.5
+    q = jnp.asarray(rng.normal(size=(B, H, D)), jnp.float32)
+    kp = jnp.asarray(rng.normal(size=(NP, H, PL, D)), jnp.float32)
+    vp = jnp.asarray(rng.normal(size=(NP, H, PL, D)), jnp.float32)
+    pt = jnp.asarray(rng.integers(0, NP, size=(B, MAXP)), jnp.int32)
+    # below / at / straddling page boundaries, plus 0 (dead slot) + full
+    for L in ([5, 128, 130], [0, 200, 512], [1, 127, 129]):
+        lengths = jnp.asarray(L, jnp.int32)
+        ref = paged_decode_attention_reference(q, kp, vp, pt, lengths,
+                                               scale=scale)
+        out = paged_decode_attention(q, kp, vp, pt, lengths,
+                                     interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=5e-6)
+        # the oracle IS the contiguous reference over the gathered view
+        # — bitwise, which is what makes paged-vs-contiguous engine
+        # parity a storage claim rather than a numerics claim
+        kg, vg = gather_pages(kp, pt), gather_pages(vp, pt)
+        contig = decode_attention_reference(q, kg, vg, lengths,
+                                            scale=scale)
+        assert (np.asarray(ref) == np.asarray(contig)).all()
+    # rows with length 0 return exactly zero (dead serving slots)
+    out = paged_decode_attention(q, kp, vp, pt,
+                                 jnp.asarray([0, 3, 0], jnp.int32),
+                                 interpret=True)
+    assert (np.asarray(out)[[0, 2]] == 0).all()
+
+
+def test_paged_decode_kernel_bf16_and_fallback():
+    rng = np.random.default_rng(1)
+    B, H, D, PL, NP, MAXP = 2, 2, 16, 128, 5, 2
+    q = jnp.asarray(rng.normal(size=(B, H, D)), jnp.bfloat16)
+    kp = jnp.asarray(rng.normal(size=(NP, H, PL, D)), jnp.bfloat16)
+    vp = jnp.asarray(rng.normal(size=(NP, H, PL, D)), jnp.bfloat16)
+    pt = jnp.asarray(rng.integers(0, NP, size=(B, MAXP)), jnp.int32)
+    lengths = jnp.asarray([100, 256], jnp.int32)
+    ref = paged_decode_attention_reference(q, kp, vp, pt, lengths,
+                                           scale=0.25)
+    out = paged_decode_attention(q, kp, vp, pt, lengths, interpret=True)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32), atol=3e-2)
+    # unaligned page_len (not a lane multiple) falls back to the oracle
+    out_fb = paged_decode_attention(q[:, :, :], kp[:, :, :24],
+                                    vp[:, :, :24], pt,
+                                    jnp.asarray([10, 40], jnp.int32))
+    assert out_fb.shape == (B, H, D)
+    with pytest.raises(ValueError, match="page_table"):
+        paged_decode_attention(q, kp, vp, pt[0], lengths)
+    with pytest.raises(ValueError, match="lengths"):
+        paged_decode_attention(q, kp, vp, pt, lengths[:1])
+    with pytest.raises(ValueError, match="pools"):
+        paged_decode_attention(q, kp, vp[:, :1], pt, lengths)
+
+
+def test_paged_prefill_kernel_matches_oracle_across_offsets():
+    rng = np.random.default_rng(2)
+    B, H, C, D, PL, NP, MAXP = 2, 2, 16, 16, 128, 7, 4
+    scale = 1.0 / D ** 0.5
+    q = jnp.asarray(rng.normal(size=(B, H, C, D)), jnp.float32)
+    kp = jnp.asarray(rng.normal(size=(NP, H, PL, D)), jnp.float32)
+    vp = jnp.asarray(rng.normal(size=(NP, H, PL, D)), jnp.float32)
+    pt = jnp.asarray(rng.integers(0, NP, size=(B, MAXP)), jnp.int32)
+    for offs in ([0, 0], [128, 200], [496, 3]):
+        off = jnp.asarray(offs, jnp.int32)
+        ref = paged_prefill_attention_reference(q, kp, vp, pt, off,
+                                                scale=scale)
+        out = paged_prefill_attention(q, kp, vp, pt, off, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=5e-6)
+        kg, vg = gather_pages(kp, pt), gather_pages(vp, pt)
+        contig = prefill_attention_reference(q, kg, vg, off, scale=scale)
+        assert (np.asarray(ref) == np.asarray(contig)).all()
+    # q-block override exercises the multi-q-block grid
+    q2 = jnp.asarray(rng.normal(size=(B, H, 256, D)), jnp.float32)
+    off = jnp.asarray([128, 200], jnp.int32)
+    ref = paged_prefill_attention_reference(q2, kp, vp, pt, off,
+                                            scale=scale)
+    out = paged_prefill_attention(q2, kp, vp, pt, off, block_q=64,
+                                  interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=5e-6)
+    with pytest.raises(ValueError, match="offsets"):
+        paged_prefill_attention(q, kp, vp, pt, off[:1])
+
+
+# ------------------------------------------------------------ engines
+def _tiny_lm(max_seq_len=64, **kw):
+    return TransformerLM(vocab_size=VOCAB, hidden=32, num_layers=2,
+                         num_heads=4, max_seq_len=max_seq_len, **kw)
+
+
+@pytest.fixture(scope="module")
+def lm_and_params():
+    m = _tiny_lm()
+    params = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32),
+                    train=False)["params"]
+    return m, params
+
+
+def _mk_engine(lm_and_params, *, paged, pool=2, slots=3, seed=5,
+               **kw):
+    m, params = lm_and_params
+    return Engine(m, params, slots=slots, max_len=64, prefill_len=24,
+                  chunk_len=CHUNK, prefix_pool=pool, paged=paged,
+                  policy=resolve_policy("O0", verbose=False), seed=seed,
+                  **kw)
+
+
+@pytest.fixture(scope="module")
+def engine_pair(lm_and_params):
+    """One paged engine + one contiguous engine, identical geometry —
+    the parity pair (jit caches warm across the module)."""
+    return (_mk_engine(lm_and_params, paged=True),
+            _mk_engine(lm_and_params, paged=False))
+
+
+def test_paged_engine_geometry_and_defaults(engine_pair):
+    ep, ec = engine_pair
+    assert ep.paged and not ec.paged
+    assert ep.page_len == CHUNK           # min(chunk, 128) -> chunk
+    assert ep.max_pages == 64 // CHUNK
+    # default pool budget == the contiguous layout's rows (+ sentinel)
+    assert ep.num_pages == (3 + 2) * ep.max_pages + 1
+    assert ep.pool.free_pages == ep.num_pages - 1
+
+
+def test_paged_engine_validation(lm_and_params):
+    with pytest.raises(ValueError, match="divide chunk_len"):
+        _mk_engine(lm_and_params, paged=True, page_len=5)
+    with pytest.raises(ValueError, match="cannot hold even one"):
+        _mk_engine(lm_and_params, paged=True, num_pages=4)
+    eng = _mk_engine(lm_and_params, paged=True, pool=0)
+    assert eng.prefix_cache is None
+    with pytest.raises(RuntimeError, match="retired"):
+        eng.copy_kv(0, 1, 8)
+    with pytest.raises(RuntimeError, match="prefix cache"):
+        eng.retain_prefix(0, [1] * 8)
+    with pytest.raises(ValueError, match="page-aligned"):
+        eng.prefill_chunk(0, [1, 2], 3)
+    ec = _mk_engine(lm_and_params, paged=False, pool=0)
+    with pytest.raises(RuntimeError, match="paged=False"):
+        ec.release_slot(0)
+    with pytest.raises(RuntimeError, match="paged=False"):
+        ec.pages_required(8, 4)
+
+
+def _boundary_cases():
+    """(prompt_a, prompt_b, expected_reuse) with shared-prefix lengths
+    below / at / straddling page boundaries (page_len == CHUNK == 8) and
+    spanning two pages — the same sweep test_prefix_cache runs on the
+    contiguous layout."""
+    rng = np.random.default_rng(42)
+    out = []
+    for pre_len, want in [(5, 0), (8, 8), (13, 8), (16, 16)]:
+        pre = list(rng.integers(1, VOCAB, size=pre_len))
+        out.append((pre + list(rng.integers(1, VOCAB, size=3)),
+                    pre + list(rng.integers(1, VOCAB, size=3)), want))
+    return out
+
+
+def test_paged_token_exact_vs_contiguous_over_hit_miss_evict_stream(
+        engine_pair, lm_and_params):
+    """THE acceptance pin: greedy tokens from the paged engine (with
+    copy-on-write prefix retention on) match the contiguous baseline
+    (same geometry, retention on) request-for-request across a stream
+    that drives misses, hits, boundary-length prompts and (on the
+    1-row contiguous pool of test_prefix_cache's sibling sweep)
+    evictions — and both match one teacher-forcing recompute."""
+    m, params = lm_and_params
+    ep, ec = engine_pair
+    ep.reset(clear_prefixes=True)
+    ec.reset(clear_prefixes=True)
+    sp = Scheduler(ep, retain_prefixes=True)
+    sc = Scheduler(ec, retain_prefixes=True)
+    for prompt_a, prompt_b, want_reuse in _boundary_cases():
+        for prompt in (prompt_a, prompt_b):
+            (rp,) = sp.run([Request(prompt=list(prompt),
+                                    max_new_tokens=5)])
+            (rc,) = sc.run([Request(prompt=list(prompt),
+                                    max_new_tokens=5)])
+            assert rp.output_tokens == rc.output_tokens, \
+                f"paged diverged from contiguous (prompt len {len(prompt)})"
+            assert rp.reused_tokens == rc.reused_tokens
+            assert rp.chunks == rc.chunks
+        assert rp.reused_tokens == want_reuse
+        # teacher-forcing recompute re-derives every greedy step
+        seq = jnp.asarray([list(prompt_b) + rp.output_tokens], jnp.int32)
+        full = m.apply({"params": params}, seq, train=False)
+        want = np.asarray(jnp.argmax(full[0], axis=-1))
+        for i, tok in enumerate(rp.output_tokens):
+            assert tok == int(want[len(prompt_b) - 1 + i]), \
+                f"recompute divergence at token {i}"
+
+
+def test_exactly_three_compiled_programs_with_zero_copy_hits(
+        engine_pair):
+    """The re-derived program pin: the same hit/miss stream that pins
+    FOUR programs on the contiguous engine (chunk + decode + monolithic
+    + row-copy) pins THREE here — a prefix hit is host bookkeeping plus
+    the existing programs, never a copy dispatch. copy_traces stays 0
+    across the whole module (every earlier test rode these engines)."""
+    ep, _ = engine_pair
+    ep.reset(clear_prefixes=True)
+    sched = Scheduler(ep, retain_prefixes=True)
+    rng = np.random.default_rng(1)
+    pre = list(rng.integers(1, VOCAB, size=16))
+    sched.run([Request(prompt=pre + [7, 8], max_new_tokens=3)])   # miss
+    (hit,) = sched.run([Request(prompt=pre + [9], max_new_tokens=3)])
+    assert hit.reused_tokens == 16
+    ep.prefill(0, [5, 9, 2])          # the monolithic baseline compiles
+    assert (ep.chunk_traces, ep.decode_traces, ep.prefill_traces,
+            ep.copy_traces) == (1, 1, 1, 0)
+    assert ep.compiled_programs == 3
+    assert ep._jit_copy is None       # the program object never exists
+
+
+def test_cow_shared_page_never_freed_while_referenced(engine_pair):
+    """Copy-on-write refcount pinning, observed at the page level: the
+    donor entry's pages are shared into the hitting slot's table (one
+    page, >= 2 readers, ZERO copies); releasing either reader alone
+    keeps the page resident; write-after-share lands on a FRESH page —
+    the donor's pages are never written by the borrower."""
+    ep, _ = engine_pair
+    ep.reset(clear_prefixes=True)
+    sched = Scheduler(ep, retain_prefixes=True)
+    rng = np.random.default_rng(9)
+    pre = list(rng.integers(1, VOCAB, size=8))     # exactly one page
+    sched.run([Request(prompt=pre + [1], max_new_tokens=2)])
+    stats = ep.pool_stats()
+    assert stats["pages_in_use"] == 1              # the retained page
+    assert stats["cow_shares"] == 0
+    # b hits pre and stays live (manual stepping)
+    b = Request(prompt=pre + [2, 3], max_new_tokens=50)
+    sched.submit(b)
+    while b.status != "running":
+        sched.step()
+    assert b.reused_tokens == 8
+    shared = int(ep._page_table[ [s for s, r in
+                                  enumerate(sched._running)
+                                  if r is b][0], 0])
+    assert ep.pool.refcount[shared] == 2           # entry + b's slot
+    assert ep.pool_stats()["cow_shares"] == 1
+    # write-after-share: b's tail page (holding its unique tokens and
+    # decode writes) is NOT the shared page
+    slot = [s for s, r in enumerate(sched._running) if r is b][0]
+    tail = int(ep._page_table[slot, 1])
+    assert tail != shared and ep.pool.refcount[tail] == 1
+    # evicting the donor entry mid-flight is harmless: the page's slot
+    # refcount keeps it resident
+    assert ep.prefix_cache.evict_lru()
+    assert ep.pool.refcount[shared] == 1
+    while sched.pending:
+        sched.step()
+    assert b.status == "done"
+    # last reader gone: page freed NOW (immediate reclamation)
+    assert ep.pool.refcount[shared] == 0
+    assert ep.pool_stats()["pages_in_use"] == 0
+
+
+def test_pool_exhaustion_queues_admissions_and_degrades_gracefully(
+        lm_and_params):
+    """A pool sized for ONE max-budget request at a time: three such
+    requests serve back-to-back (admission blocks on reservation, FIFO
+    holds, admit_blocked counts) — exhaustion is a queueing signal,
+    never a mid-decode failure. Prefix entries give way under pressure
+    (LRU eviction at reservation time)."""
+    # max_len 64, page 8 -> 8 pages/request worst case; 9 usable pages
+    eng = _mk_engine(lm_and_params, paged=True, pool=2, slots=3,
+                     num_pages=10)
+    reg = telemetry.MetricsRegistry()
+    sched = Scheduler(eng, retain_prefixes=True, registry=reg)
+    rng = np.random.default_rng(4)
+    reqs = [Request(prompt=list(rng.integers(1, VOCAB, size=8)),
+                    max_new_tokens=56) for _ in range(3)]
+    done = sched.run(reqs)
+    assert len(done) == 3
+    assert all(r.status == "done" for r in reqs)
+    snap = reg.snapshot()
+    assert snap["counters"].get("serving.pool.admit_blocked", 0) > 0
+    # the first request's retained prefix was evicted to make room
+    # for a later reservation (pressure valve) — pool back to empty
+    sched_stats = eng.pool_stats()
+    assert sched_stats["pages_reserved"] == 0
+    assert eng.prefix_cache.evictions >= 1
+    # direct (scheduler-less) overcommit fails loudly, not silently
+    eng.reset(clear_prefixes=True)
+    eng.prefill_chunked(0, list(rng.integers(1, VOCAB, size=24)))
+    eng.prefill_chunked(1, list(rng.integers(1, VOCAB, size=24)))
+    with pytest.raises(RuntimeError, match="pool exhausted"):
+        # 9 usable pages; two 24-token prompts hold 6, a third needs 3
+        # more for its padded window plus decode growth past it
+        eng.prefill_chunked(2, list(rng.integers(1, VOCAB, size=24)))
+        for _ in range(60):
+            eng.decode_step([1, 1, 1], [True, True, True],
+                            [0.0, 0.0, 0.0])
+
+
+def test_cold_start_paths_keep_the_admission_reservation(lm_and_params):
+    """Regression (review finding): every cold-start release inside an
+    admitted request — the first chunk's offset-0 branch AND the
+    monolithic prefill — must pass keep_reservation, or the admission
+    promise silently evaporates and a later admission can steal the
+    pages, resurrecting the mid-decode exhaustion the reservation
+    design exists to prevent."""
+    eng = _mk_engine(lm_and_params, paged=True, pool=0, slots=2)
+    assert eng.try_reserve_slot(0, 5)
+    assert eng.pool.reserved_total == 5
+    eng.prefill_chunk(0, [1, 2, 3], 0)            # offset-0 cold start
+    # one page drawn FROM the reservation, the rest still promised
+    assert int(eng._slot_reserved[0]) == 4
+    assert eng.pool.reserved_total == 4
+    eng.release_slot(0)
+    assert eng.pool.reserved_total == 0
+    assert eng.try_reserve_slot(1, 5)
+    eng.prefill(1, [1, 2, 3])                     # monolithic cold start
+    assert int(eng._slot_reserved[1]) == 5 - eng.pool.pages_for(
+        eng.prefill_len)
+    assert eng.pool.reserved_total == int(eng._slot_reserved[1])
+    eng.release_slot(1)
+
+
+def test_paged_pool_telemetry_gauges_and_request_records(engine_pair):
+    ep, _ = engine_pair
+    ep.reset(clear_prefixes=True)
+    reg = telemetry.MetricsRegistry()
+    ep.set_registry(reg)
+    sched = Scheduler(ep, retain_prefixes=True, registry=reg)
+    rng = np.random.default_rng(11)
+    pre = list(rng.integers(1, VOCAB, size=16))
+    reqs = [Request(prompt=pre + [1], max_new_tokens=3),
+            Request(prompt=pre + [2, 3], max_new_tokens=3)]
+    try:
+        sched.run([reqs[0]])
+        sched.run([reqs[1]])
+    finally:
+        ep.set_registry(None)
+    snap = reg.snapshot()
+    g = snap["gauges"]
+    for key in ("serving.pool.pages_in_use", "serving.pool.pages_free",
+                "serving.pool.cow_shares", "serving.pool.fragmentation"):
+        assert key in g, f"missing gauge {key}"
+    assert g["serving.pool.pages_in_use"] >= 0
+    assert 0.0 <= g["serving.pool.fragmentation"] <= 1.0
+    c = snap["counters"]
+    assert c["serving.prefix.hits"] == 1
+    assert c["serving.prefix.tokens_reused"] == 16
+    recs = {rec["uid"]: rec for rec in reg.records
+            if rec.get("tag") == "serving.request"}
+    assert recs[reqs[0].uid]["reused_tokens"] == 0
+    assert recs[reqs[1].uid]["reused_tokens"] == 16
+
+
+def test_paged_reset_keeps_warm_prefix_pages_unless_cleared(engine_pair):
+    ep, _ = engine_pair
+    ep.reset(clear_prefixes=True)
+    sched = Scheduler(ep, retain_prefixes=True)
+    pre = list(np.random.default_rng(13).integers(1, VOCAB, size=8))
+    sched.run([Request(prompt=pre + [1], max_new_tokens=2)])
+    ep.reset()                    # warm: the entry keeps its page
+    assert ep.pool_stats()["pages_in_use"] == 1
+    (r,) = Scheduler(ep, retain_prefixes=True).run(
+        [Request(prompt=pre + [2], max_new_tokens=2)])
+    assert r.reused_tokens == 8, "reset() must not drop warm prefixes"
+    ep.reset(clear_prefixes=True)
+    assert ep.pool_stats()["pages_in_use"] == 0
+    assert ep.prefix_cache.size == 0
+
+
+def test_logical_requests_outlive_physical_rows(lm_and_params):
+    """The capacity unlock in miniature: a pool holding the bytes of
+    THREE contiguous rows serves a 9-request short-prompt stream
+    through 3 slots with room to spare, because each request only ever
+    holds the pages it uses and frees them at completion — the
+    contiguous layout would spend 3 full rows regardless of length."""
+    eng = _mk_engine(lm_and_params, paged=True, pool=0, slots=3,
+                     num_pages=3 * 8 + 1)
+    reg = telemetry.MetricsRegistry()
+    sched = Scheduler(eng, registry=reg)
+    rng = np.random.default_rng(8)
+    reqs = [Request(prompt=list(rng.integers(1, VOCAB, size=4)),
+                    max_new_tokens=3) for _ in range(9)]
+    done = sched.run(reqs)
+    assert len(done) == 9 and all(r.status == "done" for r in reqs)
+    # worst-case page use per request: 1 page (4+3 tokens < page 8),
+    # but the reservation is chunk-padded — still far under a row
+    assert eng.pool_stats()["pages_in_use"] == 0
+    snap = reg.snapshot()
+    assert snap["counters"].get("serving.pool.admit_blocked", 0) == 0

@@ -22,6 +22,13 @@ Everything runs on CPU with a tiny model at policy O0 (exact fp32), the
 same shared-program discipline as test_serving.py: the hit path and the
 cold path literally execute the same XLA programs, so exactness is
 bitwise, not approximately.
+
+These engines are built ``paged=False`` on purpose: this file pins the
+CONTIGUOUS layout's prefix machinery (pool rows, the compiled row-copy,
+refcount pinning, the exactly-FOUR-programs discipline), which the
+paged default keeps as its parity oracle. The paged layout's prefix
+story — copy-on-write page sharing, zero-copy hits, the THREE-program
+pin — lives in tests/L0/test_paged_kv.py.
 """
 
 import jax
@@ -57,7 +64,7 @@ def lm_and_params():
 def _mk_engine(lm_and_params, *, pool=2, slots=3, seed=5):
     m, params = lm_and_params
     return Engine(m, params, slots=slots, max_len=128, prefill_len=24,
-                  chunk_len=CHUNK, prefix_pool=pool,
+                  chunk_len=CHUNK, prefix_pool=pool, paged=False,
                   policy=resolve_policy("O0", verbose=False), seed=seed)
 
 

@@ -96,21 +96,40 @@ def test_chunk_prefill_keys_are_tuned():
         assert key in table, f"{key} missing from the tuned tables"
 
 
+def test_paged_kernel_keys_are_tuned():
+    """The paged-pool satellite: the block-table kernels' knobs ship
+    tuned values — ``decode.page_block_q`` (the paged prefill kernel's
+    q block; the KV block is pinned to one pool page) and
+    ``decode.page_len`` (the Engine's default page size — the pool's
+    sharing/DMA granule). A fresh paged engine on v5e silicon must not
+    fall back to emulator-era defaults for its two hottest programs."""
+    table = _table_keys()
+    for key in ("decode.page_block_q", "decode.page_len"):
+        assert key in table, f"{key} missing from the tuned tables"
+    refs = _referenced_keys({"decode"})
+    for key in ("decode.page_block_q", "decode.page_len"):
+        assert key in refs, f"{key} is in the tables but no code " \
+            "consumes it (stale sweep row)"
+
+
 def test_prefix_copy_sources_are_linted_and_carry_no_tuned_keys():
-    """The PR 5 prefix-reuse satellite: the KV row-copy program is pure
-    data movement (one dynamic-slice pair, no Pallas kernel), so it
-    deliberately introduces NO ``decode.copy_*`` tuned keys — pin that
-    the tables carry none (a ``decode.copy_*`` row would be a dead
-    sweep, caught here by name rather than only via the generic stale
-    check), and that the lint's scan really covers the new
-    ``serving/prefix_cache.py`` source so any key a future copy kernel
-    DOES reference gets the existence/staleness treatment
-    automatically."""
+    """The PR 5 prefix-reuse satellite, tightened by the paged-pool
+    refactor that RETIRED the copy from the hit path: the contiguous
+    KV row-copy program is pure data movement (one dynamic-slice pair,
+    no Pallas kernel) and the paged path replaces it with host-side
+    page sharing (no program at all) — so neither owes the tables any
+    key, and NO ``decode.copy_*`` row may remain (a stale row would be
+    a dead sweep, caught here by name rather than only via the generic
+    stale check). Also pins that the lint's scan covers the sources the
+    retired path and its replacement live in, so any key a future copy
+    or paging kernel DOES reference gets the existence/staleness
+    treatment automatically."""
     table = _table_keys()
     stale_copy = {k for k in table if k.startswith("decode.copy_")}
     assert not stale_copy, (
-        f"tuned tables carry decode.copy_* keys but the KV row-copy "
-        f"consumes no tuned knobs: {stale_copy}")
+        f"tuned tables carry decode.copy_* keys but neither the "
+        f"contiguous KV row-copy nor the paged zero-copy hit path "
+        f"consumes tuned knobs: {stale_copy}")
     scanned = {os.path.relpath(p, ROOT)
                for d in SCAN_DIRS
                for p in glob.glob(os.path.join(d, "**", "*.py"),
@@ -118,3 +137,4 @@ def test_prefix_copy_sources_are_linted_and_carry_no_tuned_keys():
     assert os.path.join("apex_tpu", "serving",
                         "prefix_cache.py") in scanned
     assert os.path.join("apex_tpu", "serving", "engine.py") in scanned
+    assert os.path.join("apex_tpu", "serving", "kv_cache.py") in scanned
