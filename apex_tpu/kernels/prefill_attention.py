@@ -44,10 +44,14 @@ refactor's chunk-ingestion kernel. Same shifted-causal online softmax,
 but K/V arrive from a dense page pool through a ``[batch, max_pages]``
 page table rather than a contiguous cache row: the KV grid dimension
 walks the row's page list via scalar-prefetch block index maps (page
-``j`` of row ``b`` DMAs pool page ``page_table[b, j]``), the q-block ×
-page skip runs on global positions exactly as the contiguous kernel's
-q-block × k-block skip. The q-block knob is ``decode.page_block_q``
-(the KV block is pinned to one page — the pool's DMA granule).
+``j`` of row ``b`` DMAs pool page ``page_table[b, j]``, clamped at the
+row's last reachable page ``(offsets[b] + C - 1) // page_len`` so grid
+steps past the chunk's extent re-issue the same block index and cost
+no new DMA — the fetch walk is O(offset + C) like the compute, not
+O(max_pages)), the q-block × page skip runs on global positions
+exactly as the contiguous kernel's q-block × k-block skip. The q-block
+knob is ``decode.page_block_q`` (the KV block is pinned to one page —
+the pool's DMA granule).
 """
 
 from __future__ import annotations
@@ -306,16 +310,29 @@ def _paged_prefill_pallas(q, k_pool, v_pool, pt, offsets, scale, bq,
     max_pages = pt.shape[1]
     kernel = functools.partial(_paged_prefill_kernel, scale=scale,
                                block_q=bq, page_len=page_len)
+
+    def _kv_page(b, hh, i, j, pt, off):
+        # Bound the DMA extent by the chunk's offset: row b's queries
+        # reach global position off[b] + C - 1 at most, so pages past
+        # index (off[b] + C - 1) // page_len are never computed over
+        # (the kernel's q-block × page skip). Clamping the page walk
+        # there makes every later grid step re-issue the SAME block
+        # index, which the Pallas pipeline does not re-fetch — the
+        # kernel stops paying DMA for the max_pages tail just as it
+        # already stopped paying MXU for it. Computed steps always have
+        # j <= last, so the clamp never changes what the compute reads
+        # (outputs stay bitwise identical to the oracle).
+        last = (off[b] + (C - 1)) // page_len
+        return (pt[b, jnp.minimum(j, last)], hh, 0, 0)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,                   # page_table, offsets
         grid=(B, h, C // bq, max_pages),
         in_specs=[
             pl.BlockSpec((1, 1, bq, d),
                          lambda b, hh, i, j, pt, off: (b, hh, i, 0)),
-            pl.BlockSpec((1, 1, page_len, d),
-                         lambda b, hh, i, j, pt, off: (pt[b, j], hh, 0, 0)),
-            pl.BlockSpec((1, 1, page_len, d),
-                         lambda b, hh, i, j, pt, off: (pt[b, j], hh, 0, 0)),
+            pl.BlockSpec((1, 1, page_len, d), _kv_page),
+            pl.BlockSpec((1, 1, page_len, d), _kv_page),
         ],
         out_specs=pl.BlockSpec((1, 1, bq, d),
                                lambda b, hh, i, j, pt, off: (b, hh, i, 0)),
@@ -359,8 +376,15 @@ def paged_prefill_attention(q, k_pool, v_pool, page_table, offsets, *,
     scalar-prefetch index maps and skips pages past each q-block's last
     global position — O(offset + C) MXU work per chunk, same as the
     contiguous kernel, over a pool that is dense and shared instead of
-    slot-partitioned. Unaligned shapes and non-Mosaic dtypes fall back
-    to the gather-then-reference oracle.
+    slot-partitioned. The DMA extent is bounded the same way: the page
+    index map clamps at each row's last reachable page
+    (``(offsets[b] + C - 1) // page_len``), so grid steps past the
+    prefix re-issue the same block index and the pipeline fetches
+    nothing new — an early chunk of a long prompt pays O(offset + C)
+    DMA, not O(max_pages) (the clamp only ever retargets steps whose
+    compute is skipped, so outputs are bitwise unchanged). Unaligned
+    shapes and non-Mosaic dtypes fall back to the gather-then-reference
+    oracle.
 
     Tuned geometry: ``decode.page_block_q`` in the
     :mod:`apex_tpu.kernels.vmem` override registry (the KV block is one

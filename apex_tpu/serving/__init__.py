@@ -43,6 +43,18 @@ generation requests from a fixed set of compiled programs:
   ``serving.prefix.*`` / tokens-per-sec telemetry through the shared
   :class:`~apex_tpu.telemetry.MetricsRegistry`.
 
+- :class:`FaultPlan` / :class:`FaultPolicy` / :class:`PoolAuditor`
+  (:mod:`.faults`) — fault isolation: a seeded deterministic
+  chaos-injection harness (non-finite logits into chosen decode slots,
+  transient call-boundary exceptions, heartbeat stalls, debug-copy
+  page-table corruption), the scheduler's always-on containment policy
+  (per-slot non-finite quarantine, requeue with capped exponential
+  backoff → typed ``FAILED``, heartbeat watchdog), and an O(pages)
+  page-pool invariant auditor that raises loudly on leaked or
+  double-freed pages. Un-faulted greedy requests stay bitwise
+  identical to a fault-free run; containment adds ZERO compiled
+  programs.
+
 Quick start::
 
     from apex_tpu import serving
@@ -61,10 +73,14 @@ Exercised end-to-end by ``bench_serving.py`` and
 """
 
 from .engine import Engine, sample_tokens
+from .faults import (FaultPlan, FaultPolicy, FaultSpec, InjectedFault,
+                     PoolAuditor, PoolInvariantError)
 from .kv_cache import KVCache, PagedKVCache, PagePool
 from .prefix_cache import PrefixCache, PrefixMatch
-from .scheduler import QueueFull, Request, Scheduler
+from .scheduler import QueueFull, Request, RequestStatus, Scheduler
 
-__all__ = ["Engine", "KVCache", "PagedKVCache", "PagePool",
-           "PrefixCache", "PrefixMatch", "QueueFull", "Request",
+__all__ = ["Engine", "FaultPlan", "FaultPolicy", "FaultSpec",
+           "InjectedFault", "KVCache", "PagedKVCache", "PagePool",
+           "PoolAuditor", "PoolInvariantError", "PrefixCache",
+           "PrefixMatch", "QueueFull", "Request", "RequestStatus",
            "Scheduler", "sample_tokens"]

@@ -57,6 +57,20 @@ truncated to the engine's static ``top_k``. Temperatures are per-slot
 traced values; ``top_k`` is static (a different ``top_k`` is a new
 engine).
 
+Every sampling program also carries the **non-finite guard**: an
+``all(isfinite)`` reduction over the fp32 logits row(s) it samples
+from, returned per slot so the host (the scheduler's fault policy) can
+quarantine a NaN/Inf slot while its batchmates keep their exact tokens
+— fused into the existing executables, zero new programs. The decode
+and chunk programs additionally take a ``fault_bias`` logit-offset
+operand (all-zero in production — adding +0.0 to an fp32 row is
+value-identical — NaN/Inf under a
+:class:`~apex_tpu.serving.FaultPlan`, which makes the guard fire on
+real non-finite logits). Verdicts land in
+:attr:`Engine.last_decode_finite` / :attr:`Engine.last_chunk_finite` /
+:attr:`Engine.last_prefill_finite` and count
+``serving.faults.nonfinite``.
+
 Weights are cast ONCE at construction through the amp cast-policy
 machinery (default: pure-half O3 — bf16 storage, no fp32 masters, the
 cache in the same dtype); pass ``policy=amp.resolve_policy("O0")`` for
@@ -345,6 +359,16 @@ class Engine:
         self.chunk_traces = 0
         self.copy_traces = 0
         self.tokens_generated = 0
+        # the non-finite guard's host-side view, refreshed by every
+        # sampling call: per-slot flags for the last decode step, one
+        # flag each for the last chunk/monolithic prefill. True means
+        # the sampled logits row was entirely finite (the token is
+        # trustworthy); False is the quarantine signal the scheduler's
+        # fault policy consumes.
+        self.last_decode_finite = np.ones(self.slots, bool)
+        self.last_chunk_finite = True
+        self.last_prefill_finite = True
+        self.nonfinite_events = 0
         # prefill flash-attention geometry: decode.* tuned keys beat the
         # training sweep's flash.* defaults when present
         self._pf_bq = vmem.get_override("decode.prefill_block_q", 0,
@@ -395,6 +419,16 @@ class Engine:
                 + self.prefill_traces + self.copy_traces)
 
     # ------------------------------------------------------ compiled bodies
+    # Every sampling program also returns a per-slot FINITENESS flag —
+    # all(isfinite) over the fp32 logits row it samples from — so the
+    # host can quarantine a NaN/Inf slot without touching its batchmates
+    # (the non-finite guard is FUSED into the existing programs: zero
+    # new executables, pinned by the trace-count tests). The decode and
+    # chunk programs additionally take a ``fault_bias`` logit offset
+    # (per-slot [slots] / scalar) that is 0.0 in production — adding
+    # +0.0 to an fp32 row is value-identical, so clean-path tokens are
+    # unchanged — and NaN/Inf under a FaultPlan injection, which makes
+    # the in-program guard see REAL non-finite logits.
     def _prefill_impl(self, params, cache, tokens, length, slot,
                       temperature, key):
         self.prefill_traces += 1    # python body runs at trace time only
@@ -403,12 +437,14 @@ class Engine:
         cache = cache.insert(slot, k_new, v_new, length)
         last = jax.lax.dynamic_index_in_dim(logits[0], length - 1,
                                             keepdims=False)        # [V]
+        last = jnp.asarray(last, jnp.float32)
+        finite = jnp.all(jnp.isfinite(last))
         token = sample_tokens(last[None], temperature[None], key,
                               self.top_k)[0]
-        return cache, token
+        return cache, token, finite
 
     def _chunk_impl(self, params, cache, tokens, slot, offset, n_valid,
-                    temperature, key):
+                    temperature, fault_bias, key):
         self.chunk_traces += 1      # python body runs at trace time only
         k_slot, v_slot = cache.slot_view(slot)
         offset = jnp.asarray(offset, jnp.int32)
@@ -421,12 +457,14 @@ class Engine:
         # otherwise (one program either way — finality is not traced)
         last = jax.lax.dynamic_index_in_dim(logits[0], n_valid - 1,
                                             keepdims=False)        # [V]
+        last = jnp.asarray(last, jnp.float32) + fault_bias
+        finite = jnp.all(jnp.isfinite(last))
         token = sample_tokens(last[None], temperature[None], key,
                               self.top_k)[0]
-        return cache, token
+        return cache, token, finite
 
     def _decode_impl(self, params, cache, last_tokens, active,
-                     temperature, key):
+                     temperature, fault_bias, key):
         self.decode_traces += 1     # python body runs at trace time only
         # prefix-pool rows sit past the serving slots in the same
         # arrays: slice them out (static) so the decode batch stays
@@ -438,9 +476,11 @@ class Engine:
         logits, (k2, v2) = self._model.apply(
             {"params": params}, last_tokens[:, None], train=False,
             cache=cache.front_view(self.slots), positions=positions)
-        tokens = sample_tokens(logits[:, 0, :], temperature, key,
-                               self.top_k)
-        return cache.advance_front(k2, v2, active), tokens
+        rows = jnp.asarray(logits[:, 0, :], jnp.float32) \
+            + fault_bias[:, None]
+        finite = jnp.all(jnp.isfinite(rows), axis=-1)         # [slots]
+        tokens = sample_tokens(rows, temperature, key, self.top_k)
+        return cache.advance_front(k2, v2, active), tokens, finite
 
     def _copy_impl(self, cache, src, dst, length):
         self.copy_traces += 1       # python body runs at trace time only
@@ -474,12 +514,14 @@ class Engine:
                               v=_scatter(cache.v, v_new))
         last = jax.lax.dynamic_index_in_dim(logits[0], length - 1,
                                             keepdims=False)        # [V]
+        last = jnp.asarray(last, jnp.float32)
+        finite = jnp.all(jnp.isfinite(last))
         token = sample_tokens(last[None], temperature[None], key,
                               self.top_k)[0]
-        return cache, token
+        return cache, token, finite
 
     def _paged_chunk_impl(self, params, cache, tokens, pt_row, offset,
-                          n_valid, temperature, key):
+                          n_valid, temperature, fault_bias, key):
         self.chunk_traces += 1      # python body runs at trace time only
         offset = jnp.asarray(offset, jnp.int32)
         logits, (k2, v2) = self._model.apply(
@@ -489,12 +531,14 @@ class Engine:
         # sample at the last VALID row (see _chunk_impl)
         last = jax.lax.dynamic_index_in_dim(logits[0], n_valid - 1,
                                             keepdims=False)        # [V]
+        last = jnp.asarray(last, jnp.float32) + fault_bias
+        finite = jnp.all(jnp.isfinite(last))
         token = sample_tokens(last[None], temperature[None], key,
                               self.top_k)[0]
-        return cache, token
+        return cache, token, finite
 
     def _paged_decode_impl(self, params, cache, last_tokens, page_table,
-                           lengths, temperature, key):
+                           lengths, temperature, fault_bias, key):
         self.decode_traces += 1     # python body runs at trace time only
         # lengths are HOST state in the paged layout (the allocator owns
         # them); the program is a pure function of the operands. Length
@@ -505,9 +549,11 @@ class Engine:
         logits, (k2, v2) = self._model.apply(
             {"params": params}, last_tokens[:, None], train=False,
             cache=(cache.k, cache.v, page_table), positions=positions)
-        tokens = sample_tokens(logits[:, 0, :], temperature, key,
-                               self.top_k)
-        return cache.replace(k=k2, v=v2), tokens
+        rows = jnp.asarray(logits[:, 0, :], jnp.float32) \
+            + fault_bias[:, None]
+        finite = jnp.all(jnp.isfinite(rows), axis=-1)         # [slots]
+        tokens = sample_tokens(rows, temperature, key, self.top_k)
+        return cache.replace(k=k2, v=v2), tokens, finite
 
     # ------------------------------------------------------------- host API
     def _next_key(self):
@@ -541,7 +587,7 @@ class Engine:
             # slots' promises) with enough pages to hold it
             self.release_slot(slot, keep_reservation=True)
             self._grow_slot(slot, -(-self.prefill_len // self.page_len))
-            self.cache, token = self._with_prefill_blocks(
+            self.cache, token, finite = self._with_prefill_blocks(
                 lambda: self._jit_prefill(
                     self.params, self.cache, jnp.asarray(tokens),
                     jnp.asarray(self._page_table[slot:slot + 1]),
@@ -549,12 +595,15 @@ class Engine:
                     self._next_key()))
             self._host_len[slot] = n
         else:
-            self.cache, token = self._with_prefill_blocks(
+            self.cache, token, finite = self._with_prefill_blocks(
                 lambda: self._jit_prefill(
                     self.params, self.cache, jnp.asarray(tokens),
                     np.int32(n), np.int32(slot), np.float32(temperature),
                     self._next_key()))
         token = int(token)
+        self.last_prefill_finite = bool(finite)
+        if not self.last_prefill_finite:
+            self._count_nonfinite(1)
         if self._registry is not None:
             self._registry.observe("serving.prefill.s",
                                    time.perf_counter() - t0)
@@ -564,8 +613,8 @@ class Engine:
         return token
 
     def prefill_chunk(self, slot: int, chunk: Sequence[int], offset: int,
-                      temperature: float = 0.0, *,
-                      final: bool = True) -> int:
+                      temperature: float = 0.0, *, final: bool = True,
+                      fault_bias: float = 0.0) -> int:
         """Ingest one chunk of a prompt into ``slot`` at cache position
         ``offset`` and return the token sampled at the chunk's last
         valid row (host int). The token is the request's first output
@@ -575,6 +624,13 @@ class Engine:
 
         ``final`` is host-side accounting only (tokens_generated and the
         telemetry counters tick once per request, on the real token).
+
+        ``fault_bias`` is the chaos harness's injection operand: a
+        float added to the sampled logits row inside the compiled
+        program (0.0 in production — value-identical; NaN/Inf under a
+        :class:`~apex_tpu.serving.FaultPlan` makes the in-program
+        finiteness guard fire for real). The guard's verdict lands in
+        :attr:`last_chunk_finite` either way.
         """
         n = len(chunk)
         if not 0 < n <= self.chunk_len:
@@ -613,18 +669,22 @@ class Engine:
                 self.release_slot(slot, keep_reservation=True)
             self._grow_slot(
                 slot, -(-(offset + self.chunk_len) // self.page_len))
-            self.cache, token = self._jit_chunk(
+            self.cache, token, finite = self._jit_chunk(
                 self.params, self.cache, jnp.asarray(tokens),
                 jnp.asarray(self._page_table[slot:slot + 1]),
                 np.int32(offset), np.int32(n), np.float32(temperature),
-                self._next_key())
+                np.float32(fault_bias), self._next_key())
             self._host_len[slot] = offset + n
         else:
-            self.cache, token = self._jit_chunk(
+            self.cache, token, finite = self._jit_chunk(
                 self.params, self.cache, jnp.asarray(tokens),
                 np.int32(slot), np.int32(offset), np.int32(n),
-                np.float32(temperature), self._next_key())
+                np.float32(temperature), np.float32(fault_bias),
+                self._next_key())
         token = int(token)
+        self.last_chunk_finite = bool(finite)
+        if not self.last_chunk_finite:
+            self._count_nonfinite(1)
         if self._registry is not None:
             self._registry.observe("serving.prefill_chunk_s",
                                    time.perf_counter() - t0)
@@ -874,12 +934,29 @@ class Engine:
             self._host_len, self._n_pages)
         return stats
 
-    def decode_step(self, last_tokens, active, temperatures) -> np.ndarray:
+    def decode_step(self, last_tokens, active, temperatures,
+                    fault_bias=None) -> np.ndarray:
         """One decode step over every slot: ``last_tokens`` [slots] int
         (each slot's most recent token), ``active`` [slots] bool,
         ``temperatures`` [slots] float. Returns the next token per slot
-        (host int32 array; inactive rows are noise to discard)."""
+        (host int32 array; inactive rows are noise to discard).
+
+        ``fault_bias`` ([slots] float, default all-zero) is added to
+        the fp32 logits rows inside the compiled program — the chaos
+        harness's per-slot NaN/Inf injection point (+0.0 elsewhere is
+        value-identical, so healthy slots keep their exact tokens).
+        The in-program finiteness verdict lands in
+        :attr:`last_decode_finite` ([slots] bool); slots flagged False
+        sampled from non-finite logits and must be quarantined, not
+        trusted."""
         t0 = time.perf_counter()
+        if fault_bias is None:
+            fault_bias = np.zeros(self.slots, np.float32)
+        else:
+            fault_bias = np.asarray(fault_bias, np.float32)
+            if fault_bias.shape != (self.slots,):
+                raise ValueError(f"fault_bias {fault_bias.shape} must "
+                                 f"be [{self.slots}]")
         if self.paged:
             act = np.asarray(active, bool)
             # write-then-attend writes at host_len: make sure each
@@ -890,22 +967,29 @@ class Engine:
                 pos = int(self._host_len[s])
                 if pos < self.max_len:
                     self._grow_slot(s, self.pool.pages_for(pos + 1))
-            self.cache, tokens = self._jit_decode(
+            self.cache, tokens, finite = self._jit_decode(
                 self.params, self.cache,
                 jnp.asarray(last_tokens, jnp.int32),
                 jnp.asarray(self._page_table),
                 jnp.asarray(self._host_len),
-                jnp.asarray(temperatures, jnp.float32), self._next_key())
+                jnp.asarray(temperatures, jnp.float32),
+                jnp.asarray(fault_bias), self._next_key())
             out = np.asarray(tokens)        # device sync: step latency
             grow = act & (self._host_len < self.max_len)
             self._host_len[grow] += 1
         else:
-            self.cache, tokens = self._jit_decode(
+            self.cache, tokens, finite = self._jit_decode(
                 self.params, self.cache,
                 jnp.asarray(last_tokens, jnp.int32),
                 jnp.asarray(active, bool),
-                jnp.asarray(temperatures, jnp.float32), self._next_key())
+                jnp.asarray(temperatures, jnp.float32),
+                jnp.asarray(fault_bias), self._next_key())
             out = np.asarray(tokens)        # device sync: step latency
+        self.last_decode_finite = np.asarray(finite, bool)
+        bad = int(np.sum(np.asarray(active, bool)
+                         & ~self.last_decode_finite))
+        if bad:
+            self._count_nonfinite(bad)
         n_active = int(np.sum(np.asarray(active, bool)))
         self.tokens_generated += n_active
         if self._registry is not None:
@@ -915,6 +999,24 @@ class Engine:
             self._registry.counter_inc("serving.tokens_generated",
                                        n_active)
         return out
+
+    def _count_nonfinite(self, n: int) -> None:
+        """One quarantine-worthy non-finite sampling event per affected
+        slot: the ``serving.faults.nonfinite`` counter plus the host
+        tally (kept registry-less so direct callers see it too)."""
+        self.nonfinite_events += int(n)
+        if self._registry is not None:
+            self._registry.counter_inc("serving.faults.nonfinite",
+                                       int(n))
+
+    def page_table_snapshot(self):
+        """DEBUG COPIES of the paged host state — ``(page_table,
+        n_pages)`` numpy arrays safe to mutate (the chaos harness's
+        :meth:`FaultPlan.corrupt_page_table` target and the
+        :class:`~apex_tpu.serving.PoolAuditor`'s corruption-detection
+        probe). Never hands out the live arrays."""
+        self._require_paged("page_table_snapshot")
+        return self._page_table.copy(), self._n_pages.copy()
 
     def lengths(self) -> np.ndarray:
         """Host view of per-slot cache lengths (host state on the paged

@@ -1,0 +1,423 @@
+"""Fault isolation for the serving stack: injection, policy, auditing.
+
+Production serving treats bad numerics and flaky steps as *expected
+events to absorb*, not crashes — the same stance apex's dynamic loss
+scaler takes toward training overflow (detect, skip, keep going). This
+module is the serving-side counterpart, three pieces:
+
+- :class:`FaultPlan` — a **seeded, deterministic fault injector**. A
+  plan is a schedule of :class:`FaultSpec` events keyed by scheduler
+  heartbeat (``tick``): non-finite logit injection into chosen decode
+  slots (delivered through the compiled programs' ``fault_bias``
+  operand, so the engine's in-program finiteness guard sees REAL
+  NaN/Inf logits), transient exceptions raised at the chunk-prefill or
+  decode call boundary (:class:`InjectedFault` — raised *instead of*
+  the compiled call, so cache state is never half-mutated), heartbeat
+  stalls (a plain sleep the watchdog must catch), and page-table
+  corruption applied to **debug copies only**
+  (:meth:`FaultPlan.corrupt_page_table` — proving the
+  :class:`PoolAuditor` detects corruption; it is never pointed at the
+  live tables). Deterministic by construction: explicit specs or
+  :meth:`FaultPlan.random` from a seed — the chaos tests and
+  ``bench_serving.py --chaos`` replay identical schedules.
+
+- :class:`FaultPolicy` — the **per-request containment knobs** the
+  scheduler applies when a fault (injected or real) surfaces: requeue
+  with capped exponential backoff up to ``max_retries`` then a typed
+  ``FAILED`` terminal status, a wall-clock watchdog budget per
+  heartbeat (breach → ``serving.watchdog.stall`` + the ``on_stall``
+  callback), and the :class:`PoolAuditor` sampling rate. The scheduler
+  always runs with a policy (defaults are production-shaped);
+  containment is not opt-in.
+
+- :class:`PoolAuditor` — the **page-pool invariant checker**: an
+  O(pages) host-side walk reconciling :class:`~apex_tpu.serving
+  .PagePool` refcounts against every live slot's page table plus every
+  prefix-cache entry's retained pages, plus free-list hygiene
+  (no duplicates, refcount-0 only, disjoint from referenced pages) and
+  page conservation (in-use + free == allocatable). Any mismatch
+  raises :class:`PoolInvariantError` *loudly* — a leaked page
+  (refcount above its visible readers: HBM that will never come back)
+  or a double-free/dangling reference (refcount below: a table reading
+  a page the allocator may hand to someone else) is corruption, not
+  telemetry. Run it every event in tests (``every_n=1``); sample it in
+  production (``FaultPolicy.audit_every_n``).
+
+The guarantees this layer buys, pinned by ``tests/L0/test_faults.py``:
+under an injected fault schedule every un-faulted greedy request
+completes **bitwise token-identical** to a fault-free run (healthy
+slots in a batch with a quarantined slot keep their exact tokens — the
+guard is per-slot, the program is unchanged), every faulted request
+reaches a typed terminal status, and the auditor reports zero
+leaked/double-freed pages at drain.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from apex_tpu.log_util import get_logger
+
+__all__ = ["FaultSpec", "FaultPlan", "FaultPolicy", "InjectedFault",
+           "PoolAuditor", "PoolInvariantError"]
+
+_logger = get_logger("serving")
+
+# injection sites a FaultSpec(kind="exception") may name
+_EXCEPTION_SITES = ("chunk", "decode")
+
+
+class InjectedFault(RuntimeError):
+    """A :class:`FaultPlan`-scheduled transient failure, raised at the
+    compiled-call boundary (the call itself never runs, so engine/cache
+    state is exactly what it was before the heartbeat reached the
+    call). ``slot`` names the victim slot when the site attributes one
+    (decode faults), else -1 (the scheduler attributes the in-flight
+    request at the call site)."""
+
+    def __init__(self, message: str, slot: int = -1):
+        super().__init__(message)
+        self.slot = int(slot)
+        self.transient = True
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One scheduled fault.
+
+    ``kind``:
+
+    - ``"nonfinite"`` — add ``value`` (default NaN) to slot ``slot``'s
+      decode logits at heartbeat ``tick`` via the decode program's
+      ``fault_bias`` operand. The engine's in-program guard must flag
+      the slot; every other slot's logits gain exactly ``+0.0``.
+    - ``"exception"`` — raise :class:`InjectedFault` at heartbeat
+      ``tick`` from injection site ``site`` (``"chunk"`` /
+      ``"decode"``), instead of running the compiled call.
+    - ``"stall"`` — sleep ``stall_s`` seconds at heartbeat ``tick``
+      (the watchdog-budget breach the plan manufactures).
+    """
+
+    kind: str
+    tick: int
+    slot: int = -1
+    site: str = "decode"
+    value: float = float("nan")
+    stall_s: float = 0.0
+
+    def __post_init__(self):
+        if self.kind not in ("nonfinite", "exception", "stall"):
+            raise ValueError(f"unknown fault kind {self.kind!r}")
+        if self.kind == "nonfinite" and self.slot < 0:
+            raise ValueError("nonfinite faults need a victim slot")
+        if self.kind == "exception" and self.site not in _EXCEPTION_SITES:
+            raise ValueError(f"exception site {self.site!r} not in "
+                             f"{_EXCEPTION_SITES}")
+        if self.kind == "stall" and self.stall_s <= 0:
+            raise ValueError("stall faults need stall_s > 0")
+
+
+class FaultPlan:
+    """A deterministic schedule of :class:`FaultSpec` events, consulted
+    by the scheduler once per heartbeat (see module docstring). Plans
+    are replayable: the same specs (or the same :meth:`random` seed)
+    produce the same injections in the same heartbeats, which is what
+    lets the chaos tests compare a chaos run against a fault-free run
+    token-for-token."""
+
+    def __init__(self, specs: Sequence[FaultSpec] = ()):
+        self.specs: Tuple[FaultSpec, ...] = tuple(specs)
+        self._nonfinite: Dict[int, List[FaultSpec]] = {}
+        self._exceptions: Dict[Tuple[str, int], FaultSpec] = {}
+        self._stalls: Dict[int, FaultSpec] = {}
+        for s in self.specs:
+            if s.kind == "nonfinite":
+                self._nonfinite.setdefault(int(s.tick), []).append(s)
+            elif s.kind == "exception":
+                self._exceptions[(s.site, int(s.tick))] = s
+            else:
+                self._stalls[int(s.tick)] = s
+        # raw injection counters (the chaos bench reads them)
+        self.injected_nonfinite = 0
+        self.injected_exceptions = 0
+        self.injected_stalls = 0
+
+    @classmethod
+    def random(cls, seed: int, ticks: int, *, slots: int,
+               nonfinite_rate: float = 0.0, exception_rate: float = 0.0,
+               stall_rate: float = 0.0,
+               stall_s: float = 0.05) -> "FaultPlan":
+        """A seeded random schedule over ``ticks`` heartbeats: each
+        tick independently draws a non-finite injection (uniform victim
+        slot), a transient exception (uniform site), and/or a stall at
+        the given per-tick rates. Same seed → same schedule, always."""
+        rng = np.random.default_rng(seed)
+        specs: List[FaultSpec] = []
+        for t in range(int(ticks)):
+            if rng.random() < nonfinite_rate:
+                specs.append(FaultSpec(
+                    kind="nonfinite", tick=t,
+                    slot=int(rng.integers(0, max(1, slots)))))
+            if rng.random() < exception_rate:
+                specs.append(FaultSpec(
+                    kind="exception", tick=t,
+                    site=_EXCEPTION_SITES[int(rng.integers(0, 2))]))
+            if rng.random() < stall_rate:
+                specs.append(FaultSpec(kind="stall", tick=t,
+                                       stall_s=stall_s))
+        return cls(specs)
+
+    # ------------------------------------------------------------ injection
+    def decode_bias(self, tick: int, slots: int) -> Optional[np.ndarray]:
+        """The decode program's per-slot logit bias for this heartbeat:
+        ``None`` (no operand worth building) on fault-free ticks, else
+        a float32 ``[slots]`` array that is 0.0 everywhere except the
+        victim slots' injected values. Victims outside ``[0, slots)``
+        are ignored (a random plan drawn for a wider engine stays
+        usable)."""
+        specs = self._nonfinite.get(int(tick))
+        if not specs:
+            return None
+        bias = np.zeros(int(slots), np.float32)
+        hit = False
+        for s in specs:
+            if 0 <= s.slot < slots:
+                bias[s.slot] = np.float32(s.value)
+                hit = True
+        if not hit:
+            return None
+        self.injected_nonfinite += 1
+        return bias
+
+    def maybe_raise(self, site: str, tick: int) -> None:
+        """Raise the :class:`InjectedFault` scheduled for ``site`` at
+        this heartbeat, if any — called by the scheduler *instead of*
+        the compiled call it guards. The spec is CONSUMED when it
+        fires: one scheduled fault is one injection with one victim,
+        even when the heartbeat makes several calls at the same site
+        (chunk budgets > 1, cold-queue bursts)."""
+        spec = self._exceptions.pop((site, int(tick)), None)
+        if spec is not None:
+            self.injected_exceptions += 1
+            raise InjectedFault(
+                f"injected transient {site} failure at tick {tick}",
+                slot=spec.slot)
+
+    def maybe_stall(self, tick: int) -> float:
+        """Sleep through the stall scheduled for this heartbeat (if
+        any); returns the seconds slept (0.0 on stall-free ticks)."""
+        spec = self._stalls.get(int(tick))
+        if spec is None:
+            return 0.0
+        self.injected_stalls += 1
+        time.sleep(spec.stall_s)
+        return spec.stall_s
+
+    def corrupt_page_table(self, page_table: np.ndarray,
+                           n_pages: np.ndarray, *, slot: int = 0,
+                           entry: int = 0,
+                           value: int = -1) -> np.ndarray:
+        """Corrupt one entry of a **debug copy** of a page table (the
+        auditor-sensitivity probe: a corrupted copy must make
+        :meth:`PoolAuditor.audit` raise). Refuses to write through to
+        what looks like live engine state — pass
+        ``Engine.page_table_snapshot()`` output. Returns the corrupted
+        table for chaining."""
+        if not page_table.flags.writeable or not page_table.flags.owndata:
+            raise ValueError(
+                "corrupt_page_table mutates its argument and is meant "
+                "for DEBUG COPIES (Engine.page_table_snapshot()) — "
+                "refusing a view/read-only array that may be live "
+                "engine state")
+        if not int(n_pages[slot]):
+            raise ValueError(f"slot {slot} holds no pages to corrupt")
+        entry = int(entry) % int(n_pages[slot])
+        page_table[slot, entry] = value
+        return page_table
+
+    def stats(self) -> dict:
+        """Injection counts so far (the chaos bench's honesty row)."""
+        return {
+            "scheduled": len(self.specs),
+            "injected_nonfinite": self.injected_nonfinite,
+            "injected_exceptions": self.injected_exceptions,
+            "injected_stalls": self.injected_stalls,
+        }
+
+
+@dataclasses.dataclass
+class FaultPolicy:
+    """The scheduler's containment knobs (always on; these defaults are
+    the production shape — tests tighten ``audit_every_n`` to 1 and
+    zero the backoff for speed).
+
+    - ``max_retries``: transient faults a request may absorb before its
+      typed ``FAILED`` terminal status (each fault releases the slot
+      and its pages, then requeues).
+    - ``backoff_base_s`` / ``backoff_cap_s``: capped exponential
+      backoff between retries (``base * 2**(retries-1)``, capped) — a
+      requeued request is not re-admitted before its backoff elapses.
+    - ``watchdog_budget_s``: wall-clock budget per scheduler heartbeat;
+      a breach emits ``serving.watchdog.stall`` (+ the breach duration
+      into the ``serving.watchdog.stall_s`` histogram) and invokes
+      ``on_stall(elapsed_s)``. ``None`` disables the watchdog. Note the
+      first heartbeat traces compiled programs — budget accordingly (or
+      warm the engine first).
+    - ``audit_every_n``: run the :class:`PoolAuditor` every N
+      finish/eviction events (1 = every event — the test setting; the
+      default samples). ``0`` disables auditing.
+    """
+
+    max_retries: int = 2
+    backoff_base_s: float = 0.05
+    backoff_cap_s: float = 2.0
+    watchdog_budget_s: Optional[float] = None
+    on_stall: Optional[Callable[[float], None]] = None
+    audit_every_n: int = 64
+
+    def backoff_s(self, retries: int) -> float:
+        """Backoff before retry number ``retries`` (1-based)."""
+        if self.backoff_base_s <= 0:
+            return 0.0
+        return min(self.backoff_base_s * (2.0 ** (max(int(retries), 1)
+                                                  - 1)),
+                   self.backoff_cap_s)
+
+
+class PoolInvariantError(RuntimeError):
+    """A page-pool invariant does not hold: leaked pages (refcounted
+    above their visible readers), double-frees/dangling references
+    (below), free-list corruption, or an out-of-range/sentinel page id
+    in a live table. Raised loudly by :meth:`PoolAuditor.audit` —
+    this is corruption, not a telemetry event."""
+
+
+class PoolAuditor:
+    """Reconcile a paged engine's :class:`~apex_tpu.serving.PagePool`
+    refcounts with everything that can legitimately hold a page: live
+    slot page tables and prefix-cache entries (see module docstring).
+    O(pages + table entries) of pure numpy/python per audit — cheap
+    enough for ``every_n=1`` in tests; sample in production.
+
+    ``maybe_audit`` is the scheduler's hook (counts events, audits
+    every ``every_n``-th); ``audit`` is the full check, callable with
+    debug-copy overrides so the chaos tests can prove a corrupted
+    table is *detected*."""
+
+    def __init__(self, every_n: int = 1, registry=None):
+        self.every_n = int(every_n)
+        self._registry = registry
+        self._events = 0
+        self.audits = 0
+
+    def maybe_audit(self, engine) -> Optional[dict]:
+        """Count one auditable event (request finish, prefix eviction);
+        run :meth:`audit` on every ``every_n``-th. No-op (None) when
+        sampling skips this event or auditing is disabled."""
+        if self.every_n <= 0:
+            return None
+        self._events += 1
+        if self._events % self.every_n:
+            return None
+        return self.audit(engine)
+
+    def audit(self, engine, page_table: Optional[np.ndarray] = None,
+              n_pages: Optional[np.ndarray] = None) -> dict:
+        """Walk the pool and raise :class:`PoolInvariantError` on any
+        violation; returns a summary dict when everything reconciles.
+        ``page_table``/``n_pages`` override the engine's live tables
+        with debug copies (the corruption-detection probe)."""
+        if not getattr(engine, "paged", False):
+            raise RuntimeError("PoolAuditor audits paged engines only")
+        pool = engine.pool
+        if page_table is None:
+            page_table = engine._page_table
+        if n_pages is None:
+            n_pages = engine._n_pages
+        num_pages = pool.num_pages
+        problems: List[str] = []
+        expected = np.zeros(num_pages, np.int64)
+        for s in range(page_table.shape[0]):
+            n = int(n_pages[s])
+            for p in page_table[s, :n]:
+                p = int(p)
+                if not 0 < p < num_pages:
+                    problems.append(
+                        f"slot {s} table holds page id {p} outside the "
+                        f"allocatable range (1, {num_pages}) — corrupt "
+                        f"entry or sentinel in the live region")
+                else:
+                    expected[p] += 1
+        pcache = getattr(engine, "prefix_cache", None)
+        if pcache is not None:
+            for pages in pcache.page_holds():
+                for p in pages:
+                    p = int(p)
+                    if not 0 < p < num_pages:
+                        problems.append(
+                            f"prefix entry holds out-of-range page id "
+                            f"{p}")
+                    else:
+                        expected[p] += 1
+        ref = np.asarray(pool.refcount, np.int64)
+        leaked = np.flatnonzero(ref > expected)
+        dangling = np.flatnonzero(ref < expected)
+        if leaked.size:
+            problems.append(
+                f"LEAKED pages {leaked.tolist()}: refcount "
+                f"{ref[leaked].tolist()} exceeds visible readers "
+                f"{expected[leaked].tolist()} — these pages can never "
+                f"return to the free list")
+        if dangling.size:
+            problems.append(
+                f"DOUBLE-FREED/dangling pages {dangling.tolist()}: "
+                f"visible readers {expected[dangling].tolist()} exceed "
+                f"refcount {ref[dangling].tolist()} — a table "
+                f"references a page the allocator may reuse")
+        free = [int(p) for p in pool.free_list()]
+        free_set = set(free)
+        if len(free_set) != len(free):
+            problems.append("free list holds duplicate page ids")
+        if 0 in free_set:
+            problems.append("sentinel page 0 is on the free list")
+        out_of_range = [p for p in free_set if not 0 <= p < num_pages]
+        if out_of_range:
+            problems.append(
+                f"free list holds out-of-range page ids "
+                f"{out_of_range} — a future alloc would hand out a "
+                f"page that does not exist")
+        bad_free = [p for p in free_set
+                    if 0 < p < num_pages and ref[p] != 0]
+        if bad_free:
+            problems.append(
+                f"pages {bad_free} are on the free list with nonzero "
+                f"refcounts")
+        # conservation against an INDEPENDENT quantity (pages_in_use is
+        # derived from the free list, so comparing those two would be a
+        # tautology): every allocatable page must be either free or
+        # refcounted — a page that is neither has fallen out of the
+        # allocator entirely and can never be handed out again
+        lost = [p for p in range(1, num_pages)
+                if ref[p] == 0 and p not in free_set]
+        if lost:
+            problems.append(
+                f"pages {lost} are neither free nor referenced — lost "
+                f"from the allocator (conservation broken)")
+        self.audits += 1
+        if self._registry is not None:
+            self._registry.counter_inc("serving.faults.audits")
+        if problems:
+            raise PoolInvariantError(
+                "page-pool invariant audit failed:\n  - "
+                + "\n  - ".join(problems))
+        return {
+            "pages": num_pages,
+            "pages_in_use": pool.pages_in_use,
+            "pages_free": len(free),
+            "cow_shares": pool.cow_shares,
+            "audits": self.audits,
+        }

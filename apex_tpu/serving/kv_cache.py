@@ -73,7 +73,7 @@ mid-decode.
 
 from __future__ import annotations
 
-from typing import Any, Iterable, List, Optional, Sequence
+from typing import Any, Iterable, List, Optional, Sequence, Tuple
 
 import flax.struct
 import jax
@@ -382,6 +382,13 @@ class PagePool:
     def pages_for(self, positions: int) -> int:
         """Pages covering ``positions`` logical positions."""
         return -(-int(positions) // self.page_len)
+
+    def free_list(self) -> Tuple[int, ...]:
+        """Snapshot of the free list (page ids, allocation order not
+        guaranteed) — the :class:`~apex_tpu.serving.PoolAuditor`'s view
+        for free-list hygiene checks (no duplicates, refcount 0 only,
+        disjoint from referenced pages)."""
+        return tuple(self._free)
 
     # ----------------------------------------------------------- allocation
     def reserve(self, n: int) -> bool:

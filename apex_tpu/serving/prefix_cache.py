@@ -357,6 +357,16 @@ class PrefixCache:
         self._index.clear()
         self._free = list(self.pool_rows)
 
+    def page_holds(self) -> List[Tuple[int, ...]]:
+        """Every paged entry's retained page-id tuple — the refcounts
+        the cache legitimately holds in the engine's
+        :class:`~apex_tpu.serving.PagePool`, exposed for the
+        :class:`~apex_tpu.serving.PoolAuditor`'s reconciliation walk.
+        Empty for a contiguous-layout cache (row entries hold no
+        pages)."""
+        return [entry.pages for entry in self._entries.values()
+                if entry.pages is not None]
+
     def stats(self) -> dict:
         """One host-side snapshot of the cache's counters and occupancy
         (the scheduler mirrors this into ``serving.prefix.*``)."""

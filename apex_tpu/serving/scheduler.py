@@ -53,8 +53,36 @@ bounds that stall at one chunk, and short prompts stop paying full
 Backpressure instead of OOM: the queue is bounded (``max_queue``);
 :meth:`submit` raises :class:`QueueFull` when it is at capacity, so a
 caller that outruns the engine gets a typed rejection to retry/shed —
-never an unbounded host-side pileup. (:meth:`run` absorbs the same
-signal by stepping the engine until space frees.)
+never an unbounded host-side pileup. The rejection carries a
+``retry_after_s`` hint derived from the measured decode throughput
+(an EMA of decode-step wall time × the steps until the nearest running
+request can finish), so a well-behaved client backs off by data, not
+by guess. (:meth:`run` absorbs the same signal by stepping the engine
+until space frees.)
+
+**Fault isolation** (always on; knobs in :class:`~apex_tpu.serving
+.FaultPolicy`): every engine call in the heartbeat is containment-
+wrapped. A transient exception from a chunk-prefill or decode call —
+real, or injected by a :class:`~apex_tpu.serving.FaultPlan` — costs
+only its victim request: the slot is freed, its pages and prefix pins
+released, and the request requeues with capped exponential backoff up
+to ``max_retries`` before the typed ``FAILED`` terminal status. The
+engine's in-program non-finite guard quarantines a NaN/Inf slot the
+same way while its batchmates keep their exact tokens. A per-heartbeat
+wall-clock watchdog (``watchdog_budget_s``) turns stalls into
+``serving.watchdog.stall`` events plus an ``on_stall`` callback, and a
+:class:`~apex_tpu.serving.PoolAuditor` (sampled via
+``audit_every_n``) reconciles page refcounts after finish/eviction
+events — leaks and double-frees raise loudly instead of rotting. The
+headline guarantee, pinned by ``tests/L0/test_faults.py``: under an
+injected fault schedule, un-faulted greedy requests complete bitwise
+token-identical to a fault-free run, faulted requests reach a typed
+terminal status, and the pool drains with zero leaked pages.
+
+Terminal request states are one typed enum (:class:`RequestStatus`):
+``FINISHED`` (served to completion), ``EXPIRED`` (deadline), and
+``FAILED`` (fault policy exhausted) — used consistently across the
+scheduler, the request records, and telemetry.
 
 Prefix registration is the write half: when a retained-prefix run's
 prompt finishes chunk prefill, its block-aligned K/V is copied into a
@@ -83,6 +111,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import enum
 import itertools
 import time
 from typing import List, Optional, Sequence
@@ -91,16 +120,59 @@ import numpy as np
 
 from apex_tpu.log_util import get_logger
 
-__all__ = ["Request", "QueueFull", "Scheduler"]
+from .faults import FaultPolicy, PoolAuditor
+
+__all__ = ["Request", "RequestStatus", "QueueFull", "Scheduler"]
 
 _logger = get_logger("serving")
 
 _uid = itertools.count()
 
 
+class RequestStatus(str, enum.Enum):
+    """A request's lifecycle state — the ONE status vocabulary shared
+    by the scheduler, the :class:`Request` record, and the telemetry
+    completion records. A ``str`` subclass, so legacy comparisons
+    against the transient literals (``"queued"``/``"prefilling"``/
+    ``"running"``) keep working; the typed terminals are
+
+    - ``FINISHED`` — served to completion (EOS / token budget / cache
+      ``max_len``; see ``finish_reason`` for which);
+    - ``EXPIRED`` — deadline passed while queued or running;
+    - ``FAILED`` — the fault policy's retry budget ran out (transient
+      step failures or non-finite quarantines; ``error`` carries the
+      last fault).
+    """
+
+    NEW = "new"
+    QUEUED = "queued"
+    PREFILLING = "prefilling"
+    RUNNING = "running"
+    FINISHED = "finished"
+    EXPIRED = "expired"
+    FAILED = "failed"
+
+    def __str__(self) -> str:           # records/logs print the value
+        return self.value
+
+    @property
+    def terminal(self) -> bool:
+        return self in (RequestStatus.FINISHED, RequestStatus.EXPIRED,
+                        RequestStatus.FAILED)
+
+
 class QueueFull(RuntimeError):
     """Raised by :meth:`Scheduler.submit` when the bounded request queue
-    is at capacity — the backpressure signal (shed or retry later)."""
+    is at capacity — the backpressure signal (shed or retry later).
+    ``retry_after_s`` (when the scheduler has measured any decode
+    throughput yet, else None) estimates how long until a queue
+    position frees: decode-step EMA × the fewest steps any running
+    request still needs."""
+
+    def __init__(self, message: str,
+                 retry_after_s: Optional[float] = None):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
 
 
 @dataclasses.dataclass
@@ -110,16 +182,19 @@ class Request:
     Inputs: ``prompt`` (token ids), ``max_new_tokens``, ``temperature``
     (0 = greedy), optional ``timeout_s`` (else the scheduler default).
 
-    Outputs (filled by the scheduler): ``output_tokens``, ``status``
-    (``"done"`` / ``"timeout"``; transiently ``"queued"`` /
-    ``"prefilling"`` / ``"running"``), ``finish_reason`` (``"eos"`` /
-    ``"max_new_tokens"`` / ``"max_len"`` / ``"timeout"``), ``ttft_s``
-    and its decomposition ``queue_wait_s`` (submit → admission) +
-    ``prefill_s`` (summed chunk/prefill compute), ``chunks`` (prefill
-    steps the prompt took; 1 on the monolithic path),
-    ``reused_tokens`` (prompt positions restored from the prefix cache
-    instead of prefilled; 0 on a miss or with retention off),
-    ``latency_s``.
+    Outputs (filled by the scheduler): ``output_tokens``, ``status`` (a
+    :class:`RequestStatus`: terminally ``FINISHED`` / ``EXPIRED`` /
+    ``FAILED``; transiently ``QUEUED`` / ``PREFILLING`` / ``RUNNING``),
+    ``finish_reason`` (``"eos"`` / ``"max_new_tokens"`` / ``"max_len"``
+    / ``"timeout"`` / ``"fault"``), ``ttft_s`` and its decomposition
+    ``queue_wait_s`` (submit → admission) + ``prefill_s`` (summed
+    chunk/prefill compute — cumulative across retries: it is compute
+    actually paid), ``chunks`` (prefill steps paid, cumulative across
+    retries), ``reused_tokens`` (prompt positions restored from the
+    prefix cache instead of prefilled; 0 on a miss or with retention
+    off), ``latency_s`` (from the ORIGINAL submit — retries don't reset
+    the clock), ``retries`` (transient faults absorbed so far) and
+    ``error`` (the last fault's description; None when never faulted).
     """
 
     prompt: Sequence[int]
@@ -130,7 +205,7 @@ class Request:
 
     # filled in by the scheduler
     output_tokens: List[int] = dataclasses.field(default_factory=list)
-    status: str = "new"
+    status: RequestStatus = RequestStatus.NEW
     finish_reason: Optional[str] = None
     ttft_s: Optional[float] = None
     queue_wait_s: Optional[float] = None
@@ -138,9 +213,19 @@ class Request:
     chunks: int = 0
     reused_tokens: int = 0
     latency_s: Optional[float] = None
+    retries: int = 0
+    error: Optional[str] = None
     _t_submit: Optional[float] = dataclasses.field(default=None,
                                                    repr=False)
+    # the CURRENT queueing episode's start (reset when a quarantine
+    # requeues): queue_wait_s measures time actually spent waiting for
+    # a slot, never prior service time — _t_submit keeps the original
+    # clock for latency_s and deadlines
+    _t_queued: Optional[float] = dataclasses.field(default=None,
+                                                   repr=False)
     _prefill_pos: int = dataclasses.field(default=0, repr=False)
+    _not_before: Optional[float] = dataclasses.field(default=None,
+                                                     repr=False)
 
 
 class Scheduler:
@@ -151,7 +236,10 @@ class Scheduler:
                  default_timeout_s: Optional[float] = None,
                  eos_id: Optional[int] = None, registry=None,
                  chunked: bool = True, chunk_budget: int = 1,
-                 retain_prefixes: bool = False):
+                 retain_prefixes: bool = False,
+                 fault_policy: Optional[FaultPolicy] = None,
+                 fault_plan=None,
+                 auditor: Optional[PoolAuditor] = None):
         if max_queue < 1:
             raise ValueError("max_queue must be >= 1")
         if chunk_budget < 1:
@@ -183,6 +271,24 @@ class Scheduler:
         # per-slot pinned prefix match (released when the slot frees)
         self._slot_prefix: List[Optional[object]] = [None] * engine.slots
         self.completed: List[Request] = []
+        # fault isolation: containment is ALWAYS on (the policy has
+        # production defaults); the plan is the chaos harness's
+        # injection schedule (None in production); the auditor
+        # reconciles page refcounts after finish/eviction events on
+        # paged engines, sampled by the policy's audit_every_n
+        self.fault_policy = fault_policy if fault_policy is not None \
+            else FaultPolicy()
+        self.fault_plan = fault_plan
+        if auditor is not None:
+            self.auditor = auditor
+        elif getattr(engine, "paged", False):
+            self.auditor = PoolAuditor(
+                every_n=self.fault_policy.audit_every_n,
+                registry=self.registry)
+        else:
+            self.auditor = None
+        self._tick = 0            # heartbeat index (the FaultPlan clock)
+        self._step_s_ema: Optional[float] = None   # decode-step seconds
 
     # ------------------------------------------------------------ ingestion
     def submit(self, request: Request) -> Request:
@@ -203,40 +309,68 @@ class Scheduler:
         if len(self._queue) >= self.max_queue:
             if self.registry is not None:
                 self.registry.counter_inc("serving.requests.rejected")
+            hint = self._retry_after_hint()
+            suffix = f" (retry_after_s~{hint:.3f})" if hint else ""
             raise QueueFull(
                 f"request queue at capacity ({self.max_queue}); retry "
-                "after a step() or shed load")
-        request.status = "queued"
+                f"after a step() or shed load{suffix}",
+                retry_after_s=hint)
+        request.status = RequestStatus.QUEUED
         request._t_submit = time.perf_counter()
+        request._t_queued = request._t_submit
         self._queue.append(request)
         if self.registry is not None:
             self.registry.counter_inc("serving.requests.submitted")
         return request
 
     # ----------------------------------------------------------- accounting
+    def _retry_after_hint(self) -> Optional[float]:
+        """The :class:`QueueFull` backoff hint, derived from measured
+        decode throughput: a queue position frees when the nearest
+        running request finishes, which costs at least (fewest
+        remaining tokens across running slots) decode steps at the
+        EMA'd step latency. None before the first measured decode step
+        (nothing honest to say yet)."""
+        if self._step_s_ema is None:
+            return None
+        remaining = [max(1, r.max_new_tokens - len(r.output_tokens))
+                     for r in self._running if r is not None]
+        steps = min(remaining) if remaining else 1
+        return round(steps * self._step_s_ema, 6)
+
+    def _free_slot(self, slot: int) -> None:
+        """Detach whatever occupies ``slot``: clear the running entry,
+        unpin its prefix donor, and (paged) return its pages plus any
+        unused admission reservation to the pool NOW — on the
+        contiguous layout the row is only reclaimed by the next prefill
+        overwriting it. Shared by normal finishes and fault
+        quarantines."""
+        self._running[slot] = None
+        self._temps[slot] = 0.0
+        if self._slot_prefix[slot] is not None:
+            # the slot no longer reads from its donor prefix: unpin
+            self.engine.prefix_cache.release(self._slot_prefix[slot])
+            self._slot_prefix[slot] = None
+        if getattr(self.engine, "paged", False):
+            self.engine.release_slot(slot)
+
     def _finish(self, request: Request, reason: str,
-                slot: Optional[int] = None) -> None:
+                slot: Optional[int] = None,
+                status: Optional[RequestStatus] = None) -> None:
         request.finish_reason = reason
-        request.status = "timeout" if reason == "timeout" else "done"
+        if status is None:
+            status = RequestStatus.EXPIRED if reason == "timeout" \
+                else RequestStatus.FINISHED
+        request.status = status
         if request._t_submit is not None:
             request.latency_s = time.perf_counter() - request._t_submit
         if slot is not None:
-            self._running[slot] = None
-            self._temps[slot] = 0.0
-            if self._slot_prefix[slot] is not None:
-                # the slot no longer reads from its donor prefix: unpin
-                self.engine.prefix_cache.release(self._slot_prefix[slot])
-                self._slot_prefix[slot] = None
-            if getattr(self.engine, "paged", False):
-                # immediate reclamation: the slot's pages (and any
-                # unused admission reservation) go back to the pool NOW
-                # — on the contiguous layout the row is only reclaimed
-                # by the next prefill overwriting it
-                self.engine.release_slot(slot)
+            self._free_slot(slot)
         self.completed.append(request)
         if self.registry is not None:
-            key = ("serving.requests.timeout" if reason == "timeout"
-                   else "serving.requests.completed")
+            key = {RequestStatus.EXPIRED: "serving.requests.timeout",
+                   RequestStatus.FAILED: "serving.requests.failed"}.get(
+                       status, "serving.requests.completed")
             self.registry.counter_inc(key)
             # one completion record per request: the TTFT decomposition
             # and chunk count ride the ring/sinks alongside the
@@ -245,16 +379,59 @@ class Scheduler:
             # histograms — don't grow junk reservoirs per request)
             self.registry.record_step({
                 "uid": request.uid,
+                "status": request.status.value,
                 "finish_reason": reason,
                 "prompt_tokens": len(request.prompt),
                 "output_tokens": len(request.output_tokens),
                 "chunks_per_prompt": request.chunks,
                 "reused_tokens": request.reused_tokens,
+                "retries": request.retries,
+                "error": request.error,
                 "queue_wait_s": request.queue_wait_s,
                 "prefill_s": request.prefill_s,
                 "ttft_s": request.ttft_s,
                 "latency_s": request.latency_s,
             }, tag="serving.request", observe=False)
+        if self.auditor is not None:
+            # finish events move refcounts (page release, reservation
+            # return): reconcile on the policy's sampling cadence
+            self.auditor.maybe_audit(self.engine)
+
+    def _quarantine(self, request: Request, slot: Optional[int],
+                    error: str) -> None:
+        """Contain one per-request fault: free the slot (pages,
+        reservation, prefix pin), then either requeue the request with
+        capped exponential backoff — its transient outputs reset, its
+        paid-compute counters (``chunks``, ``prefill_s``) and the
+        original submit clock kept — or, past ``max_retries``, finish
+        it with the typed ``FAILED`` terminal status. The engine and
+        every other slot are untouched: this is the blast-radius
+        boundary."""
+        request.retries += 1
+        request.error = error
+        if slot is not None:
+            self._free_slot(slot)
+        policy = self.fault_policy
+        if request.retries > policy.max_retries:
+            _logger.warning(
+                "request %d FAILED after %d retries: %s", request.uid,
+                request.retries - 1, error)
+            self._finish(request, "fault", status=RequestStatus.FAILED)
+            return
+        request.output_tokens.clear()
+        request._prefill_pos = 0
+        request.reused_tokens = 0
+        request.ttft_s = None
+        request.status = RequestStatus.QUEUED
+        now = time.perf_counter()
+        request._t_queued = now     # a fresh queueing episode begins
+        request._not_before = now + policy.backoff_s(request.retries)
+        self._queue.append(request)
+        if self.registry is not None:
+            self.registry.counter_inc("serving.faults.requeued")
+        _logger.info("request %d requeued (retry %d/%d): %s",
+                     request.uid, request.retries, policy.max_retries,
+                     error)
 
     def _deadline(self, request: Request) -> Optional[float]:
         t = request.timeout_s if request.timeout_s is not None \
@@ -276,26 +453,40 @@ class Scheduler:
                 self._finish(r, "timeout", slot)
 
     # ------------------------------------------------------------ admission
+    def _eligible_index(self, now: float) -> Optional[int]:
+        """The queue index of the first request whose retry backoff (if
+        any) has elapsed — FIFO order among eligible requests; a
+        backing-off request never blocks the ones behind it (it already
+        had its turn)."""
+        for i, r in enumerate(self._queue):
+            if r._not_before is None or r._not_before <= now:
+                return i
+        return None
+
     def _admit(self) -> None:
         if not self.chunked:
             return self._admit_monolithic()
         for slot in range(self.engine.slots):
             if self._running[slot] is not None or not self._queue:
                 continue
-            if not self._reserve_pages(slot, self._queue[0]):
-                # pool exhausted for the HEAD request: stop admitting
-                # (FIFO — later, smaller requests must not starve it);
-                # finishing requests release pages, so the next beat
-                # retries
+            idx = self._eligible_index(time.perf_counter())
+            if idx is None:
+                break               # everything queued is backing off
+            if not self._reserve_pages(slot, self._queue[idx]):
+                # pool exhausted for the first eligible request: stop
+                # admitting (FIFO — later, smaller requests must not
+                # starve it); finishing requests release pages, so the
+                # next beat retries
                 break
-            r = self._queue.popleft()
+            r = self._queue[idx]
+            del self._queue[idx]
             # admission ends the queue wait; prefill compute is paid one
             # chunk per heartbeat from here (_prefill_tick)
-            r.queue_wait_s = time.perf_counter() - r._t_submit
+            r.queue_wait_s = time.perf_counter() - r._t_queued
             if self.registry is not None:
                 self.registry.observe("serving.queue_wait_s",
                                       r.queue_wait_s)
-            r.status = "prefilling"
+            r.status = RequestStatus.PREFILLING
             r._prefill_pos = 0
             if self.retain_prefixes:
                 self._consult_prefix_cache(r, slot)
@@ -361,24 +552,41 @@ class Scheduler:
             # keep filling THIS slot: a request that finishes right at
             # prefill (instant EOS / budget 1) leaves it free for the next
             while self._queue and self._running[slot] is None:
-                if not self._reserve_pages(slot, self._queue[0],
+                idx = self._eligible_index(time.perf_counter())
+                if idx is None:
+                    return          # everything queued is backing off
+                if not self._reserve_pages(slot, self._queue[idx],
                                            monolithic=True):
                     return          # pool exhausted: keep FIFO, retry later
-                r = self._queue.popleft()
-                r.queue_wait_s = time.perf_counter() - r._t_submit
+                r = self._queue[idx]
+                del self._queue[idx]
+                r.queue_wait_s = time.perf_counter() - r._t_queued
                 if self.registry is not None:
                     self.registry.observe("serving.queue_wait_s",
                                           r.queue_wait_s)
                 t0 = time.perf_counter()
-                token = self.engine.prefill(slot, list(r.prompt),
-                                            temperature=r.temperature)
-                r.prefill_s = time.perf_counter() - t0
-                r.chunks = 1
+                try:
+                    token = self.engine.prefill(
+                        slot, list(r.prompt), temperature=r.temperature)
+                except Exception as e:  # noqa: BLE001 — containment edge
+                    r.prefill_s += time.perf_counter() - t0
+                    self._count_transient()
+                    self._quarantine(r, slot,
+                                     f"{type(e).__name__}: {e}")
+                    continue
+                r.prefill_s += time.perf_counter() - t0
+                r.chunks += 1
+                if not self.engine.last_prefill_finite:
+                    # non-finite prompt logits: the sampled token is
+                    # garbage — quarantine instead of emitting it
+                    self._quarantine(r, slot,
+                                     "non-finite prefill logits")
+                    continue
                 r.ttft_s = time.perf_counter() - r._t_submit
                 if self.registry is not None:
                     self.registry.observe("serving.ttft_s", r.ttft_s)
                 r.output_tokens.append(token)
-                r.status = "running"
+                r.status = RequestStatus.RUNNING
                 if self.eos_id is not None and token == self.eos_id:
                     self._finish(r, "eos")
                 elif r.max_new_tokens <= 1:
@@ -398,10 +606,21 @@ class Scheduler:
                     # free the pages + leftover reservation now
                     self.engine.release_slot(slot)
 
-    def _prefill_tick(self) -> int:
+    def _count_transient(self) -> None:
+        if self.registry is not None:
+            self.registry.counter_inc("serving.faults.transient")
+
+    def _prefill_tick(self, tick: Optional[int] = None) -> int:
         """Run at most ``chunk_budget`` chunk-prefill steps across the
         prefilling slots, round-robin from a rotating start so no slot
-        starves. Returns the number of chunks run."""
+        starves. Returns the number of chunks run. Each engine call is
+        containment-wrapped: a transient failure (real or
+        plan-injected) or a non-finite sampled row quarantines ONLY the
+        slot's request — the other prefilling/decoding slots never see
+        it. ``tick`` is the heartbeat index faults are keyed by (the
+        same clock every injection site reads)."""
+        if tick is None:
+            tick = self._tick
         ran = 0
         slots = self.engine.slots
         start = self._pf_rr
@@ -416,9 +635,19 @@ class Scheduler:
             hi = min(lo + self.engine.chunk_len, len(r.prompt))
             final = hi == len(r.prompt)
             t0 = time.perf_counter()
-            token = self.engine.prefill_chunk(
-                slot, list(r.prompt[lo:hi]), lo, r.temperature,
-                final=final)
+            try:
+                if self.fault_plan is not None:
+                    self.fault_plan.maybe_raise("chunk", tick)
+                token = self.engine.prefill_chunk(
+                    slot, list(r.prompt[lo:hi]), lo, r.temperature,
+                    final=final)
+            except Exception as e:  # noqa: BLE001 — containment edge
+                r.prefill_s += time.perf_counter() - t0
+                ran += 1            # the heartbeat spent its budget here
+                self._pf_rr = (slot + 1) % slots
+                self._count_transient()
+                self._quarantine(r, slot, f"{type(e).__name__}: {e}")
+                continue
             r.prefill_s += time.perf_counter() - t0
             r._prefill_pos = hi
             r.chunks += 1
@@ -427,6 +656,15 @@ class Scheduler:
             # separated by gaps still ingest at the same rate (a +1
             # bump would serve the slot after a gap twice as often)
             self._pf_rr = (slot + 1) % slots
+            if not self.engine.last_chunk_finite:
+                # non-finite logits at the sampled row: the slot's K/V
+                # is suspect end-to-end — quarantine the request (the
+                # mid-prompt sampled token is discarded anyway; a final
+                # chunk's token would have been the request's first
+                # real output, which we must not emit from NaN logits)
+                self._quarantine(r, slot,
+                                 "non-finite chunk-prefill logits")
+                continue
             if not final:
                 continue
             if self.retain_prefixes:
@@ -444,7 +682,7 @@ class Scheduler:
                 # last prompt position's K/V and emit a corrupted token
                 self._finish(r, "max_len", slot)
             else:
-                r.status = "running"
+                r.status = RequestStatus.RUNNING
                 self._last_tokens[slot] = token
         return ran
 
@@ -475,22 +713,58 @@ class Scheduler:
                 self.registry.counter_inc("serving.prefix.registrations")
             elif outcome == "pool_full":
                 self.registry.counter_inc("serving.prefix.pool_full")
+        if self.auditor is not None and pcache.evictions != before:
+            # evictions release entry page refcounts: reconcile on the
+            # policy's sampling cadence
+            self.auditor.maybe_audit(self.engine)
 
     # ------------------------------------------------------------- stepping
     def step(self) -> bool:
-        """One scheduler beat: expire → admit → chunk prefill → decode.
-        Returns True if any forward progress was made (a decode step ran
-        or a prefill chunk was ingested)."""
+        """One scheduler beat: expire → admit → chunk prefill → decode,
+        every engine call containment-wrapped (see the module
+        docstring's fault-isolation contract), timed against the fault
+        policy's watchdog budget. Returns True if any forward progress
+        was made (a decode step ran or a prefill chunk was ingested)."""
+        t_tick = time.perf_counter()
+        tick = self._tick
+        self._tick += 1
+        if self.fault_plan is not None:
+            # injected heartbeat stall (the watchdog-breach probe)
+            self.fault_plan.maybe_stall(tick)
+        try:
+            return self._step_body(tick)
+        finally:
+            if self.fault_policy.watchdog_budget_s is not None:
+                elapsed = time.perf_counter() - t_tick
+                if elapsed > self.fault_policy.watchdog_budget_s:
+                    self._on_watchdog_breach(tick, elapsed)
+
+    def _on_watchdog_breach(self, tick: int, elapsed: float) -> None:
+        """A heartbeat blew its wall-clock budget: count the
+        ``serving.watchdog.stall`` event, record the breach duration,
+        and hand it to the policy's ``on_stall`` callback (alerting /
+        shedding is the caller's choice — the scheduler itself keeps
+        beating)."""
+        if self.registry is not None:
+            self.registry.counter_inc("serving.watchdog.stall")
+            self.registry.observe("serving.watchdog.stall_s", elapsed)
+        _logger.warning("heartbeat %d stalled: %.3fs against a %.3fs "
+                        "watchdog budget", tick, elapsed,
+                        self.fault_policy.watchdog_budget_s)
+        if self.fault_policy.on_stall is not None:
+            self.fault_policy.on_stall(elapsed)
+
+    def _step_body(self, tick: int) -> bool:
         self._expire(time.perf_counter())
         self._admit()
-        chunks = self._prefill_tick() if self.chunked else 0
+        chunks = self._prefill_tick(tick) if self.chunked else 0
         # the chunk budget bounds the stall imposed ON in-flight
         # decodes; while nothing is decoding there is nothing to stall,
         # so keep ingesting back-to-back (cold-start/queue-drain bursts
         # reach full slot occupancy without idle heartbeats)
         while chunks and not any(r is not None and r.status == "running"
                                  for r in self._running):
-            more = self._prefill_tick()
+            more = self._prefill_tick(tick)
             if not more:
                 break
             chunks += more
@@ -517,11 +791,53 @@ class Scheduler:
                                         float(ps["fragmentation"]))
         if not active.any():
             return chunks > 0
-        tokens = self.engine.decode_step(self._last_tokens, active,
-                                         self._temps)
+        bias = None
+        if self.fault_plan is not None:
+            bias = self.fault_plan.decode_bias(tick, self.engine.slots)
+        t0 = time.perf_counter()
+        try:
+            if self.fault_plan is not None:
+                self.fault_plan.maybe_raise("decode", tick)
+            tokens = self.engine.decode_step(self._last_tokens, active,
+                                             self._temps,
+                                             fault_bias=bias)
+        except Exception as e:  # noqa: BLE001 — containment edge
+            # a failed decode call produced no tokens (injected faults
+            # raise INSTEAD of the call; a real mid-call failure left
+            # the host token state unconsumed either way): quarantine
+            # the attributed victim when the exception names one, else
+            # every running request absorbs one retry — the engine
+            # survives and the next beat retries the survivors
+            self._count_transient()
+            victim = getattr(e, "slot", -1)
+            desc = f"{type(e).__name__}: {e}"
+            # honor the attribution only if the victim was actually in
+            # the decode batch; otherwise charge the decoding requests
+            # — prefilling slots were not in the failed call and keep
+            # their progress either way
+            if 0 <= victim < self.engine.slots \
+                    and self._running[victim] is not None \
+                    and self._running[victim].status == "running":
+                self._quarantine(self._running[victim], victim, desc)
+            else:
+                for slot, r in enumerate(self._running):
+                    if r is not None and r.status == "running":
+                        self._quarantine(r, slot, desc)
+            return True
+        dt = time.perf_counter() - t0
+        self._step_s_ema = dt if self._step_s_ema is None \
+            else 0.8 * self._step_s_ema + 0.2 * dt
+        finite = self.engine.last_decode_finite
         lengths = self.engine.lengths()
         for slot, r in enumerate(self._running):
             if r is None or r.status != "running":
+                continue
+            if not finite[slot]:
+                # the in-program guard flagged this slot's logits:
+                # its sampled token is garbage — quarantine the slot's
+                # request; batchmates' tokens are untouched (the guard
+                # and the bias are per-slot, the program is shared)
+                self._quarantine(r, slot, "non-finite decode logits")
                 continue
             token = int(tokens[slot])
             r.output_tokens.append(token)
@@ -542,6 +858,20 @@ class Scheduler:
         return len(self._queue) + sum(r is not None
                                       for r in self._running)
 
+    def _sleep_toward_backoff(self) -> None:
+        """When nothing occupies a slot and everything queued is inside
+        a retry-backoff window, sleep toward the earliest horizon
+        (capped at 50 ms per wait) instead of burning CPU — and the
+        caller's step budget — on no-op heartbeats."""
+        if any(r is not None for r in self._running):
+            return
+        now = time.perf_counter()
+        horizon = min((r._not_before for r in self._queue
+                       if r._not_before is not None
+                       and r._not_before > now), default=None)
+        if horizon is not None:
+            time.sleep(min(horizon - now, 0.05))
+
     # ---------------------------------------------------------------- runs
     def run(self, requests: Sequence[Request] = (),
             max_steps: int = 100000) -> List[Request]:
@@ -560,11 +890,14 @@ class Scheduler:
                 except QueueFull:
                     # a step admits queued work into slots (and decodes),
                     # freeing queue capacity — backpressure absorbed here
-                    if not self.step() and not self._queue:
-                        raise    # nothing active yet queue full: no drain
+                    if not self.step():
+                        if not self._queue:
+                            raise    # nothing active yet queue full
+                        self._sleep_toward_backoff()
         steps = 0
         while self.pending and steps < max_steps:
-            self.step()
+            if not self.step():
+                self._sleep_toward_backoff()
             steps += 1
         dt = time.perf_counter() - t0
         toks = self.engine.tokens_generated - tok0

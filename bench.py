@@ -30,8 +30,13 @@ drop it): a smoke-sized paged-vs-contiguous serving capacity
 measurement via ``bench_serving.paged_capacity_stats`` — tokens/s,
 max-concurrent-requests vs contiguous rows, and HBM-bytes-per-request
 reduction — so the serving stack finally has rows in the tracked
-BENCH_* trajectory (ROADMAP's "Recent" gap). Failure-isolated: a broken
-serving stack puts {"error": ...} there, never kills the ResNet row.
+BENCH_* trajectory (ROADMAP's "Recent" gap), plus a nested ``chaos``
+sub-object (BENCH_SERVING_CHAOS=0 to drop it): goodput under a seeded
+fault-injection schedule vs the fault-free rate, failed/requeued
+counts and ``token_mismatched_requests`` (expected 0) via
+``bench_serving.chaos_stats``. Failure-isolated at both layers: a
+broken serving stack puts {"error": ...} there, never kills the
+ResNet row.
 """
 
 from __future__ import annotations
@@ -130,6 +135,13 @@ _SERVING_SMOKE = {
     "PREFILL_LEN": 32, "REQUESTS": 12, "NEW_TOKENS": 8, "WINDOWS": 1,
 }
 
+# The chaos sub-leg's smoke geometry (it serves its stream TWICE —
+# rate 0 + injected — so it is sized below the capacity leg's)
+_SERVING_CHAOS_SMOKE = {
+    "SIZE": "tiny", "VOCAB": 512, "SLOTS": 4, "MAX_LEN": 128,
+    "PREFILL_LEN": 32, "REQUESTS": 6, "NEW_TOKENS": 8, "WINDOWS": 1,
+}
+
 
 def _serving_leg() -> dict:
     """The serving trajectory row (ROADMAP: bench_serving.py had no
@@ -144,13 +156,39 @@ def _serving_leg() -> dict:
 
         bench_serving._load_env(smoke=dict(_SERVING_SMOKE))
         _, summary = bench_serving.paged_capacity_stats()
-        return {k: summary[k] for k in (
+        out = {k: summary[k] for k in (
             "value", "unit", "baseline_tokens_per_s",
             "max_concurrent_requests", "contiguous_slots",
             "logical_concurrency_exceeds_rows",
             "hbm_bytes_per_request", "hbm_bytes_per_request_contiguous",
             "hbm_bytes_per_request_reduction_pct", "pool_mib",
             "token_mismatched_requests", "model")}
+        out["chaos"] = _serving_chaos_leg()
+        return out
+    except KeyboardInterrupt:
+        raise
+    except BaseException as e:  # noqa: BLE001 — the row must not die here
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def _serving_chaos_leg() -> dict:
+    """The fault-isolation trajectory sub-row: smoke-sized
+    goodput-under-injection summary (rate 0 vs BENCH_SERVING_FAULT_PCT)
+    from ``bench_serving.chaos_stats``. BENCH_SERVING_CHAOS=0 drops it;
+    failure-isolated like its parent — a broken fault layer yields
+    {"error": ...} here, never a lost serving (or ResNet) row."""
+    if _env_int("BENCH_SERVING_CHAOS", "1") == 0:
+        return {"skipped": True}
+    try:
+        import bench_serving
+
+        bench_serving._load_env(smoke=dict(_SERVING_CHAOS_SMOKE))
+        _, summary = bench_serving.chaos_stats()
+        return {k: summary[k] for k in (
+            "value", "unit", "goodput_rate0_tokens_per_s",
+            "goodput_retention_pct", "fault_pct", "clean_requests",
+            "failed_requests", "requeued_retries",
+            "token_mismatched_requests", "pages_in_use_at_drain")}
     except KeyboardInterrupt:
         raise
     except BaseException as e:  # noqa: BLE001 — the row must not die here

@@ -78,6 +78,22 @@ wider decode batch costs MORE per step on the CPU fallback (the
 reference decode attends every slot) — judge tokens/s on TPU rows; the
 capacity and bytes columns are the leg's claim.
 
+``--chaos`` runs the fault-isolation leg: the IDENTICAL greedy request
+stream served twice on one engine — fault rate 0, then
+``BENCH_SERVING_FAULT_PCT``% per-tick injection (seeded
+``FaultPlan.random``: non-finite logits at the rate, transient
+chunk/decode exceptions at half of it) under the standard containment
+policy (requeue ×2 then typed FAILED, auditor every event) — one row
+per mode plus a final line whose payoff fields are **goodput**
+(clean-request tokens/s), ``goodput_retention_pct`` vs the rate-0 row
+(the price of containment: requeued prefills re-run, failed requests
+waste partial compute), failed/requeued/injected counts,
+``pages_in_use_at_drain`` (the auditor ran and the pool drained), and
+``token_mismatched_requests`` — clean chaos-run requests vs the rate-0
+run, expected 0 **bitwise** on every backend (the containment
+guarantee, not a numerics regime claim). Defaults to a smoke geometry
+(8 requests × 12 tokens); the env knobs resize it.
+
 Wrapped in ``guard_bench_main`` — EVERY outcome (backend init failure,
 OOM, bad env) still ends in a parseable JSON line.
 """
@@ -95,6 +111,7 @@ METRIC = "serving_decode_tokens_per_sec"
 MIXED_METRIC = "serving_mixed_prompts_tokens_per_sec"
 SHARED_METRIC = "serving_shared_prefix_tokens_per_sec"
 PAGED_METRIC = "serving_paged_pool_tokens_per_sec"
+CHAOS_METRIC = "serving_chaos_goodput_tokens_per_sec"
 
 # Literal defaults at import time; the BENCH_SERVING_* env overrides are
 # parsed by _load_env() INSIDE each guarded main, so a malformed value
@@ -129,6 +146,12 @@ SHARED_SMOKE = {"REQUESTS": 8, "NEW_TOKENS": 16, "WINDOWS": 2}
 # waste is worst)
 PAGED_SLOTS = 0
 PAGED_PROMPT = 32
+# --chaos leg: per-tick injection percentage (non-finite at this rate,
+# transient exceptions at half of it) and its smoke preset — the leg
+# serves the SAME stream twice (rate 0, then FAULT_PCT), so halve the
+# geometry you would give one mode
+FAULT_PCT = 10
+CHAOS_SMOKE = {"REQUESTS": 8, "NEW_TOKENS": 12, "WINDOWS": 1}
 
 _ENV_KNOBS = {
     "VOCAB": "BENCH_SERVING_VOCAB", "SLOTS": "BENCH_SERVING_SLOTS",
@@ -144,6 +167,7 @@ _ENV_KNOBS = {
     "PREFIX_POOL": "BENCH_SERVING_PREFIX_POOL",
     "PAGED_SLOTS": "BENCH_SERVING_PAGED_SLOTS",
     "PAGED_PROMPT": "BENCH_SERVING_PAGED_PROMPT",
+    "FAULT_PCT": "BENCH_SERVING_FAULT_PCT",
 }
 
 
@@ -716,6 +740,131 @@ def main_paged():
     print(json.dumps(summary))
 
 
+def _chaos_requests():
+    """A deterministic greedy stream (mode-independent seed): identical
+    prompts/budgets served at fault rate 0 and at FAULT_PCT, so the
+    two modes' outputs compare request-for-request."""
+    from apex_tpu.serving import Request
+
+    rng = np.random.default_rng(5)
+    reqs = []
+    for _ in range(REQUESTS):
+        n = int(rng.integers(1, PREFILL_LEN + 1))
+        budget = max(1, min(NEW_TOKENS, MAX_LEN - n))
+        reqs.append(Request(
+            prompt=rng.integers(1, VOCAB, size=n).tolist(),
+            max_new_tokens=budget))
+    return reqs
+
+
+def _serve_chaos(engine, plan):
+    """One mode of the --chaos leg: serve the deterministic stream with
+    (or without) an injection plan under the standard containment
+    policy; returns (requests, wall seconds, scheduler)."""
+    from apex_tpu import serving
+
+    policy = serving.FaultPolicy(max_retries=2, backoff_base_s=0.0,
+                                 audit_every_n=1)
+    sched = serving.Scheduler(engine, max_queue=max(REQUESTS, 1),
+                              chunk_budget=CHUNK_BUDGET,
+                              fault_policy=policy, fault_plan=plan)
+    reqs = _chaos_requests()
+    t0 = time.perf_counter()
+    done = sched.run(reqs, max_steps=REQUESTS * (NEW_TOKENS + 64))
+    dt = time.perf_counter() - t0
+    assert len(done) == REQUESTS
+    return reqs, dt, sched
+
+
+def chaos_stats():
+    """The --chaos measurement, reusable by bench.py's serving
+    trajectory leg: the identical greedy stream at fault rate 0 vs
+    FAULT_PCT% per-tick injection (seeded, deterministic). Headline
+    fields: goodput (clean-request tokens/s — requests that never
+    faulted), failed/requeued/injected counts, and
+    token_mismatched_requests (clean chaos-run requests vs the rate-0
+    run; the containment guarantee says 0, bitwise). A discarded
+    warmup pass compiles the programs first, so the rate-0 goodput row
+    is not poisoned by trace latency."""
+    from apex_tpu import serving
+
+    engine = _build_engine()
+    _serve_chaos(engine, None)      # compile warmup, discarded
+    rows = {}
+    outputs = {}
+    # ticks upper bound for the plan: every request's decode budget
+    # plus generous prefill/requeue slack — the plan just needs to
+    # cover the run, extra scheduled ticks never fire
+    ticks = REQUESTS * (NEW_TOKENS + 64)
+    for mode in ("rate0", "chaos"):
+        engine.reset()
+        if mode == "rate0":
+            plan = None
+        else:
+            plan = serving.FaultPlan.random(
+                9, ticks, slots=SLOTS,
+                nonfinite_rate=FAULT_PCT / 100.0,
+                exception_rate=FAULT_PCT / 200.0)
+        reqs, dt, sched = _serve_chaos(engine, plan)
+        clean = [r for r in reqs if r.retries == 0
+                 and r.status == "finished"]
+        goodput = sum(len(r.output_tokens) for r in clean) / dt \
+            if dt > 0 else 0.0
+        audit = sched.auditor.audit(engine) if sched.auditor else {}
+        rows[mode] = {
+            "metric": f"{CHAOS_METRIC}.{mode}",
+            "value": round(goodput, 2),
+            "unit": "tokens/s",
+            "clean_requests": len(clean),
+            "failed_requests": sum(r.status == "failed" for r in reqs),
+            "requeued_retries": sum(r.retries for r in reqs),
+            "injected": plan.stats() if plan is not None else {},
+            "pages_in_use_at_drain": audit.get("pages_in_use", 0),
+            "compiled_programs": engine.compiled_programs,
+        }
+        outputs[mode] = {i: list(r.output_tokens)
+                         for i, r in enumerate(reqs)
+                         if r.retries == 0 and r.status == "finished"}
+    # a clean chaos-run request must match the rate-0 run bitwise —
+    # requests the plan faulted (retried or failed) are excluded, the
+    # containment guarantee is about everyone else
+    mismatches = sum(outputs["chaos"][i] != outputs["rate0"].get(i)
+                     for i in outputs["chaos"])
+    r0, rc = rows["rate0"], rows["chaos"]
+    summary = {
+        "metric": CHAOS_METRIC,
+        "value": rc["value"],
+        "unit": "tokens/s",
+        "goodput_rate0_tokens_per_s": r0["value"],
+        "goodput_retention_pct": round(
+            100.0 * rc["value"] / r0["value"], 1)
+        if r0["value"] else 0.0,
+        "fault_pct": FAULT_PCT,
+        "clean_requests": rc["clean_requests"],
+        "failed_requests": rc["failed_requests"],
+        "requeued_retries": rc["requeued_retries"],
+        "injected": rc["injected"],
+        "token_mismatched_requests": mismatches,
+        "token_exact_clean_vs_rate0": mismatches == 0,
+        "pages_in_use_at_drain": rc["pages_in_use_at_drain"],
+        "requests_per_window": REQUESTS,
+        "model": SIZE,
+    }
+    return rows, summary
+
+
+def main_chaos():
+    import jax
+
+    _load_env(smoke=CHAOS_SMOKE)
+
+    rows, summary = chaos_stats()
+    for mode in ("rate0", "chaos"):
+        print(json.dumps(rows[mode]))
+    summary["backend"] = jax.default_backend()
+    print(json.dumps(summary))
+
+
 if __name__ == "__main__":
     from apex_tpu.telemetry import guard_bench_main
 
@@ -725,5 +874,7 @@ if __name__ == "__main__":
         guard_bench_main(main_shared, SHARED_METRIC)
     elif "--paged-pool" in sys.argv[1:]:
         guard_bench_main(main_paged, PAGED_METRIC)
+    elif "--chaos" in sys.argv[1:]:
+        guard_bench_main(main_chaos, CHAOS_METRIC)
     else:
         guard_bench_main(main, METRIC)

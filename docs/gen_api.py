@@ -58,7 +58,8 @@ PAGES = {
     "serving": ["apex_tpu.serving", "apex_tpu.serving.kv_cache",
                 "apex_tpu.serving.engine",
                 "apex_tpu.serving.prefix_cache",
-                "apex_tpu.serving.scheduler"],
+                "apex_tpu.serving.scheduler",
+                "apex_tpu.serving.faults"],
     "contrib": [
         "apex_tpu.contrib.bottleneck", "apex_tpu.contrib.clip_grad",
         "apex_tpu.contrib.conv_bias_relu", "apex_tpu.contrib.cudnn_gbn",

@@ -374,7 +374,7 @@ def test_cow_shared_page_never_freed_while_referenced(engine_pair):
     assert ep.pool.refcount[shared] == 1
     while sched.pending:
         sched.step()
-    assert b.status == "done"
+    assert b.status == "finished"
     # last reader gone: page freed NOW (immediate reclamation)
     assert ep.pool.refcount[shared] == 0
     assert ep.pool_stats()["pages_in_use"] == 0
@@ -397,7 +397,7 @@ def test_pool_exhaustion_queues_admissions_and_degrades_gracefully(
                     max_new_tokens=56) for _ in range(3)]
     done = sched.run(reqs)
     assert len(done) == 3
-    assert all(r.status == "done" for r in reqs)
+    assert all(r.status == "finished" for r in reqs)
     snap = reg.snapshot()
     assert snap["counters"].get("serving.pool.admit_blocked", 0) > 0
     # the first request's retained prefix was evicted to make room
@@ -503,7 +503,7 @@ def test_logical_requests_outlive_physical_rows(lm_and_params):
     reqs = [Request(prompt=list(rng.integers(1, VOCAB, size=4)),
                     max_new_tokens=3) for _ in range(9)]
     done = sched.run(reqs)
-    assert len(done) == 9 and all(r.status == "done" for r in reqs)
+    assert len(done) == 9 and all(r.status == "finished" for r in reqs)
     # worst-case page use per request: 1 page (4+3 tokens < page 8),
     # but the reservation is chunk-padded — still far under a row
     assert eng.pool_stats()["pages_in_use"] == 0

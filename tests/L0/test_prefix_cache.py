@@ -349,10 +349,10 @@ def test_pool_full_with_live_pins_degrades_to_cold_path(pool1_engine):
     other = list(rng.integers(1, VOCAB, size=9))
     c = Request(prompt=other, max_new_tokens=2)
     sched.submit(c)
-    while c.status not in ("done", "timeout"):   # b (budget 50) outlives c
+    while c.status not in ("finished", "expired"):   # b (budget 50) outlives c
         sched.step()
     assert b.status == "running", "pin holder must still be live"
-    assert c.status == "done" and len(c.output_tokens) == 2
+    assert c.status == "finished" and len(c.output_tokens) == 2
     pc = eng.prefix_cache
     assert pc.pool_full - pool_full0 >= 1 and pc.evictions == evic0
     assert pc.match(pre + [3]) is not None, "pinned entry evicted"

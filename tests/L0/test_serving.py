@@ -423,7 +423,7 @@ def test_scheduler_timeout(fp32_engine):
     r = sched.submit(Request(prompt=[1, 2], max_new_tokens=500))
     time.sleep(0.01)
     sched.step()
-    assert r.status == "timeout" and r.finish_reason == "timeout"
+    assert r.status == "expired" and r.finish_reason == "timeout"
     assert sched.pending == 0
 
 
