@@ -60,6 +60,17 @@ class SelfAttention(nn.Module):
       returned aux is the UPDATED POOL pair (pages are shared across
       rows), not per-row caches; chunk writes must be page-aligned and
       whole-page (the engine enforces ``chunk_len % page_len == 0``).
+    - **unaligned append** (``unaligned_append=True``, paged ``S > 1``):
+      the speculative-verify write shape — a SMALL block of S draft
+      tokens landing at an arbitrary (non-page-aligned) cache offset
+      mid-generation, where the whole-page chunk write cannot apply.
+      Each of the S positions scatters individually by page id (the
+      decode write, unrolled over the static S), then the same
+      shifted-causal paged prefill attention runs. The pages written
+      are always the slot's own: generation positions sit past any
+      copy-on-write share, so unaligned writes can never touch a
+      shared page. Contiguous caches ignore the flag (their
+      ``dynamic_update_slice`` chunk write already takes any offset).
 
     ``inference_dtype`` is the decode path's storage/compute dtype: when
     set, Q/K/V leave the qkv GEMM in that dtype (normally the amp half —
@@ -76,7 +87,7 @@ class SelfAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool, cache=None, positions=None,
-                 return_kv: bool = False):
+                 return_kv: bool = False, unaligned_append: bool = False):
         # dtype=None → O1 engine: GEMMs are FP16_FUNCS 'linear'
         from apex_tpu.amp.autocast import resolve_dtype
         dense_dtype = resolve_dtype(self.dtype, "linear", jnp.float32)
@@ -142,7 +153,24 @@ class SelfAttention(nn.Module):
             else:
                 from apex_tpu.kernels.prefill_attention import (
                     prefill_attention, paged_prefill_attention)
-                if paged:
+                if paged and unaligned_append:
+                    # speculative verify: S is small (draft_len + 1)
+                    # and the offset is an arbitrary mid-generation
+                    # position — scatter each position individually
+                    # (the decode write, unrolled over the static S)
+                    for s in range(S):
+                        p = pos + s                             # [B]
+                        page_ids = jnp.take_along_axis(
+                            page_table, (p // page_len)[:, None],
+                            axis=1)[:, 0]
+                        off = p % page_len
+                        k_cache = k_cache.at[page_ids, :, off].set(
+                            jnp.asarray(k[:, :, s], k_cache.dtype))
+                        v_cache = v_cache.at[page_ids, :, off].set(
+                            jnp.asarray(v[:, :, s], v_cache.dtype))
+                    ctx = paged_prefill_attention(q, k_cache, v_cache,
+                                                  page_table, pos)
+                elif paged:
                     # chunk writes must cover whole pages: the serving
                     # engine pins chunk_len % page_len == 0 and page-
                     # aligned offsets, so the chunk's S positions are
@@ -213,7 +241,7 @@ class TransformerBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool, cache=None, positions=None,
-                 return_kv: bool = False):
+                 return_kv: bool = False, unaligned_append: bool = False):
         # FusedLayerNorm resolves 'layer_norm' (FP32) itself from the raw
         # self.dtype; the Dense sites resolve 'linear' (FP16) here
         from apex_tpu.amp.autocast import resolve_dtype
@@ -228,7 +256,9 @@ class TransformerBlock(nn.Module):
                                  self.inference_dtype,
                                  name="attn")(h, train=train, cache=cache,
                                               positions=positions,
-                                              return_kv=return_kv)
+                                              return_kv=return_kv,
+                                              unaligned_append=
+                                              unaligned_append)
         if cache is not None or return_kv:
             attn_out, aux = attn_out
         x = x + attn_out
@@ -278,6 +308,11 @@ class TransformerLM(nn.Module):
       + s``, K/V written to cache ``[positions[b], positions[b] + C)``,
       shifted-causal attention over the cached prefix (the engine's
       chunk-prefill program; one chunk per decode heartbeat).
+    - **speculative verify**: chunked prefill with
+      ``unaligned_append=True`` — a ``[B, K+1]`` draft block landing at
+      an arbitrary mid-generation offset; paged caches switch to
+      per-position scatters (see :class:`SelfAttention`), contiguous
+      caches are offset-agnostic already.
 
     ``inference_dtype`` (normally the amp half dtype) pins the
     eval-mode GEMM/cache dtype independently of the training policy, so
@@ -303,7 +338,7 @@ class TransformerLM(nn.Module):
     @nn.compact
     def __call__(self, tokens, *, train: bool = True,
                  features_only: bool = False, cache=None, positions=None,
-                 return_kv: bool = False):
+                 return_kv: bool = False, unaligned_append: bool = False):
         from apex_tpu.amp.autocast import resolve_dtype
         dense_dtype = resolve_dtype(self.dtype, "linear", jnp.float32)
         if self.inference_dtype is not None and not train:
@@ -342,7 +377,8 @@ class TransformerLM(nn.Module):
                 if len(cache) == 3:
                     layer_cache = layer_cache + (cache[2],)
                 x, (lk, lv) = block(x, train, cache=layer_cache,
-                                    positions=positions)
+                                    positions=positions,
+                                    unaligned_append=unaligned_append)
                 kv_out[0].append(lk)
                 kv_out[1].append(lv)
             elif return_kv:

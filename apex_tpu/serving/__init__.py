@@ -43,6 +43,17 @@ generation requests from a fixed set of compiled programs:
   ``serving.prefix.*`` / tokens-per-sec telemetry through the shared
   :class:`~apex_tpu.telemetry.MetricsRegistry`.
 
+- :class:`SpecConfig` / :func:`draft_tokens` (:mod:`.speculative`) —
+  speculative decoding fused into the heartbeat: a host-side
+  prompt-lookup / n-gram drafter proposes up to K next tokens per
+  greedy slot, ONE compiled ``[1, K+1]`` verify program
+  (:meth:`Engine.verify_step` — the chunk-append machinery at the
+  draft shape) scores them all in a single step, and in-program
+  accept-longest-prefix keeps greedy output bitwise identical to
+  plain decode while lifting tokens-per-step above 1
+  (``Scheduler(speculative=True)``; rejected-tail K/V never becomes
+  visible — rollback is a host/length decrement).
+
 - :class:`FaultPlan` / :class:`FaultPolicy` / :class:`PoolAuditor`
   (:mod:`.faults`) — fault isolation: a seeded deterministic
   chaos-injection harness (non-finite logits into chosen decode slots,
@@ -78,9 +89,10 @@ from .faults import (FaultPlan, FaultPolicy, FaultSpec, InjectedFault,
 from .kv_cache import KVCache, PagedKVCache, PagePool
 from .prefix_cache import PrefixCache, PrefixMatch
 from .scheduler import QueueFull, Request, RequestStatus, Scheduler
+from .speculative import SpecConfig, draft_tokens
 
 __all__ = ["Engine", "FaultPlan", "FaultPolicy", "FaultSpec",
            "InjectedFault", "KVCache", "PagedKVCache", "PagePool",
            "PoolAuditor", "PoolInvariantError", "PrefixCache",
            "PrefixMatch", "QueueFull", "Request", "RequestStatus",
-           "Scheduler", "sample_tokens"]
+           "Scheduler", "SpecConfig", "draft_tokens", "sample_tokens"]

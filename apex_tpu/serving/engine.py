@@ -71,6 +71,28 @@ real non-finite logits). Verdicts land in
 :attr:`Engine.last_prefill_finite` and count
 ``serving.faults.nonfinite``.
 
+**Speculative verify** (``spec=SpecConfig(...)``): one more compiled
+program — a ``[1, K+1]`` draft-and-verify step built on the chunk-append
+machinery. The host drafts K tokens (prompt-lookup n-gram — see
+:mod:`apex_tpu.serving.speculative`), the program embeds
+``[last_token, d_1 .. d_K]`` at the slot's current offset, writes their
+K/V (paged: per-position scatters — ``unaligned_append``; contiguous:
+the ordinary offset chunk write), runs shifted-causal attention, and
+computes ACCEPT-LONGEST-PREFIX *in-program*: greedy target ``g_s`` per
+row, ``n_accepted`` = the longest run with ``d_i == g_{i-1}``. The
+emitted tokens ``g_0 .. g_m`` are the program's own greedy targets, so
+greedy output is token-identical to plain decode by construction. The
+rejected tail's K/V is written but NEVER visible: lengths are what gate
+attention, and the contiguous program sets the slot length to
+``offset + n_accepted + 1`` itself (the paged host does the same to its
+host-side length) — rollback is a length decrement, no cache mutation
+to undo; the stale positions are overwritten write-then-attend before
+anything can attend them (the same contract inactive-slot decode writes
+already live by). One executable serves every draft/offset/slot
+(``verify_traces`` pins it); a fused isfinite guard + scalar
+``fault_bias`` operand give chaos the same grip it has on every other
+program (:attr:`Engine.last_verify_finite`).
+
 Weights are cast ONCE at construction through the amp cast-policy
 machinery (default: pure-half O3 — bf16 storage, no fp32 masters, the
 cache in the same dtype); pass ``policy=amp.resolve_policy("O0")`` for
@@ -117,6 +139,7 @@ from apex_tpu.log_util import get_logger
 
 from .kv_cache import KVCache, PagedKVCache, PagePool
 from .prefix_cache import PrefixCache
+from .speculative import SpecConfig
 
 __all__ = ["Engine", "resolve_page_len", "sample_tokens"]
 
@@ -223,6 +246,12 @@ class Engine:
         — the same HBM the contiguous layout would spend on full-length
         rows; size it down for denser sharing or up for more retained
         prefixes.
+    spec:
+        A :class:`~apex_tpu.serving.SpecConfig` enabling the
+        speculative-verify program (``draft_len`` fixes its ``[1, K+1]``
+        compiled shape). None (the default) compiles nothing extra and
+        leaves today's program set untouched; the program itself traces
+        lazily on the first :meth:`verify_step`.
     top_k:
         Static top-k truncation for sampled (non-greedy) slots; 0 = off.
     registry:
@@ -241,7 +270,8 @@ class Engine:
                  prefix_pool: int = 0, top_k: int = 0, seed: int = 0,
                  registry=None, paged: bool = True,
                  page_len: Optional[int] = None,
-                 num_pages: Optional[int] = None):
+                 num_pages: Optional[int] = None,
+                 spec: Optional[SpecConfig] = None):
         from apex_tpu.amp.policy import resolve_policy
 
         if policy is None:
@@ -285,6 +315,16 @@ class Engine:
                 f"ceil(prefill_len/chunk_len)*chunk_len <= max_len")
         if prefix_pool < 0:
             raise ValueError("prefix_pool must be >= 0")
+        if spec is not None:
+            if not isinstance(spec, SpecConfig):
+                raise TypeError(f"spec must be a SpecConfig, got "
+                                f"{type(spec).__name__}")
+            if spec.draft_len + 1 > max_len:
+                raise ValueError(
+                    f"spec.draft_len {spec.draft_len}: a verify step "
+                    f"writes draft_len + 1 = {spec.draft_len + 1} "
+                    f"positions, which cannot fit max_len={max_len}")
+        self.spec = spec
         self.slots = int(slots)
         self.max_len = int(max_len)
         self.prefill_len = int(prefill_len)
@@ -358,6 +398,7 @@ class Engine:
         self.decode_traces = 0
         self.chunk_traces = 0
         self.copy_traces = 0
+        self.verify_traces = 0
         self.tokens_generated = 0
         # the non-finite guard's host-side view, refreshed by every
         # sampling call: per-slot flags for the last decode step, one
@@ -368,6 +409,7 @@ class Engine:
         self.last_decode_finite = np.ones(self.slots, bool)
         self.last_chunk_finite = True
         self.last_prefill_finite = True
+        self.last_verify_finite = True
         self.nonfinite_events = 0
         # prefill flash-attention geometry: decode.* tuned keys beat the
         # training sweep's flash.* defaults when present
@@ -382,6 +424,8 @@ class Engine:
                                        donate_argnums=(1,))
             self._jit_chunk = jax.jit(self._paged_chunk_impl,
                                       donate_argnums=(1,))
+            self._jit_verify = jax.jit(self._paged_verify_impl,
+                                       donate_argnums=(1,))
             self._jit_copy = None      # retired: hits share pages
             _logger.info(
                 "serving engine (paged): %d slots x %d positions, "
@@ -399,6 +443,8 @@ class Engine:
                                        donate_argnums=(1,))
             self._jit_chunk = jax.jit(self._chunk_impl,
                                       donate_argnums=(1,))
+            self._jit_verify = jax.jit(self._verify_impl,
+                                       donate_argnums=(1,))
             self._jit_copy = jax.jit(self._copy_impl, donate_argnums=(0,))
             _logger.info(
                 "serving engine: %d slots x %d positions, prefill_len=%d,"
@@ -414,9 +460,11 @@ class Engine:
         discipline the serving tests pin: exactly three across a run
         that exercises chunk prefill, decode, and the monolithic
         baseline; exactly four once prefix reuse exercises the KV
-        row-copy too)."""
+        row-copy too — and one more, on either layout, once speculative
+        decoding exercises the verify program: 4 paged, 5 contiguous)."""
         return (self.chunk_traces + self.decode_traces
-                + self.prefill_traces + self.copy_traces)
+                + self.prefill_traces + self.copy_traces
+                + self.verify_traces)
 
     # ------------------------------------------------------ compiled bodies
     # Every sampling program also returns a per-slot FINITENESS flag —
@@ -486,6 +534,47 @@ class Engine:
         self.copy_traces += 1       # python body runs at trace time only
         return cache.copy_slot(src, dst, length)
 
+    @staticmethod
+    def _accept_longest_prefix(rows, tokens, n_drafted):
+        """In-program accept-longest-prefix over fp32 logit ``rows``
+        ``[K+1, V]`` for draft ``tokens`` ``[1, K+1]`` (row 0 is the
+        last committed token, rows 1..K the drafts; drafts past
+        ``n_drafted`` are padding and never accepted). Greedy only —
+        every emitted token IS the greedy target, which is the whole
+        bitwise-parity argument. Returns ``(greedy [K+1] int32,
+        n_accepted int32)``."""
+        K = tokens.shape[1] - 1
+        greedy = jnp.argmax(rows, axis=-1).astype(jnp.int32)  # [K+1]
+        match = (greedy[:K] == tokens[0, 1:]) \
+            & (jnp.arange(K, dtype=jnp.int32) < n_drafted)
+        n_accepted = jnp.sum(
+            jnp.cumprod(match.astype(jnp.int32))).astype(jnp.int32)
+        return greedy, n_accepted
+
+    def _verify_impl(self, params, cache, tokens, slot, n_drafted,
+                     fault_bias):
+        self.verify_traces += 1     # python body runs at trace time only
+        slot = jnp.asarray(slot, jnp.int32)
+        # the slot's committed length IS the verify offset on the
+        # contiguous layout (device state, exactly like decode)
+        offset = jax.lax.dynamic_index_in_dim(cache.lengths, slot,
+                                              keepdims=False)
+        k_slot, v_slot = cache.slot_view(slot)
+        logits, (k2, v2) = self._model.apply(
+            {"params": params}, tokens, train=False,
+            cache=(k_slot, v_slot), positions=offset[None])
+        rows = jnp.asarray(logits[0], jnp.float32) + fault_bias
+        finite = jnp.all(jnp.isfinite(rows))
+        greedy, n_accepted = self._accept_longest_prefix(rows, tokens,
+                                                         n_drafted)
+        # commit exactly the accepted extent: the rejected tail's K/V
+        # is written but sits past the length — unreachable (attention
+        # masks by length) and overwritten write-then-attend by the
+        # slot's next step. Rollback is this length arithmetic; there
+        # is no cache mutation to undo.
+        cache = cache.write_slot(slot, k2, v2, offset + n_accepted + 1)
+        return cache, greedy, n_accepted, finite
+
     # -------------------------------------------- compiled bodies (paged)
     def _paged_prefill_impl(self, params, cache, tokens, pt_row, length,
                             temperature, key):
@@ -554,6 +643,29 @@ class Engine:
         finite = jnp.all(jnp.isfinite(rows), axis=-1)         # [slots]
         tokens = sample_tokens(rows, temperature, key, self.top_k)
         return cache.replace(k=k2, v=v2), tokens, finite
+
+    def _paged_verify_impl(self, params, cache, tokens, pt_row, offset,
+                           n_drafted, fault_bias):
+        self.verify_traces += 1     # python body runs at trace time only
+        offset = jnp.asarray(offset, jnp.int32)
+        # unaligned_append: the [1, K+1] draft block lands at an
+        # arbitrary mid-generation offset — per-position page scatters
+        # instead of the whole-page chunk write (the host grew the
+        # slot's table to cover offset + K + 1 before this call)
+        logits, (k2, v2) = self._model.apply(
+            {"params": params}, tokens, train=False,
+            cache=(cache.k, cache.v, pt_row), positions=offset[None],
+            unaligned_append=True)
+        cache = cache.replace(k=k2, v=v2)
+        rows = jnp.asarray(logits[0], jnp.float32) + fault_bias
+        finite = jnp.all(jnp.isfinite(rows))
+        greedy, n_accepted = self._accept_longest_prefix(rows, tokens,
+                                                         n_drafted)
+        # lengths are host state on the paged layout: the rollback (the
+        # host-side length decrement) happens in verify_step after it
+        # reads n_accepted — the rejected tail's pages stay allocated
+        # to the slot, their K/V unreachable behind the length
+        return cache, greedy, n_accepted, finite
 
     # ------------------------------------------------------------- host API
     def _next_key(self):
@@ -999,6 +1111,95 @@ class Engine:
             self._registry.counter_inc("serving.tokens_generated",
                                        n_active)
         return out
+
+    def verify_step(self, slot: int, last_token: int,
+                    drafts: Sequence[int], offset: int, *,
+                    fault_bias: float = 0.0):
+        """One speculative draft-and-verify step for ``slot``: score
+        ``[last_token, d_1 .. d_K]`` in the compiled ``[1, K+1]`` verify
+        program at cache position ``offset`` (the slot's committed
+        length — the position ``last_token``'s K/V will be written at,
+        exactly where a plain decode step would write it) and return
+        ``(tokens, n_accepted)``: ``tokens`` [K+1] int32 are the
+        program's greedy targets, of which ``tokens[:n_accepted + 1]``
+        are this step's emitted output (the accepted drafts — equal to
+        their targets by the acceptance rule — plus the bonus/greedy
+        token at the first mismatch). Greedy-only: speculation verifies
+        against argmax, so the scheduler routes sampled requests
+        through plain decode.
+
+        Fewer than ``draft_len`` drafts are padded up to the fixed
+        program shape and excluded from acceptance (one executable for
+        every draft length — drafting never retraces). The caller must
+        leave room for the full padded window: ``offset + draft_len + 1
+        <= max_len`` (and, under scheduler admission, within the
+        request's reserved page budget — the scheduler's gate).
+
+        ``fault_bias`` is the chaos harness's scalar injection operand
+        (0.0 in production — value-identical; NaN/Inf makes the fused
+        in-program guard fire for real). The verdict lands in
+        :attr:`last_verify_finite`; a False verdict means every
+        returned token is garbage — quarantine, don't emit.
+        """
+        if self.spec is None:
+            raise RuntimeError(
+                "verify_step needs an engine built with "
+                "spec=SpecConfig(...) — the verify program's [1, K+1] "
+                "shape is fixed at construction")
+        K = self.spec.draft_len
+        n = len(drafts)
+        if not 1 <= n <= K:
+            raise ValueError(f"draft length {n} not in [1, "
+                             f"draft_len={K}] (an empty draft is the "
+                             "plain-decode fallback, not a verify)")
+        if not 0 <= slot < self.slots:
+            raise ValueError(f"slot {slot} not in [0, {self.slots})")
+        offset = int(offset)
+        if not 0 < offset or offset + K + 1 > self.max_len:
+            raise ValueError(
+                f"verify window [{offset}, {offset + K + 1}) needs a "
+                f"committed prefix and must fit max_len={self.max_len}")
+        tokens = np.zeros((1, K + 1), np.int32)
+        tokens[0, 0] = int(last_token)
+        tokens[0, 1:1 + n] = np.asarray(drafts, np.int32)
+        t0 = time.perf_counter()
+        if self.paged:
+            if offset != int(self._host_len[slot]):
+                raise ValueError(
+                    f"verify offset {offset} disagrees with slot "
+                    f"{slot}'s committed length "
+                    f"{int(self._host_len[slot])}")
+            # the write extent must be backed by pages BEFORE the
+            # program runs (reservation at admission guarantees the
+            # pool can cover it when the scheduler gated the call)
+            self._grow_slot(slot, self.pool.pages_for(offset + K + 1))
+            self.cache, out, n_accepted, finite = self._jit_verify(
+                self.params, self.cache, jnp.asarray(tokens),
+                jnp.asarray(self._page_table[slot:slot + 1]),
+                np.int32(offset), np.int32(n), np.float32(fault_bias))
+        else:
+            self.cache, out, n_accepted, finite = self._jit_verify(
+                self.params, self.cache, jnp.asarray(tokens),
+                np.int32(slot), np.int32(n), np.float32(fault_bias))
+        out = np.asarray(out)           # device sync: step latency
+        m = int(n_accepted)
+        if self.paged:
+            # rollback IS this assignment: the rejected tail's K/V sits
+            # at [offset + m + 1, offset + K + 1), past the committed
+            # length — unreachable, and overwritten write-then-attend
+            # by the slot's next decode/verify step
+            self._host_len[slot] = offset + m + 1
+        self.last_verify_finite = bool(finite)
+        if not self.last_verify_finite:
+            self._count_nonfinite(1)
+        emitted = m + 1
+        self.tokens_generated += emitted
+        if self._registry is not None:
+            self._registry.observe("serving.spec.verify_s",
+                                   time.perf_counter() - t0)
+            self._registry.counter_inc("serving.tokens_generated",
+                                       emitted)
+        return out, m
 
     def _count_nonfinite(self, n: int) -> None:
         """One quarantine-worthy non-finite sampling event per affected

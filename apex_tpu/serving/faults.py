@@ -67,8 +67,10 @@ __all__ = ["FaultSpec", "FaultPlan", "FaultPolicy", "InjectedFault",
 
 _logger = get_logger("serving")
 
-# injection sites a FaultSpec(kind="exception") may name
-_EXCEPTION_SITES = ("chunk", "decode")
+# injection sites a FaultSpec(kind="exception") may name ("verify" is
+# the speculative draft-and-verify call; it only fires on schedulers
+# running speculative=True — see FaultPlan.random's ``sites``)
+_EXCEPTION_SITES = ("chunk", "decode", "verify")
 
 
 class InjectedFault(RuntimeError):
@@ -96,8 +98,8 @@ class FaultSpec:
       ``fault_bias`` operand. The engine's in-program guard must flag
       the slot; every other slot's logits gain exactly ``+0.0``.
     - ``"exception"`` — raise :class:`InjectedFault` at heartbeat
-      ``tick`` from injection site ``site`` (``"chunk"`` /
-      ``"decode"``), instead of running the compiled call.
+      ``tick`` from injection site ``site`` (``"chunk"`` / ``"decode"``
+      / ``"verify"``), instead of running the compiled call.
     - ``"stall"`` — sleep ``stall_s`` seconds at heartbeat ``tick``
       (the watchdog-budget breach the plan manufactures).
     """
@@ -149,12 +151,20 @@ class FaultPlan:
     @classmethod
     def random(cls, seed: int, ticks: int, *, slots: int,
                nonfinite_rate: float = 0.0, exception_rate: float = 0.0,
-               stall_rate: float = 0.0,
-               stall_s: float = 0.05) -> "FaultPlan":
+               stall_rate: float = 0.0, stall_s: float = 0.05,
+               sites: Sequence[str] = ("chunk", "decode")) -> "FaultPlan":
         """A seeded random schedule over ``ticks`` heartbeats: each
         tick independently draws a non-finite injection (uniform victim
-        slot), a transient exception (uniform site), and/or a stall at
-        the given per-tick rates. Same seed → same schedule, always."""
+        slot), a transient exception (site uniform over ``sites``),
+        and/or a stall at the given per-tick rates. Same seed → same
+        schedule, always. ``sites`` defaults to the two call sites every
+        scheduler has — include ``"verify"`` only for speculative runs
+        (a verify-site fault on a non-speculative scheduler never
+        fires)."""
+        for s in sites:
+            if s not in _EXCEPTION_SITES:
+                raise ValueError(f"exception site {s!r} not in "
+                                 f"{_EXCEPTION_SITES}")
         rng = np.random.default_rng(seed)
         specs: List[FaultSpec] = []
         for t in range(int(ticks)):
@@ -165,7 +175,7 @@ class FaultPlan:
             if rng.random() < exception_rate:
                 specs.append(FaultSpec(
                     kind="exception", tick=t,
-                    site=_EXCEPTION_SITES[int(rng.integers(0, 2))]))
+                    site=sites[int(rng.integers(0, len(sites)))]))
             if rng.random() < stall_rate:
                 specs.append(FaultSpec(kind="stall", tick=t,
                                        stall_s=stall_s))
@@ -192,6 +202,25 @@ class FaultPlan:
             return None
         self.injected_nonfinite += 1
         return bias
+
+    def take_nonfinite(self, tick: int, slot: int) -> Optional[float]:
+        """CONSUME the non-finite injection scheduled for ``slot`` at
+        this heartbeat, if any, returning its value (the verify call's
+        scalar ``fault_bias``) — or None. The speculative scheduler
+        calls this for each slot it verifies BEFORE building the decode
+        batch's :meth:`decode_bias`, so a victim slot that takes the
+        verify path this tick still gets its scheduled injection
+        (through the verify program's guard instead of the decode
+        program's) and is never double-injected."""
+        specs = self._nonfinite.get(int(tick))
+        if not specs:
+            return None
+        for i, s in enumerate(specs):
+            if s.slot == int(slot):
+                specs.pop(i)
+                self.injected_nonfinite += 1
+                return float(s.value)
+        return None
 
     def maybe_raise(self, site: str, tick: int) -> None:
         """Raise the :class:`InjectedFault` scheduled for ``site`` at
@@ -264,9 +293,12 @@ class FaultPolicy:
     - ``watchdog_budget_s``: wall-clock budget per scheduler heartbeat;
       a breach emits ``serving.watchdog.stall`` (+ the breach duration
       into the ``serving.watchdog.stall_s`` histogram) and invokes
-      ``on_stall(elapsed_s)``. ``None`` disables the watchdog. Note the
-      first heartbeat traces compiled programs — budget accordingly (or
-      warm the engine first).
+      ``on_stall(elapsed_s)``. ``None`` disables the watchdog.
+      Heartbeats that TRACE a compiled program (first contact with
+      chunk/decode/prefill/verify) are exempt — their wall time is
+      one-off compile latency, observed separately as
+      ``serving.watchdog.warmup_s`` — so tiny budgets no longer
+      false-trip on tick 0 of a cold engine.
     - ``audit_every_n``: run the :class:`PoolAuditor` every N
       finish/eviction events (1 = every event — the test setting; the
       default samples). ``0`` disables auditing.

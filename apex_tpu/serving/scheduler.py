@@ -37,9 +37,19 @@ beats:
    on IN-FLIGHT decodes — while nothing is decoding there is nothing
    to stall, so a cold queue bursts chunk-after-chunk (stopping the
    moment a slot flips to decoding) instead of idling between beats;
-4. **decode** — one fixed-shape engine step over all slots; each
-   decoding slot appends its token and finishes on EOS /
-   ``max_new_tokens`` / cache ``max_len``.
+4. **draft** (``speculative=True``) — for each greedy decoding slot, a
+   host-side prompt-lookup drafter (:mod:`~apex_tpu.serving
+   .speculative`) proposes up to ``K`` next tokens from n-gram matches
+   over ``prompt + generated``;
+5. **verify-or-decode** — slots with a non-empty draft take one
+   compiled ``[1, K+1]`` verify step (:meth:`Engine.verify_step`:
+   accept-longest-prefix in-program, up to ``K + 1`` tokens emitted
+   per step, greedy output bitwise identical to plain decode);
+   everything else — empty drafts, sampled requests, requests within
+   ``K`` tokens of their budget — falls back to the ordinary
+   fixed-shape decode step over the remaining slots.
+   ``speculative=False`` (the default) skips the draft phase entirely
+   and keeps today's path as the measurable baseline.
 
 Step 3 is the head-of-line fix (Orca-style continuous batching +
 Sarathi-style chunked prefill): the monolithic alternative — pause the
@@ -105,6 +115,14 @@ completion record per request (with ``chunks_per_prompt`` and
 ``.misses`` / ``.hit_rate`` (gauge), ``serving.prefix.tokens_reused``,
 ``serving.prefix.chunks_skipped``, ``serving.prefix.evictions``,
 ``serving.prefix.registrations`` and ``serving.prefix.pool_full``.
+Speculative runs add ``serving.spec.drafted`` / ``serving.spec
+.accepted`` counters, the per-verify ``serving.spec.acceptance_rate``
+histogram, the per-heartbeat ``serving.spec.tokens_per_step`` gauge
+(tokens emitted per SLOT sequence-step — plain decode pins 1.0, the
+>1 reading is the whole point), and per-request ``spec_accepted`` in
+the completion record. The heartbeat watchdog separately accounts ticks that traced a
+new compiled program as ``serving.watchdog.warmup_s`` instead of
+breaching (first-contact compile time is not a stall).
 """
 
 from __future__ import annotations
@@ -121,6 +139,7 @@ import numpy as np
 from apex_tpu.log_util import get_logger
 
 from .faults import FaultPolicy, PoolAuditor
+from .speculative import draft_tokens
 
 __all__ = ["Request", "RequestStatus", "QueueFull", "Scheduler"]
 
@@ -186,7 +205,10 @@ class Request:
     :class:`RequestStatus`: terminally ``FINISHED`` / ``EXPIRED`` /
     ``FAILED``; transiently ``QUEUED`` / ``PREFILLING`` / ``RUNNING``),
     ``finish_reason`` (``"eos"`` / ``"max_new_tokens"`` / ``"max_len"``
-    / ``"timeout"`` / ``"fault"``), ``ttft_s`` and its decomposition
+    / ``"timeout"`` / ``"fault"``), ``spec_drafted`` / ``spec_accepted``
+    (speculative tokens proposed / accepted for this request —
+    cumulative across retries, like the other paid-compute counters;
+    0 on non-speculative runs), ``ttft_s`` and its decomposition
     ``queue_wait_s`` (submit → admission) + ``prefill_s`` (summed
     chunk/prefill compute — cumulative across retries: it is compute
     actually paid), ``chunks`` (prefill steps paid, cumulative across
@@ -212,6 +234,8 @@ class Request:
     prefill_s: float = 0.0
     chunks: int = 0
     reused_tokens: int = 0
+    spec_drafted: int = 0
+    spec_accepted: int = 0
     latency_s: Optional[float] = None
     retries: int = 0
     error: Optional[str] = None
@@ -237,6 +261,7 @@ class Scheduler:
                  eos_id: Optional[int] = None, registry=None,
                  chunked: bool = True, chunk_budget: int = 1,
                  retain_prefixes: bool = False,
+                 speculative: bool = False,
                  fault_policy: Optional[FaultPolicy] = None,
                  fault_plan=None,
                  auditor: Optional[PoolAuditor] = None):
@@ -244,6 +269,11 @@ class Scheduler:
             raise ValueError("max_queue must be >= 1")
         if chunk_budget < 1:
             raise ValueError("chunk_budget must be >= 1")
+        if speculative and getattr(engine, "spec", None) is None:
+            raise ValueError(
+                "speculative=True requires an engine built with "
+                "spec=SpecConfig(...) — the verify program's shape is "
+                "fixed at engine construction")
         if retain_prefixes:
             if not chunked:
                 raise ValueError(
@@ -261,8 +291,27 @@ class Scheduler:
         self.chunked = bool(chunked)
         self.chunk_budget = int(chunk_budget)
         self.retain_prefixes = bool(retain_prefixes)
+        self.speculative = bool(speculative)
         self.registry = registry if registry is not None \
             else getattr(engine, "_registry", None)
+        # registry wiring: several engine-side metrics (the guard's
+        # serving.faults.nonfinite above all) are emitted by the
+        # ENGINE's registry — a scheduler-only registry would silently
+        # miss them, so propagate ours to a registry-less engine; when
+        # BOTH are set and differ, keep them (the split may be
+        # deliberate) but say so loudly
+        eng_reg = getattr(engine, "_registry", None)
+        if self.registry is not None and hasattr(engine, "set_registry"):
+            if eng_reg is None:
+                engine.set_registry(self.registry)
+            elif eng_reg is not self.registry:
+                _logger.warning(
+                    "scheduler and engine carry DIFFERENT telemetry "
+                    "registries: engine-side metrics (e.g. "
+                    "serving.faults.nonfinite, serving.prefill.*) land "
+                    "in the engine's, scheduler-side in the "
+                    "scheduler's — pass one registry to both unless "
+                    "the split is deliberate")
         self._queue: collections.deque = collections.deque()
         self._running: List[Optional[Request]] = [None] * engine.slots
         self._last_tokens = np.zeros(engine.slots, np.int32)
@@ -385,6 +434,8 @@ class Scheduler:
                 "output_tokens": len(request.output_tokens),
                 "chunks_per_prompt": request.chunks,
                 "reused_tokens": request.reused_tokens,
+                "spec_drafted": request.spec_drafted,
+                "spec_accepted": request.spec_accepted,
                 "retries": request.retries,
                 "error": request.error,
                 "queue_wait_s": request.queue_wait_s,
@@ -718,6 +769,110 @@ class Scheduler:
             # policy's sampling cadence
             self.auditor.maybe_audit(self.engine)
 
+    # ---------------------------------------------------------- speculative
+    def _spec_tick(self, tick: int):
+        """The draft → verify half of a speculative heartbeat: for each
+        greedy decoding slot, prompt-lookup a draft over ``prompt +
+        generated`` and — when non-empty and within budget — run one
+        compiled verify step, emitting the accepted prefix plus the
+        bonus token. Returns ``(verified_slots, calls, emitted)``:
+        slots that took a verify step this tick (excluded from the
+        decode batch — they already advanced), verify calls run, and
+        tokens emitted. Containment-wrapped exactly like chunk/decode:
+        a transient failure or non-finite verdict quarantines only the
+        victim. Slots that draft nothing, sampled requests, and
+        requests within ``draft_len`` tokens of their budget (the
+        padded verify window must stay inside the admission page
+        reservation and ``max_len``) fall through to plain decode."""
+        eng = self.engine
+        cfg = eng.spec
+        verified: set = set()
+        calls = emitted = 0
+        for slot, r in enumerate(self._running):
+            if r is None or r.status != "running":
+                continue
+            if r.temperature != 0.0:
+                continue    # acceptance verifies against argmax only
+            owed = r.max_new_tokens - len(r.output_tokens)
+            # the slot's committed length: everything but the pending
+            # last token (which the verify step writes, like decode)
+            offset = len(r.prompt) + len(r.output_tokens) - 1
+            # endgame gate: require draft_len < owed, so a fully
+            # accepted verify's n_accepted + 1 <= K + 1 <= owed tokens
+            # ALL emit — emission never truncates, which keeps the
+            # engine's tokens_generated, the bench's per-slot-step
+            # arithmetic, and the padded window's page reservation all
+            # exact. The last <= K tokens take plain decode.
+            if cfg.draft_len >= owed \
+                    or offset + cfg.draft_len + 1 > eng.max_len:
+                continue
+            draft = draft_tokens(list(r.prompt) + r.output_tokens, cfg)
+            if not draft:
+                continue    # nothing to verify: plain-decode fallback
+            try:
+                if self.fault_plan is not None:
+                    # the exception site raises INSTEAD of the call, so
+                    # it must fire before the nonfinite spec is
+                    # consumed — a co-scheduled nonfinite stays live
+                    # for the retry instead of being counted as
+                    # delivered to a call that never ran
+                    self.fault_plan.maybe_raise("verify", tick)
+                bias = 0.0
+                if self.fault_plan is not None:
+                    taken = self.fault_plan.take_nonfinite(tick, slot)
+                    if taken is not None:
+                        bias = taken
+                toks, m = eng.verify_step(
+                    slot, int(self._last_tokens[slot]), draft, offset,
+                    fault_bias=bias)
+            except Exception as e:  # noqa: BLE001 — containment edge
+                self._count_transient()
+                self._quarantine(r, slot, f"{type(e).__name__}: {e}")
+                continue
+            calls += 1
+            if not eng.last_verify_finite:
+                # the in-program guard flagged the verify logits: every
+                # returned token is garbage — quarantine the request
+                # (slot, pages, reservation freed); batchmates and the
+                # decode batch never see it. Acceptance stats are NOT
+                # recorded: n_accepted was argmaxed over NaN/Inf rows
+                # and would pollute the acceptance histograms the
+                # bench's p50/p99 read
+                self._quarantine(r, slot, "non-finite verify logits")
+                continue
+            r.spec_drafted += len(draft)
+            r.spec_accepted += m
+            if self.registry is not None:
+                self.registry.counter_inc("serving.spec.drafted",
+                                          len(draft))
+                self.registry.counter_inc("serving.spec.accepted", m)
+                self.registry.observe("serving.spec.acceptance_rate",
+                                      m / len(draft))
+            verified.add(slot)
+            # emit the accepted prefix + bonus token through the SAME
+            # per-token finish checks plain decode applies (EOS first,
+            # then budget, then cache exhaustion) — the emitted stream
+            # is the greedy stream, discovered several tokens per step
+            # (m + 1 <= owed by the endgame gate: nothing truncates)
+            for i in range(m + 1):
+                tok = int(toks[i])
+                r.output_tokens.append(tok)
+                self._last_tokens[slot] = tok
+                emitted += 1
+                if self.eos_id is not None and tok == self.eos_id:
+                    self._finish(r, "eos", slot)
+                    break
+                if len(r.output_tokens) >= r.max_new_tokens:
+                    self._finish(r, "max_new_tokens", slot)
+                    break
+                if offset + i + 2 > eng.max_len:
+                    # the cache position this token's successor would
+                    # write at is past max_len — same check, same
+                    # reason string as the decode loop
+                    self._finish(r, "max_len", slot)
+                    break
+        return verified, calls, emitted
+
     # ------------------------------------------------------------- stepping
     def step(self) -> bool:
         """One scheduler beat: expire → admit → chunk prefill → decode,
@@ -731,12 +886,24 @@ class Scheduler:
         if self.fault_plan is not None:
             # injected heartbeat stall (the watchdog-breach probe)
             self.fault_plan.maybe_stall(tick)
+        compiled0 = getattr(self.engine, "compiled_programs", 0)
         try:
             return self._step_body(tick)
         finally:
             if self.fault_policy.watchdog_budget_s is not None:
                 elapsed = time.perf_counter() - t_tick
-                if elapsed > self.fault_policy.watchdog_budget_s:
+                if getattr(self.engine, "compiled_programs", 0) \
+                        > compiled0:
+                    # warm-start exemption: this heartbeat TRACED a
+                    # compiled program, so its wall time is dominated
+                    # by one-off compile latency, not a stall — tiny
+                    # watchdog budgets must not false-trip on first
+                    # contact. Accounted separately so the compile
+                    # cost stays visible instead of vanishing.
+                    if self.registry is not None:
+                        self.registry.observe(
+                            "serving.watchdog.warmup_s", elapsed)
+                elif elapsed > self.fault_policy.watchdog_budget_s:
                     self._on_watchdog_breach(tick, elapsed)
 
     def _on_watchdog_breach(self, tick: int, elapsed: float) -> None:
@@ -768,8 +935,16 @@ class Scheduler:
             if not more:
                 break
             chunks += more
+        spec_slots: set = set()
+        spec_calls = spec_emitted = 0
+        if self.speculative:
+            # draft → verify-or-decode: verified slots already advanced
+            # (possibly by several tokens) and sit out this tick's
+            # decode batch; empty drafts fall through to plain decode
+            spec_slots, spec_calls, spec_emitted = self._spec_tick(tick)
         active = np.array([r is not None and r.status == "running"
-                           for r in self._running])
+                           and slot not in spec_slots
+                           for slot, r in enumerate(self._running)])
         if self.registry is not None:
             occ = float(active.mean())
             self.registry.gauge_set("serving.slot_occupancy", occ)
@@ -790,7 +965,8 @@ class Scheduler:
                 self.registry.gauge_set("serving.pool.fragmentation",
                                         float(ps["fragmentation"]))
         if not active.any():
-            return chunks > 0
+            self._set_spec_gauge(spec_calls, spec_emitted, 0, 0)
+            return chunks > 0 or spec_calls > 0
         bias = None
         if self.fault_plan is not None:
             bias = self.fault_plan.decode_bias(tick, self.engine.slots)
@@ -813,15 +989,18 @@ class Scheduler:
             desc = f"{type(e).__name__}: {e}"
             # honor the attribution only if the victim was actually in
             # the decode batch; otherwise charge the decoding requests
-            # — prefilling slots were not in the failed call and keep
+            # — prefilling slots (and slots that already took a verify
+            # step this tick) were not in the failed call and keep
             # their progress either way
             if 0 <= victim < self.engine.slots \
+                    and victim not in spec_slots \
                     and self._running[victim] is not None \
                     and self._running[victim].status == "running":
                 self._quarantine(self._running[victim], victim, desc)
             else:
                 for slot, r in enumerate(self._running):
-                    if r is not None and r.status == "running":
+                    if r is not None and r.status == "running" \
+                            and slot not in spec_slots:
                         self._quarantine(r, slot, desc)
             return True
         dt = time.perf_counter() - t0
@@ -829,8 +1008,9 @@ class Scheduler:
             else 0.8 * self._step_s_ema + 0.2 * dt
         finite = self.engine.last_decode_finite
         lengths = self.engine.lengths()
+        decode_emitted = 0
         for slot, r in enumerate(self._running):
-            if r is None or r.status != "running":
+            if r is None or r.status != "running" or slot in spec_slots:
                 continue
             if not finite[slot]:
                 # the in-program guard flagged this slot's logits:
@@ -842,6 +1022,7 @@ class Scheduler:
             token = int(tokens[slot])
             r.output_tokens.append(token)
             self._last_tokens[slot] = token
+            decode_emitted += 1
             if self.eos_id is not None and token == self.eos_id:
                 self._finish(r, "eos", slot)
             elif len(r.output_tokens) >= r.max_new_tokens:
@@ -850,7 +1031,25 @@ class Scheduler:
                 # cache exhausted: the NEXT token would have nowhere to
                 # attend from
                 self._finish(r, "max_len", slot)
+        self._set_spec_gauge(spec_calls, spec_emitted, 1, decode_emitted)
         return True
+
+    def _set_spec_gauge(self, spec_calls: int, spec_emitted: int,
+                        decode_steps: int, decode_emitted: int) -> None:
+        """The headline speculative gauge: tokens emitted this
+        heartbeat per SLOT sequence-step run — a decode step advances
+        each participating slot by exactly one (so plain decode pins
+        the gauge at 1.0), a verify call is one slot-step that emits
+        ``n_accepted + 1``; acceptance is the only thing that pushes
+        the reading above 1. Only emitted on speculative runs."""
+        del decode_steps            # a slot-step count, not a dispatch count
+        if not self.speculative or self.registry is None:
+            return
+        steps = spec_calls + decode_emitted
+        if steps:
+            self.registry.gauge_set(
+                "serving.spec.tokens_per_step",
+                (spec_emitted + decode_emitted) / steps)
 
     @property
     def pending(self) -> int:

@@ -1,0 +1,134 @@
+"""Speculative decoding: host-side n-gram drafting for draft-and-verify.
+
+Decode is one token per step per slot — the wall-clock floor of every
+serving bench. Draft-and-verify lifts tokens-per-step above 1 without a
+second model: a cheap DRAFTER guesses the next ``K`` tokens, ONE
+compiled verify program (:meth:`~apex_tpu.serving.Engine.verify_step`,
+the chunk-append machinery at shape ``[1, K+1]``) scores all of them in
+a single step, and accept-longest-prefix keeps greedy output bitwise
+identical to plain decode: every emitted token is the verify program's
+own greedy target, and a draft token is accepted only when it EQUALS
+the greedy target at its position — so the emitted stream is exactly
+the token-by-token greedy stream, just discovered up to ``K+1`` tokens
+per step instead of one.
+
+This module is the drafter half, all host-side numpy/python (no device
+work, no compiled programs — drafting can never retrace anything):
+
+- :class:`SpecConfig` — the engine-level knobs: ``draft_len`` (K, the
+  verify program's static draft width) and ``ngram`` (the longest
+  suffix n-gram the lookup tries to match).
+- :func:`draft_tokens` — prompt-lookup / n-gram drafting (PLD): find
+  the most recent earlier occurrence of the sequence's trailing
+  n-gram inside ``prompt + generated`` and propose the tokens that
+  followed it. Shared-prefix templates, multi-turn histories and
+  repetitive generations — exactly the workloads the prefix cache
+  serves — are full of such matches; free-running text simply drafts
+  nothing and the scheduler falls back to the plain decode program.
+
+An EMPTY draft costs nothing: the slot takes this heartbeat's ordinary
+decode step. A wrong draft costs one verify step that still emits at
+least one correct token (the bonus/greedy token at the first
+mismatch), so speculation never emits fewer tokens per program call
+than plain decode — the only regression risk is the verify step's
+extra FLOPs, which is why ``Scheduler(speculative=False)`` keeps
+today's path as the measurable baseline.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["SpecConfig", "draft_tokens"]
+
+
+@dataclasses.dataclass(frozen=True)
+class SpecConfig:
+    """Speculative-decoding knobs (engine-level: ``draft_len`` fixes
+    the verify program's compiled shape).
+
+    - ``draft_len`` (K): draft tokens per verify step. The verify
+      program is ``[1, K+1]`` — bigger K amortises more dispatches per
+      accepted run but wastes more compute when acceptance is low.
+      On silicon, K+1 a multiple of 8 keeps the verify attention on
+      its Pallas path (smaller shapes fall back to the exact jnp
+      reference — same tokens, more FLOPs).
+    - ``ngram``: longest trailing n-gram the prompt-lookup tries to
+      match (it degrades toward ``min_ngram`` before giving up).
+    - ``min_ngram``: shortest match worth drafting from (1 = a single
+      repeated token already drafts; raise it to cut spurious drafts
+      on near-random text).
+    """
+
+    draft_len: int = 4
+    ngram: int = 3
+    min_ngram: int = 1
+
+    def __post_init__(self):
+        if self.draft_len < 1:
+            raise ValueError("draft_len must be >= 1")
+        if self.ngram < 1:
+            raise ValueError("ngram must be >= 1")
+        if not 1 <= self.min_ngram <= self.ngram:
+            raise ValueError(
+                f"min_ngram {self.min_ngram} must be in [1, "
+                f"ngram={self.ngram}]")
+
+
+def _rfind(data: bytes, pattern: bytes, last_start: int) -> int:
+    """TOKEN index of the last occurrence of ``pattern`` in the
+    4-byte-per-token encoding ``data`` starting at token index
+    ``<= last_start``; -1 when absent (or ``last_start`` < 0). One
+    C-speed ``bytes.rfind`` per try, with a backward re-search loop for
+    the rare byte-misaligned hit (a real match starts on a token
+    boundary) — the heartbeat calls this for every greedy slot every
+    tick, so the common no-match case must not cost Python-loop time."""
+    if last_start < 0:
+        return -1
+    pos = data.rfind(pattern, 0, last_start * 4 + len(pattern))
+    while pos >= 0 and pos % 4:
+        pos = data.rfind(pattern, 0, pos + len(pattern) - 1)
+    return pos // 4 if pos >= 0 else -1
+
+
+def draft_tokens(tokens: Sequence[int], config: SpecConfig,
+                 max_draft: Optional[int] = None) -> List[int]:
+    """Prompt-lookup draft for the NEXT positions of ``tokens``
+    (``prompt + generated so far``, including the pending token that is
+    not yet in the KV cache).
+
+    Tries the trailing n-gram at ``config.ngram`` down to
+    ``config.min_ngram``; the first size with an earlier occurrence
+    wins. Among occurrences, the most recent one with a FULL
+    ``draft_len`` follower window is preferred — on periodic text the
+    newest match always ends right next to the sequence end and would
+    truncate every draft to the period length — falling back to the
+    most recent occurrence with at least one follower. The followers —
+    up to ``min(config.draft_len, max_draft)`` — are the draft (they
+    may overlap the suffix itself, which is how repetition drafts its
+    own loop). Returns ``[]`` when nothing matches (the scheduler's
+    plain-decode fallback) — never raises on short sequences.
+    """
+    limit = config.draft_len if max_draft is None \
+        else min(config.draft_len, int(max_draft))
+    L = len(tokens)
+    if limit < 1 or L < config.min_ngram + 1:
+        return []
+    tokens = list(tokens)
+    # one 4-byte-per-token encoding per call: every n-gram try below is
+    # a C-speed substring search over it, not a Python scan
+    data = np.asarray(tokens, "<u4").tobytes()
+    for n in range(min(config.ngram, L - 1), config.min_ngram - 1, -1):
+        pattern = data[(L - n) * 4:]
+        i = _rfind(data, pattern, L - n - limit)   # full follower window
+        if i < 0:
+            i = _rfind(data, pattern, L - n - 1)   # >= 1 follower
+        if i < 0:
+            continue
+        follow = tokens[i + n:i + n + limit]
+        if follow:
+            return list(follow)
+    return []

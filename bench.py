@@ -34,7 +34,11 @@ BENCH_* trajectory (ROADMAP's "Recent" gap), plus a nested ``chaos``
 sub-object (BENCH_SERVING_CHAOS=0 to drop it): goodput under a seeded
 fault-injection schedule vs the fault-free rate, failed/requeued
 counts and ``token_mismatched_requests`` (expected 0) via
-``bench_serving.chaos_stats``. Failure-isolated at both layers: a
+``bench_serving.chaos_stats``, and a nested ``speculative``
+sub-object (BENCH_SERVING_SPEC=0 to drop it): draft-and-verify
+acceptance rate and tokens-per-slot-step vs plain decode with
+``token_mismatched_requests`` (expected 0, bitwise) via
+``bench_serving.spec_stats``. Failure-isolated at every layer: a
 broken serving stack puts {"error": ...} there, never kills the
 ResNet row.
 """
@@ -142,6 +146,13 @@ _SERVING_CHAOS_SMOKE = {
     "PREFILL_LEN": 32, "REQUESTS": 6, "NEW_TOKENS": 8, "WINDOWS": 1,
 }
 
+# The speculative sub-leg's smoke geometry (two streams, each served
+# twice — plain + spec — so it matches the chaos leg's sizing)
+_SERVING_SPEC_SMOKE = {
+    "SIZE": "tiny", "VOCAB": 512, "SLOTS": 4, "MAX_LEN": 128,
+    "PREFILL_LEN": 32, "REQUESTS": 6, "NEW_TOKENS": 8, "WINDOWS": 1,
+}
+
 
 def _serving_leg() -> dict:
     """The serving trajectory row (ROADMAP: bench_serving.py had no
@@ -164,6 +175,7 @@ def _serving_leg() -> dict:
             "hbm_bytes_per_request_reduction_pct", "pool_mib",
             "token_mismatched_requests", "model")}
         out["chaos"] = _serving_chaos_leg()
+        out["speculative"] = _serving_spec_leg()
         return out
     except KeyboardInterrupt:
         raise
@@ -189,6 +201,32 @@ def _serving_chaos_leg() -> dict:
             "goodput_retention_pct", "fault_pct", "clean_requests",
             "failed_requests", "requeued_retries",
             "token_mismatched_requests", "pages_in_use_at_drain")}
+    except KeyboardInterrupt:
+        raise
+    except BaseException as e:  # noqa: BLE001 — the row must not die here
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def _serving_spec_leg() -> dict:
+    """The speculative-decoding trajectory sub-row: smoke-sized
+    draft-and-verify summary (plain vs spec on the shared-prefix and
+    multi-turn streams) from ``bench_serving.spec_stats``.
+    BENCH_SERVING_SPEC=0 drops it; failure-isolated like its siblings
+    — a broken spec layer yields {"error": ...} here, never a lost
+    serving (or ResNet) row."""
+    if _env_int("BENCH_SERVING_SPEC", "1") == 0:
+        return {"skipped": True}
+    try:
+        import bench_serving
+
+        bench_serving._load_env(smoke=dict(_SERVING_SPEC_SMOKE))
+        _, summary = bench_serving.spec_stats()
+        return {k: summary[k] for k in (
+            "value", "unit", "baseline_tokens_per_s", "acceptance_rate",
+            "acceptance_p50", "acceptance_p99", "tokens_per_step",
+            "tokens_per_step_plain", "multi_turn_acceptance_rate",
+            "multi_turn_tokens_per_step", "token_mismatched_requests",
+            "spec_k", "verify_traces")}
     except KeyboardInterrupt:
         raise
     except BaseException as e:  # noqa: BLE001 — the row must not die here

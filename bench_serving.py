@@ -78,6 +78,28 @@ wider decode batch costs MORE per step on the CPU fallback (the
 reference decode attends every slot) — judge tokens/s on TPU rows; the
 capacity and bytes columns are the leg's claim.
 
+``--speculative`` runs the draft-and-verify leg: TWO drafter-friendly
+greedy streams — shared-prefix (the ``--shared-prefix`` shape: every
+prompt opens with the same system prefix) and multi-turn (a shared
+conversation history plus a repeated per-request tail, the
+prompt-lookup drafter's best case) — each served twice on one engine
+built with ``spec=SpecConfig(draft_len=BENCH_SERVING_SPEC_K)``:
+``speculative=False`` (plain decode, the measurable baseline) then
+``speculative=True``. One row per (stream, mode) plus a final line
+whose payoff fields are ``acceptance_rate`` (accepted/drafted, with
+per-verify-call p50/p99 from the ``serving.spec.acceptance_rate``
+histogram), ``tokens_per_step`` (tokens emitted per compiled
+sequence-step — plain decode pins 1.0, acceptance pushes it above),
+and ``token_mismatched_requests`` — spec vs plain, expected **0
+bitwise on every backend** (accept-longest-prefix emits only the
+verify program's own greedy targets). Throughput regime note: on the
+CPU fallback a ``[1, K+1]`` verify costs ~K+1 decode steps of real
+compute (the reference kernels do the full math), so spec tokens/s
+reads flat-to-worse here even at high acceptance — CPU rows prove
+exactness + acceptance; tokens/s is the TPU rows' claim (one verify
+dispatch replaces up to K+1 decode dispatches). Defaults to a smoke
+geometry; env knobs resize it (env-beats-smoke).
+
 ``--chaos`` runs the fault-isolation leg: the IDENTICAL greedy request
 stream served twice on one engine — fault rate 0, then
 ``BENCH_SERVING_FAULT_PCT``% per-tick injection (seeded
@@ -112,6 +134,7 @@ MIXED_METRIC = "serving_mixed_prompts_tokens_per_sec"
 SHARED_METRIC = "serving_shared_prefix_tokens_per_sec"
 PAGED_METRIC = "serving_paged_pool_tokens_per_sec"
 CHAOS_METRIC = "serving_chaos_goodput_tokens_per_sec"
+SPEC_METRIC = "serving_speculative_tokens_per_sec"
 
 # Literal defaults at import time; the BENCH_SERVING_* env overrides are
 # parsed by _load_env() INSIDE each guarded main, so a malformed value
@@ -152,6 +175,11 @@ PAGED_PROMPT = 32
 # geometry you would give one mode
 FAULT_PCT = 10
 CHAOS_SMOKE = {"REQUESTS": 8, "NEW_TOKENS": 12, "WINDOWS": 1}
+# --speculative leg: drafts per verify step (the engine's [1, K+1]
+# verify shape; on silicon keep K+1 a multiple of 8 for the Pallas
+# path) and its smoke preset — the leg serves TWO streams twice each
+SPEC_K = 4
+SPEC_SMOKE = {"REQUESTS": 6, "NEW_TOKENS": 16, "WINDOWS": 1}
 
 _ENV_KNOBS = {
     "VOCAB": "BENCH_SERVING_VOCAB", "SLOTS": "BENCH_SERVING_SLOTS",
@@ -168,6 +196,7 @@ _ENV_KNOBS = {
     "PAGED_SLOTS": "BENCH_SERVING_PAGED_SLOTS",
     "PAGED_PROMPT": "BENCH_SERVING_PAGED_PROMPT",
     "FAULT_PCT": "BENCH_SERVING_FAULT_PCT",
+    "SPEC_K": "BENCH_SERVING_SPEC_K",
 }
 
 
@@ -865,6 +894,178 @@ def main_chaos():
     print(json.dumps(summary))
 
 
+def _spec_streams():
+    """The two drafter-friendly stream factories, seeded independently
+    of mode so plain and speculative serve IDENTICAL prompts:
+    shared-prefix (every prompt opens with one system prefix) and
+    multi-turn (a shared conversation history + a per-request tail
+    repeated twice — the trailing n-gram matches its own first copy, so
+    the drafter fires from the very first decode step)."""
+    rng0 = np.random.default_rng(7)
+    shared_len = min(SHARED_PREFIX, PREFILL_LEN - 1)
+    shared = rng0.integers(1, VOCAB, size=shared_len).tolist()
+    history_len = min(SHARED_PREFIX, max(1, PREFILL_LEN - 8))
+    history = rng0.integers(1, VOCAB, size=history_len).tolist()
+
+    from apex_tpu.serving import Request
+
+    def shared_prefix(rng):
+        reqs = []
+        for _ in range(REQUESTS):
+            tail = max(1, PREFILL_LEN - len(shared))
+            n = int(rng.integers(1, tail + 1))
+            prompt = shared + rng.integers(1, VOCAB, size=n).tolist()
+            budget = max(1, min(NEW_TOKENS, MAX_LEN - len(prompt)))
+            reqs.append(Request(prompt=prompt, max_new_tokens=budget))
+        return reqs
+
+    def multi_turn(rng):
+        reqs = []
+        for _ in range(REQUESTS):
+            room = max(2, PREFILL_LEN - len(history))
+            u = int(rng.integers(1, max(2, room // 2 + 1)))
+            tail = rng.integers(1, VOCAB, size=u).tolist()
+            prompt = (history + tail + tail)[:PREFILL_LEN]
+            budget = max(1, min(NEW_TOKENS, MAX_LEN - len(prompt)))
+            reqs.append(Request(prompt=prompt, max_new_tokens=budget))
+        return reqs
+
+    return {"shared_prefix": shared_prefix, "multi_turn": multi_turn}
+
+
+def _serve_spec(engine, factory, seed, speculative):
+    """WINDOWS measured windows (plus compile warmup) of one stream in
+    one mode; per-mode registry so the acceptance stats are the
+    measured windows' own."""
+    from apex_tpu import serving, telemetry
+
+    reg = telemetry.MetricsRegistry()
+    rng = np.random.default_rng(seed)
+    rates, all_reqs = [], []
+    tok0 = step0 = ver0 = 0
+    for w in range(WINDOWS + 1):
+        engine.reset()
+        engine.set_registry(reg if w else None)
+        sched = serving.Scheduler(engine, max_queue=max(REQUESTS, 1),
+                                  registry=reg if w else None,
+                                  chunk_budget=CHUNK_BUDGET,
+                                  speculative=speculative)
+        reqs = factory(rng)
+        t0 = time.perf_counter()
+        tokw = engine.tokens_generated
+        done = sched.run(reqs)
+        dt = time.perf_counter() - t0
+        assert len(done) == REQUESTS
+        if w > 0:
+            rates.append((engine.tokens_generated - tokw) / dt)
+            all_reqs.extend(reqs)
+    engine.set_registry(None)
+    snap = reg.snapshot()
+    return _median(rates), all_reqs, snap
+
+
+def spec_stats():
+    """The --speculative measurement, reusable by bench.py's serving
+    trajectory leg: both drafter-friendly streams served plain vs
+    speculative on ONE spec-enabled engine (same compiled programs —
+    the verify program only ever traces once), with per-mode
+    acceptance stats and a bitwise token comparison. A discarded
+    warmup window per (stream, mode) keeps trace latency out of the
+    rates."""
+    from apex_tpu.serving import SpecConfig
+
+    engine = _build_engine(spec=SpecConfig(draft_len=SPEC_K, ngram=3))
+    rows, summaries = {}, {}
+    for stream, factory in _spec_streams().items():
+        outputs = {}
+        for mode, speculative in (("plain", False), ("spec", True)):
+            rate, reqs, snap = _serve_spec(engine, factory,
+                                           seed=11, speculative=speculative)
+            drafted = snap["counters"].get("serving.spec.drafted", 0)
+            accepted = snap["counters"].get("serving.spec.accepted", 0)
+            acc_hist = snap["histograms"].get(
+                "serving.spec.acceptance_rate", {})
+            verify_calls = snap["histograms"].get(
+                "serving.spec.verify_s", {}).get("count", 0)
+            decode_steps = snap["counters"].get("serving.decode.steps",
+                                                0)
+            emitted = sum(len(r.output_tokens) for r in reqs)
+            # per-SLOT sequence steps: each decode-emitted token is one
+            # slot advancing one step (batch width is not speculation —
+            # plain decode must read exactly 1.0), each verify call is
+            # one slot-step emitting n_accepted + 1 tokens
+            spec_emitted = int(accepted) + int(verify_calls)
+            decode_emitted = emitted - len(reqs) - spec_emitted
+            seq_steps = verify_calls + decode_emitted
+            row = {
+                "metric": f"{SPEC_METRIC}.{stream}.{mode}",
+                "value": round(rate, 2),
+                "unit": "tokens/s",
+                "drafted": int(drafted),
+                "accepted": int(accepted),
+                "acceptance_rate": round(accepted / drafted, 4)
+                if drafted else 0.0,
+                "acceptance_p50": round(acc_hist.get("p50", 0.0), 4),
+                "acceptance_p99": round(acc_hist.get("p99", 0.0), 4),
+                "verify_calls": int(verify_calls),
+                "decode_steps": int(decode_steps),
+                # the per-request prefill token is excluded from the
+                # numerator: it rides the chunk program either way
+                "tokens_per_step": round(
+                    (emitted - len(reqs)) / seq_steps, 3)
+                if seq_steps else 0.0,
+                "spec_accepted_per_request": round(
+                    float(np.mean([r.spec_accepted for r in reqs])), 2),
+                "compiled_programs": engine.compiled_programs,
+            }
+            rows[f"{stream}.{mode}"] = row
+            outputs[mode] = [list(r.output_tokens) for r in reqs]
+        summaries[stream] = {
+            "mismatches": sum(a != b for a, b in zip(outputs["spec"],
+                                                     outputs["plain"])),
+        }
+    sp = rows["shared_prefix.spec"]
+    mt = rows["multi_turn.spec"]
+    mism = (summaries["shared_prefix"]["mismatches"]
+            + summaries["multi_turn"]["mismatches"])
+    summary = {
+        "metric": SPEC_METRIC,
+        "value": sp["value"],
+        "unit": "tokens/s",
+        "baseline_tokens_per_s": rows["shared_prefix.plain"]["value"],
+        "acceptance_rate": sp["acceptance_rate"],
+        "acceptance_p50": sp["acceptance_p50"],
+        "acceptance_p99": sp["acceptance_p99"],
+        "tokens_per_step": sp["tokens_per_step"],
+        "tokens_per_step_plain": rows["shared_prefix.plain"][
+            "tokens_per_step"],
+        "multi_turn_tokens_per_s": mt["value"],
+        "multi_turn_acceptance_rate": mt["acceptance_rate"],
+        "multi_turn_tokens_per_step": mt["tokens_per_step"],
+        "token_exact_vs_plain": mism == 0,
+        "token_mismatched_requests": mism,
+        "spec_k": SPEC_K,
+        "windows": WINDOWS,
+        "requests_per_window": REQUESTS,
+        "compiled_programs": engine.compiled_programs,
+        "verify_traces": engine.verify_traces,
+        "model": SIZE,
+    }
+    return rows, summary
+
+
+def main_spec():
+    import jax
+
+    _load_env(smoke=SPEC_SMOKE)
+
+    rows, summary = spec_stats()
+    for row in rows.values():
+        print(json.dumps(row))
+    summary["backend"] = jax.default_backend()
+    print(json.dumps(summary))
+
+
 if __name__ == "__main__":
     from apex_tpu.telemetry import guard_bench_main
 
@@ -876,5 +1077,7 @@ if __name__ == "__main__":
         guard_bench_main(main_paged, PAGED_METRIC)
     elif "--chaos" in sys.argv[1:]:
         guard_bench_main(main_chaos, CHAOS_METRIC)
+    elif "--speculative" in sys.argv[1:]:
+        guard_bench_main(main_spec, SPEC_METRIC)
     else:
         guard_bench_main(main, METRIC)

@@ -58,6 +58,7 @@ PAGES = {
     "serving": ["apex_tpu.serving", "apex_tpu.serving.kv_cache",
                 "apex_tpu.serving.engine",
                 "apex_tpu.serving.prefix_cache",
+                "apex_tpu.serving.speculative",
                 "apex_tpu.serving.scheduler",
                 "apex_tpu.serving.faults"],
     "contrib": [

@@ -474,6 +474,50 @@ def test_watchdog_flags_slow_heartbeats_only_over_budget(engine):
     engine.reset()
 
 
+def test_watchdog_warm_start_exempts_tracing_ticks(lm_and_params):
+    """The warm-start regression (PR 7 NOTE): the first heartbeat on a
+    COLD engine traces compiled programs, so a tiny
+    ``watchdog_budget_s`` used to false-trip on tick 0 before the
+    engine had done anything wrong. Tracing ticks are now exempt and
+    separately accounted as ``serving.watchdog.warmup_s``: with a
+    budget every tick must breach, stalls + warm-ups partition the run
+    exactly, and the ticks that traced never counted as stalls."""
+    eng = _mk_engine(lm_and_params, seed=9)     # cold: nothing traced
+    assert eng.compiled_programs == 0
+    stalls = []
+    reg = telemetry.MetricsRegistry()
+    sched = Scheduler(
+        eng, registry=reg,
+        fault_policy=_fast_policy(watchdog_budget_s=1e-9,
+                                  on_stall=stalls.append))
+    steps = 0
+    sched.submit(Request(prompt=[5, 6, 7], max_new_tokens=4))
+    while sched.pending:
+        sched.step()
+        steps += 1
+    snap = reg.snapshot()
+    warmups = snap["histograms"]["serving.watchdog.warmup_s"]["count"]
+    stalls_n = snap["counters"].get("serving.watchdog.stall", 0)
+    # tick 0 traced the chunk AND decode programs (the final chunk
+    # flips the slot to decoding within the same heartbeat)
+    assert warmups >= 1, "tracing ticks were not accounted as warm-up"
+    # every tick either warmed or breached the (impossible) budget —
+    # and the tracing ticks are exactly the ones that did NOT stall
+    assert warmups + stalls_n == steps
+    assert len(stalls) == stalls_n
+    # a warmed engine stops producing warm-up ticks: one more request,
+    # same scheduler — every subsequent tick breaches instead
+    sched.submit(Request(prompt=[5, 6, 7], max_new_tokens=2))
+    more = 0
+    while sched.pending:
+        sched.step()
+        more += 1
+    snap = reg.snapshot()
+    assert snap["histograms"]["serving.watchdog.warmup_s"]["count"] \
+        == warmups, "a warm engine must not keep claiming warm-up"
+    assert snap["counters"]["serving.watchdog.stall"] == stalls_n + more
+
+
 # ------------------------------------------------------------- the soak
 @pytest.mark.slow
 def test_chaos_soak_pool_exhaustion_prefix_eviction_zero_leaks(

@@ -1,15 +1,18 @@
-"""Fault-telemetry lint: every ``serving.faults.*`` / second
-``serving.watchdog.*`` metric the serving code emits must be documented
-in ``docs/serving.md``, and every documented one must be emitted.
+"""Serving-telemetry lint: every ``serving.faults.*`` /
+``serving.watchdog.*`` / ``serving.spec.*`` metric the serving code
+emits must be documented in ``docs/serving.md``, and every documented
+one must be emitted.
 
 Same failure mode as the tuned-keys lint, one layer up: metric names
 are stringly typed, so a renamed counter silently orphans its dashboard
 row (and a doc'd metric nobody emits is an alert that can never fire).
 The fault-isolation layer is exactly where that rot is most expensive —
 ``serving.faults.nonfinite`` going dark looks identical to "no faults"
-— so the loop is closed by lint: the set of fault/watchdog metric
-literals in ``apex_tpu/serving/`` source must EQUAL the set named in
-the docs' fault-tolerance tables.
+— and the speculative layer is next in line: an orphaned
+``serving.spec.acceptance_rate`` reads as "speculation off" while the
+verify program burns real FLOPs. The loop is closed by lint: the set of
+fault/watchdog/spec metric literals in ``apex_tpu/serving/`` source
+must EQUAL the set named in the docs' tables.
 """
 
 import glob
@@ -25,8 +28,8 @@ ROOT = os.path.abspath(os.path.join(HERE, os.pardir, os.pardir))
 SRC_DIR = os.path.join(ROOT, "apex_tpu", "serving")
 DOC = os.path.join(ROOT, "docs", "serving.md")
 
-# metric families the fault-isolation layer owns
-_PAT = re.compile(r"serving\.(?:faults|watchdog)\.[a-z0-9_]+")
+# metric families the fault-isolation + speculative layers own
+_PAT = re.compile(r"serving\.(?:faults|watchdog|spec)\.[a-z0-9_]+")
 
 
 def _emitted():
@@ -49,16 +52,28 @@ def test_scan_surface_is_alive():
     """The lint must be looking at real code and real docs — an empty
     scan means the regex or paths broke, not that the code is clean."""
     emitted = _emitted()
-    assert emitted, "no serving.faults.*/serving.watchdog.* literals " \
+    assert emitted, "no serving.faults.*/watchdog.*/spec.* literals " \
         "found under apex_tpu/serving — scan broken?"
-    # the two metrics the issue headlines must exist and come from the
-    # layers that own them (engine guard / scheduler watchdog)
+    # the metrics the issues headline must exist and come from the
+    # layers that own them (engine guard / scheduler watchdog + spec)
     assert os.path.join("apex_tpu", "serving", "engine.py") \
         in emitted.get("serving.faults.nonfinite", [])
     assert os.path.join("apex_tpu", "serving", "scheduler.py") \
         in emitted.get("serving.watchdog.stall", [])
-    assert _documented(), "docs/serving.md names no fault/watchdog " \
-        "metrics — doc section missing?"
+    # the speculative-decoding layer (watchdog warm-start satellite
+    # rides the same scan): acceptance + warm-up accounting are live
+    sched = os.path.join("apex_tpu", "serving", "scheduler.py")
+    for name in ("serving.spec.drafted", "serving.spec.accepted",
+                 "serving.spec.acceptance_rate",
+                 "serving.spec.tokens_per_step",
+                 "serving.watchdog.warmup_s"):
+        assert sched in emitted.get(name, []), \
+            f"{name} not emitted by the scheduler — spec/watchdog " \
+            "telemetry went dark"
+    assert os.path.join("apex_tpu", "serving", "engine.py") \
+        in emitted.get("serving.spec.verify_s", [])
+    assert _documented(), "docs/serving.md names no fault/watchdog/" \
+        "spec metrics — doc section missing?"
 
 
 def test_every_emitted_fault_metric_is_documented():

@@ -138,3 +138,27 @@ def test_prefix_copy_sources_are_linted_and_carry_no_tuned_keys():
                         "prefix_cache.py") in scanned
     assert os.path.join("apex_tpu", "serving", "engine.py") in scanned
     assert os.path.join("apex_tpu", "serving", "kv_cache.py") in scanned
+
+
+def test_speculative_verify_owes_the_tables_no_keys():
+    """The speculative-decoding satellite, in the copy-program pattern:
+    the verify program is the chunk-append machinery at a different
+    shape — its attention rides the EXISTING ``decode.chunk_block_*`` /
+    ``decode.page_block_q`` knobs and the drafter is pure host python —
+    so no ``decode.verify_*`` key may exist in the tables (a row no
+    code consumes would be a dead sweep; if a dedicated verify kernel
+    ever lands, its keys get the existence/staleness treatment
+    automatically because the scan covers speculative.py and
+    engine.py)."""
+    table = _table_keys()
+    stale_verify = {k for k in table if k.startswith("decode.verify_")
+                    or k.startswith("decode.spec_")}
+    assert not stale_verify, (
+        f"tuned tables carry verify/spec keys but the verify program "
+        f"reuses the chunk-attention knobs: {stale_verify}")
+    scanned = {os.path.relpath(p, ROOT)
+               for d in SCAN_DIRS
+               for p in glob.glob(os.path.join(d, "**", "*.py"),
+                                  recursive=True)}
+    assert os.path.join("apex_tpu", "serving",
+                        "speculative.py") in scanned
