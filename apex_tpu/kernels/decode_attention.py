@@ -44,6 +44,18 @@ table rides SMEM ahead of the grid, and each (b, h, j) step DMAs pool
 page ``page_table[b, j]`` instead of row offset ``j``. The length skip
 is unchanged — pages wholly past ``lengths[b]`` are masked to the
 sentinel page and their compute skipped.
+
+**Tensor parallelism** (``serving.Engine(mesh=...)``): the kernels need
+NO sharded variant. The grid iterates ``batch x heads`` (flattened to
+``b*h`` rows here, an explicit heads dimension in the paged grid), so a
+heads-sharded pool — ``[num_pages, heads/tp, page_len, head_dim]`` per
+shard, the serving tier's TP layout — simply hands each shard a grid
+with fewer heads-axis blocks over its own pool slice: the index maps
+never mix heads, every DMA stays shard-local, and the per-shard math is
+bit-identical to the single-chip kernel over that head subset.
+Attention therefore contributes ZERO collectives to the sharded serving
+programs (the psums live in the projection GEMMs; see
+:mod:`apex_tpu.serving.sharding`).
 """
 
 from __future__ import annotations

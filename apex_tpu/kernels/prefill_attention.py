@@ -52,6 +52,15 @@ O(max_pages)), the q-block × page skip runs on global positions
 exactly as the contiguous kernel's q-block × k-block skip. The q-block
 knob is ``decode.page_block_q`` (the KV block is pinned to one page —
 the pool's DMA granule).
+
+**Tensor parallelism** (``serving.Engine(mesh=...)``): no sharded
+variant needed — the grid's heads dimension simply shrinks. A
+heads-sharded pool (``heads/tp`` per shard) gives each shard the same
+index maps over fewer heads-axis blocks of its own pool slice; no DMA
+or mask ever crosses heads, so the per-shard kernel is unchanged math
+over its head subset and attention adds no collectives to the sharded
+serving programs (the block knobs above tune per-shard exactly as they
+do single-chip — same shapes per head, fewer heads).
 """
 
 from __future__ import annotations

@@ -76,6 +76,20 @@ class SelfAttention(nn.Module):
     set, Q/K/V leave the qkv GEMM in that dtype (normally the amp half —
     pure-bf16 decode needs no fp32 master weights anywhere); when None
     the training-policy ``dense_dtype`` governs, as before.
+
+    **Tensor parallelism** (``tp_axis``/``tp_size``, set by
+    ``serving.Engine(mesh=...)`` and meaningful only inside a
+    ``shard_map`` over that axis): the module becomes ONE SHARD of a
+    Megatron-style split — the qkv projection is column-parallel over
+    ``num_heads // tp_size`` local heads, attention (cached or not)
+    runs entirely over the local heads (the KV cache/pool arrives
+    heads-sharded, so nothing here crosses ICI), and the row-parallel
+    output projection's partial sum is ``psum``-reduced over
+    ``tp_axis``. The projection BIAS is added per shard inside the
+    Dense and the param sharder value-scales it by ``1/tp_size``
+    (:mod:`apex_tpu.serving.sharding`), so the psum restores it exactly
+    once. ``tp_size=1`` (the default) leaves every shape and op
+    untouched.
     """
 
     hidden: int
@@ -84,6 +98,8 @@ class SelfAttention(nn.Module):
     dtype: Optional[Any] = None
     param_dtype: Any = jnp.float32
     inference_dtype: Optional[Any] = None
+    tp_axis: Optional[str] = None
+    tp_size: int = 1
 
     @nn.compact
     def __call__(self, x, train: bool, cache=None, positions=None,
@@ -95,11 +111,15 @@ class SelfAttention(nn.Module):
             dense_dtype = self.inference_dtype
         B, S, H = x.shape
         d = self.hidden // self.num_heads
-        qkv = nn.Dense(3 * self.hidden, dtype=dense_dtype,
+        # tensor-parallel shard: this module computes heads // tp local
+        # heads over the full (replicated) residual stream; the param
+        # sharder hands it the matching qkv/proj kernel slices
+        heads = self.num_heads // self.tp_size
+        qkv = nn.Dense(3 * heads * d, dtype=dense_dtype,
                        param_dtype=self.param_dtype, name="qkv")(x)
         # one transpose to [3, B, h, S, d], then three views — no
         # throwaway generator re-indexing qkv[:, :, i] three times
-        qkv = qkv.reshape(B, S, 3, self.num_heads, d).transpose(2, 0, 3, 1, 4)
+        qkv = qkv.reshape(B, S, 3, heads, d).transpose(2, 0, 3, 1, 4)
         q, k, v = qkv[0], qkv[1], qkv[2]             # [B, h, S, d]
         if cache is not None:
             paged = len(cache) == 3
@@ -186,7 +206,7 @@ class SelfAttention(nn.Module):
                                                       axis=1)  # [B, npg]
                     def _pages(x, dtype):
                         return jnp.asarray(x, dtype).reshape(
-                            B, self.num_heads, npg, page_len, d
+                            B, heads, npg, page_len, d
                         ).transpose(0, 2, 1, 3, 4)   # [B, npg, h, pl, d]
                     k_cache = k_cache.at[chunk_pages].set(
                         _pages(k, k_cache.dtype))
@@ -205,13 +225,20 @@ class SelfAttention(nn.Module):
                     v_cache = jax.vmap(_write)(
                         v_cache, jnp.asarray(v, v_cache.dtype), pos)
                     ctx = prefill_attention(q, k_cache, v_cache, pos)
-            out = jnp.moveaxis(ctx.reshape(B, self.num_heads, S, d),
-                               1, 2).reshape(B, S, self.hidden)
+            out = jnp.moveaxis(ctx.reshape(B, heads, S, d),
+                               1, 2).reshape(B, S, heads * d)
         else:
             out = flash_attention(q, k, v, causal=True)  # [B, h, S, d]
-            out = jnp.moveaxis(out, 1, 2).reshape(B, S, self.hidden)
+            out = jnp.moveaxis(out, 1, 2).reshape(B, S, heads * d)
         out = nn.Dense(self.hidden, dtype=dense_dtype,
                        param_dtype=self.param_dtype, name="proj")(out)
+        if self.tp_size > 1:
+            # row-parallel reduce: each shard's proj saw only its heads'
+            # context, so the outputs are partial sums; the Dense added
+            # the 1/tp-scaled bias per shard (sharding.shard_params), so
+            # this one psum yields x @ W + b exactly — the first of the
+            # block's two canonical TP all-reduces
+            out = jax.lax.psum(out, self.tp_axis)
         if self.dropout > 0.0:
             out = nn.Dropout(rate=self.dropout, deterministic=not train)(out)
         if cache is not None:
@@ -238,6 +265,8 @@ class TransformerBlock(nn.Module):
     dtype: Optional[Any] = None
     param_dtype: Any = jnp.float32
     inference_dtype: Optional[Any] = None
+    tp_axis: Optional[str] = None
+    tp_size: int = 1
 
     @nn.compact
     def __call__(self, x, train: bool, cache=None, positions=None,
@@ -254,6 +283,7 @@ class TransformerBlock(nn.Module):
         attn_out = SelfAttention(self.hidden, self.num_heads, self.dropout,
                                  self.dtype, self.param_dtype,
                                  self.inference_dtype,
+                                 self.tp_axis, self.tp_size,
                                  name="attn")(h, train=train, cache=cache,
                                               positions=positions,
                                               return_kv=return_kv,
@@ -264,7 +294,10 @@ class TransformerBlock(nn.Module):
         x = x + attn_out
         h = FusedLayerNorm(normalized_shape=self.hidden, dtype=self.dtype,
                            name="ln_mlp")(x)
-        inner = self.mlp_ratio * self.hidden
+        # tensor-parallel shard: column-parallel up-projection (this
+        # shard's inner/tp slice), row-parallel down-projection psummed
+        # below — the MLP half of the Megatron split
+        inner = self.mlp_ratio * self.hidden // self.tp_size
         h = nn.Dense(inner, dtype=dense_dtype, param_dtype=self.param_dtype,
                      name="mlp_in")(h)
         # tanh-approximation GELU (GPT-2's own formulation) on the fp32
@@ -277,6 +310,10 @@ class TransformerBlock(nn.Module):
         h = nn.Dense(self.hidden, dtype=dense_dtype,
                      param_dtype=self.param_dtype,
                      name="mlp_out")(jnp.asarray(h, dense_dtype))
+        if self.tp_size > 1:
+            # row-parallel reduce (the block's second TP all-reduce);
+            # mlp_out's bias is 1/tp-scaled per shard, restored here
+            h = jax.lax.psum(h, self.tp_axis)
         if self.dropout > 0.0:
             h = nn.Dropout(rate=self.dropout, deterministic=not train)(h)
         if aux is not None:
@@ -334,6 +371,14 @@ class TransformerLM(nn.Module):
     dtype: Optional[Any] = None
     param_dtype: Any = jnp.float32
     inference_dtype: Optional[Any] = None
+    # tensor parallelism (serving.Engine(mesh=...); meaningful only
+    # inside a shard_map over tp_axis): every block becomes one
+    # Megatron-style shard (local heads, split MLP, 2 psums/block) and
+    # the tied LM head returns VOCAB-LOCAL logits — each shard matmuls
+    # its vocab/tp slice of the replicated embedding; the caller (the
+    # engine's compiled program) all-gathers only the sampled rows.
+    tp_axis: Optional[str] = None
+    tp_size: int = 1
 
     @nn.compact
     def __call__(self, tokens, *, train: bool = True,
@@ -346,6 +391,11 @@ class TransformerLM(nn.Module):
         if cache is not None and return_kv:
             raise ValueError("cache (decode) and return_kv (prefill) are "
                              "exclusive modes")
+        if self.tp_size > 1 and (self.num_heads % self.tp_size
+                                 or self.vocab_size % self.tp_size):
+            raise ValueError(
+                f"tp_size={self.tp_size} must divide num_heads="
+                f"{self.num_heads} and vocab_size={self.vocab_size}")
         B, S = tokens.shape
         embed = nn.Embed(self.vocab_size, self.hidden,
                          param_dtype=self.param_dtype, name="wte")
@@ -368,7 +418,8 @@ class TransformerLM(nn.Module):
         for i in range(self.num_layers):
             block = block_cls(self.hidden, self.num_heads, self.mlp_ratio,
                               self.dropout, self.dtype, self.param_dtype,
-                              self.inference_dtype, name=f"block_{i}")
+                              self.inference_dtype, self.tp_axis,
+                              self.tp_size, name=f"block_{i}")
             if cache is not None:
                 # 2-tuple: per-slot rows [layers, B, h, L, d]; 3-tuple:
                 # paged pools [layers, P, h, page_len, d] + one shared
@@ -395,8 +446,20 @@ class TransformerLM(nn.Module):
             # head weight is params["wte"]["embedding"], vocab-major)
             return x
         # tied LM head; logits in fp32
-        logits = jnp.dot(jnp.asarray(x, jnp.float32),
-                         jnp.asarray(embed.embedding, jnp.float32).T)
+        if self.tp_size > 1:
+            # vocab-parallel head: each shard matmuls its vocab/tp slice
+            # of the replicated embedding (cutting the largest GEMM in a
+            # decode step by tp) and returns VOCAB-LOCAL logits — the
+            # engine all-gathers only the rows it actually samples
+            vl = self.vocab_size // self.tp_size
+            idx = jax.lax.axis_index(self.tp_axis)
+            head = jax.lax.dynamic_slice_in_dim(
+                jnp.asarray(embed.embedding, jnp.float32), idx * vl, vl,
+                axis=0)                                     # [V/tp, H]
+            logits = jnp.dot(jnp.asarray(x, jnp.float32), head.T)
+        else:
+            logits = jnp.dot(jnp.asarray(x, jnp.float32),
+                             jnp.asarray(embed.embedding, jnp.float32).T)
         if cache is not None or return_kv:
             return logits, (jnp.stack(kv_out[0]), jnp.stack(kv_out[1]))
         return logits

@@ -46,13 +46,23 @@ generation requests from a fixed set of compiled programs:
 - :class:`SpecConfig` / :func:`draft_tokens` (:mod:`.speculative`) —
   speculative decoding fused into the heartbeat: a host-side
   prompt-lookup / n-gram drafter proposes up to K next tokens per
-  greedy slot, ONE compiled ``[1, K+1]`` verify program
-  (:meth:`Engine.verify_step` — the chunk-append machinery at the
-  draft shape) scores them all in a single step, and in-program
+  greedy slot, ONE compiled ``[slots, K+1]`` BATCHED verify program
+  (:meth:`Engine.verify_batch` — the chunk-append machinery at the
+  draft shape; every verify-eligible slot shares one invocation per
+  heartbeat) scores them all in a single step, and in-program
   accept-longest-prefix keeps greedy output bitwise identical to
   plain decode while lifting tokens-per-step above 1
   (``Scheduler(speculative=True)``; rejected-tail K/V never becomes
   visible — rollback is a host/length decrement).
+
+- :mod:`.sharding` — tensor-parallel serving (``Engine(mesh=...)``,
+  paged only): a ``match_partition_rules``-style rule table over the
+  TransformerLM pytree plus shard_map-wrapped engine programs. The KV
+  pool shards along the heads axis so attention never crosses ICI;
+  the only collectives are two psums per transformer block plus one
+  all-gather of the sampled logits rows (the tied head runs
+  vocab-parallel). ``mesh=None`` stays the verbatim single-chip
+  baseline, pinned bitwise against a ``tp=1`` mesh.
 
 - :class:`FaultPlan` / :class:`FaultPolicy` / :class:`PoolAuditor`
   (:mod:`.faults`) — fault isolation: a seeded deterministic
@@ -83,6 +93,7 @@ Exercised end-to-end by ``bench_serving.py`` and
 ``examples/lm/main_amp.py --generate``.
 """
 
+from . import sharding
 from .engine import Engine, sample_tokens
 from .faults import (FaultPlan, FaultPolicy, FaultSpec, InjectedFault,
                      PoolAuditor, PoolInvariantError)
@@ -95,4 +106,5 @@ __all__ = ["Engine", "FaultPlan", "FaultPolicy", "FaultSpec",
            "InjectedFault", "KVCache", "PagedKVCache", "PagePool",
            "PoolAuditor", "PoolInvariantError", "PrefixCache",
            "PrefixMatch", "QueueFull", "Request", "RequestStatus",
-           "Scheduler", "SpecConfig", "draft_tokens", "sample_tokens"]
+           "Scheduler", "SpecConfig", "draft_tokens", "sample_tokens",
+           "sharding"]

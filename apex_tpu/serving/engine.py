@@ -72,26 +72,49 @@ real non-finite logits). Verdicts land in
 ``serving.faults.nonfinite``.
 
 **Speculative verify** (``spec=SpecConfig(...)``): one more compiled
-program — a ``[1, K+1]`` draft-and-verify step built on the chunk-append
-machinery. The host drafts K tokens (prompt-lookup n-gram — see
-:mod:`apex_tpu.serving.speculative`), the program embeds
-``[last_token, d_1 .. d_K]`` at the slot's current offset, writes their
-K/V (paged: per-position scatters — ``unaligned_append``; contiguous:
-the ordinary offset chunk write), runs shifted-causal attention, and
-computes ACCEPT-LONGEST-PREFIX *in-program*: greedy target ``g_s`` per
-row, ``n_accepted`` = the longest run with ``d_i == g_{i-1}``. The
-emitted tokens ``g_0 .. g_m`` are the program's own greedy targets, so
-greedy output is token-identical to plain decode by construction. The
-rejected tail's K/V is written but NEVER visible: lengths are what gate
-attention, and the contiguous program sets the slot length to
-``offset + n_accepted + 1`` itself (the paged host does the same to its
-host-side length) — rollback is a length decrement, no cache mutation
-to undo; the stale positions are overwritten write-then-attend before
-anything can attend them (the same contract inactive-slot decode writes
-already live by). One executable serves every draft/offset/slot
-(``verify_traces`` pins it); a fused isfinite guard + scalar
-``fault_bias`` operand give chaos the same grip it has on every other
-program (:attr:`Engine.last_verify_finite`).
+program — a BATCHED ``[slots, K+1]`` draft-and-verify step built on the
+chunk-append machinery, the same fixed-shape discipline as decode:
+every verify-eligible slot shares ONE program invocation per heartbeat
+(instead of B sequential single-slot calls), and slots not verifying
+ride along as padding whose cache bytes are provably untouched (paged:
+their table-row operand is zeroed so writes land on the sentinel page;
+contiguous: their rows are masked back to their prior bytes
+in-program). The host drafts K tokens per slot (prompt-lookup n-gram —
+see :mod:`apex_tpu.serving.speculative`), the program embeds each
+row's ``[last_token, d_1 .. d_K]`` at that slot's current offset,
+writes their K/V (paged: per-position scatters — ``unaligned_append``;
+contiguous: the ordinary offset chunk write), runs shifted-causal
+attention, and computes ACCEPT-LONGEST-PREFIX *in-program* per row:
+greedy target ``g_s``, ``n_accepted`` = the longest run with
+``d_i == g_{i-1}``. The emitted tokens ``g_0 .. g_m`` are the
+program's own greedy targets, so greedy output is token-identical to
+plain decode by construction. The rejected tail's K/V is written but
+NEVER visible: lengths are what gate attention, and the contiguous
+program sets each verifying slot's length to ``offset + n_accepted +
+1`` itself (the paged host does the same to its host-side lengths) —
+rollback is a length decrement, no cache mutation to undo; the stale
+positions are overwritten write-then-attend before anything can attend
+them (the same contract inactive-slot decode writes already live by).
+One executable serves every draft/offset/slot combination AND the
+single-slot :meth:`Engine.verify_step` wrapper (``verify_traces`` pins
+it); a fused per-row isfinite guard + per-slot ``fault_bias`` operand
+give chaos the same grip it has on every other program
+(:attr:`Engine.last_verify_finite_slots`).
+
+**Tensor parallelism** (``mesh=...``, paged only): the same programs,
+shard_map'd over a 1-D tensor-parallel mesh axis
+(:mod:`apex_tpu.serving.sharding`). Params split per a
+``match_partition_rules`` table (qkv/MLP-up column-parallel, proj/
+MLP-down row-parallel, embeddings replicated), the KV pool shards
+along the HEADS axis (``[layers, num_pages, heads/tp, page_len,
+head_dim]`` per shard) so attention never crosses ICI, and the only
+collectives are the two canonical TP all-reduces per block
+(post-attention, post-MLP) plus ONE all-gather of the sampled logits
+rows (the tied head computes vocab/tp slices per shard) — 2 psums per
+block + 1 gather per program, pinned from compiled HLO. ``mesh=None``
+(the default) is the verbatim single-chip baseline — none of the
+sharding code is on its trace path — and a ``tp=1`` mesh is pinned
+bitwise against it on a greedy stream.
 
 Weights are cast ONCE at construction through the amp cast-policy
 machinery (default: pure-half O3 — bf16 storage, no fp32 masters, the
@@ -248,10 +271,20 @@ class Engine:
         prefixes.
     spec:
         A :class:`~apex_tpu.serving.SpecConfig` enabling the
-        speculative-verify program (``draft_len`` fixes its ``[1, K+1]``
-        compiled shape). None (the default) compiles nothing extra and
-        leaves today's program set untouched; the program itself traces
-        lazily on the first :meth:`verify_step`.
+        speculative-verify program (``draft_len`` fixes its
+        ``[slots, K+1]`` compiled shape — one batched invocation serves
+        every verify-eligible slot per heartbeat). None (the default)
+        compiles nothing extra and leaves today's program set
+        untouched; the program itself traces lazily on the first
+        :meth:`verify_batch` / :meth:`verify_step`.
+    mesh:
+        A 1-D :class:`jax.sharding.Mesh` enabling tensor-parallel
+        serving (paged only): every compiled program runs shard_map'd
+        over the mesh axis with params split per the
+        :mod:`~apex_tpu.serving.sharding` rule table and the KV pool
+        sharded along heads (``heads % tp == 0`` enforced, as are the
+        MLP-inner and vocab splits). ``mesh=None`` (the default) is
+        the verbatim single-chip engine.
     top_k:
         Static top-k truncation for sampled (non-greedy) slots; 0 = off.
     registry:
@@ -271,7 +304,7 @@ class Engine:
                  registry=None, paged: bool = True,
                  page_len: Optional[int] = None,
                  num_pages: Optional[int] = None,
-                 spec: Optional[SpecConfig] = None):
+                 spec: Optional[SpecConfig] = None, mesh=None):
         from apex_tpu.amp.policy import resolve_policy
 
         if policy is None:
@@ -331,17 +364,53 @@ class Engine:
         self.chunk_len = int(chunk_len)
         self.prefix_pool = int(prefix_pool)
         self.top_k = int(top_k)
-        # pin the eval dtype on the module itself so decode GEMMs and
-        # the cache agree (pure-half: no fp32 masters anywhere)
-        try:
-            self._model = model.clone(inference_dtype=half)
-        except TypeError:  # model without the inference_dtype field
-            self._model = model
-        self.params = policy.cast_params(params)
         hidden = int(model.hidden)
         heads = int(model.num_heads)
         layers = int(model.num_layers)
         head_dim = hidden // heads
+        self.mesh = mesh
+        if mesh is not None:
+            from . import sharding as _sharding
+
+            if not paged:
+                raise ValueError(
+                    "Engine(mesh=...) requires paged=True: the sharded "
+                    "programs gather K/V through the heads-sharded page "
+                    "pool; the contiguous layout stays the single-chip "
+                    "parity oracle/baseline")
+            self._tp_axis = _sharding.tp_axis_of(mesh)
+            self.tp = int(np.prod(mesh.devices.shape))
+            _sharding.validate_tp_geometry(
+                self.tp, num_heads=heads, hidden=hidden,
+                mlp_ratio=int(getattr(model, "mlp_ratio", 4)),
+                vocab_size=int(model.vocab_size))
+        else:
+            self._tp_axis = None
+            self.tp = 1
+        # pin the eval dtype on the module itself so decode GEMMs and
+        # the cache agree (pure-half: no fp32 masters anywhere); under a
+        # mesh also pin the tensor-parallel shard geometry (the module
+        # becomes one Megatron-style shard inside shard_map)
+        clone_kw = {"inference_dtype": half}
+        if mesh is not None:
+            clone_kw.update(tp_axis=self._tp_axis, tp_size=self.tp)
+        try:
+            self._model = model.clone(**clone_kw)
+        except TypeError:  # model without the inference_dtype field
+            if mesh is not None:
+                raise TypeError(
+                    "Engine(mesh=...) needs a model with tp_axis/"
+                    "tp_size fields (the TransformerLM tensor-parallel "
+                    "contract)")
+            self._model = model
+        self.params = policy.cast_params(params)
+        if mesh is not None:
+            # permute/scale + place per the partition-rule table; the
+            # spec tree below is what the shard_map wrappers split by
+            self.params = _sharding.shard_params(
+                self.params, mesh, num_heads=heads, axis=self._tp_axis)
+            self._pspec = _sharding.match_partition_rules(
+                _sharding.partition_rules(self._tp_axis), self.params)
         self.paged = bool(paged)
         if self.paged:
             self.page_len = page_len = resolve_page_len(self.chunk_len,
@@ -361,9 +430,23 @@ class Engine:
                     f"max_len request ({self.max_pages} pages) plus "
                     f"the sentinel page")
             self.num_pages = num_pages
-            self.cache = PagedKVCache.create(
-                layers=layers, num_pages=num_pages, heads=heads,
-                page_len=page_len, head_dim=head_dim, dtype=half)
+            if mesh is None:
+                self.cache = PagedKVCache.create(
+                    layers=layers, num_pages=num_pages, heads=heads,
+                    page_len=page_len, head_dim=head_dim, dtype=half)
+            else:
+                # heads-axis pool sharding: each shard holds
+                # [layers, num_pages, heads/tp, page_len, head_dim] —
+                # attention never crosses ICI; page tables, lengths and
+                # the allocator stay replicated host state. Allocated
+                # DIRECTLY into the sharded layout (zeros_sharded): a
+                # pool sized to aggregate HBM — the point of sharding
+                # it — must never transit one chip whole.
+                shape = (layers, num_pages, heads, page_len, head_dim)
+                pspec = _sharding.cache_pspec(self._tp_axis)
+                self.cache = PagedKVCache(
+                    k=_sharding.zeros_sharded(shape, half, mesh, pspec),
+                    v=_sharding.zeros_sharded(shape, half, mesh, pspec))
             self.pool = PagePool(num_pages, page_len)
             self._page_table = np.zeros((self.slots, self.max_pages),
                                         np.int32)
@@ -410,6 +493,7 @@ class Engine:
         self.last_chunk_finite = True
         self.last_prefill_finite = True
         self.last_verify_finite = True
+        self.last_verify_finite_slots = np.ones(self.slots, bool)
         self.nonfinite_events = 0
         # prefill flash-attention geometry: decode.* tuned keys beat the
         # training sweep's flash.* defaults when present
@@ -418,24 +502,35 @@ class Engine:
         self._pf_bk = vmem.get_override("decode.prefill_block_k", 0,
                                         multiple=128) or None
         if self.paged:
-            self._jit_prefill = jax.jit(self._paged_prefill_impl,
-                                        donate_argnums=(1,))
-            self._jit_decode = jax.jit(self._paged_decode_impl,
-                                       donate_argnums=(1,))
-            self._jit_chunk = jax.jit(self._paged_chunk_impl,
-                                      donate_argnums=(1,))
-            self._jit_verify = jax.jit(self._paged_verify_impl,
-                                       donate_argnums=(1,))
+            # under a mesh each program body runs shard_map'd over the
+            # tensor-parallel axis (params split per the rule table, the
+            # pool on heads, every host operand replicated); mesh=None
+            # wraps nothing — the verbatim single-chip programs
+            self._jit_prefill = jax.jit(
+                self._tp_wrap(self._paged_prefill_impl, 2),
+                donate_argnums=(1,))
+            self._jit_decode = jax.jit(
+                self._tp_wrap(self._paged_decode_impl, 2),
+                donate_argnums=(1,))
+            self._jit_chunk = jax.jit(
+                self._tp_wrap(self._paged_chunk_impl, 2),
+                donate_argnums=(1,))
+            self._jit_verify = jax.jit(
+                self._tp_wrap(self._paged_verify_impl, 3),
+                donate_argnums=(1,))
             self._jit_copy = None      # retired: hits share pages
             _logger.info(
-                "serving engine (paged): %d slots x %d positions, "
+                "serving engine (paged%s): %d slots x %d positions, "
                 "prefill_len=%d, chunk_len=%d, page_len=%d, %d pages "
                 "(+1 sentinel in count), prefix_pool=%d, cache %s "
-                "(%.1f MiB), top_k=%d",
+                "(%.1f MiB%s), top_k=%d",
+                f", tp={self.tp}" if mesh is not None else "",
                 self.slots, self.max_len, self.prefill_len,
                 self.chunk_len, self.page_len, self.num_pages,
                 self.prefix_pool, np.dtype(half).name,
-                self.cache.nbytes() / 2**20, self.top_k)
+                self.cache.nbytes() / 2**20,
+                f", {self.cache.nbytes() / self.tp / 2**20:.1f}/shard"
+                if mesh is not None else "", self.top_k)
         else:
             self._jit_prefill = jax.jit(self._prefill_impl,
                                         donate_argnums=(1,))
@@ -453,6 +548,72 @@ class Engine:
                 self.slots, self.max_len, self.prefill_len,
                 self.chunk_len, self.prefix_pool, np.dtype(half).name,
                 self.cache.nbytes() / 2**20, self.top_k)
+
+        self._emit_tp_gauges()
+
+    # --------------------------------------------------- tensor parallelism
+    def _tp_wrap(self, fn, n_extra_out: int):
+        """Wrap a paged program body in ``shard_map`` over the engine's
+        tensor-parallel mesh: params split per the partition-rule table,
+        the KV pool on its heads axis, every other operand (tokens, page
+        tables, lengths, scalars, PRNG key) replicated, outputs
+        replicated except the pool. ``mesh=None`` returns ``fn``
+        untouched — the single-chip baseline is the verbatim program,
+        not a degenerate wrap."""
+        if self.mesh is None:
+            return fn
+        from jax.sharding import PartitionSpec as P
+
+        from apex_tpu.utils.compat import shard_map
+
+        from .sharding import cache_pspec
+
+        cspec = PagedKVCache(k=cache_pspec(self._tp_axis),
+                             v=cache_pspec(self._tp_axis))
+
+        def wrapped(params, cache, *rest):
+            return shard_map(
+                fn, mesh=self.mesh,
+                in_specs=(self._pspec, cspec) + (P(),) * len(rest),
+                out_specs=(cspec,) + (P(),) * n_extra_out,
+                check_vma=False)(params, cache, *rest)
+
+        return wrapped
+
+    def _gather_logits(self, rows):
+        """Rejoin vocab-parallel logits: under a mesh the model's tied
+        head returns ``[..., vocab/tp]`` local slices (see
+        :class:`~apex_tpu.models.transformer_lm.TransformerLM`) and this
+        one all-gather — the sharded programs' ONLY gather, applied to
+        the rows actually being sampled — restores the full vocabulary
+        so sampling and the fused non-finite guard run exactly as on one
+        chip. Identity on a single-chip engine."""
+        if self.mesh is None:
+            return rows
+        return jax.lax.all_gather(rows, self._tp_axis,
+                                  axis=rows.ndim - 1, tiled=True)
+
+    def _emit_tp_gauges(self) -> None:
+        """The ``serving.tp.*`` telemetry snapshot of a sharded engine:
+        shard count, the per-program collective inventory (2 psums per
+        block + 1 logits all-gather — the numbers the HLO pin asserts),
+        and the per-shard pool view (each shard holds every page at
+        ``heads/tp`` width, so HBM per chip is the pool bytes over tp).
+        Single-chip engines emit nothing."""
+        if self._registry is None or self.mesh is None:
+            return
+        from .sharding import expected_collectives
+
+        coll = expected_collectives(self.cache.layers)
+        self._registry.gauge_set("serving.tp.shards", float(self.tp))
+        self._registry.gauge_set("serving.tp.psums_per_program",
+                                 float(coll["all_reduce"]))
+        self._registry.gauge_set("serving.tp.all_gathers_per_program",
+                                 float(coll["all_gather"]))
+        self._registry.gauge_set("serving.tp.hbm_bytes_per_shard",
+                                 self.cache.nbytes() / self.tp)
+        self._registry.gauge_set("serving.tp.pool_pages_per_shard",
+                                 float(self.num_pages))
 
     @property
     def compiled_programs(self) -> int:
@@ -537,42 +698,62 @@ class Engine:
     @staticmethod
     def _accept_longest_prefix(rows, tokens, n_drafted):
         """In-program accept-longest-prefix over fp32 logit ``rows``
-        ``[K+1, V]`` for draft ``tokens`` ``[1, K+1]`` (row 0 is the
-        last committed token, rows 1..K the drafts; drafts past
-        ``n_drafted`` are padding and never accepted). Greedy only —
-        every emitted token IS the greedy target, which is the whole
-        bitwise-parity argument. Returns ``(greedy [K+1] int32,
-        n_accepted int32)``."""
+        ``[B, K+1, V]`` for draft ``tokens`` ``[B, K+1]`` (per row:
+        column 0 is the last committed token, columns 1..K the drafts;
+        drafts past ``n_drafted[b]`` are padding and never accepted —
+        rows with ``n_drafted[b] == 0`` are fixed-shape passengers and
+        accept nothing). Greedy only — every emitted token IS the
+        greedy target, which is the whole bitwise-parity argument.
+        Returns ``(greedy [B, K+1] int32, n_accepted [B] int32)``."""
         K = tokens.shape[1] - 1
-        greedy = jnp.argmax(rows, axis=-1).astype(jnp.int32)  # [K+1]
-        match = (greedy[:K] == tokens[0, 1:]) \
-            & (jnp.arange(K, dtype=jnp.int32) < n_drafted)
+        greedy = jnp.argmax(rows, axis=-1).astype(jnp.int32)  # [B, K+1]
+        match = (greedy[:, :K] == tokens[:, 1:]) \
+            & (jnp.arange(K, dtype=jnp.int32)[None, :]
+               < n_drafted[:, None])
         n_accepted = jnp.sum(
-            jnp.cumprod(match.astype(jnp.int32))).astype(jnp.int32)
+            jnp.cumprod(match.astype(jnp.int32), axis=1),
+            axis=1).astype(jnp.int32)
         return greedy, n_accepted
 
-    def _verify_impl(self, params, cache, tokens, slot, n_drafted,
-                     fault_bias):
+    def _verify_impl(self, params, cache, tokens, n_drafted, fault_bias):
         self.verify_traces += 1     # python body runs at trace time only
-        slot = jnp.asarray(slot, jnp.int32)
-        # the slot's committed length IS the verify offset on the
-        # contiguous layout (device state, exactly like decode)
-        offset = jax.lax.dynamic_index_in_dim(cache.lengths, slot,
-                                              keepdims=False)
-        k_slot, v_slot = cache.slot_view(slot)
+        K = tokens.shape[1] - 1
+        # per-row offsets ARE the committed device lengths on the
+        # contiguous layout (device state, exactly like decode); rows
+        # with n_drafted == 0 ride the fixed-shape batch — their writes
+        # are masked back out below, their outputs discarded by the host
+        offsets = cache.lengths[:self.slots]
         logits, (k2, v2) = self._model.apply(
             {"params": params}, tokens, train=False,
-            cache=(k_slot, v_slot), positions=offset[None])
-        rows = jnp.asarray(logits[0], jnp.float32) + fault_bias
-        finite = jnp.all(jnp.isfinite(rows))
+            cache=cache.front_view(self.slots), positions=offsets)
+        rows = jnp.asarray(logits, jnp.float32) \
+            + fault_bias[:, None, None]
+        finite = jnp.all(jnp.isfinite(rows), axis=(1, 2))     # [slots]
         greedy, n_accepted = self._accept_longest_prefix(rows, tokens,
                                                          n_drafted)
-        # commit exactly the accepted extent: the rejected tail's K/V
-        # is written but sits past the length — unreachable (attention
-        # masks by length) and overwritten write-then-attend by the
-        # slot's next step. Rollback is this length arithmetic; there
-        # is no cache mutation to undo.
-        cache = cache.write_slot(slot, k2, v2, offset + n_accepted + 1)
+        # commit ONLY the verifying rows whose padded window fits and
+        # that hold a committed prefix: a passenger row near max_len
+        # would have had its [K+1]-wide write clipped back over live
+        # K/V (the model's position safety net relocates, it does not
+        # drop), so its bytes are restored verbatim. verify_batch
+        # raises host-side before any active row can reach this mask
+        # (same contract as the paged path), so the in-program guard is
+        # defense-in-depth for raw _jit_verify callers only — it keeps
+        # an invalid window from corrupting the cache, never a public
+        # API outcome. For verifying rows the rejected tail's K/V sits
+        # past the committed length — unreachable (attention masks by
+        # length) and overwritten write-then-attend by the slot's next
+        # step; rollback is length arithmetic, no cache mutation to
+        # undo.
+        fits = (offsets > 0) & (offsets + K + 1 <= self.max_len)
+        verifying = (n_drafted > 0) & fits
+        mask = verifying[None, :, None, None, None]
+        k_old, v_old = cache.front_view(self.slots)
+        k2 = jnp.where(mask, jnp.asarray(k2, cache.dtype), k_old)
+        v2 = jnp.where(mask, jnp.asarray(v2, cache.dtype), v_old)
+        n_accepted = jnp.where(verifying, n_accepted, 0)
+        new_len = jnp.where(verifying, offsets + n_accepted + 1, offsets)
+        cache = cache.commit_front(k2, v2, new_len)
         return cache, greedy, n_accepted, finite
 
     # -------------------------------------------- compiled bodies (paged)
@@ -602,8 +783,8 @@ class Engine:
         cache = cache.replace(k=_scatter(cache.k, k_new),
                               v=_scatter(cache.v, v_new))
         last = jax.lax.dynamic_index_in_dim(logits[0], length - 1,
-                                            keepdims=False)        # [V]
-        last = jnp.asarray(last, jnp.float32)
+                                            keepdims=False)   # [V(/tp)]
+        last = self._gather_logits(jnp.asarray(last, jnp.float32))
         finite = jnp.all(jnp.isfinite(last))
         token = sample_tokens(last[None], temperature[None], key,
                               self.top_k)[0]
@@ -619,8 +800,9 @@ class Engine:
         cache = cache.replace(k=k2, v=v2)
         # sample at the last VALID row (see _chunk_impl)
         last = jax.lax.dynamic_index_in_dim(logits[0], n_valid - 1,
-                                            keepdims=False)        # [V]
-        last = jnp.asarray(last, jnp.float32) + fault_bias
+                                            keepdims=False)   # [V(/tp)]
+        last = self._gather_logits(jnp.asarray(last, jnp.float32)) \
+            + fault_bias
         finite = jnp.all(jnp.isfinite(last))
         token = sample_tokens(last[None], temperature[None], key,
                               self.top_k)[0]
@@ -638,31 +820,37 @@ class Engine:
         logits, (k2, v2) = self._model.apply(
             {"params": params}, last_tokens[:, None], train=False,
             cache=(cache.k, cache.v, page_table), positions=positions)
-        rows = jnp.asarray(logits[:, 0, :], jnp.float32) \
+        rows = self._gather_logits(jnp.asarray(logits[:, 0, :],
+                                               jnp.float32)) \
             + fault_bias[:, None]
         finite = jnp.all(jnp.isfinite(rows), axis=-1)         # [slots]
         tokens = sample_tokens(rows, temperature, key, self.top_k)
         return cache.replace(k=k2, v=v2), tokens, finite
 
-    def _paged_verify_impl(self, params, cache, tokens, pt_row, offset,
-                           n_drafted, fault_bias):
+    def _paged_verify_impl(self, params, cache, tokens, page_table,
+                           lengths, n_drafted, fault_bias):
         self.verify_traces += 1     # python body runs at trace time only
-        offset = jnp.asarray(offset, jnp.int32)
-        # unaligned_append: the [1, K+1] draft block lands at an
+        # unaligned_append: every row's [K+1] draft block lands at an
         # arbitrary mid-generation offset — per-position page scatters
-        # instead of the whole-page chunk write (the host grew the
-        # slot's table to cover offset + K + 1 before this call)
+        # instead of the whole-page chunk write (the host grew each
+        # verifying slot's table to cover offset + K + 1 before this
+        # call). Non-verifying rows arrive with ZEROED table rows and
+        # length 0 from verify_batch, so their fixed-shape writes land
+        # on the sentinel page and their (discarded) attention reads
+        # garbage — a live decode slot's pages are never touched by a
+        # verify batch it is not in.
         logits, (k2, v2) = self._model.apply(
             {"params": params}, tokens, train=False,
-            cache=(cache.k, cache.v, pt_row), positions=offset[None],
+            cache=(cache.k, cache.v, page_table), positions=lengths,
             unaligned_append=True)
         cache = cache.replace(k=k2, v=v2)
-        rows = jnp.asarray(logits[0], jnp.float32) + fault_bias
-        finite = jnp.all(jnp.isfinite(rows))
+        rows = self._gather_logits(jnp.asarray(logits, jnp.float32)) \
+            + fault_bias[:, None, None]
+        finite = jnp.all(jnp.isfinite(rows), axis=(1, 2))     # [slots]
         greedy, n_accepted = self._accept_longest_prefix(rows, tokens,
                                                          n_drafted)
         # lengths are host state on the paged layout: the rollback (the
-        # host-side length decrement) happens in verify_step after it
+        # host-side length arithmetic) happens in verify_batch after it
         # reads n_accepted — the rejected tail's pages stay allocated
         # to the slot, their K/V unreachable behind the length
         return cache, greedy, n_accepted, finite
@@ -1112,94 +1300,194 @@ class Engine:
                                        n_active)
         return out
 
-    def verify_step(self, slot: int, last_token: int,
-                    drafts: Sequence[int], offset: int, *,
-                    fault_bias: float = 0.0):
-        """One speculative draft-and-verify step for ``slot``: score
-        ``[last_token, d_1 .. d_K]`` in the compiled ``[1, K+1]`` verify
-        program at cache position ``offset`` (the slot's committed
-        length — the position ``last_token``'s K/V will be written at,
-        exactly where a plain decode step would write it) and return
-        ``(tokens, n_accepted)``: ``tokens`` [K+1] int32 are the
-        program's greedy targets, of which ``tokens[:n_accepted + 1]``
-        are this step's emitted output (the accepted drafts — equal to
-        their targets by the acceptance rule — plus the bonus/greedy
-        token at the first mismatch). Greedy-only: speculation verifies
-        against argmax, so the scheduler routes sampled requests
-        through plain decode.
+    def verify_batch(self, drafts, *, fault_bias=None, offsets=None):
+        """One speculative draft-and-verify step for EVERY verifying
+        slot at once: ``drafts`` maps ``slot -> (last_token,
+        draft_tokens)`` and the whole map is scored by the ONE compiled
+        ``[slots, K+1]`` verify program — B verify-eligible slots share
+        one program invocation instead of B sequential calls (the same
+        fixed-shape discipline as the decode step: slots not in the map
+        ride along as padding — their cache bytes are provably
+        untouched — and that waste is the price of one executable).
 
-        Fewer than ``draft_len`` drafts are padded up to the fixed
-        program shape and excluded from acceptance (one executable for
-        every draft length — drafting never retraces). The caller must
-        leave room for the full padded window: ``offset + draft_len + 1
-        <= max_len`` (and, under scheduler admission, within the
-        request's reserved page budget — the scheduler's gate).
+        Each verifying row embeds ``[last_token, d_1 .. d_K]`` at the
+        slot's committed length (exactly where a plain decode step
+        would write), runs shifted-causal attention, and computes
+        ACCEPT-LONGEST-PREFIX in-program. Returns ``(tokens,
+        n_accepted)``: ``tokens`` [slots, K+1] int32 greedy targets —
+        row ``s``'s ``tokens[s, :n_accepted[s] + 1]`` is that slot's
+        emitted output — and ``n_accepted`` [slots] int32 (0 on
+        non-verifying rows). Greedy-only; fewer than ``draft_len``
+        drafts per row are padded to the fixed shape and excluded from
+        acceptance. Every verifying slot needs ``0 < offset`` and
+        ``offset + draft_len + 1 <= max_len`` (the scheduler's endgame
+        gate) — violated windows raise HERE, on both layouts, before
+        anything mutates (the contiguous check reads the device
+        lengths: a sync, priced into the parity-oracle path — a
+        silently-masked row would return ``n_accepted = 0`` with
+        nothing committed, indistinguishable from a real zero-accept
+        verify, and the caller would emit a token whose K/V never
+        landed).
 
-        ``fault_bias`` is the chaos harness's scalar injection operand
-        (0.0 in production — value-identical; NaN/Inf makes the fused
-        in-program guard fire for real). The verdict lands in
-        :attr:`last_verify_finite`; a False verdict means every
-        returned token is garbage — quarantine, don't emit.
+        ``offsets`` (optional ``{slot: expected_offset}``) cross-checks
+        the caller's bookkeeping against each verifying slot's
+        committed length and raises on drift — the scheduler passes its
+        computed offsets so scheduler-vs-engine divergence stays a loud
+        error, exactly as the per-slot path always guaranteed.
+
+        ``fault_bias`` ([slots] float, default all-zero) is the chaos
+        harness's per-row injection operand. Per-slot verdicts land in
+        :attr:`last_verify_finite_slots` (non-verifying rows always
+        read True); a False verdict means that row's tokens are garbage
+        — quarantine that slot, don't emit.
         """
         if self.spec is None:
             raise RuntimeError(
-                "verify_step needs an engine built with "
-                "spec=SpecConfig(...) — the verify program's [1, K+1] "
-                "shape is fixed at construction")
+                "verify_batch needs an engine built with "
+                "spec=SpecConfig(...) — the verify program's "
+                "[slots, K+1] shape is fixed at construction")
+        if not drafts:
+            raise ValueError("verify_batch needs at least one "
+                             "verifying slot (empty drafts are the "
+                             "plain-decode fallback)")
         K = self.spec.draft_len
-        n = len(drafts)
-        if not 1 <= n <= K:
-            raise ValueError(f"draft length {n} not in [1, "
-                             f"draft_len={K}] (an empty draft is the "
-                             "plain-decode fallback, not a verify)")
-        if not 0 <= slot < self.slots:
-            raise ValueError(f"slot {slot} not in [0, {self.slots})")
+        tokens = np.zeros((self.slots, K + 1), np.int32)
+        n_drafted = np.zeros(self.slots, np.int32)
+        for slot, (last_token, d) in drafts.items():
+            slot = int(slot)
+            if not 0 <= slot < self.slots:
+                raise ValueError(f"slot {slot} not in [0, {self.slots})")
+            n = len(d)
+            if not 1 <= n <= K:
+                raise ValueError(f"draft length {n} not in [1, "
+                                 f"draft_len={K}] (an empty draft is "
+                                 "the plain-decode fallback, not a "
+                                 "verify)")
+            tokens[slot, 0] = int(last_token)
+            tokens[slot, 1:1 + n] = np.asarray(d, np.int32)
+            n_drafted[slot] = n
+        active = n_drafted > 0
+        if fault_bias is None:
+            fault_bias = np.zeros(self.slots, np.float32)
+        else:
+            fault_bias = np.asarray(fault_bias, np.float32)
+            if fault_bias.shape != (self.slots,):
+                raise ValueError(f"fault_bias {fault_bias.shape} must "
+                                 f"be [{self.slots}]")
+        # validate EVERY verifying slot's window host-side, on BOTH
+        # layouts, before anything mutates: a masked row would return
+        # n_accepted=0 with nothing committed — indistinguishable from
+        # a real zero-accept verify, so the caller would emit a bonus
+        # token whose K/V never landed. The contiguous layout keeps
+        # lengths on device, so this read is a device sync — an
+        # acceptable price on the parity-oracle path for the same
+        # loud-failure contract the paged path has always had.
+        lens = self._host_len if self.paged \
+            else np.asarray(self.cache.lengths)[:self.slots]
+        for s in np.flatnonzero(active):
+            off = int(lens[s])
+            if not 0 < off or off + K + 1 > self.max_len:
+                raise ValueError(
+                    f"verify window [{off}, {off + K + 1}) of slot "
+                    f"{s} needs a committed prefix and must fit "
+                    f"max_len={self.max_len}")
+            if offsets is not None and s in offsets \
+                    and int(offsets[s]) != off:
+                raise ValueError(
+                    f"verify offset {int(offsets[s])} disagrees with "
+                    f"slot {s}'s committed length {off}")
+        t0 = time.perf_counter()
+        if self.paged:
+            for s in np.flatnonzero(active):
+                # the write extent must be backed by pages BEFORE the
+                # program runs (reservation at admission guarantees the
+                # pool can cover it when the scheduler gated the call)
+                self._grow_slot(s, self.pool.pages_for(
+                    int(self._host_len[s]) + K + 1))
+            # non-verifying rows: sentinel-only table + offset 0, so
+            # their fixed-shape writes can never land on a live page
+            vt = np.where(active[:, None], self._page_table, 0)
+            vlen = np.where(active, self._host_len, 0)
+            self.cache, out, n_accepted, finite = self._jit_verify(
+                self.params, self.cache, jnp.asarray(tokens),
+                jnp.asarray(vt.astype(np.int32)),
+                jnp.asarray(vlen.astype(np.int32)),
+                jnp.asarray(n_drafted), jnp.asarray(fault_bias))
+        else:
+            self.cache, out, n_accepted, finite = self._jit_verify(
+                self.params, self.cache, jnp.asarray(tokens),
+                jnp.asarray(n_drafted), jnp.asarray(fault_bias))
+        out = np.asarray(out)           # device sync: step latency
+        n_accepted = np.asarray(n_accepted, np.int32)
+        finite = np.asarray(finite, bool)
+        if self.paged:
+            # rollback IS this assignment, per slot: the rejected tail's
+            # K/V sits at [offset + m + 1, offset + K + 1), past the
+            # committed length — unreachable, and overwritten
+            # write-then-attend by the slot's next decode/verify step
+            for s in np.flatnonzero(active):
+                self._host_len[s] = int(self._host_len[s]) \
+                    + int(n_accepted[s]) + 1
+        self.last_verify_finite_slots = np.where(active, finite, True)
+        # keep the long-standing scalar attribute live too: a caller
+        # written against the pre-batching API must not read a stale
+        # True past a batched verify that flagged a row
+        self.last_verify_finite = bool(self.last_verify_finite_slots
+                                       .all())
+        bad = int(np.sum(active & ~finite))
+        if bad:
+            self._count_nonfinite(bad)
+        emitted = int(np.sum(n_accepted[active])) + int(active.sum())
+        self.tokens_generated += emitted
+        if self._registry is not None:
+            self._registry.observe("serving.spec.verify_s",
+                                   time.perf_counter() - t0)
+            self._registry.counter_inc("serving.spec.verify_slots",
+                                       int(active.sum()))
+            self._registry.counter_inc("serving.tokens_generated",
+                                       emitted)
+        return out, n_accepted
+
+    def verify_step(self, slot: int, last_token: int,
+                    drafts: Sequence[int], offset: int, *,
+                    fault_bias: float = 0.0):
+        """One speculative draft-and-verify step for a single ``slot``
+        — a thin wrapper routing through the SAME compiled
+        ``[slots, K+1]`` batched program as :meth:`verify_batch` (one
+        executable either way; the other rows ride along as padding
+        with their cache bytes untouched). Returns ``(tokens,
+        n_accepted)`` for the slot: ``tokens`` [K+1] int32 greedy
+        targets, ``tokens[:n_accepted + 1]`` the emitted output.
+        ``offset`` must equal the slot's committed length and the
+        padded window must fit: ``offset + draft_len + 1 <= max_len``.
+        The finiteness verdict lands in :attr:`last_verify_finite`."""
+        if self.spec is None:
+            raise RuntimeError(
+                "verify_step needs an engine built with "
+                "spec=SpecConfig(...) — the verify program's "
+                "[slots, K+1] shape is fixed at construction")
+        # draft-length and slot-range validation live in verify_batch
+        # (one copy of the contract); only the CALLER-offset window
+        # check is this wrapper's own — it validates the argument
+        # itself, where verify_batch validates the committed length
+        K = self.spec.draft_len
         offset = int(offset)
         if not 0 < offset or offset + K + 1 > self.max_len:
             raise ValueError(
                 f"verify window [{offset}, {offset + K + 1}) needs a "
                 f"committed prefix and must fit max_len={self.max_len}")
-        tokens = np.zeros((1, K + 1), np.int32)
-        tokens[0, 0] = int(last_token)
-        tokens[0, 1:1 + n] = np.asarray(drafts, np.int32)
-        t0 = time.perf_counter()
-        if self.paged:
-            if offset != int(self._host_len[slot]):
-                raise ValueError(
-                    f"verify offset {offset} disagrees with slot "
-                    f"{slot}'s committed length "
-                    f"{int(self._host_len[slot])}")
-            # the write extent must be backed by pages BEFORE the
-            # program runs (reservation at admission guarantees the
-            # pool can cover it when the scheduler gated the call)
-            self._grow_slot(slot, self.pool.pages_for(offset + K + 1))
-            self.cache, out, n_accepted, finite = self._jit_verify(
-                self.params, self.cache, jnp.asarray(tokens),
-                jnp.asarray(self._page_table[slot:slot + 1]),
-                np.int32(offset), np.int32(n), np.float32(fault_bias))
-        else:
-            self.cache, out, n_accepted, finite = self._jit_verify(
-                self.params, self.cache, jnp.asarray(tokens),
-                np.int32(slot), np.int32(n), np.float32(fault_bias))
-        out = np.asarray(out)           # device sync: step latency
-        m = int(n_accepted)
-        if self.paged:
-            # rollback IS this assignment: the rejected tail's K/V sits
-            # at [offset + m + 1, offset + K + 1), past the committed
-            # length — unreachable, and overwritten write-then-attend
-            # by the slot's next decode/verify step
-            self._host_len[slot] = offset + m + 1
-        self.last_verify_finite = bool(finite)
-        if not self.last_verify_finite:
-            self._count_nonfinite(1)
-        emitted = m + 1
-        self.tokens_generated += emitted
-        if self._registry is not None:
-            self._registry.observe("serving.spec.verify_s",
-                                   time.perf_counter() - t0)
-            self._registry.counter_inc("serving.tokens_generated",
-                                       emitted)
-        return out, m
+        if not 0 <= slot < self.slots:
+            raise ValueError(f"slot {slot} not in [0, {self.slots})")
+        bias = np.zeros(self.slots, np.float32)
+        bias[slot] = fault_bias
+        # verify_batch validates the committed-length window and the
+        # offset cross-check (both layouts) before anything mutates
+        out, n_accepted = self.verify_batch(
+            {slot: (last_token, list(drafts))}, fault_bias=bias,
+            offsets={slot: offset})
+        self.last_verify_finite = bool(
+            self.last_verify_finite_slots[slot])
+        return out[slot], int(n_accepted[slot])
 
     def _count_nonfinite(self, n: int) -> None:
         """One quarantine-worthy non-finite sampling event per affected
@@ -1230,6 +1518,7 @@ class Engine:
         """Swap the telemetry registry (e.g. after a compile-warmup pass,
         so first-trace latency never poisons the serving histograms)."""
         self._registry = registry
+        self._emit_tp_gauges()
 
     def reset(self, clear_prefixes: bool = False) -> None:
         """Zero the serving-slot lengths (slot table wipe; K/V left in

@@ -236,6 +236,23 @@ class KVCache:
             jnp.where(grow, front + 1, front))
         return self.replace(k=k, v=v, lengths=lengths)
 
+    def commit_front(self, k_front, v_front, front_lengths) -> "KVCache":
+        """:meth:`advance_front`'s general sibling for the batched
+        speculative verify: commit the model-returned front stacks and
+        SET the front rows' lengths to ``front_lengths`` (``[n]`` int32,
+        already computed in-program as ``offset + n_accepted + 1`` for
+        verifying rows and the unchanged old length for the rest).
+        Prefix-pool rows past the front are untouched."""
+        n = k_front.shape[1]
+        start = (jnp.int32(0),) * 5
+        k = jax.lax.dynamic_update_slice(
+            self.k, jnp.asarray(k_front, self.k.dtype), start)
+        v = jax.lax.dynamic_update_slice(
+            self.v, jnp.asarray(v_front, self.v.dtype), start)
+        lengths = self.lengths.at[:n].set(
+            jnp.asarray(front_lengths, jnp.int32))
+        return self.replace(k=k, v=v, lengths=lengths)
+
     def advance(self, k, v, active) -> "KVCache":
         """Absorb a decode step: ``k``/``v`` are the model-returned
         stacks (each slot's new token written at its old length) and

@@ -41,13 +41,14 @@ beats:
    host-side prompt-lookup drafter (:mod:`~apex_tpu.serving
    .speculative`) proposes up to ``K`` next tokens from n-gram matches
    over ``prompt + generated``;
-5. **verify-or-decode** — slots with a non-empty draft take one
-   compiled ``[1, K+1]`` verify step (:meth:`Engine.verify_step`:
-   accept-longest-prefix in-program, up to ``K + 1`` tokens emitted
-   per step, greedy output bitwise identical to plain decode);
-   everything else — empty drafts, sampled requests, requests within
-   ``K`` tokens of their budget — falls back to the ordinary
-   fixed-shape decode step over the remaining slots.
+5. **verify-or-decode** — every slot with a non-empty draft shares ONE
+   compiled ``[slots, K+1]`` batched verify call
+   (:meth:`Engine.verify_batch`: accept-longest-prefix in-program per
+   row, up to ``K + 1`` tokens emitted per slot-step, greedy output
+   bitwise identical to plain decode; B verify-eligible slots cost one
+   program invocation, not B); everything else — empty drafts, sampled
+   requests, requests within ``K`` tokens of their budget — falls back
+   to the ordinary fixed-shape decode step over the remaining slots.
    ``speculative=False`` (the default) skips the draft phase entirely
    and keeps today's path as the measurable baseline.
 
@@ -773,21 +774,27 @@ class Scheduler:
     def _spec_tick(self, tick: int):
         """The draft → verify half of a speculative heartbeat: for each
         greedy decoding slot, prompt-lookup a draft over ``prompt +
-        generated`` and — when non-empty and within budget — run one
-        compiled verify step, emitting the accepted prefix plus the
-        bonus token. Returns ``(verified_slots, calls, emitted)``:
-        slots that took a verify step this tick (excluded from the
-        decode batch — they already advanced), verify calls run, and
+        generated``; every slot that drafted something (and is within
+        budget) then shares ONE compiled ``[slots, K+1]`` batched
+        verify call (:meth:`Engine.verify_batch` — B verify-eligible
+        slots per program invocation instead of B sequential calls),
+        each emitting its accepted prefix plus the bonus token. Returns
+        ``(verified_slots, slot_steps, emitted)``: slots that took a
+        verify step this tick (excluded from the decode batch — they
+        already advanced), per-SLOT verify sequence-steps run, and
         tokens emitted. Containment-wrapped exactly like chunk/decode:
-        a transient failure or non-finite verdict quarantines only the
-        victim. Slots that draft nothing, sampled requests, and
-        requests within ``draft_len`` tokens of their budget (the
+        a transient failure during the shared call quarantines the
+        slots that were IN it (the decode batch and prefilling slots
+        never see it); a per-row non-finite verdict quarantines only
+        that row's request. Slots that draft nothing, sampled requests,
+        and requests within ``draft_len`` tokens of their budget (the
         padded verify window must stay inside the admission page
         reservation and ``max_len``) fall through to plain decode."""
         eng = self.engine
         cfg = eng.spec
         verified: set = set()
         calls = emitted = 0
+        pending = []            # (slot, request, draft, offset)
         for slot, r in enumerate(self._running):
             if r is None or r.status != "running":
                 continue
@@ -809,29 +816,54 @@ class Scheduler:
             draft = draft_tokens(list(r.prompt) + r.output_tokens, cfg)
             if not draft:
                 continue    # nothing to verify: plain-decode fallback
-            try:
-                if self.fault_plan is not None:
-                    # the exception site raises INSTEAD of the call, so
-                    # it must fire before the nonfinite spec is
-                    # consumed — a co-scheduled nonfinite stays live
-                    # for the retry instead of being counted as
-                    # delivered to a call that never ran
-                    self.fault_plan.maybe_raise("verify", tick)
-                bias = 0.0
-                if self.fault_plan is not None:
+            pending.append((slot, r, draft, offset))
+        if not pending:
+            return verified, calls, emitted
+        try:
+            if self.fault_plan is not None:
+                # the exception site raises INSTEAD of the call, so it
+                # must fire before the nonfinite spec is consumed — a
+                # co-scheduled nonfinite stays live for the retry
+                # instead of being counted as delivered to a call that
+                # never ran
+                self.fault_plan.maybe_raise("verify", tick)
+            bias = np.zeros(eng.slots, np.float32)
+            if self.fault_plan is not None:
+                for slot, _r, _d, _o in pending:
                     taken = self.fault_plan.take_nonfinite(tick, slot)
                     if taken is not None:
-                        bias = taken
-                toks, m = eng.verify_step(
-                    slot, int(self._last_tokens[slot]), draft, offset,
-                    fault_bias=bias)
-            except Exception as e:  # noqa: BLE001 — containment edge
-                self._count_transient()
-                self._quarantine(r, slot, f"{type(e).__name__}: {e}")
-                continue
-            calls += 1
-            if not eng.last_verify_finite:
-                # the in-program guard flagged the verify logits: every
+                        bias[slot] = taken
+            # offsets= cross-checks our bookkeeping against the
+            # engine's committed lengths — drift raises loudly instead
+            # of silently diverging tokens (the old per-slot path's
+            # guarantee, kept through the batching)
+            toks, n_acc = eng.verify_batch(
+                {slot: (int(self._last_tokens[slot]), draft)
+                 for slot, _r, draft, _o in pending},
+                fault_bias=bias,
+                offsets={slot: off for slot, _r, _d, off in pending})
+        except ValueError:
+            # verify_batch's ValueErrors are all pre-mutation
+            # validation (slot range, draft length, the offsets
+            # cross-check): deterministic scheduler-vs-engine contract
+            # bugs, not runtime faults — propagate loudly instead of
+            # quarantining N-1 healthy batchmates over untouched
+            # engine state
+            raise
+        except Exception as e:  # noqa: BLE001 — containment edge
+            # the shared call produced no tokens: every slot that was
+            # in it absorbs one retry (they share the blast radius the
+            # way the decode batch shares a decode-site fault); the
+            # decode batch and prefilling slots keep their progress
+            self._count_transient()
+            desc = f"{type(e).__name__}: {e}"
+            for slot, r, _d, _o in pending:
+                self._quarantine(r, slot, desc)
+            return verified, calls, emitted
+        finite = eng.last_verify_finite_slots
+        for slot, r, draft, offset in pending:
+            if not finite[slot]:
+                # the in-program guard flagged this row's logits: every
                 # returned token is garbage — quarantine the request
                 # (slot, pages, reservation freed); batchmates and the
                 # decode batch never see it. Acceptance stats are NOT
@@ -840,6 +872,8 @@ class Scheduler:
                 # bench's p50/p99 read
                 self._quarantine(r, slot, "non-finite verify logits")
                 continue
+            m = int(n_acc[slot])
+            calls += 1
             r.spec_drafted += len(draft)
             r.spec_accepted += m
             if self.registry is not None:
@@ -855,7 +889,7 @@ class Scheduler:
             # is the greedy stream, discovered several tokens per step
             # (m + 1 <= owed by the endgame gate: nothing truncates)
             for i in range(m + 1):
-                tok = int(toks[i])
+                tok = int(toks[slot, i])
                 r.output_tokens.append(tok)
                 self._last_tokens[slot] = tok
                 emitted += 1

@@ -34,13 +34,18 @@ BENCH_* trajectory (ROADMAP's "Recent" gap), plus a nested ``chaos``
 sub-object (BENCH_SERVING_CHAOS=0 to drop it): goodput under a seeded
 fault-injection schedule vs the fault-free rate, failed/requeued
 counts and ``token_mismatched_requests`` (expected 0) via
-``bench_serving.chaos_stats``, and a nested ``speculative``
+``bench_serving.chaos_stats``, a nested ``speculative``
 sub-object (BENCH_SERVING_SPEC=0 to drop it): draft-and-verify
 acceptance rate and tokens-per-slot-step vs plain decode with
 ``token_mismatched_requests`` (expected 0, bitwise) via
-``bench_serving.spec_stats``. Failure-isolated at every layer: a
-broken serving stack puts {"error": ...} there, never kills the
-ResNet row.
+``bench_serving.spec_stats``, and a nested ``tensor_parallel``
+sub-object (BENCH_SERVING_TP=0 to drop it; BENCH_SERVING_TP=N sizes
+the mesh): tp=1 vs tp=N CPU device emulation — per-shard KV HBM
+bytes, collective inventory, ``token_mismatched_requests`` (expected
+0) — run as a subprocess because the mesh leg must force emulated CPU
+devices before any backend initializes. Failure-isolated at every
+layer: a broken serving stack puts {"error": ...} there, never kills
+the ResNet row.
 """
 
 from __future__ import annotations
@@ -176,6 +181,7 @@ def _serving_leg() -> dict:
             "token_mismatched_requests", "model")}
         out["chaos"] = _serving_chaos_leg()
         out["speculative"] = _serving_spec_leg()
+        out["tensor_parallel"] = _serving_tp_leg()
         return out
     except KeyboardInterrupt:
         raise
@@ -227,6 +233,51 @@ def _serving_spec_leg() -> dict:
             "tokens_per_step_plain", "multi_turn_acceptance_rate",
             "multi_turn_tokens_per_step", "token_mismatched_requests",
             "spec_k", "verify_traces")}
+    except KeyboardInterrupt:
+        raise
+    except BaseException as e:  # noqa: BLE001 — the row must not die here
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def _serving_tp_leg() -> dict:
+    """The tensor-parallel trajectory sub-row: the bench_serving.py
+    --tensor-parallel smoke (tp=1 vs BENCH_SERVING_TP-shard CPU device
+    emulation: tokens/s, per-shard KV HBM bytes, collective inventory,
+    token_mismatched_requests — expected 0). Runs as a SUBPROCESS, not
+    in-process like its siblings: the leg must force the CPU backend
+    with emulated devices BEFORE any jax client initializes, and this
+    process's backend is long since live (on axon it is the one real
+    TPU). BENCH_SERVING_TP=0 drops it; failure-isolated like its
+    siblings — a broken (or timed-out) mesh layer yields
+    {"error": ...} here, never a lost serving (or ResNet) row."""
+    if _env_int("BENCH_SERVING_TP", "2") == 0:
+        return {"skipped": True}
+    try:
+        import subprocess
+        import sys
+
+        root = os.path.dirname(os.path.abspath(__file__))
+        env = dict(os.environ)
+        # CPU emulation + smoke geometry; any exported BENCH_SERVING_*
+        # knob still wins inside the child (env-beats-smoke)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("XLA_FLAGS", None)
+        proc = subprocess.run(
+            [sys.executable, os.path.join(root, "bench_serving.py"),
+             "--tensor-parallel"],
+            capture_output=True, text=True, env=env, cwd=root,
+            timeout=600)
+        lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
+        summary = json.loads(lines[-1])      # guard contract: last line
+        if "error" in summary:
+            return {"error": summary["error"],
+                    "transient": summary.get("transient", False)}
+        return {k: summary[k] for k in (
+            "value", "unit", "baseline_tokens_per_s", "tp",
+            "hbm_bytes_per_shard", "hbm_bytes_per_shard_tp1",
+            "hbm_bytes_per_shard_reduction_pct", "psums_per_program",
+            "all_gathers_per_program", "token_mismatched_requests",
+            "model", "emulated_devices")}
     except KeyboardInterrupt:
         raise
     except BaseException as e:  # noqa: BLE001 — the row must not die here

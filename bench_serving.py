@@ -116,6 +116,24 @@ run, expected 0 **bitwise** on every backend (the containment
 guarantee, not a numerics regime claim). Defaults to a smoke geometry
 (8 requests × 12 tokens); the env knobs resize it.
 
+``--tensor-parallel`` runs the mesh leg on CPU DEVICE EMULATION (the
+leg forces ``JAX_PLATFORMS=cpu`` + ``--xla_force_host_platform_
+device_count`` before any backend initializes — it is an exactness +
+capacity-accounting measurement by definition; tokens/s over emulated
+devices carries no silicon signal): the SAME greedy stream served by
+the verbatim single-chip engine (``mesh=None`` — the honest tp=1) and
+by ``Engine(mesh=<BENCH_SERVING_TP shards>)``. One row per mode plus a
+final line whose payoff fields are tokens/s both modes,
+``hbm_bytes_per_shard`` (the pool's heads-axis split: per-chip KV HBM
+is ``1/tp`` of the single-chip engine's — the claim that lets a model
+of real size serve at all), the per-program collective inventory
+(``psums_per_program`` = 2/block, ``all_gathers_per_program`` = 1 —
+the HLO-pinned numbers), and ``token_mismatched_requests`` (greedy;
+the expected reading is **0** — tp=1 is pinned bitwise and tp>1
+token-exact by tests/L0/test_sharding.py). Defaults to a smoke
+geometry; env knobs resize it (env-beats-smoke), ``BENCH_SERVING_TP``
+sets the shard count (default 2).
+
 Wrapped in ``guard_bench_main`` — EVERY outcome (backend init failure,
 OOM, bad env) still ends in a parseable JSON line.
 """
@@ -124,6 +142,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import sys
 import time
 
@@ -135,6 +154,7 @@ SHARED_METRIC = "serving_shared_prefix_tokens_per_sec"
 PAGED_METRIC = "serving_paged_pool_tokens_per_sec"
 CHAOS_METRIC = "serving_chaos_goodput_tokens_per_sec"
 SPEC_METRIC = "serving_speculative_tokens_per_sec"
+TP_METRIC = "serving_tensor_parallel_tokens_per_sec"
 
 # Literal defaults at import time; the BENCH_SERVING_* env overrides are
 # parsed by _load_env() INSIDE each guarded main, so a malformed value
@@ -180,6 +200,14 @@ CHAOS_SMOKE = {"REQUESTS": 8, "NEW_TOKENS": 12, "WINDOWS": 1}
 # path) and its smoke preset — the leg serves TWO streams twice each
 SPEC_K = 4
 SPEC_SMOKE = {"REQUESTS": 6, "NEW_TOKENS": 16, "WINDOWS": 1}
+# --tensor-parallel leg: shards (heads/vocab/MLP-inner must divide —
+# the engine rejects ragged geometry loudly) and its smoke preset: the
+# leg serves the stream TWICE (mesh=None then the mesh) and CPU
+# emulation pays tp x the per-step dispatch, so it is sized small
+TP = 2
+TP_SMOKE = {"SIZE": "tiny", "VOCAB": 512, "SLOTS": 4, "MAX_LEN": 128,
+            "PREFILL_LEN": 32, "REQUESTS": 6, "NEW_TOKENS": 12,
+            "WINDOWS": 1}
 
 _ENV_KNOBS = {
     "VOCAB": "BENCH_SERVING_VOCAB", "SLOTS": "BENCH_SERVING_SLOTS",
@@ -197,6 +225,7 @@ _ENV_KNOBS = {
     "PAGED_PROMPT": "BENCH_SERVING_PAGED_PROMPT",
     "FAULT_PCT": "BENCH_SERVING_FAULT_PCT",
     "SPEC_K": "BENCH_SERVING_SPEC_K",
+    "TP": "BENCH_SERVING_TP",
 }
 
 
@@ -985,18 +1014,24 @@ def spec_stats():
             accepted = snap["counters"].get("serving.spec.accepted", 0)
             acc_hist = snap["histograms"].get(
                 "serving.spec.acceptance_rate", {})
-            verify_calls = snap["histograms"].get(
+            # batched verify: serving.spec.verify_s counts DISPATCHES
+            # (one [slots, K+1] call per heartbeat with >=1 eligible
+            # slot); the per-SLOT sequence-step arithmetic below wants
+            # slot-steps, which the engine counts separately
+            verify_dispatches = snap["histograms"].get(
                 "serving.spec.verify_s", {}).get("count", 0)
+            verify_slots = snap["counters"].get(
+                "serving.spec.verify_slots", 0)
             decode_steps = snap["counters"].get("serving.decode.steps",
                                                 0)
             emitted = sum(len(r.output_tokens) for r in reqs)
             # per-SLOT sequence steps: each decode-emitted token is one
             # slot advancing one step (batch width is not speculation —
-            # plain decode must read exactly 1.0), each verify call is
+            # plain decode must read exactly 1.0), each verified slot is
             # one slot-step emitting n_accepted + 1 tokens
-            spec_emitted = int(accepted) + int(verify_calls)
+            spec_emitted = int(accepted) + int(verify_slots)
             decode_emitted = emitted - len(reqs) - spec_emitted
-            seq_steps = verify_calls + decode_emitted
+            seq_steps = verify_slots + decode_emitted
             row = {
                 "metric": f"{SPEC_METRIC}.{stream}.{mode}",
                 "value": round(rate, 2),
@@ -1007,7 +1042,8 @@ def spec_stats():
                 if drafted else 0.0,
                 "acceptance_p50": round(acc_hist.get("p50", 0.0), 4),
                 "acceptance_p99": round(acc_hist.get("p99", 0.0), 4),
-                "verify_calls": int(verify_calls),
+                "verify_calls": int(verify_dispatches),
+                "verify_slot_steps": int(verify_slots),
                 "decode_steps": int(decode_steps),
                 # the per-request prefill token is excluded from the
                 # numerator: it rides the chunk program either way
@@ -1066,6 +1102,154 @@ def main_spec():
     print(json.dumps(summary))
 
 
+def _ensure_cpu_devices(n: int) -> None:
+    """Force the CPU backend with >= ``n`` emulated devices BEFORE the
+    first backend initialization (XLA reads ``XLA_FLAGS`` when a client
+    is created, so this works even though jax was imported by the
+    guard). The TP leg is CPU device emulation by definition — its
+    claims are exactness and per-shard HBM accounting, never emulated
+    tokens/s. A backend that initialized too early fails loudly: run
+    the leg standalone (or via bench.py's subprocess embedding)."""
+    import jax
+
+    want = max(int(n), 1)
+    flags = os.environ.get("XLA_FLAGS", "")
+    pat = r"--xla_force_host_platform_device_count=(\d+)"
+    m = re.search(pat, flags)
+    if m is None:
+        os.environ["XLA_FLAGS"] = (
+            flags
+            + f" --xla_force_host_platform_device_count={want}").strip()
+    elif int(m.group(1)) < want:
+        # a pre-existing smaller count would starve the mesh — raise
+        # it (harmless if the backend is already live: the loud check
+        # below still catches that case)
+        os.environ["XLA_FLAGS"] = re.sub(
+            pat, f"--xla_force_host_platform_device_count={want}",
+            flags)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    jax.config.update("jax_platforms", "cpu")
+    have = len(jax.devices())
+    if have < n:
+        raise SystemExit(
+            f"tensor-parallel leg needs {n} CPU devices, got {have}: "
+            "the jax backend initialized before XLA_FLAGS could take "
+            "effect — run `python bench_serving.py --tensor-parallel` "
+            "standalone (bench.py embeds it as a subprocess for this "
+            "reason)")
+
+
+def _serve_tp(engine, seed: int):
+    """WINDOWS measured windows (plus compile warmup) of the standard
+    variable-length greedy stream on one engine; identical seed per
+    mode so the two modes' outputs compare request-for-request."""
+    from apex_tpu import serving, telemetry
+
+    reg = telemetry.MetricsRegistry()
+    rng = np.random.default_rng(seed)
+    rates, all_reqs = [], []
+    for w in range(WINDOWS + 1):
+        engine.reset()
+        engine.set_registry(reg if w else None)
+        sched = serving.Scheduler(engine, max_queue=max(REQUESTS, 1),
+                                  registry=reg if w else None,
+                                  chunk_budget=CHUNK_BUDGET)
+        reqs = _requests(rng)
+        t0 = time.perf_counter()
+        tok0 = engine.tokens_generated
+        done = sched.run(reqs)
+        dt = time.perf_counter() - t0
+        assert len(done) == REQUESTS
+        if w > 0:
+            rates.append((engine.tokens_generated - tok0) / dt)
+            all_reqs.extend(reqs)
+    engine.set_registry(None)
+    return _median(rates), all_reqs, reg.snapshot()
+
+
+def tp_stats():
+    """The --tensor-parallel measurement, reusable by bench.py's
+    serving trajectory leg (via subprocess — the parent's backend is
+    already initialized): the SAME greedy stream on the verbatim
+    single-chip engine (mesh=None, the honest tp=1 baseline) and on
+    ``Engine(mesh=<TP shards>)``. Headline fields: tokens/s both modes
+    (CPU emulation — a plumbing/capacity signal, judge throughput on
+    silicon), per-shard KV HBM bytes (the heads-axis split's 1/tp
+    claim), the per-program collective inventory, and
+    token_mismatched_requests (expected 0: tp=1 is bitwise-pinned,
+    tp>1 token-exact)."""
+    import jax
+    from jax.sharding import Mesh
+
+    from apex_tpu.serving import sharding
+
+    _ensure_cpu_devices(TP)
+    rows, outputs = {}, {}
+    for mode in ("tp1", "sharded"):
+        mesh = None if mode == "tp1" else \
+            Mesh(np.array(jax.devices()[:TP]), ("tp",))
+        engine = _build_engine(mesh=mesh)
+        rate, reqs, snap = _serve_tp(engine, seed=13)
+        rows[mode] = {
+            "metric": f"{TP_METRIC}.{mode}",
+            "value": round(rate, 2),
+            "unit": "tokens/s",
+            "tp": engine.tp,
+            "hbm_bytes_per_shard": engine.cache.nbytes() // engine.tp,
+            "pool_mib": round(engine.cache.nbytes() / 2**20, 2),
+            "compiled_programs": engine.compiled_programs,
+            "decode_step_p50_ms": round(
+                snap["histograms"].get("serving.decode.step_s",
+                                       {}).get("p50", 0.0) * 1e3, 3),
+        }
+        if mesh is not None:
+            coll = sharding.expected_collectives(
+                int(engine.cache.layers))
+            rows[mode]["psums_per_program"] = coll["all_reduce"]
+            rows[mode]["all_gathers_per_program"] = coll["all_gather"]
+            rows[mode]["tp_gauges"] = {
+                k: v for k, v in snap["gauges"].items()
+                if k.startswith("serving.tp.")}
+        outputs[mode] = [list(r.output_tokens) for r in reqs]
+    mismatches = sum(a != b for a, b in zip(outputs["sharded"],
+                                            outputs["tp1"]))
+    t1, sh = rows["tp1"], rows["sharded"]
+    summary = {
+        "metric": TP_METRIC,
+        "value": sh["value"],
+        "unit": "tokens/s",
+        "baseline_tokens_per_s": t1["value"],
+        "tp": sh["tp"],
+        "hbm_bytes_per_shard": sh["hbm_bytes_per_shard"],
+        "hbm_bytes_per_shard_tp1": t1["hbm_bytes_per_shard"],
+        "hbm_bytes_per_shard_reduction_pct": round(
+            (1.0 - sh["hbm_bytes_per_shard"]
+             / t1["hbm_bytes_per_shard"]) * 100.0, 1)
+        if t1["hbm_bytes_per_shard"] else 0.0,
+        "psums_per_program": sh["psums_per_program"],
+        "all_gathers_per_program": sh["all_gathers_per_program"],
+        "token_exact_vs_tp1": mismatches == 0,
+        "token_mismatched_requests": mismatches,
+        "windows": WINDOWS,
+        "requests_per_window": REQUESTS,
+        "model": SIZE,
+        "emulated_devices": True,
+    }
+    return rows, summary
+
+
+def main_tp():
+    import jax
+
+    _load_env(smoke=dict(TP_SMOKE))
+
+    rows, summary = tp_stats()
+    for mode in ("tp1", "sharded"):
+        print(json.dumps(rows[mode]))
+    summary["backend"] = jax.default_backend()
+    print(json.dumps(summary))
+
+
 if __name__ == "__main__":
     from apex_tpu.telemetry import guard_bench_main
 
@@ -1079,5 +1263,7 @@ if __name__ == "__main__":
         guard_bench_main(main_chaos, CHAOS_METRIC)
     elif "--speculative" in sys.argv[1:]:
         guard_bench_main(main_spec, SPEC_METRIC)
+    elif "--tensor-parallel" in sys.argv[1:]:
+        guard_bench_main(main_tp, TP_METRIC)
     else:
         guard_bench_main(main, METRIC)

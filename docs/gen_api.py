@@ -57,6 +57,7 @@ PAGES = {
                   "apex_tpu.telemetry.summarize", "apex_tpu.log_util"],
     "serving": ["apex_tpu.serving", "apex_tpu.serving.kv_cache",
                 "apex_tpu.serving.engine",
+                "apex_tpu.serving.sharding",
                 "apex_tpu.serving.prefix_cache",
                 "apex_tpu.serving.speculative",
                 "apex_tpu.serving.scheduler",

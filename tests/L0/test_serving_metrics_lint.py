@@ -1,7 +1,7 @@
 """Serving-telemetry lint: every ``serving.faults.*`` /
-``serving.watchdog.*`` / ``serving.spec.*`` metric the serving code
-emits must be documented in ``docs/serving.md``, and every documented
-one must be emitted.
+``serving.watchdog.*`` / ``serving.spec.*`` / ``serving.tp.*`` metric
+the serving code emits must be documented in ``docs/serving.md``, and
+every documented one must be emitted.
 
 Same failure mode as the tuned-keys lint, one layer up: metric names
 are stringly typed, so a renamed counter silently orphans its dashboard
@@ -10,9 +10,12 @@ The fault-isolation layer is exactly where that rot is most expensive —
 ``serving.faults.nonfinite`` going dark looks identical to "no faults"
 — and the speculative layer is next in line: an orphaned
 ``serving.spec.acceptance_rate`` reads as "speculation off" while the
-verify program burns real FLOPs. The loop is closed by lint: the set of
-fault/watchdog/spec metric literals in ``apex_tpu/serving/`` source
-must EQUAL the set named in the docs' tables.
+verify program burns real FLOPs. The tensor-parallel family joined with
+the mesh tentpole: ``serving.tp.shards`` / the per-program collective
+gauges going dark would make a sharded fleet indistinguishable from a
+single-chip one on every dashboard. The loop is closed by lint: the set
+of fault/watchdog/spec/tp metric literals in ``apex_tpu/serving/``
+source must EQUAL the set named in the docs' tables.
 """
 
 import glob
@@ -28,8 +31,9 @@ ROOT = os.path.abspath(os.path.join(HERE, os.pardir, os.pardir))
 SRC_DIR = os.path.join(ROOT, "apex_tpu", "serving")
 DOC = os.path.join(ROOT, "docs", "serving.md")
 
-# metric families the fault-isolation + speculative layers own
-_PAT = re.compile(r"serving\.(?:faults|watchdog|spec)\.[a-z0-9_]+")
+# metric families the fault-isolation + speculative + tensor-parallel
+# layers own
+_PAT = re.compile(r"serving\.(?:faults|watchdog|spec|tp)\.[a-z0-9_]+")
 
 
 def _emitted():
@@ -72,6 +76,17 @@ def test_scan_surface_is_alive():
             "telemetry went dark"
     assert os.path.join("apex_tpu", "serving", "engine.py") \
         in emitted.get("serving.spec.verify_s", [])
+    # the batched-verify slot-step counter (bench arithmetic's basis)
+    # and the tensor-parallel gauge family are engine-emitted
+    engine_py = os.path.join("apex_tpu", "serving", "engine.py")
+    for name in ("serving.spec.verify_slots", "serving.tp.shards",
+                 "serving.tp.psums_per_program",
+                 "serving.tp.all_gathers_per_program",
+                 "serving.tp.hbm_bytes_per_shard",
+                 "serving.tp.pool_pages_per_shard"):
+        assert engine_py in emitted.get(name, []), \
+            f"{name} not emitted by the engine — batched-verify/tp " \
+            "telemetry went dark"
     assert _documented(), "docs/serving.md names no fault/watchdog/" \
         "spec metrics — doc section missing?"
 

@@ -310,6 +310,111 @@ def test_speculative_with_eos_matches_plain(engines):
     assert sp[0].finish_reason == plain[0].finish_reason == "eos"
 
 
+# --------------------------------------------------------- batched verify
+@pytest.mark.parametrize("layout", ["paged", "contiguous"])
+def test_verify_batch_matches_sequential_per_slot(engines, layout):
+    """The batched-verify satellite's parity pin: B verify-eligible
+    slots through ONE [slots, K+1] call emit bitwise the same tokens
+    and acceptance counts as B sequential single-slot verify_step calls
+    — the wrapper routes through the SAME executable, so this is the
+    per-row-independence guarantee (a slot's verify never reads or
+    writes a batchmate's rows), on both layouts."""
+    eng = engines[layout]
+    prompts = {0: [3, 17, 91, 42, 8], 1: [7, 7, 9, 7, 7, 9, 2],
+               2: [11, 4, 11, 4, 11]}
+    drafts = {0: [5, 9, 1], 1: [7, 9], 2: [11]}   # varied draft lengths
+
+    def prep():
+        eng.reset()
+        return {s: eng.prefill_chunked(s, p) for s, p in prompts.items()}
+
+    first = prep()
+    toks_b, acc_b = eng.verify_batch(
+        {s: (first[s], drafts[s]) for s in prompts})
+    assert toks_b.shape == (eng.slots, K + 1)
+    assert acc_b.shape == (eng.slots,)
+    assert eng.last_verify_finite_slots.all()
+    first = prep()
+    for s in prompts:
+        toks_s, m_s = eng.verify_step(s, first[s], drafts[s],
+                                      len(prompts[s]))
+        assert int(acc_b[s]) == m_s, f"slot {s}: acceptance diverged"
+        assert toks_b[s].tolist() == toks_s.tolist(), \
+            f"slot {s}: batched verify diverged from per-slot verify"
+    eng.reset()
+
+
+def test_verify_batch_leaves_nonverifying_slots_untouched(engines):
+    """Fixed-shape safety: a decoding slot NOT in the verify batch must
+    keep its exact cache bytes — its subsequent plain-decode stream is
+    bitwise the reference stream even though a batched verify ran on a
+    batchmate in between (paged: the passenger's table-row operand is
+    zeroed so writes land on the sentinel; this is the guarantee that
+    lets the scheduler verify some slots while others decode)."""
+    eng = engines["paged"]
+    prompt = [3, 17, 91, 42, 8]
+    ref = _plain_greedy(eng, prompt, 8)
+
+    eng.reset()
+    t0 = eng.prefill_chunked(0, prompt)             # the bystander
+    t1 = eng.prefill_chunked(1, [7, 7, 9, 7, 7, 9, 2])  # the verifier
+    eng.verify_batch({1: (t1, [7, 7, 9])})
+    out = [t0]
+    last = np.zeros(eng.slots, np.int32)
+    active = np.zeros(eng.slots, bool)
+    active[0] = True
+    temps = np.zeros(eng.slots, np.float32)
+    while len(out) < len(ref):
+        last[0] = out[-1]
+        out.append(int(eng.decode_step(last, active, temps)[0]))
+    assert out == ref, "a batched verify on slot 1 corrupted slot 0's " \
+        "cache"
+    eng.reset()
+
+
+def test_verify_batch_validation(engines):
+    eng = engines["paged"]
+    eng.reset()
+    eng.prefill_chunked(0, [1, 2, 3])
+    with pytest.raises(ValueError, match="at least one"):
+        eng.verify_batch({})
+    with pytest.raises(ValueError, match="draft length"):
+        eng.verify_batch({0: (1, [])})
+    with pytest.raises(ValueError, match="draft length"):
+        eng.verify_batch({0: (1, [1] * (K + 1))})
+    with pytest.raises(ValueError, match="slot"):
+        eng.verify_batch({eng.slots: (1, [1])})
+    eng.reset()
+
+
+@pytest.mark.parametrize("paged", [True, False])
+def test_verify_batch_window_and_offset_raise_on_both_layouts(
+        lm_and_params, paged):
+    """Loud-failure contract, BOTH layouts (review finding: the
+    contiguous path used to mask a spilling window in-program and
+    return n_accepted=0 — indistinguishable from a real zero-accept,
+    so the caller would emit a token whose K/V never landed): a
+    verifying slot whose committed length leaves no room for the
+    padded [K+1] window raises BEFORE anything mutates, and a caller
+    offset that disagrees with the committed length raises on the
+    contiguous layout too (the old per-slot path only checked paged)."""
+    m, params = lm_and_params
+    eng = Engine(m, params, slots=2, max_len=8, prefill_len=8,
+                 chunk_len=8, paged=paged,
+                 policy=resolve_policy("O0", verbose=False),
+                 spec=SpecConfig(draft_len=K, ngram=2))
+    t = eng.prefill_chunked(0, [1, 2, 3, 4, 5])   # committed length 5
+    with pytest.raises(ValueError, match="verify window"):
+        eng.verify_batch({0: (t, [1, 2])})        # [5, 9) spills 8
+    t1 = eng.prefill_chunked(1, [1, 2, 3])        # committed length 3
+    with pytest.raises(ValueError, match="disagrees"):
+        eng.verify_batch({1: (t1, [1])}, offsets={1: 4})  # fits, drifts
+    assert eng.verify_traces == 0, \
+        "validation must fire before the program ever traces"
+    # tokens_generated counted nothing for the refused calls
+    assert eng.tokens_generated == 2              # the prefill tokens
+
+
 # ------------------------------------------------- compiled-programs pin
 @pytest.mark.parametrize("layout", ["paged", "contiguous"])
 def test_exactly_one_new_executable(engines, layout):

@@ -377,6 +377,53 @@ def test_guard_bench_main_retries_transient_then_succeeds(capsys):
     assert marker["discard_preceding"] is True
 
 
+def test_guard_bench_main_classifies_bench_r05_error_transient(capsys):
+    """The tensor-parallel PR's guard satellite: the VERBATIM BENCH_r05
+    failure — a JaxRuntimeError whose message is the axon remote-compile
+    tunnel reset — must classify as transient end-to-end: retried (with
+    the ``transient_retry`` discard marker), recovered when the retry
+    succeeds, and tagged ``"transient": true`` when it persists, so one
+    flaky backend can never zero out a bench round again."""
+
+    class JaxRuntimeError(RuntimeError):
+        pass
+
+    R05 = ("INTERNAL: http://127.0.0.1:8103/remote_compile: read body: "
+           "response body closed before all bytes were read")
+    assert telemetry._is_transient_error(f"JaxRuntimeError: {R05}")
+    calls = []
+
+    def r05_flaky():
+        calls.append(1)
+        if len(calls) == 1:
+            raise JaxRuntimeError(R05)
+        return {"value": 1.0}
+
+    assert telemetry.guard_bench_main(r05_flaky, "m") == {"value": 1.0}
+    assert len(calls) == 2
+    marker = json.loads(
+        capsys.readouterr().out.strip().splitlines()[0])
+    assert marker["event"] == "transient_retry"
+    assert "remote_compile" in marker["error"]
+
+    calls.clear()
+
+    def r05_persistent():
+        calls.append(1)
+        raise JaxRuntimeError(R05)
+
+    with pytest.raises(SystemExit) as exc:
+        telemetry.guard_bench_main(r05_persistent, "m", retries=2)
+    assert exc.value.code == 1
+    assert len(calls) == 3                       # original + two retries
+    parsed = json.loads(
+        capsys.readouterr().out.strip().splitlines()[-1])
+    assert parsed["transient"] is True, \
+        "the BENCH_r05 remote-compile reset must be read as infra " \
+        "noise, not a perf regression"
+    assert parsed["error"] == f"JaxRuntimeError: {R05}"
+
+
 def test_guard_bench_main_persistent_transient_tags_true(capsys):
     calls = []
 

@@ -162,3 +162,33 @@ def test_speculative_verify_owes_the_tables_no_keys():
                                   recursive=True)}
     assert os.path.join("apex_tpu", "serving",
                         "speculative.py") in scanned
+
+
+def test_sharded_serving_owes_the_tables_no_new_keys():
+    """The tensor-parallel satellite, in the copy/verify pattern: the
+    sharded programs run the EXISTING paged kernels over fewer heads
+    per shard (the grid's heads dimension shrinks; no index map or
+    block shape changes), so sharding introduces NO new ``decode.*``
+    table key — the per-shard kernels reuse the block knobs already
+    swept (same shapes per head, fewer heads). The decode.* table
+    surface is pinned by name, so a future sharded-attention knob must
+    land here AND in the tables deliberately; and the lint's scan must
+    cover serving/sharding.py so any key it ever does reference gets
+    the existence/staleness treatment automatically."""
+    table = {k for k in _table_keys() if k.startswith("decode.")}
+    assert table == {
+        "decode.block_k", "decode.chunk_block_q", "decode.chunk_block_k",
+        "decode.prefill_block_q", "decode.prefill_block_k",
+        "decode.page_block_q", "decode.page_len",
+    }, (f"decode.* table surface changed: {sorted(table)} — if a "
+        "sharded-attention knob landed, update this pin deliberately")
+    stale_tp = {k for k in _table_keys()
+                if k.startswith(("decode.tp_", "decode.shard_"))}
+    assert not stale_tp, (
+        f"tuned tables carry tensor-parallel keys but the sharded "
+        f"kernels reuse the existing block knobs: {stale_tp}")
+    scanned = {os.path.relpath(p, ROOT)
+               for d in SCAN_DIRS
+               for p in glob.glob(os.path.join(d, "**", "*.py"),
+                                  recursive=True)}
+    assert os.path.join("apex_tpu", "serving", "sharding.py") in scanned
