@@ -79,14 +79,24 @@ DEFAULT_BLOCK_K = 256
 
 
 # --------------------------------------------------------------- jnp reference
-def decode_attention_reference(q, k, v, lengths, *, scale: float = 1.0):
+def decode_attention_reference(q, k, v, lengths, *, scale: float = 1.0,
+                               k_scale=None, v_scale=None):
     """fp32-math oracle: masked softmax over the valid cache prefix.
 
     ``q`` [b, h, d]; ``k``/``v`` [b, h, L, d]; ``lengths`` [b] int32.
     Returns [b, h, d] in ``q.dtype``; rows with ``lengths == 0`` are 0.
+    ``k_scale``/``v_scale`` ([h] fp32) are the quantized-cache tier's
+    per-head dequantization scales: when given, ``k``/``v`` hold int8
+    codes and are dequantized (cast + scale multiply) before the exact
+    fp32 math — the gather-dequant oracle the in-kernel path is tested
+    against.
     """
     out_dtype = q.dtype
     q32, k32, v32 = (jnp.asarray(t, jnp.float32) for t in (q, k, v))
+    if k_scale is not None:
+        k32 = k32 * jnp.asarray(k_scale, jnp.float32)[None, :, None, None]
+    if v_scale is not None:
+        v32 = v32 * jnp.asarray(v_scale, jnp.float32)[None, :, None, None]
     s = jnp.einsum("bhd,bhld->bhl", q32, k32) * scale
     L = k.shape[2]
     valid = (jnp.arange(L, dtype=jnp.int32)[None, None, :]
@@ -99,15 +109,26 @@ def decode_attention_reference(q, k, v, lengths, *, scale: float = 1.0):
 
 
 # -------------------------------------------------------------------- kernel
-def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
-                   l_ref, *, scale, block_k):
+def _decode_kernel(len_ref, *refs, scale, block_k, quant):
     """Grid (bh, nk): one batch·head row, blockwise over cached KV.
 
     Online softmax identical to the training forward kernel's (m, l)
     recurrence, with the causal tile-skip replaced by a length skip:
     a block whose first position is already past this row's valid
     length contributes nothing and is skipped entirely.
+
+    ``quant`` (static) threads the int8-cache tier through: two extra
+    SMEM refs carry the per-row K/V dequantization scales, the K scale
+    folds into the existing logit multiply and the V scale into the
+    accumulator update — dequantization fused with the attend, the
+    int8 block never expanding outside VMEM. The non-quant trace is
+    byte-identical to before the tier existed.
     """
+    if quant:
+        ks_ref, vs_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, \
+            l_ref = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref = refs
     b = pl.program_id(0)
     ki = pl.program_id(1)
     nk = pl.num_programs(1)
@@ -126,6 +147,10 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale       # [1, bk]
+        if quant:
+            # dequant-in-kernel: the per-head K scale is constant over
+            # the row, so it factors out of the int8 dot product
+            s = s * ks_ref[b]
         cols = ki * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (1, block_k), 1)
         s = jnp.where(cols < length, s, _NEG_INF)
@@ -136,9 +161,12 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
         alpha = jnp.exp(m_prev - m_new)
         l_ref[:1, :1] = alpha * l_ref[:1, :1] + jnp.sum(
             p, axis=-1, keepdims=True)
-        acc_ref[:1, :] = acc_ref[:1, :] * alpha + jax.lax.dot_general(
+        pv = jax.lax.dot_general(
             p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
+        if quant:
+            pv = pv * vs_ref[b]
+        acc_ref[:1, :] = acc_ref[:1, :] * alpha + pv
         m_ref[:1, :1] = m_new
 
     @pl.when(ki == nk - 1)
@@ -148,15 +176,22 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
         o_ref[0] = (acc_ref[:1, :] / l_safe).astype(o_ref.dtype)
 
 
-def _decode_pallas(q3, k3, v3, len3, scale, bk, interpret):
+def _decode_pallas(q3, k3, v3, len3, scale, bk, interpret, ks3=None,
+                   vs3=None):
     bh, d = q3.shape
     L = k3.shape[1]
-    kernel = functools.partial(_decode_kernel, scale=scale, block_k=bk)
+    quant = ks3 is not None
+    kernel = functools.partial(_decode_kernel, scale=scale, block_k=bk,
+                               quant=quant)
+    scale_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] * 2 \
+        if quant else []
+    scale_ops = (ks3, vs3) if quant else ()
     out = pl.pallas_call(
         kernel,
         grid=(bh, L // bk),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),                # lengths
+            *scale_specs,                         # k/v dequant scales
             pl.BlockSpec((1, 1, d), lambda b, j: (b, 0, 0)),      # q
             pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),     # k
             pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),     # v
@@ -169,7 +204,7 @@ def _decode_pallas(q3, k3, v3, len3, scale, bk, interpret):
             pltpu.VMEM((8, 128), jnp.float32),    # l
         ],
         interpret=interpret,
-    )(len3, q3.reshape(bh, 1, d), k3, v3)
+    )(len3, *scale_ops, q3.reshape(bh, 1, d), k3, v3)
     return out.reshape(bh, d)
 
 
@@ -181,8 +216,23 @@ def _resolve_block(block_k):
     return block_k
 
 
+def _check_head_scales(name, h, k_scale, v_scale):
+    """Quantized-cache scale validation shared by the four dispatchers:
+    scales come as a pair of [heads] fp32 vectors or not at all."""
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError(f"{name}: k_scale and v_scale must be given "
+                         f"together (int8 K and V are stored with "
+                         f"independent per-head scales)")
+    if k_scale is not None:
+        for nm, s in (("k_scale", k_scale), ("v_scale", v_scale)):
+            if s.shape != (h,):
+                raise ValueError(f"{name}: {nm} {s.shape} must be "
+                                 f"[{h}] (one scale per head)")
+
+
 def decode_attention(q, k, v, lengths, *, scale: Optional[float] = None,
                      block_k: Optional[int] = None,
+                     k_scale=None, v_scale=None,
                      interpret: bool = False):
     """Single-token attention against a length-masked KV cache.
 
@@ -199,6 +249,13 @@ def decode_attention(q, k, v, lengths, *, scale: Optional[float] = None,
     non-Mosaic dtypes fall back to the jnp reference, which XLA fuses
     acceptably at decode's tiny per-step footprint.
 
+    Quantized cache (``k_scale``/``v_scale``, both ``[heads]`` fp32):
+    ``k``/``v`` hold int8 codes dequantized IN-KERNEL — the K scale
+    rides the logit multiply, the V scale the accumulator update — so
+    the half-width cache bytes stream through VMEM and never expand in
+    HBM. The fallback path dequantizes in the jnp oracle instead (same
+    math, materialised).
+
     Tuned geometry: ``decode.block_k`` in the
     :mod:`apex_tpu.kernels.vmem` override registry (lane-multiple 128,
     clamped to the largest aligned divisor of ``max_len``).
@@ -211,6 +268,7 @@ def decode_attention(q, k, v, lengths, *, scale: Optional[float] = None,
     if lengths.shape != (b,):
         raise ValueError(f"decode_attention: lengths {lengths.shape} must "
                          f"be [{b}]")
+    _check_head_scales("decode_attention", h, k_scale, v_scale)
     if scale is None:
         scale = 1.0 / (d ** 0.5)
     from apex_tpu.kernels.flash_attention import _fit_block, _has_vma
@@ -220,12 +278,20 @@ def decode_attention(q, k, v, lengths, *, scale: Optional[float] = None,
     pallas_ok = (L % bk == 0 and d % 8 == 0 and bk % 128 == 0)
     if not pallas_ok or (interpret and _has_vma(q)) \
             or (not interpret and not mosaic_dtype_ok(q, k, v)):
-        return decode_attention_reference(q, k, v, lengths, scale=scale)
+        return decode_attention_reference(q, k, v, lengths, scale=scale,
+                                          k_scale=k_scale,
+                                          v_scale=v_scale)
     q3 = q.reshape(b * h, d)
     k3 = k.reshape(b * h, L, d)
     v3 = v.reshape(b * h, L, d)
     len3 = jnp.repeat(jnp.asarray(lengths, jnp.int32), h)
-    out = _decode_pallas(q3, k3, v3, len3, scale, bk, interpret)
+    ks3 = vs3 = None
+    if k_scale is not None:
+        # flattened bh rows walk heads fastest: row b*h + hh -> head hh
+        ks3 = jnp.tile(jnp.asarray(k_scale, jnp.float32), b)
+        vs3 = jnp.tile(jnp.asarray(v_scale, jnp.float32), b)
+    out = _decode_pallas(q3, k3, v3, len3, scale, bk, interpret, ks3,
+                         vs3)
     live = (lengths > 0)[:, None, None]
     return jnp.where(live, out.reshape(b, h, d), 0).astype(q.dtype)
 
@@ -249,24 +315,35 @@ def gather_pages(pool, page_table):
 
 
 def paged_decode_attention_reference(q, k_pool, v_pool, page_table,
-                                     lengths, *, scale: float = 1.0):
+                                     lengths, *, scale: float = 1.0,
+                                     k_scale=None, v_scale=None):
     """fp32-math oracle: gather the page-table view, then the exact
     contiguous decode reference. ``q`` [b, h, d]; pools
     [num_pages, h, page_len, d]; ``page_table`` [b, max_pages];
-    ``lengths`` [b] int32."""
+    ``lengths`` [b] int32. With ``k_scale``/``v_scale`` ([h] fp32) the
+    gathered int8 pages are dequantized before the exact math — the
+    gather-dequant oracle of the quantized-cache tier."""
     k = gather_pages(k_pool, page_table)
     v = gather_pages(v_pool, page_table)
-    return decode_attention_reference(q, k, v, lengths, scale=scale)
+    return decode_attention_reference(q, k, v, lengths, scale=scale,
+                                      k_scale=k_scale, v_scale=v_scale)
 
 
-def _paged_decode_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
-                         acc_ref, m_ref, l_ref, *, scale, page_len):
+def _paged_decode_kernel(pt_ref, len_ref, *refs, scale, page_len, quant):
     """Grid (b, h, max_pages): one batch row x head, one pool page per
     step. The (m, l) recurrence is :func:`_decode_kernel`'s; the page
     the DMA fetched was chosen by the scalar-prefetch index map
     (``pt_ref[b, j]``), so the kernel body only needs the length skip/
-    mask on GLOBAL positions ``j * page_len + lane``."""
+    mask on GLOBAL positions ``j * page_len + lane``. ``quant``
+    (static) adds two scalar-prefetch scale refs and the same fused
+    per-head dequant multiplies as :func:`_decode_kernel`."""
+    if quant:
+        ks_ref, vs_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, \
+            l_ref = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref = refs
     b = pl.program_id(0)
+    hh = pl.program_id(1)
     j = pl.program_id(2)
     nj = pl.num_programs(2)
     length = len_ref[b]
@@ -284,6 +361,8 @@ def _paged_decode_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale       # [1, pl]
+        if quant:
+            s = s * ks_ref[hh]
         cols = j * page_len + jax.lax.broadcasted_iota(
             jnp.int32, (1, page_len), 1)
         s = jnp.where(cols < length, s, _NEG_INF)
@@ -294,9 +373,12 @@ def _paged_decode_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         alpha = jnp.exp(m_prev - m_new)
         l_ref[:1, :1] = alpha * l_ref[:1, :1] + jnp.sum(
             p, axis=-1, keepdims=True)
-        acc_ref[:1, :] = acc_ref[:1, :] * alpha + jax.lax.dot_general(
+        pv = jax.lax.dot_general(
             p, v_ref[0, 0].astype(jnp.float32), (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
+        if quant:
+            pv = pv * vs_ref[hh]
+        acc_ref[:1, :] = acc_ref[:1, :] * alpha + pv
         m_ref[:1, :1] = m_new
 
     @pl.when(j == nj - 1)
@@ -306,24 +388,32 @@ def _paged_decode_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0, 0] = (acc_ref[:1, :] / l_safe)[0].astype(o_ref.dtype)
 
 
-def _paged_decode_pallas(q, k_pool, v_pool, pt, lengths, scale, interpret):
+def _paged_decode_pallas(q, k_pool, v_pool, pt, lengths, scale,
+                         interpret, ks=None, vs=None):
     B, h, d = q.shape
     page_len = k_pool.shape[2]
     max_pages = pt.shape[1]
+    quant = ks is not None
     kernel = functools.partial(_paged_decode_kernel, scale=scale,
-                               page_len=page_len)
+                               page_len=page_len, quant=quant)
+    # the dequant scales ride as two extra scalar-prefetch operands (the
+    # variadic tail absorbs them — only the kernel body reads them)
+    def _q_idx(b, hh, j, pt, ln, *_scales):
+        return (b, hh, 0)
+
+    def _kv_idx(b, hh, j, pt, ln, *_scales):
+        return (pt[b, j], hh, 0, 0)
+
+    n_prefetch, extra_ops = (4, (ks, vs)) if quant else (2, ())
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,                   # page_table, lengths
+        num_scalar_prefetch=n_prefetch,   # page_table, lengths[, ks, vs]
         grid=(B, h, max_pages),
         in_specs=[
-            pl.BlockSpec((1, 1, d), lambda b, hh, j, pt, ln: (b, hh, 0)),
-            pl.BlockSpec((1, 1, page_len, d),
-                         lambda b, hh, j, pt, ln: (pt[b, j], hh, 0, 0)),
-            pl.BlockSpec((1, 1, page_len, d),
-                         lambda b, hh, j, pt, ln: (pt[b, j], hh, 0, 0)),
+            pl.BlockSpec((1, 1, d), _q_idx),
+            pl.BlockSpec((1, 1, page_len, d), _kv_idx),
+            pl.BlockSpec((1, 1, page_len, d), _kv_idx),
         ],
-        out_specs=pl.BlockSpec((1, 1, d),
-                               lambda b, hh, j, pt, ln: (b, hh, 0)),
+        out_specs=pl.BlockSpec((1, 1, d), _q_idx),
         scratch_shapes=[
             pltpu.VMEM((8, d), jnp.float32),      # acc (row 0 live)
             pltpu.VMEM((8, 128), jnp.float32),    # m
@@ -334,11 +424,12 @@ def _paged_decode_pallas(q, k_pool, v_pool, pt, lengths, scale, interpret):
         kernel, grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, h, d), q.dtype),
         interpret=interpret,
-    )(pt, lengths, q, k_pool, v_pool)
+    )(pt, lengths, *extra_ops, q, k_pool, v_pool)
 
 
 def paged_decode_attention(q, k_pool, v_pool, page_table, lengths, *,
                            scale: Optional[float] = None,
+                           k_scale=None, v_scale=None,
                            interpret: bool = False):
     """Single-token attention against a PAGED, length-masked KV pool.
 
@@ -372,6 +463,7 @@ def paged_decode_attention(q, k_pool, v_pool, page_table, lengths, *,
     if lengths.shape != (B,):
         raise ValueError(f"paged_decode_attention: lengths "
                          f"{lengths.shape} must be [{B}]")
+    _check_head_scales("paged_decode_attention", h, k_scale, v_scale)
     if scale is None:
         scale = 1.0 / (d ** 0.5)
     from apex_tpu.kernels.flash_attention import _has_vma
@@ -381,10 +473,15 @@ def paged_decode_attention(q, k_pool, v_pool, page_table, lengths, *,
     if not pallas_ok or (interpret and _has_vma(q)) \
             or (not interpret and not mosaic_dtype_ok(q, k_pool, v_pool)):
         return paged_decode_attention_reference(
-            q, k_pool, v_pool, page_table, lengths, scale=scale)
+            q, k_pool, v_pool, page_table, lengths, scale=scale,
+            k_scale=k_scale, v_scale=v_scale)
     pt = jnp.asarray(page_table, jnp.int32)
     len32 = jnp.asarray(lengths, jnp.int32)
+    ks = vs = None
+    if k_scale is not None:
+        ks = jnp.asarray(k_scale, jnp.float32)
+        vs = jnp.asarray(v_scale, jnp.float32)
     out = _paged_decode_pallas(q, k_pool, v_pool, pt, len32, scale,
-                               interpret)
+                               interpret, ks, vs)
     live = (lengths > 0)[:, None, None]
     return jnp.where(live, out, 0).astype(q.dtype)

@@ -84,15 +84,23 @@ DEFAULT_BLOCK_K = 256
 
 
 # --------------------------------------------------------------- jnp reference
-def prefill_attention_reference(q, k, v, offsets, *, scale: float = 1.0):
+def prefill_attention_reference(q, k, v, offsets, *, scale: float = 1.0,
+                                k_scale=None, v_scale=None):
     """fp32-math oracle: per-row shifted-causal softmax over the cache.
 
     ``q`` [b, h, C, d]; ``k``/``v`` [b, h, L, d]; ``offsets`` [b] int32.
     Query row ``i`` attends cache positions ``j <= offsets[b] + i``.
-    Returns [b, h, C, d] in ``q.dtype``.
+    Returns [b, h, C, d] in ``q.dtype``. ``k_scale``/``v_scale`` ([h]
+    fp32) dequantize an int8 cache before the exact math (the
+    quantized tier's oracle — see
+    :func:`~apex_tpu.kernels.decode_attention.decode_attention_reference`).
     """
     out_dtype = q.dtype
     q32, k32, v32 = (jnp.asarray(t, jnp.float32) for t in (q, k, v))
+    if k_scale is not None:
+        k32 = k32 * jnp.asarray(k_scale, jnp.float32)[None, :, None, None]
+    if v_scale is not None:
+        v32 = v32 * jnp.asarray(v_scale, jnp.float32)[None, :, None, None]
     s = jnp.einsum("bhqd,bhld->bhql", q32, k32) * scale
     C, L = q.shape[2], k.shape[2]
     rows = (offsets[:, None, None, None]
@@ -104,13 +112,19 @@ def prefill_attention_reference(q, k, v, offsets, *, scale: float = 1.0):
 
 
 # -------------------------------------------------------------------- kernel
-def _prefill_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
-                    l_ref, *, scale, block_q, block_k):
+def _prefill_kernel(off_ref, *refs, scale, block_q, block_k, quant):
     """Grid (bh, nq, nk): one batch·head row, q-blocked chunk, blockwise
     over cached KV. The (m, l) recurrence is the flash forward kernel's;
     the causal skip/mask runs on GLOBAL query positions ``offset + row``
     instead of chunk-local ones, which is the whole difference between
-    training attention and chunked prefill."""
+    training attention and chunked prefill. ``quant`` (static) adds two
+    per-row SMEM scale refs and fuses the int8-cache dequant multiplies
+    into the logit/accumulator updates (the decode kernel's pattern)."""
+    if quant:
+        ks_ref, vs_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, \
+            l_ref = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref = refs
     b = pl.program_id(0)
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -131,6 +145,8 @@ def _prefill_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale      # [bq, bk]
+        if quant:
+            s = s * ks_ref[b]
         rows = offset + qi * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
         cols = ki * block_k + jax.lax.broadcasted_iota(
@@ -142,9 +158,12 @@ def _prefill_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
         p = jnp.exp(s - m_new)                               # [bq, bk]
         alpha = jnp.exp(m_prev - m_new)
         l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
-        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+        pv = jax.lax.dot_general(
             p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
+        if quant:
+            pv = pv * vs_ref[b]
+        acc_ref[:] = acc_ref[:] * alpha + pv
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
 
@@ -157,16 +176,22 @@ def _prefill_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
         o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
 
 
-def _prefill_pallas(q3, k3, v3, off3, scale, bq, bk, interpret):
+def _prefill_pallas(q3, k3, v3, off3, scale, bq, bk, interpret,
+                    ks3=None, vs3=None):
     bh, C, d = q3.shape
     L = k3.shape[1]
+    quant = ks3 is not None
     kernel = functools.partial(_prefill_kernel, scale=scale, block_q=bq,
-                               block_k=bk)
+                               block_k=bk, quant=quant)
+    scale_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] * 2 \
+        if quant else []
+    scale_ops = (ks3, vs3) if quant else ()
     return pl.pallas_call(
         kernel,
         grid=(bh, C // bq, L // bk),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),                 # offsets
+            *scale_specs,                          # k/v dequant scales
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),   # q
             pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),   # k
             pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),   # v
@@ -179,7 +204,7 @@ def _prefill_pallas(q3, k3, v3, off3, scale, bq, bk, interpret):
             pltpu.VMEM((bq, 128), jnp.float32),    # l (col 0 live)
         ],
         interpret=interpret,
-    )(off3, q3, k3, v3)
+    )(off3, *scale_ops, q3, k3, v3)
 
 
 # ------------------------------------------------------------------ dispatch
@@ -196,6 +221,7 @@ def _resolve_blocks(block_q, block_k):
 def prefill_attention(q, k, v, offsets, *, scale: Optional[float] = None,
                       block_q: Optional[int] = None,
                       block_k: Optional[int] = None,
+                      k_scale=None, v_scale=None,
                       interpret: bool = False):
     """Chunk-of-queries attention against a cached, offset prefix.
 
@@ -226,6 +252,8 @@ def prefill_attention(q, k, v, offsets, *, scale: Optional[float] = None,
     if offsets.shape != (b,):
         raise ValueError(f"prefill_attention: offsets {offsets.shape} "
                          f"must be [{b}]")
+    from apex_tpu.kernels.decode_attention import _check_head_scales
+    _check_head_scales("prefill_attention", h, k_scale, v_scale)
     if scale is None:
         scale = 1.0 / (d ** 0.5)
     from apex_tpu.kernels.flash_attention import _fit_block, _has_vma
@@ -238,37 +266,55 @@ def prefill_attention(q, k, v, offsets, *, scale: Optional[float] = None,
                  and bq % 8 == 0 and bk % 128 == 0)
     if not pallas_ok or (interpret and _has_vma(q)) \
             or (not interpret and not mosaic_dtype_ok(q, k, v)):
-        return prefill_attention_reference(q, k, v, offsets, scale=scale)
+        return prefill_attention_reference(q, k, v, offsets, scale=scale,
+                                           k_scale=k_scale,
+                                           v_scale=v_scale)
     q3 = q.reshape(b * h, C, d)
     k3 = k.reshape(b * h, L, d)
     v3 = v.reshape(b * h, L, d)
     off3 = jnp.repeat(jnp.asarray(offsets, jnp.int32), h)
-    out = _prefill_pallas(q3, k3, v3, off3, scale, bq, bk, interpret)
+    ks3 = vs3 = None
+    if k_scale is not None:
+        ks3 = jnp.tile(jnp.asarray(k_scale, jnp.float32), b)
+        vs3 = jnp.tile(jnp.asarray(v_scale, jnp.float32), b)
+    out = _prefill_pallas(q3, k3, v3, off3, scale, bq, bk, interpret,
+                          ks3, vs3)
     return out.reshape(b, h, C, d).astype(q.dtype)
 
 
 # ------------------------------------------------------------ paged variant
 def paged_prefill_attention_reference(q, k_pool, v_pool, page_table,
-                                      offsets, *, scale: float = 1.0):
+                                      offsets, *, scale: float = 1.0,
+                                      k_scale=None, v_scale=None):
     """fp32-math oracle: gather the page-table view, then the exact
     contiguous chunk-prefill reference. ``q`` [b, h, C, d]; pools
     [num_pages, h, page_len, d]; ``page_table`` [b, max_pages];
-    ``offsets`` [b] int32."""
+    ``offsets`` [b] int32. With ``k_scale``/``v_scale`` ([h] fp32) the
+    gathered int8 pages are dequantized before the exact math — the
+    quantized tier's gather-dequant oracle."""
     from apex_tpu.kernels.decode_attention import gather_pages
 
     k = gather_pages(k_pool, page_table)
     v = gather_pages(v_pool, page_table)
-    return prefill_attention_reference(q, k, v, offsets, scale=scale)
+    return prefill_attention_reference(q, k, v, offsets, scale=scale,
+                                       k_scale=k_scale, v_scale=v_scale)
 
 
-def _paged_prefill_kernel(pt_ref, off_ref, q_ref, k_ref, v_ref, o_ref,
-                          acc_ref, m_ref, l_ref, *, scale, block_q,
-                          page_len):
+def _paged_prefill_kernel(pt_ref, off_ref, *refs, scale, block_q,
+                          page_len, quant):
     """Grid (b, h, nq, max_pages): one batch row x head, q-blocked
     chunk, one pool page per KV step. :func:`_prefill_kernel`'s (m, l)
     recurrence and global-position shifted-causal mask; the page the
-    DMA fetched was chosen by the scalar-prefetch index map."""
+    DMA fetched was chosen by the scalar-prefetch index map. ``quant``
+    (static) adds two scalar-prefetch scale refs and the fused per-head
+    dequant multiplies."""
+    if quant:
+        ks_ref, vs_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, \
+            l_ref = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref = refs
     b = pl.program_id(0)
+    hh = pl.program_id(1)
     qi = pl.program_id(2)
     ji = pl.program_id(3)
     nj = pl.num_programs(3)
@@ -288,6 +334,8 @@ def _paged_prefill_kernel(pt_ref, off_ref, q_ref, k_ref, v_ref, o_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale      # [bq, pl]
+        if quant:
+            s = s * ks_ref[hh]
         rows = offset + qi * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, page_len), 0)
         cols = ji * page_len + jax.lax.broadcasted_iota(
@@ -299,9 +347,12 @@ def _paged_prefill_kernel(pt_ref, off_ref, q_ref, k_ref, v_ref, o_ref,
         p = jnp.exp(s - m_new)                               # [bq, pl]
         alpha = jnp.exp(m_prev - m_new)
         l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
-        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+        pv = jax.lax.dot_general(
             p, v_ref[0, 0].astype(jnp.float32), (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
+        if quant:
+            pv = pv * vs_ref[hh]
+        acc_ref[:] = acc_ref[:] * alpha + pv
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
 
@@ -313,14 +364,22 @@ def _paged_prefill_kernel(pt_ref, off_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def _paged_prefill_pallas(q, k_pool, v_pool, pt, offsets, scale, bq,
-                          interpret):
+                          interpret, ks=None, vs=None):
     B, h, C, d = q.shape
     page_len = k_pool.shape[2]
     max_pages = pt.shape[1]
+    quant = ks is not None
     kernel = functools.partial(_paged_prefill_kernel, scale=scale,
-                               block_q=bq, page_len=page_len)
+                               block_q=bq, page_len=page_len,
+                               quant=quant)
 
-    def _kv_page(b, hh, i, j, pt, off):
+    # the dequant scales ride as two extra scalar-prefetch operands;
+    # the index maps' variadic tails absorb them (only the kernel body
+    # reads them)
+    def _q_idx(b, hh, i, j, pt, off, *_scales):
+        return (b, hh, i, 0)
+
+    def _kv_page(b, hh, i, j, pt, off, *_scales):
         # Bound the DMA extent by the chunk's offset: row b's queries
         # reach global position off[b] + C - 1 at most, so pages past
         # index (off[b] + C - 1) // page_len are never computed over
@@ -334,17 +393,17 @@ def _paged_prefill_pallas(q, k_pool, v_pool, pt, offsets, scale, bq,
         last = (off[b] + (C - 1)) // page_len
         return (pt[b, jnp.minimum(j, last)], hh, 0, 0)
 
+    n_prefetch, extra_ops = (4, (ks, vs)) if quant else (2, ())
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,                   # page_table, offsets
+        num_scalar_prefetch=n_prefetch,  # page_table, offsets[, ks, vs]
         grid=(B, h, C // bq, max_pages),
         in_specs=[
-            pl.BlockSpec((1, 1, bq, d),
-                         lambda b, hh, i, j, pt, off: (b, hh, i, 0)),
+            pl.BlockSpec((1, 1, bq, d), _q_idx),
             pl.BlockSpec((1, 1, page_len, d), _kv_page),
             pl.BlockSpec((1, 1, page_len, d), _kv_page),
         ],
-        out_specs=pl.BlockSpec((1, 1, bq, d),
-                               lambda b, hh, i, j, pt, off: (b, hh, i, 0)),
+        out_specs=pl.BlockSpec((1, 1, bq, d), _q_idx),
         scratch_shapes=[
             pltpu.VMEM((bq, d), jnp.float32),      # acc
             pltpu.VMEM((bq, 128), jnp.float32),    # m (col 0 live)
@@ -355,7 +414,7 @@ def _paged_prefill_pallas(q, k_pool, v_pool, pt, offsets, scale, bq,
         kernel, grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, h, C, d), q.dtype),
         interpret=interpret,
-    )(pt, offsets, q, k_pool, v_pool)
+    )(pt, offsets, *extra_ops, q, k_pool, v_pool)
 
 
 def _resolve_page_block_q(block_q):
@@ -368,6 +427,7 @@ def _resolve_page_block_q(block_q):
 def paged_prefill_attention(q, k_pool, v_pool, page_table, offsets, *,
                             scale: Optional[float] = None,
                             block_q: Optional[int] = None,
+                            k_scale=None, v_scale=None,
                             interpret: bool = False):
     """Chunk-of-queries attention against a PAGED cached prefix.
 
@@ -411,6 +471,8 @@ def paged_prefill_attention(q, k_pool, v_pool, page_table, offsets, *,
     if offsets.shape != (B,):
         raise ValueError(f"paged_prefill_attention: offsets "
                          f"{offsets.shape} must be [{B}]")
+    from apex_tpu.kernels.decode_attention import _check_head_scales
+    _check_head_scales("paged_prefill_attention", h, k_scale, v_scale)
     if scale is None:
         scale = 1.0 / (d ** 0.5)
     from apex_tpu.kernels.flash_attention import _fit_block, _has_vma
@@ -422,8 +484,13 @@ def paged_prefill_attention(q, k_pool, v_pool, page_table, offsets, *,
     if not pallas_ok or (interpret and _has_vma(q)) \
             or (not interpret and not mosaic_dtype_ok(q, k_pool, v_pool)):
         return paged_prefill_attention_reference(
-            q, k_pool, v_pool, page_table, offsets, scale=scale)
+            q, k_pool, v_pool, page_table, offsets, scale=scale,
+            k_scale=k_scale, v_scale=v_scale)
     pt = jnp.asarray(page_table, jnp.int32)
     off32 = jnp.asarray(offsets, jnp.int32)
+    ks = vs = None
+    if k_scale is not None:
+        ks = jnp.asarray(k_scale, jnp.float32)
+        vs = jnp.asarray(v_scale, jnp.float32)
     return _paged_prefill_pallas(q, k_pool, v_pool, pt, off32, scale, bq,
-                                 interpret).astype(q.dtype)
+                                 interpret, ks, vs).astype(q.dtype)

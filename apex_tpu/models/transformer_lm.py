@@ -77,6 +77,16 @@ class SelfAttention(nn.Module):
     pure-bf16 decode needs no fp32 master weights anywhere); when None
     the training-policy ``dense_dtype`` governs, as before.
 
+    - **quantized cache** (``kv_scales=(k_scale, v_scale)``, each
+      ``[heads]`` fp32 for this layer — the serving engine's
+      ``kv_quant`` int8 storage tier): every cache WRITE above
+      quantizes the fresh K/V symmetrically per head
+      (:mod:`apex_tpu.serving.kv_quant`) before storing, and every
+      attention READ passes the scales into the kernels, which
+      dequantize in-kernel (int8 block load → scale multiply → the
+      unchanged online-softmax fp32 math). ``kv_scales=None`` (the
+      default) leaves every mode byte-identical to the bf16 tier.
+
     **Tensor parallelism** (``tp_axis``/``tp_size``, set by
     ``serving.Engine(mesh=...)`` and meaningful only inside a
     ``shard_map`` over that axis): the module becomes ONE SHARD of a
@@ -103,7 +113,8 @@ class SelfAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool, cache=None, positions=None,
-                 return_kv: bool = False, unaligned_append: bool = False):
+                 return_kv: bool = False, unaligned_append: bool = False,
+                 kv_scales=None):
         # dtype=None → O1 engine: GEMMs are FP16_FUNCS 'linear'
         from apex_tpu.amp.autocast import resolve_dtype
         dense_dtype = resolve_dtype(self.dtype, "linear", jnp.float32)
@@ -121,6 +132,21 @@ class SelfAttention(nn.Module):
         # throwaway generator re-indexing qkv[:, :, i] three times
         qkv = qkv.reshape(B, S, 3, heads, d).transpose(2, 0, 3, 1, 4)
         q, k, v = qkv[0], qkv[1], qkv[2]             # [B, h, S, d]
+        # quantized-cache tier: per-head dequant scales for this layer
+        # ([heads] fp32 each; None = the byte-identical bf16 tier).
+        # _store is the ONE write-site cast every cache mode below
+        # shares: a plain dtype cast on the bf16 tier, symmetric int8
+        # quantization on the quant tier (heads at `axis`).
+        ks = vs = None
+        if kv_scales is not None:
+            ks, vs = kv_scales
+
+        def _store(new, ref_dtype, scale, axis):
+            if scale is None:
+                return jnp.asarray(new, ref_dtype)
+            from apex_tpu.serving.kv_quant import quantize
+            return quantize(new, scale, axis=axis)
+
         if cache is not None:
             paged = len(cache) == 3
             if paged:
@@ -155,21 +181,22 @@ class SelfAttention(nn.Module):
                         axis=1)[:, 0]
                     off = pos % page_len
                     k_cache = k_cache.at[page_ids, :, off].set(
-                        jnp.asarray(k[:, :, 0], k_cache.dtype))
+                        _store(k[:, :, 0], k_cache.dtype, ks, 1))
                     v_cache = v_cache.at[page_ids, :, off].set(
-                        jnp.asarray(v[:, :, 0], v_cache.dtype))
+                        _store(v[:, :, 0], v_cache.dtype, vs, 1))
                     ctx = paged_decode_attention(
                         q[:, :, 0], k_cache, v_cache, page_table,
-                        pos + 1)
+                        pos + 1, k_scale=ks, v_scale=vs)
                 else:
                     bidx = jnp.arange(B)
                     k_cache = k_cache.at[bidx, :, pos].set(
-                        jnp.asarray(k[:, :, 0], k_cache.dtype))
+                        _store(k[:, :, 0], k_cache.dtype, ks, 1))
                     v_cache = v_cache.at[bidx, :, pos].set(
-                        jnp.asarray(v[:, :, 0], v_cache.dtype))
+                        _store(v[:, :, 0], v_cache.dtype, vs, 1))
                     # write-then-attend: the token sees its own K/V
                     ctx = decode_attention(q[:, :, 0], k_cache, v_cache,
-                                           pos + 1)
+                                           pos + 1, k_scale=ks,
+                                           v_scale=vs)
             else:
                 from apex_tpu.kernels.prefill_attention import (
                     prefill_attention, paged_prefill_attention)
@@ -185,11 +212,13 @@ class SelfAttention(nn.Module):
                             axis=1)[:, 0]
                         off = p % page_len
                         k_cache = k_cache.at[page_ids, :, off].set(
-                            jnp.asarray(k[:, :, s], k_cache.dtype))
+                            _store(k[:, :, s], k_cache.dtype, ks, 1))
                         v_cache = v_cache.at[page_ids, :, off].set(
-                            jnp.asarray(v[:, :, s], v_cache.dtype))
+                            _store(v[:, :, s], v_cache.dtype, vs, 1))
                     ctx = paged_prefill_attention(q, k_cache, v_cache,
-                                                  page_table, pos)
+                                                  page_table, pos,
+                                                  k_scale=ks,
+                                                  v_scale=vs)
                 elif paged:
                     # chunk writes must cover whole pages: the serving
                     # engine pins chunk_len % page_len == 0 and page-
@@ -204,16 +233,18 @@ class SelfAttention(nn.Module):
                         npg, dtype=jnp.int32)[None, :]
                     chunk_pages = jnp.take_along_axis(page_table, idx,
                                                       axis=1)  # [B, npg]
-                    def _pages(x, dtype):
-                        return jnp.asarray(x, dtype).reshape(
+                    def _pages(x, dtype, scale):
+                        return _store(x, dtype, scale, 1).reshape(
                             B, heads, npg, page_len, d
                         ).transpose(0, 2, 1, 3, 4)   # [B, npg, h, pl, d]
                     k_cache = k_cache.at[chunk_pages].set(
-                        _pages(k, k_cache.dtype))
+                        _pages(k, k_cache.dtype, ks))
                     v_cache = v_cache.at[chunk_pages].set(
-                        _pages(v, v_cache.dtype))
+                        _pages(v, v_cache.dtype, vs))
                     ctx = paged_prefill_attention(q, k_cache, v_cache,
-                                                  page_table, pos)
+                                                  page_table, pos,
+                                                  k_scale=ks,
+                                                  v_scale=vs)
                 else:
                     # chunked prefill: S tokens land at [pos, pos + S)
                     # of each row's cache (vmapped per-row offsets)
@@ -221,13 +252,29 @@ class SelfAttention(nn.Module):
                         return jax.lax.dynamic_update_slice(row, new,
                                                             (0, p, 0))
                     k_cache = jax.vmap(_write)(
-                        k_cache, jnp.asarray(k, k_cache.dtype), pos)
+                        k_cache, _store(k, k_cache.dtype, ks, 1), pos)
                     v_cache = jax.vmap(_write)(
-                        v_cache, jnp.asarray(v, v_cache.dtype), pos)
-                    ctx = prefill_attention(q, k_cache, v_cache, pos)
+                        v_cache, _store(v, v_cache.dtype, vs, 1), pos)
+                    ctx = prefill_attention(q, k_cache, v_cache, pos,
+                                            k_scale=ks, v_scale=vs)
             out = jnp.moveaxis(ctx.reshape(B, heads, S, d),
                                1, 2).reshape(B, S, heads * d)
         else:
+            if return_kv and ks is not None:
+                # monolithic prefill on the quantized tier: attend (and
+                # return) K/V through the storage grid — quantize then
+                # dequantize with the per-head scales so this forward
+                # sees exactly the values every later attend reads back
+                # out of the int8 cache (chunked prefill writes codes
+                # and attends them in-kernel; without this round-trip
+                # the two ingest paths would attend different K/V and
+                # store divergent codes for every layer past the
+                # first). fp32 keeps the engine's storage quantize an
+                # exact code recovery: round((c*s)/s) == c.
+                from apex_tpu.serving.kv_quant import dequantize, quantize
+                k = dequantize(quantize(k, ks, axis=1), ks, axis=1)
+                v = dequantize(quantize(v, vs, axis=1), vs, axis=1)
+                q = jnp.asarray(q, jnp.float32)
             out = flash_attention(q, k, v, causal=True)  # [B, h, S, d]
             out = jnp.moveaxis(out, 1, 2).reshape(B, S, heads * d)
         out = nn.Dense(self.hidden, dtype=dense_dtype,
@@ -270,7 +317,8 @@ class TransformerBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool, cache=None, positions=None,
-                 return_kv: bool = False, unaligned_append: bool = False):
+                 return_kv: bool = False, unaligned_append: bool = False,
+                 kv_scales=None):
         # FusedLayerNorm resolves 'layer_norm' (FP32) itself from the raw
         # self.dtype; the Dense sites resolve 'linear' (FP16) here
         from apex_tpu.amp.autocast import resolve_dtype
@@ -288,7 +336,8 @@ class TransformerBlock(nn.Module):
                                               positions=positions,
                                               return_kv=return_kv,
                                               unaligned_append=
-                                              unaligned_append)
+                                              unaligned_append,
+                                              kv_scales=kv_scales)
         if cache is not None or return_kv:
             attn_out, aux = attn_out
         x = x + attn_out
@@ -383,7 +432,8 @@ class TransformerLM(nn.Module):
     @nn.compact
     def __call__(self, tokens, *, train: bool = True,
                  features_only: bool = False, cache=None, positions=None,
-                 return_kv: bool = False, unaligned_append: bool = False):
+                 return_kv: bool = False, unaligned_append: bool = False,
+                 kv_scales=None):
         from apex_tpu.amp.autocast import resolve_dtype
         dense_dtype = resolve_dtype(self.dtype, "linear", jnp.float32)
         if self.inference_dtype is not None and not train:
@@ -420,6 +470,13 @@ class TransformerLM(nn.Module):
                               self.dropout, self.dtype, self.param_dtype,
                               self.inference_dtype, self.tp_axis,
                               self.tp_size, name=f"block_{i}")
+            # quantized cache: this layer's per-head scale pair
+            # ([layers, heads] engine arrays sliced at i) — threaded
+            # into BOTH inference modes, so monolithic (return_kv)
+            # prefill attends the same storage grid the cache modes
+            # write and read
+            layer_scales = None if kv_scales is None else \
+                (kv_scales[0][i], kv_scales[1][i])
             if cache is not None:
                 # 2-tuple: per-slot rows [layers, B, h, L, d]; 3-tuple:
                 # paged pools [layers, P, h, page_len, d] + one shared
@@ -429,11 +486,13 @@ class TransformerLM(nn.Module):
                     layer_cache = layer_cache + (cache[2],)
                 x, (lk, lv) = block(x, train, cache=layer_cache,
                                     positions=positions,
-                                    unaligned_append=unaligned_append)
+                                    unaligned_append=unaligned_append,
+                                    kv_scales=layer_scales)
                 kv_out[0].append(lk)
                 kv_out[1].append(lv)
             elif return_kv:
-                x, (lk, lv) = block(x, train, return_kv=True)
+                x, (lk, lv) = block(x, train, return_kv=True,
+                                    kv_scales=layer_scales)
                 kv_out[0].append(lk)
                 kv_out[1].append(lv)
             else:

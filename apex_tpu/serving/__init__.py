@@ -98,13 +98,14 @@ from .engine import Engine, sample_tokens
 from .faults import (FaultPlan, FaultPolicy, FaultSpec, InjectedFault,
                      PoolAuditor, PoolInvariantError)
 from .kv_cache import KVCache, PagedKVCache, PagePool
+from .kv_quant import KVQuantConfig
 from .prefix_cache import PrefixCache, PrefixMatch
 from .scheduler import QueueFull, Request, RequestStatus, Scheduler
 from .speculative import SpecConfig, draft_tokens
 
 __all__ = ["Engine", "FaultPlan", "FaultPolicy", "FaultSpec",
-           "InjectedFault", "KVCache", "PagedKVCache", "PagePool",
-           "PoolAuditor", "PoolInvariantError", "PrefixCache",
-           "PrefixMatch", "QueueFull", "Request", "RequestStatus",
-           "Scheduler", "SpecConfig", "draft_tokens", "sample_tokens",
-           "sharding"]
+           "InjectedFault", "KVCache", "KVQuantConfig", "PagedKVCache",
+           "PagePool", "PoolAuditor", "PoolInvariantError",
+           "PrefixCache", "PrefixMatch", "QueueFull", "Request",
+           "RequestStatus", "Scheduler", "SpecConfig", "draft_tokens",
+           "sample_tokens", "sharding"]

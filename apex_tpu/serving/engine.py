@@ -161,6 +161,7 @@ from apex_tpu.kernels import vmem
 from apex_tpu.log_util import get_logger
 
 from .kv_cache import KVCache, PagedKVCache, PagePool
+from .kv_quant import KVQuantConfig, quantize
 from .prefix_cache import PrefixCache
 from .speculative import SpecConfig
 
@@ -285,6 +286,21 @@ class Engine:
         sharded along heads (``heads % tp == 0`` enforced, as are the
         MLP-inner and vocab splits). ``mesh=None`` (the default) is
         the verbatim single-chip engine.
+    kv_quant:
+        A :class:`~apex_tpu.serving.KVQuantConfig` turning on the
+        quantized cache STORAGE tier (works on both layouts, composes
+        with prefix sharing, speculative verify and ``mesh=``): K/V are
+        stored as int8 with per-``[layer, head]`` fp32 scales carried
+        in the cache pytree — halving pool HBM, so the same bytes hold
+        ~2x the slots/pages — cache writes quantize in-program and the
+        attention kernels dequantize in-kernel. Scales are calibrated
+        (or given) at construction; degenerate calibration (absmax 0 /
+        non-finite) raises HERE. Greedy output becomes a
+        token-match-rate claim vs the bf16 oracle
+        (``bench_serving.py --quantized-kv``); ``kv_quant=None`` (the
+        default) is the bitwise bf16 baseline — none of the quant code
+        is on its trace path. The program set is unchanged either way
+        (dequant is fused, never a new executable).
     top_k:
         Static top-k truncation for sampled (non-greedy) slots; 0 = off.
     registry:
@@ -304,7 +320,8 @@ class Engine:
                  registry=None, paged: bool = True,
                  page_len: Optional[int] = None,
                  num_pages: Optional[int] = None,
-                 spec: Optional[SpecConfig] = None, mesh=None):
+                 spec: Optional[SpecConfig] = None, mesh=None,
+                 kv_quant: Optional[KVQuantConfig] = None):
         from apex_tpu.amp.policy import resolve_policy
 
         if policy is None:
@@ -368,6 +385,23 @@ class Engine:
         heads = int(model.num_heads)
         layers = int(model.num_layers)
         head_dim = hidden // heads
+        # quantized-cache storage tier (independent of the COMPUTE half
+        # dtype the policy picks): int8 K/V with per-[layer, head] fp32
+        # scales, resolved HERE so a degenerate calibration (absmax 0 /
+        # non-finite) is a loud construction error, never NaN output.
+        # Calibration runs on the caller's uncast model/params — absmax
+        # estimation does not need the serving dtype's rounding.
+        self.kv_quant = kv_quant
+        if kv_quant is not None:
+            if not isinstance(kv_quant, KVQuantConfig):
+                raise TypeError(f"kv_quant must be a KVQuantConfig, "
+                                f"got {type(kv_quant).__name__}")
+            k_scale, v_scale = kv_quant.resolve_scales(
+                model, params, layers=layers, heads=heads)
+            cache_dtype = jnp.dtype(kv_quant.dtype)
+        else:
+            k_scale = v_scale = None
+            cache_dtype = half
         self.mesh = mesh
         if mesh is not None:
             from . import sharding as _sharding
@@ -433,7 +467,8 @@ class Engine:
             if mesh is None:
                 self.cache = PagedKVCache.create(
                     layers=layers, num_pages=num_pages, heads=heads,
-                    page_len=page_len, head_dim=head_dim, dtype=half)
+                    page_len=page_len, head_dim=head_dim,
+                    dtype=cache_dtype, k_scale=k_scale, v_scale=v_scale)
             else:
                 # heads-axis pool sharding: each shard holds
                 # [layers, num_pages, heads/tp, page_len, head_dim] —
@@ -441,12 +476,25 @@ class Engine:
                 # the allocator stay replicated host state. Allocated
                 # DIRECTLY into the sharded layout (zeros_sharded): a
                 # pool sized to aggregate HBM — the point of sharding
-                # it — must never transit one chip whole.
+                # it — must never transit one chip whole. Quantization
+                # scales shard ALONG the pool's heads axis
+                # ([layers, heads/tp] per shard), so each shard
+                # de/quantizes its own heads collective-free.
                 shape = (layers, num_pages, heads, page_len, head_dim)
                 pspec = _sharding.cache_pspec(self._tp_axis)
+                if k_scale is not None:
+                    sspec = _sharding.scale_pspec(self._tp_axis)
+                    from jax.sharding import NamedSharding
+                    k_scale = jax.device_put(
+                        k_scale, NamedSharding(mesh, sspec))
+                    v_scale = jax.device_put(
+                        v_scale, NamedSharding(mesh, sspec))
                 self.cache = PagedKVCache(
-                    k=_sharding.zeros_sharded(shape, half, mesh, pspec),
-                    v=_sharding.zeros_sharded(shape, half, mesh, pspec))
+                    k=_sharding.zeros_sharded(shape, cache_dtype, mesh,
+                                              pspec),
+                    v=_sharding.zeros_sharded(shape, cache_dtype, mesh,
+                                              pspec),
+                    k_scale=k_scale, v_scale=v_scale)
             self.pool = PagePool(num_pages, page_len)
             self._page_table = np.zeros((self.slots, self.max_pages),
                                         np.int32)
@@ -469,7 +517,7 @@ class Engine:
             self.cache = KVCache.create(
                 layers=layers, slots=self.slots + self.prefix_pool,
                 heads=heads, max_len=self.max_len, head_dim=head_dim,
-                dtype=half)
+                dtype=cache_dtype, k_scale=k_scale, v_scale=v_scale)
             self.prefix_cache = None if self.prefix_pool == 0 else \
                 PrefixCache(
                     block_len=self.chunk_len,
@@ -527,7 +575,7 @@ class Engine:
                 f", tp={self.tp}" if mesh is not None else "",
                 self.slots, self.max_len, self.prefill_len,
                 self.chunk_len, self.page_len, self.num_pages,
-                self.prefix_pool, np.dtype(half).name,
+                self.prefix_pool, np.dtype(cache_dtype).name,
                 self.cache.nbytes() / 2**20,
                 f", {self.cache.nbytes() / self.tp / 2**20:.1f}/shard"
                 if mesh is not None else "", self.top_k)
@@ -546,10 +594,11 @@ class Engine:
                 " chunk_len=%d, prefix_pool=%d, cache %s (%.1f MiB), "
                 "top_k=%d",
                 self.slots, self.max_len, self.prefill_len,
-                self.chunk_len, self.prefix_pool, np.dtype(half).name,
+                self.chunk_len, self.prefix_pool, np.dtype(cache_dtype).name,
                 self.cache.nbytes() / 2**20, self.top_k)
 
         self._emit_tp_gauges()
+        self._emit_kv_gauges()
 
     # --------------------------------------------------- tensor parallelism
     def _tp_wrap(self, fn, n_extra_out: int):
@@ -566,10 +615,16 @@ class Engine:
 
         from apex_tpu.utils.compat import shard_map
 
-        from .sharding import cache_pspec
+        from .sharding import cache_pspec, scale_pspec
 
-        cspec = PagedKVCache(k=cache_pspec(self._tp_axis),
-                             v=cache_pspec(self._tp_axis))
+        # the cache pytree's spec mirrors its structure: pool arrays on
+        # the heads axis, quantization scales (when present) on THEIR
+        # heads axis, None fields stay None
+        quant = self.kv_quant is not None
+        cspec = PagedKVCache(
+            k=cache_pspec(self._tp_axis), v=cache_pspec(self._tp_axis),
+            k_scale=scale_pspec(self._tp_axis) if quant else None,
+            v_scale=scale_pspec(self._tp_axis) if quant else None)
 
         def wrapped(params, cache, *rest):
             return shard_map(
@@ -615,6 +670,29 @@ class Engine:
         self._registry.gauge_set("serving.tp.pool_pages_per_shard",
                                  float(self.num_pages))
 
+    def _emit_kv_gauges(self) -> None:
+        """The ``serving.kv.*`` telemetry snapshot: per-token cache
+        bytes (``layers * heads * head_dim * itemsize * 2`` — the
+        number the quantized tier halves, and the basis of the bench's
+        bytes-per-token reduction claim) and, on a quantized engine,
+        the largest absolute value the calibrated scales can represent
+        (``max(scale) * 127`` — a drifting workload whose true absmax
+        exceeds this is CLIPPING, the dashboard signal to recalibrate).
+        """
+        if self._registry is None:
+            return
+        c = self.cache
+        per_token = c.layers * c.heads * c.head_dim \
+            * np.dtype(c.dtype).itemsize * 2
+        self._registry.gauge_set("serving.kv.bytes_per_token",
+                                 float(per_token))
+        if c.k_scale is not None:
+            from .kv_quant import QMAX
+            absmax = max(float(jnp.max(c.k_scale)),
+                         float(jnp.max(c.v_scale))) * QMAX
+            self._registry.gauge_set("serving.kv.quant_scale_absmax",
+                                     absmax)
+
     @property
     def compiled_programs(self) -> int:
         """Distinct XLA executables traced so far (the compile-count
@@ -638,11 +716,36 @@ class Engine:
     # +0.0 to an fp32 row is value-identical, so clean-path tokens are
     # unchanged — and NaN/Inf under a FaultPlan injection, which makes
     # the in-program guard see REAL non-finite logits.
+    def _kv_scales_of(self, cache):
+        """The ``(k_scale, v_scale)`` pair the quantized tier threads
+        into the model's cache modes; None on the bf16 default (a
+        static, trace-time choice — quantization is an engine property,
+        not an operand)."""
+        if cache.k_scale is None:
+            return None
+        return (cache.k_scale, cache.v_scale)
+
+    def _quantize_prefill_kv(self, cache, k_new, v_new):
+        """Quantize a prefill's stacked ``[layers, B, heads, P, d]``
+        K/V into the cache's int8 codes (identity on the bf16 tier):
+        the one STORAGE cast the model does not perform itself, because
+        ``return_kv`` prefill never sees the cache. The model has
+        already round-tripped these values through the scale grid
+        (``kv_scales`` in the ``return_kv`` forward), so this quantize
+        is an exact code recovery — the bytes stored here are the bytes
+        chunked prefill would have written."""
+        if cache.k_scale is None:
+            return k_new, v_new
+        return (quantize(k_new, cache.k_scale[:, None, :, None, None]),
+                quantize(v_new, cache.v_scale[:, None, :, None, None]))
+
     def _prefill_impl(self, params, cache, tokens, length, slot,
                       temperature, key):
         self.prefill_traces += 1    # python body runs at trace time only
         logits, (k_new, v_new) = self._model.apply(
-            {"params": params}, tokens, train=False, return_kv=True)
+            {"params": params}, tokens, train=False, return_kv=True,
+            kv_scales=self._kv_scales_of(cache))
+        k_new, v_new = self._quantize_prefill_kv(cache, k_new, v_new)
         cache = cache.insert(slot, k_new, v_new, length)
         last = jax.lax.dynamic_index_in_dim(logits[0], length - 1,
                                             keepdims=False)        # [V]
@@ -659,7 +762,8 @@ class Engine:
         offset = jnp.asarray(offset, jnp.int32)
         logits, (k2, v2) = self._model.apply(
             {"params": params}, tokens, train=False,
-            cache=(k_slot, v_slot), positions=offset[None])
+            cache=(k_slot, v_slot), positions=offset[None],
+            kv_scales=self._kv_scales_of(cache))
         cache = cache.write_slot(slot, k2, v2, offset + n_valid)
         # sample at the last VALID row: the request's first token when
         # this is the prompt's final chunk, discarded by the host
@@ -684,7 +788,8 @@ class Engine:
                                 self.max_len - 1)
         logits, (k2, v2) = self._model.apply(
             {"params": params}, last_tokens[:, None], train=False,
-            cache=cache.front_view(self.slots), positions=positions)
+            cache=cache.front_view(self.slots), positions=positions,
+            kv_scales=self._kv_scales_of(cache))
         rows = jnp.asarray(logits[:, 0, :], jnp.float32) \
             + fault_bias[:, None]
         finite = jnp.all(jnp.isfinite(rows), axis=-1)         # [slots]
@@ -725,7 +830,8 @@ class Engine:
         offsets = cache.lengths[:self.slots]
         logits, (k2, v2) = self._model.apply(
             {"params": params}, tokens, train=False,
-            cache=cache.front_view(self.slots), positions=offsets)
+            cache=cache.front_view(self.slots), positions=offsets,
+            kv_scales=self._kv_scales_of(cache))
         rows = jnp.asarray(logits, jnp.float32) \
             + fault_bias[:, None, None]
         finite = jnp.all(jnp.isfinite(rows), axis=(1, 2))     # [slots]
@@ -761,7 +867,9 @@ class Engine:
                             temperature, key):
         self.prefill_traces += 1    # python body runs at trace time only
         logits, (k_new, v_new) = self._model.apply(
-            {"params": params}, tokens, train=False, return_kv=True)
+            {"params": params}, tokens, train=False, return_kv=True,
+            kv_scales=self._kv_scales_of(cache))
+        k_new, v_new = self._quantize_prefill_kv(cache, k_new, v_new)
         # scatter the padded [0, prefill_len) window into the slot's
         # pages: m whole pages, ids from the (traced) page-table row
         pl_ = self.page_len
@@ -796,7 +904,8 @@ class Engine:
         offset = jnp.asarray(offset, jnp.int32)
         logits, (k2, v2) = self._model.apply(
             {"params": params}, tokens, train=False,
-            cache=(cache.k, cache.v, pt_row), positions=offset[None])
+            cache=(cache.k, cache.v, pt_row), positions=offset[None],
+            kv_scales=self._kv_scales_of(cache))
         cache = cache.replace(k=k2, v=v2)
         # sample at the last VALID row (see _chunk_impl)
         last = jax.lax.dynamic_index_in_dim(logits[0], n_valid - 1,
@@ -819,7 +928,8 @@ class Engine:
         positions = jnp.minimum(lengths, self.max_len - 1)
         logits, (k2, v2) = self._model.apply(
             {"params": params}, last_tokens[:, None], train=False,
-            cache=(cache.k, cache.v, page_table), positions=positions)
+            cache=(cache.k, cache.v, page_table), positions=positions,
+            kv_scales=self._kv_scales_of(cache))
         rows = self._gather_logits(jnp.asarray(logits[:, 0, :],
                                                jnp.float32)) \
             + fault_bias[:, None]
@@ -842,7 +952,8 @@ class Engine:
         logits, (k2, v2) = self._model.apply(
             {"params": params}, tokens, train=False,
             cache=(cache.k, cache.v, page_table), positions=lengths,
-            unaligned_append=True)
+            unaligned_append=True,
+            kv_scales=self._kv_scales_of(cache))
         cache = cache.replace(k=k2, v=v2)
         rows = self._gather_logits(jnp.asarray(logits, jnp.float32)) \
             + fault_bias[:, None, None]
@@ -1519,6 +1630,7 @@ class Engine:
         so first-trace latency never poisons the serving histograms)."""
         self._registry = registry
         self._emit_tp_gauges()
+        self._emit_kv_gauges()
 
     def reset(self, clear_prefixes: bool = False) -> None:
         """Zero the serving-slot lengths (slot table wipe; K/V left in

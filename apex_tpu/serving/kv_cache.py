@@ -85,11 +85,21 @@ __all__ = ["KVCache", "PagedKVCache", "PagePool"]
 
 @flax.struct.dataclass
 class KVCache:
-    """Slot-major KV cache pytree (see module docstring for semantics)."""
+    """Slot-major KV cache pytree (see module docstring for semantics).
+
+    ``k_scale``/``v_scale`` (both None by default) are the quantized
+    storage tier's per-``[layer, head]`` fp32 dequantization scales
+    (:mod:`apex_tpu.serving.kv_quant`): when set, ``k``/``v`` hold int8
+    codes and every reader multiplies through the matching scale. They
+    ride the pytree so the donated cache stays self-describing; an
+    unquantized cache flattens to exactly the same three leaves as
+    before."""
 
     k: jnp.ndarray        # [layers, slots, heads, max_len, head_dim]
     v: jnp.ndarray        # [layers, slots, heads, max_len, head_dim]
     lengths: jnp.ndarray  # [slots] int32
+    k_scale: Optional[jnp.ndarray] = None   # [layers, heads] fp32
+    v_scale: Optional[jnp.ndarray] = None   # [layers, heads] fp32
 
     # ------------------------------------------------------------- geometry
     @property
@@ -123,13 +133,17 @@ class KVCache:
     # -------------------------------------------------------------- updates
     @classmethod
     def create(cls, *, layers: int, slots: int, heads: int, max_len: int,
-               head_dim: int, dtype: Any = jnp.bfloat16) -> "KVCache":
+               head_dim: int, dtype: Any = jnp.bfloat16,
+               k_scale=None, v_scale=None) -> "KVCache":
         """Allocate a zeroed cache. ``dtype`` is normally the amp half
         dtype (``policy.half_dtype`` / ``compute_dtype`` — the serving
-        engine resolves it from its policy)."""
+        engine resolves it from its policy), or int8 with the
+        ``k_scale``/``v_scale`` pair when the engine's
+        :class:`~apex_tpu.serving.KVQuantConfig` tier is on."""
         shape = (layers, slots, heads, max_len, head_dim)
         return cls(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
-                   lengths=jnp.zeros((slots,), jnp.int32))
+                   lengths=jnp.zeros((slots,), jnp.int32),
+                   k_scale=k_scale, v_scale=v_scale)
 
     def insert(self, slot, k_new, v_new, length) -> "KVCache":
         """Write a prefilled request into ``slot``: ``k_new``/``v_new``
@@ -288,6 +302,11 @@ class PagedKVCache:
 
     k: jnp.ndarray        # [layers, num_pages, heads, page_len, head_dim]
     v: jnp.ndarray        # [layers, num_pages, heads, page_len, head_dim]
+    # quantized storage tier (kv_quant): per-[layer, head] fp32 dequant
+    # scales; None on the bf16 default. Per-head — NOT per-page — so a
+    # copy-on-write share never copies scale state alongside its pages.
+    k_scale: Optional[jnp.ndarray] = None   # [layers, heads] fp32
+    v_scale: Optional[jnp.ndarray] = None   # [layers, heads] fp32
 
     # ------------------------------------------------------------- geometry
     @property
@@ -320,16 +339,19 @@ class PagedKVCache:
 
     @classmethod
     def create(cls, *, layers: int, num_pages: int, heads: int,
-               page_len: int, head_dim: int,
-               dtype: Any = jnp.bfloat16) -> "PagedKVCache":
+               page_len: int, head_dim: int, dtype: Any = jnp.bfloat16,
+               k_scale=None, v_scale=None) -> "PagedKVCache":
         """Allocate a zeroed pool (``dtype`` normally the amp half
-        dtype). ``num_pages`` INCLUDES the page-0 sentinel, so the
-        usable capacity is ``(num_pages - 1) * page_len`` positions."""
+        dtype, or int8 with the scale pair under the engine's
+        ``kv_quant`` tier). ``num_pages`` INCLUDES the page-0 sentinel,
+        so the usable capacity is ``(num_pages - 1) * page_len``
+        positions."""
         if num_pages < 2:
             raise ValueError("num_pages must be >= 2 (page 0 is the "
                              "sentinel/garbage page)")
         shape = (layers, num_pages, heads, page_len, head_dim)
-        return cls(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+        return cls(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
+                   k_scale=k_scale, v_scale=v_scale)
 
     def layer_view(self):
         """The ``(k, v)`` pool pair the paged model path consumes."""

@@ -70,7 +70,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
 __all__ = ["partition_rules", "match_partition_rules", "shard_params",
-           "cache_pspec", "shard_cache", "zeros_sharded",
+           "cache_pspec", "scale_pspec", "shard_cache", "zeros_sharded",
            "expected_collectives", "tp_axis_of", "validate_tp_geometry"]
 
 # host-side transforms a plain even split cannot express, keyed by the
@@ -233,6 +233,15 @@ def cache_pspec(axis: str = "tp") -> PartitionSpec:
     unchanged over fewer heads; page tables and lengths stay replicated
     host state)."""
     return PartitionSpec(None, None, axis, None, None)
+
+
+def scale_pspec(axis: str = "tp") -> PartitionSpec:
+    """The quantized-cache tier's scale spec: per-``[layer, head]``
+    dequantization scales split along the SAME heads axis as the pool
+    (``[layers, heads/tp]`` per shard), so every shard quantizes and
+    dequantizes its own heads with its own slice — the int8 tier adds
+    zero collectives, exactly like the pool sharding itself."""
+    return PartitionSpec(None, axis)
 
 
 def shard_cache(cache, mesh, axis: str = None):

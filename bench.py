@@ -38,12 +38,16 @@ counts and ``token_mismatched_requests`` (expected 0) via
 sub-object (BENCH_SERVING_SPEC=0 to drop it): draft-and-verify
 acceptance rate and tokens-per-slot-step vs plain decode with
 ``token_mismatched_requests`` (expected 0, bitwise) via
-``bench_serving.spec_stats``, and a nested ``tensor_parallel``
+``bench_serving.spec_stats``, a nested ``tensor_parallel``
 sub-object (BENCH_SERVING_TP=0 to drop it; BENCH_SERVING_TP=N sizes
 the mesh): tp=1 vs tp=N CPU device emulation — per-shard KV HBM
 bytes, collective inventory, ``token_mismatched_requests`` (expected
 0) — run as a subprocess because the mesh leg must force emulated CPU
-devices before any backend initializes. Failure-isolated at every
+devices before any backend initializes, and a nested ``quantized_kv``
+sub-object (BENCH_SERVING_QUANT=0 to drop it): the int8-capacity leg
+— KV-bytes-per-token reduction, concurrency both modes,
+``token_match_rate`` vs the bf16 oracle — via
+``bench_serving.quantized_kv_stats``. Failure-isolated at every
 layer: a broken serving stack puts {"error": ...} there, never kills
 the ResNet row.
 """
@@ -158,6 +162,14 @@ _SERVING_SPEC_SMOKE = {
     "PREFILL_LEN": 32, "REQUESTS": 6, "NEW_TOKENS": 8, "WINDOWS": 1,
 }
 
+# The quantized-KV sub-leg's smoke geometry (the shared-prefix stream
+# served twice — bf16 oracle + int8 — so it matches its siblings'
+# sizing; BENCH_SERVING_QUANT_SLOTS et al. still win, env-beats-smoke)
+_SERVING_QUANT_SMOKE = {
+    "SIZE": "tiny", "VOCAB": 512, "SLOTS": 4, "MAX_LEN": 128,
+    "PREFILL_LEN": 32, "REQUESTS": 6, "NEW_TOKENS": 8, "WINDOWS": 1,
+}
+
 
 def _serving_leg() -> dict:
     """The serving trajectory row (ROADMAP: bench_serving.py had no
@@ -182,6 +194,7 @@ def _serving_leg() -> dict:
         out["chaos"] = _serving_chaos_leg()
         out["speculative"] = _serving_spec_leg()
         out["tensor_parallel"] = _serving_tp_leg()
+        out["quantized_kv"] = _serving_quant_leg()
         return out
     except KeyboardInterrupt:
         raise
@@ -233,6 +246,36 @@ def _serving_spec_leg() -> dict:
             "tokens_per_step_plain", "multi_turn_acceptance_rate",
             "multi_turn_tokens_per_step", "token_mismatched_requests",
             "spec_k", "verify_traces")}
+    except KeyboardInterrupt:
+        raise
+    except BaseException as e:  # noqa: BLE001 — the row must not die here
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def _serving_quant_leg() -> dict:
+    """The quantized-KV trajectory sub-row: smoke-sized int8-capacity
+    summary (bf16 oracle vs int8 engine at identical pool bytes —
+    KV-bytes-per-token reduction, concurrency both modes, greedy
+    token-match-rate) from ``bench_serving.quantized_kv_stats``.
+    BENCH_SERVING_QUANT=0 drops it; failure-isolated like its siblings
+    — a broken quant tier yields {"error": ...} here, never a lost
+    serving (or ResNet) row."""
+    if _env_int("BENCH_SERVING_QUANT", "1") == 0:
+        return {"skipped": True}
+    try:
+        import bench_serving
+
+        bench_serving._load_env(smoke=dict(_SERVING_QUANT_SMOKE))
+        _, summary = bench_serving.quantized_kv_stats()
+        return {k: summary[k] for k in (
+            "value", "unit", "baseline_tokens_per_s", "token_match_rate",
+            "token_mismatched_requests", "kv_bytes_per_token",
+            "kv_bytes_per_token_bf16", "kv_bytes_per_token_reduction_pct",
+            "hbm_bytes_per_request", "hbm_bytes_per_request_bf16",
+            "hbm_bytes_per_request_reduction_pct",
+            "max_concurrent_requests", "max_concurrent_requests_bf16",
+            "slots", "slots_bf16", "pool_mib", "quant_scale_absmax",
+            "model")}
     except KeyboardInterrupt:
         raise
     except BaseException as e:  # noqa: BLE001 — the row must not die here

@@ -134,6 +134,26 @@ token-exact by tests/L0/test_sharding.py). Defaults to a smoke
 geometry; env knobs resize it (env-beats-smoke), ``BENCH_SERVING_TP``
 sets the shard count (default 2).
 
+``--quantized-kv`` runs the int8-capacity leg: the shared-prefix greedy
+stream served twice — the bf16 engine (``kv_quant=None``, the bitwise
+oracle) and the int8 engine (``KVQuantConfig`` calibrated on the shared
+prefix) given the SAME physical pool bytes but
+``BENCH_SERVING_QUANT_SLOTS`` (default 2x) decode slots, possible
+because int8 halves bytes-per-position. One row per mode plus a final
+line whose payoff fields are ``kv_bytes_per_token_reduction_pct`` (50
+by construction — the >= 45% acceptance bar), ``hbm_bytes_per_request``
+both modes, ``max_concurrent_requests`` both modes,
+``quant_scale_absmax``, and ``token_match_rate`` — positionwise greedy
+agreement vs the bf16 oracle (the TOLERANCE contract the quantized
+tier trades bitwise parity for; the bf16 default itself stays
+bitwise). Throughput regime note: the int8 engine's wider decode batch
+costs MORE per step on the CPU fallback (reference kernels dequantize
+by materialising; decode attends every slot), so quantized tokens/s
+reads flat-to-worse here — capacity, bytes and match-rate are the
+CPU-honest columns, tokens/s is the TPU rows' claim (half the cache
+DMA per attended token). Defaults to a smoke geometry; env knobs
+resize it (env-beats-smoke).
+
 Wrapped in ``guard_bench_main`` — EVERY outcome (backend init failure,
 OOM, bad env) still ends in a parseable JSON line.
 """
@@ -155,6 +175,7 @@ PAGED_METRIC = "serving_paged_pool_tokens_per_sec"
 CHAOS_METRIC = "serving_chaos_goodput_tokens_per_sec"
 SPEC_METRIC = "serving_speculative_tokens_per_sec"
 TP_METRIC = "serving_tensor_parallel_tokens_per_sec"
+QUANT_METRIC = "serving_quantized_kv_tokens_per_sec"
 
 # Literal defaults at import time; the BENCH_SERVING_* env overrides are
 # parsed by _load_env() INSIDE each guarded main, so a malformed value
@@ -208,6 +229,14 @@ TP = 2
 TP_SMOKE = {"SIZE": "tiny", "VOCAB": 512, "SLOTS": 4, "MAX_LEN": 128,
             "PREFILL_LEN": 32, "REQUESTS": 6, "NEW_TOKENS": 12,
             "WINDOWS": 1}
+# --quantized-kv leg: int8 decode width over the SAME pool bytes as
+# the bf16 baseline's SLOTS (0 -> 2x: int8 halves bytes-per-position,
+# so identical bytes hold twice the pages) and its smoke preset — the
+# leg serves the shared-prefix stream twice (bf16 oracle, then int8)
+QUANT_SLOTS = 0
+QUANT_SMOKE = {"SIZE": "tiny", "VOCAB": 512, "SLOTS": 4, "MAX_LEN": 128,
+               "PREFILL_LEN": 32, "REQUESTS": 8, "NEW_TOKENS": 12,
+               "WINDOWS": 1}
 
 _ENV_KNOBS = {
     "VOCAB": "BENCH_SERVING_VOCAB", "SLOTS": "BENCH_SERVING_SLOTS",
@@ -226,6 +255,7 @@ _ENV_KNOBS = {
     "FAULT_PCT": "BENCH_SERVING_FAULT_PCT",
     "SPEC_K": "BENCH_SERVING_SPEC_K",
     "TP": "BENCH_SERVING_TP",
+    "QUANT_SLOTS": "BENCH_SERVING_QUANT_SLOTS",
 }
 
 
@@ -490,14 +520,16 @@ def main_mixed():
     }))
 
 
-def _shared_prefix_requests(rng):
+def _shared_prefix_requests(rng, shared=None):
     """Repeated-system-prompt arrivals: every prompt opens with THE SAME
-    shared prefix (drawn once per leg from the mode-independent seed)
-    followed by a short unique tail — the traffic shape where
-    content-addressed prefix reuse pays."""
+    shared prefix (drawn once per leg from the mode-independent seed;
+    ``shared`` overrides the module global for legs that carry their
+    own prefix, e.g. --quantized-kv) followed by a short unique tail —
+    the traffic shape where content-addressed prefix reuse pays."""
     from apex_tpu.serving import Request
 
-    shared = _SHARED_TOKENS
+    if shared is None:
+        shared = _SHARED_TOKENS
     reqs = []
     for _ in range(REQUESTS):
         tail = max(1, PREFILL_LEN - len(shared))
@@ -649,29 +681,36 @@ def _short_requests(rng):
     return reqs
 
 
-def _serve_paged_leg(paged: bool, slots: int, num_pages=None):
-    """One mode of the --paged-pool leg: WINDOWS measured windows (plus
-    compile warmup) of the short-prompt stream, tracking the peak
-    number of in-flight (prefilling + running) requests per window and,
-    on the paged engine, peak pages_in_use."""
+def _serve_paged_leg(paged: bool, slots: int, num_pages=None, *,
+                     requests_fn=_short_requests, seed: int = 3,
+                     retain_prefixes: bool = False, **engine_kw):
+    """One mode of the --paged-pool (and, parameterized, --quantized-kv)
+    leg: WINDOWS measured windows (plus compile warmup) of the
+    ``requests_fn`` stream, tracking the peak number of in-flight
+    (prefilling + running) requests per window and, on the paged
+    engine, peak pages_in_use. ``retain_prefixes`` serves with prefix
+    retention on and clears the prefix pool between windows (identical
+    cold start per mode — cross-mode comparisons stay
+    window-for-window honest); extra kwargs reach the Engine."""
     from apex_tpu import serving, telemetry
 
     reg = telemetry.MetricsRegistry()
-    kw = {"paged": paged}
+    kw = {"paged": paged, **engine_kw}
     if paged and num_pages is not None:
         kw["num_pages"] = num_pages
     engine = _build_engine(slots=slots, **kw)
-    rng = np.random.default_rng(3)
+    rng = np.random.default_rng(seed)
     rates, all_reqs = [], []
     peak_inflight = peak_pages = 0
     for w in range(WINDOWS + 1):
-        engine.reset()
+        engine.reset(clear_prefixes=retain_prefixes)
         if w == 1:
             engine.set_registry(reg)
         sched = serving.Scheduler(engine, max_queue=max(REQUESTS, 1),
                                   registry=reg if w else None,
-                                  chunk_budget=CHUNK_BUDGET)
-        reqs = _short_requests(rng)
+                                  chunk_budget=CHUNK_BUDGET,
+                                  retain_prefixes=retain_prefixes)
+        reqs = requests_fn(rng)
         t0 = time.perf_counter()
         tok0 = engine.tokens_generated
         for r in reqs:
@@ -1102,6 +1141,134 @@ def main_spec():
     print(json.dumps(summary))
 
 
+def quantized_kv_stats():
+    """The --quantized-kv measurement, reusable by bench.py's serving
+    trajectory leg: the shared-prefix greedy stream served by the bf16
+    engine (``kv_quant=None`` — the bitwise oracle) and by the int8
+    engine (``KVQuantConfig`` calibrated on the shared prefix) given
+    the SAME physical pool bytes but ~2x the decode slots — possible
+    because int8 halves bytes-per-position. Headline fields:
+    ``kv_bytes_per_token`` both modes + reduction pct (the >= 45%
+    acceptance bar; 50% by construction), ``hbm_bytes_per_request``
+    both modes, ``max_concurrent_requests`` both modes, and
+    ``token_match_rate`` — positionwise greedy agreement vs the bf16
+    oracle (the tolerance contract; ``kv_quant=None`` stays bitwise).
+    CPU-regime caveat: the int8 engine's wider decode batch costs MORE
+    per step on the CPU fallback, so judge tokens/s on TPU rows —
+    capacity, bytes and match-rate are the leg's claim."""
+    from apex_tpu import telemetry
+    from apex_tpu.serving import KVQuantConfig
+    from apex_tpu.serving.engine import resolve_page_len
+
+    # replicate the Engine's chunk_len default EXACTLY (incl. the
+    # spill-to-single-chunk degrade) — same discipline as the paged leg
+    chunk = CHUNK_LEN or min(PREFILL_LEN, 256)
+    if not CHUNK_LEN and -(-PREFILL_LEN // chunk) * chunk > MAX_LEN:
+        chunk = PREFILL_LEN
+    page_len = resolve_page_len(chunk)
+    num_pages = SLOTS * MAX_LEN // page_len
+    quant_slots = QUANT_SLOTS or SLOTS * 2
+    rng0 = np.random.default_rng(7)
+    shared_len = min(SHARED_PREFIX, PREFILL_LEN - 1)
+    shared = rng0.integers(1, VOCAB, size=shared_len).tolist()
+    # calibrate on the stream's own shared prefix — representative
+    # traffic beats the seeded random fallback, exactly the guidance
+    # docs/serving.md gives operators
+    cfg = KVQuantConfig(calibration_tokens=list(shared))
+    rows, outputs = {}, {}
+    for mode in ("bf16", "int8"):
+        quant = mode == "int8"
+        rate, reqs, engine, peak_inflight, peak_pages = _serve_paged_leg(
+            True, quant_slots if quant else SLOTS,
+            # identical pool BYTES: int8 positions cost half a bf16
+            # position, so the same budget holds 2x the pages
+            num_pages * 2 if quant else num_pages,
+            requests_fn=lambda r: _shared_prefix_requests(r, shared),
+            seed=6, retain_prefixes=True, prefix_pool=PREFIX_POOL,
+            kv_quant=cfg if quant else None)
+        # the serving.kv.* gauges ARE the capacity-claim numbers — read
+        # them from the engine's own emitter rather than re-deriving
+        # the formulas here
+        reg = telemetry.MetricsRegistry()
+        engine.set_registry(reg)
+        gauges = reg.snapshot()["gauges"]
+        per_pos = engine.cache.nbytes() \
+            / (engine.num_pages * engine.page_len)
+        demands = [engine.pages_required(len(r.prompt),
+                                         r.max_new_tokens)
+                   * engine.page_len for r in reqs]
+        rows[mode] = {
+            "metric": f"{QUANT_METRIC}.{mode}",
+            "value": round(rate, 2),
+            "unit": "tokens/s",
+            "slots": engine.slots,
+            "cache_dtype": np.dtype(engine.cache.dtype).name,
+            "kv_bytes_per_token":
+                int(gauges["serving.kv.bytes_per_token"]),
+            "hbm_bytes_per_request": round(float(np.mean(demands))
+                                           * per_pos),
+            "pool_mib": round(engine.cache.nbytes() / 2**20, 2),
+            "num_pages": engine.num_pages,
+            "max_concurrent_requests": peak_inflight,
+            "peak_pages_in_use": peak_pages,
+            "compiled_programs": engine.compiled_programs,
+        }
+        if quant:
+            rows[mode]["quant_scale_absmax"] = round(
+                gauges["serving.kv.quant_scale_absmax"], 4)
+        outputs[mode] = [list(r.output_tokens) for r in reqs]
+    tot = hit = mismatched = 0
+    for a, b in zip(outputs["bf16"], outputs["int8"]):
+        tot += max(len(a), len(b))
+        hit += sum(int(x == y) for x, y in zip(a, b))
+        mismatched += int(a != b)
+    bf, q8 = rows["bf16"], rows["int8"]
+    summary = {
+        "metric": QUANT_METRIC,
+        "value": q8["value"],
+        "unit": "tokens/s",
+        "baseline_tokens_per_s": bf["value"],
+        "token_match_rate": round(hit / tot, 4) if tot else 1.0,
+        "token_mismatched_requests": mismatched,
+        "kv_bytes_per_token": q8["kv_bytes_per_token"],
+        "kv_bytes_per_token_bf16": bf["kv_bytes_per_token"],
+        "kv_bytes_per_token_reduction_pct": round(
+            (1.0 - q8["kv_bytes_per_token"]
+             / bf["kv_bytes_per_token"]) * 100.0, 1)
+        if bf["kv_bytes_per_token"] else 0.0,
+        "hbm_bytes_per_request": q8["hbm_bytes_per_request"],
+        "hbm_bytes_per_request_bf16": bf["hbm_bytes_per_request"],
+        "hbm_bytes_per_request_reduction_pct": round(
+            (1.0 - q8["hbm_bytes_per_request"]
+             / bf["hbm_bytes_per_request"]) * 100.0, 1)
+        if bf["hbm_bytes_per_request"] else 0.0,
+        "max_concurrent_requests": q8["max_concurrent_requests"],
+        "max_concurrent_requests_bf16": bf["max_concurrent_requests"],
+        "slots": q8["slots"],
+        "slots_bf16": bf["slots"],
+        "pool_mib": q8["pool_mib"],
+        "pool_mib_bf16": bf["pool_mib"],
+        "quant_scale_absmax": q8["quant_scale_absmax"],
+        "windows": WINDOWS,
+        "requests_per_window": REQUESTS,
+        "shared_prefix_len": shared_len,
+        "model": SIZE,
+    }
+    return rows, summary
+
+
+def main_quant():
+    import jax
+
+    _load_env(smoke=dict(QUANT_SMOKE))
+
+    rows, summary = quantized_kv_stats()
+    for mode in ("bf16", "int8"):
+        print(json.dumps(rows[mode]))
+    summary["backend"] = jax.default_backend()
+    print(json.dumps(summary))
+
+
 def _ensure_cpu_devices(n: int) -> None:
     """Force the CPU backend with >= ``n`` emulated devices BEFORE the
     first backend initialization (XLA reads ``XLA_FLAGS`` when a client
@@ -1265,5 +1432,7 @@ if __name__ == "__main__":
         guard_bench_main(main_spec, SPEC_METRIC)
     elif "--tensor-parallel" in sys.argv[1:]:
         guard_bench_main(main_tp, TP_METRIC)
+    elif "--quantized-kv" in sys.argv[1:]:
+        guard_bench_main(main_quant, QUANT_METRIC)
     else:
         guard_bench_main(main, METRIC)

@@ -129,3 +129,35 @@ def test_jit_and_explicit_block_k():
     ref = decode_attention_reference(q, k, v, lens, scale=1.0 / d ** 0.5)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_int8_dequant_in_kernel_matches_dequant_oracle():
+    """The quantized-cache tier (kv_quant): int8 K/V + per-head scales
+    through the SAME kernel, dequantized in-kernel, vs quantizing the
+    oracle's inputs up front — same math, fused vs materialised. Also
+    pins that garbage int8 past a row's length stays masked."""
+    rng = np.random.default_rng(11)
+    B, h, L, d = 3, 4, 256, 16
+    q = _rand(rng, (B, h, d))
+    k8 = jnp.asarray(rng.integers(-127, 128, size=(B, h, L, d)), jnp.int8)
+    v8 = jnp.asarray(rng.integers(-127, 128, size=(B, h, L, d)), jnp.int8)
+    ks = jnp.asarray(rng.uniform(0.01, 0.06, size=h), jnp.float32)
+    vs = jnp.asarray(rng.uniform(0.01, 0.06, size=h), jnp.float32)
+    lens = jnp.asarray([1, 37, 256], jnp.int32)
+    ref = decode_attention_reference(
+        jnp.asarray(q, jnp.float32),
+        jnp.asarray(k8, jnp.float32) * ks[None, :, None, None],
+        jnp.asarray(v8, jnp.float32) * vs[None, :, None, None],
+        lens, scale=1.0 / d ** 0.5)
+    out = decode_attention(q, k8, v8, lens, k_scale=ks, v_scale=vs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    # garbage codes past the length must not move the output
+    k_dirty = k8.at[:, :, 40:].set(127)
+    out2 = decode_attention(q, k_dirty, v8,
+                            jnp.asarray([1, 37, 40], jnp.int32),
+                            k_scale=ks, v_scale=vs)
+    base = decode_attention(q, k8, v8,
+                            jnp.asarray([1, 37, 40], jnp.int32),
+                            k_scale=ks, v_scale=vs)
+    np.testing.assert_array_equal(np.asarray(out2), np.asarray(base))

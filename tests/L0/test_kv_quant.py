@@ -1,0 +1,457 @@
+"""Quantized KV cache — int8 per-head-scale storage, hermetic.
+
+The acceptance bar from the quantized-cache issue, as tests:
+
+- **calibration guard**: an absmax of 0 or a non-finite absmax raises
+  LOUDLY at engine construction (degenerate scales must never surface
+  later as NaN output), and the quantize/dequant round-trip error is
+  bounded by ``scale / 2`` at representative absmax ranges;
+- **dequant-in-kernel**: the four attention kernels' int8 paths match
+  the jnp gather-dequant oracles (the PR 6 oracle pattern, lifted to
+  the quantized tier);
+- **composition** is the point: greedy token-match-rate >= threshold
+  vs the bf16 oracle across a prefix hit/miss/evict stream, the paged
+  and contiguous quantized engines token-exact against EACH OTHER
+  (same quantization, indirected storage), COW prefix sharing over
+  quantized pages with no scale copies, speculative verify token-exact
+  plain-vs-spec ON the quantized engine (accept-longest-prefix emits
+  the program's own greedy targets — quantization moves both sides
+  identically), and a tp=1 mesh bitwise vs the unsharded quantized
+  engine (tp=2 slow-marked, per the PR 5 pattern);
+- **the bf16 default stays the bitwise baseline**: ``kv_quant=None``
+  builds a scale-less cache, compiles the same pinned program set, and
+  none of the quant code is on its trace path (two default engines
+  serve a greedy stream token-identically);
+- **capacity accounting**: int8 halves ``cache.nbytes()`` and the
+  ``serving.kv.bytes_per_token`` gauge at identical geometry.
+
+Everything runs on CPU with a tiny model at policy O0 (exact fp32
+compute — the match-rate tolerance isolates QUANTIZATION error, not
+bf16 rounding); the kernels take their interpret/reference paths.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import telemetry
+from apex_tpu.amp.policy import resolve_policy
+from apex_tpu.kernels.decode_attention import (
+    decode_attention, decode_attention_reference, paged_decode_attention,
+    paged_decode_attention_reference)
+from apex_tpu.kernels.prefill_attention import (
+    paged_prefill_attention, paged_prefill_attention_reference,
+    prefill_attention, prefill_attention_reference)
+from apex_tpu.models.transformer_lm import TransformerLM
+from apex_tpu.serving import (Engine, KVQuantConfig, Request, Scheduler,
+                              SpecConfig)
+from apex_tpu.serving.kv_quant import QMAX, dequantize, quantize
+
+pytestmark = pytest.mark.serving
+
+VOCAB = 96          # divisible by the tp sizes under test (1, 2)
+CHUNK = 8
+# the tolerance of the issue's token-match contract at tiny-model
+# scale: a single early argmax flip diverges a request's whole greedy
+# tail, so the bound is deliberately below the bench-scale 0.99 claim
+MATCH_THRESHOLD = 0.95
+
+
+def _tiny_lm(**kw):
+    return TransformerLM(vocab_size=VOCAB, hidden=32, num_layers=2,
+                         num_heads=4, max_seq_len=64, **kw)
+
+
+@pytest.fixture(scope="module")
+def lm_and_params():
+    m = _tiny_lm()
+    params = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32),
+                    train=False)["params"]
+    return m, params
+
+
+def _mk_engine(lm_and_params, *, kv_quant=None, paged=True, pool=2,
+               slots=3, seed=5, **kw):
+    m, params = lm_and_params
+    return Engine(m, params, slots=slots, max_len=64, prefill_len=24,
+                  chunk_len=CHUNK, prefix_pool=pool, paged=paged,
+                  policy=resolve_policy("O0", verbose=False), seed=seed,
+                  kv_quant=kv_quant, **kw)
+
+
+@pytest.fixture(scope="module")
+def engine_trio(lm_and_params):
+    """bf16(O0) oracle + paged-int8 + contiguous-int8, identical
+    geometry — the match-rate triple (jit caches warm across the
+    module)."""
+    return (_mk_engine(lm_and_params),
+            _mk_engine(lm_and_params, kv_quant=KVQuantConfig()),
+            _mk_engine(lm_and_params, kv_quant=KVQuantConfig(),
+                       paged=False))
+
+
+def _shared_prefix_stream(seed, n=8, new_tokens=8):
+    """Prefix hit/miss/evict shape: every prompt opens with one shared
+    16-token (2-page) prefix plus a short unique tail."""
+    rng = np.random.default_rng(seed)
+    pre = list(rng.integers(1, VOCAB, size=16))
+    reqs = []
+    for _ in range(n):
+        tail = list(rng.integers(1, VOCAB,
+                                 size=int(rng.integers(1, 7))))
+        reqs.append(Request(prompt=pre + tail,
+                            max_new_tokens=new_tokens))
+    return reqs
+
+
+def _serve(engine, seed, **sched_kw):
+    engine.reset(clear_prefixes=True)
+    sched = Scheduler(engine, retain_prefixes=True, **sched_kw)
+    reqs = _shared_prefix_stream(seed)
+    sched.run(reqs)
+    return [list(r.output_tokens) for r in reqs]
+
+
+def _match_rate(a_lists, b_lists):
+    tot = hit = 0
+    for a, b in zip(a_lists, b_lists):
+        assert len(a) == len(b)
+        tot += len(a)
+        hit += sum(int(x == y) for x, y in zip(a, b))
+    return hit / tot if tot else 1.0
+
+
+# ------------------------------------------------------ config + round-trip
+def test_config_validation():
+    with pytest.raises(ValueError, match="int8"):
+        KVQuantConfig(dtype=jnp.bfloat16)
+    with pytest.raises(ValueError, match="granularity"):
+        KVQuantConfig(scale_granularity="page")
+    with pytest.raises(ValueError, match="margin"):
+        KVQuantConfig(margin=0.0)
+    with pytest.raises(ValueError, match="margin"):
+        KVQuantConfig(margin=float("nan"))
+    with pytest.raises(ValueError, match="calibration_len"):
+        KVQuantConfig(calibration_len=0)
+
+
+@pytest.mark.parametrize("absmax", [1e-3, 0.25, 1.0, 100.0])
+def test_quantize_roundtrip_error_bound(absmax):
+    """The int8 tier's accuracy floor, pinned per absmax range: for
+    in-range inputs the round-trip error is <= scale / 2 per element
+    (symmetric round-to-nearest on a uniform grid), and out-of-range
+    inputs clip to the representable absmax."""
+    rng = np.random.default_rng(3)
+    h = 4
+    scale = np.full(h, absmax / QMAX, np.float32)
+    x = jnp.asarray(rng.uniform(-absmax, absmax, size=(2, h, 16)),
+                    jnp.float32)
+    q = quantize(x, scale, axis=1)
+    assert q.dtype == jnp.int8
+    back = dequantize(q, scale, axis=1)
+    bound = absmax / QMAX / 2
+    assert float(jnp.max(jnp.abs(back - x))) <= bound * (1 + 1e-6)
+    # clipping: 2x the range lands exactly at the grid edge
+    over = jnp.full((1, h, 1), 2 * absmax, jnp.float32)
+    qo = quantize(over, scale, axis=1)
+    assert int(jnp.max(qo)) == QMAX
+    np.testing.assert_allclose(np.asarray(dequantize(qo, scale, axis=1)),
+                               absmax, rtol=1e-5)
+
+
+def test_degenerate_calibration_raises_at_construction(lm_and_params):
+    """The calibration guard satellite: absmax 0 / NaN / negative must
+    be a LOUD engine-construction error, never NaN output later."""
+    for bad in (0.0, float("nan"), float("inf"), -1.0):
+        with pytest.raises(ValueError, match="degenerate"):
+            _mk_engine(lm_and_params,
+                       kv_quant=KVQuantConfig(calibration_absmax=bad))
+    # one bad head inside an otherwise-fine array is still loud
+    absmax = np.ones((2, 4), np.float32)
+    absmax[1, 2] = 0.0
+    with pytest.raises(ValueError, match=r"layer=1, head=2"):
+        _mk_engine(lm_and_params,
+                   kv_quant=KVQuantConfig(calibration_absmax=absmax))
+    # an explicit positive absmax (scalar or (k, v) pair) constructs
+    eng = _mk_engine(lm_and_params,
+                     kv_quant=KVQuantConfig(calibration_absmax=(2.0,
+                                                                3.0)))
+    assert float(jnp.max(eng.cache.v_scale)) > \
+        float(jnp.max(eng.cache.k_scale))
+
+
+def test_kv_quant_type_and_tokens_validation(lm_and_params):
+    with pytest.raises(TypeError, match="KVQuantConfig"):
+        _mk_engine(lm_and_params, kv_quant="int8")
+    with pytest.raises(ValueError, match="calibration_tokens"):
+        _mk_engine(lm_and_params,
+                   kv_quant=KVQuantConfig(calibration_tokens=[]))
+
+
+# ------------------------------------------------- kernels vs dequant oracle
+def test_quantized_kernels_match_gather_dequant_oracles():
+    """All four attention kernels' int8 dequant-in-kernel paths vs the
+    jnp gather-dequant oracles (the PR 6 oracle pattern)."""
+    rng = np.random.default_rng(0)
+    B, h, L, d, C = 2, 4, 256, 16, 16
+    NP_, PL, MAXP = 5, 128, 2
+    q1 = jnp.asarray(rng.standard_normal((B, h, d)), jnp.float32)
+    qc = jnp.asarray(rng.standard_normal((B, h, C, d)), jnp.float32)
+    k8 = jnp.asarray(rng.integers(-QMAX, QMAX + 1, size=(B, h, L, d)),
+                     jnp.int8)
+    v8 = jnp.asarray(rng.integers(-QMAX, QMAX + 1, size=(B, h, L, d)),
+                     jnp.int8)
+    kp = jnp.asarray(rng.integers(-QMAX, QMAX + 1, size=(NP_, h, PL, d)),
+                     jnp.int8)
+    vp = jnp.asarray(rng.integers(-QMAX, QMAX + 1, size=(NP_, h, PL, d)),
+                     jnp.int8)
+    pt = jnp.asarray(rng.integers(0, NP_, size=(B, MAXP)), jnp.int32)
+    ks = jnp.asarray(rng.uniform(0.01, 0.05, size=h), jnp.float32)
+    vs = jnp.asarray(rng.uniform(0.01, 0.05, size=h), jnp.float32)
+    lens = jnp.asarray([37, 256], jnp.int32)
+    offs = jnp.asarray([0, 200], jnp.int32)
+    plens = jnp.asarray([5, 130], jnp.int32)
+    poffs = jnp.asarray([0, 100], jnp.int32)
+    cases = [
+        (decode_attention(q1, k8, v8, lens, k_scale=ks, v_scale=vs),
+         decode_attention_reference(q1, k8, v8, lens, scale=1 / d ** 0.5,
+                                    k_scale=ks, v_scale=vs)),
+        (prefill_attention(qc, k8, v8, offs, k_scale=ks, v_scale=vs),
+         prefill_attention_reference(qc, k8, v8, offs,
+                                     scale=1 / d ** 0.5, k_scale=ks,
+                                     v_scale=vs)),
+        (paged_decode_attention(q1, kp, vp, pt, plens, k_scale=ks,
+                                v_scale=vs, interpret=True),
+         paged_decode_attention_reference(q1, kp, vp, pt, plens,
+                                          scale=1 / d ** 0.5,
+                                          k_scale=ks, v_scale=vs)),
+        (paged_prefill_attention(qc, kp, vp, pt, poffs, k_scale=ks,
+                                 v_scale=vs, interpret=True),
+         paged_prefill_attention_reference(qc, kp, vp, pt, poffs,
+                                           scale=1 / d ** 0.5,
+                                           k_scale=ks, v_scale=vs)),
+    ]
+    for out, ref in cases:
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+    # a lone scale is a caller bug, named loudly
+    with pytest.raises(ValueError, match="together"):
+        decode_attention(q1, k8, v8, lens, k_scale=ks)
+    with pytest.raises(ValueError, match="per head"):
+        paged_decode_attention(q1, kp, vp, pt, plens, k_scale=ks[:2],
+                               v_scale=vs[:2])
+
+
+# ------------------------------------------------------------- composition
+def test_quantized_token_match_vs_bf16_oracle_over_hit_miss_evict(
+        engine_trio):
+    """THE composition pin: the quantized engines serve the prefix
+    hit/miss/evict stream at greedy token-match-rate >= threshold vs
+    the bf16 oracle, and paged-int8 is token-EXACT vs contiguous-int8
+    (same quantization, indirected storage — the PR 6 parity argument,
+    one tier down)."""
+    oracle, quant_paged, quant_contig = engine_trio
+    out_o = _serve(oracle, seed=42)
+    out_p = _serve(quant_paged, seed=42)
+    out_c = _serve(quant_contig, seed=42)
+    rate = _match_rate(out_o, out_p)
+    assert rate >= MATCH_THRESHOLD, \
+        f"quantized token-match-rate {rate:.3f} vs bf16 oracle"
+    assert out_p == out_c, \
+        "paged and contiguous int8 engines diverged — quantization " \
+        "must be a storage property, not a layout property"
+    # halved storage at identical geometry
+    assert quant_paged.cache.nbytes() * 2 <= oracle.cache.nbytes()
+
+
+def test_cow_prefix_sharing_shares_quantized_pages(engine_trio):
+    """COW composition: a prefix hit on the quantized engine shares
+    int8 pages by refcount bump (zero data movement, zero scale
+    copies — scales are per-head engine state, not per-page), and the
+    hit request's tokens match the cold miss path token-for-token
+    (shared bytes are byte-identical to freshly written bytes)."""
+    _, eq, _ = engine_trio
+    eq.reset(clear_prefixes=True)
+    sched = Scheduler(eq, retain_prefixes=True)
+    rng = np.random.default_rng(9)
+    pre = list(rng.integers(1, VOCAB, size=8))      # exactly one page
+    tail = list(rng.integers(1, VOCAB, size=3))
+    (miss,) = sched.run([Request(prompt=pre + tail, max_new_tokens=4)])
+    assert miss.reused_tokens == 0
+    stats = eq.pool_stats()
+    assert stats["pages_in_use"] == 1 and stats["cow_shares"] == 0
+    (hit,) = sched.run([Request(prompt=pre + tail, max_new_tokens=4)])
+    assert hit.reused_tokens == 8
+    assert hit.output_tokens == miss.output_tokens
+    # the scale arrays are the ENGINE's two [layers, heads] tensors —
+    # sharing pages allocated no per-page scale state
+    assert eq.cache.k_scale.shape == (2, 4)
+    assert eq.cache.v_scale.shape == (2, 4)
+
+
+def test_speculative_verify_is_token_exact_on_the_quantized_engine(
+        lm_and_params):
+    """Speculative composition: ON the quantized engine, spec-vs-plain
+    stays token-exact (the verify program's emitted tokens ARE its own
+    greedy targets, so quantization moves both modes identically) with
+    real drafts accepted, and rollback stays length arithmetic — no
+    scale state to unwind."""
+    eng = _mk_engine(lm_and_params, kv_quant=KVQuantConfig(),
+                     spec=SpecConfig(draft_len=3, ngram=2))
+    rng = np.random.default_rng(7)
+    hist = list(rng.integers(1, VOCAB, size=10))
+
+    def stream(r):
+        reqs = []
+        for _ in range(4):
+            tail = list(r.integers(1, VOCAB, size=3))
+            reqs.append(Request(prompt=(hist + tail + tail)[:24],
+                                max_new_tokens=10))
+        return reqs
+
+    outs, accepted = {}, {}
+    for mode, sp in (("plain", False), ("spec", True)):
+        eng.reset(clear_prefixes=True)
+        sched = Scheduler(eng, speculative=sp)
+        reqs = stream(np.random.default_rng(3))
+        sched.run(reqs)
+        outs[mode] = [list(r.output_tokens) for r in reqs]
+        accepted[mode] = sum(r.spec_accepted for r in reqs)
+    assert outs["spec"] == outs["plain"]
+    assert accepted["spec"] > 0, "drafter never fired — the exactness " \
+        "pin proved nothing"
+    # quantization adds no program: 3 paged + 1 lazy verify
+    assert eng.compiled_programs == eng.chunk_traces \
+        + eng.decode_traces + eng.verify_traces
+    assert eng.verify_traces == 1
+
+
+def test_tp1_mesh_is_bitwise_vs_unsharded_quantized_engine(
+        lm_and_params):
+    """Tensor-parallel composition (tier-1 half): a 1-device mesh over
+    the quantized engine — scales sharded along heads next to the pool
+    — serves the greedy stream BITWISE identical to the unsharded
+    quantized engine, the same pin the bf16 tier carries."""
+    if len(jax.devices()) < 1:        # pragma: no cover
+        pytest.skip("needs a device")
+    from jax.sharding import Mesh
+
+    e0 = _mk_engine(lm_and_params, kv_quant=KVQuantConfig(), seed=11)
+    e1 = _mk_engine(lm_and_params, kv_quant=KVQuantConfig(), seed=11,
+                    mesh=Mesh(np.array(jax.devices()[:1]), ("tp",)))
+    assert _serve(e1, seed=21) == _serve(e0, seed=21)
+
+
+@pytest.mark.slow
+def test_tp2_mesh_is_token_exact_vs_unsharded_quantized_engine(
+        lm_and_params):
+    """Tensor-parallel composition (slow half, per the PR 5 pattern):
+    tp=2 CPU device emulation over the quantized engine is token-exact
+    vs the unsharded quantized engine, with the scale arrays sharded
+    [layers, heads/tp] per shard."""
+    from jax.sharding import Mesh
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 virtual devices")
+    e0 = _mk_engine(lm_and_params, kv_quant=KVQuantConfig(), seed=11)
+    e2 = _mk_engine(lm_and_params, kv_quant=KVQuantConfig(), seed=11,
+                    mesh=Mesh(np.array(jax.devices()[:2]), ("tp",)))
+    assert _serve(e2, seed=23) == _serve(e0, seed=23)
+    shard_shapes = {s.data.shape
+                    for s in e2.cache.k_scale.addressable_shards}
+    assert shard_shapes == {(2, 2)}   # [layers, heads/tp] per shard
+
+
+def test_monolithic_prefill_attends_the_quant_grid(lm_and_params):
+    """Ingest-path consistency: the monolithic (``return_kv``) prefill
+    on a quantized engine attends K/V through the SAME storage grid
+    chunked prefill writes and reads. Pinned at the model level — with
+    ``kv_scales`` the returned K/V are fixed points of quantize∘
+    dequantize (so the engine's storage cast is exact code recovery)
+    and the logits move off the raw-precision forward — and at the
+    engine level: the monolithic scheduler path token-matches the
+    chunked path on one quantized engine (different executables, so
+    the tolerance contract, not bitwise — same bar as the oracle
+    comparison)."""
+    m, params = lm_and_params
+    eng = _mk_engine(lm_and_params, kv_quant=KVQuantConfig(), seed=13)
+    ks, vs = eng.cache.k_scale, eng.cache.v_scale
+    toks = jnp.asarray([list(range(1, 13))], jnp.int32)
+    logits_q, (k_q, v_q) = m.apply({"params": params}, toks,
+                                   train=False, return_kv=True,
+                                   kv_scales=(ks, vs))
+    sk = ks[:, None, :, None, None]
+    sv = vs[:, None, :, None, None]
+    for got, scale in ((k_q, sk), (v_q, sv)):
+        np.testing.assert_array_equal(
+            np.asarray(dequantize(quantize(got, scale), scale)),
+            np.asarray(got, np.float32),
+            err_msg="return_kv K/V are not on the quantization grid")
+    logits_raw = m.apply({"params": params}, toks, train=False)
+    assert not np.array_equal(np.asarray(logits_q),
+                              np.asarray(logits_raw)), \
+        "kv_scales did not engage the grid in the return_kv forward"
+    # engine level: chunked vs monolithic ingestion, one quantized
+    # engine, chunk-boundary prompt lengths (below/at/straddling)
+    rng = np.random.default_rng(17)
+    prompts = [list(rng.integers(1, VOCAB, size=n))
+               for n in (5, CHUNK, 13, 21)]
+    outs = {}
+    for label, chunked in (("chunk", True), ("mono", False)):
+        eng.reset(clear_prefixes=True)
+        reqs = [Request(prompt=p, max_new_tokens=6) for p in prompts]
+        Scheduler(eng, chunked=chunked).run(reqs)
+        outs[label] = [list(r.output_tokens) for r in reqs]
+    rate = _match_rate(outs["chunk"], outs["mono"])
+    assert rate >= MATCH_THRESHOLD, \
+        f"quantized chunked-vs-monolithic token-match-rate {rate:.3f}"
+
+
+# ----------------------------------------------------- the bf16 default pin
+def test_kv_quant_none_stays_the_bitwise_baseline_with_pinned_programs(
+        lm_and_params):
+    """The contract the ROADMAP states: kv_quant=None is the DEFAULT
+    and the bitwise baseline. Two default engines serve the stream
+    token-identically through the pinned paged program set (3 + the
+    monolithic baseline = 3 total distinct executables, copy retired),
+    their caches carry NO scale state, and the quantized engine
+    compiles the same set — zero new programs either way."""
+    a = _mk_engine(lm_and_params, seed=11)
+    b = _mk_engine(lm_and_params, seed=11)
+    assert a.kv_quant is None and a.cache.k_scale is None \
+        and a.cache.v_scale is None
+    assert _serve(a, seed=31) == _serve(b, seed=31)
+    a.prefill(0, [5, 9, 2])           # the monolithic baseline compiles
+    assert (a.chunk_traces, a.decode_traces, a.prefill_traces,
+            a.copy_traces) == (1, 1, 1, 0)
+    assert a.compiled_programs == 3
+    q = _mk_engine(lm_and_params, kv_quant=KVQuantConfig(), seed=11)
+    _serve(q, seed=31)
+    q.prefill(0, [5, 9, 2])
+    assert (q.chunk_traces, q.decode_traces, q.prefill_traces,
+            q.copy_traces) == (1, 1, 1, 0)
+    assert q.compiled_programs == 3
+
+
+def test_kv_gauges_report_the_capacity_claim(lm_and_params):
+    """serving.kv.* telemetry: bytes_per_token halves at identical
+    geometry (the measurable capacity claim) and the quantized engine
+    reports the representable absmax its scales encode."""
+    reg_b, reg_q = telemetry.MetricsRegistry(), telemetry.MetricsRegistry()
+    eb = _mk_engine(lm_and_params, registry=reg_b)
+    eq = _mk_engine(lm_and_params, kv_quant=KVQuantConfig(),
+                    registry=reg_q)
+    gb = reg_b.snapshot()["gauges"]
+    gq = reg_q.snapshot()["gauges"]
+    # O0 oracle stores fp32 (4 bytes); int8 is a 4x cut there, 2x vs
+    # the production bf16 default — assert the itemsize ratio exactly
+    ratio = np.dtype(eb.cache.dtype).itemsize
+    assert gb["serving.kv.bytes_per_token"] \
+        == ratio * gq["serving.kv.bytes_per_token"]
+    assert "serving.kv.quant_scale_absmax" not in gb
+    assert gq["serving.kv.quant_scale_absmax"] > 0
+    # swap-in registry path (warmup pattern) re-emits the gauges
+    reg2 = telemetry.MetricsRegistry()
+    eq.set_registry(reg2)
+    assert "serving.kv.bytes_per_token" in reg2.snapshot()["gauges"]

@@ -137,3 +137,26 @@ def test_shape_validation():
                           jnp.zeros((1,), jnp.int32))
     with pytest.raises(ValueError, match="offsets"):
         prefill_attention(q, k, k, jnp.zeros((2,), jnp.int32))
+
+
+def test_int8_dequant_in_kernel_matches_dequant_oracle():
+    """The quantized-cache tier (kv_quant): the chunk kernel's int8
+    path with per-head scales vs the dequantize-up-front oracle —
+    shifted-causal masking and online softmax unchanged, dequant fused
+    into the block loads."""
+    rng = np.random.default_rng(12)
+    B, h, C, L, d = 2, 4, 16, 256, 16
+    q = _rand((B, h, C, d))
+    k8 = jnp.asarray(rng.integers(-127, 128, size=(B, h, L, d)), jnp.int8)
+    v8 = jnp.asarray(rng.integers(-127, 128, size=(B, h, L, d)), jnp.int8)
+    ks = jnp.asarray(rng.uniform(0.01, 0.06, size=h), jnp.float32)
+    vs = jnp.asarray(rng.uniform(0.01, 0.06, size=h), jnp.float32)
+    off = jnp.asarray([0, 200], jnp.int32)
+    ref = prefill_attention_reference(
+        jnp.asarray(q, jnp.float32),
+        jnp.asarray(k8, jnp.float32) * ks[None, :, None, None],
+        jnp.asarray(v8, jnp.float32) * vs[None, :, None, None],
+        off, scale=1.0 / d ** 0.5)
+    out = prefill_attention(q, k8, v8, off, k_scale=ks, v_scale=vs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)

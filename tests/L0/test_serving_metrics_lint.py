@@ -13,8 +13,12 @@ The fault-isolation layer is exactly where that rot is most expensive —
 verify program burns real FLOPs. The tensor-parallel family joined with
 the mesh tentpole: ``serving.tp.shards`` / the per-program collective
 gauges going dark would make a sharded fleet indistinguishable from a
-single-chip one on every dashboard. The loop is closed by lint: the set
-of fault/watchdog/spec/tp metric literals in ``apex_tpu/serving/``
+single-chip one on every dashboard. The ``serving.kv.*`` family joined
+with the quantized-cache tentpole: ``serving.kv.bytes_per_token`` is
+the capacity claim's basis, and ``serving.kv.quant_scale_absmax`` going
+dark would hide that a drifted workload is CLIPPING against its
+calibration. The loop is closed by lint: the set of
+fault/watchdog/spec/tp/kv metric literals in ``apex_tpu/serving/``
 source must EQUAL the set named in the docs' tables.
 """
 
@@ -32,8 +36,9 @@ SRC_DIR = os.path.join(ROOT, "apex_tpu", "serving")
 DOC = os.path.join(ROOT, "docs", "serving.md")
 
 # metric families the fault-isolation + speculative + tensor-parallel
-# layers own
-_PAT = re.compile(r"serving\.(?:faults|watchdog|spec|tp)\.[a-z0-9_]+")
+# + quantized-KV layers own
+_PAT = re.compile(
+    r"serving\.(?:faults|watchdog|spec|tp|kv)\.[a-z0-9_]+")
 
 
 def _emitted():
@@ -83,10 +88,12 @@ def test_scan_surface_is_alive():
                  "serving.tp.psums_per_program",
                  "serving.tp.all_gathers_per_program",
                  "serving.tp.hbm_bytes_per_shard",
-                 "serving.tp.pool_pages_per_shard"):
+                 "serving.tp.pool_pages_per_shard",
+                 "serving.kv.bytes_per_token",
+                 "serving.kv.quant_scale_absmax"):
         assert engine_py in emitted.get(name, []), \
-            f"{name} not emitted by the engine — batched-verify/tp " \
-            "telemetry went dark"
+            f"{name} not emitted by the engine — batched-verify/tp/" \
+            "quantized-kv telemetry went dark"
     assert _documented(), "docs/serving.md names no fault/watchdog/" \
         "spec metrics — doc section missing?"
 

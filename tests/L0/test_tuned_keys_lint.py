@@ -164,6 +164,35 @@ def test_speculative_verify_owes_the_tables_no_keys():
                         "speculative.py") in scanned
 
 
+def test_quantized_kv_owes_the_tables_no_new_keys():
+    """The quantized-KV satellite, in the copy/verify/sharding
+    pattern: dequantization is FUSED into the existing attention
+    kernels (a per-head scalar multiply on the logit and accumulator
+    updates — no new grid, block shape or index map), so the int8 tier
+    introduces NO new ``decode.*`` table key; its kernels reuse the
+    block knobs already swept. Any ``decode.qkv_*`` / ``decode.kv_*``
+    row (a quantized-qkv or quant-specific sweep that no code consumes)
+    is a dead row named loudly here; if a dedicated quant kernel ever
+    lands, its keys get the existence/staleness treatment automatically
+    because the scan covers serving/kv_quant.py and the two attention
+    kernel files."""
+    table = _table_keys()
+    stale_quant = {k for k in table
+                   if k.startswith(("decode.qkv_", "decode.kv_"))}
+    assert not stale_quant, (
+        f"tuned tables carry quantized-KV keys but the int8 tier "
+        f"reuses the existing attention block knobs: {stale_quant}")
+    scanned = {os.path.relpath(p, ROOT)
+               for d in SCAN_DIRS
+               for p in glob.glob(os.path.join(d, "**", "*.py"),
+                                  recursive=True)}
+    assert os.path.join("apex_tpu", "serving", "kv_quant.py") in scanned
+    assert os.path.join("apex_tpu", "kernels",
+                        "decode_attention.py") in scanned
+    assert os.path.join("apex_tpu", "kernels",
+                        "prefill_attention.py") in scanned
+
+
 def test_sharded_serving_owes_the_tables_no_new_keys():
     """The tensor-parallel satellite, in the copy/verify pattern: the
     sharded programs run the EXISTING paged kernels over fewer heads
