@@ -94,18 +94,18 @@ Exercised end-to-end by ``bench_serving.py`` and
 """
 
 from . import sharding
-from .engine import Engine, sample_tokens
+from .engine import Engine, PendingDecode, sample_tokens
 from .faults import (FaultPlan, FaultPolicy, FaultSpec, InjectedFault,
                      PoolAuditor, PoolInvariantError)
 from .kv_cache import KVCache, PagedKVCache, PagePool
 from .kv_quant import KVQuantConfig
 from .prefix_cache import PrefixCache, PrefixMatch
 from .scheduler import QueueFull, Request, RequestStatus, Scheduler
-from .speculative import SpecConfig, draft_tokens
+from .speculative import DraftWorker, SpecConfig, draft_tokens
 
-__all__ = ["Engine", "FaultPlan", "FaultPolicy", "FaultSpec",
-           "InjectedFault", "KVCache", "KVQuantConfig", "PagedKVCache",
-           "PagePool", "PoolAuditor", "PoolInvariantError",
-           "PrefixCache", "PrefixMatch", "QueueFull", "Request",
-           "RequestStatus", "Scheduler", "SpecConfig", "draft_tokens",
-           "sample_tokens", "sharding"]
+__all__ = ["DraftWorker", "Engine", "FaultPlan", "FaultPolicy",
+           "FaultSpec", "InjectedFault", "KVCache", "KVQuantConfig",
+           "PagedKVCache", "PagePool", "PendingDecode", "PoolAuditor",
+           "PoolInvariantError", "PrefixCache", "PrefixMatch",
+           "QueueFull", "Request", "RequestStatus", "Scheduler",
+           "SpecConfig", "draft_tokens", "sample_tokens", "sharding"]

@@ -101,6 +101,25 @@ it); a fused per-row isfinite guard + per-slot ``fault_bias`` operand
 give chaos the same grip it has on every other program
 (:attr:`Engine.last_verify_finite_slots`).
 
+**Async dispatch** (the pipelined heartbeat's engine half): the decode
+step is split into :meth:`Engine.decode_dispatch` — enqueue the
+compiled call and return a :class:`PendingDecode` whose sampled tokens
+stay ON DEVICE — and :meth:`Engine.decode_reconcile` — one batched
+readback per step, where emission accounting and the finiteness
+verdict land. ``decode_dispatch`` accepts a previous pending step's
+un-forced token array as its ``last_tokens``, so decode step t+1
+chains onto step t entirely on the device; :meth:`Engine.decode_step`
+is the two halves back-to-back (the depth-0 sync oracle — same
+program, same operands, same bytes). Every site that blocks on the
+runtime — forced reads (token readback, finite flags), the
+:meth:`Engine.sync` barrier, and the compiled calls themselves
+(:meth:`Engine._runtime_call`: the CPU backend executes
+donated-buffer programs synchronously inside dispatch, so the call's
+block time IS device execution there; on silicon async dispatch makes
+it ~µs) — charges its block time to :attr:`Engine.device_wait_s`,
+which the scheduler differences per heartbeat into the
+``serving.heartbeat.*`` host-think / device-wait split.
+
 **Tensor parallelism** (``mesh=...``, paged only): the same programs,
 shard_map'd over a 1-D tensor-parallel mesh axis
 (:mod:`apex_tpu.serving.sharding`). Params split per a
@@ -149,6 +168,7 @@ Paged-mode host bookkeeping (all numpy, no device work):
 
 from __future__ import annotations
 
+import dataclasses
 import math
 import time
 from typing import Any, Optional, Sequence
@@ -165,7 +185,8 @@ from .kv_quant import KVQuantConfig, quantize
 from .prefix_cache import PrefixCache
 from .speculative import SpecConfig
 
-__all__ = ["Engine", "resolve_page_len", "sample_tokens"]
+__all__ = ["Engine", "PendingDecode", "resolve_page_len",
+           "sample_tokens"]
 
 _logger = get_logger("serving")
 
@@ -209,6 +230,30 @@ def sample_tokens(logits, temperature, key, top_k: int = 0):
     scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
     sampled = jax.random.categorical(key, scaled).astype(jnp.int32)
     return jnp.where(temperature > 0, sampled, greedy)
+
+
+@dataclasses.dataclass
+class PendingDecode:
+    """One dispatched-but-unread decode step — the handle the async
+    pipelined heartbeat holds between :meth:`Engine.decode_dispatch`
+    and :meth:`Engine.decode_reconcile`.
+
+    ``tokens`` / ``finite`` are DEVICE arrays: touching them with
+    ``int()`` / ``float()`` / ``np.asarray`` forces the host to wait
+    for the step — exactly the stall dispatch-ahead execution exists to
+    remove — so nothing reads them until reconcile (the scheduler lint
+    in ``tests/L0/test_serving_metrics_lint.py`` enforces this on the
+    dispatch region). ``active`` is the host-side dispatch mask (who
+    the step computed for) and ``t_dispatch`` the dispatch timestamp,
+    so reconcile can observe the full dispatch→retire latency as
+    ``serving.decode.step_s`` (in sync mode reconcile follows dispatch
+    immediately and the reading degenerates to today's measurement)."""
+
+    tokens: Any                 # [slots] int32, ON DEVICE until reconcile
+    finite: Any                 # [slots] bool, ON DEVICE until reconcile
+    active: np.ndarray          # [slots] bool, host dispatch mask
+    t_dispatch: float
+    reconciled: bool = False
 
 
 class Engine:
@@ -531,6 +576,14 @@ class Engine:
         self.copy_traces = 0
         self.verify_traces = 0
         self.tokens_generated = 0
+        # cumulative seconds the HOST spent blocked waiting for device
+        # results (every forcing site — token readback, finiteness
+        # verdicts, the sync() barrier — is timed into this). The
+        # scheduler differences it around each heartbeat to split beat
+        # wall time into host-think vs device-wait: the basis of the
+        # serving.heartbeat.* gauges and the pipelined watchdog's
+        # host-portion budget.
+        self.device_wait_s = 0.0
         # the non-finite guard's host-side view, refreshed by every
         # sampling call: per-slot flags for the last decode step, one
         # flag each for the last chunk/monolithic prefill. True means
@@ -998,21 +1051,25 @@ class Engine:
             # slots' promises) with enough pages to hold it
             self.release_slot(slot, keep_reservation=True)
             self._grow_slot(slot, -(-self.prefill_len // self.page_len))
-            self.cache, token, finite = self._with_prefill_blocks(
-                lambda: self._jit_prefill(
-                    self.params, self.cache, jnp.asarray(tokens),
-                    jnp.asarray(self._page_table[slot:slot + 1]),
-                    np.int32(n), np.float32(temperature),
-                    self._next_key()))
+            self.cache, token, finite = self._runtime_call(
+                lambda: self._with_prefill_blocks(
+                    lambda: self._jit_prefill(
+                        self.params, self.cache, jnp.asarray(tokens),
+                        jnp.asarray(self._page_table[slot:slot + 1]),
+                        np.int32(n), np.float32(temperature),
+                        self._next_key())))
             self._host_len[slot] = n
         else:
-            self.cache, token, finite = self._with_prefill_blocks(
-                lambda: self._jit_prefill(
-                    self.params, self.cache, jnp.asarray(tokens),
-                    np.int32(n), np.int32(slot), np.float32(temperature),
-                    self._next_key()))
-        token = int(token)
+            self.cache, token, finite = self._runtime_call(
+                lambda: self._with_prefill_blocks(
+                    lambda: self._jit_prefill(
+                        self.params, self.cache, jnp.asarray(tokens),
+                        np.int32(n), np.int32(slot),
+                        np.float32(temperature), self._next_key())))
+        tw = time.perf_counter()
+        token = int(token)                  # device sync
         self.last_prefill_finite = bool(finite)
+        self.device_wait_s += time.perf_counter() - tw
         if not self.last_prefill_finite:
             self._count_nonfinite(1)
         if self._registry is not None:
@@ -1080,20 +1137,25 @@ class Engine:
                 self.release_slot(slot, keep_reservation=True)
             self._grow_slot(
                 slot, -(-(offset + self.chunk_len) // self.page_len))
-            self.cache, token, finite = self._jit_chunk(
-                self.params, self.cache, jnp.asarray(tokens),
-                jnp.asarray(self._page_table[slot:slot + 1]),
-                np.int32(offset), np.int32(n), np.float32(temperature),
-                np.float32(fault_bias), self._next_key())
+            self.cache, token, finite = self._runtime_call(
+                lambda: self._jit_chunk(
+                    self.params, self.cache, jnp.asarray(tokens),
+                    jnp.asarray(self._page_table[slot:slot + 1]),
+                    np.int32(offset), np.int32(n),
+                    np.float32(temperature), np.float32(fault_bias),
+                    self._next_key()))
             self._host_len[slot] = offset + n
         else:
-            self.cache, token, finite = self._jit_chunk(
-                self.params, self.cache, jnp.asarray(tokens),
-                np.int32(slot), np.int32(offset), np.int32(n),
-                np.float32(temperature), np.float32(fault_bias),
-                self._next_key())
-        token = int(token)
+            self.cache, token, finite = self._runtime_call(
+                lambda: self._jit_chunk(
+                    self.params, self.cache, jnp.asarray(tokens),
+                    np.int32(slot), np.int32(offset), np.int32(n),
+                    np.float32(temperature), np.float32(fault_bias),
+                    self._next_key()))
+        tw = time.perf_counter()
+        token = int(token)                  # device sync
         self.last_chunk_finite = bool(finite)
+        self.device_wait_s += time.perf_counter() - tw
         if not self.last_chunk_finite:
             self._count_nonfinite(1)
         if self._registry is not None:
@@ -1315,13 +1377,16 @@ class Engine:
             self._slot_reserved[slot] -= refund
             self.pool.unreserve(refund)
 
-    def retain_prefix(self, slot: int, prompt: Sequence[int]) -> str:
+    def retain_prefix(self, slot: int, prompt: Sequence[int],
+                      keys: Optional[Sequence[int]] = None) -> str:
         """Registration, paged style: retain ``prompt``'s block-aligned
         prefix by SHARING the pages that already hold it in ``slot`` —
         no copy, no reserved rows. Returns the
         :meth:`PrefixCache.register` outcome; on ``"registered"`` the
         entry holds its own refcount on each page (released at entry
-        eviction), so the prefix survives the slot."""
+        eviction), so the prefix survives the slot. ``keys`` are the
+        prompt's precomputed rolling block keys (the pipelined
+        scheduler's hash offload; None hashes inline)."""
         self._require_paged("retain_prefix")
         if self.prefix_cache is None:
             raise RuntimeError("engine built without a prefix cache "
@@ -1330,7 +1395,8 @@ class Engine:
         length = n_blocks * self.chunk_len
         n_pages = length // self.page_len
         pages = tuple(int(p) for p in self._page_table[slot, :n_pages])
-        outcome = self.prefix_cache.register(prompt, pages=pages)
+        outcome = self.prefix_cache.register(prompt, pages=pages,
+                                             keys=keys)
         if outcome == "registered":
             self.pool.share(pages)
         return outcome
@@ -1352,6 +1418,12 @@ class Engine:
         ``temperatures`` [slots] float. Returns the next token per slot
         (host int32 array; inactive rows are noise to discard).
 
+        This is the SYNCHRONOUS shape — :meth:`decode_dispatch`
+        immediately followed by :meth:`decode_reconcile`, the depth-0
+        oracle path of the async pipelined heartbeat. Both halves run
+        the same compiled program over the same operands, so the split
+        changes no bytes.
+
         ``fault_bias`` ([slots] float, default all-zero) is added to
         the fp32 logits rows inside the compiled program — the chaos
         harness's per-slot NaN/Inf injection point (+0.0 elsewhere is
@@ -1360,7 +1432,35 @@ class Engine:
         :attr:`last_decode_finite` ([slots] bool); slots flagged False
         sampled from non-finite logits and must be quarantined, not
         trusted."""
-        t0 = time.perf_counter()
+        pending = self.decode_dispatch(last_tokens, active, temperatures,
+                                       fault_bias=fault_bias)
+        out, _finite, _dt = self.decode_reconcile(pending)
+        return out
+
+    def decode_dispatch(self, last_tokens, active, temperatures,
+                        fault_bias=None) -> PendingDecode:
+        """DISPATCH one decode step and return without waiting for it:
+        the compiled call is enqueued on the device (JAX async
+        dispatch), host bookkeeping advances speculatively (paged
+        lengths grow by one for each active slot — pure arithmetic, the
+        same rollback-free contract as PR 8's speculative lengths), and
+        the sampled tokens stay ON DEVICE inside the returned
+        :class:`PendingDecode` until :meth:`decode_reconcile` reads
+        them back in one batched transfer.
+
+        ``last_tokens`` may be a HOST int array or a DEVICE array — in
+        particular the previous pending step's un-forced ``tokens`` —
+        which is what lets the pipelined heartbeat chain decode step
+        t+1 onto step t's output without the host ever touching the
+        token values: the data dependency stays on the device, and the
+        host think-time (drafting, admission, telemetry) overlaps the
+        device's execution of the steps in flight.
+
+        Nothing here counts tokens or observes latency — a dispatched
+        token is not an emitted token until the reconcile decides it
+        survived (a slot that turned out to finish mid-pipeline
+        discards its speculated successors), so all accounting lives in
+        :meth:`decode_reconcile`."""
         if fault_bias is None:
             fault_bias = np.zeros(self.slots, np.float32)
         else:
@@ -1368,8 +1468,9 @@ class Engine:
             if fault_bias.shape != (self.slots,):
                 raise ValueError(f"fault_bias {fault_bias.shape} must "
                                  f"be [{self.slots}]")
+        act = np.asarray(active, bool)
+        t0 = time.perf_counter()
         if self.paged:
-            act = np.asarray(active, bool)
             # write-then-attend writes at host_len: make sure each
             # active slot's write page exists BEFORE the program runs
             # (reservation at admission guarantees the pool can cover
@@ -1378,37 +1479,97 @@ class Engine:
                 pos = int(self._host_len[s])
                 if pos < self.max_len:
                     self._grow_slot(s, self.pool.pages_for(pos + 1))
-            self.cache, tokens, finite = self._jit_decode(
-                self.params, self.cache,
-                jnp.asarray(last_tokens, jnp.int32),
-                jnp.asarray(self._page_table),
-                jnp.asarray(self._host_len),
-                jnp.asarray(temperatures, jnp.float32),
-                jnp.asarray(fault_bias), self._next_key())
-            out = np.asarray(tokens)        # device sync: step latency
+            self.cache, tokens, finite = self._runtime_call(
+                lambda: self._jit_decode(
+                    self.params, self.cache,
+                    jnp.asarray(last_tokens, jnp.int32),
+                    jnp.asarray(self._page_table),
+                    jnp.asarray(self._host_len),
+                    jnp.asarray(temperatures, jnp.float32),
+                    jnp.asarray(fault_bias), self._next_key()))
             grow = act & (self._host_len < self.max_len)
             self._host_len[grow] += 1
         else:
-            self.cache, tokens, finite = self._jit_decode(
-                self.params, self.cache,
-                jnp.asarray(last_tokens, jnp.int32),
-                jnp.asarray(active, bool),
-                jnp.asarray(temperatures, jnp.float32),
-                jnp.asarray(fault_bias), self._next_key())
-            out = np.asarray(tokens)        # device sync: step latency
-        self.last_decode_finite = np.asarray(finite, bool)
-        bad = int(np.sum(np.asarray(active, bool)
-                         & ~self.last_decode_finite))
+            self.cache, tokens, finite = self._runtime_call(
+                lambda: self._jit_decode(
+                    self.params, self.cache,
+                    jnp.asarray(last_tokens, jnp.int32),
+                    jnp.asarray(act),
+                    jnp.asarray(temperatures, jnp.float32),
+                    jnp.asarray(fault_bias), self._next_key()))
+        return PendingDecode(tokens=tokens, finite=finite, active=act,
+                             t_dispatch=t0)
+
+    def decode_reconcile(self, pending: PendingDecode, valid=None):
+        """Read a dispatched decode step back to the host — ONE batched
+        token transfer per step, never per-slot ``int()`` calls against
+        device arrays — and account for it. Returns ``(tokens, finite,
+        step_s)``: host int32 ``[slots]``, host bool ``[slots]``, and
+        the dispatch→retire wall seconds (observed as
+        ``serving.decode.step_s``; in sync mode this is exactly the old
+        per-step measurement, in pipelined mode it still bounds the
+        device's execution latency from above).
+
+        ``valid`` ([slots] bool, default the dispatch mask) marks the
+        slots whose token the caller will actually consume: the
+        pipelined scheduler excludes slots whose request finished (or
+        was quarantined / expired) while this step was in flight, so
+        ``tokens_generated`` counts only emitted tokens and stays
+        comparable with the sync path serving the same stream. The
+        block time is charged to :attr:`device_wait_s`; the finiteness
+        verdict lands in :attr:`last_decode_finite`."""
+        if pending.reconciled:
+            raise RuntimeError("PendingDecode already reconciled — each "
+                               "dispatched step reads back exactly once")
+        pending.reconciled = True
+        valid = pending.active if valid is None \
+            else np.asarray(valid, bool)
+        tw = time.perf_counter()
+        out = np.asarray(pending.tokens)    # device sync: step latency
+        finite = np.asarray(pending.finite, bool)
+        now = time.perf_counter()
+        self.device_wait_s += now - tw
+        dt = now - pending.t_dispatch
+        self.last_decode_finite = finite
+        bad = int(np.sum(valid & ~finite))
         if bad:
             self._count_nonfinite(bad)
-        n_active = int(np.sum(np.asarray(active, bool)))
-        self.tokens_generated += n_active
+        n_valid = int(np.sum(valid))
+        self.tokens_generated += n_valid
         if self._registry is not None:
-            dt = time.perf_counter() - t0
             self._registry.observe("serving.decode.step_s", dt)
             self._registry.counter_inc("serving.decode.steps")
             self._registry.counter_inc("serving.tokens_generated",
-                                       n_active)
+                                       n_valid)
+        return out, finite, dt
+
+    def sync(self) -> None:
+        """Explicit device barrier: block until every dispatched
+        program (decode steps in flight included) has retired. The
+        pipelined heartbeat never needs this for correctness — the
+        cache is threaded through every call, so program order IS
+        dispatch order — but benches and tests use it to close a
+        timing window, and the wait is charged to
+        :attr:`device_wait_s` like any other forced sync."""
+        tw = time.perf_counter()
+        jax.block_until_ready(jax.tree_util.tree_leaves(self.cache))
+        self.device_wait_s += time.perf_counter() - tw
+
+    def _runtime_call(self, fn):
+        """Invoke one compiled program, charging the call's block time
+        to :attr:`device_wait_s`. On real accelerators JAX dispatch is
+        asynchronous — the call returns in ~µs and the real wait
+        surfaces at the forced read — but the CPU backend executes
+        DONATED-buffer programs synchronously inside the call (the
+        cache is donated on every program here), so without this the
+        whole device execution would masquerade as host think-time,
+        inverting the ``serving.heartbeat.*`` split and letting
+        healthy CPU decode breach the watchdog's host budget. The ~µs
+        of true dispatch overhead this misattributes on silicon is
+        noise."""
+        t0 = time.perf_counter()
+        out = fn()
+        self.device_wait_s += time.perf_counter() - t0
         return out
 
     def verify_batch(self, drafts, *, fault_bias=None, offsets=None):
@@ -1493,8 +1654,12 @@ class Engine:
         # lengths on device, so this read is a device sync — an
         # acceptable price on the parity-oracle path for the same
         # loud-failure contract the paged path has always had.
-        lens = self._host_len if self.paged \
-            else np.asarray(self.cache.lengths)[:self.slots]
+        if self.paged:
+            lens = self._host_len
+        else:
+            tw = time.perf_counter()
+            lens = np.asarray(self.cache.lengths)[:self.slots]
+            self.device_wait_s += time.perf_counter() - tw
         for s in np.flatnonzero(active):
             off = int(lens[s])
             if not 0 < off or off + K + 1 > self.max_len:
@@ -1519,18 +1684,24 @@ class Engine:
             # their fixed-shape writes can never land on a live page
             vt = np.where(active[:, None], self._page_table, 0)
             vlen = np.where(active, self._host_len, 0)
-            self.cache, out, n_accepted, finite = self._jit_verify(
-                self.params, self.cache, jnp.asarray(tokens),
-                jnp.asarray(vt.astype(np.int32)),
-                jnp.asarray(vlen.astype(np.int32)),
-                jnp.asarray(n_drafted), jnp.asarray(fault_bias))
+            self.cache, out, n_accepted, finite = self._runtime_call(
+                lambda: self._jit_verify(
+                    self.params, self.cache, jnp.asarray(tokens),
+                    jnp.asarray(vt.astype(np.int32)),
+                    jnp.asarray(vlen.astype(np.int32)),
+                    jnp.asarray(n_drafted), jnp.asarray(fault_bias)))
         else:
-            self.cache, out, n_accepted, finite = self._jit_verify(
-                self.params, self.cache, jnp.asarray(tokens),
-                jnp.asarray(n_drafted), jnp.asarray(fault_bias))
+            self.cache, out, n_accepted, finite = self._runtime_call(
+                lambda: self._jit_verify(
+                    self.params, self.cache, jnp.asarray(tokens),
+                    jnp.asarray(n_drafted), jnp.asarray(fault_bias)))
+        tw = time.perf_counter()
+        # ONE batched readback per verify dispatch (tokens, acceptance,
+        # verdicts) — the host never int()s a device element per slot
         out = np.asarray(out)           # device sync: step latency
         n_accepted = np.asarray(n_accepted, np.int32)
         finite = np.asarray(finite, bool)
+        self.device_wait_s += time.perf_counter() - tw
         if self.paged:
             # rollback IS this assignment, per slot: the rejected tail's
             # K/V sits at [offset + m + 1, offset + K + 1), past the
@@ -1623,7 +1794,10 @@ class Engine:
         path; a device read on the contiguous one)."""
         if self.paged:
             return self._host_len[:self.slots].copy()
-        return np.asarray(self.cache.lengths)
+        tw = time.perf_counter()
+        out = np.asarray(self.cache.lengths)    # device sync
+        self.device_wait_s += time.perf_counter() - tw
+        return out
 
     def set_registry(self, registry) -> None:
         """Swap the telemetry registry (e.g. after a compile-warmup pass,

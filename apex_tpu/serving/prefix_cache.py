@@ -167,20 +167,29 @@ class PrefixCache:
         return keys
 
     # ------------------------------------------------------------- matching
-    def match(self, prompt: Sequence[int]) -> Optional[PrefixMatch]:
+    def match(self, prompt: Sequence[int],
+              keys: Optional[Sequence[int]] = None) -> \
+            Optional[PrefixMatch]:
         """Longest cached block-aligned prefix of ``prompt``, verified
         token-for-token; None on a miss. The match never covers the
         whole prompt (cap ``aligned(n - 1)``): the final block must run
         through chunk prefill so its logits produce the request's first
-        token. Counts toward :attr:`hit_rate` either way."""
+        token. Counts toward :attr:`hit_rate` either way.
+
+        ``keys`` (optional) are ``prompt``'s PRECOMPUTED rolling block
+        keys — at least ``(n - 1) // block_len`` of them, e.g. from
+        :meth:`block_keys` run on a :class:`~apex_tpu.serving
+        .DraftWorker` thread at submit time (the async heartbeat's
+        hash offload). The hash is deterministic and every hit is
+        still verified token-for-token below, so precomputed and
+        inline keys are interchangeable bit-for-bit."""
         n = len(prompt)
         max_blocks = (n - 1) // self.block_len       # strictly < n tokens
+        if keys is None:
+            keys = self.block_keys(prompt, max_blocks)
         best: Optional[PrefixMatch] = None
-        h = 0
         for i in range(max_blocks):
-            block = tuple(int(t) for t in
-                          prompt[i * self.block_len:(i + 1) * self.block_len])
-            h = _roll(h, block)
+            h = keys[i]
             hit = self._index.get(h)
             if hit is None:
                 continue
@@ -226,7 +235,8 @@ class PrefixCache:
     # ---------------------------------------------------------- registration
     def register(self, prompt: Sequence[int],
                  copy_fn: Optional[Callable[[int, int], None]] = None,
-                 *, pages: Optional[Sequence[int]] = None) -> str:
+                 *, pages: Optional[Sequence[int]] = None,
+                 keys: Optional[Sequence[int]] = None) -> str:
         """Retain ``prompt``'s block-aligned prefix. Contiguous layout:
         ``copy_fn(row, length)`` runs the engine's row-copy program
         (serving slot → pool row ``row``) and is called at most once,
@@ -245,6 +255,10 @@ class PrefixCache:
           pinned (refcount > 0) entry — graceful degradation, nothing
           evicted. Paged registration never hits this (sharing costs
           zero new pages).
+
+        ``keys`` (optional) are the prompt's precomputed rolling block
+        keys (at least ``n_blocks`` of them) — same contract as
+        :meth:`match`.
         """
         if (copy_fn is None) == (pages is None):
             raise ValueError("register takes exactly one of copy_fn "
@@ -253,7 +267,8 @@ class PrefixCache:
         if n_blocks == 0:
             return "too_short"
         length = n_blocks * self.block_len
-        keys = self.block_keys(prompt, n_blocks)
+        keys = self.block_keys(prompt, n_blocks) if keys is None \
+            else list(keys[:n_blocks])
         hit = self._index.get(keys[-1])
         if hit is not None:
             row, blocks = hit

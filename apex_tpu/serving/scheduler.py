@@ -52,6 +52,36 @@ beats:
    ``speculative=False`` (the default) skips the draft phase entirely
    and keeps today's path as the measurable baseline.
 
+**Async pipelined heartbeat** (``pipeline_depth >= 1``): the sync beat
+forces every sampled token to the host (``np.asarray``) before the
+next step is dispatched, so the device idles through all the host
+think-time in between — drafting, admission, hashing, telemetry.
+Dispatch-ahead execution inverts that: decode step t+1 is DISPATCHED
+against the speculated schedule (every in-flight slot presumed to
+continue — EOS is the only finality the host cannot know in advance;
+token-budget and ``max_len`` exhaustion are pure host arithmetic and
+are never speculated past) with step t's un-forced device tokens as
+its ``last_tokens``, and step t is only then RECONCILED: one batched
+readback, per-slot emission through the same finish checks as the
+sync path, and rollback of any mispredict — a slot that turned out to
+finish (or quarantine, or expire) mid-pipeline simply discards its
+speculated successors' tokens (matched by request uid, counted as
+``serving.heartbeat.discarded``). Device state needs no undo: the
+speculated step's K/V write lands past every reader exactly like
+PR 8's rejected verify tail — lengths gate attention, dispatch order
+is program order (the cache threads through every call), and the next
+occupant's chunk prefill overwrites whole pages before attending them
+(write-then-attend). Host bookkeeping rollback is pure length
+arithmetic, already performed by ``release_slot``. ``pipeline_depth=0``
+(the default) keeps today's fully synchronous beat as the bitwise
+oracle; depth ``d`` keeps at most ``d`` decode steps in flight.
+A :class:`~apex_tpu.serving.DraftWorker` thread overlaps n-gram
+drafting and prefix block-hashing with device execution (pure
+closures over snapshots — timing can reorder host work, never change
+tokens), and the greedy output stream is BITWISE identical to the
+sync path across chunked, speculative, prefix-hit and chaos streams
+(pinned by ``tests/L0/test_async_heartbeat.py``).
+
 Step 3 is the head-of-line fix (Orca-style continuous batching +
 Sarathi-style chunked prefill): the monolithic alternative — pause the
 heartbeat and run a whole ``[1, prefill_len]`` prefill at admit time —
@@ -133,14 +163,16 @@ import dataclasses
 import enum
 import itertools
 import time
-from typing import List, Optional, Sequence
+import weakref
+from typing import Dict, List, Optional, Sequence
 
+import jax.numpy as jnp
 import numpy as np
 
 from apex_tpu.log_util import get_logger
 
 from .faults import FaultPolicy, PoolAuditor
-from .speculative import draft_tokens
+from .speculative import DraftWorker, draft_tokens
 
 __all__ = ["Request", "RequestStatus", "QueueFull", "Scheduler"]
 
@@ -253,9 +285,35 @@ class Request:
                                                      repr=False)
 
 
+@dataclasses.dataclass
+class _InflightStep:
+    """Host-side record of one dispatch-ahead decode step: the
+    engine's :class:`~apex_tpu.serving.PendingDecode` handle plus the
+    ``slot -> request uid`` map it was computed for. Reconcile emits a
+    slot's token only while the SAME request still runs there — any
+    finality, quarantine or expiry that frees the slot drops its entry
+    from every in-flight record on the spot (``_free_slot``), which is
+    the whole host-side rollback; the uid+status re-check at reconcile
+    is belt-and-braces on top."""
+
+    pending: object
+    uids: Dict[int, int]
+    tick: int
+
+    # ``uids`` is mutated by Scheduler._free_slot: the moment a slot
+    # frees (finish, quarantine, expiry), its entry is DROPPED from
+    # every in-flight record and counted as discarded — eager
+    # invalidation, because a requeued request keeps its uid, so a
+    # reconcile-time uid comparison alone could mistake a stale
+    # pre-quarantine step for the retried occupant's.
+
+
 class Scheduler:
     """Continuous-batching front of an :class:`~apex_tpu.serving.Engine`
-    (see module docstring for the step anatomy)."""
+    (see module docstring for the step anatomy). ``pipeline_depth=0``
+    (default) is the fully synchronous beat; ``>= 1`` enables
+    dispatch-ahead decode with deferred token readback (bitwise-greedy
+    identical, see the module docstring's async-heartbeat section)."""
 
     def __init__(self, engine, *, max_queue: int = 64,
                  default_timeout_s: Optional[float] = None,
@@ -263,6 +321,7 @@ class Scheduler:
                  chunked: bool = True, chunk_budget: int = 1,
                  retain_prefixes: bool = False,
                  speculative: bool = False,
+                 pipeline_depth: int = 0,
                  fault_policy: Optional[FaultPolicy] = None,
                  fault_plan=None,
                  auditor: Optional[PoolAuditor] = None):
@@ -270,6 +329,9 @@ class Scheduler:
             raise ValueError("max_queue must be >= 1")
         if chunk_budget < 1:
             raise ValueError("chunk_budget must be >= 1")
+        if pipeline_depth < 0:
+            raise ValueError("pipeline_depth must be >= 0 (0 = the "
+                             "synchronous oracle beat)")
         if speculative and getattr(engine, "spec", None) is None:
             raise ValueError(
                 "speculative=True requires an engine built with "
@@ -339,6 +401,23 @@ class Scheduler:
             self.auditor = None
         self._tick = 0            # heartbeat index (the FaultPlan clock)
         self._step_s_ema: Optional[float] = None   # decode-step seconds
+        # ---- async pipelined heartbeat state (pipeline_depth >= 1):
+        # dispatched-but-unreconciled decode steps, oldest first, and
+        # the worker thread that overlaps drafting + prefix hashing
+        # with device execution. Depth 0 never touches any of it — the
+        # sync beat stays the bitwise oracle path.
+        self.pipeline_depth = int(pipeline_depth)
+        self._pipeline: collections.deque = collections.deque()
+        self._worker: Optional[DraftWorker] = None
+        if self.pipeline_depth > 0:
+            self._worker = DraftWorker()
+            # stop the thread when the scheduler is collected (the
+            # finalizer closes over the WORKER, not self — no cycle)
+            weakref.finalize(self, self._worker.stop)
+        # per-slot precomputed prefix block keys (admission stashes the
+        # worker's hash for the registration that follows ingestion)
+        self._slot_hash_keys: List[Optional[list]] = \
+            [None] * engine.slots
 
     # ------------------------------------------------------------ ingestion
     def submit(self, request: Request) -> Request:
@@ -369,6 +448,17 @@ class Scheduler:
         request._t_submit = time.perf_counter()
         request._t_queued = request._t_submit
         self._queue.append(request)
+        if self._worker is not None and self.retain_prefixes:
+            # hash offload: the prompt's rolling block keys start
+            # computing NOW on the worker thread, overlapping whatever
+            # the device is executing — admission takes the result (or
+            # computes inline on a miss; identical bits either way)
+            pcache = self.engine.prefix_cache
+            prompt = tuple(request.prompt)
+            n_blocks = len(prompt) // pcache.block_len
+            self._worker.submit(
+                ("hash", request.uid),
+                lambda: pcache.block_keys(prompt, n_blocks))
         if self.registry is not None:
             self.registry.counter_inc("serving.requests.submitted")
         return request
@@ -397,6 +487,20 @@ class Scheduler:
         quarantines."""
         self._running[slot] = None
         self._temps[slot] = 0.0
+        self._slot_hash_keys[slot] = None
+        if self._pipeline:
+            # invalidate the slot's in-flight dispatch-ahead steps NOW
+            # (speculated-finality rollback): a uid check at reconcile
+            # is NOT enough on its own — a quarantined request keeps
+            # its uid through requeue, so if it re-admits into this
+            # same slot before the stale steps retire, their
+            # garbage-lineage tokens would pass a uid+status test and
+            # be emitted into the retried stream
+            dropped = sum(rec.uids.pop(slot, None) is not None
+                          for rec in self._pipeline)
+            if dropped and self.registry is not None:
+                self.registry.counter_inc("serving.heartbeat.discarded",
+                                          dropped)
         if self._slot_prefix[slot] is not None:
             # the slot no longer reads from its donor prefix: unpin
             self.engine.prefix_cache.release(self._slot_prefix[slot])
@@ -571,7 +675,16 @@ class Scheduler:
         the matched offset. A miss changes nothing — the request
         prefills cold from offset 0."""
         pcache = self.engine.prefix_cache
-        m = pcache.match(r.prompt)
+        keys = None
+        if self._worker is not None:
+            prompt = tuple(r.prompt)
+            n_blocks = len(prompt) // pcache.block_len
+            keys = self._worker.take(
+                ("hash", r.uid),
+                lambda: pcache.block_keys(prompt, n_blocks))
+            # registration after ingestion reuses the same keys
+            self._slot_hash_keys[slot] = keys
+        m = pcache.match(r.prompt, keys=keys)
         if m is not None:
             if getattr(self.engine, "paged", False):
                 self.engine.attach_prefix(slot, m)
@@ -749,13 +862,16 @@ class Scheduler:
         unaffected)."""
         pcache = self.engine.prefix_cache
         before = pcache.evictions
+        keys = self._slot_hash_keys[slot]
         if getattr(self.engine, "paged", False):
-            outcome = self.engine.retain_prefix(slot, r.prompt)
+            outcome = self.engine.retain_prefix(slot, r.prompt,
+                                                keys=keys)
         else:
             outcome = pcache.register(
                 r.prompt,
                 lambda row, length: self.engine.store_prefix(row, slot,
-                                                             length))
+                                                             length),
+                keys=keys)
         if self.registry is not None:
             evicted = pcache.evictions - before
             if evicted:
@@ -813,7 +929,7 @@ class Scheduler:
             if cfg.draft_len >= owed \
                     or offset + cfg.draft_len + 1 > eng.max_len:
                 continue
-            draft = draft_tokens(list(r.prompt) + r.output_tokens, cfg)
+            draft = self._take_draft(r)
             if not draft:
                 continue    # nothing to verify: plain-decode fallback
             pending.append((slot, r, draft, offset))
@@ -860,6 +976,11 @@ class Scheduler:
             for slot, r, _d, _o in pending:
                 self._quarantine(r, slot, desc)
             return verified, calls, emitted
+        # ONE batched readback per verify dispatch (the engine already
+        # forces exactly once; these are host views) — the emission
+        # loop below walks python ints, never per-element device reads
+        toks = np.asarray(toks)
+        n_acc = np.asarray(n_acc, np.int32)
         finite = eng.last_verify_finite_slots
         for slot, r, draft, offset in pending:
             if not finite[slot]:
@@ -888,8 +1009,7 @@ class Scheduler:
             # then budget, then cache exhaustion) — the emitted stream
             # is the greedy stream, discovered several tokens per step
             # (m + 1 <= owed by the endgame gate: nothing truncates)
-            for i in range(m + 1):
-                tok = int(toks[slot, i])
+            for i, tok in enumerate(toks[slot, :m + 1].tolist()):
                 r.output_tokens.append(tok)
                 self._last_tokens[slot] = tok
                 emitted += 1
@@ -905,15 +1025,68 @@ class Scheduler:
                     # reason string as the decode loop
                     self._finish(r, "max_len", slot)
                     break
+            else:
+                # slot still running: its outputs are settled until the
+                # next reconcile, so start the NEXT draft on the worker
+                # now — it computes while this beat's decode dispatch
+                # executes on the device
+                self._presubmit_draft(r)
         return verified, calls, emitted
+
+    def _draft_key(self, r: Request):
+        """A draft job's identity: the request AND its settled output
+        length — a stale precomputed draft (the slot emitted again, or
+        a quarantine requeued the request) can never be taken, only
+        aged out."""
+        return ("draft", r.uid, len(r.output_tokens))
+
+    def _take_draft(self, r: Request) -> list:
+        """The slot's n-gram draft: the worker's precomputed result
+        when one is ready (pipelined mode), else computed inline —
+        byte-identical either way (``draft_tokens`` is pure)."""
+        cfg = self.engine.spec
+        toks = list(r.prompt) + list(r.output_tokens)
+        fn = lambda toks=toks: draft_tokens(toks, cfg)  # noqa: E731
+        if self._worker is None:
+            return fn()
+        return self._worker.take(self._draft_key(r), fn)
+
+    def _presubmit_draft(self, r: Request) -> None:
+        """Queue the request's next draft on the worker thread (no-op
+        without one). Closes over a SNAPSHOT of prompt + outputs, so a
+        concurrent host append cannot skew the computation — the key
+        pins the length the snapshot was taken at."""
+        if self._worker is None or r.temperature != 0.0:
+            return
+        cfg = self.engine.spec
+        if cfg is None:
+            return
+        toks = list(r.prompt) + list(r.output_tokens)
+        self._worker.submit(
+            self._draft_key(r),
+            lambda toks=toks: draft_tokens(toks, cfg))
 
     # ------------------------------------------------------------- stepping
     def step(self) -> bool:
-        """One scheduler beat: expire → admit → chunk prefill → decode,
-        every engine call containment-wrapped (see the module
-        docstring's fault-isolation contract), timed against the fault
-        policy's watchdog budget. Returns True if any forward progress
-        was made (a decode step ran or a prefill chunk was ingested)."""
+        """One scheduler beat: expire → admit → chunk prefill → decode
+        (``pipeline_depth >= 1``: dispatch-ahead decode with deferred
+        readback — see the module docstring), every engine call
+        containment-wrapped (see the fault-isolation contract), timed
+        against the fault policy's watchdog budget. Returns True if any
+        forward progress was made (a decode step ran or reconciled, a
+        verify emitted, or a prefill chunk was ingested).
+
+        Every beat's wall time is split into HOST-THINK vs DEVICE-WAIT
+        (differencing the engine's :attr:`~apex_tpu.serving.Engine
+        .device_wait_s` around the body): the ``serving.heartbeat
+        .host_s`` / ``device_wait_s`` histograms and the
+        ``serving.heartbeat.duty_cycle`` gauge (device-wait fraction of
+        the beat). The watchdog budgets the HOST portion — a beat that
+        spends its wall blocked on healthy device execution is the
+        steady state, not a stall; a beat whose host think-time blows
+        the budget is (under pipelining the whole point is that
+        device-wait stops inflating beat wall, so budgeting wall would
+        re-conflate the two)."""
         t_tick = time.perf_counter()
         tick = self._tick
         self._tick += 1
@@ -921,39 +1094,58 @@ class Scheduler:
             # injected heartbeat stall (the watchdog-breach probe)
             self.fault_plan.maybe_stall(tick)
         compiled0 = getattr(self.engine, "compiled_programs", 0)
+        dw0 = getattr(self.engine, "device_wait_s", 0.0)
         try:
+            if self.pipeline_depth > 0:
+                return self._step_body_pipelined(tick)
             return self._step_body(tick)
         finally:
+            elapsed = time.perf_counter() - t_tick
+            dwait = max(0.0, getattr(self.engine, "device_wait_s", 0.0)
+                        - dw0)
+            host_s = max(elapsed - dwait, 0.0)
+            if self.registry is not None:
+                self.registry.observe("serving.heartbeat.host_s",
+                                      host_s)
+                self.registry.observe("serving.heartbeat.device_wait_s",
+                                      dwait)
+                if elapsed > 0:
+                    self.registry.gauge_set(
+                        "serving.heartbeat.duty_cycle", dwait / elapsed)
             if self.fault_policy.watchdog_budget_s is not None:
-                elapsed = time.perf_counter() - t_tick
                 if getattr(self.engine, "compiled_programs", 0) \
                         > compiled0:
                     # warm-start exemption: this heartbeat TRACED a
                     # compiled program, so its wall time is dominated
                     # by one-off compile latency, not a stall — tiny
                     # watchdog budgets must not false-trip on first
-                    # contact. Accounted separately so the compile
-                    # cost stays visible instead of vanishing.
+                    # contact (a dispatch-ahead beat traces at DISPATCH
+                    # time, so the exemption lands on the right beat
+                    # under pipelining too). Accounted separately so
+                    # the compile cost stays visible instead of
+                    # vanishing.
                     if self.registry is not None:
                         self.registry.observe(
                             "serving.watchdog.warmup_s", elapsed)
-                elif elapsed > self.fault_policy.watchdog_budget_s:
-                    self._on_watchdog_breach(tick, elapsed)
+                elif host_s > self.fault_policy.watchdog_budget_s:
+                    self._on_watchdog_breach(tick, host_s)
 
-    def _on_watchdog_breach(self, tick: int, elapsed: float) -> None:
-        """A heartbeat blew its wall-clock budget: count the
-        ``serving.watchdog.stall`` event, record the breach duration,
-        and hand it to the policy's ``on_stall`` callback (alerting /
-        shedding is the caller's choice — the scheduler itself keeps
-        beating)."""
+    def _on_watchdog_breach(self, tick: int, host_s: float) -> None:
+        """A heartbeat blew its HOST-portion budget (beat wall minus
+        time blocked on device results — injected stalls, runaway
+        drafting and slow bookkeeping all land here; healthy device
+        execution does not): count the ``serving.watchdog.stall``
+        event, record the breach duration, and hand it to the policy's
+        ``on_stall`` callback (alerting / shedding is the caller's
+        choice — the scheduler itself keeps beating)."""
         if self.registry is not None:
             self.registry.counter_inc("serving.watchdog.stall")
-            self.registry.observe("serving.watchdog.stall_s", elapsed)
-        _logger.warning("heartbeat %d stalled: %.3fs against a %.3fs "
-                        "watchdog budget", tick, elapsed,
+            self.registry.observe("serving.watchdog.stall_s", host_s)
+        _logger.warning("heartbeat %d stalled: %.3fs of host time "
+                        "against a %.3fs watchdog budget", tick, host_s,
                         self.fault_policy.watchdog_budget_s)
         if self.fault_policy.on_stall is not None:
-            self.fault_policy.on_stall(elapsed)
+            self.fault_policy.on_stall(host_s)
 
     def _step_body(self, tick: int) -> bool:
         self._expire(time.perf_counter())
@@ -979,25 +1171,7 @@ class Scheduler:
         active = np.array([r is not None and r.status == "running"
                            and slot not in spec_slots
                            for slot, r in enumerate(self._running)])
-        if self.registry is not None:
-            occ = float(active.mean())
-            self.registry.gauge_set("serving.slot_occupancy", occ)
-            self.registry.observe("serving.slot_occupancy", occ)
-            self.registry.observe("serving.padding_waste", 1.0 - occ)
-            if getattr(self.engine, "paged", False):
-                # the paged pool's per-step health: HBM pressure
-                # (pages_in_use/free), sharing efficiency (cow_shares —
-                # pages serving >1 reader for one page of HBM) and
-                # internal fragmentation (allocated-but-invalid slack)
-                ps = self.engine.pool_stats()
-                self.registry.gauge_set("serving.pool.pages_in_use",
-                                        float(ps["pages_in_use"]))
-                self.registry.gauge_set("serving.pool.pages_free",
-                                        float(ps["pages_free"]))
-                self.registry.gauge_set("serving.pool.cow_shares",
-                                        float(ps["cow_shares"]))
-                self.registry.gauge_set("serving.pool.fragmentation",
-                                        float(ps["fragmentation"]))
+        self._emit_beat_gauges(active)
         if not active.any():
             self._set_spec_gauge(spec_calls, spec_emitted, 0, 0)
             return chunks > 0 or spec_calls > 0
@@ -1068,6 +1242,257 @@ class Scheduler:
         self._set_spec_gauge(spec_calls, spec_emitted, 1, decode_emitted)
         return True
 
+    def _emit_beat_gauges(self, active: np.ndarray) -> None:
+        """Per-beat occupancy / padding-waste / paged-pool gauges over
+        the decode batch's dispatch mask (shared by the sync and
+        pipelined beats)."""
+        if self.registry is None:
+            return
+        occ = float(active.mean())
+        self.registry.gauge_set("serving.slot_occupancy", occ)
+        self.registry.observe("serving.slot_occupancy", occ)
+        self.registry.observe("serving.padding_waste", 1.0 - occ)
+        if getattr(self.engine, "paged", False):
+            # the paged pool's per-step health: HBM pressure
+            # (pages_in_use/free), sharing efficiency (cow_shares —
+            # pages serving >1 reader for one page of HBM) and
+            # internal fragmentation (allocated-but-invalid slack)
+            ps = self.engine.pool_stats()
+            self.registry.gauge_set("serving.pool.pages_in_use",
+                                    float(ps["pages_in_use"]))
+            self.registry.gauge_set("serving.pool.pages_free",
+                                    float(ps["pages_free"]))
+            self.registry.gauge_set("serving.pool.cow_shares",
+                                    float(ps["cow_shares"]))
+            self.registry.gauge_set("serving.pool.fragmentation",
+                                    float(ps["fragmentation"]))
+
+    # ------------------------------------------- the pipelined heartbeat
+    def _step_body_pipelined(self, tick: int) -> bool:
+        """One dispatch-ahead beat (``pipeline_depth >= 1``): expire →
+        admit → chunk prefill → [speculative: reconcile-all → draft →
+        verify] → DISPATCH decode t+1 → RECONCILE step t (keeping at
+        most ``pipeline_depth`` steps in flight). The decode dispatched
+        here executes on the device while the NEXT beat's host work —
+        expiry, admission, chunk bookkeeping, worker-thread drafting,
+        telemetry — runs; the emitted greedy stream is bitwise the sync
+        path's because every token still flows through the same
+        compiled programs and the same per-token finish checks, just
+        read back one batched transfer later."""
+        self._expire(time.perf_counter())
+        self._admit()
+        chunks = self._prefill_tick(tick) if self.chunked else 0
+        # cold-queue burst (same contract as the sync beat): only while
+        # nothing is decoding AND nothing is in flight
+        while chunks and not self._pipeline \
+                and not any(r is not None and r.status == "running"
+                            for r in self._running):
+            more = self._prefill_tick(tick)
+            if not more:
+                break
+            chunks += more
+        spec_slots: set = set()
+        spec_calls = spec_emitted = 0
+        reconciled = 0
+        if self.speculative:
+            # drafting and the verify program need settled outputs:
+            # retire everything in flight first (those flights already
+            # overlapped this beat's expire/admit/chunk work), then
+            # draft → verify-or-decode exactly like the sync beat
+            reconciled += self._reconcile_all()
+            spec_slots, spec_calls, spec_emitted = self._spec_tick(tick)
+        active = self._dispatch_decode(tick, spec_slots)
+        self._emit_beat_gauges(active if active is not None
+                               else np.zeros(self.engine.slots, bool))
+        while len(self._pipeline) > self.pipeline_depth:
+            reconciled += self._reconcile_oldest()
+        drained = False
+        if active is None and self._pipeline:
+            # nothing newly dispatched: drain the pipeline rather than
+            # strand finished device work (endgame/idle beats) — and
+            # count the drain as progress even when every retired step
+            # was a discard (an all-discard drain still moved state)
+            drained = True
+            reconciled += self._reconcile_all()
+        self._set_spec_gauge(spec_calls, spec_emitted, 1, reconciled)
+        return (chunks > 0 or spec_calls > 0 or active is not None
+                or reconciled > 0 or drained)
+
+    def _dispatch_decode(self, tick: int,
+                         spec_slots) -> Optional[np.ndarray]:
+        """DISPATCH-AHEAD REGION: issue one decode step against the
+        speculated schedule — every running slot presumed to continue,
+        EXCEPT past host-known finality (token budget / ``max_len``
+        exhaustion counting the tokens already in flight — pure
+        arithmetic, so only EOS is ever mispredicted). Returns the
+        dispatch mask when a step went in flight (or a contained
+        dispatch fault quarantined its batch), None when there was
+        nothing to dispatch.
+
+        Nothing between here and :meth:`_reconcile_oldest` may force a
+        device value to host: no ``int()`` / ``float()`` /
+        ``np.asarray`` on engine results (the foot-gun this refactor
+        exists to remove — statically linted by
+        ``tests/L0/test_serving_metrics_lint.py``)."""
+        eng = self.engine
+        inflight: collections.Counter = collections.Counter()
+        for rec in self._pipeline:
+            for slot, uid in rec.uids.items():
+                r = self._running[slot]
+                if r is not None and r.uid == uid:
+                    inflight[slot] += 1
+        uids: Dict[int, int] = {}
+        active = np.zeros(eng.slots, bool)
+        for slot, r in enumerate(self._running):
+            if r is None or r.status != "running" or slot in spec_slots:
+                continue
+            n_have = len(r.output_tokens) + inflight[slot]
+            if n_have >= r.max_new_tokens:
+                continue    # host-known finality: never dispatch past it
+            if len(r.prompt) + n_have - 1 >= eng.max_len:
+                continue    # cache exhausted once the flights land
+            active[slot] = True
+            uids[slot] = r.uid
+        if not uids:
+            return None
+        bias = None
+        if self.fault_plan is not None:
+            bias = self.fault_plan.decode_bias(tick, eng.slots)
+        try:
+            if self.fault_plan is not None:
+                self.fault_plan.maybe_raise("decode", tick)
+            pending = eng.decode_dispatch(
+                self._pipeline_last_tokens(active), active, self._temps,
+                fault_bias=bias)
+        except Exception as e:  # noqa: BLE001 — containment edge
+            # the dispatch produced no step (injected faults raise
+            # INSTEAD of the call): same blast radius as the sync
+            # decode site — the attributed victim, else every request
+            # in the would-be batch; in-flight steps for quarantined
+            # slots discard at their reconcile by uid mismatch
+            self._count_transient()
+            victim = getattr(e, "slot", -1)
+            desc = f"{type(e).__name__}: {e}"
+            if victim in uids:
+                self._quarantine(self._running[victim], victim, desc)
+            else:
+                for slot in sorted(uids):
+                    r = self._running[slot]
+                    if r is not None and r.uid == uids[slot]:
+                        self._quarantine(r, slot, desc)
+            return active
+        self._pipeline.append(_InflightStep(pending=pending, uids=uids,
+                                            tick=tick))
+        return active
+
+    def _pipeline_last_tokens(self, active: np.ndarray):
+        """The dispatch's ``last_tokens`` operand: host values for
+        settled slots, the NEWEST in-flight step's un-forced device
+        tokens for slots whose latest token is still on the device —
+        merged by one tiny device ``where`` so the data dependency
+        chains decode t+1 onto t without the host ever reading a token
+        (dispatch-ahead region: linted force-free)."""
+        host = self._last_tokens
+        if not self._pipeline:
+            return host
+        newest = self._pipeline[-1]
+        mask = np.zeros(host.shape[0], bool)
+        for slot, uid in newest.uids.items():
+            r = self._running[slot]
+            if r is not None and r.uid == uid and active[slot]:
+                mask[slot] = True
+        if not mask.any():
+            return host
+        return jnp.where(jnp.asarray(mask), newest.pending.tokens,
+                         jnp.asarray(host))
+
+    def _reconcile_oldest(self) -> int:
+        """RECONCILE the oldest in-flight decode step: ONE batched
+        token readback (never per-slot ``int()`` against device
+        arrays), emission through the same per-token finish checks as
+        the sync path, and the speculated-finality rollback — a slot
+        whose request finished, quarantined or expired while the step
+        was in flight had its entry dropped by ``_free_slot`` already
+        (counted as ``serving.heartbeat.discarded``); the uid+status
+        check here is belt-and-braces. Returns tokens emitted."""
+        rec = self._pipeline.popleft()
+        eng = self.engine
+        valid = np.zeros(eng.slots, bool)
+        for slot, uid in rec.uids.items():
+            r = self._running[slot]
+            if r is not None and r.uid == uid \
+                    and r.status == "running":
+                valid[slot] = True
+        try:
+            tokens, finite, dt = eng.decode_reconcile(rec.pending,
+                                                      valid=valid)
+        except Exception as e:  # noqa: BLE001 — containment edge
+            # a dispatched-ahead step can fail at its DEFERRED force:
+            # async backends surface runtime errors at the first read,
+            # not at dispatch (the CPU backend's donated-call
+            # synchronous execution hides this — errors land at the
+            # wrapped dispatch site there). Same blast radius as a
+            # sync decode-site fault: the attributed victim, else
+            # every request the step computed for; quarantining frees
+            # their slots, which drops their entries from any younger
+            # in-flight records (_free_slot's eager invalidation)
+            self._count_transient()
+            victim = getattr(e, "slot", -1)
+            desc = f"{type(e).__name__}: {e}"
+            if 0 <= victim < eng.slots and valid[victim]:
+                self._quarantine(self._running[victim], victim, desc)
+            else:
+                for slot in sorted(rec.uids):
+                    if valid[slot]:
+                        self._quarantine(self._running[slot], slot,
+                                         desc)
+            return 0
+        self._step_s_ema = dt if self._step_s_ema is None \
+            else 0.8 * self._step_s_ema + 0.2 * dt
+        emitted = discarded = 0
+        for slot in sorted(rec.uids):
+            if not valid[slot]:
+                discarded += 1
+                continue
+            r = self._running[slot]
+            if not finite[slot]:
+                # the in-program guard flagged this slot's logits (same
+                # quarantine as the sync beat); any younger in-flight
+                # step for it discards at ITS reconcile by uid mismatch
+                self._quarantine(r, slot, "non-finite decode logits")
+                continue
+            token = int(tokens[slot])
+            r.output_tokens.append(token)
+            self._last_tokens[slot] = token
+            emitted += 1
+            if self.eos_id is not None and token == self.eos_id:
+                self._finish(r, "eos", slot)
+            elif len(r.output_tokens) >= r.max_new_tokens:
+                self._finish(r, "max_new_tokens", slot)
+            elif len(r.prompt) + len(r.output_tokens) - 1 \
+                    >= eng.max_len:
+                # committed length (prompt + outputs - 1) reached the
+                # cache — the same condition the sync beat reads back
+                # from engine.lengths(), computed host-side here so
+                # reconcile forces nothing beyond the token readback
+                self._finish(r, "max_len", slot)
+            elif self.speculative:
+                # outputs settled until the next reconcile: start the
+                # next draft on the worker now, overlapping the device
+                self._presubmit_draft(r)
+        if discarded and self.registry is not None:
+            self.registry.counter_inc("serving.heartbeat.discarded",
+                                      discarded)
+        return emitted
+
+    def _reconcile_all(self) -> int:
+        """Retire every in-flight step, oldest first (the speculative
+        beat's settle point and the endgame drain)."""
+        emitted = 0
+        while self._pipeline:
+            emitted += self._reconcile_oldest()
+        return emitted
+
     def _set_spec_gauge(self, spec_calls: int, spec_emitted: int,
                         decode_steps: int, decode_emitted: int) -> None:
         """The headline speculative gauge: tokens emitted this
@@ -1087,9 +1512,16 @@ class Scheduler:
 
     @property
     def pending(self) -> int:
-        """Queued + running request count (drain target)."""
-        return len(self._queue) + sum(r is not None
-                                      for r in self._running)
+        """Queued + running request count, plus one while any
+        dispatched-ahead decode step is still awaiting reconcile (the
+        drain target: ``step()`` until 0 leaves nothing behind — not
+        even in-flight device work, so the LAST request's EOS cannot
+        strand its speculated successors un-discarded)."""
+        n = len(self._queue) + sum(r is not None
+                                   for r in self._running)
+        if self._pipeline:
+            n += 1
+        return n
 
     def _sleep_toward_backoff(self) -> None:
         """When nothing occupies a slot and everything queued is inside

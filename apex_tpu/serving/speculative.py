@@ -25,6 +25,14 @@ work, no compiled programs — drafting can never retrace anything):
   repetitive generations — exactly the workloads the prefix cache
   serves — are full of such matches; free-running text simply drafts
   nothing and the scheduler falls back to the plain decode program.
+- :class:`DraftWorker` — the THREADED drafter the async pipelined
+  heartbeat uses (``Scheduler(pipeline_depth >= 1)``): a single
+  background thread that precomputes drafts (and prefix block hashes)
+  while the device executes dispatched-ahead programs, so host
+  think-time overlaps device compute instead of serializing with it.
+  Jobs are pure closures over snapshots, so a precomputed draft is
+  byte-identical to the inline one — threading changes WHEN host work
+  runs, never what it computes.
 
 An EMPTY draft costs nothing: the slot takes this heartbeat's ordinary
 decode step. A wrong draft costs one verify step that still emits at
@@ -38,11 +46,13 @@ today's path as the measurable baseline.
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional, Sequence
+import queue
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
-__all__ = ["SpecConfig", "draft_tokens"]
+__all__ = ["DraftWorker", "SpecConfig", "draft_tokens"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -76,6 +86,106 @@ class SpecConfig:
             raise ValueError(
                 f"min_ngram {self.min_ngram} must be in [1, "
                 f"ngram={self.ngram}]")
+
+
+class DraftWorker:
+    """One background thread that precomputes pure host-side heartbeat
+    work — n-gram drafts and prefix block hashes — while the device
+    executes dispatched programs (the async pipelined heartbeat's
+    host-overlap half).
+
+    The contract that keeps this SAFE to thread is purity: every
+    submitted job is a closure over an immutable SNAPSHOT of its inputs
+    (the caller copies token lists before submitting), and
+    :func:`draft_tokens` / the prefix cache's rolling hash are pure
+    functions — so a precomputed result is byte-identical to the inline
+    computation it replaces, regardless of when the thread gets
+    scheduled. Timing can never change tokens, only overlap.
+
+    API: :meth:`submit` enqueues ``fn`` under ``key`` (idempotent — a
+    key already queued or done is not re-run); :meth:`take` returns the
+    result for ``key``, waiting briefly if the job is mid-flight, or
+    simply runs ``fn`` inline when the key was never submitted (the
+    scheduler's depth-0 path and every miss degrade to today's inline
+    behavior). Results are consumed on take; unclaimed results (a
+    request that finished before its draft was needed) age out of a
+    small ring so the worker cannot leak memory across a long serve.
+    The thread is a daemon and :meth:`stop` is idempotent — the
+    scheduler registers it with ``weakref.finalize``."""
+
+    _MAX_UNCLAIMED = 256
+
+    def __init__(self):
+        self._jobs: "queue.Queue" = queue.Queue()
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._results: Dict[Any, Any] = {}
+        self._inflight: set = set()
+        self._stopped = False
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="serving-draft-worker")
+        self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            item = self._jobs.get()
+            if item is None:
+                return
+            key, fn = item
+            try:
+                result = fn()
+            except BaseException as e:  # noqa: BLE001 — surfaced at take
+                result = _JobError(e)
+            with self._cond:
+                self._inflight.discard(key)
+                self._results[key] = result
+                while len(self._results) > self._MAX_UNCLAIMED:
+                    # drop the oldest unclaimed result (dict order);
+                    # a later take simply recomputes inline
+                    self._results.pop(next(iter(self._results)))
+                self._cond.notify_all()
+
+    def submit(self, key, fn: Callable[[], Any]) -> None:
+        """Enqueue ``fn`` to run on the worker thread under ``key``
+        (no-op if the key is already queued or completed). ``fn`` MUST
+        close over snapshots, never live mutable state."""
+        with self._lock:
+            if self._stopped or key in self._inflight \
+                    or key in self._results:
+                return
+            self._inflight.add(key)
+        self._jobs.put((key, fn))
+
+    def take(self, key, fn: Callable[[], Any]):
+        """The result for ``key``: precomputed if :meth:`submit` ran it
+        (waiting out a mid-flight job), else ``fn()`` inline — the
+        caller cannot tell the difference because jobs are pure."""
+        with self._cond:
+            while key in self._inflight:
+                self._cond.wait(timeout=1.0)
+            if key in self._results:
+                result = self._results.pop(key)
+                if isinstance(result, _JobError):
+                    raise result.error
+                return result
+        return fn()
+
+    def stop(self) -> None:
+        """Shut the thread down (idempotent; registered as the owning
+        scheduler's finalizer)."""
+        with self._lock:
+            if self._stopped:
+                return
+            self._stopped = True
+        self._jobs.put(None)
+        self._thread.join(timeout=2.0)
+
+
+@dataclasses.dataclass
+class _JobError:
+    """A worker job's raised exception, parked until its take()."""
+
+    error: BaseException
 
 
 def _rfind(data: bytes, pattern: bytes, last_start: int) -> int:

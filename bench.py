@@ -47,9 +47,13 @@ devices before any backend initializes, and a nested ``quantized_kv``
 sub-object (BENCH_SERVING_QUANT=0 to drop it): the int8-capacity leg
 — KV-bytes-per-token reduction, concurrency both modes,
 ``token_match_rate`` vs the bf16 oracle — via
-``bench_serving.quantized_kv_stats``. Failure-isolated at every
-layer: a broken serving stack puts {"error": ...} there, never kills
-the ResNet row.
+``bench_serving.quantized_kv_stats``, and a nested
+``async_heartbeat`` sub-object (BENCH_SERVING_ASYNC=0 to drop it):
+sync vs dispatch-ahead pipelined serving on one engine — heartbeat
+wall per emitted token, duty cycle, ``token_mismatched_requests``
+(expected 0, bitwise) — via ``bench_serving.async_stats``.
+Failure-isolated at every layer: a broken serving stack puts
+{"error": ...} there, never kills the ResNet row.
 """
 
 from __future__ import annotations
@@ -170,6 +174,20 @@ _SERVING_QUANT_SMOKE = {
     "PREFILL_LEN": 32, "REQUESTS": 6, "NEW_TOKENS": 8, "WINDOWS": 1,
 }
 
+# The async-heartbeat sub-leg's smoke geometry (the stream is served
+# twice — sync oracle + dispatch-ahead). Sized LONGER than its
+# siblings on purpose: pipelining pays fixed fill/drain beats per
+# wave, and a too-short stream measures mostly that overhead. On this
+# CPU backend the pipelined row reads a small loss REGARDLESS
+# (donated-buffer programs execute synchronously inside dispatch —
+# see bench_serving's module docstring); exactness + the heartbeat
+# split are the CPU-honest fields, the improvement is the TPU claim.
+# BENCH_SERVING_ASYNC_DEPTH et al. still win, env-beats-smoke.
+_SERVING_ASYNC_SMOKE = {
+    "SIZE": "tiny", "VOCAB": 512, "SLOTS": 4, "MAX_LEN": 128,
+    "PREFILL_LEN": 32, "REQUESTS": 8, "NEW_TOKENS": 16, "WINDOWS": 2,
+}
+
 
 def _serving_leg() -> dict:
     """The serving trajectory row (ROADMAP: bench_serving.py had no
@@ -195,6 +213,7 @@ def _serving_leg() -> dict:
         out["speculative"] = _serving_spec_leg()
         out["tensor_parallel"] = _serving_tp_leg()
         out["quantized_kv"] = _serving_quant_leg()
+        out["async_heartbeat"] = _serving_async_leg()
         return out
     except KeyboardInterrupt:
         raise
@@ -276,6 +295,35 @@ def _serving_quant_leg() -> dict:
             "max_concurrent_requests", "max_concurrent_requests_bf16",
             "slots", "slots_bf16", "pool_mib", "quant_scale_absmax",
             "model")}
+    except KeyboardInterrupt:
+        raise
+    except BaseException as e:  # noqa: BLE001 — the row must not die here
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def _serving_async_leg() -> dict:
+    """The async-heartbeat trajectory sub-row: smoke-sized
+    dispatch-ahead summary (sync vs pipeline_depth=N on one engine —
+    heartbeat wall per emitted token, duty cycle, tokens/s, bitwise
+    exactness) from ``bench_serving.async_stats``.
+    BENCH_SERVING_ASYNC=0 drops it; failure-isolated like its siblings
+    — a broken pipelined beat yields {"error": ...} here, never a lost
+    serving (or ResNet) row."""
+    if _env_int("BENCH_SERVING_ASYNC", "1") == 0:
+        return {"skipped": True}
+    try:
+        import bench_serving
+
+        bench_serving._load_env(smoke=dict(_SERVING_ASYNC_SMOKE))
+        _, summary = bench_serving.async_stats()
+        return {k: summary[k] for k in (
+            "value", "unit", "baseline_tokens_per_s", "pipeline_depth",
+            "heartbeat_wall_per_token_ms",
+            "heartbeat_wall_per_token_ms_sync",
+            "heartbeat_wall_per_token_improvement_pct",
+            "duty_cycle", "duty_cycle_sync", "host_s_fraction",
+            "discarded_inflight_tokens", "token_mismatched_requests",
+            "compiled_programs", "model")}
     except KeyboardInterrupt:
         raise
     except BaseException as e:  # noqa: BLE001 — the row must not die here

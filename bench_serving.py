@@ -154,6 +154,33 @@ CPU-honest columns, tokens/s is the TPU rows' claim (half the cache
 DMA per attended token). Defaults to a smoke geometry; env knobs
 resize it (env-beats-smoke).
 
+``--async-heartbeat`` runs the dispatch-ahead leg: the SAME seeded
+greedy stream served twice on one engine — synchronously
+(``pipeline_depth=0``, the bitwise oracle) and pipelined
+(``pipeline_depth=BENCH_SERVING_ASYNC_DEPTH``, default 2: decode t+1
+dispatches against the speculated schedule before step t's tokens are
+read back, one batched readback per reconcile, drafting/hashing on a
+worker thread). One row per mode plus a final line whose payoff
+fields are **heartbeat wall per emitted token** both modes +
+improvement pct (the latency the refactor attacks — host think-time
+overlaps device execution instead of serializing with it), the
+**duty cycle** (device-wait fraction of beat wall) and host-seconds
+fraction behind it, ``discarded_inflight_tokens`` (speculated steps
+rolled back at EOS — the price of dispatching ahead), and
+``token_mismatched_requests`` — expected 0 **bitwise** on every
+backend (same compiled programs, same bytes, deferred readback only).
+CPU regime note: this box's CPU backend executes DONATED-buffer
+programs synchronously inside the dispatch call (measured: the
+engine's donated-cache decode blocks ~the full step at dispatch,
+while an undonated jit returns in ~0.1 ms), so dispatch-ahead overlap
+is STRUCTURALLY zero here and the pipelined row reads a small
+per-beat-overhead LOSS — the same CPU-regime shape as chunked
+prefill (PR 4) and speculative verify (PR 8). The CPU-honest columns
+are exactness, the host/duty-cycle split, and the overhead bound;
+wall-per-token improvement is the silicon claim (real accelerators
+dispatch asynchronously — the premise the refactor is built on).
+Defaults to a smoke geometry; env knobs resize it (env-beats-smoke).
+
 Wrapped in ``guard_bench_main`` — EVERY outcome (backend init failure,
 OOM, bad env) still ends in a parseable JSON line.
 """
@@ -176,6 +203,7 @@ CHAOS_METRIC = "serving_chaos_goodput_tokens_per_sec"
 SPEC_METRIC = "serving_speculative_tokens_per_sec"
 TP_METRIC = "serving_tensor_parallel_tokens_per_sec"
 QUANT_METRIC = "serving_quantized_kv_tokens_per_sec"
+ASYNC_METRIC = "serving_async_heartbeat_tokens_per_sec"
 
 # Literal defaults at import time; the BENCH_SERVING_* env overrides are
 # parsed by _load_env() INSIDE each guarded main, so a malformed value
@@ -237,6 +265,14 @@ QUANT_SLOTS = 0
 QUANT_SMOKE = {"SIZE": "tiny", "VOCAB": 512, "SLOTS": 4, "MAX_LEN": 128,
                "PREFILL_LEN": 32, "REQUESTS": 8, "NEW_TOKENS": 12,
                "WINDOWS": 1}
+# --async-heartbeat leg: in-flight decode steps (pipeline_depth for the
+# pipelined mode; the sync mode is always depth 0) and its smoke
+# preset — the leg serves the SAME stream in both modes on one engine,
+# so halve the geometry you would give one mode
+ASYNC_DEPTH = 2
+ASYNC_SMOKE = {"SIZE": "tiny", "VOCAB": 512, "SLOTS": 4,
+               "MAX_LEN": 128, "PREFILL_LEN": 32, "REQUESTS": 8,
+               "NEW_TOKENS": 16, "WINDOWS": 2}
 
 _ENV_KNOBS = {
     "VOCAB": "BENCH_SERVING_VOCAB", "SLOTS": "BENCH_SERVING_SLOTS",
@@ -256,6 +292,7 @@ _ENV_KNOBS = {
     "SPEC_K": "BENCH_SERVING_SPEC_K",
     "TP": "BENCH_SERVING_TP",
     "QUANT_SLOTS": "BENCH_SERVING_QUANT_SLOTS",
+    "ASYNC_DEPTH": "BENCH_SERVING_ASYNC_DEPTH",
 }
 
 
@@ -1417,6 +1454,127 @@ def main_tp():
     print(json.dumps(summary))
 
 
+def _serve_async(engine, depth, seed):
+    """WINDOWS measured windows (plus a discarded compile warmup) of
+    the seeded stream at one pipeline depth; per-mode registry so the
+    heartbeat split is the measured windows' own."""
+    from apex_tpu import serving, telemetry
+
+    reg = telemetry.MetricsRegistry()
+    rng = np.random.default_rng(seed)
+    rates, all_reqs = [], []
+    for w in range(WINDOWS + 1):
+        engine.reset()
+        engine.set_registry(reg if w else None)
+        sched = serving.Scheduler(engine, max_queue=max(REQUESTS, 1),
+                                  registry=reg if w else None,
+                                  chunk_budget=CHUNK_BUDGET,
+                                  pipeline_depth=depth)
+        reqs = _requests(rng)
+        t0 = time.perf_counter()
+        tokw = engine.tokens_generated
+        done = sched.run(reqs)
+        dt = time.perf_counter() - t0
+        assert len(done) == REQUESTS
+        if w > 0:
+            rates.append((engine.tokens_generated - tokw) / dt)
+            all_reqs.extend(reqs)
+    engine.set_registry(None)
+    return _median(rates), all_reqs, reg.snapshot()
+
+
+def async_stats():
+    """The --async-heartbeat measurement, reusable by bench.py's
+    serving trajectory leg: the SAME seeded greedy stream served by one
+    engine synchronously (pipeline_depth=0, the bitwise oracle) and
+    dispatch-ahead (pipeline_depth=ASYNC_DEPTH), one warmup window per
+    mode discarded. Headline fields per mode: tokens/s, **heartbeat
+    wall per emitted token** (total beat wall / tokens — the latency
+    the refactor attacks), the **duty cycle** (device-wait fraction of
+    beat wall: host think-time leaves this denominator when it overlaps
+    device execution), and the host/device second totals behind both.
+    ``token_mismatched_requests`` is the exactness pin (must be 0 —
+    same programs, same bytes, deferred readback only). CPU-regime
+    note: the CPU backend executes donated-buffer programs
+    synchronously inside the dispatch call, so overlap is structurally
+    zero here and the pipelined row reads a small per-beat-overhead
+    loss — exactness, the host/duty-cycle split and the overhead
+    bound are the CPU-honest columns; the improvement is the silicon
+    claim (see the module docstring)."""
+    engine = _build_engine()
+    rows, outputs = {}, {}
+    for mode, depth in (("sync", 0), ("pipelined", ASYNC_DEPTH)):
+        rate, reqs, snap = _serve_async(engine, depth, seed=13)
+        h = snap["histograms"]
+        host = h.get("serving.heartbeat.host_s", {})
+        dwait = h.get("serving.heartbeat.device_wait_s", {})
+        host_total = host.get("mean", 0.0) * host.get("count", 0)
+        dwait_total = dwait.get("mean", 0.0) * dwait.get("count", 0)
+        wall_total = host_total + dwait_total
+        emitted = sum(len(r.output_tokens) for r in reqs)
+        row = {
+            "metric": f"{ASYNC_METRIC}.{mode}",
+            "value": round(rate, 2),
+            "unit": "tokens/s",
+            "pipeline_depth": depth,
+            "beats": host.get("count", 0),
+            "heartbeat_wall_s": round(wall_total, 4),
+            "heartbeat_wall_per_token_ms": round(
+                1000.0 * wall_total / emitted, 4) if emitted else 0.0,
+            "host_s": round(host_total, 4),
+            "device_wait_s": round(dwait_total, 4),
+            "duty_cycle": round(dwait_total / wall_total, 4)
+            if wall_total else 0.0,
+            "discarded_inflight_tokens": int(snap["counters"].get(
+                "serving.heartbeat.discarded", 0)),
+            "decode_step_p50_s": round(
+                h.get("serving.decode.step_s", {}).get("p50", 0.0), 6),
+            "compiled_programs": engine.compiled_programs,
+        }
+        rows[mode] = row
+        outputs[mode] = [list(r.output_tokens) for r in reqs]
+    mismatches = sum(a != b for a, b in zip(outputs["pipelined"],
+                                            outputs["sync"]))
+    sy, pi = rows["sync"], rows["pipelined"]
+    summary = {
+        "metric": ASYNC_METRIC,
+        "value": pi["value"],
+        "unit": "tokens/s",
+        "baseline_tokens_per_s": sy["value"],
+        "pipeline_depth": ASYNC_DEPTH,
+        "heartbeat_wall_per_token_ms": pi["heartbeat_wall_per_token_ms"],
+        "heartbeat_wall_per_token_ms_sync": sy[
+            "heartbeat_wall_per_token_ms"],
+        "heartbeat_wall_per_token_improvement_pct": round(
+            (1.0 - pi["heartbeat_wall_per_token_ms"]
+             / sy["heartbeat_wall_per_token_ms"]) * 100.0, 1)
+        if sy["heartbeat_wall_per_token_ms"] else 0.0,
+        "duty_cycle": pi["duty_cycle"],
+        "duty_cycle_sync": sy["duty_cycle"],
+        "host_s_fraction": round(1.0 - pi["duty_cycle"], 4),
+        "discarded_inflight_tokens": pi["discarded_inflight_tokens"],
+        "token_exact_vs_sync": mismatches == 0,
+        "token_mismatched_requests": mismatches,
+        "windows": WINDOWS,
+        "requests_per_window": REQUESTS,
+        "compiled_programs": engine.compiled_programs,
+        "model": SIZE,
+    }
+    return rows, summary
+
+
+def main_async():
+    import jax
+
+    _load_env(smoke=dict(ASYNC_SMOKE))
+
+    rows, summary = async_stats()
+    for mode in ("sync", "pipelined"):
+        print(json.dumps(rows[mode]))
+    summary["backend"] = jax.default_backend()
+    print(json.dumps(summary))
+
+
 if __name__ == "__main__":
     from apex_tpu.telemetry import guard_bench_main
 
@@ -1434,5 +1592,7 @@ if __name__ == "__main__":
         guard_bench_main(main_tp, TP_METRIC)
     elif "--quantized-kv" in sys.argv[1:]:
         guard_bench_main(main_quant, QUANT_METRIC)
+    elif "--async-heartbeat" in sys.argv[1:]:
+        guard_bench_main(main_async, ASYNC_METRIC)
     else:
         guard_bench_main(main, METRIC)

@@ -17,11 +17,28 @@ single-chip one on every dashboard. The ``serving.kv.*`` family joined
 with the quantized-cache tentpole: ``serving.kv.bytes_per_token`` is
 the capacity claim's basis, and ``serving.kv.quant_scale_absmax`` going
 dark would hide that a drifted workload is CLIPPING against its
-calibration. The loop is closed by lint: the set of
-fault/watchdog/spec/tp/kv metric literals in ``apex_tpu/serving/``
-source must EQUAL the set named in the docs' tables.
+calibration. The ``serving.heartbeat.*`` family joined with the async
+pipelined heartbeat: ``host_s`` / ``device_wait_s`` / ``duty_cycle``
+are the duty-cycle claim's basis (the whole point of dispatch-ahead
+execution), and ``discarded`` going dark would hide speculated-finality
+rollbacks entirely. The loop is closed by lint: the set of
+fault/watchdog/spec/tp/kv/heartbeat metric literals in
+``apex_tpu/serving/`` source must EQUAL the set named in the docs'
+tables.
+
+This file also owns the **force-early lint**: the dispatch-ahead
+region of ``scheduler.py`` (everything between a decode dispatch and
+its reconcile) must never force a device value to host — no ``int()``
+/ ``float()`` / ``np.asarray()`` / ``np.array()`` / ``jax.device_get``
+calls inside :func:`Scheduler._dispatch_decode` or
+:func:`Scheduler._pipeline_last_tokens`. A single forced read there
+serializes the host against the device and silently reverts the
+pipelined heartbeat to the sync one — the exact foot-gun the async
+refactor exists to remove, invisible to every parity test because
+forcing changes no tokens.
 """
 
+import ast
 import glob
 import os
 import re
@@ -36,9 +53,9 @@ SRC_DIR = os.path.join(ROOT, "apex_tpu", "serving")
 DOC = os.path.join(ROOT, "docs", "serving.md")
 
 # metric families the fault-isolation + speculative + tensor-parallel
-# + quantized-KV layers own
+# + quantized-KV + async-heartbeat layers own
 _PAT = re.compile(
-    r"serving\.(?:faults|watchdog|spec|tp|kv)\.[a-z0-9_]+")
+    r"serving\.(?:faults|watchdog|spec|tp|kv|heartbeat)\.[a-z0-9_]+")
 
 
 def _emitted():
@@ -94,6 +111,15 @@ def test_scan_surface_is_alive():
         assert engine_py in emitted.get(name, []), \
             f"{name} not emitted by the engine — batched-verify/tp/" \
             "quantized-kv telemetry went dark"
+    # the async-heartbeat family: the host-think/device-wait split and
+    # the speculated-finality rollback counter are scheduler-emitted
+    for name in ("serving.heartbeat.host_s",
+                 "serving.heartbeat.device_wait_s",
+                 "serving.heartbeat.duty_cycle",
+                 "serving.heartbeat.discarded"):
+        assert sched in emitted.get(name, []), \
+            f"{name} not emitted by the scheduler — async-heartbeat " \
+            "telemetry went dark"
     assert _documented(), "docs/serving.md names no fault/watchdog/" \
         "spec metrics — doc section missing?"
 
@@ -115,3 +141,63 @@ def test_every_documented_fault_metric_is_emitted():
         f"docs/serving.md documents fault/watchdog metrics no serving "
         f"code emits (stale doc rows — delete them or wire the "
         f"emitter): {stale}")
+
+
+# ------------------------------------------------- the force-early lint
+# Functions that make up the dispatch-ahead region: between issuing a
+# decode step and reconciling it, the host must never block on a device
+# value. These are checked by NAME so a rename breaks the lint loudly
+# instead of silently un-scoping it.
+_DISPATCH_REGION = ("_dispatch_decode", "_pipeline_last_tokens")
+
+# Call shapes that force a device array to host. ``jnp.*`` stays legal
+# (device-side ops); ``np.zeros``/``np.flatnonzero`` over host state
+# stay legal (no device operand can reach them in these functions,
+# which hold only host bookkeeping + PendingDecode handles).
+_FORCING_NAMES = {"int", "float", "bool"}
+_FORCING_ATTRS = {("np", "asarray"), ("np", "array"),
+                  ("numpy", "asarray"), ("numpy", "array"),
+                  ("jax", "device_get"), ("jax", "block_until_ready")}
+
+
+def _forcing_calls(fn_node):
+    bad = []
+    for node in ast.walk(fn_node):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Name) and f.id in _FORCING_NAMES:
+            bad.append((f.id, node.lineno))
+        elif (isinstance(f, ast.Attribute)
+              and isinstance(f.value, ast.Name)
+              and (f.value.id, f.attr) in _FORCING_ATTRS):
+            bad.append((f"{f.value.id}.{f.attr}", node.lineno))
+    return bad
+
+
+def test_dispatch_ahead_region_never_forces_to_host():
+    """No code path between decode dispatch and reconcile may call
+    ``int()`` / ``float()`` / ``np.asarray`` / ``jax.device_get`` on
+    anything: a forced read there stalls the host on the in-flight
+    step and silently degrades pipeline_depth>=1 to the sync beat
+    (tokens identical, overlap gone — no parity test can catch it)."""
+    path = os.path.join(SRC_DIR, "scheduler.py")
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    found = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name in _DISPATCH_REGION:
+            found[node.name] = _forcing_calls(node)
+    missing = set(_DISPATCH_REGION) - set(found)
+    assert not missing, (
+        f"dispatch-ahead functions {sorted(missing)} not found in "
+        "scheduler.py — renamed? update _DISPATCH_REGION so the "
+        "force-early lint keeps covering the region")
+    offenders = {name: calls for name, calls in found.items() if calls}
+    assert not offenders, (
+        f"host-forcing calls inside the dispatch-ahead region "
+        f"(function -> [(call, line)]): {offenders} — these block the "
+        "host on in-flight device work, the exact stall the async "
+        "heartbeat exists to remove. Move the read to "
+        "_reconcile_oldest (the one batched readback site).")
