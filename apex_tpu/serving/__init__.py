@@ -67,14 +67,28 @@ generation requests from a fixed set of compiled programs:
 - :class:`FaultPlan` / :class:`FaultPolicy` / :class:`PoolAuditor`
   (:mod:`.faults`) — fault isolation: a seeded deterministic
   chaos-injection harness (non-finite logits into chosen decode slots,
-  transient call-boundary exceptions, heartbeat stalls, debug-copy
-  page-table corruption), the scheduler's always-on containment policy
-  (per-slot non-finite quarantine, requeue with capped exponential
-  backoff → typed ``FAILED``, heartbeat watchdog), and an O(pages)
-  page-pool invariant auditor that raises loudly on leaked or
-  double-freed pages. Un-faulted greedy requests stay bitwise
-  identical to a fault-free run; containment adds ZERO compiled
-  programs.
+  transient call-boundary exceptions, heartbeat stalls, replica deaths
+  at the router tier, debug-copy page-table corruption), the
+  scheduler's always-on containment policy (per-slot non-finite
+  quarantine, requeue with capped exponential backoff → typed
+  ``FAILED``, heartbeat watchdog), and an O(pages) page-pool invariant
+  auditor that raises loudly on leaked or double-freed pages.
+  Un-faulted greedy requests stay bitwise identical to a fault-free
+  run; containment adds ZERO compiled programs.
+
+- :class:`Router` (:mod:`.router`) — replica-parallel serving (tp × dp
+  scale-out): N ``Scheduler``+``Engine`` replicas behind one
+  host-side ``submit()`` that routes by PREFIX AFFINITY (one set of
+  rolling block hashes probes every replica's cache read-only; the
+  request lands where its K/V already lives) with least-loaded
+  admission as the fallback (free slots / queue depth / free pool
+  pages from :meth:`Scheduler.load_snapshot`), cross-replica
+  backpressure (a full replica is a spill to the next-best; QueueFull
+  only when the whole fleet is saturated, ``retry_after_s`` = max of
+  replica hints), and replica-death containment: a dead replica's
+  requests drain (:meth:`Scheduler.drain_requests`) and re-route onto
+  survivors with zero leaked pages — un-faulted requests stay bitwise.
+  Zero compiled programs added; ``serving.router.*`` telemetry.
 
 Quick start::
 
@@ -100,6 +114,7 @@ from .faults import (FaultPlan, FaultPolicy, FaultSpec, InjectedFault,
 from .kv_cache import KVCache, PagedKVCache, PagePool
 from .kv_quant import KVQuantConfig
 from .prefix_cache import PrefixCache, PrefixMatch
+from .router import Router
 from .scheduler import QueueFull, Request, RequestStatus, Scheduler
 from .speculative import DraftWorker, SpecConfig, draft_tokens
 
@@ -107,5 +122,6 @@ __all__ = ["DraftWorker", "Engine", "FaultPlan", "FaultPolicy",
            "FaultSpec", "InjectedFault", "KVCache", "KVQuantConfig",
            "PagedKVCache", "PagePool", "PendingDecode", "PoolAuditor",
            "PoolInvariantError", "PrefixCache", "PrefixMatch",
-           "QueueFull", "Request", "RequestStatus", "Scheduler",
-           "SpecConfig", "draft_tokens", "sample_tokens", "sharding"]
+           "QueueFull", "Request", "RequestStatus", "Router",
+           "Scheduler", "SpecConfig", "draft_tokens", "sample_tokens",
+           "sharding"]

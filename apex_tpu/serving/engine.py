@@ -1401,6 +1401,14 @@ class Engine:
             self.pool.share(pages)
         return outcome
 
+    @property
+    def pages_free(self) -> int:
+        """Free pages in the paged pool right now (0 on the contiguous
+        layout) — the cheap host-only capacity gauge the router's
+        least-loaded admission reads per routed request, without the
+        fragmentation walk :meth:`pool_stats` pays."""
+        return self.pool.free_pages if self.paged else 0
+
     def pool_stats(self) -> dict:
         """Paged-pool telemetry snapshot: allocator counters plus the
         per-slot fragmentation view (allocated-but-invalid positions

@@ -13,8 +13,10 @@ module is the serving-side counterpart, three pieces:
   NaN/Inf logits), transient exceptions raised at the chunk-prefill or
   decode call boundary (:class:`InjectedFault` — raised *instead of*
   the compiled call, so cache state is never half-mutated), heartbeat
-  stalls (a plain sleep the watchdog must catch), and page-table
-  corruption applied to **debug copies only**
+  stalls (a plain sleep the watchdog must catch), whole-replica deaths
+  consumed by the :class:`~apex_tpu.serving.Router`'s step loop (the
+  router-tier fault: the dead replica's requests drain onto the
+  survivors), and page-table corruption applied to **debug copies only**
   (:meth:`FaultPlan.corrupt_page_table` — proving the
   :class:`PoolAuditor` detects corruption; it is never pointed at the
   live tables). Deterministic by construction: explicit specs or
@@ -102,6 +104,13 @@ class FaultSpec:
       / ``"verify"``), instead of running the compiled call.
     - ``"stall"`` — sleep ``stall_s`` seconds at heartbeat ``tick``
       (the watchdog-budget breach the plan manufactures).
+    - ``"replica_death"`` — the ROUTER-tier fault: kill replica
+      ``replica`` at ROUTER tick ``tick``. Consumed by
+      :meth:`FaultPlan.take_replica_deaths` from the
+      :class:`~apex_tpu.serving.Router`'s step loop (a scheduler-tier
+      plan never sees it) — the router drains the dead replica's
+      queued and in-flight requests onto the survivors, so the death
+      is a routing event, not an outage.
     """
 
     kind: str
@@ -110,9 +119,11 @@ class FaultSpec:
     site: str = "decode"
     value: float = float("nan")
     stall_s: float = 0.0
+    replica: int = -1
 
     def __post_init__(self):
-        if self.kind not in ("nonfinite", "exception", "stall"):
+        if self.kind not in ("nonfinite", "exception", "stall",
+                             "replica_death"):
             raise ValueError(f"unknown fault kind {self.kind!r}")
         if self.kind == "nonfinite" and self.slot < 0:
             raise ValueError("nonfinite faults need a victim slot")
@@ -121,6 +132,9 @@ class FaultSpec:
                              f"{_EXCEPTION_SITES}")
         if self.kind == "stall" and self.stall_s <= 0:
             raise ValueError("stall faults need stall_s > 0")
+        if self.kind == "replica_death" and self.replica < 0:
+            raise ValueError("replica_death faults need a victim "
+                             "replica index")
 
 
 class FaultPlan:
@@ -136,23 +150,29 @@ class FaultPlan:
         self._nonfinite: Dict[int, List[FaultSpec]] = {}
         self._exceptions: Dict[Tuple[str, int], FaultSpec] = {}
         self._stalls: Dict[int, FaultSpec] = {}
+        self._deaths: Dict[int, List[FaultSpec]] = {}
         for s in self.specs:
             if s.kind == "nonfinite":
                 self._nonfinite.setdefault(int(s.tick), []).append(s)
             elif s.kind == "exception":
                 self._exceptions[(s.site, int(s.tick))] = s
+            elif s.kind == "replica_death":
+                self._deaths.setdefault(int(s.tick), []).append(s)
             else:
                 self._stalls[int(s.tick)] = s
         # raw injection counters (the chaos bench reads them)
         self.injected_nonfinite = 0
         self.injected_exceptions = 0
         self.injected_stalls = 0
+        self.injected_replica_deaths = 0
 
     @classmethod
     def random(cls, seed: int, ticks: int, *, slots: int,
                nonfinite_rate: float = 0.0, exception_rate: float = 0.0,
                stall_rate: float = 0.0, stall_s: float = 0.05,
-               sites: Sequence[str] = ("chunk", "decode")) -> "FaultPlan":
+               sites: Sequence[str] = ("chunk", "decode"),
+               replica_death_rate: float = 0.0,
+               replicas: int = 0) -> "FaultPlan":
         """A seeded random schedule over ``ticks`` heartbeats: each
         tick independently draws a non-finite injection (uniform victim
         slot), a transient exception (site uniform over ``sites``),
@@ -160,11 +180,17 @@ class FaultPlan:
         schedule, always. ``sites`` defaults to the two call sites every
         scheduler has — include ``"verify"`` only for speculative runs
         (a verify-site fault on a non-speculative scheduler never
-        fires)."""
+        fires). ``replica_death_rate`` > 0 (router-tier plans only;
+        requires ``replicas`` >= 1) additionally draws a replica death
+        with a uniform victim — the draw is SKIPPED entirely at the
+        default rate 0, so pre-router seeds replay bit-for-bit."""
         for s in sites:
             if s not in _EXCEPTION_SITES:
                 raise ValueError(f"exception site {s!r} not in "
                                  f"{_EXCEPTION_SITES}")
+        if replica_death_rate > 0 and replicas < 1:
+            raise ValueError("replica_death_rate > 0 needs replicas "
+                             ">= 1 to draw victims from")
         rng = np.random.default_rng(seed)
         specs: List[FaultSpec] = []
         for t in range(int(ticks)):
@@ -179,6 +205,11 @@ class FaultPlan:
             if rng.random() < stall_rate:
                 specs.append(FaultSpec(kind="stall", tick=t,
                                        stall_s=stall_s))
+            if replica_death_rate > 0 \
+                    and rng.random() < replica_death_rate:
+                specs.append(FaultSpec(
+                    kind="replica_death", tick=t,
+                    replica=int(rng.integers(0, replicas))))
         return cls(specs)
 
     # ------------------------------------------------------------ injection
@@ -236,6 +267,18 @@ class FaultPlan:
                 f"injected transient {site} failure at tick {tick}",
                 slot=spec.slot)
 
+    def take_replica_deaths(self, tick: int) -> List[int]:
+        """CONSUME the replica deaths scheduled for this ROUTER tick,
+        returning the victim replica indices (empty on death-free
+        ticks). Called by the :class:`~apex_tpu.serving.Router` once
+        per step — each spec fires exactly once, like every other
+        injection."""
+        specs = self._deaths.pop(int(tick), None)
+        if not specs:
+            return []
+        self.injected_replica_deaths += len(specs)
+        return [s.replica for s in specs]
+
     def maybe_stall(self, tick: int) -> float:
         """Sleep through the stall scheduled for this heartbeat (if
         any); returns the seconds slept (0.0 on stall-free ticks)."""
@@ -275,6 +318,7 @@ class FaultPlan:
             "injected_nonfinite": self.injected_nonfinite,
             "injected_exceptions": self.injected_exceptions,
             "injected_stalls": self.injected_stalls,
+            "injected_replica_deaths": self.injected_replica_deaths,
         }
 
 

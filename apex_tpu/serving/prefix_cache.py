@@ -183,6 +183,35 @@ class PrefixCache:
         hash offload). The hash is deterministic and every hit is
         still verified token-for-token below, so precomputed and
         inline keys are interchangeable bit-for-bit."""
+        best = self._best_match(prompt, keys)
+        if best is None:
+            self.misses += 1
+            return None
+        self.hits += 1
+        self.tokens_reused += best.length
+        entry = self._entries[best.row]
+        entry.last_used = next(self._clock)
+        return best
+
+    def probe(self, prompt: Sequence[int],
+              keys: Optional[Sequence[int]] = None) -> int:
+        """READ-ONLY affinity probe: the length of the longest cached
+        block-aligned prefix of ``prompt`` (0 on a miss), verified
+        token-for-token exactly like :meth:`match` — but touching
+        NOTHING: no hit/miss counters, no LRU refresh, no refcounts.
+        This is the :class:`~apex_tpu.serving.Router`'s routing signal
+        — it probes EVERY replica's cache per request, and a probe that
+        counted would poison :attr:`hit_rate` (and churn LRU order) on
+        the N-1 replicas the request never lands on. Same ``keys``
+        contract as :meth:`match`."""
+        best = self._best_match(prompt, keys)
+        return 0 if best is None else best.length
+
+    def _best_match(self, prompt: Sequence[int],
+                    keys: Optional[Sequence[int]] = None) -> \
+            Optional[PrefixMatch]:
+        """The pure match walk shared by :meth:`match` (which adds
+        counter + LRU bookkeeping) and :meth:`probe` (which must not)."""
         n = len(prompt)
         max_blocks = (n - 1) // self.block_len       # strictly < n tokens
         if keys is None:
@@ -212,13 +241,6 @@ class PrefixCache:
                 page_len = len(entry.tokens) // len(entry.pages)
                 pages = entry.pages[:length // page_len]
             best = PrefixMatch(row=row, length=length, pages=pages)
-        if best is None:
-            self.misses += 1
-            return None
-        self.hits += 1
-        self.tokens_reused += best.length
-        entry = self._entries[best.row]
-        entry.last_used = next(self._clock)
         return best
 
     # ------------------------------------------------------------ refcounts
@@ -396,3 +418,26 @@ class PrefixCache:
             "entries": self.size,
             "capacity": self.capacity,
         }
+
+    _DELTA_KEYS = ("hits", "misses", "tokens_reused", "evictions",
+                   "pool_full", "registrations")
+
+    def stats_since(self, baseline: dict) -> dict:
+        """The counter DELTAS since ``baseline`` (a prior :meth:`stats`
+        snapshot), with ``hit_rate`` recomputed over the window's own
+        hits/misses. The raw counters are run-scoped, not cache-scoped —
+        they survive :meth:`clear` and every engine ``reset()`` on
+        purpose (cumulative totals stay honest across warm windows) —
+        so any per-window reading (the router's per-replica affinity
+        accounting, the bench's measured-window hit rate) must be a
+        delta: reading :attr:`hit_rate` directly after a warm reset
+        silently blends the warmup's hits in. Occupancy (``entries`` /
+        ``capacity``) is reported as-of-now — it is state, not a
+        counter."""
+        now = self.stats()
+        out = {k: now[k] - baseline.get(k, 0) for k in self._DELTA_KEYS}
+        consulted = out["hits"] + out["misses"]
+        out["hit_rate"] = out["hits"] / consulted if consulted else 0.0
+        out["entries"] = self.size
+        out["capacity"] = self.capacity
+        return out

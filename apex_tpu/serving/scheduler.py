@@ -418,11 +418,36 @@ class Scheduler:
         # worker's hash for the registration that follows ingestion)
         self._slot_hash_keys: List[Optional[list]] = \
             [None] * engine.slots
+        # uid -> rolling block keys handed in at submit (the router's
+        # pre-probed hashes); consumed at admission, dropped at finish
+        self._presubmitted_keys: Dict[int, list] = {}
 
     # ------------------------------------------------------------ ingestion
-    def submit(self, request: Request) -> Request:
+    def submit(self, request: Request,
+               prefix_keys: Optional[Sequence[int]] = None,
+               count_rejection: bool = True) -> Request:
         """Queue ``request``; raises :class:`QueueFull` at capacity and
-        ``ValueError`` for prompts the engine can never serve."""
+        ``ValueError`` for prompts the engine can never serve.
+
+        ``count_rejection=False`` suppresses the
+        ``serving.requests.rejected`` tick on a capacity raise — the
+        router probes replicas with it so an absorbed SPILL (placed
+        and served on the next-best replica) never reads as a
+        caller-visible rejection; the router counts one rejection
+        itself only when the WHOLE fleet turns the request away.
+
+        ``prefix_keys`` (optional) are the prompt's PRECOMPUTED rolling
+        block hashes — the :class:`~apex_tpu.serving.Router` already
+        computed them once to probe every replica's prefix cache, so
+        the chosen replica takes them here instead of re-hashing (the
+        hash is deterministic: precomputed and inline keys are
+        interchangeable bit-for-bit). At least ``len(prompt) //
+        block_len`` keys, as :meth:`PrefixCache.block_keys` returns.
+
+        A request whose ``_t_submit`` clock is already running (a
+        router requeue after a replica death) keeps it — like a
+        quarantine requeue, re-submission never resets ``latency_s``
+        or the deadline."""
         n = len(request.prompt)
         if not 0 < n <= self.engine.prefill_len:
             raise ValueError(
@@ -436,7 +461,7 @@ class Scheduler:
         # the Engine constructor guarantees every pool can hold, so the
         # queue head always admits eventually as running slots drain
         if len(self._queue) >= self.max_queue:
-            if self.registry is not None:
+            if self.registry is not None and count_rejection:
                 self.registry.counter_inc("serving.requests.rejected")
             hint = self._retry_after_hint()
             suffix = f" (retry_after_s~{hint:.3f})" if hint else ""
@@ -445,10 +470,16 @@ class Scheduler:
                 f"after a step() or shed load{suffix}",
                 retry_after_s=hint)
         request.status = RequestStatus.QUEUED
-        request._t_submit = time.perf_counter()
-        request._t_queued = request._t_submit
+        now = time.perf_counter()
+        if request._t_submit is None:
+            request._t_submit = now
+        request._t_queued = now
         self._queue.append(request)
-        if self._worker is not None and self.retain_prefixes:
+        if self.retain_prefixes and prefix_keys is not None:
+            # the router's pre-probed hashes: admission consumes them
+            # in place of a worker/inline computation
+            self._presubmitted_keys[request.uid] = list(prefix_keys)
+        elif self._worker is not None and self.retain_prefixes:
             # hash offload: the prompt's rolling block keys start
             # computing NOW on the worker thread, overlapping whatever
             # the device is executing — admission takes the result (or
@@ -516,6 +547,7 @@ class Scheduler:
             status = RequestStatus.EXPIRED if reason == "timeout" \
                 else RequestStatus.FINISHED
         request.status = status
+        self._presubmitted_keys.pop(request.uid, None)
         if request._t_submit is not None:
             request.latency_s = time.perf_counter() - request._t_submit
         if slot is not None:
@@ -574,13 +606,7 @@ class Scheduler:
                 request.retries - 1, error)
             self._finish(request, "fault", status=RequestStatus.FAILED)
             return
-        request.output_tokens.clear()
-        request._prefill_pos = 0
-        request.reused_tokens = 0
-        request.ttft_s = None
-        request.status = RequestStatus.QUEUED
-        now = time.perf_counter()
-        request._t_queued = now     # a fresh queueing episode begins
+        now = self._reset_transient(request)
         request._not_before = now + policy.backoff_s(request.retries)
         self._queue.append(request)
         if self.registry is not None:
@@ -588,6 +614,23 @@ class Scheduler:
         _logger.info("request %d requeued (retry %d/%d): %s",
                      request.uid, request.retries, policy.max_retries,
                      error)
+
+    def _reset_transient(self, request: Request) -> float:
+        """Roll ``request`` back to a servable queued state (the shared
+        half of a quarantine requeue and a replica-death drain): its
+        transient outputs reset, its paid-compute counters (``chunks``,
+        ``prefill_s``, the spec counters) and the ORIGINAL submit clock
+        kept — retries and drains never reset ``latency_s`` or the
+        deadline. Returns ``now`` (the fresh queueing episode's
+        start)."""
+        request.output_tokens.clear()
+        request._prefill_pos = 0
+        request.reused_tokens = 0
+        request.ttft_s = None
+        request.status = RequestStatus.QUEUED
+        now = time.perf_counter()
+        request._t_queued = now     # a fresh queueing episode begins
+        return now
 
     def _deadline(self, request: Request) -> Optional[float]:
         t = request.timeout_s if request.timeout_s is not None \
@@ -675,13 +718,14 @@ class Scheduler:
         the matched offset. A miss changes nothing — the request
         prefills cold from offset 0."""
         pcache = self.engine.prefix_cache
-        keys = None
-        if self._worker is not None:
+        keys = self._presubmitted_keys.pop(r.uid, None)
+        if keys is None and self._worker is not None:
             prompt = tuple(r.prompt)
             n_blocks = len(prompt) // pcache.block_len
             keys = self._worker.take(
                 ("hash", r.uid),
                 lambda: pcache.block_keys(prompt, n_blocks))
+        if keys is not None:
             # registration after ingestion reuses the same keys
             self._slot_hash_keys[slot] = keys
         m = pcache.match(r.prompt, keys=keys)
@@ -1522,6 +1566,74 @@ class Scheduler:
         if self._pipeline:
             n += 1
         return n
+
+    # ----------------------------------------------------- router seams
+    def load_snapshot(self) -> dict:
+        """One HOST-ONLY load reading for this scheduler+engine pair —
+        the :class:`~apex_tpu.serving.Router`'s least-loaded admission
+        signal, taken per routed request. Everything here is host
+        bookkeeping (queue/slot walks, the paged allocator's free
+        count); nothing forces a device value, so probing N replicas
+        per submit costs microseconds, not syncs. ``pages_free`` is
+        None on a contiguous engine (rows are preallocated — slot
+        occupancy is the whole capacity story there)."""
+        busy = sum(r is not None for r in self._running)
+        return {
+            "queue_depth": len(self._queue),
+            "queue_free": self.max_queue - len(self._queue),
+            "slots": self.engine.slots,
+            "slots_busy": busy,
+            "slots_free": self.engine.slots - busy,
+            "inflight_steps": len(self._pipeline),
+            "pages_free": self.engine.pages_free
+            if getattr(self.engine, "paged", False) else None,
+        }
+
+    def drain_requests(self) -> List[Request]:
+        """Export every live request — running slots first (admission
+        order), then the queue FIFO — rolled back to a servable queued
+        state (:meth:`_reset_transient`: outputs cleared, paid-compute
+        counters and the original submit clock kept, retry backoff
+        cleared so survivors re-admit immediately), with every slot
+        freed through the normal quarantine path: pages, reservations
+        and prefix pins go back to the pool NOW and any dispatched-
+        ahead steps are discarded, so a drained engine audits with
+        zero leaked pages. This is the replica-death seam: the router
+        calls it on a dead replica and requeues the result on
+        survivors — a drain is NOT a fault of the requests, so
+        ``retries`` is untouched. The scheduler itself stays
+        constructed (its ``completed`` history and telemetry survive);
+        pair with :meth:`close` to stop the worker thread."""
+        drained: List[Request] = []
+        for slot, r in enumerate(self._running):
+            if r is None:
+                continue
+            self._free_slot(slot)   # pages + reservation + prefix pin
+            self._reset_transient(r)
+            r._not_before = None
+            drained.append(r)
+        # any in-flight dispatch-ahead steps lost their uids to
+        # _free_slot above; drop the empty records (their device work
+        # is never reconciled — the dead engine's results are garbage)
+        self._pipeline.clear()
+        while self._queue:
+            r = self._queue.popleft()
+            self._reset_transient(r)
+            r._not_before = None
+            drained.append(r)
+        for r in drained:
+            # the router re-routes (and re-probes) on a survivor: this
+            # scheduler's stashed hash keys are dead weight
+            self._presubmitted_keys.pop(r.uid, None)
+        return drained
+
+    def close(self) -> None:
+        """Stop the scheduler's :class:`~apex_tpu.serving.DraftWorker`
+        thread (no-op at ``pipeline_depth=0``; idempotent — the
+        weakref finalizer registered at construction runs the same
+        stop)."""
+        if self._worker is not None:
+            self._worker.stop()
 
     def _sleep_toward_backoff(self) -> None:
         """When nothing occupies a slot and everything queued is inside

@@ -51,7 +51,13 @@ sub-object (BENCH_SERVING_QUANT=0 to drop it): the int8-capacity leg
 ``async_heartbeat`` sub-object (BENCH_SERVING_ASYNC=0 to drop it):
 sync vs dispatch-ahead pipelined serving on one engine — heartbeat
 wall per emitted token, duty cycle, ``token_mismatched_requests``
-(expected 0, bitwise) — via ``bench_serving.async_stats``.
+(expected 0, bitwise) — via ``bench_serving.async_stats``, and a
+nested ``replica_router`` sub-object (BENCH_SERVING_ROUTER=0 to drop
+it; BENCH_SERVING_REPLICAS sizes the fleet): the prefix-aware
+least-loaded router at 1 vs N replicas — aggregate tokens/s, p99
+TTFT, prefix hit rate affinity vs a random-routing control,
+``token_mismatched_requests`` (expected 0, bitwise) — via
+``bench_serving.replica_router_stats``.
 Failure-isolated at every layer: a broken serving stack puts
 {"error": ...} there, never kills the ResNet row.
 """
@@ -188,6 +194,17 @@ _SERVING_ASYNC_SMOKE = {
     "PREFILL_LEN": 32, "REQUESTS": 8, "NEW_TOKENS": 16, "WINDOWS": 2,
 }
 
+# The replica-router sub-leg's smoke geometry (the session stream is
+# served THREE ways — 1 replica, N affinity, N random control — so it
+# is sized small; REQUESTS is SESSIONS per window, 2 turns each;
+# CHUNK_LEN stays small so a turn's history spans several reuse
+# blocks). BENCH_SERVING_REPLICAS et al. still win, env-beats-smoke.
+_SERVING_ROUTER_SMOKE = {
+    "SIZE": "tiny", "VOCAB": 512, "SLOTS": 2, "MAX_LEN": 128,
+    "PREFILL_LEN": 48, "CHUNK_LEN": 8, "REQUESTS": 4, "NEW_TOKENS": 8,
+    "WINDOWS": 1, "PREFIX_POOL": 4,
+}
+
 
 def _serving_leg() -> dict:
     """The serving trajectory row (ROADMAP: bench_serving.py had no
@@ -214,6 +231,7 @@ def _serving_leg() -> dict:
         out["tensor_parallel"] = _serving_tp_leg()
         out["quantized_kv"] = _serving_quant_leg()
         out["async_heartbeat"] = _serving_async_leg()
+        out["replica_router"] = _serving_router_leg()
         return out
     except KeyboardInterrupt:
         raise
@@ -324,6 +342,36 @@ def _serving_async_leg() -> dict:
             "duty_cycle", "duty_cycle_sync", "host_s_fraction",
             "discarded_inflight_tokens", "token_mismatched_requests",
             "compiled_programs", "model")}
+    except KeyboardInterrupt:
+        raise
+    except BaseException as e:  # noqa: BLE001 — the row must not die here
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def _serving_router_leg() -> dict:
+    """The replica-parallel trajectory sub-row: smoke-sized
+    prefix-aware-router summary (1 replica vs BENCH_SERVING_REPLICAS,
+    affinity vs random-routing control — aggregate tokens/s, p99 TTFT,
+    prefix hit rate both policies, bitwise exactness) from
+    ``bench_serving.replica_router_stats``. BENCH_SERVING_ROUTER=0
+    drops it; failure-isolated like its siblings — a broken router
+    yields {"error": ...} here, never a lost serving (or ResNet)
+    row."""
+    if _env_int("BENCH_SERVING_ROUTER", "1") == 0:
+        return {"skipped": True}
+    try:
+        import bench_serving
+
+        bench_serving._load_env(smoke=dict(_SERVING_ROUTER_SMOKE))
+        _, summary = bench_serving.replica_router_stats()
+        return {k: summary[k] for k in (
+            "value", "unit", "replicas", "baseline_tokens_per_s",
+            "scaling_x", "ttft_p99_ms", "ttft_p99_ms_one_replica",
+            "prefix_hit_rate", "prefix_hit_rate_random",
+            "reused_tokens_per_request",
+            "reused_tokens_per_request_random",
+            "affinity_beats_random", "spills",
+            "token_mismatched_requests", "compiled_programs", "model")}
     except KeyboardInterrupt:
         raise
     except BaseException as e:  # noqa: BLE001 — the row must not die here

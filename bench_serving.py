@@ -181,6 +181,30 @@ wall-per-token improvement is the silicon claim (real accelerators
 dispatch asynchronously — the premise the refactor is built on).
 Defaults to a smoke geometry; env knobs resize it (env-beats-smoke).
 
+``--replica-router`` runs the replica-parallel leg: a multi-turn
+session stream (``BENCH_SERVING_REQUESTS`` sessions of 2 turns per
+window; turn 2's prompt EXTENDS turn 1's, so its block-aligned prefix
+lives exactly where turn 1 was served) routed through
+``serving.Router`` three ways — ONE replica (the baseline),
+``BENCH_SERVING_REPLICAS`` replicas with prefix-affinity routing, and
+the same fleet with seeded RANDOM routing (the control row: what
+scale-out looks like when nobody cares where the K/V lives). One row
+per mode plus a final line whose payoff fields are aggregate tokens/s
+at 1 vs N (+ ``scaling_x``), p99 TTFT both, the **prefix hit rate**
+affinity vs random (measured from per-replica
+``PrefixCache.stats_since`` deltas over the measured windows — the
+delta lens is what makes the reading immune to the counters'
+cumulative-across-reset semantics), reused-tokens-per-request both,
+``affinity_beats_random`` (the routing claim), and
+``token_mismatched_requests`` vs the 1-replica run — expected 0
+**bitwise** under every policy (identically-built replicas: routing
+changes WHERE a request decodes, never what). CPU regime note:
+replicas share this box's CPU cores, so N-replica tokens/s is NOT a
+scaling measurement here — affinity hit rate vs the control, bitwise
+parity and the leak-free drain are the CPU-honest columns; aggregate
+scaling vs replica count is the silicon claim. Defaults to a smoke
+geometry; env knobs resize it (env-beats-smoke).
+
 Wrapped in ``guard_bench_main`` — EVERY outcome (backend init failure,
 OOM, bad env) still ends in a parseable JSON line.
 """
@@ -204,6 +228,7 @@ SPEC_METRIC = "serving_speculative_tokens_per_sec"
 TP_METRIC = "serving_tensor_parallel_tokens_per_sec"
 QUANT_METRIC = "serving_quantized_kv_tokens_per_sec"
 ASYNC_METRIC = "serving_async_heartbeat_tokens_per_sec"
+ROUTER_METRIC = "serving_replica_router_tokens_per_sec"
 
 # Literal defaults at import time; the BENCH_SERVING_* env overrides are
 # parsed by _load_env() INSIDE each guarded main, so a malformed value
@@ -273,6 +298,17 @@ ASYNC_DEPTH = 2
 ASYNC_SMOKE = {"SIZE": "tiny", "VOCAB": 512, "SLOTS": 4,
                "MAX_LEN": 128, "PREFILL_LEN": 32, "REQUESTS": 8,
                "NEW_TOKENS": 16, "WINDOWS": 2}
+# --replica-router leg: engine replicas behind the prefix-aware router
+# (the leg serves its session stream THREE ways — 1 replica, N with
+# affinity, N with random routing — so it is sized small) and its
+# smoke preset. REQUESTS is SESSIONS per window here (2 turns each);
+# CHUNK_LEN stays small so a turn's history spans several blocks and
+# reuse is visible at block granularity.
+REPLICAS = 2
+ROUTER_SMOKE = {"SIZE": "tiny", "VOCAB": 512, "SLOTS": 2,
+                "MAX_LEN": 128, "PREFILL_LEN": 48, "CHUNK_LEN": 8,
+                "REQUESTS": 6, "NEW_TOKENS": 8, "WINDOWS": 1,
+                "PREFIX_POOL": 4}
 
 _ENV_KNOBS = {
     "VOCAB": "BENCH_SERVING_VOCAB", "SLOTS": "BENCH_SERVING_SLOTS",
@@ -293,6 +329,7 @@ _ENV_KNOBS = {
     "TP": "BENCH_SERVING_TP",
     "QUANT_SLOTS": "BENCH_SERVING_QUANT_SLOTS",
     "ASYNC_DEPTH": "BENCH_SERVING_ASYNC_DEPTH",
+    "REPLICAS": "BENCH_SERVING_REPLICAS",
 }
 
 
@@ -1575,6 +1612,183 @@ def main_async():
     print(json.dumps(summary))
 
 
+def _router_waves(rng):
+    """REQUESTS multi-turn sessions, 2 turns each, served as
+    sequential WAVES (a turn arrives only after the previous response
+    — real multi-turn traffic). Turn 2's prompt EXTENDS turn 1's, so
+    its block-aligned prefix is resident exactly on the replica that
+    served turn 1: affinity routing hits it, random routing hits only
+    when luck lands the turn home — which is what makes the hit-rate
+    gap the routing claim."""
+    from apex_tpu.serving import Request
+
+    chunk = CHUNK_LEN or 8
+    waves = [[], []]
+    for _ in range(REQUESTS):
+        # session histories are DISJOINT on purpose: the only possible
+        # hit is a turn-2 request finding its own turn-1 K/V, so the
+        # hit rate reads routing quality cleanly (a shared system
+        # prompt would let any replica serve a shallow hit and blur
+        # the affinity-vs-random gap the leg exists to measure)
+        p = rng.integers(1, VOCAB, size=2 * chunk).tolist()
+        for t in range(2):
+            prompt = list(p)[:PREFILL_LEN]
+            budget = max(1, min(NEW_TOKENS, MAX_LEN - len(prompt)))
+            waves[t].append(Request(prompt=prompt,
+                                    max_new_tokens=budget))
+            if len(p) + chunk <= PREFILL_LEN:
+                p = p + rng.integers(1, VOCAB, size=chunk).tolist()
+    return waves
+
+
+def _serve_router(engines, policy, seed):
+    """WINDOWS measured windows (plus a discarded compile warmup) of
+    the session-wave stream through one Router mode. Per-replica
+    prefix accounting reads ``stats_since`` DELTAS over the measured
+    windows — the cache counters survive the warm resets between
+    windows on purpose, so only a delta isolates the window."""
+    from apex_tpu import serving, telemetry
+
+    reg = telemetry.MetricsRegistry()
+    rng = np.random.default_rng(seed)
+    rates, all_reqs, ttfts = [], [], []
+    hits = misses = reused = 0
+    for w in range(WINDOWS + 1):
+        for e in engines:
+            e.reset(clear_prefixes=True)
+            e.set_registry(reg if w else None)
+        router = serving.Router(engines, registry=reg if w else None,
+                                route_policy=policy, seed=seed,
+                                max_queue=max(REQUESTS, 1),
+                                chunk_budget=CHUNK_BUDGET,
+                                retain_prefixes=True)
+        waves = _router_waves(rng)
+        base = [e.prefix_cache.stats() for e in engines]
+        t0 = time.perf_counter()
+        tokw = sum(e.tokens_generated for e in engines)
+        for wave in waves:
+            router.run(wave)
+        dt = time.perf_counter() - t0
+        router.close()
+        reqs = [r for wave in waves for r in wave]
+        assert all(r.status == "finished" for r in reqs)
+        if w > 0:
+            rates.append(
+                (sum(e.tokens_generated for e in engines) - tokw) / dt)
+            for e, b in zip(engines, base):
+                d = e.prefix_cache.stats_since(b)
+                hits += d["hits"]
+                misses += d["misses"]
+                reused += d["tokens_reused"]
+            all_reqs.extend(reqs)
+            ttfts.extend(r.ttft_s for r in reqs
+                         if r.ttft_s is not None)
+    for e in engines:
+        e.set_registry(None)
+    consulted = hits + misses
+    return {
+        "rate": _median(rates),
+        "hit_rate": hits / consulted if consulted else 0.0,
+        "reused_per_request": reused / len(all_reqs) if all_reqs
+        else 0.0,
+        "ttft_p99_ms": float(np.percentile(ttfts, 99) * 1e3)
+        if ttfts else 0.0,
+        "reqs": all_reqs,
+        "snap": reg.snapshot(),
+    }
+
+
+def replica_router_stats():
+    """The --replica-router measurement, reusable by bench.py's
+    serving trajectory leg: the SAME seeded session-wave stream served
+    through Router(1 replica) — the baseline — then
+    Router(REPLICAS) with affinity routing and with seeded random
+    routing (the control). Headline fields: aggregate tokens/s 1 vs N
+    (CPU caveat: replicas share cores here — scaling is the silicon
+    claim), p99 TTFT, prefix hit rate affinity vs random (the
+    CPU-honest routing claim: ``affinity_beats_random`` compares hit
+    rate, depth-tie-broken by reused tokens), and
+    ``token_mismatched_requests`` vs the 1-replica run (expected 0,
+    bitwise, under every policy)."""
+    n = max(1, REPLICAS)
+    engines = [_build_engine(prefix_pool=PREFIX_POOL)
+               for _ in range(n)]
+    modes = {
+        "one_replica": (engines[:1], "affinity"),
+        "affinity": (engines, "affinity"),
+        "random": (engines, "random"),
+    }
+    rows, results = {}, {}
+    for mode, (engs, policy) in modes.items():
+        res = _serve_router(engs, policy, seed=17)
+        results[mode] = res
+        counters = res["snap"]["counters"]
+        rows[mode] = {
+            "metric": f"{ROUTER_METRIC}.{mode}",
+            "value": round(res["rate"], 2),
+            "unit": "tokens/s",
+            "replicas": len(engs),
+            "route_policy": policy,
+            "prefix_hit_rate": round(res["hit_rate"], 4),
+            "reused_tokens_per_request": round(
+                res["reused_per_request"], 2),
+            "ttft_p99_ms": round(res["ttft_p99_ms"], 3),
+            "routed": int(counters.get("serving.router.routed", 0)),
+            "affinity_hits": int(counters.get(
+                "serving.router.affinity_hits", 0)),
+            "spills": int(counters.get("serving.router.spills", 0)),
+            "compiled_programs": [e.compiled_programs for e in engs],
+        }
+    ref = [list(r.output_tokens) for r in results["one_replica"]["reqs"]]
+    mism = sum(
+        sum(a != b for a, b in
+            zip([list(r.output_tokens) for r in results[m]["reqs"]],
+                ref))
+        for m in ("affinity", "random"))
+    aff, rnd, one = rows["affinity"], rows["random"], rows["one_replica"]
+    summary = {
+        "metric": ROUTER_METRIC,
+        "value": aff["value"],
+        "unit": "tokens/s",
+        "replicas": n,
+        "baseline_tokens_per_s": one["value"],
+        "scaling_x": round(aff["value"] / one["value"], 3)
+        if one["value"] else 0.0,
+        "ttft_p99_ms": aff["ttft_p99_ms"],
+        "ttft_p99_ms_one_replica": one["ttft_p99_ms"],
+        "prefix_hit_rate": aff["prefix_hit_rate"],
+        "prefix_hit_rate_random": rnd["prefix_hit_rate"],
+        "reused_tokens_per_request": aff["reused_tokens_per_request"],
+        "reused_tokens_per_request_random": rnd[
+            "reused_tokens_per_request"],
+        "affinity_beats_random": (
+            aff["prefix_hit_rate"], aff["reused_tokens_per_request"])
+        > (rnd["prefix_hit_rate"], rnd["reused_tokens_per_request"]),
+        "affinity_hits": aff["affinity_hits"],
+        "spills": aff["spills"],
+        "token_exact_vs_one_replica": mism == 0,
+        "token_mismatched_requests": mism,
+        "windows": WINDOWS,
+        "sessions_per_window": REQUESTS,
+        "turns": 2,
+        "compiled_programs": [e.compiled_programs for e in engines],
+        "model": SIZE,
+    }
+    return rows, summary
+
+
+def main_router():
+    import jax
+
+    _load_env(smoke=dict(ROUTER_SMOKE))
+
+    rows, summary = replica_router_stats()
+    for mode in ("one_replica", "affinity", "random"):
+        print(json.dumps(rows[mode]))
+    summary["backend"] = jax.default_backend()
+    print(json.dumps(summary))
+
+
 if __name__ == "__main__":
     from apex_tpu.telemetry import guard_bench_main
 
@@ -1594,5 +1808,7 @@ if __name__ == "__main__":
         guard_bench_main(main_quant, QUANT_METRIC)
     elif "--async-heartbeat" in sys.argv[1:]:
         guard_bench_main(main_async, ASYNC_METRIC)
+    elif "--replica-router" in sys.argv[1:]:
+        guard_bench_main(main_router, ROUTER_METRIC)
     else:
         guard_bench_main(main, METRIC)

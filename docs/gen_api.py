@@ -62,6 +62,7 @@ PAGES = {
                 "apex_tpu.serving.prefix_cache",
                 "apex_tpu.serving.speculative",
                 "apex_tpu.serving.scheduler",
+                "apex_tpu.serving.router",
                 "apex_tpu.serving.faults"],
     "contrib": [
         "apex_tpu.contrib.bottleneck", "apex_tpu.contrib.clip_grad",

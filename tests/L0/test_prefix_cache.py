@@ -112,6 +112,61 @@ def test_match_is_block_aligned_and_capped_below_the_prompt():
     assert pc.tokens_reused == 8 + 4 + 4
 
 
+def test_probe_reads_like_match_but_mutates_nothing():
+    """The router's affinity probe: identical verified-longest-prefix
+    answer as match(), with ZERO bookkeeping — no hit/miss counters, no
+    LRU refresh, no refcounts. A probe that counted would poison every
+    non-chosen replica's hit_rate N-1 times per routed request."""
+    pc = _pc()
+    pc.register(list(range(1, 11)), lambda row, n: None)
+    prompt = list(range(1, 9)) + [77, 78, 79]
+    assert pc.probe(prompt) == pc.match(prompt).length == 8
+    stats0 = pc.stats()
+    clock0 = pc._entries[9].last_used
+    # hits, misses and LRU order are all untouched by any probe outcome
+    assert pc.probe(prompt) == 8
+    assert pc.probe([5, 5, 5, 5, 5]) == 0          # a miss probes as 0
+    assert pc.probe(prompt, keys=pc.block_keys(prompt, 2)) == 8
+    assert pc.stats() == stats0
+    assert pc._entries[9].last_used == clock0
+    # and the verified-tokens guarantee holds: a would-be hash hit over
+    # different tokens probes as 0, never a wrong length
+    assert pc.probe([1, 2, 3, 99] + list(range(5, 12))) == 0
+
+
+def test_stats_since_reads_window_deltas_across_warm_resets():
+    """The counters are run-scoped on purpose (they survive clear() and
+    warm engine resets), so per-window accounting — the router's
+    per-replica affinity rates, the bench's measured windows — must be
+    a delta: stats_since(baseline) isolates the window, where reading
+    hit_rate directly would blend every prior window in."""
+    pc = _pc()
+    pc.register([1] * 8, lambda row, n: None)
+    assert pc.match([1] * 9) is not None            # warmup hit
+    assert pc.match([7] * 9) is None                # warmup miss
+    base = pc.stats()
+    # a warm reset drops entries but NOT counters — the PR 11 quirk
+    pc.clear()
+    assert pc.hits == 1 and pc.misses == 1
+    pc.register([2] * 8, lambda row, n: None)
+    assert pc.match([2] * 12) is not None
+    assert pc.match([2] * 12) is not None
+    assert pc.match([9] * 9) is None
+    delta = pc.stats_since(base)
+    assert delta["hits"] == 2 and delta["misses"] == 1
+    assert delta["hit_rate"] == pytest.approx(2 / 3)
+    assert delta["registrations"] == 1
+    assert delta["tokens_reused"] == 16
+    # the cumulative view is (deliberately) different from the window's
+    assert pc.hit_rate == pytest.approx(3 / 5)
+    # occupancy is state, not a counter: reported as-of-now
+    assert delta["entries"] == pc.size == 1
+    # an empty window reads all-zero, hit_rate 0.0 (not NaN/raise)
+    empty = pc.stats_since(pc.stats())
+    assert empty["hits"] == empty["misses"] == 0
+    assert empty["hit_rate"] == 0.0
+
+
 def test_register_dedupes_and_rejects_too_short():
     pc = _pc()
     calls = []

@@ -1,0 +1,531 @@
+"""Replica-parallel serving: the :class:`~apex_tpu.serving.Router`'s
+contract pins.
+
+The headline guarantees, per ISSUE 12's acceptance criteria:
+
+- **Parity**: the same request stream served through
+  ``Router([engine])`` is bitwise identical (per submitted request) to
+  a bare :class:`~apex_tpu.serving.Scheduler` on the same engine, and
+  an N-replica router's greedy outputs are bitwise identical to the
+  1-replica run — replication changes WHERE a request decodes, never
+  what it decodes. Zero compiled programs are added per replica, and
+  every pool drains leak-free.
+- **Affinity**: multi-turn traffic lands on the replica whose prefix
+  cache already holds its history (probed READ-ONLY across replicas,
+  hashed once), and the probe keys ride into the chosen scheduler so
+  admission never re-hashes.
+- **Backpressure**: a saturated best replica is a spill, not an error;
+  :class:`~apex_tpu.serving.QueueFull` surfaces only when the whole
+  fleet is full, carrying the MAX of the replicas' measured
+  ``retry_after_s`` hints (and None before any replica has measured a
+  decode step — a missing EMA degrades to honest silence, never a
+  crash).
+- **Containment**: a router-tier ``replica_death`` fault drains the
+  victim's queued/in-flight requests onto survivors — every one
+  reaches a terminal state there, un-faulted requests stay bitwise vs
+  the fault-free run, the dead pool audits with zero leaked pages, and
+  the drain never charges the requests' retry budgets.
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import telemetry
+from apex_tpu.amp.policy import resolve_policy
+from apex_tpu.models.transformer_lm import TransformerLM
+from apex_tpu.serving import (Engine, FaultPlan, FaultSpec, PoolAuditor,
+                              QueueFull, Request, Router, Scheduler)
+
+pytestmark = [pytest.mark.serving, pytest.mark.chaos]
+
+VOCAB = 64
+CHUNK = 8
+
+
+@pytest.fixture(scope="module")
+def lm_and_params():
+    m = TransformerLM(vocab_size=VOCAB, hidden=32, num_layers=2,
+                      num_heads=4, max_seq_len=64)
+    params = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32),
+                    train=False)["params"]
+    return m, params
+
+
+def _mk_engine(lm_and_params, *, slots=2, pool=4, seed=5, **kw):
+    m, params = lm_and_params
+    return Engine(m, params, slots=slots, max_len=64, prefill_len=24,
+                  chunk_len=CHUNK, prefix_pool=pool,
+                  policy=resolve_policy("O0", verbose=False), seed=seed,
+                  **kw)
+
+
+@pytest.fixture(scope="module")
+def engines(lm_and_params):
+    """One shared PAIR of identically-built paged engines: every test
+    resets them (clear_prefixes=True), so bitwise comparisons across
+    runs stay within the same compiled executables per replica."""
+    return [_mk_engine(lm_and_params), _mk_engine(lm_and_params)]
+
+
+def _reset(engines):
+    for e in engines:
+        e.reset(clear_prefixes=True)
+        e.set_registry(None)
+
+
+def _stream(seed=42):
+    """Mixed chunk-boundary prompts and budgets — the parity sweep."""
+    rng = np.random.default_rng(seed)
+    return [Request(prompt=list(rng.integers(1, VOCAB, size=n)),
+                    max_new_tokens=b)
+            for n, b in [(5, 10), (8, 4), (13, 6), (21, 4), (3, 9),
+                         (16, 5), (7, 1), (11, 7)]]
+
+
+def _session_waves(turns=2, sessions=3):
+    """Multi-turn sessions: turn t+1's prompt EXTENDS turn t's, so its
+    block-aligned prefix lives exactly where turn t was served. Waves
+    are served sequentially (a turn arrives after the previous
+    response) — the affinity workload."""
+    rng = np.random.default_rng(7)
+    base = rng.integers(1, VOCAB, size=CHUNK).tolist()
+    prompts = []
+    for s in range(sessions):
+        srng = np.random.default_rng(100 + s)
+        p = base + srng.integers(1, VOCAB, size=CHUNK).tolist()
+        turns_s = [list(p)]
+        for _ in range(turns - 1):
+            p = p + srng.integers(1, VOCAB, size=4).tolist()
+            turns_s.append(list(p))
+        prompts.append(turns_s)
+    return [[Request(prompt=prompts[s][t], max_new_tokens=4)
+             for s in range(sessions)] for t in range(turns)]
+
+
+def _tokens(reqs):
+    return [list(r.output_tokens) for r in reqs]
+
+
+def _audit_drained(engine):
+    """The zero-leak pin: the pool's invariants hold, and after a
+    clearing reset nothing but the sentinel remains allocated."""
+    aud = PoolAuditor()
+    aud.audit(engine)               # raises PoolInvariantError on leaks
+    engine.reset(clear_prefixes=True)
+    assert aud.audit(engine)["pages_in_use"] == 0
+
+
+# ------------------------------------------------------------- validation
+def test_router_validation(lm_and_params, engines):
+    _reset(engines)
+    with pytest.raises(ValueError, match="at least one engine"):
+        Router([])
+    with pytest.raises(ValueError, match="route_policy"):
+        Router(engines, route_policy="sticky")
+    with pytest.raises(ValueError, match="replica_plans"):
+        Router(engines, replica_plans=[None])
+    odd = _mk_engine(lm_and_params, slots=3)
+    with pytest.raises(ValueError, match="geometry"):
+        Router([engines[0], odd])
+    r = Router(engines)
+    with pytest.raises(ValueError, match="out of range"):
+        r.kill_replica(7)
+    # affinity with retention off degrades to least-loaded, loudly
+    # visible as the flag (nothing to probe in empty caches)
+    assert not r.affinity_enabled
+    assert Router(engines, retain_prefixes=True).affinity_enabled
+
+
+# ------------------------------------------------------- the parity pins
+def test_single_replica_router_is_bitwise_the_bare_scheduler(engines):
+    """Router(replicas=1) vs a bare Scheduler on the SAME engine: the
+    routing layer adds bookkeeping, never bytes — same tokens per
+    submitted request, zero new compiled programs, leak-free drain."""
+    _reset(engines)
+    eng = engines[0]
+    bare = _stream()
+    Scheduler(eng, retain_prefixes=True).run(bare)
+    programs0 = eng.compiled_programs
+    eng.reset(clear_prefixes=True)
+    routed = _stream()
+    router = Router([eng], retain_prefixes=True)
+    router.run(routed)
+    assert _tokens(routed) == _tokens(bare)
+    assert eng.compiled_programs == programs0, \
+        "the router traced new programs"
+    assert router.pending == 0
+    router.close()
+    _audit_drained(eng)
+
+
+@pytest.mark.parametrize("policy", ["affinity", "least_loaded",
+                                    "random"])
+def test_n_replica_outputs_bitwise_identical_to_one_replica(engines,
+                                                            policy):
+    """Scale-out parity under every routing policy: a request decodes
+    the same greedy tokens wherever it lands (identically-built
+    replicas), so N=2 output is bitwise N=1 output per submitted
+    request — and neither replica traced anything new."""
+    _reset(engines)
+    one = _stream()
+    r1 = Router(engines[:1], retain_prefixes=True)
+    r1.run(one)
+    r1.close()
+    pinned = engines[0].compiled_programs
+    _reset(engines)
+    two = _stream()
+    r2 = Router(engines, retain_prefixes=True, route_policy=policy,
+                seed=3)
+    r2.run(two)
+    assert _tokens(two) == _tokens(one), \
+        f"{policy} routing changed tokens"
+    assert {r2.placements[r.uid] for r in two} <= {0, 1}
+    # zero programs beyond the single-replica pin, on EVERY replica
+    # (replica 1 may trace its own copies on first contact — the pin is
+    # the count, not the warmth)
+    assert all(e.compiled_programs == pinned for e in engines)
+    r2.close()
+    for e in engines:
+        _audit_drained(e)
+
+
+# --------------------------------------------------------------- affinity
+def test_affinity_routes_turns_home_and_probe_is_pure(engines):
+    """Turn t+1 lands on turn t's replica (longest probed prefix wins),
+    reuses its K/V, and counts serving.router.affinity_hits — while the
+    LOSING replicas' caches stay untouched by the probe (no counter or
+    LRU pollution: their windows read zero consultations)."""
+    _reset(engines)
+    reg = telemetry.MetricsRegistry()
+    router = Router(engines, registry=reg, retain_prefixes=True)
+    w1, w2 = _session_waves()
+    router.run(w1)
+    homes = {i: router.placements[r.uid] for i, r in enumerate(w1)}
+    assert set(homes.values()) == {0, 1}, \
+        "least-loaded cold start should spread sessions over replicas"
+    base = [e.prefix_cache.stats() for e in engines]
+    router.run(w2)
+    for i, r in enumerate(w2):
+        assert router.placements[r.uid] == homes[i], \
+            f"session {i} turn 2 did not follow its history"
+        assert r.reused_tokens > 0, f"session {i} re-prefilled its history"
+    counters = reg.snapshot()["counters"]
+    assert counters["serving.router.affinity_hits"] == len(w2)
+    assert counters["serving.router.routed"] == len(w1) + len(w2)
+    # probe purity, observed through the satellite's delta lens: each
+    # replica's cache was CONSULTED (hit+miss) only by the requests
+    # that actually landed on it — N-1 probes per request left no trace
+    for i, e in enumerate(engines):
+        landed = sum(1 for r in w2 if router.placements[r.uid] == i)
+        delta = e.prefix_cache.stats_since(base[i])
+        assert delta["hits"] + delta["misses"] == landed
+        assert delta["hits"] == landed      # every turn 2 is a real hit
+    router.close()
+
+
+# ----------------------------------------------- load + backpressure
+def test_least_loaded_spreads_across_replicas(lm_and_params):
+    """With affinity out of the picture, routing follows queue depth /
+    free slots: an un-stepped fleet splits arrivals evenly."""
+    e1 = _mk_engine(lm_and_params, pool=0)
+    e2 = _mk_engine(lm_and_params, pool=0)
+    router = Router([e1, e2], route_policy="least_loaded", max_queue=2)
+    reqs = [Request(prompt=[i + 1, i + 2], max_new_tokens=2)
+            for i in range(4)]
+    for r in reqs:              # queue capacity 2 per replica, no steps
+        router.submit(r)
+    placements = [router.placements[r.uid] for r in reqs]
+    assert placements.count(0) == placements.count(1) == 2
+    with pytest.raises(QueueFull):
+        router.submit(Request(prompt=[9], max_new_tokens=2))
+    while router.pending:
+        router.step()
+    assert all(r.status == "finished" for r in reqs)
+    router.close()
+
+
+def test_saturated_affinity_home_spills_to_next_best(engines):
+    """Cross-replica backpressure: the replica holding the prefix is
+    the first choice, but when its queue is full the request SPILLS to
+    the next-best replica (counted, served, no QueueFull surfaced)."""
+    _reset(engines)
+    reg = telemetry.MetricsRegistry()
+    router = Router(engines, registry=reg, retain_prefixes=True,
+                    max_queue=1)
+    w1, w2 = _session_waves(sessions=1)
+    router.run(w1)
+    home = router.placements[w1[0].uid]
+    # jam the home replica's queue directly (bypassing the router, so
+    # the filler itself is not load-balanced away from it)
+    filler = Request(prompt=[1, 2, 3], max_new_tokens=2)
+    router.replicas[home].submit(filler)
+    router.submit(w2[0])
+    assert router.placements[w2[0].uid] == 1 - home, \
+        "a full home replica must spill, not block"
+    counters = reg.snapshot()["counters"]
+    assert counters.get("serving.router.spills") == 1
+    # an ABSORBED spill is not a caller-visible rejection: the request
+    # was placed and served — the rejected counter must not move
+    assert counters.get("serving.requests.rejected", 0) == 0
+    while router.pending:
+        router.step()
+    assert w2[0].status == "finished" and filler.status == "finished"
+    router.close()
+
+
+def test_all_saturated_hint_is_max_of_replicas_and_none_safe(
+        lm_and_params):
+    """Satellite 2: the fleet-level QueueFull carries max(replica
+    hints); replicas that never measured a decode step contribute None
+    and must degrade the max, not crash it."""
+    e1 = _mk_engine(lm_and_params, pool=0)
+    e2 = _mk_engine(lm_and_params, pool=0)
+    router = Router([e1, e2], route_policy="least_loaded", max_queue=3)
+    for i in range(6):      # queue capacity 3 per replica, no steps
+        router.submit(Request(prompt=[i + 1], max_new_tokens=2))
+    # nothing has decoded yet: every replica's EMA is unmeasured, so
+    # the fleet hint is honestly None (no fake number, no TypeError)
+    with pytest.raises(QueueFull) as exc:
+        router.submit(Request(prompt=[7], max_new_tokens=2))
+    assert exc.value.retry_after_s is None
+    # the fleet-level raise counts as ONE caller-visible rejection
+    # (the per-replica probes are suppressed — no double counting)
+    reg2 = telemetry.MetricsRegistry()
+    router.registry = reg2
+    with pytest.raises(QueueFull):
+        router.submit(Request(prompt=[7], max_new_tokens=2))
+    assert reg2.snapshot()["counters"][
+        "serving.requests.rejected"] == 1
+    router.registry = None
+    # one replica measured, one still hasn't: max over the known hints
+    router.replicas[0]._step_s_ema = 0.25
+    with pytest.raises(QueueFull) as exc:
+        router.submit(Request(prompt=[7], max_new_tokens=2))
+    h0 = router.replicas[0]._retry_after_hint()
+    assert exc.value.retry_after_s == pytest.approx(h0)
+    # both measured: the max (the fleet frees when its slowest does)
+    router.replicas[1]._step_s_ema = 0.75
+    with pytest.raises(QueueFull) as exc:
+        router.submit(Request(prompt=[7], max_new_tokens=2))
+    h1 = router.replicas[1]._retry_after_hint()
+    assert exc.value.retry_after_s == pytest.approx(max(h0, h1))
+    while router.pending:
+        router.step()
+    router.close()
+
+
+# ----------------------------------------------------- replica death
+def test_replica_death_chaos_unfaulted_bitwise_zero_leaks(engines):
+    """THE chaos pin: a seeded router-tier FaultPlan kills a replica
+    mid-stream. Every request that lived on it reaches a terminal
+    state on the survivor; un-faulted requests (here: ALL requests —
+    greedy decode depends only on a slot's own lineage) stay bitwise
+    vs the fault-free run; no retry budget is charged for the drain;
+    both pools audit leak-free; zero new programs traced."""
+    _reset(engines)
+    fault_free = _stream(seed=9)
+    r0 = Router(engines, retain_prefixes=True,
+                route_policy="least_loaded")
+    r0.run(fault_free)
+    r0.close()
+    placements0 = [r0.placements[r.uid] for r in fault_free]
+    programs = [e.compiled_programs for e in engines]
+    _reset(engines)
+    victim = 0
+    plan = FaultPlan([FaultSpec(kind="replica_death", tick=3,
+                                replica=victim)])
+    reg = telemetry.MetricsRegistry()
+    chaos = _stream(seed=9)
+    router = Router(engines, registry=reg, retain_prefixes=True,
+                    route_policy="least_loaded", fault_plan=plan)
+    router.run(chaos)
+    assert plan.stats()["injected_replica_deaths"] == 1
+    assert router.alive == [False, True]
+    snap = reg.snapshot()
+    counters = snap["counters"]
+    assert counters["serving.router.replica_deaths"] == 1
+    # the kill retires the victim's load gauges — a dashboard must
+    # never read phantom pre-death load on a drained corpse
+    for gauge in ("queue_depth", "slots_busy", "pages_free"):
+        assert snap["gauges"][
+            f"serving.router.replica{victim}.{gauge}"] == 0.0
+    drained = counters.get("serving.router.requeued", 0)
+    assert drained > 0, \
+        "tick-3 death must catch requests queued/in-flight on the victim"
+    for i, r in enumerate(chaos):
+        assert r.status == "finished", f"request {i} not terminal"
+        assert router.placements[r.uid] != victim, \
+            f"request {i} claims to have finished on the dead replica"
+        assert r.retries == 0, "a drain is not the request's fault"
+        # the bitwise pin, per submitted request
+        assert r.output_tokens == fault_free[i].output_tokens, \
+            f"request {i} (fault-free home {placements0[i]}) diverged"
+    assert [e.compiled_programs for e in engines] == programs
+    router.close()
+    for e in engines:
+        _audit_drained(e)
+
+
+def test_kill_replica_idempotent_and_last_alive_raises(engines):
+    _reset(engines)
+    router = Router(engines, route_policy="least_loaded")
+    reqs = [Request(prompt=[i + 1, 5], max_new_tokens=3)
+            for i in range(4)]
+    for r in reqs:
+        router.submit(r)
+    on_victim = [r.uid for r, p in
+                 ((r, router.placements[r.uid]) for r in reqs) if p == 1]
+    drained = router.kill_replica(1)
+    assert [r.uid for r in drained] == on_victim and drained
+    # the kill already re-routed them onto the survivor
+    assert all(router.placements[u] == 0 for u in on_victim)
+    assert router.kill_replica(1) == []          # already dead: no-op
+    with pytest.raises(RuntimeError, match="last one alive"):
+        router.kill_replica(0)
+    assert router.alive == [True, False]
+    while router.pending:
+        router.step()
+    assert all(r.status == "finished" for r in reqs)
+    router.close()
+
+
+def test_drain_requests_seam_resets_state_and_frees_pages(engines):
+    """The scheduler-level drain contract the router builds on:
+    running-first-then-queue export, transient rollback with the
+    original submit clock kept, empty pipeline, zero pages held."""
+    _reset(engines)
+    eng = engines[0]
+    sched = Scheduler(eng, retain_prefixes=True)
+    reqs = [Request(prompt=list(range(1, 12)), max_new_tokens=8),
+            Request(prompt=list(range(2, 10)), max_new_tokens=8),
+            Request(prompt=[7, 8, 9], max_new_tokens=8)]
+    for r in reqs:
+        sched.submit(r)
+    for _ in range(4):              # partway: slots running, one queued
+        sched.step()
+    clocks = [r._t_submit for r in reqs]
+    assert any(r.output_tokens for r in reqs)
+    drained = sched.drain_requests()
+    assert {r.uid for r in drained} == {r.uid for r in reqs}
+    assert sched.pending == 0
+    for r, t0 in zip(reqs, clocks):
+        assert r.status == "queued" and r.output_tokens == []
+        assert r._prefill_pos == 0 and r.ttft_s is None
+        assert r._t_submit == t0, "drain must not reset the clock"
+        assert r._not_before is None
+    aud = PoolAuditor()
+    aud.audit(eng)
+    # only prefix-cache holds may remain; a clearing reset zeroes them
+    _audit_drained(eng)
+    # and re-serving the drained requests elsewhere completes them
+    Scheduler(engines[1], retain_prefixes=True).run(drained)
+    assert all(r.status == "finished" for r in reqs)
+
+
+# ------------------------------------------------- lifecycle / threads
+def _worker_threads():
+    return [t for t in threading.enumerate()
+            if t.name == "serving-draft-worker" and t.is_alive()]
+
+
+def test_router_close_stops_all_workers_no_thread_leak(engines):
+    """Satellite 6: one DraftWorker per pipelined replica scheduler,
+    ALL stopped by one idempotent Router.close() — construct/serve/
+    close leaves the process's worker-thread census unchanged."""
+    _reset(engines)
+    before = len(_worker_threads())
+    router = Router(engines, retain_prefixes=True, pipeline_depth=2)
+    assert len(_worker_threads()) == before + len(engines)
+    router.run(_stream()[:4])
+    router.close()
+    router.close()                  # idempotent
+    assert len(_worker_threads()) == before, "worker thread leaked"
+    # a killed replica's worker stops at the kill, not only at close
+    _reset(engines)
+    router = Router(engines, retain_prefixes=True, pipeline_depth=1)
+    router.kill_replica(0)
+    assert len(_worker_threads()) == before + 1
+    router.close()
+    assert len(_worker_threads()) == before
+
+
+def test_load_snapshot_is_host_only_truth(engines):
+    _reset(engines)
+    eng = engines[0]
+    sched = Scheduler(eng, retain_prefixes=True, max_queue=4)
+    snap = sched.load_snapshot()
+    assert snap["slots"] == eng.slots
+    assert snap["slots_busy"] == 0 and snap["queue_depth"] == 0
+    assert snap["pages_free"] == eng.pool.free_pages
+    for _ in range(3):
+        sched.submit(Request(prompt=list(range(1, 10)),
+                             max_new_tokens=6))
+    sched.step()
+    snap = sched.load_snapshot()
+    assert snap["slots_busy"] == 2 and snap["slots_free"] == 0
+    assert snap["queue_depth"] == 1 and snap["queue_free"] == 3
+    assert snap["pages_free"] == eng.pool.free_pages < \
+        eng.pool.num_pages - 1
+    while sched.pending:
+        sched.step()
+
+
+def test_router_over_mesh_sharded_replicas_tp_by_dp(lm_and_params,
+                                                    engines):
+    """The tp × dp claim, structurally: replicas may each be
+    ``mesh=``-sharded engines (here tp=1 meshes on the one CPU device,
+    PR 9's bitwise-pinned configuration — tp>1 emulation stays in the
+    slow tier) and the router composes with them untouched — same
+    greedy stream, bitwise the unsharded fleet's output."""
+    from jax.sharding import Mesh
+
+    _reset(engines)
+    oracle = _stream(seed=21)
+    r_plain = Router(engines, retain_prefixes=True,
+                     route_policy="least_loaded")
+    r_plain.run(oracle)
+    r_plain.close()
+    mesh = Mesh(np.array(jax.devices("cpu")[:1]), ("tp",))
+    sharded = [_mk_engine(lm_and_params, mesh=mesh) for _ in range(2)]
+    got = _stream(seed=21)
+    r_mesh = Router(sharded, retain_prefixes=True,
+                    route_policy="least_loaded")
+    r_mesh.run(got)
+    assert _tokens(got) == _tokens(oracle), \
+        "tp=1-mesh replicas diverged from the unsharded fleet"
+    assert all(e.tp == 1 for e in sharded)
+    r_mesh.close()
+    for e in sharded:
+        _audit_drained(e)
+
+
+# ------------------------------------------------- FaultPlan satellite
+def test_replica_death_spec_validation_and_seeded_replay():
+    with pytest.raises(ValueError, match="victim replica"):
+        FaultSpec(kind="replica_death", tick=0)
+    spec = FaultSpec(kind="replica_death", tick=2, replica=1)
+    plan = FaultPlan([spec])
+    assert plan.take_replica_deaths(0) == []
+    assert plan.take_replica_deaths(2) == [1]
+    assert plan.take_replica_deaths(2) == []     # consumed once
+    assert plan.stats()["injected_replica_deaths"] == 1
+    # the new kwargs leave pre-router seeds byte-identical (the draw is
+    # skipped entirely at the default rate 0)
+    old = FaultPlan.random(11, 40, slots=4, nonfinite_rate=0.2,
+                           exception_rate=0.2, stall_rate=0.1)
+    new = FaultPlan.random(11, 40, slots=4, nonfinite_rate=0.2,
+                           exception_rate=0.2, stall_rate=0.1,
+                           replica_death_rate=0.0, replicas=3)
+    assert old.specs == new.specs
+    with pytest.raises(ValueError, match="replicas"):
+        FaultPlan.random(11, 10, slots=4, replica_death_rate=0.5)
+    deadly = FaultPlan.random(11, 60, slots=4, replica_death_rate=0.3,
+                              replicas=3)
+    deaths = [s for s in deadly.specs if s.kind == "replica_death"]
+    assert deaths and all(0 <= s.replica < 3 for s in deaths)
+    # and the non-death half of the schedule is unperturbed by rate 0
+    assert [s for s in deadly.specs if s.kind != "replica_death"] == []

@@ -21,10 +21,16 @@ calibration. The ``serving.heartbeat.*`` family joined with the async
 pipelined heartbeat: ``host_s`` / ``device_wait_s`` / ``duty_cycle``
 are the duty-cycle claim's basis (the whole point of dispatch-ahead
 execution), and ``discarded`` going dark would hide speculated-finality
-rollbacks entirely. The loop is closed by lint: the set of
-fault/watchdog/spec/tp/kv/heartbeat metric literals in
-``apex_tpu/serving/`` source must EQUAL the set named in the docs'
-tables.
+rollbacks entirely. The ``serving.router.*`` family joined with the
+replica-parallel tentpole: ``affinity_hits`` going dark reads as "no
+multi-turn reuse" while requests silently re-prefill on cold replicas,
+``replica_deaths`` / ``requeued`` going dark makes a dying fleet look
+healthy, and the per-replica gauge namespace
+(``serving.router.replica<i>.*``) is what keeps N replicas sharing one
+registry from clobbering each other's pool gauges. The loop is closed
+by lint: the set of fault/watchdog/spec/tp/kv/heartbeat/router metric
+literals in ``apex_tpu/serving/`` source must EQUAL the set named in
+the docs' tables.
 
 This file also owns the **force-early lint**: the dispatch-ahead
 region of ``scheduler.py`` (everything between a decode dispatch and
@@ -53,9 +59,15 @@ SRC_DIR = os.path.join(ROOT, "apex_tpu", "serving")
 DOC = os.path.join(ROOT, "docs", "serving.md")
 
 # metric families the fault-isolation + speculative + tensor-parallel
-# + quantized-KV + async-heartbeat layers own
+# + quantized-KV + async-heartbeat + replica-router layers own.
+# NOTE the per-replica namespace: the router emits gauges as
+# f"serving.router.replica{i}.<gauge>" — the literal this regex
+# extracts from that f-string (source AND docs) is
+# "serving.router.replica", which is exactly the namespacing contract
+# the docs must name.
 _PAT = re.compile(
-    r"serving\.(?:faults|watchdog|spec|tp|kv|heartbeat)\.[a-z0-9_]+")
+    r"serving\.(?:faults|watchdog|spec|tp|kv|heartbeat|router)"
+    r"\.[a-z0-9_]+")
 
 
 def _emitted():
@@ -119,6 +131,18 @@ def test_scan_surface_is_alive():
                  "serving.heartbeat.discarded"):
         assert sched in emitted.get(name, []), \
             f"{name} not emitted by the scheduler — async-heartbeat " \
+            "telemetry went dark"
+    # the replica-router family: routing outcomes, death containment
+    # and the per-replica gauge namespace are router-emitted
+    router_py = os.path.join("apex_tpu", "serving", "router.py")
+    for name in ("serving.router.routed", "serving.router.affinity_hits",
+                 "serving.router.spills",
+                 "serving.router.replica_deaths",
+                 "serving.router.requeued",
+                 "serving.router.replicas_alive",
+                 "serving.router.replica"):
+        assert router_py in emitted.get(name, []), \
+            f"{name} not emitted by the router — replica-routing " \
             "telemetry went dark"
     assert _documented(), "docs/serving.md names no fault/watchdog/" \
         "spec metrics — doc section missing?"
