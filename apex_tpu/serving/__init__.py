@@ -76,6 +76,19 @@ generation requests from a fixed set of compiled programs:
   Un-faulted greedy requests stay bitwise identical to a fault-free
   run; containment adds ZERO compiled programs.
 
+- :class:`HostTier` (:mod:`.host_tier`) — hierarchical KV
+  (``Engine(host_tier=<bytes>)``, paged + ``prefix_pool > 0``): a
+  bounded host-DRAM arena behind the page pool. A prefix entry evicted
+  under pool pressure has its page bytes copied device→host (int8
+  under ``kv_quant`` — half the transfer) instead of being destroyed,
+  stays matchable/probeable in the *swapped* state, and a later hit
+  migrates the bytes back through ONE extra compiled program
+  (a fixed-shape page-block scatter) before copy-on-write sharing as
+  usual. CRC-verified: a corrupt/missing swap-in degrades to a
+  verified miss (re-prefill), never a wrong token — hit-after-swap
+  greedy streams are bitwise identical to never-swapped ones, and
+  prefix capacity is bounded by host RAM, not HBM.
+
 - :class:`Router` (:mod:`.router`) — replica-parallel serving (tp × dp
   scale-out): N ``Scheduler``+``Engine`` replicas behind one
   host-side ``submit()`` that routes by PREFIX AFFINITY (one set of
@@ -111,6 +124,7 @@ from . import sharding
 from .engine import Engine, PendingDecode, sample_tokens
 from .faults import (FaultPlan, FaultPolicy, FaultSpec, InjectedFault,
                      PoolAuditor, PoolInvariantError)
+from .host_tier import HostTier
 from .kv_cache import KVCache, PagedKVCache, PagePool
 from .kv_quant import KVQuantConfig
 from .prefix_cache import PrefixCache, PrefixMatch
@@ -119,9 +133,9 @@ from .scheduler import QueueFull, Request, RequestStatus, Scheduler
 from .speculative import DraftWorker, SpecConfig, draft_tokens
 
 __all__ = ["DraftWorker", "Engine", "FaultPlan", "FaultPolicy",
-           "FaultSpec", "InjectedFault", "KVCache", "KVQuantConfig",
-           "PagedKVCache", "PagePool", "PendingDecode", "PoolAuditor",
-           "PoolInvariantError", "PrefixCache", "PrefixMatch",
-           "QueueFull", "Request", "RequestStatus", "Router",
-           "Scheduler", "SpecConfig", "draft_tokens", "sample_tokens",
-           "sharding"]
+           "FaultSpec", "HostTier", "InjectedFault", "KVCache",
+           "KVQuantConfig", "PagedKVCache", "PagePool", "PendingDecode",
+           "PoolAuditor", "PoolInvariantError", "PrefixCache",
+           "PrefixMatch", "QueueFull", "Request", "RequestStatus",
+           "Router", "Scheduler", "SpecConfig", "draft_tokens",
+           "sample_tokens", "sharding"]

@@ -180,6 +180,7 @@ import numpy as np
 from apex_tpu.kernels import vmem
 from apex_tpu.log_util import get_logger
 
+from .host_tier import HostTier
 from .kv_cache import KVCache, PagedKVCache, PagePool
 from .kv_quant import KVQuantConfig, quantize
 from .prefix_cache import PrefixCache
@@ -346,6 +347,25 @@ class Engine:
         default) is the bitwise bf16 baseline — none of the quant code
         is on its trace path. The program set is unchanged either way
         (dequant is fused, never a new executable).
+    host_tier:
+        Hierarchical-KV host-DRAM prefix tier (paged only, requires
+        ``prefix_pool > 0`` and ``mesh=None``): an int capacity in
+        BYTES, or a pre-built :class:`~apex_tpu.serving.HostTier`.
+        When set, a prefix entry evicted under pool pressure has its
+        page bytes copied device→host into the bounded arena instead
+        of being destroyed (int8 under ``kv_quant`` — half the
+        transfer bytes), stays matchable in the *swapped* state, and
+        a later hit migrates the bytes back into freshly allocated
+        pages through ONE extra compiled program (``swap_in``: a
+        fixed-shape page-block scatter, one dispatch per swap-in — no
+        attention, no sampling, no PRNG) before copy-on-write sharing
+        as usual. Restored pages
+        are byte-exact (CRC-verified; a corrupt/missing swap-in
+        degrades to a verified miss and a re-prefill, never a wrong
+        token), so a hit-after-swap greedy stream is bitwise identical
+        to a never-swapped one, and prefix capacity is bounded by host
+        RAM instead of device HBM. ``None`` (default) keeps today's
+        destroy-on-evict behaviour and traces nothing extra.
     top_k:
         Static top-k truncation for sampled (non-greedy) slots; 0 = off.
     registry:
@@ -366,7 +386,8 @@ class Engine:
                  page_len: Optional[int] = None,
                  num_pages: Optional[int] = None,
                  spec: Optional[SpecConfig] = None, mesh=None,
-                 kv_quant: Optional[KVQuantConfig] = None):
+                 kv_quant: Optional[KVQuantConfig] = None,
+                 host_tier=None):
         from apex_tpu.amp.policy import resolve_policy
 
         if policy is None:
@@ -568,6 +589,37 @@ class Engine:
                     block_len=self.chunk_len,
                     pool_rows=range(self.slots,
                                     self.slots + self.prefix_pool))
+        # hierarchical KV: the host-DRAM prefix tier behind the paged
+        # pool. Wired AFTER the prefix cache exists — eviction becomes
+        # swap-out (bytes device→host, entry stays matchable as
+        # "swapped"), a swapped hit swaps back in through _jit_swap_in.
+        self.host_tier: Optional[HostTier] = None
+        self.swap_verify_failed = 0
+        if host_tier is not None:
+            if not self.paged:
+                raise ValueError(
+                    "Engine(host_tier=...) requires paged=True: the "
+                    "tier swaps pool pages, and the contiguous layout "
+                    "has none")
+            if self.prefix_cache is None:
+                raise ValueError(
+                    "Engine(host_tier=...) requires prefix_pool > 0 — "
+                    "the tier is a second level behind the prefix "
+                    "cache, not a standalone store")
+            if mesh is not None:
+                raise ValueError(
+                    "Engine(host_tier=...) requires mesh=None for now: "
+                    "swap-out gathers the heads-sharded pool through "
+                    "one chip and swap-in would need a sharded write "
+                    "program (carried to silicon)")
+            self.host_tier = host_tier if isinstance(host_tier, HostTier) \
+                else HostTier(int(host_tier))
+            self.host_tier.on_evict = self._on_host_tier_evict
+            self.prefix_cache.set_swap_hooks(
+                swap_out=self._swap_out_pages,
+                contains=self.host_tier.contains)
+            self._jit_swap_in = jax.jit(self._swap_in_impl,
+                                        donate_argnums=(0,))
         self._registry = registry
         self._key = jax.random.PRNGKey(seed)
         self.prefill_traces = 0
@@ -575,6 +627,7 @@ class Engine:
         self.chunk_traces = 0
         self.copy_traces = 0
         self.verify_traces = 0
+        self.swap_in_traces = 0
         self.tokens_generated = 0
         # cumulative seconds the HOST spent blocked waiting for device
         # results (every forcing site — token readback, finiteness
@@ -753,10 +806,13 @@ class Engine:
         that exercises chunk prefill, decode, and the monolithic
         baseline; exactly four once prefix reuse exercises the KV
         row-copy too — and one more, on either layout, once speculative
-        decoding exercises the verify program: 4 paged, 5 contiguous)."""
+        decoding exercises the verify program: 4 paged, 5 contiguous.
+        The hierarchical-KV tier adds AT MOST one more on the paged
+        path: the fixed-shape ``swap_in`` block scatter, traced lazily on the
+        first hit-after-swap)."""
         return (self.chunk_traces + self.decode_traces
                 + self.prefill_traces + self.copy_traces
-                + self.verify_traces)
+                + self.verify_traces + self.swap_in_traces)
 
     # ------------------------------------------------------ compiled bodies
     # Every sampling program also returns a per-slot FINITENESS flag —
@@ -1018,6 +1074,23 @@ class Engine:
         # reads n_accepted — the rejected tail's pages stay allocated
         # to the slot, their K/V unreachable behind the length
         return cache, greedy, n_accepted, finite
+
+    def _swap_in_impl(self, cache, k_blk, v_blk, page_ids):
+        """The hierarchical-KV tier's ONE compiled program: scatter a
+        host-restored page block ``[layers, max_pages, heads, page_len,
+        head_dim]`` into the pool rows named by ``page_ids``
+        ``[max_pages]`` int32 — ONE dispatch per swap-in, fixed shape
+        (entries shorter than ``max_pages`` pad their trailing ids with
+        the page-0 sentinel, whose garbage absorbs the padded writes
+        exactly as it absorbs inactive-slot decode writes). Pure data
+        movement: no attention, no sampling, no PRNG — the
+        copy-program precedent, so it owes the tuned tables no
+        ``decode.*`` key."""
+        self.swap_in_traces += 1    # python body runs at trace time only
+        page_ids = jnp.asarray(page_ids, jnp.int32)
+        k = cache.k.at[:, page_ids].set(jnp.asarray(k_blk, cache.dtype))
+        v = cache.v.at[:, page_ids].set(jnp.asarray(v_blk, cache.dtype))
+        return cache.replace(k=k, v=v)
 
     # ------------------------------------------------------------- host API
     def _next_key(self):
@@ -1352,7 +1425,135 @@ class Engine:
         self._slot_reserved[slot] += n_pages
         return True
 
-    def attach_prefix(self, slot: int, match) -> None:
+    # ------------------------------------------------- hierarchical KV tier
+    def _on_host_tier_evict(self, key: int) -> None:
+        """The host arena evicted ``key``'s bytes under capacity
+        pressure: the swapped index entry now has no backing anywhere —
+        drop it (a dangling swapped entry would be the exact rot the
+        auditor's cross-tier walk flags)."""
+        self.prefix_cache.drop(key)
+        if self._registry is not None:
+            self._registry.counter_inc("serving.swap.host_evictions")
+            self._registry.gauge_set("serving.swap.host_bytes",
+                                     float(self.host_tier.bytes_used))
+
+    def _swap_out_pages(self, key: int, pages) -> bool:
+        """The prefix cache's swap-out hook: copy the evicted entry's
+        page bytes device→host into the arena BEFORE the caller
+        releases the device pages. False (the caller destroys instead)
+        when the tier declines — an entry bigger than the whole arena.
+        The copy is a forced device read, charged to
+        :attr:`device_wait_s` like every other sync."""
+        tier = self.host_tier
+        if tier is None:
+            return False
+        idx = [int(p) for p in pages]
+        m = len(idx)
+        if m > self.max_pages:
+            return False            # cannot happen by construction
+        # SHAPE-STABLE device read: pad the gather to max_pages with
+        # the page-0 sentinel (harmless garbage, sliced off below) so
+        # every swap-out of every entry size shares one compiled
+        # gather — an entry-sized gather would silently recompile
+        # mid-serve the first time an unseen page count appears
+        padded = idx + [0] * (self.max_pages - m)
+        t0 = time.perf_counter()
+        k_host = np.asarray(self.cache.k[:, padded])[:, :m]  # device sync
+        v_host = np.asarray(self.cache.v[:, padded])[:, :m]
+        self.device_wait_s += time.perf_counter() - t0
+        if not tier.put(key, k_host, v_host):
+            return False
+        if self._registry is not None:
+            self._registry.counter_inc("serving.swap.swapped_out_pages",
+                                       len(idx))
+            self._registry.observe("serving.swap.out_s",
+                                   time.perf_counter() - t0)
+            self._registry.gauge_set("serving.swap.host_bytes",
+                                     float(tier.bytes_used))
+        return True
+
+    def _count_swap_verify_failed(self) -> None:
+        self.swap_verify_failed += 1
+        if self._registry is not None:
+            self._registry.counter_inc("serving.swap.verify_failed")
+
+    def _swap_in(self, key: int):
+        """Migrate a swapped prefix entry's page bytes host→device:
+        pop + checksum-verify the arena record, allocate fresh pool
+        pages (LRU-evicting resident prefixes under pressure, and only
+        from capacity NOT promised to admitted requests), write each
+        page through the one compiled ``swap_in`` program, and mark
+        the entry resident on the new page ids (one refcount per page
+        held by the entry, exactly like registration). Returns the
+        full restored page list, or None on degradation:
+
+        - missing / checksum-failed / wrong-geometry host bytes → the
+          entry is DROPPED and ``serving.swap.verify_failed`` counts —
+          a verified miss (the caller re-prefills), never a wrong
+          token;
+        - pool too tight even after draining resident prefixes → the
+          bytes go BACK to the arena and the entry stays swapped (a
+          later, less-pressured hit can still restore it)."""
+        tier, pcache = self.host_tier, self.prefix_cache
+        t0 = time.perf_counter()
+        rec = tier.take(key) if tier is not None else None
+        if rec is None or not rec.valid:
+            pcache.drop(key)
+            self._count_swap_verify_failed()
+            return None
+        k_host, v_host = rec.k, rec.v
+        c = self.cache
+        want = (c.layers, k_host.shape[1] if k_host.ndim == 5 else -1,
+                c.heads, c.page_len, c.head_dim)
+        if k_host.shape != want or v_host.shape != want \
+                or k_host.dtype != np.dtype(c.dtype) \
+                or v_host.dtype != np.dtype(c.dtype):
+            pcache.drop(key)
+            self._count_swap_verify_failed()
+            return None
+        m = int(k_host.shape[1])
+        if m > self.max_pages:
+            pcache.drop(key)
+            self._count_swap_verify_failed()
+            return None
+        # unreserved allocation must never eat into admission promises:
+        # draw only from `available` (free minus reserved), making room
+        # by LRU-evicting (= swapping out) resident prefix entries
+        while self.pool.available < m:
+            if not pcache.evict_lru():
+                tier.put(key, k_host, v_host)
+                _logger.debug("swap-in of entry %d deferred: pool too "
+                              "tight for %d pages", key, m)
+                return None
+        pages = [self.pool.alloc() for _ in range(m)]
+        # one fixed-shape dispatch restores the whole entry: pad the
+        # block to max_pages, trailing ids to the page-0 sentinel
+        # (its garbage absorbs the padded writes — the inactive-slot
+        # idiom), so every swap-in of every entry size shares ONE
+        # executable and ONE dispatch
+        P = self.max_pages
+        blk_shape = (c.layers, P, c.heads, c.page_len, c.head_dim)
+        k_blk = np.zeros(blk_shape, k_host.dtype)
+        v_blk = np.zeros(blk_shape, v_host.dtype)
+        k_blk[:, :m], v_blk[:, :m] = k_host, v_host
+        ids = np.zeros(P, np.int32)
+        ids[:m] = pages
+        self.cache = self._runtime_call(
+            lambda: self._jit_swap_in(self.cache, jnp.asarray(k_blk),
+                                      jnp.asarray(v_blk),
+                                      jnp.asarray(ids)))
+        pcache.swap_in_complete(key, pages)
+        if self._registry is not None:
+            self._registry.counter_inc("serving.swap.swapped_in_pages",
+                                       m)
+            self._registry.counter_inc("serving.swap.hit_after_swap")
+            self._registry.observe("serving.swap.in_s",
+                                   time.perf_counter() - t0)
+            self._registry.gauge_set("serving.swap.host_bytes",
+                                     float(tier.bytes_used))
+        return pages
+
+    def attach_prefix(self, slot: int, match) -> bool:
         """Admission-time prefix hit, paged style: the matched entry's
         pages become the head of ``slot``'s page table by refcount bump
         — ZERO data movement (the contiguous layout paid a compiled
@@ -1360,8 +1561,22 @@ class Engine:
         offset; the first write past the share lands on a fresh page by
         construction (matches are chunk-aligned, chunks cover whole
         pages). Pages the hit shares are refunded from the slot's
-        conservative admission reservation."""
+        conservative admission reservation.
+
+        A ``match.swapped`` hit (hierarchical KV) first migrates the
+        entry's page bytes back from the host tier (:meth:`_swap_in`);
+        on success the restored pages share exactly like a resident
+        hit. Returns False — with NOTHING attached (the caller must
+        treat the admission as a miss and re-prefill cold) — when the
+        swap-in degraded; True on every attached hit."""
         self._require_paged("attach_prefix")
+        if getattr(match, "swapped", False):
+            restored = self._swap_in(match.row)
+            if restored is None:
+                return False
+            k = match.length // self.page_len
+            match = dataclasses.replace(
+                match, pages=tuple(restored[:k]), swapped=False)
         pages = list(match.pages)
         if match.length != len(pages) * self.page_len:
             raise ValueError(
@@ -1376,6 +1591,7 @@ class Engine:
         if refund:
             self._slot_reserved[slot] -= refund
             self.pool.unreserve(refund)
+        return True
 
     def retain_prefix(self, slot: int, prompt: Sequence[int],
                       keys: Optional[Sequence[int]] = None) -> str:
@@ -1828,8 +2044,16 @@ class Engine:
                 self.release_slot(s)
             if clear_prefixes and self.prefix_cache is not None:
                 # entry eviction releases each entry's page refs through
-                # the pool (the on_evict hook)
+                # the pool (the on_evict hook). Swapped entries hold no
+                # pages — their host-side bytes are dropped with the
+                # arena below (warm resets keep BOTH tiers: a swapped
+                # prefix is warm state exactly like a resident one)
                 self.prefix_cache.clear()
+                if self.host_tier is not None:
+                    self.host_tier.clear()
+                    if self._registry is not None:
+                        self._registry.gauge_set("serving.swap.host_bytes",
+                                                 0.0)
             return
         lengths = self.cache.lengths
         if clear_prefixes:

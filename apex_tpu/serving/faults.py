@@ -111,6 +111,15 @@ class FaultSpec:
       plan never sees it) — the router drains the dead replica's
       queued and in-flight requests onto the survivors, so the death
       is a routing event, not an outage.
+    - ``"swap_corruption"`` — the hierarchical-KV tier fault: at
+      heartbeat ``tick``, flip one byte of a deterministically chosen
+      entry in the engine's host-DRAM swap arena
+      (:meth:`FaultPlan.maybe_corrupt_swap`, consumed from the
+      scheduler's step loop on engines with a
+      :class:`~apex_tpu.serving.HostTier`). The NEXT swap-in of the
+      victim fails its CRC and must degrade to a verified miss
+      (re-prefill, ``serving.swap.verify_failed``) — never a wrong
+      token.
     """
 
     kind: str
@@ -123,7 +132,7 @@ class FaultSpec:
 
     def __post_init__(self):
         if self.kind not in ("nonfinite", "exception", "stall",
-                             "replica_death"):
+                             "replica_death", "swap_corruption"):
             raise ValueError(f"unknown fault kind {self.kind!r}")
         if self.kind == "nonfinite" and self.slot < 0:
             raise ValueError("nonfinite faults need a victim slot")
@@ -151,6 +160,7 @@ class FaultPlan:
         self._exceptions: Dict[Tuple[str, int], FaultSpec] = {}
         self._stalls: Dict[int, FaultSpec] = {}
         self._deaths: Dict[int, List[FaultSpec]] = {}
+        self._swap_corruptions: Dict[int, FaultSpec] = {}
         for s in self.specs:
             if s.kind == "nonfinite":
                 self._nonfinite.setdefault(int(s.tick), []).append(s)
@@ -158,6 +168,8 @@ class FaultPlan:
                 self._exceptions[(s.site, int(s.tick))] = s
             elif s.kind == "replica_death":
                 self._deaths.setdefault(int(s.tick), []).append(s)
+            elif s.kind == "swap_corruption":
+                self._swap_corruptions[int(s.tick)] = s
             else:
                 self._stalls[int(s.tick)] = s
         # raw injection counters (the chaos bench reads them)
@@ -165,6 +177,7 @@ class FaultPlan:
         self.injected_exceptions = 0
         self.injected_stalls = 0
         self.injected_replica_deaths = 0
+        self.injected_swap_corruptions = 0
 
     @classmethod
     def random(cls, seed: int, ticks: int, *, slots: int,
@@ -172,7 +185,8 @@ class FaultPlan:
                stall_rate: float = 0.0, stall_s: float = 0.05,
                sites: Sequence[str] = ("chunk", "decode"),
                replica_death_rate: float = 0.0,
-               replicas: int = 0) -> "FaultPlan":
+               replicas: int = 0,
+               swap_corruption_rate: float = 0.0) -> "FaultPlan":
         """A seeded random schedule over ``ticks`` heartbeats: each
         tick independently draws a non-finite injection (uniform victim
         slot), a transient exception (site uniform over ``sites``),
@@ -183,7 +197,11 @@ class FaultPlan:
         fires). ``replica_death_rate`` > 0 (router-tier plans only;
         requires ``replicas`` >= 1) additionally draws a replica death
         with a uniform victim — the draw is SKIPPED entirely at the
-        default rate 0, so pre-router seeds replay bit-for-bit."""
+        default rate 0, so pre-router seeds replay bit-for-bit.
+        ``swap_corruption_rate`` > 0 (hierarchical-KV engines only)
+        draws a host-arena corruption per tick — same skipped-at-0
+        contract, so every pre-host-tier seed also replays
+        bit-for-bit."""
         for s in sites:
             if s not in _EXCEPTION_SITES:
                 raise ValueError(f"exception site {s!r} not in "
@@ -210,6 +228,9 @@ class FaultPlan:
                 specs.append(FaultSpec(
                     kind="replica_death", tick=t,
                     replica=int(rng.integers(0, replicas))))
+            if swap_corruption_rate > 0 \
+                    and rng.random() < swap_corruption_rate:
+                specs.append(FaultSpec(kind="swap_corruption", tick=t))
         return cls(specs)
 
     # ------------------------------------------------------------ injection
@@ -279,6 +300,27 @@ class FaultPlan:
         self.injected_replica_deaths += len(specs)
         return [s.replica for s in specs]
 
+    def maybe_corrupt_swap(self, tick: int, tier) -> bool:
+        """CONSUME the ``swap_corruption`` scheduled for this
+        heartbeat, if any, by flipping one byte of a deterministically
+        chosen entry in ``tier`` (a :class:`~apex_tpu.serving
+        .HostTier` — victim = the ``tick``-th resident key in sorted
+        order, so replays corrupt the same entry). Called by the
+        scheduler once per heartbeat on hierarchical-KV engines. An
+        empty arena makes the injection a no-op (nothing swapped yet —
+        the spec is still consumed at its tick, like every other
+        injection, but not counted as delivered). Returns True when a
+        byte actually flipped."""
+        spec = self._swap_corruptions.pop(int(tick), None)
+        if spec is None:
+            return False
+        keys = sorted(tier.keys())
+        if not keys:
+            return False
+        tier.corrupt_entry(keys[int(tick) % len(keys)])
+        self.injected_swap_corruptions += 1
+        return True
+
     def maybe_stall(self, tick: int) -> float:
         """Sleep through the stall scheduled for this heartbeat (if
         any); returns the seconds slept (0.0 on stall-free ticks)."""
@@ -319,6 +361,7 @@ class FaultPlan:
             "injected_exceptions": self.injected_exceptions,
             "injected_stalls": self.injected_stalls,
             "injected_replica_deaths": self.injected_replica_deaths,
+            "injected_swap_corruptions": self.injected_swap_corruptions,
         }
 
 
@@ -483,6 +526,42 @@ class PoolAuditor:
             problems.append(
                 f"pages {lost} are neither free nor referenced — lost "
                 f"from the allocator (conservation broken)")
+        # hierarchical KV: the host-DRAM tier must reconcile with the
+        # prefix cache's swapped state — a swapped entry holds no
+        # device pages (it already left the `expected` walk above), but
+        # swap-in/out must never strand bytes on either side. Three
+        # invariants: (1) every swapped index entry is backed by a
+        # host-arena record (a dangling entry would swap in nothing —
+        # or garbage), (2) every arena record backs a swapped entry
+        # (an orphan is host DRAM that can never be read again — the
+        # host-side leak), (3) the arena's byte accounting matches its
+        # stored arrays and respects its capacity bound.
+        tier = getattr(engine, "host_tier", None)
+        if tier is not None:
+            swapped = set(pcache.swapped_keys()) if pcache is not None \
+                else set()
+            tier_keys = set(tier.keys())
+            dangling_swap = sorted(swapped - tier_keys)
+            if dangling_swap:
+                problems.append(
+                    f"swapped prefix entries {dangling_swap} have no "
+                    f"host-tier backing — a hit would find nothing to "
+                    f"swap in (dangling swap state)")
+            orphaned = sorted(tier_keys - swapped)
+            if orphaned:
+                problems.append(
+                    f"host-tier entries {orphaned} back no swapped "
+                    f"prefix entry — unreachable host bytes (host-side "
+                    f"leak)")
+            actual = sum(tier.nbytes_of(k) for k in tier_keys)
+            if actual != tier.bytes_used:
+                problems.append(
+                    f"host-tier byte accounting drifted: reports "
+                    f"{tier.bytes_used}, stored arrays hold {actual}")
+            if tier.bytes_used > tier.capacity_bytes:
+                problems.append(
+                    f"host tier over capacity: {tier.bytes_used} bytes "
+                    f"held against a {tier.capacity_bytes}-byte bound")
         self.audits += 1
         if self._registry is not None:
             self._registry.counter_inc("serving.faults.audits")

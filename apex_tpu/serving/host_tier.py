@@ -1,0 +1,243 @@
+"""Host-DRAM second tier for the paged KV pool: the swap arena.
+
+Device HBM caps the prefix cache at a few dozen retained prompts;
+host RAM is ~100x larger and a prefix page is pure *content* — written
+once at registration, shared copy-on-write ever after, never mutated.
+That makes cold prefix pages the perfect spill candidate: only bytes
+need to move, because the hashing, token verification and refcount
+machinery already live host-side (:mod:`~apex_tpu.serving
+.prefix_cache`).
+
+:class:`HostTier` is that spill target — a **bounded numpy arena** of
+swapped-out prefix page blocks, keyed by the owning prefix-cache
+entry's synthetic key:
+
+- **put** (swap-out): the engine copies an evicted entry's page bytes
+  device→host (``[layers, m, heads, page_len, head_dim]`` K and V, in
+  the pool's storage dtype — int8 under the ``kv_quant`` tier, which
+  halves the transfer bytes for free) and the arena stores them with a
+  CRC32 checksum. Capacity is enforced at insert: least-recently-put
+  entries are evicted (the ``on_evict`` hook tells the owner to drop
+  the now-backingless index entry), and an entry larger than the whole
+  arena is *declined* — the caller falls back to plain destruction.
+- **take** (swap-in): pops the entry and re-verifies the checksum.
+  A mismatch (bit rot, or the chaos harness's ``swap_corruption``
+  injection) returns ``valid=False`` — the engine degrades the hit to
+  a **verified miss** (drop + re-prefill), never a wrong token. The
+  checksum guards the *bytes*; the prefix cache's token-for-token
+  verification continues to guard the *identity*, so the two layers
+  together keep the hierarchical cache exact.
+- **contains** is the read-only existence probe the prefix cache's
+  match/probe walk uses (no LRU touch, no counters — the router's
+  affinity probe rides it N times per request).
+
+Everything here is pure host numpy/python: no device work, no compiled
+programs, no jax import. The engine owns all telemetry
+(``serving.swap.*``) and all device-side data movement; the arena owns
+bytes, bounds and checksums.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import zlib
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from apex_tpu.log_util import get_logger
+
+__all__ = ["HostTier", "HostTierRecord"]
+
+_logger = get_logger("serving")
+
+
+def _checksum(k: np.ndarray, v: np.ndarray) -> int:
+    """CRC32 over the K then V bytes — the swap-in exactness guard.
+    Cheap (~GB/s, stdlib C) relative to the device→host copy it
+    protects, and strong enough that a corrupt swap-in can only read
+    as a verified miss, never as silently-wrong K/V."""
+    return zlib.crc32(v.tobytes(), zlib.crc32(k.tobytes()))
+
+
+@dataclasses.dataclass
+class HostTierRecord:
+    """One swapped-out prefix: the page-block K/V bytes (numpy, in the
+    pool's storage dtype), their byte count, the CRC32 computed at
+    swap-out, and the validity verdict :meth:`HostTier.take` fills in
+    when it re-verifies the checksum at swap-in."""
+
+    k: np.ndarray           # [layers, m, heads, page_len, head_dim]
+    v: np.ndarray
+    nbytes: int
+    crc: int
+    last_used: int = 0
+    valid: bool = True
+
+
+class HostTier:
+    """Bounded host-DRAM arena for swapped-out prefix pages (see
+    module docstring). ``capacity_bytes`` bounds the K+V bytes held;
+    ``on_evict(key)`` fires AFTER a capacity eviction removes an entry
+    (the engine wires it to drop the matching swapped prefix-cache
+    entry, so a prefix is never indexed without backing bytes)."""
+
+    def __init__(self, capacity_bytes: int, *,
+                 on_evict: Optional[Callable[[int], None]] = None):
+        capacity_bytes = int(capacity_bytes)
+        if capacity_bytes < 1:
+            raise ValueError("capacity_bytes must be >= 1")
+        self.capacity_bytes = capacity_bytes
+        self.on_evict = on_evict
+        self._entries: Dict[int, HostTierRecord] = {}
+        self._bytes_used = 0        # maintained incrementally: the
+        # auditor re-derives the sum from the stored arrays and raises
+        # on drift, so the two must be independent quantities
+        self._clock = itertools.count(1)
+        # raw counters (the engine mirrors the interesting ones into
+        # serving.swap.*; these keep the class importable bare)
+        self.puts = 0
+        self.takes = 0
+        self.evictions = 0
+        self.declined = 0
+        self.corruptions_detected = 0
+
+    # ------------------------------------------------------------- geometry
+    @property
+    def bytes_used(self) -> int:
+        """K+V bytes currently held (incremental accounting; the
+        :class:`~apex_tpu.serving.PoolAuditor` re-derives it from the
+        stored arrays and raises on drift)."""
+        return self._bytes_used
+
+    @property
+    def size(self) -> int:
+        return len(self._entries)
+
+    def keys(self) -> List[int]:
+        """The resident entry keys (the auditor's reconciliation view
+        against :meth:`PrefixCache.swapped_keys`)."""
+        return list(self._entries)
+
+    def contains(self, key: int) -> bool:
+        """Read-only existence probe — touches NOTHING (no LRU
+        refresh, no counters): the prefix cache's match AND probe
+        walks both ride it, and probe must stay side-effect-free."""
+        return int(key) in self._entries
+
+    def nbytes_of(self, key: int) -> int:
+        """Stored K+V bytes of one entry (0 when absent) — the
+        auditor's per-entry accounting probe."""
+        rec = self._entries.get(int(key))
+        return 0 if rec is None else rec.nbytes
+
+    @staticmethod
+    def _own(arr: np.ndarray) -> np.ndarray:
+        """A contiguous, writable, arena-owned copy of ``arr`` when it
+        is not one already (``np.asarray`` of a device buffer hands
+        back a READ-ONLY view — the arena must own mutable bytes so
+        checksums, capacity accounting and the chaos harness's
+        ``corrupt_entry`` all operate on its own storage)."""
+        arr = np.asarray(arr)
+        if arr.flags.owndata and arr.flags.writeable \
+                and arr.flags.c_contiguous:
+            return arr
+        return np.array(arr, copy=True)
+
+    # ------------------------------------------------------------ transfers
+    def put(self, key: int, k_pages: np.ndarray,
+            v_pages: np.ndarray) -> bool:
+        """Store one swapped-out prefix's page bytes under ``key``.
+        Returns False — and stores nothing — when the entry alone
+        exceeds the arena (the caller destroys instead, exactly the
+        pre-tier behaviour); otherwise evicts least-recently-put
+        entries until the entry fits, firing ``on_evict`` per victim.
+        The arrays are defensively copied (``np.asarray`` of a device
+        buffer already owns its bytes, but a caller-held view must not
+        alias the arena) and checksummed at rest."""
+        key = int(key)
+        k_pages = self._own(k_pages)
+        v_pages = self._own(v_pages)
+        nbytes = int(k_pages.nbytes + v_pages.nbytes)
+        if nbytes > self.capacity_bytes:
+            self.declined += 1
+            _logger.debug("host tier declined %d-byte entry (capacity "
+                          "%d)", nbytes, self.capacity_bytes)
+            return False
+        old = self._entries.pop(key, None)      # replace, never double-count
+        if old is not None:
+            self._bytes_used -= old.nbytes
+        while self._bytes_used + nbytes > self.capacity_bytes:
+            self._evict_lru()
+        self._entries[key] = HostTierRecord(
+            k=k_pages, v=v_pages, nbytes=nbytes,
+            crc=_checksum(k_pages, v_pages), last_used=next(self._clock))
+        self._bytes_used += nbytes
+        self.puts += 1
+        if old is not None:
+            _logger.debug("host tier replaced entry %d", key)
+        return True
+
+    def take(self, key: int) -> Optional[HostTierRecord]:
+        """POP the entry for ``key`` and re-verify its checksum:
+        ``record.valid`` is False when the stored bytes no longer
+        match the swap-out CRC (corruption — the engine must degrade
+        the hit to a verified miss). None when the key is absent
+        (e.g. evicted by capacity pressure since the match walk)."""
+        rec = self._entries.pop(int(key), None)
+        if rec is None:
+            return None
+        self._bytes_used -= rec.nbytes
+        self.takes += 1
+        rec.valid = _checksum(rec.k, rec.v) == rec.crc
+        if not rec.valid:
+            self.corruptions_detected += 1
+            _logger.warning("host tier entry %d failed its swap-in "
+                            "checksum — degrading to a verified miss",
+                            key)
+        return rec
+
+    def _evict_lru(self) -> None:
+        key, rec = min(self._entries.items(),
+                       key=lambda kv: kv[1].last_used)
+        del self._entries[key]
+        self._bytes_used -= rec.nbytes
+        self.evictions += 1
+        _logger.debug("host tier evicted entry %d (capacity pressure)",
+                      key)
+        if self.on_evict is not None:
+            self.on_evict(key)
+
+    # ------------------------------------------------------------ lifecycle
+    def corrupt_entry(self, key: int, *, byte_index: int = 0) -> None:
+        """CHAOS/DEBUG ONLY: flip one byte of the stored K block so the
+        next :meth:`take` fails its checksum — the
+        ``swap_corruption`` fault kind's injection primitive (proving
+        the verified-miss degradation, exactly as
+        ``corrupt_page_table`` proves the auditor's sensitivity).
+        Raises KeyError when the key is absent."""
+        rec = self._entries[int(key)]
+        flat = rec.k.reshape(-1).view(np.uint8)
+        flat[int(byte_index) % flat.size] ^= 0xFF
+
+    def clear(self) -> None:
+        """Drop every entry (counters survive — run-scoped, like the
+        prefix cache's). No ``on_evict`` callbacks: clear is the
+        engine-driven teardown half of ``reset(clear_prefixes=True)``,
+        where the index entries are being dropped anyway."""
+        self._entries.clear()
+        self._bytes_used = 0
+
+    def stats(self) -> dict:
+        """Host-side snapshot (the bench's host-tier honesty row)."""
+        return {
+            "entries": self.size,
+            "bytes_used": self.bytes_used,
+            "capacity_bytes": self.capacity_bytes,
+            "puts": self.puts,
+            "takes": self.takes,
+            "evictions": self.evictions,
+            "declined": self.declined,
+            "corruptions_detected": self.corruptions_detected,
+        }

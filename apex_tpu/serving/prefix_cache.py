@@ -55,6 +55,19 @@ pages — capacity pressure moves to the engine's admission reservation,
 which calls :meth:`evict_lru` instead), and hits need no
 acquire/release (the pages protect themselves via refcounts; evicting
 a donor entry mid-request is harmless).
+
+**Hierarchical KV** (paged + an engine host tier): eviction under pool
+pressure becomes a SWAP — the victim entry's page bytes migrate
+device→host (the engine's ``swap_out`` hook, wired via
+:meth:`PrefixCache.set_swap_hooks`), its device pages return to the
+pool, and the entry stays in the index in the ``swapped`` state, so
+:meth:`match` and :meth:`probe` still report it (the router's affinity
+probe keeps seeing swapped prefixes). A hit on a swapped entry carries
+``PrefixMatch.swapped=True``; the engine migrates the bytes back into
+fresh pages (checksum-verified — a corrupt or missing swap-in degrades
+to a verified miss via :meth:`drop` + :meth:`unrecord_hit`, never a
+wrong token) and calls :meth:`swap_in_complete` before sharing as
+usual. Prefix capacity is then bounded by host RAM, not device HBM.
 """
 
 from __future__ import annotations
@@ -85,7 +98,14 @@ class _Entry:
     ``pages`` (paged layout; ``row`` is then a synthetic negative key);
     ``refcount`` pins a contiguous entry against eviction while a live
     slot's admission copied from it (paged entries need no pin — their
-    pages carry their own refcounts in the engine's page pool)."""
+    pages carry their own refcounts in the engine's page pool).
+
+    ``swapped`` is the hierarchical-KV tier's resident/swapped state:
+    a swapped paged entry holds NO device pages (``pages`` is None,
+    ``swapped_pages`` remembers how many it held) — its page bytes
+    live in the engine's host-DRAM :class:`~apex_tpu.serving
+    .HostTier` under key ``row``, and a hit migrates them back before
+    sharing (:meth:`Engine.attach_prefix`'s swap-in path)."""
 
     row: int
     tokens: Tuple[int, ...]
@@ -93,6 +113,8 @@ class _Entry:
     refcount: int = 0
     last_used: int = 0
     pages: Optional[Tuple[int, ...]] = None
+    swapped: bool = False
+    swapped_pages: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -101,11 +123,14 @@ class PrefixMatch:
     cache row ``row`` (then :meth:`PrefixCache.acquire` it for the
     request's slot lifetime) — or, for a paged entry, share ``pages``
     into the admitted slot's page table (``row`` is the entry's
-    synthetic key; no acquire needed)."""
+    synthetic key; no acquire needed). ``swapped=True`` marks a hit
+    whose page bytes sit in the host tier (``pages`` is None until
+    the engine swaps them back in)."""
 
     row: int
     length: int
     pages: Optional[Tuple[int, ...]] = None
+    swapped: bool = False
 
 
 class PrefixCache:
@@ -131,6 +156,11 @@ class PrefixCache:
         # cache row ids) + the page-release hook eviction fires
         self._paged_key = itertools.count(-1, -1)
         self._on_evict = on_evict
+        # hierarchical-KV hooks (engine-wired via set_swap_hooks; both
+        # None = no host tier, eviction destroys as always)
+        self._swap_out_fn: Optional[Callable[[int, Tuple[int, ...]],
+                                             bool]] = None
+        self._swap_contains: Optional[Callable[[int], bool]] = None
         # raw counters (the scheduler mirrors them into serving.prefix.*)
         self.hits = 0
         self.misses = 0
@@ -138,6 +168,8 @@ class PrefixCache:
         self.pool_full = 0
         self.tokens_reused = 0
         self.registrations = 0
+        self.swap_outs = 0
+        self.swap_ins = 0
 
     # ------------------------------------------------------------- geometry
     @property
@@ -232,6 +264,18 @@ class PrefixCache:
             if tuple(entry.tokens[:length]) != tuple(
                     int(t) for t in prompt[:length]):
                 continue
+            if entry.swapped:
+                # hierarchical KV: the entry's page bytes live in the
+                # host tier. A hit is still a hit — the engine swaps
+                # them back in at attach time — but only while the
+                # tier actually holds the bytes (contains is a pure
+                # read: probe stays side-effect-free through it)
+                if self._swap_contains is None \
+                        or not self._swap_contains(row):
+                    continue
+                best = PrefixMatch(row=row, length=length, pages=None,
+                                   swapped=True)
+                continue
             if entry.pages is None:
                 pages = None
             else:
@@ -253,6 +297,16 @@ class PrefixCache:
         entry = self._entries.get(match.row)
         if entry is not None and entry.refcount > 0:
             entry.refcount -= 1
+
+    def unrecord_hit(self, match: PrefixMatch) -> None:
+        """Reverse one :meth:`match`'s hit accounting — the failed
+        swap-in path (missing or checksum-failed host bytes): the
+        engine degrades the hit to a verified miss and re-prefills, so
+        the counters must read a miss too or :attr:`hit_rate` would
+        claim reuse that never happened."""
+        self.hits -= 1
+        self.misses += 1
+        self.tokens_reused -= match.length
 
     # ---------------------------------------------------------- registration
     def register(self, prompt: Sequence[int],
@@ -349,12 +403,92 @@ class PrefixCache:
         """Evict the least-recently-used refcount-0 entry (pool-pressure
         valve: the paged engine calls this when an admission reservation
         cannot be covered — retained prefixes are a cache, the admitted
-        request is not). False when nothing is evictable."""
-        victims = [e for e in self._entries.values() if e.refcount == 0]
+        request is not). False when nothing is evictable.
+
+        With a host tier wired (:meth:`set_swap_hooks`) eviction is a
+        SWAP-OUT first: the victim's page bytes migrate device→host and
+        the entry stays matchable in the ``swapped`` state — its device
+        pages are released either way, which is what the caller's
+        pressure loop needs. Only resident entries are victims: a
+        swapped entry holds no device pages, so evicting it would free
+        nothing (the pressure loop would spin) — swapped entries leave
+        the tier through host-capacity eviction or a failed swap-in,
+        never through this valve."""
+        victims = [e for e in self._entries.values()
+                   if e.refcount == 0 and not e.swapped]
         if not victims:
             return False
-        self._evict(min(victims, key=lambda e: e.last_used))
+        victim = min(victims, key=lambda e: e.last_used)
+        if self._swap_out(victim):
+            return True
+        self._evict(victim)
         return True
+
+    # -------------------------------------------------- hierarchical KV
+    def set_swap_hooks(self, *, swap_out: Callable[[int, Tuple[int, ...]],
+                                                   bool],
+                       contains: Callable[[int], bool]) -> None:
+        """Wire the host-DRAM tier (engine-side): ``swap_out(key,
+        pages)`` copies an evicted entry's page bytes device→host and
+        returns True on success (False = tier off/declined → the entry
+        is destroyed, the pre-tier behaviour); ``contains(key)`` is the
+        read-only backing probe the match walk consults for swapped
+        entries."""
+        self._swap_out_fn = swap_out
+        self._swap_contains = contains
+
+    def _swap_out(self, entry: _Entry) -> bool:
+        """Migrate ``entry`` resident→swapped: bytes to the host tier
+        (via the engine hook, which must copy BEFORE this releases the
+        device pages), page refcounts back to the pool. False — and no
+        state change — when no tier is wired, the entry is not paged,
+        or the tier declined the bytes."""
+        if self._swap_out_fn is None or entry.pages is None:
+            return False
+        if not self._swap_out_fn(entry.row, entry.pages):
+            return False
+        if self._on_evict is not None:
+            self._on_evict(entry.pages)
+        entry.swapped_pages = len(entry.pages)
+        entry.pages = None
+        entry.swapped = True
+        self.swap_outs += 1
+        _logger.debug("prefix cache swapped out %d-block prefix "
+                      "(key %d, %d pages)", entry.n_blocks, entry.row,
+                      entry.swapped_pages)
+        return True
+
+    def swap_in_complete(self, key: int, pages: Sequence[int]) -> None:
+        """Mark entry ``key`` resident again on freshly migrated
+        ``pages`` (the engine already wrote the host bytes into them
+        and holds one refcount per page on the entry's behalf — the
+        same ownership shape registration leaves behind)."""
+        entry = self._entries[key]
+        if not entry.swapped:
+            raise ValueError(f"entry {key} is not swapped")
+        entry.pages = tuple(int(p) for p in pages)
+        entry.swapped = False
+        entry.swapped_pages = 0
+        self.swap_ins += 1
+
+    def drop(self, key: int) -> bool:
+        """Fully evict entry ``key`` (resident or swapped): the failed-
+        swap-in degradation and the host tier's capacity-eviction
+        callback both land here. A resident victim's pages go back
+        through ``on_evict``; a swapped victim holds none. False when
+        the key is unknown (already dropped)."""
+        entry = self._entries.get(key)
+        if entry is None:
+            return False
+        self._evict(entry)
+        return True
+
+    def swapped_keys(self) -> List[int]:
+        """Keys of entries currently in the swapped state — the
+        :class:`~apex_tpu.serving.PoolAuditor`'s cross-tier view:
+        every one of these must be backed by a host-tier entry, and
+        every host-tier entry must appear here."""
+        return [e.row for e in self._entries.values() if e.swapped]
 
     def _evict(self, entry: _Entry) -> None:
         del self._entries[entry.row]
@@ -415,12 +549,16 @@ class PrefixCache:
             "evictions": self.evictions,
             "pool_full": self.pool_full,
             "registrations": self.registrations,
+            "swap_outs": self.swap_outs,
+            "swap_ins": self.swap_ins,
             "entries": self.size,
+            "swapped_entries": len(self.swapped_keys()),
             "capacity": self.capacity,
         }
 
     _DELTA_KEYS = ("hits", "misses", "tokens_reused", "evictions",
-                   "pool_full", "registrations")
+                   "pool_full", "registrations", "swap_outs",
+                   "swap_ins")
 
     def stats_since(self, baseline: dict) -> dict:
         """The counter DELTAS since ``baseline`` (a prior :meth:`stats`
@@ -439,5 +577,6 @@ class PrefixCache:
         consulted = out["hits"] + out["misses"]
         out["hit_rate"] = out["hits"] / consulted if consulted else 0.0
         out["entries"] = self.size
+        out["swapped_entries"] = len(self.swapped_keys())
         out["capacity"] = self.capacity
         return out

@@ -731,11 +731,21 @@ class Scheduler:
         m = pcache.match(r.prompt, keys=keys)
         if m is not None:
             if getattr(self.engine, "paged", False):
-                self.engine.attach_prefix(slot, m)
+                if not self.engine.attach_prefix(slot, m):
+                    # hierarchical KV: the hit's host-tier bytes were
+                    # missing/corrupt (the engine dropped the entry and
+                    # counted serving.swap.verify_failed) or the pool
+                    # was too tight to restore them — degrade to a
+                    # VERIFIED MISS: nothing attached, the request
+                    # prefills cold from offset 0, and the hit/miss
+                    # accounting is reversed so hit_rate stays honest
+                    pcache.unrecord_hit(m)
+                    m = None
             else:
                 self.engine.restore_prefix(slot, m.row, m.length)
                 pcache.acquire(m)
                 self._slot_prefix[slot] = m
+        if m is not None:
             r._prefill_pos = m.length
             r.reused_tokens = m.length
         if self.registry is not None:
@@ -1137,6 +1147,12 @@ class Scheduler:
         if self.fault_plan is not None:
             # injected heartbeat stall (the watchdog-breach probe)
             self.fault_plan.maybe_stall(tick)
+            tier = getattr(self.engine, "host_tier", None)
+            if tier is not None:
+                # injected host-arena bit rot (the swap_corruption
+                # kind): the NEXT swap-in of the victim entry must
+                # fail its checksum and degrade to a verified miss
+                self.fault_plan.maybe_corrupt_swap(tick, tier)
         compiled0 = getattr(self.engine, "compiled_programs", 0)
         dw0 = getattr(self.engine, "device_wait_s", 0.0)
         try:

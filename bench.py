@@ -52,6 +52,11 @@ sub-object (BENCH_SERVING_QUANT=0 to drop it): the int8-capacity leg
 sync vs dispatch-ahead pipelined serving on one engine — heartbeat
 wall per emitted token, duty cycle, ``token_mismatched_requests``
 (expected 0, bitwise) — via ``bench_serving.async_stats``, and a
+nested ``host_tier`` sub-object (BENCH_SERVING_HOST_TIER=0 to drop
+it): the hierarchical-KV leg — a prefix working set larger than the
+device pool served tier-off vs tier-on (hit rate, chunks skipped,
+TTFT, swap traffic, bitwise exactness) via
+``bench_serving.host_tier_stats``, and a
 nested ``replica_router`` sub-object (BENCH_SERVING_ROUTER=0 to drop
 it; BENCH_SERVING_REPLICAS sizes the fleet): the prefix-aware
 least-loaded router at 1 vs N replicas — aggregate tokens/s, p99
@@ -194,6 +199,17 @@ _SERVING_ASYNC_SMOKE = {
     "PREFILL_LEN": 32, "REQUESTS": 8, "NEW_TOKENS": 16, "WINDOWS": 2,
 }
 
+# The host-tier sub-leg's smoke geometry (the grouped template stream
+# is served twice — tier off + tier on — over a pool deliberately
+# smaller than the template working set, so the eviction→swap churn
+# the leg measures is by construction). BENCH_SERVING_HOST_GROUPS /
+# BENCH_SERVING_HOST_TIER_MIB et al. still win, env-beats-smoke.
+_SERVING_HOST_SMOKE = {
+    "SIZE": "tiny", "VOCAB": 512, "SLOTS": 2, "MAX_LEN": 128,
+    "PREFILL_LEN": 64, "CHUNK_LEN": 8, "REQUESTS": 12, "NEW_TOKENS": 6,
+    "WINDOWS": 1, "SHARED_PREFIX": 56, "PREFIX_POOL": 4,
+}
+
 # The replica-router sub-leg's smoke geometry (the session stream is
 # served THREE ways — 1 replica, N affinity, N random control — so it
 # is sized small; REQUESTS is SESSIONS per window, 2 turns each;
@@ -232,6 +248,7 @@ def _serving_leg() -> dict:
         out["quantized_kv"] = _serving_quant_leg()
         out["async_heartbeat"] = _serving_async_leg()
         out["replica_router"] = _serving_router_leg()
+        out["host_tier"] = _serving_host_tier_leg()
         return out
     except KeyboardInterrupt:
         raise
@@ -342,6 +359,40 @@ def _serving_async_leg() -> dict:
             "duty_cycle", "duty_cycle_sync", "host_s_fraction",
             "discarded_inflight_tokens", "token_mismatched_requests",
             "compiled_programs", "model")}
+    except KeyboardInterrupt:
+        raise
+    except BaseException as e:  # noqa: BLE001 — the row must not die here
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def _serving_host_tier_leg() -> dict:
+    """The hierarchical-KV trajectory sub-row: smoke-sized
+    host-DRAM-tier summary (a prefix working set larger than the
+    device pool, tier off vs on — hit rate, chunks skipped, TTFT,
+    swap traffic, bitwise exactness) from
+    ``bench_serving.host_tier_stats``. BENCH_SERVING_HOST_TIER=0
+    drops it; failure-isolated like its siblings — a broken tier
+    yields {"error": ...} here, never a lost serving (or ResNet)
+    row."""
+    if _env_int("BENCH_SERVING_HOST_TIER", "1") == 0:
+        return {"skipped": True}
+    try:
+        import bench_serving
+
+        bench_serving._load_env(smoke=dict(_SERVING_HOST_SMOKE))
+        _, summary = bench_serving.host_tier_stats()
+        return {k: summary[k] for k in (
+            "value", "unit", "baseline_tokens_per_s",
+            "prefix_hit_rate", "prefix_hit_rate_tier_off",
+            "hit_rate_improved", "prefill_chunks_skipped",
+            "prefill_chunks_skipped_tier_off",
+            "prefill_chunks_skipped_pct", "ttft_p50_ms",
+            "ttft_p50_ms_tier_off", "ttft_p99_ms",
+            "ttft_p99_ms_tier_off", "ttft_improved", "hit_after_swap",
+            "swapped_out_pages", "swapped_in_pages",
+            "swap_verify_failed", "host_bytes",
+            "prefix_working_set_pages", "pool_pages",
+            "token_mismatched_requests", "model")}
     except KeyboardInterrupt:
         raise
     except BaseException as e:  # noqa: BLE001 — the row must not die here

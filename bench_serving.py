@@ -181,6 +181,29 @@ wall-per-token improvement is the silicon claim (real accelerators
 dispatch asynchronously — the premise the refactor is built on).
 Defaults to a smoke geometry; env knobs resize it (env-beats-smoke).
 
+``--host-tier`` runs the hierarchical-KV leg: a grouped shared-prefix
+greedy stream (``BENCH_SERVING_HOST_GROUPS`` distinct
+``BENCH_SERVING_SHARED_PREFIX``-token templates, requests cycling
+through them) whose prefix WORKING SET deliberately exceeds the
+device pool (sized for ~half the groups), served twice on identical
+pool geometry — tier off (eviction destroys, the pre-tier baseline)
+vs tier on (``Engine(host_tier=...)``: eviction swaps page bytes to a
+bounded host-DRAM arena and a revisit swaps them back in). One row
+per mode plus a final line whose payoff fields are the **prefix hit
+rate** both modes (tier-on ≫ tier-off: revisits find swapped entries
+instead of re-prefilling), ``prefill_chunks_skipped`` both modes,
+TTFT p50/p99 both modes (skipped chunks are skipped compute — honest
+on the CPU fallback), the swap traffic counters
+(``hit_after_swap`` / ``swapped_out_pages`` / ``swapped_in_pages`` /
+``verify_failed`` — expected 0 outside chaos), the working-set-vs-
+pool honesty row, and ``token_mismatched_requests`` — tier-on vs
+tier-off, expected **0 bitwise** on every backend (restored pages are
+byte-exact through the same programs). CPU regime note: swap
+BANDWIDTH is the silicon claim (real device↔host DMA vs this box's
+memcpy); hit rate, chunks skipped, TTFT and bitwise parity are the
+CPU-honest columns. Defaults to a smoke geometry; env knobs resize it
+(env-beats-smoke), ``BENCH_SERVING_HOST_TIER_MIB`` bounds the arena.
+
 ``--replica-router`` runs the replica-parallel leg: a multi-turn
 session stream (``BENCH_SERVING_REQUESTS`` sessions of 2 turns per
 window; turn 2's prompt EXTENDS turn 1's, so its block-aligned prefix
@@ -229,6 +252,7 @@ TP_METRIC = "serving_tensor_parallel_tokens_per_sec"
 QUANT_METRIC = "serving_quantized_kv_tokens_per_sec"
 ASYNC_METRIC = "serving_async_heartbeat_tokens_per_sec"
 ROUTER_METRIC = "serving_replica_router_tokens_per_sec"
+HOST_METRIC = "serving_host_tier_tokens_per_sec"
 
 # Literal defaults at import time; the BENCH_SERVING_* env overrides are
 # parsed by _load_env() INSIDE each guarded main, so a malformed value
@@ -309,6 +333,18 @@ ROUTER_SMOKE = {"SIZE": "tiny", "VOCAB": 512, "SLOTS": 2,
                 "MAX_LEN": 128, "PREFILL_LEN": 48, "CHUNK_LEN": 8,
                 "REQUESTS": 6, "NEW_TOKENS": 8, "WINDOWS": 1,
                 "PREFIX_POOL": 4}
+# --host-tier leg: distinct shared-prefix templates the stream cycles
+# through (the pool is sized for ~half of them, so revisits land on
+# evicted — with the tier, SWAPPED — prefixes), the host arena bound
+# in MiB, and the smoke preset (the leg serves the stream twice —
+# tier off + tier on — so it is sized small; REQUESTS per window
+# should be >= 2x HOST_GROUPS so every group is revisited)
+HOST_GROUPS = 6
+HOST_TIER_MIB = 64
+HOST_SMOKE = {"SIZE": "tiny", "VOCAB": 512, "SLOTS": 2, "MAX_LEN": 128,
+              "PREFILL_LEN": 64, "CHUNK_LEN": 8, "REQUESTS": 12,
+              "NEW_TOKENS": 6, "WINDOWS": 1, "SHARED_PREFIX": 56,
+              "PREFIX_POOL": 4}
 
 _ENV_KNOBS = {
     "VOCAB": "BENCH_SERVING_VOCAB", "SLOTS": "BENCH_SERVING_SLOTS",
@@ -330,6 +366,8 @@ _ENV_KNOBS = {
     "QUANT_SLOTS": "BENCH_SERVING_QUANT_SLOTS",
     "ASYNC_DEPTH": "BENCH_SERVING_ASYNC_DEPTH",
     "REPLICAS": "BENCH_SERVING_REPLICAS",
+    "HOST_GROUPS": "BENCH_SERVING_HOST_GROUPS",
+    "HOST_TIER_MIB": "BENCH_SERVING_HOST_TIER_MIB",
 }
 
 
@@ -1612,6 +1650,190 @@ def main_async():
     print(json.dumps(summary))
 
 
+def _host_tier_requests(rng, groups):
+    """REQUESTS arrivals cycling through the ``groups`` templates in
+    order (request i opens with template ``i % G`` plus a short unique
+    tail) — by the time a template is revisited, the pool pressure of
+    the templates in between has evicted it, which is exactly the
+    traffic the host tier exists for."""
+    from apex_tpu.serving import Request
+
+    reqs = []
+    for i in range(REQUESTS):
+        shared = groups[i % len(groups)]
+        tail = max(1, min(8, PREFILL_LEN - len(shared)))
+        n = int(rng.integers(1, tail + 1))
+        prompt = shared + rng.integers(1, VOCAB, size=n).tolist()
+        budget = max(1, min(NEW_TOKENS, MAX_LEN - len(prompt)))
+        reqs.append(Request(prompt=prompt, max_new_tokens=budget))
+    return reqs
+
+
+def _host_tier_geometry(chunk):
+    """(num_pages, prefix_pages, demand): a pool sized for the serving
+    slots' worst-case reservations plus a resident-prefix budget of
+    roughly HALF the template working set — so the leg's eviction
+    churn is by construction, not by luck."""
+    from apex_tpu.serving.engine import resolve_page_len
+
+    page_len = resolve_page_len(chunk)
+    shared_len = (min(SHARED_PREFIX, PREFILL_LEN - 1) // chunk) * chunk
+    prefix_pages = max(1, shared_len // page_len)
+    prefill_extent = -(-PREFILL_LEN // chunk) * chunk
+    occupied = min(PREFILL_LEN + NEW_TOKENS, MAX_LEN)
+    demand = -(-max(prefill_extent, occupied) // page_len)
+    budget = max(prefix_pages, (HOST_GROUPS // 2) * prefix_pages)
+    return 1 + SLOTS * demand + budget, prefix_pages, demand
+
+
+def _serve_host_tier(tier_on: bool, chunk: int, groups, num_pages):
+    """WINDOWS measured windows (plus a discarded compile warmup) of
+    the grouped template stream on one mode's engine; IDENTICAL pool
+    geometry both modes — only the host tier differs. Prefix stats are
+    deltas past the warmup snapshot (the cache counters are
+    run-scoped); swap counters are engine-emitted into the measured
+    windows' registry only."""
+    from apex_tpu import serving, telemetry
+
+    reg = telemetry.MetricsRegistry()
+    engine = _build_engine(
+        prefix_pool=PREFIX_POOL, chunk_len=chunk, num_pages=num_pages,
+        host_tier=(HOST_TIER_MIB << 20) if tier_on else None)
+    rng = np.random.default_rng(5)
+    rates, all_reqs, warm_stats = [], [], {}
+    for w in range(WINDOWS + 1):
+        engine.reset()      # retained AND swapped prefixes stay warm
+        if w == 1:
+            engine.set_registry(reg)
+            warm_stats = dict(engine.prefix_cache.stats())
+        sched = serving.Scheduler(engine, max_queue=max(REQUESTS, 1),
+                                  registry=reg if w else None,
+                                  chunk_budget=CHUNK_BUDGET,
+                                  retain_prefixes=True)
+        reqs = _host_tier_requests(rng, groups)
+        t0 = time.perf_counter()
+        tok0 = engine.tokens_generated
+        done = sched.run(reqs)
+        dt = time.perf_counter() - t0
+        toks = engine.tokens_generated - tok0
+        assert len(done) == REQUESTS
+        if w > 0:
+            rates.append(toks / dt)
+            all_reqs.extend(reqs)
+    engine.set_registry(None)
+    delta = engine.prefix_cache.stats_since(warm_stats)
+    return _median(rates), all_reqs, engine, delta, reg.snapshot()
+
+
+def host_tier_stats():
+    """The --host-tier measurement, reusable by bench.py's serving
+    trajectory leg: a template working set deliberately larger than
+    the device pool, served tier-off (evictions destroy — revisits
+    re-prefill) then tier-on (evictions swap to host DRAM — revisits
+    swap back in). Headline fields: prefix hit rate and prefill
+    chunks skipped both modes, TTFT p50/p99 both modes, the swap
+    traffic counters, and ``token_mismatched_requests`` vs tier-off
+    (greedy, expected 0 — restored pages are byte-exact through the
+    same compiled programs)."""
+    chunk = CHUNK_LEN or 8
+    num_pages, prefix_pages, demand = _host_tier_geometry(chunk)
+    rng0 = np.random.default_rng(29)
+    shared_len = (min(SHARED_PREFIX, PREFILL_LEN - 1) // chunk) * chunk
+    groups = [rng0.integers(1, VOCAB, size=shared_len).tolist()
+              for _ in range(max(1, HOST_GROUPS))]
+    rows, outputs = {}, {}
+    for mode, tier_on in (("tier_off", False), ("tier_on", True)):
+        rate, reqs, engine, stats, snap = _serve_host_tier(
+            tier_on, chunk, groups, num_pages)
+        ttfts = [r.ttft_s for r in reqs if r.ttft_s]
+        counters = snap["counters"]
+        gauges = snap["gauges"]
+        reused = sum(r.reused_tokens for r in reqs)
+        rows[mode] = {
+            "metric": f"{HOST_METRIC}.{mode}",
+            "value": round(rate, 2),
+            "unit": "tokens/s",
+            "prefix_hit_rate": round(stats["hit_rate"], 4),
+            "tokens_reused": stats["tokens_reused"],
+            "prefill_chunks_run": sum(r.chunks for r in reqs),
+            "prefill_chunks_skipped": reused // engine.chunk_len,
+            "evictions": stats["evictions"],
+            "swap_outs": stats["swap_outs"],
+            "swap_ins": stats["swap_ins"],
+            "ttft_p50_ms": round(float(np.percentile(ttfts, 50)) * 1e3,
+                                 3) if ttfts else 0.0,
+            "ttft_p99_ms": round(float(np.percentile(ttfts, 99)) * 1e3,
+                                 3) if ttfts else 0.0,
+            "hit_after_swap": int(counters.get(
+                "serving.swap.hit_after_swap", 0)),
+            "swapped_out_pages": int(counters.get(
+                "serving.swap.swapped_out_pages", 0)),
+            "swapped_in_pages": int(counters.get(
+                "serving.swap.swapped_in_pages", 0)),
+            "swap_verify_failed": int(counters.get(
+                "serving.swap.verify_failed", 0)),
+            "host_bytes": int(gauges.get("serving.swap.host_bytes", 0)),
+            "compiled_programs": engine.compiled_programs,
+        }
+        outputs[mode] = [list(r.output_tokens) for r in reqs]
+    mismatches = sum(a != b for a, b in zip(outputs["tier_on"],
+                                            outputs["tier_off"]))
+    off, on = rows["tier_off"], rows["tier_on"]
+    total = on["prefill_chunks_run"] + on["prefill_chunks_skipped"]
+    summary = {
+        "metric": HOST_METRIC,
+        "value": on["value"],
+        "unit": "tokens/s",
+        "baseline_tokens_per_s": off["value"],
+        "prefix_hit_rate": on["prefix_hit_rate"],
+        "prefix_hit_rate_tier_off": off["prefix_hit_rate"],
+        "hit_rate_improved": on["prefix_hit_rate"]
+        > off["prefix_hit_rate"],
+        "prefill_chunks_skipped": on["prefill_chunks_skipped"],
+        "prefill_chunks_skipped_tier_off": off["prefill_chunks_skipped"],
+        "prefill_chunks_skipped_pct": round(
+            100.0 * on["prefill_chunks_skipped"] / total, 1)
+        if total else 0.0,
+        "ttft_p50_ms": on["ttft_p50_ms"],
+        "ttft_p99_ms": on["ttft_p99_ms"],
+        "ttft_p50_ms_tier_off": off["ttft_p50_ms"],
+        "ttft_p99_ms_tier_off": off["ttft_p99_ms"],
+        "ttft_improved": on["ttft_p50_ms"] < off["ttft_p50_ms"],
+        "hit_after_swap": on["hit_after_swap"],
+        "swapped_out_pages": on["swapped_out_pages"],
+        "swapped_in_pages": on["swapped_in_pages"],
+        "swap_verify_failed": on["swap_verify_failed"],
+        "host_bytes": on["host_bytes"],
+        "host_tier_mib": HOST_TIER_MIB,
+        "token_exact_vs_tier_off": mismatches == 0,
+        "token_mismatched_requests": mismatches,
+        # the honesty row: the template working set must EXCEED the
+        # pool's resident-prefix headroom or the leg measured nothing
+        "prefix_working_set_pages": len(groups) * prefix_pages,
+        "pool_pages": num_pages,
+        "slot_reservation_pages": SLOTS * demand,
+        "groups": len(groups),
+        "windows": WINDOWS,
+        "requests_per_window": REQUESTS,
+        "shared_prefix_len": shared_len,
+        "chunk_len": chunk,
+        "model": SIZE,
+    }
+    return rows, summary
+
+
+def main_host_tier():
+    import jax
+
+    _load_env(smoke=dict(HOST_SMOKE))
+
+    rows, summary = host_tier_stats()
+    for mode in ("tier_off", "tier_on"):
+        print(json.dumps(rows[mode]))
+    summary["backend"] = jax.default_backend()
+    print(json.dumps(summary))
+
+
 def _router_waves(rng):
     """REQUESTS multi-turn sessions, 2 turns each, served as
     sequential WAVES (a turn arrives only after the previous response
@@ -1810,5 +2032,7 @@ if __name__ == "__main__":
         guard_bench_main(main_async, ASYNC_METRIC)
     elif "--replica-router" in sys.argv[1:]:
         guard_bench_main(main_router, ROUTER_METRIC)
+    elif "--host-tier" in sys.argv[1:]:
+        guard_bench_main(main_host_tier, HOST_METRIC)
     else:
         guard_bench_main(main, METRIC)

@@ -60,6 +60,7 @@ PAGES = {
                 "apex_tpu.serving.engine",
                 "apex_tpu.serving.sharding",
                 "apex_tpu.serving.prefix_cache",
+                "apex_tpu.serving.host_tier",
                 "apex_tpu.serving.speculative",
                 "apex_tpu.serving.scheduler",
                 "apex_tpu.serving.router",

@@ -1,0 +1,445 @@
+"""Hierarchical KV — the host-DRAM prefix tier, hermetic.
+
+The acceptance bar from the host-tier issue, as tests:
+
+- a hit-after-swap greedy stream is **bitwise identical** to a
+  never-swapped one, across prefix lengths below / at / straddling the
+  block boundary (the swap round-trips exact bytes through the same
+  compiled programs — storage moved, nothing recomputed);
+- the tier adds AT MOST one compiled program (the fixed-shape
+  ``swap_in`` page-block scatter — one dispatch per swap-in; the
+  chunk/decode/prefill/verify set is untouched);
+- zero leaked pages at drain across swap churn: the
+  :class:`~apex_tpu.serving.PoolAuditor`'s device walk reconciles, and
+  its new cross-tier walk reconciles host-arena entries against the
+  prefix cache's swapped state (and is SENSITIVE: fabricated dangling /
+  orphaned / drifted states raise);
+- the host arena is capacity-bounded with its own LRU: an insert that
+  does not fit evicts least-recently-put entries (whose index entries
+  are dropped — never left dangling), and an entry bigger than the
+  whole arena is declined (destroy fallback, the pre-tier behaviour);
+- composition pins: ``kv_quant`` int8 pages swap out and restore
+  byte-exact (half the transfer bytes for free), and the
+  :class:`~apex_tpu.serving.Router`'s affinity probe still sees
+  swapped prefixes (a swapped entry is warm state, not a cold miss);
+- chaos: the ``swap_corruption`` fault kind (seeded,
+  replay-compatible — rate 0 skips the draw) corrupts arena bytes and
+  the next swap-in degrades to a VERIFIED MISS (re-prefill, counted as
+  ``serving.swap.verify_failed``, hit/miss accounting reversed) —
+  never a wrong token.
+
+Everything runs on CPU with a tiny model at policy O0 (exact fp32).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import telemetry
+from apex_tpu.amp.policy import resolve_policy
+from apex_tpu.models.transformer_lm import TransformerLM
+from apex_tpu.serving import (Engine, FaultPlan, FaultSpec, HostTier,
+                              PoolAuditor, PoolInvariantError,
+                              PrefixCache, Request, Router, Scheduler)
+
+pytestmark = [pytest.mark.serving, pytest.mark.chaos]
+
+VOCAB = 101
+CHUNK = 8          # chunk_len == page_len: every chunk is one page
+# tiny-model page bytes: layers(2) * heads(4) * page_len(8) * head_dim(8)
+# * fp32(4) * K-and-V(2) — the arena-capacity arithmetic below
+PAGE_BYTES = 2 * 4 * 8 * 8 * 4 * 2
+
+
+def _tiny_lm(max_seq_len=64, **kw):
+    return TransformerLM(vocab_size=VOCAB, hidden=32, num_layers=2,
+                         num_heads=4, max_seq_len=max_seq_len, **kw)
+
+
+@pytest.fixture(scope="module")
+def lm_and_params():
+    m = _tiny_lm()
+    params = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32),
+                    train=False)["params"]
+    return m, params
+
+
+def _mk_engine(lm_and_params, *, pool=2, slots=3, seed=5, paged=True,
+               **kw):
+    m, params = lm_and_params
+    return Engine(m, params, slots=slots, max_len=64, prefill_len=24,
+                  chunk_len=CHUNK, prefix_pool=pool, paged=paged,
+                  policy=resolve_policy("O0", verbose=False), seed=seed,
+                  **kw)
+
+
+@pytest.fixture(scope="module")
+def engine_pair(lm_and_params):
+    """One hierarchical engine (host tier on) + one plain engine —
+    identical geometry, so a hit-after-swap stream and a never-swapped
+    stream compare bitwise (jit caches warm across the module)."""
+    return (_mk_engine(lm_and_params, host_tier=1 << 24),
+            _mk_engine(lm_and_params))
+
+
+# -------------------------------------------------------- arena (pure host)
+def _fake_pages(rng, m=2, dtype=np.float32):
+    shape = (2, m, 4, 8, 8)         # [layers, m, heads, page_len, d]
+    return (rng.normal(size=shape).astype(dtype),
+            rng.normal(size=shape).astype(dtype))
+
+
+def test_host_tier_put_take_contains_and_lru_capacity():
+    rng = np.random.default_rng(0)
+    k, v = _fake_pages(rng)
+    nbytes = k.nbytes + v.nbytes
+    evicted = []
+    tier = HostTier(2 * nbytes + 1, on_evict=evicted.append)
+    assert tier.put(-1, k, v) and tier.put(-2, *_fake_pages(rng))
+    assert tier.size == 2 and tier.bytes_used == 2 * nbytes
+    assert tier.contains(-1) and not tier.contains(-9)
+    assert tier.nbytes_of(-1) == nbytes and tier.nbytes_of(-9) == 0
+    # a third insert exceeds the bound: the least-recently-put entry
+    # (-1) is evicted and its owner notified
+    assert tier.put(-3, *_fake_pages(rng))
+    assert evicted == [-1] and not tier.contains(-1)
+    assert tier.bytes_used == 2 * nbytes <= tier.capacity_bytes
+    assert tier.evictions == 1
+    # an entry alone bigger than the arena is DECLINED, nothing evicted
+    big = HostTier(nbytes - 1)
+    assert not big.put(-7, k, v)
+    assert big.declined == 1 and big.size == 0
+    # take pops and verifies
+    rec = tier.take(-2)
+    assert rec is not None and rec.valid and not tier.contains(-2)
+    assert tier.take(-2) is None
+    with pytest.raises(ValueError, match="capacity_bytes"):
+        HostTier(0)
+    tier.clear()
+    assert tier.size == 0 and tier.bytes_used == 0
+
+
+def test_host_tier_checksum_detects_corruption():
+    rng = np.random.default_rng(1)
+    tier = HostTier(1 << 20)
+    tier.put(-1, *_fake_pages(rng))
+    tier.put(-2, *_fake_pages(rng))
+    tier.corrupt_entry(-1)
+    bad, good = tier.take(-1), tier.take(-2)
+    assert bad is not None and not bad.valid
+    assert good is not None and good.valid
+    assert tier.corruptions_detected == 1
+    with pytest.raises(KeyError):
+        tier.corrupt_entry(-99)
+
+
+def test_prefix_cache_swap_state_and_pressure_valve():
+    """Cache↔tier interplay without an engine: eviction under a wired
+    tier is a swap (entry stays matchable/probeable), swapped entries
+    are never pressure-valve victims (they hold no device pages — the
+    pool loop must not spin on them), and a drop reverses cleanly."""
+    released, store = [], {}
+    pc = PrefixCache(block_len=4, on_evict=released.extend)
+    pc.set_swap_hooks(swap_out=lambda key, pages: store.setdefault(
+        key, tuple(pages)) is not None, contains=lambda key: key in store)
+    prompt = list(range(10, 22))                     # 3 blocks of 4
+    assert pc.register(prompt, pages=(3, 7, 9)) == "registered"
+    (key,) = [e.row for e in pc._entries.values()]
+    assert pc.evict_lru()                            # swap, not destroy
+    assert released == [(3, 7, 9)][0:1] or released == [3, 7, 9]
+    assert pc.swapped_keys() == [key] and pc.swap_outs == 1
+    # still matchable (swapped=True) and probeable, read-only
+    m = pc.match(prompt + [1])
+    assert m is not None and m.swapped and m.pages is None \
+        and m.length == 12
+    assert pc.probe(prompt + [1]) == 12
+    # no resident victims left: the valve reports nothing evictable
+    # instead of spinning on the page-less swapped entry
+    assert not pc.evict_lru()
+    # the backing disappearing (tier capacity eviction) makes the next
+    # match a miss, not a crash
+    store.clear()
+    assert pc.match(prompt + [1]) is None
+    assert pc.drop(key) and not pc.drop(key)
+    assert pc.swapped_keys() == [] and pc.size == 0
+
+
+# ------------------------------------------------- hit-after-swap, bitwise
+def _boundary_cases():
+    """(prompt_a, prompt_b, expected_reuse) with shared-prefix lengths
+    below / at / straddling the block boundary (block == page == 8) —
+    the same sweep the paged-pool tests run, now across a swap."""
+    rng = np.random.default_rng(42)
+    out = []
+    for pre_len, want in [(5, 0), (8, 8), (13, 8), (16, 16)]:
+        pre = list(rng.integers(1, VOCAB, size=pre_len))
+        out.append((pre + list(rng.integers(1, VOCAB, size=3)),
+                    pre + list(rng.integers(1, VOCAB, size=3)), want))
+    return out
+
+
+def test_hit_after_swap_bitwise_vs_never_swapped(engine_pair):
+    """THE acceptance pin: register a prefix, force it through a full
+    device→host→device round trip, and the hit-after-swap stream must
+    be bitwise identical to the never-swapped stream on the plain
+    engine — same reuse accounting included."""
+    et, ec = engine_pair
+    for prompt_a, prompt_b, want_reuse in _boundary_cases():
+        et.reset(clear_prefixes=True)
+        ec.reset(clear_prefixes=True)
+        st = Scheduler(et, retain_prefixes=True)
+        sc = Scheduler(ec, retain_prefixes=True)
+        (ra_t,) = st.run([Request(prompt=list(prompt_a),
+                                  max_new_tokens=5)])
+        (ra_c,) = sc.run([Request(prompt=list(prompt_a),
+                                  max_new_tokens=5)])
+        # every prompt here spans >= 1 block, so prompt_a always
+        # registered an entry — eviction must SWAP it, not destroy it
+        assert et.prefix_cache.evict_lru()
+        assert et.prefix_cache.swapped_keys()
+        assert et.host_tier.size == 1
+        # the affinity probe still sees the swapped prefix (0 when
+        # prompt_b's first block genuinely differs — the 5-token case)
+        assert et.prefix_cache.probe(prompt_b) == want_reuse
+        (rb_t,) = st.run([Request(prompt=list(prompt_b),
+                                  max_new_tokens=5)])
+        (rb_c,) = sc.run([Request(prompt=list(prompt_b),
+                                  max_new_tokens=5)])
+        assert ra_t.output_tokens == ra_c.output_tokens
+        assert rb_t.output_tokens == rb_c.output_tokens, \
+            f"hit-after-swap diverged (prefix {want_reuse})"
+        assert rb_t.reused_tokens == rb_c.reused_tokens == want_reuse
+        if want_reuse:
+            # restored and re-resident: entry back on fresh pages,
+            # arena drained of the migrated record
+            assert not et.prefix_cache.swapped_keys()
+            assert et.host_tier.size == 0
+
+
+def test_at_most_one_new_program_and_zero_leaks(engine_pair):
+    """Program-count pin + leak pin, over all the swap churn the
+    module has driven so far: the hierarchical engine compiled exactly
+    chunk + decode + swap_in (one more than the plain engine's two),
+    and both pools audit clean — then drain to zero pages."""
+    et, ec = engine_pair
+    assert et.chunk_traces == 1 and et.decode_traces == 1
+    assert et.swap_in_traces == 1          # every page shares ONE program
+    assert et.copy_traces == et.verify_traces == et.prefill_traces == 0
+    assert et.compiled_programs == 3
+    assert ec.compiled_programs == 2 and ec.swap_in_traces == 0
+    for eng in engine_pair:
+        PoolAuditor().audit(eng)
+        eng.reset(clear_prefixes=True)
+        assert eng.pool.pages_in_use == 0
+        PoolAuditor().audit(eng)
+    assert et.host_tier.size == 0 and et.host_tier.bytes_used == 0
+
+
+def test_engine_host_tier_validation(lm_and_params):
+    with pytest.raises(ValueError, match="paged=True"):
+        _mk_engine(lm_and_params, host_tier=1 << 20, paged=False)
+    with pytest.raises(ValueError, match="prefix_pool"):
+        _mk_engine(lm_and_params, host_tier=1 << 20, pool=0)
+    # a pre-built arena is accepted as-is (capacity honoured)
+    eng = _mk_engine(lm_and_params, host_tier=HostTier(1 << 20))
+    assert isinstance(eng.host_tier, HostTier)
+    assert eng.host_tier.capacity_bytes == 1 << 20
+
+
+# -------------------------------------------------- capacity + composition
+def test_capacity_bounded_arena_evicts_and_drops_entries(lm_and_params):
+    """Engine-level capacity bound: an arena sized for ONE two-page
+    prefix holds the latest swap-out; swapping a second entry out
+    evicts the first's bytes AND drops its index entry (no dangling
+    swapped state), with the auditor's cross-tier walk green
+    throughout."""
+    eng = _mk_engine(lm_and_params, pool=3,
+                     host_tier=2 * PAGE_BYTES + 1)
+    sched = Scheduler(eng, retain_prefixes=True)
+    rng = np.random.default_rng(7)
+    pres = [list(rng.integers(1, VOCAB, size=16)) for _ in range(2)]
+    for pre in pres:
+        sched.run([Request(prompt=pre + [1, 2], max_new_tokens=3)])
+    auditor = PoolAuditor()
+    assert eng.prefix_cache.evict_lru()        # swap entry 0 out
+    auditor.audit(eng)
+    assert eng.prefix_cache.evict_lru()        # swap entry 1: evicts 0
+    auditor.audit(eng)
+    tier = eng.host_tier
+    assert tier.size == 1 and tier.evictions == 1
+    assert tier.bytes_used <= tier.capacity_bytes
+    # entry 0 is GONE from the index (dropped with its bytes): its
+    # prefix probes 0, entry 1's still probes through the tier
+    assert eng.prefix_cache.probe(pres[0] + [9]) == 0
+    assert eng.prefix_cache.probe(pres[1] + [9]) == 16
+    assert len(eng.prefix_cache.swapped_keys()) == 1
+
+
+def test_int8_pages_swap_and_restore_byte_exact(lm_and_params):
+    """kv_quant composition: int8 pages ride the tier at half the
+    transfer bytes, and the restored device bytes are EXACTLY the
+    evicted ones (the whole bitwise argument, at the byte level)."""
+    from apex_tpu.serving import KVQuantConfig
+
+    eng = _mk_engine(lm_and_params, host_tier=1 << 24,
+                     kv_quant=KVQuantConfig())
+    sched = Scheduler(eng, retain_prefixes=True)
+    rng = np.random.default_rng(11)
+    pre = list(rng.integers(1, VOCAB, size=16))
+    sched.run([Request(prompt=pre + [7, 8], max_new_tokens=3)])
+    (key,) = list(eng.prefix_cache._entries)
+    pages0 = list(eng.prefix_cache._entries[key].pages)
+    before_k = np.asarray(eng.cache.k[:, pages0]).copy()
+    before_v = np.asarray(eng.cache.v[:, pages0]).copy()
+    assert before_k.dtype == np.int8       # half the swap bytes, free
+    assert eng.prefix_cache.evict_lru()
+    assert eng.host_tier.bytes_used == 2 * PAGE_BYTES // 4   # int8 vs fp32
+    (r,) = sched.run([Request(prompt=pre + [9, 10],
+                              max_new_tokens=3)])
+    assert r.reused_tokens == 16
+    pages1 = list(eng.prefix_cache._entries[key].pages)
+    np.testing.assert_array_equal(before_k,
+                                  np.asarray(eng.cache.k[:, pages1]))
+    np.testing.assert_array_equal(before_v,
+                                  np.asarray(eng.cache.v[:, pages1]))
+    PoolAuditor().audit(eng)
+
+
+def test_router_affinity_probe_sees_swapped_prefixes(engine_pair):
+    """Router composition: a replica whose prefix was swapped to host
+    still wins the affinity probe — swap-out moves bytes, not
+    routing signal."""
+    et, ec = engine_pair
+    for eng in engine_pair:
+        eng.reset(clear_prefixes=True)
+    reg = telemetry.MetricsRegistry()
+    router = Router([et, ec], registry=reg, retain_prefixes=True)
+    try:
+        rng = np.random.default_rng(13)
+        pre = list(rng.integers(1, VOCAB, size=16))
+        (r1,) = router.run([Request(prompt=pre + [1, 2],
+                                    max_new_tokens=3)])
+        # find the replica that served turn 1 and swap its prefix out
+        (home,) = [i for i, e in enumerate((et, ec))
+                   if e.prefix_cache is not None and e.prefix_cache.size]
+        owner = (et, ec)[home]
+        if owner.host_tier is not None:
+            assert owner.prefix_cache.evict_lru()
+            assert owner.prefix_cache.swapped_keys()
+        hits0 = reg.snapshot()["counters"].get(
+            "serving.router.affinity_hits", 0)
+        (r2,) = router.run([Request(prompt=pre + [3, 4],
+                                    max_new_tokens=3)])
+        hits1 = reg.snapshot()["counters"].get(
+            "serving.router.affinity_hits", 0)
+        assert hits1 == hits0 + 1          # the probe saw the prefix
+        assert r2.reused_tokens == 16
+    finally:
+        router.close()
+
+
+# ----------------------------------------------------------------- chaos
+def test_swap_corruption_degrades_to_verified_miss(engine_pair):
+    """The chaos pin: corrupt arena bytes make the next swap-in fail
+    its checksum and the request re-prefills COLD — bitwise identical
+    to a cold run, `serving.swap.verify_failed` counted, hit/miss
+    accounting reversed, request FINISHED (never failed, never a wrong
+    token)."""
+    et, ec = engine_pair
+    for eng in engine_pair:
+        eng.reset(clear_prefixes=True)
+    rng = np.random.default_rng(17)
+    pre = list(rng.integers(1, VOCAB, size=16))
+    p2 = pre + list(rng.integers(1, VOCAB, size=3))
+    # cold oracle on the plain engine (no retention: fully cold)
+    (oracle,) = Scheduler(ec).run([Request(prompt=list(p2),
+                                           max_new_tokens=5)])
+    reg = telemetry.MetricsRegistry()
+    et.set_registry(reg)
+    try:
+        sched = Scheduler(et, registry=reg, retain_prefixes=True)
+        sched.run([Request(prompt=pre + [7, 8, 9], max_new_tokens=5)])
+        assert et.prefix_cache.evict_lru()
+        base = dict(et.prefix_cache.stats())
+        sched.fault_plan = FaultPlan(
+            [FaultSpec(kind="swap_corruption", tick=sched._tick)])
+        (r,) = sched.run([Request(prompt=list(p2), max_new_tokens=5)])
+        assert r.output_tokens == oracle.output_tokens
+        assert r.status == "finished" and r.reused_tokens == 0
+        assert sched.fault_plan.injected_swap_corruptions == 1
+        assert sched.fault_plan.stats()["injected_swap_corruptions"] == 1
+        counters = reg.snapshot()["counters"]
+        assert counters.get("serving.swap.verify_failed") == 1
+        delta = et.prefix_cache.stats_since(base)
+        assert delta["hits"] == 0 and delta["misses"] == 1   # reversed
+        # the corrupt entry is gone everywhere; the pool stays clean
+        assert not et.prefix_cache.swapped_keys()
+        assert et.host_tier.size == 0
+        PoolAuditor().audit(et)
+    finally:
+        et.set_registry(None)
+
+
+def test_faultplan_swap_corruption_replay_compatible():
+    """Rate 0 skips the draw entirely (the PR 12 replica-death
+    pattern), so every pre-host-tier seed replays bit-for-bit; a
+    positive rate draws the new kind."""
+    kw = dict(slots=4, nonfinite_rate=0.3, exception_rate=0.2,
+              stall_rate=0.1)
+    assert FaultPlan.random(3, 40, **kw).specs \
+        == FaultPlan.random(3, 40, swap_corruption_rate=0.0, **kw).specs
+    plan = FaultPlan.random(3, 60, slots=4, swap_corruption_rate=0.5)
+    assert any(s.kind == "swap_corruption" for s in plan.specs)
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        FaultSpec(kind="swap_rot", tick=0)
+    # an empty arena makes the injection a consumed no-op
+    empty = FaultPlan([FaultSpec(kind="swap_corruption", tick=0)])
+    assert not empty.maybe_corrupt_swap(0, HostTier(1 << 10))
+    assert empty.injected_swap_corruptions == 0
+
+
+# --------------------------------------------------------------- auditor
+def test_auditor_cross_tier_walk_is_sensitive(engine_pair):
+    """The extended conservation audit detects every cross-tier rot it
+    claims to: dangling swapped entries, orphaned arena bytes, drifted
+    byte accounting, and an over-capacity arena."""
+    et, _ = engine_pair
+    et.reset(clear_prefixes=True)
+    sched = Scheduler(et, retain_prefixes=True)
+    rng = np.random.default_rng(23)
+    pre = list(rng.integers(1, VOCAB, size=16))
+    sched.run([Request(prompt=pre + [1, 2], max_new_tokens=3)])
+    assert et.prefix_cache.evict_lru()
+    auditor = PoolAuditor()
+    auditor.audit(et)                      # consistent: green
+    tier = et.host_tier
+    (key,) = tier.keys()
+    # (1) dangling: swapped entry with no arena backing
+    rec = tier._entries.pop(key)
+    tier._bytes_used -= rec.nbytes
+    with pytest.raises(PoolInvariantError, match="no host-tier backing"):
+        auditor.audit(et)
+    tier._entries[key] = rec
+    tier._bytes_used += rec.nbytes
+    auditor.audit(et)
+    # (2) orphan: arena bytes backing no swapped entry
+    tier._entries[-777] = rec
+    tier._bytes_used += rec.nbytes
+    with pytest.raises(PoolInvariantError, match="host-side leak"):
+        auditor.audit(et)
+    del tier._entries[-777]
+    tier._bytes_used -= rec.nbytes
+    # (3) byte-accounting drift
+    tier._bytes_used += 1
+    with pytest.raises(PoolInvariantError, match="drifted"):
+        auditor.audit(et)
+    tier._bytes_used -= 1
+    # (4) over-capacity arena
+    saved = tier.capacity_bytes
+    tier.capacity_bytes = 1
+    with pytest.raises(PoolInvariantError, match="over capacity"):
+        auditor.audit(et)
+    tier.capacity_bytes = saved
+    auditor.audit(et)
+    et.reset(clear_prefixes=True)

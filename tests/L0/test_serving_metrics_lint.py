@@ -27,10 +27,15 @@ multi-turn reuse" while requests silently re-prefill on cold replicas,
 ``replica_deaths`` / ``requeued`` going dark makes a dying fleet look
 healthy, and the per-replica gauge namespace
 (``serving.router.replica<i>.*``) is what keeps N replicas sharing one
-registry from clobbering each other's pool gauges. The loop is closed
-by lint: the set of fault/watchdog/spec/tp/kv/heartbeat/router metric
-literals in ``apex_tpu/serving/`` source must EQUAL the set named in
-the docs' tables.
+registry from clobbering each other's pool gauges. The ``serving.swap.*``
+family joined with the hierarchical-KV tentpole: ``hit_after_swap``
+going dark reads as "the host tier never pays off" while swap-ins
+silently skip real prefill chunks, ``verify_failed`` going dark would
+hide that swapped prefixes are rotting (every one a full re-prefill),
+and ``host_bytes`` is the tier's capacity claim. The loop is closed
+by lint: the set of fault/watchdog/spec/tp/kv/heartbeat/router/swap
+metric literals in ``apex_tpu/serving/`` source must EQUAL the set
+named in the docs' tables.
 
 This file also owns the **force-early lint**: the dispatch-ahead
 region of ``scheduler.py`` (everything between a decode dispatch and
@@ -66,7 +71,7 @@ DOC = os.path.join(ROOT, "docs", "serving.md")
 # "serving.router.replica", which is exactly the namespacing contract
 # the docs must name.
 _PAT = re.compile(
-    r"serving\.(?:faults|watchdog|spec|tp|kv|heartbeat|router)"
+    r"serving\.(?:faults|watchdog|spec|tp|kv|heartbeat|router|swap)"
     r"\.[a-z0-9_]+")
 
 
@@ -131,6 +136,19 @@ def test_scan_surface_is_alive():
                  "serving.heartbeat.discarded"):
         assert sched in emitted.get(name, []), \
             f"{name} not emitted by the scheduler — async-heartbeat " \
+            "telemetry went dark"
+    # the hierarchical-KV family: swap traffic, the host-arena
+    # capacity gauge, the hit-after-swap payoff counter and the
+    # verified-miss degradation counter are all engine-emitted
+    for name in ("serving.swap.swapped_out_pages",
+                 "serving.swap.swapped_in_pages",
+                 "serving.swap.host_bytes",
+                 "serving.swap.hit_after_swap",
+                 "serving.swap.verify_failed",
+                 "serving.swap.host_evictions",
+                 "serving.swap.out_s", "serving.swap.in_s"):
+        assert engine_py in emitted.get(name, []), \
+            f"{name} not emitted by the engine — hierarchical-KV " \
             "telemetry went dark"
     # the replica-router family: routing outcomes, death containment
     # and the per-replica gauge namespace are router-emitted

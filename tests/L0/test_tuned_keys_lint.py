@@ -193,6 +193,31 @@ def test_quantized_kv_owes_the_tables_no_new_keys():
                         "prefill_attention.py") in scanned
 
 
+def test_host_tier_owes_the_tables_no_new_keys():
+    """The hierarchical-KV satellite, in the copy-program pattern: the
+    host tier is pure data movement — swap-out is a forced device read
+    (no program at all) and swap-in is one fixed-shape page-block
+    scatter (no attention, no Pallas kernel, no grid) —
+    so it introduces NO new ``decode.*`` tuned key; restored pages are
+    read back through the EXISTING paged-attention knobs. Any
+    ``decode.swap_*`` / ``decode.host_*`` row would be a dead sweep,
+    named loudly here; and the lint's scan must cover host_tier.py so
+    any key a future swap-DMA kernel DOES reference gets the
+    existence/staleness treatment automatically."""
+    table = _table_keys()
+    stale_swap = {k for k in table
+                  if k.startswith(("decode.swap_", "decode.host_"))}
+    assert not stale_swap, (
+        f"tuned tables carry host-tier keys but swap-in/out is pure "
+        f"data movement over the existing programs: {stale_swap}")
+    scanned = {os.path.relpath(p, ROOT)
+               for d in SCAN_DIRS
+               for p in glob.glob(os.path.join(d, "**", "*.py"),
+                                  recursive=True)}
+    assert os.path.join("apex_tpu", "serving",
+                        "host_tier.py") in scanned
+
+
 def test_sharded_serving_owes_the_tables_no_new_keys():
     """The tensor-parallel satellite, in the copy/verify pattern: the
     sharded programs run the EXISTING paged kernels over fewer heads
