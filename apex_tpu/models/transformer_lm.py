@@ -33,6 +33,25 @@ from apex_tpu.normalization import FusedLayerNorm
 __all__ = ["TransformerLM", "TransformerBlock", "create_lm"]
 
 
+def _dense_factory(weight_quant: bool, dense_dtype, param_dtype):
+    """The one Dense-site constructor both block modules share: plain
+    ``nn.Dense`` on the default path (kept verbatim — the bitwise
+    baseline), ``QuantDense`` (int8 kernel, per-output-channel scale
+    in the epilogue) when the engine enabled weight quantization —
+    same param paths either way."""
+    if weight_quant:
+        from apex_tpu.serving.weight_quant import QuantDense
+
+        def _dense(features, name):
+            return QuantDense(features, dtype=dense_dtype,
+                              param_dtype=param_dtype, name=name)
+    else:
+        def _dense(features, name):
+            return nn.Dense(features, dtype=dense_dtype,
+                            param_dtype=param_dtype, name=name)
+    return _dense
+
+
 class SelfAttention(nn.Module):
     """Causal MHA with four modes sharing one set of weights:
 
@@ -100,6 +119,14 @@ class SelfAttention(nn.Module):
     (:mod:`apex_tpu.serving.sharding`), so the psum restores it exactly
     once. ``tp_size=1`` (the default) leaves every shape and op
     untouched.
+
+    **Quantized weights** (``weight_quant=True``, set by
+    ``serving.Engine(weight_quant=...)``): the qkv and proj GEMMs run
+    over int8 kernels through
+    :class:`~apex_tpu.serving.weight_quant.QuantDense` — the
+    per-output-channel fp32 scale multiplies the accumulator in the
+    epilogue, so dequantized weights never materialise. The default
+    (False) keeps ``nn.Dense`` on the trace path verbatim.
     """
 
     hidden: int
@@ -110,6 +137,7 @@ class SelfAttention(nn.Module):
     inference_dtype: Optional[Any] = None
     tp_axis: Optional[str] = None
     tp_size: int = 1
+    weight_quant: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool, cache=None, positions=None,
@@ -120,14 +148,15 @@ class SelfAttention(nn.Module):
         dense_dtype = resolve_dtype(self.dtype, "linear", jnp.float32)
         if self.inference_dtype is not None and not train:
             dense_dtype = self.inference_dtype
+        _dense = _dense_factory(self.weight_quant, dense_dtype,
+                                self.param_dtype)
         B, S, H = x.shape
         d = self.hidden // self.num_heads
         # tensor-parallel shard: this module computes heads // tp local
         # heads over the full (replicated) residual stream; the param
         # sharder hands it the matching qkv/proj kernel slices
         heads = self.num_heads // self.tp_size
-        qkv = nn.Dense(3 * heads * d, dtype=dense_dtype,
-                       param_dtype=self.param_dtype, name="qkv")(x)
+        qkv = _dense(3 * heads * d, "qkv")(x)
         # one transpose to [3, B, h, S, d], then three views — no
         # throwaway generator re-indexing qkv[:, :, i] three times
         qkv = qkv.reshape(B, S, 3, heads, d).transpose(2, 0, 3, 1, 4)
@@ -277,8 +306,7 @@ class SelfAttention(nn.Module):
                 q = jnp.asarray(q, jnp.float32)
             out = flash_attention(q, k, v, causal=True)  # [B, h, S, d]
             out = jnp.moveaxis(out, 1, 2).reshape(B, S, heads * d)
-        out = nn.Dense(self.hidden, dtype=dense_dtype,
-                       param_dtype=self.param_dtype, name="proj")(out)
+        out = _dense(self.hidden, "proj")(out)
         if self.tp_size > 1:
             # row-parallel reduce: each shard's proj saw only its heads'
             # context, so the outputs are partial sums; the Dense added
@@ -314,6 +342,7 @@ class TransformerBlock(nn.Module):
     inference_dtype: Optional[Any] = None
     tp_axis: Optional[str] = None
     tp_size: int = 1
+    weight_quant: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool, cache=None, positions=None,
@@ -325,6 +354,8 @@ class TransformerBlock(nn.Module):
         dense_dtype = resolve_dtype(self.dtype, "linear", jnp.float32)
         if self.inference_dtype is not None and not train:
             dense_dtype = self.inference_dtype
+        _dense = _dense_factory(self.weight_quant, dense_dtype,
+                                self.param_dtype)
         h = FusedLayerNorm(normalized_shape=self.hidden, dtype=self.dtype,
                            name="ln_attn")(x)
         aux = None
@@ -332,6 +363,7 @@ class TransformerBlock(nn.Module):
                                  self.dtype, self.param_dtype,
                                  self.inference_dtype,
                                  self.tp_axis, self.tp_size,
+                                 weight_quant=self.weight_quant,
                                  name="attn")(h, train=train, cache=cache,
                                               positions=positions,
                                               return_kv=return_kv,
@@ -347,8 +379,7 @@ class TransformerBlock(nn.Module):
         # shard's inner/tp slice), row-parallel down-projection psummed
         # below — the MLP half of the Megatron split
         inner = self.mlp_ratio * self.hidden // self.tp_size
-        h = nn.Dense(inner, dtype=dense_dtype, param_dtype=self.param_dtype,
-                     name="mlp_in")(h)
+        h = _dense(inner, "mlp_in")(h)
         # tanh-approximation GELU (GPT-2's own formulation) on the fp32
         # accumulator. tanh fuses into the GEMM epilogue on TPU; exact
         # erf priced at +250 us per MLP f+b at the gpt2 shape on v5e
@@ -356,9 +387,7 @@ class TransformerBlock(nn.Module):
         # fused_dense API keeps exact erf; the models use the variant
         # their original papers trained with.
         h = nn.gelu(jnp.asarray(h, jnp.float32), approximate=True)
-        h = nn.Dense(self.hidden, dtype=dense_dtype,
-                     param_dtype=self.param_dtype,
-                     name="mlp_out")(jnp.asarray(h, dense_dtype))
+        h = _dense(self.hidden, "mlp_out")(jnp.asarray(h, dense_dtype))
         if self.tp_size > 1:
             # row-parallel reduce (the block's second TP all-reduce);
             # mlp_out's bias is 1/tp-scaled per shard, restored here
@@ -428,6 +457,13 @@ class TransformerLM(nn.Module):
     # engine's compiled program) all-gathers only the sampled rows.
     tp_axis: Optional[str] = None
     tp_size: int = 1
+    # quantized serving weights (serving.Engine(weight_quant=...); the
+    # engine provides int8 kernels + per-output-channel fp32 scales in
+    # the params tree): every block GEMM runs through QuantDense and
+    # the tied embedding/head through QuantEmbed — dequant is the
+    # epilogue scale multiply, never a materialised weight matrix.
+    # Serving-only: int8 kernels cannot train.
+    weight_quant: bool = False
 
     @nn.compact
     def __call__(self, tokens, *, train: bool = True,
@@ -441,14 +477,25 @@ class TransformerLM(nn.Module):
         if cache is not None and return_kv:
             raise ValueError("cache (decode) and return_kv (prefill) are "
                              "exclusive modes")
+        if self.weight_quant and train:
+            raise ValueError(
+                "weight_quant is a serving-only mode: int8 kernels "
+                "cannot train — keep the bf16/fp32 model for training "
+                "and let serving.Engine(weight_quant=...) quantize")
         if self.tp_size > 1 and (self.num_heads % self.tp_size
                                  or self.vocab_size % self.tp_size):
             raise ValueError(
                 f"tp_size={self.tp_size} must divide num_heads="
                 f"{self.num_heads} and vocab_size={self.vocab_size}")
         B, S = tokens.shape
-        embed = nn.Embed(self.vocab_size, self.hidden,
-                         param_dtype=self.param_dtype, name="wte")
+        if self.weight_quant:
+            from apex_tpu.serving.weight_quant import QuantEmbed
+            embed = QuantEmbed(self.vocab_size, self.hidden,
+                               dtype=dense_dtype,
+                               param_dtype=self.param_dtype, name="wte")
+        else:
+            embed = nn.Embed(self.vocab_size, self.hidden,
+                             param_dtype=self.param_dtype, name="wte")
         pos = self.param("wpe", nn.initializers.normal(stddev=0.02),
                          (self.max_seq_len, self.hidden), self.param_dtype)
         if cache is not None:
@@ -469,7 +516,9 @@ class TransformerLM(nn.Module):
             block = block_cls(self.hidden, self.num_heads, self.mlp_ratio,
                               self.dropout, self.dtype, self.param_dtype,
                               self.inference_dtype, self.tp_axis,
-                              self.tp_size, name=f"block_{i}")
+                              self.tp_size,
+                              weight_quant=self.weight_quant,
+                              name=f"block_{i}")
             # quantized cache: this layer's per-head scale pair
             # ([layers, heads] engine arrays sliced at i) — threaded
             # into BOTH inference modes, so monolithic (return_kv)
@@ -504,7 +553,10 @@ class TransformerLM(nn.Module):
             # tied head into the loss (kernels/lm_head_loss.py — the
             # head weight is params["wte"]["embedding"], vocab-major)
             return x
-        # tied LM head; logits in fp32
+        # tied LM head; logits in fp32. Quantized weights: the head's
+        # output channels ARE the vocab rows, so the per-row embedding
+        # scales multiply the logits accumulator in the epilogue —
+        # sliced by the SAME dynamic_slice as the vocab-parallel matrix
         if self.tp_size > 1:
             # vocab-parallel head: each shard matmuls its vocab/tp slice
             # of the replicated embedding (cutting the largest GEMM in a
@@ -516,9 +568,14 @@ class TransformerLM(nn.Module):
                 jnp.asarray(embed.embedding, jnp.float32), idx * vl, vl,
                 axis=0)                                     # [V/tp, H]
             logits = jnp.dot(jnp.asarray(x, jnp.float32), head.T)
+            if self.weight_quant:
+                logits = logits * jax.lax.dynamic_slice_in_dim(
+                    embed.embedding_scale, idx * vl, vl, axis=0)
         else:
             logits = jnp.dot(jnp.asarray(x, jnp.float32),
                              jnp.asarray(embed.embedding, jnp.float32).T)
+            if self.weight_quant:
+                logits = logits * embed.embedding_scale
         if cache is not None or return_kv:
             return logits, (jnp.stack(kv_out[0]), jnp.stack(kv_out[1]))
         return logits

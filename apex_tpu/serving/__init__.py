@@ -64,6 +64,18 @@ generation requests from a fixed set of compiled programs:
   vocab-parallel). ``mesh=None`` stays the verbatim single-chip
   baseline, pinned bitwise against a ``tp=1`` mesh.
 
+- :class:`KVQuantConfig` (:mod:`.kv_quant`) / :class:`WeightQuantConfig`
+  (:mod:`.weight_quant`) — the int8 storage tiers over the two dominant
+  HBM-resident populations, sharing one symmetric-quant core
+  (:mod:`.quant_common`): the KV pool stores int8 with per-``[layer,
+  head]`` scales dequantized inside the attention kernels (~2x
+  concurrency at the same pool bytes), and the serving weights store
+  int8 with per-output-channel scales dequantized in each GEMM's
+  epilogue (~2x model-size headroom vs bf16). Both are params/cache
+  properties, not programs — zero new executables, token-match-rate
+  contracts vs the bf16 oracle, and the ``None`` defaults stay the
+  bitwise baselines.
+
 - :class:`FaultPlan` / :class:`FaultPolicy` / :class:`PoolAuditor`
   (:mod:`.faults`) — fault isolation: a seeded deterministic
   chaos-injection harness (non-finite logits into chosen decode slots,
@@ -131,11 +143,12 @@ from .prefix_cache import PrefixCache, PrefixMatch
 from .router import Router
 from .scheduler import QueueFull, Request, RequestStatus, Scheduler
 from .speculative import DraftWorker, SpecConfig, draft_tokens
+from .weight_quant import WeightQuantConfig
 
 __all__ = ["DraftWorker", "Engine", "FaultPlan", "FaultPolicy",
            "FaultSpec", "HostTier", "InjectedFault", "KVCache",
            "KVQuantConfig", "PagedKVCache", "PagePool", "PendingDecode",
            "PoolAuditor", "PoolInvariantError", "PrefixCache",
            "PrefixMatch", "QueueFull", "Request", "RequestStatus",
-           "Router", "Scheduler", "SpecConfig", "draft_tokens",
-           "sample_tokens", "sharding"]
+           "Router", "Scheduler", "SpecConfig", "WeightQuantConfig",
+           "draft_tokens", "sample_tokens", "sharding"]

@@ -185,6 +185,7 @@ from .kv_cache import KVCache, PagedKVCache, PagePool
 from .kv_quant import KVQuantConfig, quantize
 from .prefix_cache import PrefixCache
 from .speculative import SpecConfig
+from .weight_quant import WeightQuantConfig
 
 __all__ = ["Engine", "PendingDecode", "resolve_page_len",
            "sample_tokens"]
@@ -347,6 +348,26 @@ class Engine:
         default) is the bitwise bf16 baseline — none of the quant code
         is on its trace path. The program set is unchanged either way
         (dequant is fused, never a new executable).
+    weight_quant:
+        A :class:`~apex_tpu.serving.WeightQuantConfig` turning on the
+        quantized WEIGHT storage tier (both layouts; composes with
+        ``kv_quant``, prefix sharing, speculative verify, the async
+        heartbeat, ``host_tier`` and ``mesh=``): the big serving GEMM
+        kernels — qkv, proj, MLP in/out, and the tied vocab head — are
+        stored int8 with per-output-channel fp32 scales, and dequant
+        is the scale multiply folded onto each GEMM's accumulator in
+        the epilogue (:mod:`~apex_tpu.serving.weight_quant`). Roughly
+        halves weight HBM vs bf16; together with ``kv_quant`` the two
+        dominant resident allocations both shrink. A params property,
+        not a program — the compiled-program set and every trace-count
+        pin are unchanged. Calibration is the per-channel absmax of
+        the (policy-cast) weights themselves, resolved HERE with the
+        loud degenerate-channel failure; under a mesh the scales shard
+        with their kernels per the partition-rule table. Greedy output
+        becomes a token-match-rate claim vs the bf16 oracle
+        (``bench_serving.py --quantized-weights``);
+        ``weight_quant=None`` (the default) is the bitwise baseline —
+        none of the quant code is on its trace path.
     host_tier:
         Hierarchical-KV host-DRAM prefix tier (paged only, requires
         ``prefix_pool > 0`` and ``mesh=None``): an int capacity in
@@ -387,6 +408,7 @@ class Engine:
                  num_pages: Optional[int] = None,
                  spec: Optional[SpecConfig] = None, mesh=None,
                  kv_quant: Optional[KVQuantConfig] = None,
+                 weight_quant: Optional[WeightQuantConfig] = None,
                  host_tier=None):
         from apex_tpu.amp.policy import resolve_policy
 
@@ -468,6 +490,16 @@ class Engine:
         else:
             k_scale = v_scale = None
             cache_dtype = half
+        # quantized WEIGHT storage tier (independent of both the
+        # compute half dtype and the cache tier): int8 GEMM kernels
+        # with per-output-channel fp32 scales, dequantized in the
+        # matmul epilogue. A params property, not a program — the
+        # compiled-program set and every trace-count pin are unchanged.
+        self.weight_quant = weight_quant
+        if weight_quant is not None \
+                and not isinstance(weight_quant, WeightQuantConfig):
+            raise TypeError(f"weight_quant must be a WeightQuantConfig, "
+                            f"got {type(weight_quant).__name__}")
         self.mesh = mesh
         if mesh is not None:
             from . import sharding as _sharding
@@ -494,16 +526,42 @@ class Engine:
         clone_kw = {"inference_dtype": half}
         if mesh is not None:
             clone_kw.update(tp_axis=self._tp_axis, tp_size=self.tp)
+        if weight_quant is not None:
+            clone_kw["weight_quant"] = True
         try:
             self._model = model.clone(**clone_kw)
         except TypeError:  # model without the inference_dtype field
-            if mesh is not None:
+            # diagnose by the field actually missing — a tp-capable
+            # model lacking only weight_quant (or vice versa) must be
+            # told about ITS gap, not the other feature's
+            fields = set(getattr(type(model), "__dataclass_fields__",
+                                 ()))
+            if mesh is not None \
+                    and not {"tp_axis", "tp_size"} <= fields:
                 raise TypeError(
                     "Engine(mesh=...) needs a model with tp_axis/"
                     "tp_size fields (the TransformerLM tensor-parallel "
                     "contract)")
+            if weight_quant is not None \
+                    and "weight_quant" not in fields:
+                raise TypeError(
+                    "Engine(weight_quant=...) needs a model with the "
+                    "weight_quant field (the TransformerLM "
+                    "quantized-serving contract)")
+            if mesh is not None or weight_quant is not None:
+                # the fields exist, so the clone failed for some other
+                # reason — degrading to the un-cloned model would
+                # silently drop the requested tier
+                raise
             self._model = model
         self.params = policy.cast_params(params)
+        if weight_quant is not None:
+            # quantize AFTER the policy cast (the absmax measured is
+            # the serving dtype's, so codes reproduce exactly the
+            # values the bf16 GEMM would have loaded) and BEFORE the
+            # mesh placement, so the scale leaves shard with their
+            # kernels under the rule table below
+            self.params = weight_quant.quantize_params(self.params)
         if mesh is not None:
             # permute/scale + place per the partition-rule table; the
             # spec tree below is what the shard_map wrappers split by
@@ -705,6 +763,7 @@ class Engine:
 
         self._emit_tp_gauges()
         self._emit_kv_gauges()
+        self._emit_wq_gauges()
 
     # --------------------------------------------------- tensor parallelism
     def _tp_wrap(self, fn, n_extra_out: int):
@@ -798,6 +857,28 @@ class Engine:
                          float(jnp.max(c.v_scale))) * QMAX
             self._registry.gauge_set("serving.kv.quant_scale_absmax",
                                      absmax)
+
+    def _emit_wq_gauges(self) -> None:
+        """The ``serving.wq.*`` telemetry snapshot of a weight-quantized
+        engine: mean bytes per WEIGHT parameter (total param-tree bytes
+        over weight elements, scale overhead charged in — the basis of
+        the bench's weight-bytes reduction claim; ~2.0 on the bf16
+        default, ~1.0+scales quantized) and the largest absolute weight
+        the calibrated scales can represent (``max(scale) * 127`` — a
+        provenance number: it moves only when the checkpoint or margin
+        does, so a dashboard step flags a silent weight swap).
+        Unquantized engines emit nothing — the family is the tier's
+        liveness signal."""
+        if self._registry is None or self.weight_quant is None:
+            return
+        from .weight_quant import (param_bytes, param_count,
+                                   quant_scale_absmax)
+
+        self._registry.gauge_set(
+            "serving.wq.bytes_per_param",
+            param_bytes(self.params) / param_count(self.params))
+        self._registry.gauge_set("serving.wq.quant_scale_absmax",
+                                 quant_scale_absmax(self.params))
 
     @property
     def compiled_programs(self) -> int:
@@ -2029,6 +2110,7 @@ class Engine:
         self._registry = registry
         self._emit_tp_gauges()
         self._emit_kv_gauges()
+        self._emit_wq_gauges()
 
     def reset(self, clear_prefixes: bool = False) -> None:
         """Zero the serving-slot lengths (slot table wipe; K/V left in

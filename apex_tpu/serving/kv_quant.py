@@ -50,6 +50,12 @@ seeded random ints). An absmax of 0 or a non-finite absmax would
 produce degenerate scales — dequantizing everything to 0 or NaN — so
 :meth:`KVQuantConfig.resolve_scales` raises at ENGINE CONSTRUCTION,
 never letting a degenerate scale surface later as NaN output.
+
+The numeric core — grid, scale resolution, degenerate-absmax guard —
+lives in :mod:`apex_tpu.serving.quant_common`, shared with the weight
+tier (:mod:`apex_tpu.serving.weight_quant`); ``QMAX`` / ``quantize`` /
+``dequantize`` / ``expand_scale`` are re-exported here unchanged, so
+every pre-refactor import keeps working.
 """
 
 from __future__ import annotations
@@ -60,49 +66,11 @@ from typing import Any, Optional, Sequence, Tuple, Union
 import jax.numpy as jnp
 import numpy as np
 
+from .quant_common import (QMAX, check_absmax, dequantize, expand_scale,
+                           quantize, scale_from_absmax)
+
 __all__ = ["KVQuantConfig", "QMAX", "quantize", "dequantize",
            "expand_scale"]
-
-# symmetric int8: +/-127 levels (the -128 code is never produced, so the
-# grid is symmetric and dequantization needs no zero-point)
-QMAX = 127
-
-
-def expand_scale(scale, ndim: int, axis: int):
-    """Broadcast a 1-D ``[heads]`` scale vector to rank ``ndim`` with
-    its dimension at ``axis`` — the shape glue every quantized
-    write/read site shares (callers with ``[layers, heads]`` scales
-    index or broadcast the layers axis themselves)."""
-    scale = jnp.asarray(scale, jnp.float32)
-    if scale.ndim != 1:
-        raise ValueError(f"expand_scale wants a 1-D [heads] scale, got "
-                         f"{scale.shape}")
-    shape = [1] * ndim
-    shape[axis] = scale.shape[0]
-    return scale.reshape(shape)
-
-
-def quantize(x, scale, *, axis: Optional[int] = None):
-    """Symmetric int8 quantization of ``x`` with per-head ``scale``:
-    ``round(x / scale)`` clipped to ``[-QMAX, QMAX]``. With ``axis``,
-    ``scale`` is a 1-D ``[heads]`` vector placed at that axis of ``x``;
-    without it, ``scale`` must already broadcast against ``x`` (the
-    engine's ``[layers, 1, heads, 1, 1]`` prefill shape). The
-    write-site half of the storage tier — K/V go straight from the
-    compute half dtype to int8 cache bytes."""
-    s = jnp.asarray(scale, jnp.float32) if axis is None \
-        else expand_scale(scale, jnp.ndim(x), axis)
-    q = jnp.round(jnp.asarray(x, jnp.float32) / s)
-    return jnp.clip(q, -QMAX, QMAX).astype(jnp.int8)
-
-
-def dequantize(q, scale, *, axis: Optional[int] = None):
-    """Inverse of :func:`quantize` (fp32 out) — the jnp oracle half of
-    dequant-in-kernel: the Pallas kernels fold the same per-head
-    multiply into their block loads instead of materialising this."""
-    s = jnp.asarray(scale, jnp.float32) if axis is None \
-        else expand_scale(scale, jnp.ndim(q), axis)
-    return jnp.asarray(q, jnp.float32) * s
 
 
 def _as_layer_head(value, layers: int, heads: int, what: str):
@@ -240,17 +208,13 @@ class KVQuantConfig:
             k_absmax, v_absmax = self._calibrate(model, params, layers,
                                                  heads)
         for name, absmax in (("K", k_absmax), ("V", v_absmax)):
-            bad = ~np.isfinite(absmax) | (absmax <= 0)
-            if bad.any():
-                lh = np.argwhere(bad)[0]
-                raise ValueError(
-                    f"degenerate {name} calibration absmax at "
-                    f"[layer={int(lh[0])}, head={int(lh[1])}]: "
-                    f"{float(absmax[tuple(lh)])!r} — an absmax of 0 or "
-                    f"a non-finite absmax would produce degenerate "
-                    f"quantization scales (all-zero or NaN "
-                    f"dequantized K/V); fix the calibration sample or "
-                    f"pass an explicit positive calibration_absmax")
-        k_scale = (k_absmax * self.margin / QMAX).astype(np.float32)
-        v_scale = (v_absmax * self.margin / QMAX).astype(np.float32)
+            check_absmax(
+                absmax,
+                describe=lambda lh, n=name: (
+                    f"{n} calibration absmax at [layer={lh[0]}, "
+                    f"head={lh[1]}]"),
+                hint="fix the calibration sample or pass an explicit "
+                     "positive calibration_absmax")
+        k_scale = scale_from_absmax(k_absmax, self.margin)
+        v_scale = scale_from_absmax(v_absmax, self.margin)
         return jnp.asarray(k_scale), jnp.asarray(v_scale)

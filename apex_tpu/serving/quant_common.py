@@ -1,0 +1,115 @@
+"""Shared symmetric int8 quantization core for the serving quant tiers.
+
+Two storage tiers quantize to int8 with fp32 scales — the KV cache
+(:mod:`apex_tpu.serving.kv_quant`, per-``[layer, head]`` scales, PR 10)
+and the serving weights (:mod:`apex_tpu.serving.weight_quant`,
+per-output-channel scales) — and both depend on exactly the same
+numeric core: symmetric linear quantization to ``[-QMAX, QMAX]`` with a
+1-D scale vector broadcast at a chosen axis, ``scale = absmax * margin
+/ QMAX`` resolution, and the LOUD degenerate-absmax guard (an absmax of
+0 would make ``quantize`` divide by ~0 and ``dequantize`` return 0
+everywhere; a non-finite one would poison every consumer — both must
+fail at construction/calibration time, never later as NaN output).
+
+This module is that core, factored out so the tiers cannot drift: the
+grid both quantize on is one implementation, the error bound
+(``scale / 2`` per element for in-range inputs, clipping beyond) is one
+argument, and a fix to the guard reaches both tiers at once. Everything
+here is tier-agnostic — no engine, cache or parameter knowledge.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["QMAX", "check_absmax", "dequantize", "expand_scale",
+           "quantize", "quantize_host", "scale_from_absmax"]
+
+# symmetric int8: +/-127 levels (the -128 code is never produced, so the
+# grid is symmetric and dequantization needs no zero-point)
+QMAX = 127
+
+
+def expand_scale(scale, ndim: int, axis: int):
+    """Broadcast a 1-D scale vector to rank ``ndim`` with its dimension
+    at ``axis`` — the shape glue every quantized write/read site shares
+    (callers with stacked scales — e.g. the KV tier's ``[layers,
+    heads]`` — index or broadcast the extra axes themselves)."""
+    scale = jnp.asarray(scale, jnp.float32)
+    if scale.ndim != 1:
+        raise ValueError(f"expand_scale wants a 1-D scale vector, got "
+                         f"{scale.shape}")
+    shape = [1] * ndim
+    shape[axis] = scale.shape[0]
+    return scale.reshape(shape)
+
+
+def quantize(x, scale, *, axis: Optional[int] = None):
+    """Symmetric int8 quantization of ``x``: ``round(x / scale)``
+    clipped to ``[-QMAX, QMAX]``. With ``axis``, ``scale`` is a 1-D
+    vector placed at that axis of ``x`` (the KV tier's per-head axis,
+    the weight tier's output-channel axis); without it, ``scale`` must
+    already broadcast against ``x``."""
+    s = jnp.asarray(scale, jnp.float32) if axis is None \
+        else expand_scale(scale, jnp.ndim(x), axis)
+    q = jnp.round(jnp.asarray(x, jnp.float32) / s)
+    return jnp.clip(q, -QMAX, QMAX).astype(jnp.int8)
+
+
+def quantize_host(x, scale, *, axis: int) -> np.ndarray:
+    """The pure-numpy twin of :func:`quantize` for construction-time
+    HOST work (weight quantization happens once, before any device
+    placement): same grid, same fp32 math, same round-half-even — but
+    the full-size source leaf never transits a device (at real model
+    sizes that transient is exactly what the sharder's host-copy
+    discipline exists to avoid)."""
+    x = np.asarray(x, np.float32)
+    s = np.asarray(scale, np.float32)
+    shape = [1] * x.ndim
+    shape[axis] = s.shape[0]
+    q = np.round(x / s.reshape(shape))
+    return np.clip(q, -QMAX, QMAX).astype(np.int8)
+
+
+def dequantize(q, scale, *, axis: Optional[int] = None):
+    """Inverse of :func:`quantize` (fp32 out) — the jnp oracle half of
+    dequant-in-kernel/epilogue: consumers fold the same scale multiply
+    into their block loads (attention kernels) or their GEMM epilogues
+    (the weight tier) instead of materialising this."""
+    s = jnp.asarray(scale, jnp.float32) if axis is None \
+        else expand_scale(scale, jnp.ndim(q), axis)
+    return jnp.asarray(q, jnp.float32) * s
+
+
+def check_absmax(absmax, *, describe: Callable[[Tuple[int, ...]], str],
+                 hint: str) -> np.ndarray:
+    """The loud degenerate-calibration guard both tiers share: raise
+    :class:`ValueError` when any entry of ``absmax`` is zero, negative
+    or non-finite. ``describe`` formats the first offending index into
+    the tier's own coordinates (``[layer, head]`` / ``output channel``)
+    and ``hint`` names the tier's remedy. Returns ``absmax`` as a
+    float32 numpy array for the caller's scale resolution."""
+    absmax = np.asarray(absmax, np.float32)
+    bad = ~np.isfinite(absmax) | (absmax <= 0)
+    if bad.any():
+        idx = tuple(int(i) for i in np.argwhere(bad)[0])
+        raise ValueError(
+            f"degenerate {describe(idx)}: {float(absmax[idx])!r} — an "
+            f"absmax of 0 or a non-finite absmax would produce "
+            f"degenerate quantization scales (all-zero or NaN "
+            f"dequantized values); {hint}")
+    return absmax
+
+
+def scale_from_absmax(absmax, margin: float) -> np.ndarray:
+    """The one scale resolution both tiers pin their numerics to:
+    ``scale = absmax * margin / QMAX`` (fp32). ``margin`` is headroom
+    on the calibrated absmax for the KV tier (decode-time values can
+    exceed a prompt-sample absmax); the weight tier's absmax is exact
+    (weights are static), so its margin only sets the clip-vs-grid
+    trade — each tier documents and pins its own default."""
+    return (np.asarray(absmax, np.float32)
+            * np.float32(margin) / QMAX).astype(np.float32)

@@ -74,8 +74,12 @@ __all__ = ["partition_rules", "match_partition_rules", "shard_params",
            "expected_collectives", "tp_axis_of", "validate_tp_geometry"]
 
 # host-side transforms a plain even split cannot express, keyed by the
-# SAME regexes the rule table uses (see shard_params)
-_QKV_RE = re.compile(r"attn/qkv/(kernel|bias)$")
+# SAME regexes the rule table uses (see shard_params). kernel_scale is
+# the weight-quant tier's per-output-channel dequant vector: it lives
+# on the qkv OUTPUT axis, so it rides the same head-group permutation
+# as the kernel and bias — every local channel keeps its own scale,
+# which is what makes tp=1 bitwise vs the unsharded quantized engine.
+_QKV_RE = re.compile(r"attn/qkv/(kernel|bias|kernel_scale)$")
 _ROW_BIAS_RE = re.compile(r"(attn/proj|mlp_out)/bias$")
 
 
@@ -89,16 +93,29 @@ def partition_rules(axis: str = "tp") -> Tuple[Tuple[str, PartitionSpec],
     replicated so the lookup is collective-free; the logits are sliced
     vocab-parallel *in-program* instead — see the module docstring)."""
     P = PartitionSpec
+    # kernel_scale leaves are the weight-quant tier's per-output-channel
+    # dequant vectors: column-parallel kernels split on the OUTPUT axis,
+    # so their scales split with them (qkv's additionally head-group
+    # permuted — see _QKV_RE); row-parallel kernels split on the INPUT
+    # axis, so their per-output scales replicate (the scale is constant
+    # across shards, which is exactly why scaling each partial sum
+    # before the psum is exact). wte's embedding_scale replicates with
+    # the embedding via the catch-all; the vocab-parallel head slices
+    # matrix and scale together in-program.
     return (
         (r"attn/qkv/kernel$", P(None, axis)),   # column-parallel (heads)
         (r"attn/qkv/bias$", P(axis)),
+        (r"attn/qkv/kernel_scale$", P(axis)),
         (r"attn/proj/kernel$", P(axis, None)),  # row-parallel
         (r"attn/proj/bias$", P()),              # replicated, scaled 1/tp
+        (r"attn/proj/kernel_scale$", P()),      # replicated (row-par.)
         (r"mlp_in/kernel$", P(None, axis)),     # column-parallel
         (r"mlp_in/bias$", P(axis)),
+        (r"mlp_in/kernel_scale$", P(axis)),
         (r"mlp_out/kernel$", P(axis, None)),    # row-parallel
         (r"mlp_out/bias$", P()),                # replicated, scaled 1/tp
-        (r".*", P()),   # wte/wpe/LayerNorms/ln_f: replicated
+        (r"mlp_out/kernel_scale$", P()),        # replicated (row-par.)
+        (r".*", P()),   # wte(+scale)/wpe/LayerNorms/ln_f: replicated
     )
 
 

@@ -47,7 +47,12 @@ devices before any backend initializes, and a nested ``quantized_kv``
 sub-object (BENCH_SERVING_QUANT=0 to drop it): the int8-capacity leg
 — KV-bytes-per-token reduction, concurrency both modes,
 ``token_match_rate`` vs the bf16 oracle — via
-``bench_serving.quantized_kv_stats``, and a nested
+``bench_serving.quantized_kv_stats``, a nested
+``quantized_weights`` sub-object (BENCH_SERVING_WQUANT=0 to drop it):
+the int8-weights leg — weight-bytes reduction, bytes-per-param,
+HBM-bytes-per-request bf16 vs the combined weights+KV tier,
+``token_match_rate`` both quantized modes vs the bf16 oracle — via
+``bench_serving.quantized_weights_stats``, and a nested
 ``async_heartbeat`` sub-object (BENCH_SERVING_ASYNC=0 to drop it):
 sync vs dispatch-ahead pipelined serving on one engine — heartbeat
 wall per emitted token, duty cycle, ``token_mismatched_requests``
@@ -185,6 +190,15 @@ _SERVING_QUANT_SMOKE = {
     "PREFILL_LEN": 32, "REQUESTS": 6, "NEW_TOKENS": 8, "WINDOWS": 1,
 }
 
+# The quantized-weights sub-leg's smoke geometry (the shared-prefix
+# stream served THREE times — bf16 oracle, int8 weights, int8 weights
+# + int8 KV — at identical geometry, so it matches its siblings'
+# sizing; env knobs still win, env-beats-smoke)
+_SERVING_WQUANT_SMOKE = {
+    "SIZE": "tiny", "VOCAB": 512, "SLOTS": 4, "MAX_LEN": 128,
+    "PREFILL_LEN": 32, "REQUESTS": 6, "NEW_TOKENS": 8, "WINDOWS": 1,
+}
+
 # The async-heartbeat sub-leg's smoke geometry (the stream is served
 # twice — sync oracle + dispatch-ahead). Sized LONGER than its
 # siblings on purpose: pipelining pays fixed fill/drain beats per
@@ -246,6 +260,7 @@ def _serving_leg() -> dict:
         out["speculative"] = _serving_spec_leg()
         out["tensor_parallel"] = _serving_tp_leg()
         out["quantized_kv"] = _serving_quant_leg()
+        out["quantized_weights"] = _serving_wquant_leg()
         out["async_heartbeat"] = _serving_async_leg()
         out["replica_router"] = _serving_router_leg()
         out["host_tier"] = _serving_host_tier_leg()
@@ -330,6 +345,38 @@ def _serving_quant_leg() -> dict:
             "max_concurrent_requests", "max_concurrent_requests_bf16",
             "slots", "slots_bf16", "pool_mib", "quant_scale_absmax",
             "model")}
+    except KeyboardInterrupt:
+        raise
+    except BaseException as e:  # noqa: BLE001 — the row must not die here
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def _serving_wquant_leg() -> dict:
+    """The quantized-weights trajectory sub-row: smoke-sized
+    int8-weights summary (bf16 oracle vs int8 weights vs int8 weights
+    + int8 KV at identical geometry — weight-bytes reduction,
+    bytes-per-param, HBM-bytes-per-request, greedy token-match-rate
+    both quantized modes) from ``bench_serving.quantized_weights_
+    stats``. BENCH_SERVING_WQUANT=0 drops it; failure-isolated like
+    its siblings — a broken weight tier yields {"error": ...} here,
+    never a lost serving (or ResNet) row."""
+    if _env_int("BENCH_SERVING_WQUANT", "1") == 0:
+        return {"skipped": True}
+    try:
+        import bench_serving
+
+        bench_serving._load_env(smoke=dict(_SERVING_WQUANT_SMOKE))
+        _, summary = bench_serving.quantized_weights_stats()
+        return {k: summary[k] for k in (
+            "value", "unit", "baseline_tokens_per_s",
+            "combined_tokens_per_s", "token_match_rate",
+            "token_mismatched_requests", "combined_token_match_rate",
+            "combined_token_mismatched_requests", "weight_mib",
+            "weight_mib_bf16", "weight_bytes_reduction_pct",
+            "bytes_per_param", "bytes_per_param_bf16",
+            "hbm_bytes_per_request", "hbm_bytes_per_request_bf16",
+            "hbm_bytes_per_request_reduction_pct",
+            "quant_scale_absmax", "model")}
     except KeyboardInterrupt:
         raise
     except BaseException as e:  # noqa: BLE001 — the row must not die here

@@ -154,6 +154,28 @@ CPU-honest columns, tokens/s is the TPU rows' claim (half the cache
 DMA per attended token). Defaults to a smoke geometry; env knobs
 resize it (env-beats-smoke).
 
+``--quantized-weights`` runs the int8-weights leg: the shared-prefix
+greedy stream served THREE ways at IDENTICAL engine geometry — bf16
+weights (``weight_quant=None``, the bitwise oracle), int8 weights
+(``WeightQuantConfig()``: per-output-channel fp32 scales, dequant
+folded into the GEMM epilogues — zero new compiled programs), and
+int8 weights + int8 KV (the combined tier; ``kv_quant`` calibrated on
+the shared prefix). One row per mode plus a final line whose payoff
+fields are ``weight_bytes_reduction_pct`` (the >= 45% acceptance bar;
+~49% at the ``small`` shape), ``bytes_per_param`` both modes (scale
+overhead charged in), ``hbm_bytes_per_request`` bf16 vs combined (the
+int8 cache halves it again on top of the weight cut),
+``quant_scale_absmax`` (the grid's representable range — a provenance
+number for weights), and ``token_match_rate`` /
+``combined_token_match_rate`` — positionwise greedy agreement vs the
+bf16 oracle (the TOLERANCE contract; ``weight_quant=None`` stays
+bitwise). Throughput regime note: the reference-path GEMMs dequantize
+by materialising on the CPU fallback, so quantized tokens/s reads
+flat here — weight bytes, per-request bytes and match-rate are the
+CPU-honest columns, tokens/s is the TPU rows' claim (half the weight
+DMA per GEMM, int8 MXU issue where hardware has it). Defaults to a
+smoke geometry; env knobs resize it (env-beats-smoke).
+
 ``--async-heartbeat`` runs the dispatch-ahead leg: the SAME seeded
 greedy stream served twice on one engine — synchronously
 (``pipeline_depth=0``, the bitwise oracle) and pipelined
@@ -250,6 +272,7 @@ CHAOS_METRIC = "serving_chaos_goodput_tokens_per_sec"
 SPEC_METRIC = "serving_speculative_tokens_per_sec"
 TP_METRIC = "serving_tensor_parallel_tokens_per_sec"
 QUANT_METRIC = "serving_quantized_kv_tokens_per_sec"
+WQUANT_METRIC = "serving_quantized_weights_tokens_per_sec"
 ASYNC_METRIC = "serving_async_heartbeat_tokens_per_sec"
 ROUTER_METRIC = "serving_replica_router_tokens_per_sec"
 HOST_METRIC = "serving_host_tier_tokens_per_sec"
@@ -314,6 +337,14 @@ QUANT_SLOTS = 0
 QUANT_SMOKE = {"SIZE": "tiny", "VOCAB": 512, "SLOTS": 4, "MAX_LEN": 128,
                "PREFILL_LEN": 32, "REQUESTS": 8, "NEW_TOKENS": 12,
                "WINDOWS": 1}
+# --quantized-weights leg: the shared-prefix stream at IDENTICAL
+# geometry three times (bf16 oracle, int8 weights, int8 weights + int8
+# KV) — weight quantization changes param bytes, not pool geometry, so
+# unlike --quantized-kv nothing resizes; the smoke preset matches its
+# sibling's
+WQUANT_SMOKE = {"SIZE": "tiny", "VOCAB": 512, "SLOTS": 4,
+                "MAX_LEN": 128, "PREFILL_LEN": 32, "REQUESTS": 8,
+                "NEW_TOKENS": 12, "WINDOWS": 1}
 # --async-heartbeat leg: in-flight decode steps (pipeline_depth for the
 # pipelined mode; the sync mode is always depth 0) and its smoke
 # preset — the leg serves the SAME stream in both modes on one engine,
@@ -1381,6 +1412,132 @@ def main_quant():
     print(json.dumps(summary))
 
 
+def quantized_weights_stats():
+    """The --quantized-weights measurement, reusable by bench.py's
+    serving trajectory leg: the shared-prefix greedy stream served
+    THREE ways at IDENTICAL engine geometry — bf16 weights
+    (``weight_quant=None``, the bitwise oracle), int8 weights
+    (``WeightQuantConfig()``: per-output-channel scales, dequant in
+    the GEMM epilogues), and int8 weights + int8 KV (the combined
+    tier, ``kv_quant`` calibrated on the shared prefix). Headline
+    fields: ``weight_bytes_reduction_pct`` (the >= 45% acceptance
+    bar), ``bytes_per_param`` both modes (scale overhead charged in),
+    ``hbm_bytes_per_request`` bf16 vs combined (the KV half of the
+    combined claim), and ``token_match_rate`` /
+    ``combined_token_match_rate`` — positionwise greedy agreement vs
+    the bf16 oracle (the tolerance contract; ``weight_quant=None``
+    stays bitwise). CPU-regime caveat: the reference-path GEMMs
+    dequantize by materialising, so quantized tokens/s reads flat
+    here — weight bytes, per-request bytes and match-rate are the
+    leg's claim; tokens/s is the TPU rows' (half the weight DMA per
+    GEMM)."""
+    from apex_tpu import telemetry
+    from apex_tpu.serving import KVQuantConfig, WeightQuantConfig
+    from apex_tpu.serving.weight_quant import (param_bytes, param_count,
+                                               quant_scale_absmax)
+
+    rng0 = np.random.default_rng(7)
+    shared_len = min(SHARED_PREFIX, PREFILL_LEN - 1)
+    shared = rng0.integers(1, VOCAB, size=shared_len).tolist()
+    kv_cfg = KVQuantConfig(calibration_tokens=list(shared))
+    modes = {
+        "bf16": {},
+        "int8w": {"weight_quant": WeightQuantConfig()},
+        "int8w_int8kv": {"weight_quant": WeightQuantConfig(),
+                         "kv_quant": kv_cfg},
+    }
+    rows, outputs = {}, {}
+    for mode, kw in modes.items():
+        rate, reqs, engine, peak_inflight, _pages = _serve_paged_leg(
+            True, SLOTS, None,
+            requests_fn=lambda r: _shared_prefix_requests(r, shared),
+            seed=6, retain_prefixes=True, prefix_pool=PREFIX_POOL, **kw)
+        reg = telemetry.MetricsRegistry()
+        engine.set_registry(reg)
+        gauges = reg.snapshot()["gauges"]
+        per_pos = engine.cache.nbytes() \
+            / (engine.num_pages * engine.page_len)
+        demands = [engine.pages_required(len(r.prompt),
+                                         r.max_new_tokens)
+                   * engine.page_len for r in reqs]
+        w_bytes = param_bytes(engine.params)
+        rows[mode] = {
+            "metric": f"{WQUANT_METRIC}.{mode}",
+            "value": round(rate, 2),
+            "unit": "tokens/s",
+            "slots": engine.slots,
+            "weight_mib": round(w_bytes / 2**20, 3),
+            "bytes_per_param": round(
+                w_bytes / param_count(engine.params), 3),
+            "cache_dtype": np.dtype(engine.cache.dtype).name,
+            "kv_bytes_per_token":
+                int(gauges["serving.kv.bytes_per_token"]),
+            "hbm_bytes_per_request": round(float(np.mean(demands))
+                                           * per_pos),
+            "max_concurrent_requests": peak_inflight,
+            "compiled_programs": engine.compiled_programs,
+        }
+        if "weight_quant" in kw:
+            rows[mode]["quant_scale_absmax"] = round(
+                quant_scale_absmax(engine.params), 4)
+        outputs[mode] = [list(r.output_tokens) for r in reqs]
+
+    def _match(mode):
+        tot = hit = mismatched = 0
+        for a, b in zip(outputs["bf16"], outputs[mode]):
+            tot += max(len(a), len(b))
+            hit += sum(int(x == y) for x, y in zip(a, b))
+            mismatched += int(a != b)
+        return (hit / tot if tot else 1.0), mismatched
+
+    rate_w, mism_w = _match("int8w")
+    rate_c, mism_c = _match("int8w_int8kv")
+    bf, w8, c8 = rows["bf16"], rows["int8w"], rows["int8w_int8kv"]
+    summary = {
+        "metric": WQUANT_METRIC,
+        "value": w8["value"],
+        "unit": "tokens/s",
+        "baseline_tokens_per_s": bf["value"],
+        "combined_tokens_per_s": c8["value"],
+        "token_match_rate": round(rate_w, 4),
+        "token_mismatched_requests": mism_w,
+        "combined_token_match_rate": round(rate_c, 4),
+        "combined_token_mismatched_requests": mism_c,
+        "weight_mib": w8["weight_mib"],
+        "weight_mib_bf16": bf["weight_mib"],
+        "weight_bytes_reduction_pct": round(
+            (1.0 - w8["weight_mib"] / bf["weight_mib"]) * 100.0, 1)
+        if bf["weight_mib"] else 0.0,
+        "bytes_per_param": w8["bytes_per_param"],
+        "bytes_per_param_bf16": bf["bytes_per_param"],
+        "hbm_bytes_per_request": c8["hbm_bytes_per_request"],
+        "hbm_bytes_per_request_bf16": bf["hbm_bytes_per_request"],
+        "hbm_bytes_per_request_reduction_pct": round(
+            (1.0 - c8["hbm_bytes_per_request"]
+             / bf["hbm_bytes_per_request"]) * 100.0, 1)
+        if bf["hbm_bytes_per_request"] else 0.0,
+        "quant_scale_absmax": w8["quant_scale_absmax"],
+        "slots": w8["slots"],
+        "windows": WINDOWS,
+        "requests_per_window": REQUESTS,
+        "shared_prefix_len": shared_len,
+        "model": SIZE,
+    }
+    return rows, summary
+
+
+def main_wquant():
+    import jax
+
+    _load_env(smoke=dict(WQUANT_SMOKE))
+
+    rows, summary = quantized_weights_stats()
+    for mode in ("bf16", "int8w", "int8w_int8kv"):
+        print(json.dumps(rows[mode]))
+    summary["backend"] = jax.default_backend()
+    print(json.dumps(summary))
+
+
 def _ensure_cpu_devices(n: int) -> None:
     """Force the CPU backend with >= ``n`` emulated devices BEFORE the
     first backend initialization (XLA reads ``XLA_FLAGS`` when a client
@@ -2028,6 +2185,8 @@ if __name__ == "__main__":
         guard_bench_main(main_tp, TP_METRIC)
     elif "--quantized-kv" in sys.argv[1:]:
         guard_bench_main(main_quant, QUANT_METRIC)
+    elif "--quantized-weights" in sys.argv[1:]:
+        guard_bench_main(main_wquant, WQUANT_METRIC)
     elif "--async-heartbeat" in sys.argv[1:]:
         guard_bench_main(main_async, ASYNC_METRIC)
     elif "--replica-router" in sys.argv[1:]:

@@ -56,7 +56,9 @@ PAGES = {
     "telemetry": ["apex_tpu.telemetry", "apex_tpu.telemetry.sinks",
                   "apex_tpu.telemetry.summarize", "apex_tpu.log_util"],
     "serving": ["apex_tpu.serving", "apex_tpu.serving.kv_cache",
+                "apex_tpu.serving.quant_common",
                 "apex_tpu.serving.kv_quant",
+                "apex_tpu.serving.weight_quant",
                 "apex_tpu.serving.engine",
                 "apex_tpu.serving.sharding",
                 "apex_tpu.serving.prefix_cache",

@@ -37,6 +37,27 @@ by lint: the set of fault/watchdog/spec/tp/kv/heartbeat/router/swap
 metric literals in ``apex_tpu/serving/`` source must EQUAL the set
 named in the docs' tables.
 
+The ``serving.wq.*`` family joined with the quantized-weights
+tentpole: ``bytes_per_param`` is the weight-capacity claim's basis and
+the family's absence on an engine is the signal the tier is OFF — both
+gauges going dark would make a quantized fleet indistinguishable from
+a bf16 one on every dashboard.
+
+This file also owns the **eager-gather shape lint** (the PR 13 gotcha,
+generalized): an eager ``pool[:, idx_list]`` fancy-index gather over
+the device KV pool compiles ONE executable PER INDEX-COUNT — a serving
+path whose index list length is data-dependent (per-prefix page
+counts) silently recompiles ~165 ms mid-serve the first time an unseen
+length appears, wrecking latency percentiles while every parity test
+stays green (the bytes are right, only the wall-clock rots). The fix
+is always the same: pad the index list to a fixed bound (the page-0
+sentinel absorbs padding) so one shape serves all sizes. The lint
+AST-scans ``apex_tpu/serving/`` for fancy-index gathers over the pool
+arrays and pins the site set to exactly the allowlisted PADDED ones
+(both host_tier swap directions), so every new gather must either pad
+and join the allowlist deliberately or take a compiled fixed-shape
+path.
+
 This file also owns the **force-early lint**: the dispatch-ahead
 region of ``scheduler.py`` (everything between a decode dispatch and
 its reconcile) must never force a device value to host — no ``int()``
@@ -71,7 +92,7 @@ DOC = os.path.join(ROOT, "docs", "serving.md")
 # "serving.router.replica", which is exactly the namespacing contract
 # the docs must name.
 _PAT = re.compile(
-    r"serving\.(?:faults|watchdog|spec|tp|kv|heartbeat|router|swap)"
+    r"serving\.(?:faults|watchdog|spec|tp|kv|wq|heartbeat|router|swap)"
     r"\.[a-z0-9_]+")
 
 
@@ -128,6 +149,14 @@ def test_scan_surface_is_alive():
         assert engine_py in emitted.get(name, []), \
             f"{name} not emitted by the engine — batched-verify/tp/" \
             "quantized-kv telemetry went dark"
+    # the quantized-weights family: the bytes-per-param capacity gauge
+    # and the scale-provenance gauge are engine-emitted (and double as
+    # the tier's liveness signal — unquantized engines emit neither)
+    for name in ("serving.wq.bytes_per_param",
+                 "serving.wq.quant_scale_absmax"):
+        assert engine_py in emitted.get(name, []), \
+            f"{name} not emitted by the engine — quantized-weights " \
+            "telemetry went dark"
     # the async-heartbeat family: the host-think/device-wait split and
     # the speculated-finality rollback counter are scheduler-emitted
     for name in ("serving.heartbeat.host_s",
@@ -243,3 +272,101 @@ def test_dispatch_ahead_region_never_forces_to_host():
         "host on in-flight device work, the exact stall the async "
         "heartbeat exists to remove. Move the read to "
         "_reconcile_oldest (the one batched readback site).")
+
+
+# ---------------------------------------------- the eager-gather shape lint
+# Fancy-index gathers over the device KV pool arrays that are ALLOWED
+# because their index operand is padded to a fixed bound (max_pages,
+# page-0 sentinel absorbing the padding) so one compiled shape serves
+# every entry size: the two host_tier swap-out reads. Keyed
+# (file, function, gathered-array) so a refactor that moves or renames
+# a site re-reviews its padding deliberately.
+_PADDED_GATHERS_ALLOWED = {
+    ("engine.py", "_swap_out_pages", "self.cache.k"),
+    ("engine.py", "_swap_out_pages", "self.cache.v"),
+}
+
+
+def _attr_chain(node):
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    else:
+        parts.append("<expr>")
+    return ".".join(reversed(parts))
+
+
+def _is_fancy_index(idx):
+    """True when any element of the subscript is a VARIABLE index
+    (Name/List/expression) rather than a slice or constant — the shape
+    of a gather whose compiled shape follows the index length. Slices
+    with variable bounds stay legal (their shapes are per-engine
+    constants like ``[:slots]``, not per-call data)."""
+    elts = idx.elts if isinstance(idx, ast.Tuple) else [idx]
+    for e in elts:
+        if isinstance(e, (ast.Slice, ast.Constant)):
+            continue
+        if isinstance(e, ast.UnaryOp) \
+                and isinstance(e.operand, ast.Constant):
+            continue
+        return True
+    return False
+
+
+def _pool_gather_sites():
+    """Every fancy-index READ of a pool array (attribute chain ending
+    in ``.k`` / ``.v`` — the device K/V pools; ``.at[...]`` functional
+    updates are excluded, they live inside compiled bodies with
+    fixed-shape operands) under apex_tpu/serving/, attributed to its
+    INNERMOST enclosing function."""
+    sites = set()
+    for path in glob.glob(os.path.join(SRC_DIR, "**", "*.py"),
+                          recursive=True):
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=path)
+        funcs = [n for n in ast.walk(tree)
+                 if isinstance(n, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef))]
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Subscript)
+                    and isinstance(node.ctx, ast.Load)):
+                continue
+            chain = _attr_chain(node.value)
+            if not chain.split(".")[-1] in ("k", "v"):
+                continue
+            if not _is_fancy_index(node.slice):
+                continue
+            enclosing = [fn for fn in funcs
+                         if fn.lineno <= node.lineno
+                         <= (fn.end_lineno or fn.lineno)]
+            fname = max(enclosing, key=lambda fn: fn.lineno).name \
+                if enclosing else "<module>"
+            sites.add((os.path.basename(path), fname, chain))
+    return sites
+
+
+def test_pool_gathers_are_exactly_the_padded_allowlist():
+    """Every fancy-index gather over the device pool arrays must be an
+    allowlisted PADDED site: an unpadded one compiles a new executable
+    per index length — the ~165 ms per-shape mid-serve recompile trap
+    (PR 13) that no parity test can see. Set EQUALITY both directions:
+    a new gather fails until it pads its index to a fixed bound and
+    joins the allowlist deliberately, and a removed/renamed allowlist
+    entry fails so the lint never rots into scanning nothing."""
+    sites = _pool_gather_sites()
+    new = sites - _PADDED_GATHERS_ALLOWED
+    assert not new, (
+        f"unreviewed fancy-index gathers over the device KV pool: "
+        f"{sorted(new)} — an index list whose length is data-dependent "
+        "recompiles a fresh executable per length mid-serve (~165 ms "
+        "each, PR 13). Pad the index to a fixed bound (page-0 sentinel "
+        "absorbs padding) and add the site to "
+        "_PADDED_GATHERS_ALLOWED with the padding in place.")
+    stale = _PADDED_GATHERS_ALLOWED - sites
+    assert not stale, (
+        f"allowlisted pool-gather sites no longer found (moved or "
+        f"renamed — re-review their padding and update the "
+        f"allowlist): {sorted(stale)}")

@@ -193,6 +193,32 @@ def test_quantized_kv_owes_the_tables_no_new_keys():
                         "prefill_attention.py") in scanned
 
 
+def test_quantized_weights_owe_the_tables_no_new_keys():
+    """The quantized-weights satellite, in the quantized-KV pattern:
+    dequantization is FOLDED into the existing GEMMs' epilogues (a
+    per-output-channel scale multiply on the accumulator — no new
+    kernel, grid or block shape), so the int8 weight tier introduces NO
+    new ``decode.*`` table key. Any ``decode.wq_*`` / ``decode.weight_*``
+    row would be a dead sweep, named loudly here; and the lint's scan
+    must cover weight_quant.py and the shared quant core so any key a
+    future dedicated int8-GEMM kernel DOES reference gets the
+    existence/staleness treatment automatically."""
+    table = _table_keys()
+    stale_wq = {k for k in table
+                if k.startswith(("decode.wq_", "decode.weight_"))}
+    assert not stale_wq, (
+        f"tuned tables carry quantized-weight keys but the int8 tier "
+        f"folds dequant into the existing GEMM epilogues: {stale_wq}")
+    scanned = {os.path.relpath(p, ROOT)
+               for d in SCAN_DIRS
+               for p in glob.glob(os.path.join(d, "**", "*.py"),
+                                  recursive=True)}
+    assert os.path.join("apex_tpu", "serving",
+                        "weight_quant.py") in scanned
+    assert os.path.join("apex_tpu", "serving",
+                        "quant_common.py") in scanned
+
+
 def test_host_tier_owes_the_tables_no_new_keys():
     """The hierarchical-KV satellite, in the copy-program pattern: the
     host tier is pure data movement — swap-out is a forced device read
