@@ -88,18 +88,26 @@ generation requests from a fixed set of compiled programs:
   Un-faulted greedy requests stay bitwise identical to a fault-free
   run; containment adds ZERO compiled programs.
 
-- :class:`HostTier` (:mod:`.host_tier`) — hierarchical KV
-  (``Engine(host_tier=<bytes>)``, paged + ``prefix_pool > 0``): a
-  bounded host-DRAM arena behind the page pool. A prefix entry evicted
-  under pool pressure has its page bytes copied device→host (int8
-  under ``kv_quant`` — half the transfer) instead of being destroyed,
-  stays matchable/probeable in the *swapped* state, and a later hit
-  migrates the bytes back through ONE extra compiled program
-  (a fixed-shape page-block scatter) before copy-on-write sharing as
-  usual. CRC-verified: a corrupt/missing swap-in degrades to a
-  verified miss (re-prefill), never a wrong token — hit-after-swap
-  greedy streams are bitwise identical to never-swapped ones, and
-  prefix capacity is bounded by host RAM, not HBM.
+- :class:`HostTier` / :class:`SwapWorker` (:mod:`.host_tier`) —
+  hierarchical KV (``Engine(host_tier=<bytes>)``, paged +
+  ``prefix_pool > 0``; composes with ``mesh=``): a bounded host-DRAM
+  arena behind the page pool. A prefix entry evicted under pool
+  pressure has its page bytes migrated device→host (int8 under
+  ``kv_quant`` — half the transfer) instead of being destroyed — by
+  default ASYNCHRONOUSLY: the admission path only dispatches a
+  fixed-shape compiled gather (the snapshot rides program order) and
+  a worker thread forces/checksums/stores the bytes off the hot path,
+  the entry staying matchable in the *swapping* → *swapped* states (a
+  hit racing its own swap JOINS the copy; ``sync_swap=True`` is the
+  measurable inline baseline). A later hit migrates the bytes back
+  through the other fixed-shape compiled program (a page-block
+  scatter) before copy-on-write sharing as usual; under a mesh both
+  swap programs shard over the pool's heads axis with ZERO
+  collectives and arena records carry per-shard CRCs. CRC-verified:
+  a corrupt/missing swap-in degrades to a verified miss (re-prefill),
+  never a wrong token — hit-after-swap greedy streams are bitwise
+  identical to never-swapped ones, async or sync, and prefix capacity
+  is bounded by host RAM, not HBM.
 
 - :class:`Router` (:mod:`.router`) — replica-parallel serving (tp × dp
   scale-out): N ``Scheduler``+``Engine`` replicas behind one
@@ -136,7 +144,7 @@ from . import sharding
 from .engine import Engine, PendingDecode, sample_tokens
 from .faults import (FaultPlan, FaultPolicy, FaultSpec, InjectedFault,
                      PoolAuditor, PoolInvariantError)
-from .host_tier import HostTier
+from .host_tier import HostTier, SwapWorker
 from .kv_cache import KVCache, PagedKVCache, PagePool
 from .kv_quant import KVQuantConfig
 from .prefix_cache import PrefixCache, PrefixMatch
@@ -150,5 +158,6 @@ __all__ = ["DraftWorker", "Engine", "FaultPlan", "FaultPolicy",
            "KVQuantConfig", "PagedKVCache", "PagePool", "PendingDecode",
            "PoolAuditor", "PoolInvariantError", "PrefixCache",
            "PrefixMatch", "QueueFull", "Request", "RequestStatus",
-           "Router", "Scheduler", "SpecConfig", "WeightQuantConfig",
-           "draft_tokens", "sample_tokens", "sharding"]
+           "Router", "Scheduler", "SpecConfig", "SwapWorker",
+           "WeightQuantConfig", "draft_tokens", "sample_tokens",
+           "sharding"]

@@ -170,7 +170,9 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import threading
 import time
+import weakref
 from typing import Any, Optional, Sequence
 
 import jax
@@ -180,7 +182,7 @@ import numpy as np
 from apex_tpu.kernels import vmem
 from apex_tpu.log_util import get_logger
 
-from .host_tier import HostTier
+from .host_tier import HostTier, SwapWorker
 from .kv_cache import KVCache, PagedKVCache, PagePool
 from .kv_quant import KVQuantConfig, quantize
 from .prefix_cache import PrefixCache
@@ -370,23 +372,43 @@ class Engine:
         none of the quant code is on its trace path.
     host_tier:
         Hierarchical-KV host-DRAM prefix tier (paged only, requires
-        ``prefix_pool > 0`` and ``mesh=None``): an int capacity in
-        BYTES, or a pre-built :class:`~apex_tpu.serving.HostTier`.
+        ``prefix_pool > 0``; composes with ``mesh=``): an int capacity
+        in BYTES, or a pre-built :class:`~apex_tpu.serving.HostTier`.
         When set, a prefix entry evicted under pool pressure has its
-        page bytes copied device→host into the bounded arena instead
+        page bytes migrated device→host into the bounded arena instead
         of being destroyed (int8 under ``kv_quant`` — half the
-        transfer bytes), stays matchable in the *swapped* state, and
-        a later hit migrates the bytes back into freshly allocated
-        pages through ONE extra compiled program (``swap_in``: a
-        fixed-shape page-block scatter, one dispatch per swap-in — no
-        attention, no sampling, no PRNG) before copy-on-write sharing
-        as usual. Restored pages
-        are byte-exact (CRC-verified; a corrupt/missing swap-in
-        degrades to a verified miss and a re-prefill, never a wrong
-        token), so a hit-after-swap greedy stream is bitwise identical
-        to a never-swapped one, and prefix capacity is bounded by host
-        RAM instead of device HBM. ``None`` (default) keeps today's
-        destroy-on-evict behaviour and traces nothing extra.
+        transfer bytes). Swap-out is ASYNCHRONOUS by default: the
+        admission path only DISPATCHES a fixed-shape compiled gather
+        (``swap_out`` — the pool-byte snapshot is taken at dispatch,
+        before the freed pages can be reused) and hands the un-forced
+        device blocks to a :class:`~apex_tpu.serving.SwapWorker`
+        thread, which forces, checksums and stores them off the hot
+        path; the entry sits matchable in the *swapping* state
+        meanwhile, and a hit racing its own swap-out JOINS the
+        in-flight copy (never reads partial bytes). A later hit
+        migrates the bytes back through the other compiled program
+        (``swap_in``: a fixed-shape page-block scatter, one dispatch
+        per swap-in — no attention, no sampling, no PRNG) before
+        copy-on-write sharing as usual. Restored pages are byte-exact
+        (per-shard CRC-verified; a corrupt/missing swap-in degrades to
+        a verified miss and a re-prefill, never a wrong token), so a
+        hit-after-swap greedy stream is bitwise identical to a
+        never-swapped one — asynchronously or not — and prefix
+        capacity is bounded by host RAM instead of device HBM. Under
+        a ``mesh`` both swap programs run shard_map'd with the pool's
+        heads-axis sharding — each shard gathers/scatters its own
+        ``heads/tp`` slice, ZERO collectives (pure data movement) —
+        and arena records carry one CRC per shard. ``None`` (default)
+        keeps today's destroy-on-evict behaviour and traces nothing
+        extra.
+    sync_swap:
+        Escape hatch (``host_tier`` only): True forces the PRE-ASYNC
+        behaviour — swap-out forces the gathered bytes to host and
+        stores them inline on the admission path (no worker thread).
+        The emitted token streams are bitwise identical either way
+        (pinned); the hatch exists for debugging and as the bench's
+        measurable baseline (``serving.swap.admit_stall_s`` sync vs
+        async is the admission-stall claim).
     top_k:
         Static top-k truncation for sampled (non-greedy) slots; 0 = off.
     registry:
@@ -409,7 +431,7 @@ class Engine:
                  spec: Optional[SpecConfig] = None, mesh=None,
                  kv_quant: Optional[KVQuantConfig] = None,
                  weight_quant: Optional[WeightQuantConfig] = None,
-                 host_tier=None):
+                 host_tier=None, sync_swap: bool = False):
         from apex_tpu.amp.policy import resolve_policy
 
         if policy is None:
@@ -649,9 +671,15 @@ class Engine:
                                     self.slots + self.prefix_pool))
         # hierarchical KV: the host-DRAM prefix tier behind the paged
         # pool. Wired AFTER the prefix cache exists — eviction becomes
-        # swap-out (bytes device→host, entry stays matchable as
-        # "swapped"), a swapped hit swaps back in through _jit_swap_in.
+        # swap-out (a dispatched device→host migration; the entry
+        # stays matchable as "swapping" then "swapped"), a swapped hit
+        # swaps back in through _jit_swap_in. Both swap programs are
+        # mesh-aware: under a tp mesh they run shard_map'd over the
+        # pool's heads axis (each shard moves its own heads/tp slice —
+        # zero collectives, pinned from compiled HLO).
         self.host_tier: Optional[HostTier] = None
+        self.sync_swap = bool(sync_swap)
+        self._swap_worker: Optional[SwapWorker] = None
         self.swap_verify_failed = 0
         if host_tier is not None:
             if not self.paged:
@@ -664,20 +692,30 @@ class Engine:
                     "Engine(host_tier=...) requires prefix_pool > 0 — "
                     "the tier is a second level behind the prefix "
                     "cache, not a standalone store")
-            if mesh is not None:
-                raise ValueError(
-                    "Engine(host_tier=...) requires mesh=None for now: "
-                    "swap-out gathers the heads-sharded pool through "
-                    "one chip and swap-in would need a sharded write "
-                    "program (carried to silicon)")
             self.host_tier = host_tier if isinstance(host_tier, HostTier) \
                 else HostTier(int(host_tier))
             self.host_tier.on_evict = self._on_host_tier_evict
             self.prefix_cache.set_swap_hooks(
-                swap_out=self._swap_out_pages,
+                swap_out=self._dispatch_swap_out,
                 contains=self.host_tier.contains)
-            self._jit_swap_in = jax.jit(self._swap_in_impl,
-                                        donate_argnums=(0,))
+            self._jit_swap_in = jax.jit(
+                self._wrap_swap(self._swap_in_impl,
+                                extra_in=(self._swap_block_pspec(),) * 2
+                                + (None,), block_out=0),
+                donate_argnums=(0,))
+            # the swap-out gather is deliberately UNDONATED: its output
+            # is a fresh snapshot buffer (the worker forces it later)
+            # and an undonated call dispatches asynchronously even on
+            # the CPU backend — which is exactly what takes the
+            # device→host migration off the admission path
+            self._jit_swap_out = jax.jit(
+                self._wrap_swap(self._swap_out_impl, extra_in=(None,),
+                                block_out=2))
+            if not self.sync_swap:
+                self._swap_worker = SwapWorker()
+                # stop the thread when the engine is collected (the
+                # finalizer closes over the WORKER, not self — no cycle)
+                weakref.finalize(self, self._swap_worker.stop)
         self._registry = registry
         self._key = jax.random.PRNGKey(seed)
         self.prefill_traces = 0
@@ -686,6 +724,7 @@ class Engine:
         self.copy_traces = 0
         self.verify_traces = 0
         self.swap_in_traces = 0
+        self.swap_out_traces = 0
         self.tokens_generated = 0
         # cumulative seconds the HOST spent blocked waiting for device
         # results (every forcing site — token readback, finiteness
@@ -780,16 +819,7 @@ class Engine:
 
         from apex_tpu.utils.compat import shard_map
 
-        from .sharding import cache_pspec, scale_pspec
-
-        # the cache pytree's spec mirrors its structure: pool arrays on
-        # the heads axis, quantization scales (when present) on THEIR
-        # heads axis, None fields stay None
-        quant = self.kv_quant is not None
-        cspec = PagedKVCache(
-            k=cache_pspec(self._tp_axis), v=cache_pspec(self._tp_axis),
-            k_scale=scale_pspec(self._tp_axis) if quant else None,
-            v_scale=scale_pspec(self._tp_axis) if quant else None)
+        cspec = self._cache_spec_tree()
 
         def wrapped(params, cache, *rest):
             return shard_map(
@@ -797,6 +827,62 @@ class Engine:
                 in_specs=(self._pspec, cspec) + (P(),) * len(rest),
                 out_specs=(cspec,) + (P(),) * n_extra_out,
                 check_vma=False)(params, cache, *rest)
+
+        return wrapped
+
+    def _cache_spec_tree(self):
+        """The cache pytree's partition-spec tree (mesh engines only):
+        pool arrays on the heads axis, quantization scales (when
+        present) on THEIR heads axis, None fields stay None — shared
+        by every shard_map wrap (model programs and the two swap
+        programs alike)."""
+        from .sharding import cache_pspec, scale_pspec
+
+        quant = self.kv_quant is not None
+        return PagedKVCache(
+            k=cache_pspec(self._tp_axis), v=cache_pspec(self._tp_axis),
+            k_scale=scale_pspec(self._tp_axis) if quant else None,
+            v_scale=scale_pspec(self._tp_axis) if quant else None)
+
+    def _swap_block_pspec(self):
+        """A swapped page block's partition spec: ``[layers,
+        max_pages, heads/tp, page_len, head_dim]`` per shard — the
+        SAME heads-axis split as the pool itself, so each shard's swap
+        gather/scatter moves exactly its own slice and the programs
+        need no collective at all. None on a single-chip engine."""
+        if self.mesh is None:
+            return None
+        from jax.sharding import PartitionSpec as P
+
+        return P(None, None, self._tp_axis, None, None)
+
+    def _wrap_swap(self, fn, *, extra_in, block_out: int):
+        """Wrap a swap program body (``fn(cache, *rest)``) in
+        shard_map over the tensor-parallel mesh: the cache per its
+        spec tree, ``extra_in`` the per-operand specs for ``rest``
+        (None = replicated), and the outputs — ``block_out`` page
+        blocks (heads-sharded) for the gather, else the cache tree for
+        the scatter. ``mesh=None`` returns ``fn`` untouched, exactly
+        like :meth:`_tp_wrap`: the single-chip swap programs are the
+        verbatim bodies. The wrapped programs are the collective-free
+        pin's subject: swap is pure data movement, each shard moves
+        its own heads — compiled HLO must contain ZERO collectives
+        (``tests/L0/test_host_tier.py``)."""
+        if self.mesh is None:
+            return fn
+        from jax.sharding import PartitionSpec as P
+
+        from apex_tpu.utils.compat import shard_map
+
+        cspec = self._cache_spec_tree()
+        bspec = self._swap_block_pspec()
+        in_rest = tuple(P() if s is None else s for s in extra_in)
+        out_specs = (bspec,) * block_out if block_out else cspec
+
+        def wrapped(cache, *rest):
+            return shard_map(
+                fn, mesh=self.mesh, in_specs=(cspec,) + in_rest,
+                out_specs=out_specs, check_vma=False)(cache, *rest)
 
         return wrapped
 
@@ -888,12 +974,16 @@ class Engine:
         baseline; exactly four once prefix reuse exercises the KV
         row-copy too — and one more, on either layout, once speculative
         decoding exercises the verify program: 4 paged, 5 contiguous.
-        The hierarchical-KV tier adds AT MOST one more on the paged
-        path: the fixed-shape ``swap_in`` block scatter, traced lazily on the
-        first hit-after-swap)."""
+        The hierarchical-KV tier adds AT MOST one more PER DIRECTION
+        on the paged path: the fixed-shape ``swap_out`` block gather
+        (traced lazily on the first pressure eviction) and the
+        fixed-shape ``swap_in`` block scatter (traced lazily on the
+        first hit-after-swap) — both shape-padded to ``max_pages``, so
+        no entry size can ever trace a second copy)."""
         return (self.chunk_traces + self.decode_traces
                 + self.prefill_traces + self.copy_traces
-                + self.verify_traces + self.swap_in_traces)
+                + self.verify_traces + self.swap_in_traces
+                + self.swap_out_traces)
 
     # ------------------------------------------------------ compiled bodies
     # Every sampling program also returns a per-slot FINITENESS flag —
@@ -1156,17 +1246,40 @@ class Engine:
         # to the slot, their K/V unreachable behind the length
         return cache, greedy, n_accepted, finite
 
+    def _swap_out_impl(self, cache, page_ids):
+        """The hierarchical-KV tier's OUTBOUND compiled program: gather
+        the pool pages named by ``page_ids`` ``[max_pages]`` int32 into
+        a fresh ``[layers, max_pages, heads, page_len, head_dim]``
+        snapshot block per pool array — ONE dispatch per swap-out,
+        fixed shape (entries shorter than ``max_pages`` pad their
+        trailing ids with the page-0 sentinel, whose garbage is sliced
+        off by the worker before storage). The output buffers are the
+        SNAPSHOT the async swap rides: dispatched before the entry's
+        pages are released, program order sequences this gather ahead
+        of any later overwrite, so the worker's deferred force can
+        never observe reused pages — write-then-attend protects
+        attention readers, not cross-tier copies, which is why the
+        snapshot must be taken here and not at completion time. Under
+        a mesh each shard gathers its own heads slice (zero
+        collectives — pinned from HLO). Pure data movement: no
+        attention, no sampling, no PRNG — the copy-program precedent,
+        so it owes the tuned tables no ``decode.*`` key."""
+        self.swap_out_traces += 1   # python body runs at trace time only
+        page_ids = jnp.asarray(page_ids, jnp.int32)
+        return cache.k[:, page_ids], cache.v[:, page_ids]
+
     def _swap_in_impl(self, cache, k_blk, v_blk, page_ids):
-        """The hierarchical-KV tier's ONE compiled program: scatter a
-        host-restored page block ``[layers, max_pages, heads, page_len,
+        """The hierarchical-KV tier's INBOUND compiled program: scatter
+        a host-restored page block ``[layers, max_pages, heads, page_len,
         head_dim]`` into the pool rows named by ``page_ids``
         ``[max_pages]`` int32 — ONE dispatch per swap-in, fixed shape
         (entries shorter than ``max_pages`` pad their trailing ids with
         the page-0 sentinel, whose garbage absorbs the padded writes
-        exactly as it absorbs inactive-slot decode writes). Pure data
-        movement: no attention, no sampling, no PRNG — the
-        copy-program precedent, so it owes the tuned tables no
-        ``decode.*`` key."""
+        exactly as it absorbs inactive-slot decode writes). Under a
+        mesh each shard scatters its own heads slice (zero
+        collectives — pinned from HLO). Pure data movement: no
+        attention, no sampling, no PRNG — the copy-program precedent,
+        so it owes the tuned tables no ``decode.*`` key."""
         self.swap_in_traces += 1    # python body runs at trace time only
         page_ids = jnp.asarray(page_ids, jnp.int32)
         k = cache.k.at[:, page_ids].set(jnp.asarray(k_blk, cache.dtype))
@@ -1518,40 +1631,108 @@ class Engine:
             self._registry.gauge_set("serving.swap.host_bytes",
                                      float(self.host_tier.bytes_used))
 
-    def _swap_out_pages(self, key: int, pages) -> bool:
-        """The prefix cache's swap-out hook: copy the evicted entry's
-        page bytes device→host into the arena BEFORE the caller
-        releases the device pages. False (the caller destroys instead)
+    def _dispatch_swap_out(self, key, pages) -> bool:
+        """The prefix cache's swap-out hook — the ADMISSION-SIDE half
+        of a (by default asynchronous) page migration, and the
+        dispatch-ahead region the force-early lint covers BY NAME: no
+        ``int()`` / ``float()`` / ``np.asarray`` / ``jax.device_get``
+        may appear here, because a single forced read silently reverts
+        the whole tier to the synchronous admission stall with zero
+        token-level symptom (the bytes are right either way — only
+        the wall-clock rots).
+
+        Reserves the entry's arena bytes (capacity eviction and the
+        decline decision run NOW, on this thread, so async and sync
+        arena states evolve identically), DISPATCHES the fixed-shape
+        compiled ``swap_out`` gather — the pool-byte snapshot is taken
+        by program order at dispatch, BEFORE the caller releases the
+        entry's pages for reuse — and hands the un-forced device
+        blocks to the :class:`~apex_tpu.serving.SwapWorker`
+        (:meth:`_complete_swap_out` forces, checksums and stores them
+        off the hot path; ``sync_swap=True`` runs that half inline —
+        the pre-async behaviour). False (the caller destroys instead)
         when the tier declines — an entry bigger than the whole arena.
-        The copy is a forced device read, charged to
-        :attr:`device_wait_s` like every other sync."""
+        The admission-path cost of the whole hook is observed as
+        ``serving.swap.admit_stall_s`` — the histogram the bench's
+        sync-vs-async claim reads."""
         tier = self.host_tier
         if tier is None:
             return False
-        idx = [int(p) for p in pages]
-        m = len(idx)
+        m = len(pages)
         if m > self.max_pages:
             return False            # cannot happen by construction
-        # SHAPE-STABLE device read: pad the gather to max_pages with
-        # the page-0 sentinel (harmless garbage, sliced off below) so
-        # every swap-out of every entry size shares one compiled
-        # gather — an entry-sized gather would silently recompile
-        # mid-serve the first time an unseen page count appears
-        padded = idx + [0] * (self.max_pages - m)
         t0 = time.perf_counter()
-        k_host = np.asarray(self.cache.k[:, padded])[:, :m]  # device sync
-        v_host = np.asarray(self.cache.v[:, padded])[:, :m]
-        self.device_wait_s += time.perf_counter() - t0
-        if not tier.put(key, k_host, v_host):
+        c = self.cache
+        # the reservation is pure shape arithmetic — no device read:
+        # K and V, m whole pages each, in the pool's storage dtype
+        nbytes = 2 * m * c.layers * c.heads * c.page_len * c.head_dim \
+            * np.dtype(c.dtype).itemsize
+        if not tier.put_pending(key, nbytes, shards=self.tp):
             return False
+        # SHAPE-STABLE dispatch: pad the gather to max_pages with the
+        # page-0 sentinel (harmless garbage, sliced off by the worker)
+        # so every swap-out of every entry size shares one compiled
+        # gather — an entry-sized gather would silently recompile
+        # mid-serve the first time an unseen page count appears. The
+        # gather is UNDONATED, so even this CPU backend dispatches it
+        # asynchronously (~0.1 ms) instead of executing it inline.
+        ids = np.zeros(self.max_pages, np.int32)
+        ids[:m] = list(pages)
+        k_dev, v_dev = self._runtime_call(
+            lambda: self._jit_swap_out(self.cache, jnp.asarray(ids)))
+        job = lambda: self._complete_swap_out(  # noqa: E731
+            key, k_dev, v_dev, m, t0)
+        if self._swap_worker is None:
+            job()                   # sync_swap: the measurable baseline
+        else:
+            self._swap_worker.submit(key, job)
+        if self._registry is not None:
+            self._registry.observe("serving.swap.admit_stall_s",
+                                   time.perf_counter() - t0)
+            self._registry.gauge_set(
+                "serving.swap.swap_out_queue_depth",
+                0.0 if self._swap_worker is None
+                else len(self._swap_worker.pending_keys()))
+        return True
+
+    def _complete_swap_out(self, key, k_dev, v_dev, m: int,
+                           t0: float) -> None:
+        """The WORKER-SIDE half of a swap-out: force the dispatched
+        snapshot blocks to host (the memcpy the async tier moves off
+        the admission path), slice off the sentinel padding, and
+        complete the arena's pending record (defensive copy + per-
+        shard CRC inside :meth:`HostTier.complete`). A record evicted
+        (or cleared) while the bytes were in flight discards silently
+        — its index entry is already gone. Runs on the
+        :class:`~apex_tpu.serving.SwapWorker` thread by default
+        (inline under ``sync_swap=True``); the registry is
+        thread-safe, so the traffic counters land from here either
+        way. On the WORKER thread the force deliberately does NOT
+        touch :attr:`device_wait_s` — that ledger belongs to the
+        scheduler thread's heartbeat split, and a worker-side force
+        blocks nobody's beat; running INLINE (``sync_swap=True``, or
+        the post-close degradation) it blocks the scheduler thread
+        exactly like the pre-async path did, so the wait is charged —
+        the sync baseline's duty-cycle split must not silently
+        flatter itself."""
+        tier = self.host_tier
+        worker = self._swap_worker
+        inline = worker is None \
+            or threading.current_thread() is not worker._thread
+        tw = time.perf_counter()
+        k_host = np.asarray(k_dev)[:, :m]   # the deferred force
+        v_host = np.asarray(v_dev)[:, :m]
+        if inline:
+            self.device_wait_s += time.perf_counter() - tw
+        if not tier.complete(key, k_host, v_host):
+            return                  # evicted mid-flight: bytes dropped
         if self._registry is not None:
             self._registry.counter_inc("serving.swap.swapped_out_pages",
-                                       len(idx))
+                                       int(m))
             self._registry.observe("serving.swap.out_s",
                                    time.perf_counter() - t0)
             self._registry.gauge_set("serving.swap.host_bytes",
                                      float(tier.bytes_used))
-        return True
 
     def _count_swap_verify_failed(self) -> None:
         self.swap_verify_failed += 1
@@ -1574,9 +1755,34 @@ class Engine:
           token;
         - pool too tight even after draining resident prefixes → the
           bytes go BACK to the arena and the entry stays swapped (a
-          later, less-pressured hit can still restore it)."""
+          later, less-pressured hit can still restore it).
+
+        A hit racing its own IN-FLIGHT swap-out (the entry is still
+        in the *swapping* state) first JOINS the worker's copy —
+        counted as ``serving.swap.swap_join_waits``, the wait charged
+        to :attr:`device_wait_s` like any forced device read — so the
+        arena record is complete (or failed) before it is taken:
+        partial bytes are unobservable by construction. A join that
+        surfaces the worker job's exception degrades to the same
+        verified miss as missing bytes."""
         tier, pcache = self.host_tier, self.prefix_cache
         t0 = time.perf_counter()
+        if tier is not None and self._swap_worker is not None \
+                and self._swap_worker.in_flight(key):
+            if self._registry is not None:
+                self._registry.counter_inc("serving.swap.swap_join_waits")
+            tw = time.perf_counter()
+            try:
+                self._swap_worker.join(key)
+            except Exception as e:  # noqa: BLE001 — degrade, never crash
+                # the job died before completing: the record is still
+                # pending, so take() below returns None and the hit
+                # degrades to the usual verified miss
+                _logger.warning("swap-out of entry %d failed on the "
+                                "worker (%s: %s) — degrading its hit "
+                                "to a verified miss", key,
+                                type(e).__name__, e)
+            self.device_wait_s += time.perf_counter() - tw
         rec = tier.take(key) if tier is not None else None
         if rec is None or not rec.valid:
             pcache.drop(key)
@@ -1602,7 +1808,7 @@ class Engine:
         # by LRU-evicting (= swapping out) resident prefix entries
         while self.pool.available < m:
             if not pcache.evict_lru():
-                tier.put(key, k_host, v_host)
+                tier.put(key, k_host, v_host, shards=rec.shards)
                 _logger.debug("swap-in of entry %d deferred: pool too "
                               "tight for %d pages", key, m)
                 return None
@@ -2103,6 +2309,19 @@ class Engine:
         out = np.asarray(self.cache.lengths)    # device sync
         self.device_wait_s += time.perf_counter() - tw
         return out
+
+    def close(self) -> None:
+        """Stop the engine's :class:`~apex_tpu.serving.SwapWorker`
+        thread (no-op without a host tier or under ``sync_swap``;
+        idempotent — the weakref finalizer registered at construction
+        runs the same stop). The stop DRAINS first: swap-outs queued
+        at kill time complete their arena puts, so a replica killed
+        with a non-empty swap queue still reconciles — the cross-tier
+        audit walks clean, nothing dangles. After close the engine
+        stays usable: further swap-outs run inline (the sync
+        degradation)."""
+        if self._swap_worker is not None:
+            self._swap_worker.stop()
 
     def set_registry(self, registry) -> None:
         """Swap the telemetry registry (e.g. after a compile-warmup pass,

@@ -119,7 +119,10 @@ class FaultSpec:
       :class:`~apex_tpu.serving.HostTier`). The NEXT swap-in of the
       victim fails its CRC and must degrade to a verified miss
       (re-prefill, ``serving.swap.verify_failed``) — never a wrong
-      token.
+      token. An injection landing on an entry whose async swap-out is
+      still IN FLIGHT (the *swapping* state) is armed instead and rots
+      the bytes the moment the worker stores them — the race resolves
+      to the same verified miss.
     """
 
     kind: str

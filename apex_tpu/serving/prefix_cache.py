@@ -59,15 +59,22 @@ a donor entry mid-request is harmless).
 **Hierarchical KV** (paged + an engine host tier): eviction under pool
 pressure becomes a SWAP — the victim entry's page bytes migrate
 device→host (the engine's ``swap_out`` hook, wired via
-:meth:`PrefixCache.set_swap_hooks`), its device pages return to the
-pool, and the entry stays in the index in the ``swapped`` state, so
-:meth:`match` and :meth:`probe` still report it (the router's affinity
-probe keeps seeing swapped prefixes). A hit on a swapped entry carries
-``PrefixMatch.swapped=True``; the engine migrates the bytes back into
-fresh pages (checksum-verified — a corrupt or missing swap-in degrades
-to a verified miss via :meth:`drop` + :meth:`unrecord_hit`, never a
-wrong token) and calls :meth:`swap_in_complete` before sharing as
-usual. Prefix capacity is then bounded by host RAM, not device HBM.
+:meth:`PrefixCache.set_swap_hooks`; by default the hook only
+DISPATCHES the migration — the copy completes on a
+:class:`~apex_tpu.serving.SwapWorker` thread, off the admission path —
+but the snapshot is taken by program order at dispatch, so the hook
+returning True means the bytes are safe), its device pages return to
+the pool immediately, and the entry stays in the index in the
+``swapped`` state (arena-side it passes through *swapping* while the
+copy is in flight), so :meth:`match` and :meth:`probe` still report it
+(the router's affinity probe keeps seeing swapped AND swapping
+prefixes — ``contains`` answers for both). A hit on a swapped entry
+carries ``PrefixMatch.swapped=True``; the engine joins any in-flight
+copy, migrates the bytes back into fresh pages (checksum-verified — a
+corrupt or missing swap-in degrades to a verified miss via
+:meth:`drop` + :meth:`unrecord_hit`, never a wrong token) and calls
+:meth:`swap_in_complete` before sharing as usual. Prefix capacity is
+then bounded by host RAM, not device HBM.
 """
 
 from __future__ import annotations
@@ -429,20 +436,24 @@ class PrefixCache:
                                                    bool],
                        contains: Callable[[int], bool]) -> None:
         """Wire the host-DRAM tier (engine-side): ``swap_out(key,
-        pages)`` copies an evicted entry's page bytes device→host and
-        returns True on success (False = tier off/declined → the entry
-        is destroyed, the pre-tier behaviour); ``contains(key)`` is the
-        read-only backing probe the match walk consults for swapped
-        entries."""
+        pages)`` migrates an evicted entry's page bytes device→host
+        and returns True on success — True may mean the copy is merely
+        DISPATCHED (async swap-out): the engine guarantees the
+        snapshot precedes any page reuse, so this cache treats the
+        entry as swapped either way. False = tier off/declined → the
+        entry is destroyed, the pre-tier behaviour. ``contains(key)``
+        is the read-only backing probe the match walk consults for
+        swapped entries (in-flight *swapping* entries answer True)."""
         self._swap_out_fn = swap_out
         self._swap_contains = contains
 
     def _swap_out(self, entry: _Entry) -> bool:
         """Migrate ``entry`` resident→swapped: bytes to the host tier
-        (via the engine hook, which must copy BEFORE this releases the
-        device pages), page refcounts back to the pool. False — and no
-        state change — when no tier is wired, the entry is not paged,
-        or the tier declined the bytes."""
+        (via the engine hook, which must SNAPSHOT the bytes — copy, or
+        dispatch the compiled gather that program-orders the copy —
+        BEFORE this releases the device pages), page refcounts back to
+        the pool. False — and no state change — when no tier is wired,
+        the entry is not paged, or the tier declined the bytes."""
         if self._swap_out_fn is None or entry.pages is None:
             return False
         if not self._swap_out_fn(entry.row, entry.pages):

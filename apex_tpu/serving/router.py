@@ -61,9 +61,11 @@ Telemetry (all host-side, through the shared registry): counters
 ``serving.router.routed`` / ``affinity_hits`` / ``spills`` /
 ``replica_deaths`` / ``requeued``, the ``serving.router.replicas_alive``
 gauge, and per-replica load gauges namespaced as
-``serving.router.replica<i>.{queue_depth, slots_busy, pages_free}`` so
-N replicas sharing one registry never clobber each other's pool
-gauges. Replica-internal metrics (TTFT, step latencies, prefix
+``serving.router.replica<i>.{queue_depth, slots_busy, pages_free,
+host_bytes_free}`` (the last only on hierarchical-KV replicas — the
+swap arena's remaining headroom, the least-loaded tie-break's newest
+input) so N replicas sharing one registry never clobber each other's
+pool gauges. Replica-internal metrics (TTFT, step latencies, prefix
 counters, fault counters) flow into the SAME shared registry as
 fleet-wide aggregates — which is what a capacity dashboard wants —
 while per-replica prefix accounting uses
@@ -250,6 +252,11 @@ class Router:
             -snaps[i]["slots_free"],
             snaps[i]["queue_depth"],
             -(snaps[i]["pages_free"] or 0),
+            # hierarchical-KV tie-break: of two replicas equal on
+            # slots/queue/pages, prefer the one with more host-arena
+            # headroom — landing work on a replica whose swap arena is
+            # nearly full accelerates its swapped-prefix shedding
+            -(snaps[i]["host_bytes_free"] or 0),
             i))
         return keys, order, lens
 
@@ -356,6 +363,12 @@ class Router:
         sched = self.replicas[index]
         drained = sched.drain_requests()
         sched.close()
+        # drain the victim's swap worker too: swap-outs queued at kill
+        # time COMPLETE their arena puts (bytes already snapshotted at
+        # dispatch), so the dead replica's cross-tier audit reconciles
+        # — no dangling swapped entries, no leaked host bytes
+        if hasattr(sched.engine, "close"):
+            sched.engine.close()
         if self.registry is not None:
             self.registry.counter_inc("serving.router.replica_deaths")
             if drained:
@@ -367,7 +380,8 @@ class Router:
             # empty corpse) forever. Zero is the honest reading: the
             # drain emptied it, and a dead pool has no capacity.
             prefix = f"serving.router.replica{index}."
-            for gauge in ("queue_depth", "slots_busy", "pages_free"):
+            for gauge in ("queue_depth", "slots_busy", "pages_free",
+                          "host_bytes_free"):
                 self.registry.gauge_set(prefix + gauge, 0.0)
         _logger.warning(
             "replica %d died at router tick %d: %d request(s) drained "
@@ -399,6 +413,11 @@ class Router:
             if snap["pages_free"] is not None:
                 self.registry.gauge_set(prefix + "pages_free",
                                         float(snap["pages_free"]))
+            if snap["host_bytes_free"] is not None:
+                # arena headroom rides the same namespace so the
+                # least-loaded tie-break's input is dashboard-visible
+                self.registry.gauge_set(prefix + "host_bytes_free",
+                                        float(snap["host_bytes_free"]))
 
     # ---------------------------------------------------------------- runs
     @property
@@ -448,11 +467,16 @@ class Router:
         return requests
 
     def close(self) -> None:
-        """Stop every replica's worker thread (idempotent — safe after
-        a partial kill, safe twice; each scheduler's own weakref
-        finalizer covers the forgotten-router case)."""
+        """Stop every replica's worker threads — the scheduler's
+        :class:`~apex_tpu.serving.DraftWorker` and the engine's
+        :class:`~apex_tpu.serving.SwapWorker` (which drains queued
+        swap-outs first, so arenas reconcile). Idempotent — safe after
+        a partial kill, safe twice; each worker's own weakref
+        finalizer covers the forgotten-router case."""
         if self._closed:
             return
         self._closed = True
         for sched in self.replicas:
             sched.close()
+            if hasattr(sched.engine, "close"):
+                sched.engine.close()

@@ -1589,11 +1589,17 @@ class Scheduler:
         the :class:`~apex_tpu.serving.Router`'s least-loaded admission
         signal, taken per routed request. Everything here is host
         bookkeeping (queue/slot walks, the paged allocator's free
-        count); nothing forces a device value, so probing N replicas
-        per submit costs microseconds, not syncs. ``pages_free`` is
-        None on a contiguous engine (rows are preallocated — slot
-        occupancy is the whole capacity story there)."""
+        count, the host arena's byte ledger); nothing forces a device
+        value, so probing N replicas per submit costs microseconds,
+        not syncs. ``pages_free`` is None on a contiguous engine (rows
+        are preallocated — slot occupancy is the whole capacity story
+        there); ``host_bytes_free`` is None without a hierarchical-KV
+        host tier — when present it is the swap arena's remaining
+        headroom, so the router's least-loaded tie-break sees arena
+        pressure (a replica about to shed swapped prefixes), not just
+        device pages."""
         busy = sum(r is not None for r in self._running)
+        tier = getattr(self.engine, "host_tier", None)
         return {
             "queue_depth": len(self._queue),
             "queue_free": self.max_queue - len(self._queue),
@@ -1603,6 +1609,8 @@ class Scheduler:
             "inflight_steps": len(self._pipeline),
             "pages_free": self.engine.pages_free
             if getattr(self.engine, "paged", False) else None,
+            "host_bytes_free": None if tier is None
+            else tier.capacity_bytes - tier.bytes_used,
         }
 
     def drain_requests(self) -> List[Request]:

@@ -59,9 +59,13 @@ wall per emitted token, duty cycle, ``token_mismatched_requests``
 (expected 0, bitwise) — via ``bench_serving.async_stats``, and a
 nested ``host_tier`` sub-object (BENCH_SERVING_HOST_TIER=0 to drop
 it): the hierarchical-KV leg — a prefix working set larger than the
-device pool served tier-off vs tier-on (hit rate, chunks skipped,
-TTFT, swap traffic, bitwise exactness) via
-``bench_serving.host_tier_stats``, and a
+device pool served tier-off vs sync-swap vs ASYNC swap-out (hit
+rate, chunks skipped, TTFT, admission-stall p50/p99 sync vs async
+from the telemetry histogram, swap traffic, bitwise exactness, and
+the BENCH_SERVING_HOST_TIER_TP mesh-composition sub-leg's
+per-shard-record pins) — run as a subprocess like the
+tensor-parallel leg so the mesh sub-leg can force emulated CPU
+devices, and a
 nested ``replica_router`` sub-object (BENCH_SERVING_ROUTER=0 to drop
 it; BENCH_SERVING_REPLICAS sizes the fleet): the prefix-aware
 least-loaded router at 1 vs N replicas — aggregate tokens/s, p99
@@ -213,16 +217,10 @@ _SERVING_ASYNC_SMOKE = {
     "PREFILL_LEN": 32, "REQUESTS": 8, "NEW_TOKENS": 16, "WINDOWS": 2,
 }
 
-# The host-tier sub-leg's smoke geometry (the grouped template stream
-# is served twice — tier off + tier on — over a pool deliberately
-# smaller than the template working set, so the eviction→swap churn
-# the leg measures is by construction). BENCH_SERVING_HOST_GROUPS /
-# BENCH_SERVING_HOST_TIER_MIB et al. still win, env-beats-smoke.
-_SERVING_HOST_SMOKE = {
-    "SIZE": "tiny", "VOCAB": 512, "SLOTS": 2, "MAX_LEN": 128,
-    "PREFILL_LEN": 64, "CHUNK_LEN": 8, "REQUESTS": 12, "NEW_TOKENS": 6,
-    "WINDOWS": 1, "SHARED_PREFIX": 56, "PREFIX_POOL": 4,
-}
+# (The host-tier sub-leg runs as a SUBPROCESS — see
+# _serving_host_tier_leg — so its smoke geometry is the child's own
+# HOST_SMOKE preset in bench_serving.py; exported BENCH_SERVING_*
+# knobs still win inside the child, env-beats-smoke.)
 
 # The replica-router sub-leg's smoke geometry (the session stream is
 # served THREE ways — 1 replica, N affinity, N random control — so it
@@ -415,31 +413,61 @@ def _serving_async_leg() -> dict:
 def _serving_host_tier_leg() -> dict:
     """The hierarchical-KV trajectory sub-row: smoke-sized
     host-DRAM-tier summary (a prefix working set larger than the
-    device pool, tier off vs on — hit rate, chunks skipped, TTFT,
-    swap traffic, bitwise exactness) from
-    ``bench_serving.host_tier_stats``. BENCH_SERVING_HOST_TIER=0
-    drops it; failure-isolated like its siblings — a broken tier
-    yields {"error": ...} here, never a lost serving (or ResNet)
-    row."""
+    device pool — tier off vs sync-swap vs ASYNC swap-out: hit rate,
+    chunks skipped, TTFT, the telemetry-wired admission-stall p50/p99
+    sync vs async, swap traffic, bitwise exactness, plus the
+    ``HOST_TIER_TP``-shard mesh-composition sub-leg's
+    per-shard-record/token-exactness pins) from
+    ``bench_serving.py --host-tier``. Runs as a SUBPROCESS like the
+    tensor-parallel leg: the mesh sub-leg must force emulated CPU
+    devices BEFORE any jax client initializes, and this process's
+    backend is long since live. BENCH_SERVING_HOST_TIER=0 drops it;
+    failure-isolated like its siblings — a broken (or timed-out)
+    tier yields {"error": ...} here, never a lost serving (or
+    ResNet) row."""
     if _env_int("BENCH_SERVING_HOST_TIER", "1") == 0:
         return {"skipped": True}
     try:
-        import bench_serving
+        import subprocess
+        import sys
 
-        bench_serving._load_env(smoke=dict(_SERVING_HOST_SMOKE))
-        _, summary = bench_serving.host_tier_stats()
+        root = os.path.dirname(os.path.abspath(__file__))
+        env = dict(os.environ)
+        # CPU + emulated devices for the mesh sub-leg; any exported
+        # BENCH_SERVING_* knob still wins inside the child
+        # (env-beats-smoke — the child applies its own smoke preset)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("XLA_FLAGS", None)
+        proc = subprocess.run(
+            [sys.executable, os.path.join(root, "bench_serving.py"),
+             "--host-tier"],
+            capture_output=True, text=True, env=env, cwd=root,
+            timeout=600)
+        lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
+        summary = json.loads(lines[-1])      # guard contract: last line
+        if "error" in summary:
+            return {"error": summary["error"],
+                    "transient": summary.get("transient", False)}
         return {k: summary[k] for k in (
             "value", "unit", "baseline_tokens_per_s",
+            "sync_swap_tokens_per_s",
             "prefix_hit_rate", "prefix_hit_rate_tier_off",
-            "hit_rate_improved", "prefill_chunks_skipped",
+            "hit_rate_improved", "hit_rate_unchanged_vs_sync",
+            "prefill_chunks_skipped",
             "prefill_chunks_skipped_tier_off",
             "prefill_chunks_skipped_pct", "ttft_p50_ms",
             "ttft_p50_ms_tier_off", "ttft_p99_ms",
-            "ttft_p99_ms_tier_off", "ttft_improved", "hit_after_swap",
+            "ttft_p99_ms_tier_off", "ttft_improved",
+            "admit_stall_p50_ms_sync", "admit_stall_p99_ms_sync",
+            "admit_stall_p50_ms_async", "admit_stall_p99_ms_async",
+            "admit_stall_p99_reduction_pct",
+            "admit_stall_p50_reduction_pct", "admit_stall_reduced",
+            "admit_stall_p50_reduced",
+            "swap_join_waits", "hit_after_swap",
             "swapped_out_pages", "swapped_in_pages",
             "swap_verify_failed", "host_bytes",
             "prefix_working_set_pages", "pool_pages",
-            "token_mismatched_requests", "model")}
+            "token_mismatched_requests", "mesh", "model")}
     except KeyboardInterrupt:
         raise
     except BaseException as e:  # noqa: BLE001 — the row must not die here

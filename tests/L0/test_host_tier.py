@@ -1,14 +1,30 @@
 """Hierarchical KV — the host-DRAM prefix tier, hermetic.
 
-The acceptance bar from the host-tier issue, as tests:
+The acceptance bar from the host-tier issue (+ the async/mesh
+migration issue), as tests:
 
 - a hit-after-swap greedy stream is **bitwise identical** to a
   never-swapped one, across prefix lengths below / at / straddling the
   block boundary (the swap round-trips exact bytes through the same
   compiled programs — storage moved, nothing recomputed);
-- the tier adds AT MOST one compiled program (the fixed-shape
-  ``swap_in`` page-block scatter — one dispatch per swap-in; the
-  chunk/decode/prefill/verify set is untouched);
+- swap-out is ASYNC by default (dispatch on the admission path, the
+  force/CRC/store on a ``SwapWorker`` thread) and bitwise identical
+  to the ``sync_swap=True`` escape hatch — including a hit that lands
+  while the bytes are still in flight (the *swapping* state: the hit
+  JOINS the copy, never reads partial bytes) and a chaos
+  ``swap_corruption`` racing the in-flight swap (verified miss,
+  never a wrong token); a kill with a non-empty swap queue drains
+  leak-free and no worker threads leak across construct/serve/close;
+- the mesh restriction is LIFTED: a tp=1 mesh host-tier engine is
+  bitwise vs ``mesh=None``, tp=2 (slow) is token-exact with
+  per-shard arena records (one CRC per shard), and compiled HLO of
+  BOTH swap programs carries ZERO collectives (swap is pure data
+  movement — each shard moves its own heads slice);
+- the tier adds AT MOST one compiled program PER DIRECTION (the
+  fixed-shape ``swap_out`` page-block gather and ``swap_in`` scatter
+  — one dispatch each, shape-padded to max_pages so no entry size
+  can trace a second copy; the chunk/decode/prefill/verify set is
+  untouched);
 - zero leaked pages at drain across swap churn: the
   :class:`~apex_tpu.serving.PoolAuditor`'s device walk reconciles, and
   its new cross-tier walk reconciles host-arena entries against the
@@ -31,10 +47,14 @@ The acceptance bar from the host-tier issue, as tests:
 Everything runs on CPU with a tiny model at policy O0 (exact fp32).
 """
 
+import threading
+import zlib
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+from jax.sharding import Mesh
 
 from apex_tpu import telemetry
 from apex_tpu.amp.policy import resolve_policy
@@ -217,17 +237,22 @@ def test_hit_after_swap_bitwise_vs_never_swapped(engine_pair):
             assert et.host_tier.size == 0
 
 
-def test_at_most_one_new_program_and_zero_leaks(engine_pair):
+def test_at_most_one_new_program_per_direction_and_zero_leaks(
+        engine_pair):
     """Program-count pin + leak pin, over all the swap churn the
     module has driven so far: the hierarchical engine compiled exactly
-    chunk + decode + swap_in (one more than the plain engine's two),
-    and both pools audit clean — then drain to zero pages."""
+    chunk + decode + swap_out + swap_in (TWO more than the plain
+    engine's two — one per swap direction, each shape-padded so every
+    entry size shares it), and both pools audit clean — then drain to
+    zero pages."""
     et, ec = engine_pair
     assert et.chunk_traces == 1 and et.decode_traces == 1
     assert et.swap_in_traces == 1          # every page shares ONE program
+    assert et.swap_out_traces == 1         # ... in each direction
     assert et.copy_traces == et.verify_traces == et.prefill_traces == 0
-    assert et.compiled_programs == 3
-    assert ec.compiled_programs == 2 and ec.swap_in_traces == 0
+    assert et.compiled_programs == 4
+    assert ec.compiled_programs == 2
+    assert ec.swap_in_traces == ec.swap_out_traces == 0
     for eng in engine_pair:
         PoolAuditor().audit(eng)
         eng.reset(clear_prefixes=True)
@@ -443,3 +468,351 @@ def test_auditor_cross_tier_walk_is_sensitive(engine_pair):
     tier.capacity_bytes = saved
     auditor.audit(et)
     et.reset(clear_prefixes=True)
+
+
+# ----------------------------------------------- async swap-out (tentpole)
+def _gate_worker(eng):
+    """Block ``eng``'s SwapWorker behind an Event so the NEXT
+    eviction's bytes deterministically sit in flight (the *swapping*
+    state) until the gate opens."""
+    gate = threading.Event()
+    eng._swap_worker.submit(("gate", id(gate)), gate.wait)
+    return gate
+
+
+def test_async_default_vs_sync_escape_hatch_bitwise(lm_and_params):
+    """THE async acceptance pin: the default (worker-threaded)
+    swap-out and the ``sync_swap=True`` escape hatch serve identical
+    greedy streams token-for-token — including a hit forced to land
+    while its entry's swap-out bytes are STILL IN FLIGHT, which must
+    JOIN the copy (counted as ``serving.swap.swap_join_waits``),
+    never read partial bytes. Zero leaks, clean cross-tier audits."""
+    from apex_tpu import telemetry
+
+    ea = _mk_engine(lm_and_params, host_tier=1 << 24)
+    es = _mk_engine(lm_and_params, host_tier=1 << 24, sync_swap=True)
+    assert ea._swap_worker is not None and es._swap_worker is None
+    reg = telemetry.MetricsRegistry()
+    ea.set_registry(reg)
+    try:
+        rng = np.random.default_rng(31)
+        pre = list(rng.integers(1, VOCAB, size=16))
+        p1, p2 = pre + [1, 2], pre + [3, 4]
+        outs = {}
+        for name, eng in (("async", ea), ("sync", es)):
+            sched = Scheduler(eng, retain_prefixes=True)
+            (r1,) = sched.run([Request(prompt=list(p1),
+                                       max_new_tokens=5)])
+            gate = _gate_worker(eng) if eng._swap_worker is not None \
+                else None
+            assert eng.prefix_cache.evict_lru()
+            if gate is not None:
+                # the swap is dispatched but NOT complete: the entry
+                # is in the swapping state — reserved in the arena,
+                # still matchable and probeable
+                assert eng.host_tier.pending_keys()
+                assert eng.host_tier.stats()["swapping"] == 1
+                assert eng.prefix_cache.probe(p2) == 16
+                threading.Timer(0.1, gate.set).start()
+            (r2,) = sched.run([Request(prompt=list(p2),
+                                       max_new_tokens=5)])
+            outs[name] = (list(r1.output_tokens),
+                          list(r2.output_tokens), r2.reused_tokens)
+            PoolAuditor().audit(eng)
+            assert eng.host_tier.size == 0      # restored + drained
+        assert outs["async"] == outs["sync"], \
+            "async swap-out diverged from the sync escape hatch"
+        assert outs["async"][2] == 16
+        counters = reg.snapshot()["counters"]
+        assert counters.get("serving.swap.swap_join_waits", 0) >= 1, \
+            "the in-flight hit never joined the worker copy"
+        assert counters.get("serving.swap.verify_failed", 0) == 0
+    finally:
+        ea.set_registry(None)
+        ea.close()
+        es.close()
+
+
+def test_swap_corruption_racing_inflight_swap(lm_and_params):
+    """Chaos × async: a ``swap_corruption`` landing while the victim's
+    swap-out bytes are still in flight arms the corruption (it rots
+    the bytes the moment the worker stores them), and the racing hit
+    degrades to a VERIFIED MISS — bitwise identical to a cold run,
+    never a wrong token, pool and arena reconciled."""
+    from apex_tpu import telemetry
+
+    eng = _mk_engine(lm_and_params, host_tier=1 << 24)
+    cold = _mk_engine(lm_and_params)
+    try:
+        rng = np.random.default_rng(37)
+        pre = list(rng.integers(1, VOCAB, size=16))
+        p2 = pre + [5, 6, 7]
+        (oracle,) = Scheduler(cold).run([Request(prompt=list(p2),
+                                                 max_new_tokens=5)])
+        reg = telemetry.MetricsRegistry()
+        eng.set_registry(reg)
+        sched = Scheduler(eng, registry=reg, retain_prefixes=True)
+        sched.run([Request(prompt=pre + [1, 2], max_new_tokens=5)])
+        gate = _gate_worker(eng)
+        assert eng.prefix_cache.evict_lru()
+        assert eng.host_tier.pending_keys()
+        # the injection races the in-flight swap: consumed NOW, lands
+        # at completion time
+        plan = FaultPlan([FaultSpec(kind="swap_corruption", tick=0)])
+        assert plan.maybe_corrupt_swap(0, eng.host_tier)
+        threading.Timer(0.05, gate.set).start()
+        (r,) = sched.run([Request(prompt=list(p2), max_new_tokens=5)])
+        assert r.output_tokens == oracle.output_tokens
+        assert r.status == "finished" and r.reused_tokens == 0
+        counters = reg.snapshot()["counters"]
+        assert counters.get("serving.swap.verify_failed") == 1
+        assert not eng.prefix_cache.swapped_keys()
+        assert eng.host_tier.size == 0
+        PoolAuditor().audit(eng)
+    finally:
+        eng.set_registry(None)
+        eng.close()
+
+
+def test_close_with_nonempty_swap_queue_drains_leak_free(lm_and_params):
+    """The kill contract: an engine closed while its swap queue is
+    non-empty DRAINS — every queued swap-out completes its arena put
+    (the bytes were snapshotted at dispatch), so the cross-tier audit
+    walks clean with nothing dangling; the engine stays usable after
+    close (swap-outs degrade to inline/sync)."""
+    eng = _mk_engine(lm_and_params, pool=3, host_tier=1 << 24)
+    sched = Scheduler(eng, retain_prefixes=True)
+    rng = np.random.default_rng(41)
+    pres = [list(rng.integers(1, VOCAB, size=16)) for _ in range(2)]
+    for pre in pres:
+        sched.run([Request(prompt=pre + [1, 2], max_new_tokens=3)])
+    # host_bytes_free load gauge: full arena headroom before any swap
+    snap = sched.load_snapshot()
+    assert snap["host_bytes_free"] == eng.host_tier.capacity_bytes
+    gate = _gate_worker(eng)
+    assert eng.prefix_cache.evict_lru()
+    assert eng.prefix_cache.evict_lru()
+    assert len(eng.host_tier.pending_keys()) == 2   # both in flight
+    assert len(eng._swap_worker.pending_keys()) >= 2
+    assert sched.load_snapshot()["host_bytes_free"] \
+        < eng.host_tier.capacity_bytes      # reservations count NOW
+    threading.Timer(0.05, gate.set).start()
+    eng.close()                              # drains, then stops
+    assert not eng.host_tier.pending_keys()
+    assert eng.host_tier.size == 2
+    assert len(eng.prefix_cache.swapped_keys()) == 2
+    PoolAuditor().audit(eng)
+    # post-close swap-outs run inline (sync degradation, never dropped)
+    sched.run([Request(prompt=pres[0] + [9], max_new_tokens=3)])
+    PoolAuditor().audit(eng)
+
+
+def test_no_swap_worker_thread_leaks(lm_and_params):
+    """No worker-thread leaks across construct/serve/close; close is
+    idempotent; sync_swap engines never start a thread; a plain
+    scheduler's load snapshot reads host_bytes_free=None."""
+    def workers():
+        return sum(t.name == "serving-swap-worker"
+                   for t in threading.enumerate())
+
+    base = workers()
+    eng = _mk_engine(lm_and_params, host_tier=1 << 22)
+    assert workers() == base + 1
+    sched = Scheduler(eng, retain_prefixes=True)
+    sched.run([Request(prompt=list(range(1, 18)), max_new_tokens=3)])
+    eng.close()
+    eng.close()                              # idempotent
+    assert workers() == base
+    es = _mk_engine(lm_and_params, host_tier=1 << 22, sync_swap=True)
+    assert workers() == base and es._swap_worker is None
+    plain = _mk_engine(lm_and_params)
+    assert Scheduler(plain).load_snapshot()["host_bytes_free"] is None
+    es.close()
+
+
+# ------------------------------------------------------- mesh composition
+def _mesh(n: int) -> Mesh:
+    devs = jax.devices()
+    if len(devs) < n:
+        pytest.skip(f"needs {n} virtual devices")
+    return Mesh(np.array(devs[:n]), ("tp",))
+
+
+VOCAB_TP = 96       # divisible by the tp sizes under test (1, 2)
+
+
+@pytest.fixture(scope="module")
+def tp_lm_and_params():
+    """A tp-divisible tiny model (vocab 96) for the tp>1 mesh tests —
+    the module default's 101-token vocab cannot split over 2 shards."""
+    m = TransformerLM(vocab_size=VOCAB_TP, hidden=32, num_layers=2,
+                      num_heads=4, max_seq_len=64)
+    params = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32),
+                    train=False)["params"]
+    return m, params
+
+
+def _serve_swap_stream(eng, seed=42, vocab=VOCAB):
+    """One register → evict(=swap) → hit-after-swap stream; returns
+    every request's tokens + the hit's reuse accounting."""
+    rng = np.random.default_rng(seed)
+    pre = list(rng.integers(1, vocab, size=16))
+    p1, p2 = pre + [1, 2, 3], pre + [4, 5, 6]
+    sched = Scheduler(eng, retain_prefixes=True)
+    (r1,) = sched.run([Request(prompt=list(p1), max_new_tokens=5)])
+    assert eng.prefix_cache.evict_lru()
+    (r2,) = sched.run([Request(prompt=list(p2), max_new_tokens=5)])
+    PoolAuditor().audit(eng)
+    return (list(r1.output_tokens), list(r2.output_tokens),
+            r2.reused_tokens)
+
+
+def test_mesh_tp1_host_tier_bitwise_vs_unsharded(lm_and_params):
+    """The mesh-lift pin, fast half: a tp=1 mesh host-tier engine
+    (shard_map-wrapped swap programs over one device) serves the
+    register → swap → hit-after-swap stream BITWISE identical to the
+    unsharded ``mesh=None`` host-tier engine, one compiled program per
+    swap direction on both."""
+    em = _mk_engine(lm_and_params, mesh=_mesh(1), host_tier=1 << 24)
+    e0 = _mk_engine(lm_and_params, host_tier=1 << 24)
+    try:
+        om, o0 = _serve_swap_stream(em), _serve_swap_stream(e0)
+        assert om == o0, "tp=1 mesh host tier diverged from mesh=None"
+        assert om[2] == 16
+        for eng in (em, e0):
+            assert eng.swap_out_traces == 1
+            assert eng.swap_in_traces == 1
+    finally:
+        em.close()
+        e0.close()
+
+
+@pytest.mark.slow
+def test_mesh_tp2_host_tier_token_exact_with_per_shard_records(
+        tp_lm_and_params):
+    """The mesh-lift pin, tp=2 half (CPU device emulation): the same
+    swap stream is token-exact vs mesh=None, and the arena records are
+    PER-SHARD — ``shards == tp`` with one CRC per shard, each
+    independently verifying exactly its shard's heads slice of the
+    stored bytes."""
+    em = _mk_engine(tp_lm_and_params, mesh=_mesh(2), host_tier=1 << 24)
+    e0 = _mk_engine(tp_lm_and_params, host_tier=1 << 24)
+    try:
+        assert _serve_swap_stream(em, vocab=VOCAB_TP) \
+            == _serve_swap_stream(e0, vocab=VOCAB_TP)
+        # force a fresh swap-out and inspect the resident record
+        rng = np.random.default_rng(7)
+        pre = list(rng.integers(1, VOCAB_TP, size=16))
+        Scheduler(em, retain_prefixes=True).run(
+            [Request(prompt=pre + [9], max_new_tokens=3)])
+        assert em.prefix_cache.evict_lru()
+        em._swap_worker.drain()
+        (key,) = em.host_tier.keys()
+        rec = em.host_tier._entries[key]
+        assert rec.shards == 2 and len(rec.crc) == 2
+        # each CRC covers exactly its shard's heads slice (K then V)
+        heads = rec.k.shape[2]
+        for t in range(2):
+            sl = slice(t * heads // 2, (t + 1) * heads // 2)
+            want = zlib.crc32(
+                np.ascontiguousarray(rec.v[:, :, sl]).tobytes(),
+                zlib.crc32(
+                    np.ascontiguousarray(rec.k[:, :, sl]).tobytes()))
+            assert rec.crc[t] == want, f"shard {t} CRC drifted"
+        # and per-shard verification is SENSITIVE: rot one shard's
+        # bytes and the take must flag the record invalid
+        em.host_tier.corrupt_entry(key)
+        bad = em.host_tier.take(key)
+        assert bad is not None and not bad.valid
+        em.prefix_cache.drop(key)
+        PoolAuditor().audit(em)
+    finally:
+        em.close()
+        e0.close()
+
+
+@pytest.mark.slow
+def test_swap_programs_compile_zero_collectives(tp_lm_and_params):
+    """The collective pin: compiled HLO of BOTH sharded swap programs
+    (tp=2) contains ZERO collectives — swap is pure data movement,
+    each shard gathers/scatters its own heads/tp slice of the pool.
+    A dedicated engine (``.lower()`` re-traces, which must not touch
+    the shared fixtures' trace pins)."""
+    import re as _re
+
+    eng = _mk_engine(tp_lm_and_params, mesh=_mesh(2),
+                     host_tier=1 << 24)
+    try:
+        ids = jnp.zeros(eng.max_pages, jnp.int32)
+        c = eng.cache
+        blk = jnp.zeros((c.layers, eng.max_pages, c.heads, c.page_len,
+                         c.head_dim), c.dtype)
+
+        def ncoll(txt):
+            return len(_re.findall(
+                r"= \S+ (all-reduce|all-gather|collective-permute|"
+                r"all-to-all)\(", txt))
+
+        out_hlo = eng._jit_swap_out.lower(
+            eng.cache, ids).compile().as_text()
+        in_hlo = eng._jit_swap_in.lower(
+            eng.cache, blk, blk, ids).compile().as_text()
+        assert ncoll(out_hlo) == 0, "swap-out grew a collective"
+        assert ncoll(in_hlo) == 0, "swap-in grew a collective"
+    finally:
+        eng.close()
+
+
+def test_router_probe_hits_swapping_entry_on_mesh_replica(
+        lm_and_params):
+    """Router × host-tier × mesh (the composition the mesh=None
+    restriction made untestable): an affinity probe landing on a
+    *swapping*-state entry — swap-out bytes still in flight — of a
+    MESH-SHARDED replica routes the request home, the hit joins the
+    copy, and the stream is bitwise identical to a never-swapped hit
+    on an identically-built bare scheduler."""
+    from apex_tpu import telemetry
+
+    em = _mk_engine(lm_and_params, mesh=_mesh(1), host_tier=1 << 24)
+    ep = _mk_engine(lm_and_params)
+    eo = _mk_engine(lm_and_params, mesh=_mesh(1), host_tier=1 << 24)
+    reg = telemetry.MetricsRegistry()
+    router = Router([em, ep], registry=reg, retain_prefixes=True)
+    try:
+        rng = np.random.default_rng(53)
+        pre = list(rng.integers(1, VOCAB, size=16))
+        p1, p2 = pre + [1, 2], pre + [3, 4]
+        # the never-swapped oracle: same stream, plain hit
+        so = Scheduler(eo, retain_prefixes=True)
+        (o1,) = so.run([Request(prompt=list(p1), max_new_tokens=5)])
+        (o2,) = so.run([Request(prompt=list(p2), max_new_tokens=5)])
+        # turn 1 routes to replica 0 (cold caches: least-loaded tie →
+        # lowest index) and registers its prefix there
+        (r1,) = router.run([Request(prompt=list(p1), max_new_tokens=5)])
+        assert router.placements[r1.uid] == 0
+        assert em.prefix_cache.size == 1
+        # squeeze the home replica: the entry enters the swapping
+        # state (swap dispatched, bytes gated in flight)
+        gate = _gate_worker(em)
+        assert em.prefix_cache.evict_lru()
+        assert em.host_tier.pending_keys()
+        hits0 = reg.snapshot()["counters"].get(
+            "serving.router.affinity_hits", 0)
+        threading.Timer(0.1, gate.set).start()
+        (r2,) = router.run([Request(prompt=list(p2), max_new_tokens=5)])
+        hits1 = reg.snapshot()["counters"].get(
+            "serving.router.affinity_hits", 0)
+        assert hits1 == hits0 + 1, "probe missed the swapping entry"
+        assert router.placements[r2.uid] == 0, "request routed away " \
+            "from its swapping prefix"
+        assert r2.reused_tokens == 16
+        assert r1.output_tokens == o1.output_tokens
+        assert r2.output_tokens == o2.output_tokens, \
+            "hit-through-swapping-state diverged"
+        # the tie-break input is dashboard-visible per replica
+        assert "serving.router.replica0.host_bytes_free" \
+            in reg.snapshot()["gauges"]
+        PoolAuditor().audit(em)
+    finally:
+        router.close()
+        eo.close()

@@ -59,15 +59,20 @@ and join the allowlist deliberately or take a compiled fixed-shape
 path.
 
 This file also owns the **force-early lint**: the dispatch-ahead
-region of ``scheduler.py`` (everything between a decode dispatch and
-its reconcile) must never force a device value to host — no ``int()``
-/ ``float()`` / ``np.asarray()`` / ``np.array()`` / ``jax.device_get``
-calls inside :func:`Scheduler._dispatch_decode` or
-:func:`Scheduler._pipeline_last_tokens`. A single forced read there
-serializes the host against the device and silently reverts the
-pipelined heartbeat to the sync one — the exact foot-gun the async
-refactor exists to remove, invisible to every parity test because
-forcing changes no tokens.
+regions of the serving stack must never force a device value to host
+— no ``int()`` / ``float()`` / ``np.asarray()`` / ``np.array()`` /
+``jax.device_get`` calls inside :func:`Scheduler._dispatch_decode`,
+:func:`Scheduler._pipeline_last_tokens` (the pipelined heartbeat:
+everything between a decode dispatch and its reconcile), or
+:func:`Engine._dispatch_swap_out` (the async hierarchical-KV
+swap-out's admission-side half: it snapshots pool bytes for the
+:class:`SwapWorker` by DISPATCHING a compiled gather — a forced read
+there silently reverts the tier to the synchronous admission stall).
+A single forced read in any of these serializes the host against the
+device with ZERO token-level symptom — the exact foot-gun the async
+refactors exist to remove, invisible to every parity test because
+forcing changes no tokens. Functions are checked BY NAME per file, so
+a rename breaks the lint loudly instead of silently un-scoping it.
 """
 
 import ast
@@ -175,7 +180,15 @@ def test_scan_surface_is_alive():
                  "serving.swap.hit_after_swap",
                  "serving.swap.verify_failed",
                  "serving.swap.host_evictions",
-                 "serving.swap.out_s", "serving.swap.in_s"):
+                 "serving.swap.out_s", "serving.swap.in_s",
+                 # the async swap-out's own family: the admission-path
+                 # stall histogram (the bench's sync-vs-async claim),
+                 # the in-flight-hit join counter and the worker-queue
+                 # depth gauge — any of these going dark hides whether
+                 # the async tier is actually off the hot path
+                 "serving.swap.admit_stall_s",
+                 "serving.swap.swap_join_waits",
+                 "serving.swap.swap_out_queue_depth"):
         assert engine_py in emitted.get(name, []), \
             f"{name} not emitted by the engine — hierarchical-KV " \
             "telemetry went dark"
@@ -215,11 +228,16 @@ def test_every_documented_fault_metric_is_emitted():
 
 
 # ------------------------------------------------- the force-early lint
-# Functions that make up the dispatch-ahead region: between issuing a
-# decode step and reconciling it, the host must never block on a device
-# value. These are checked by NAME so a rename breaks the lint loudly
-# instead of silently un-scoping it.
-_DISPATCH_REGION = ("_dispatch_decode", "_pipeline_last_tokens")
+# Functions that make up the dispatch-ahead regions, per file: between
+# issuing a decode step and reconciling it (scheduler), and between
+# dispatching a swap-out gather and the worker's deferred force
+# (engine), the host must never block on a device value. These are
+# checked by NAME so a rename breaks the lint loudly instead of
+# silently un-scoping it.
+_DISPATCH_REGION = {
+    "scheduler.py": ("_dispatch_decode", "_pipeline_last_tokens"),
+    "engine.py": ("_dispatch_swap_out",),
+}
 
 # Call shapes that force a device array to host. ``jnp.*`` stays legal
 # (device-side ops); ``np.zeros``/``np.flatnonzero`` over host state
@@ -247,43 +265,51 @@ def _forcing_calls(fn_node):
 
 
 def test_dispatch_ahead_region_never_forces_to_host():
-    """No code path between decode dispatch and reconcile may call
-    ``int()`` / ``float()`` / ``np.asarray`` / ``jax.device_get`` on
-    anything: a forced read there stalls the host on the in-flight
-    step and silently degrades pipeline_depth>=1 to the sync beat
-    (tokens identical, overlap gone — no parity test can catch it)."""
-    path = os.path.join(SRC_DIR, "scheduler.py")
-    with open(path) as f:
-        tree = ast.parse(f.read(), filename=path)
-    found = {}
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
-                and node.name in _DISPATCH_REGION:
-            found[node.name] = _forcing_calls(node)
-    missing = set(_DISPATCH_REGION) - set(found)
-    assert not missing, (
-        f"dispatch-ahead functions {sorted(missing)} not found in "
-        "scheduler.py — renamed? update _DISPATCH_REGION so the "
-        "force-early lint keeps covering the region")
-    offenders = {name: calls for name, calls in found.items() if calls}
-    assert not offenders, (
-        f"host-forcing calls inside the dispatch-ahead region "
-        f"(function -> [(call, line)]): {offenders} — these block the "
-        "host on in-flight device work, the exact stall the async "
-        "heartbeat exists to remove. Move the read to "
-        "_reconcile_oldest (the one batched readback site).")
+    """No code path between a dispatch and its reconcile/completion
+    may call ``int()`` / ``float()`` / ``np.asarray`` /
+    ``jax.device_get`` on anything: a forced read there stalls the
+    host on in-flight device work and silently degrades the async
+    path to its synchronous shape (pipeline_depth>=1 to the sync
+    beat; the async swap-out to the admission stall) — tokens
+    identical, overlap gone, no parity test can catch it."""
+    for fname, region in _DISPATCH_REGION.items():
+        path = os.path.join(SRC_DIR, fname)
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=path)
+        found = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)) \
+                    and node.name in region:
+                found[node.name] = _forcing_calls(node)
+        missing = set(region) - set(found)
+        assert not missing, (
+            f"dispatch-ahead functions {sorted(missing)} not found in "
+            f"{fname} — renamed? update _DISPATCH_REGION so the "
+            "force-early lint keeps covering the region")
+        offenders = {name: calls for name, calls in found.items()
+                     if calls}
+        assert not offenders, (
+            f"host-forcing calls inside {fname}'s dispatch-ahead "
+            f"region (function -> [(call, line)]): {offenders} — "
+            "these block the host on in-flight device work, the exact "
+            "stall the async refactors exist to remove. Move the read "
+            "to the reconcile/complete half (_reconcile_oldest / "
+            "_complete_swap_out — the batched readback sites).")
 
 
 # ---------------------------------------------- the eager-gather shape lint
 # Fancy-index gathers over the device KV pool arrays that are ALLOWED
 # because their index operand is padded to a fixed bound (max_pages,
 # page-0 sentinel absorbing the padding) so one compiled shape serves
-# every entry size: the two host_tier swap-out reads. Keyed
-# (file, function, gathered-array) so a refactor that moves or renames
-# a site re-reviews its padding deliberately.
+# every entry size: the compiled swap-out gather's two pool reads
+# (its page_ids operand is always a padded [max_pages] array — see
+# Engine._dispatch_swap_out). Keyed (file, function, gathered-array)
+# so a refactor that moves or renames a site re-reviews its padding
+# deliberately.
 _PADDED_GATHERS_ALLOWED = {
-    ("engine.py", "_swap_out_pages", "self.cache.k"),
-    ("engine.py", "_swap_out_pages", "self.cache.v"),
+    ("engine.py", "_swap_out_impl", "cache.k"),
+    ("engine.py", "_swap_out_impl", "cache.v"),
 }
 
 

@@ -221,9 +221,10 @@ def test_quantized_weights_owe_the_tables_no_new_keys():
 
 def test_host_tier_owes_the_tables_no_new_keys():
     """The hierarchical-KV satellite, in the copy-program pattern: the
-    host tier is pure data movement — swap-out is a forced device read
-    (no program at all) and swap-in is one fixed-shape page-block
-    scatter (no attention, no Pallas kernel, no grid) —
+    host tier is pure data movement — swap-out is one fixed-shape
+    page-block gather and swap-in one fixed-shape page-block scatter
+    (no attention, no Pallas kernel, no grid; both shard_map over the
+    pool's heads axis under a mesh with zero collectives) —
     so it introduces NO new ``decode.*`` tuned key; restored pages are
     read back through the EXISTING paged-attention knobs. Any
     ``decode.swap_*`` / ``decode.host_*`` row would be a dead sweep,
