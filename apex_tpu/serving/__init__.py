@@ -143,7 +143,7 @@ Exercised end-to-end by ``bench_serving.py`` and
 from . import sharding
 from .engine import Engine, PendingDecode, sample_tokens
 from .faults import (FaultPlan, FaultPolicy, FaultSpec, InjectedFault,
-                     PoolAuditor, PoolInvariantError)
+                     PoolAuditor, PoolInvariantError, fault_kind)
 from .host_tier import HostTier, SwapWorker
 from .kv_cache import KVCache, PagedKVCache, PagePool
 from .kv_quant import KVQuantConfig
@@ -159,5 +159,5 @@ __all__ = ["DraftWorker", "Engine", "FaultPlan", "FaultPolicy",
            "PoolAuditor", "PoolInvariantError", "PrefixCache",
            "PrefixMatch", "QueueFull", "Request", "RequestStatus",
            "Router", "Scheduler", "SpecConfig", "SwapWorker",
-           "WeightQuantConfig", "draft_tokens", "sample_tokens",
-           "sharding"]
+           "WeightQuantConfig", "draft_tokens", "fault_kind",
+           "sample_tokens", "sharding"]

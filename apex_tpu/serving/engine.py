@@ -717,6 +717,13 @@ class Engine:
                 # finalizer closes over the WORKER, not self — no cycle)
                 weakref.finalize(self, self._swap_worker.stop)
         self._registry = registry
+        # request tracer (None = off): installed by the scheduler via
+        # set_tracer. The engine's only spans are the hierarchical-KV
+        # migrations (swap_out / swap_out_store / swap_in) — emitted
+        # through event_current against the thread-local trace binding
+        # the scheduler's admission path holds, since the engine never
+        # sees a Request
+        self._tracer = None
         self._key = jax.random.PRNGKey(seed)
         self.prefill_traces = 0
         self.decode_traces = 0
@@ -1680,8 +1687,19 @@ class Engine:
         ids[:m] = list(pages)
         k_dev, v_dev = self._runtime_call(
             lambda: self._jit_swap_out(self.cache, jnp.asarray(ids)))
+        tr = self._tracer
+        ctx = None
+        if tr is not None:
+            # the admission-side span: dispatch cost only (nbytes and
+            # m are pure shape arithmetic — this hook, like the rest
+            # of the region, performs no forced read); the trace
+            # binding is captured NOW so the worker-side store span
+            # joins the same request's trace from its own thread
+            ctx = tr.current()
+            tr.event_current("swap_out", t0=t0, dur=tr.now() - t0,
+                             key=key, pages=m, bytes=nbytes)
         job = lambda: self._complete_swap_out(  # noqa: E731
-            key, k_dev, v_dev, m, t0)
+            key, k_dev, v_dev, m, t0, trace_id=ctx)
         if self._swap_worker is None:
             job()                   # sync_swap: the measurable baseline
         else:
@@ -1696,7 +1714,7 @@ class Engine:
         return True
 
     def _complete_swap_out(self, key, k_dev, v_dev, m: int,
-                           t0: float) -> None:
+                           t0: float, trace_id=None) -> None:
         """The WORKER-SIDE half of a swap-out: force the dispatched
         snapshot blocks to host (the memcpy the async tier moves off
         the admission path), slice off the sentinel padding, and
@@ -1724,7 +1742,17 @@ class Engine:
         v_host = np.asarray(v_dev)[:, :m]
         if inline:
             self.device_wait_s += time.perf_counter() - tw
-        if not tier.complete(key, k_host, v_host):
+        stored = tier.complete(key, k_host, v_host)
+        tr = self._tracer
+        if tr is not None and trace_id is not None:
+            # emitted from whichever thread ran the force — the
+            # serving-swap-worker daemon by default — with the trace
+            # id captured at dispatch: honest cross-thread attribution
+            tr.event(trace_id, "swap_out_store", t0=tw,
+                     dur=time.perf_counter() - tw, key=key, pages=m,
+                     bytes=k_host.nbytes + v_host.nbytes,
+                     stored=stored, inline=inline)
+        if not stored:
             return                  # evicted mid-flight: bytes dropped
         if self._registry is not None:
             self._registry.counter_inc("serving.swap.swapped_out_pages",
@@ -1738,6 +1766,22 @@ class Engine:
         self.swap_verify_failed += 1
         if self._registry is not None:
             self._registry.counter_inc("serving.swap.verify_failed")
+
+    def _trace_swap_in(self, t0: float, key: int, joined: bool,
+                       outcome: str, pages: int) -> None:
+        """One ``swap_in`` span per host→device migration attempt,
+        attributed to the admitting request via the scheduler's
+        thread-local binding (a no-op without a tracer or binding).
+        ``outcome`` is ``restored`` / ``verify_failed`` (missing or
+        checksum-failed bytes — the CRC verdict) / ``deferred`` (pool
+        too tight); ``joined`` marks a hit that waited on its own
+        in-flight swap-out."""
+        tr = self._tracer
+        if tr is not None:
+            tr.event_current("swap_in", t0=t0,
+                             dur=time.perf_counter() - t0, key=key,
+                             joined=joined, outcome=outcome,
+                             pages=pages, crc_ok=outcome != "verify_failed")
 
     def _swap_in(self, key: int):
         """Migrate a swapped prefix entry's page bytes host→device:
@@ -1767,8 +1811,10 @@ class Engine:
         verified miss as missing bytes."""
         tier, pcache = self.host_tier, self.prefix_cache
         t0 = time.perf_counter()
+        joined = False
         if tier is not None and self._swap_worker is not None \
                 and self._swap_worker.in_flight(key):
+            joined = True
             if self._registry is not None:
                 self._registry.counter_inc("serving.swap.swap_join_waits")
             tw = time.perf_counter()
@@ -1787,6 +1833,7 @@ class Engine:
         if rec is None or not rec.valid:
             pcache.drop(key)
             self._count_swap_verify_failed()
+            self._trace_swap_in(t0, key, joined, "verify_failed", 0)
             return None
         k_host, v_host = rec.k, rec.v
         c = self.cache
@@ -1797,11 +1844,13 @@ class Engine:
                 or v_host.dtype != np.dtype(c.dtype):
             pcache.drop(key)
             self._count_swap_verify_failed()
+            self._trace_swap_in(t0, key, joined, "verify_failed", 0)
             return None
         m = int(k_host.shape[1])
         if m > self.max_pages:
             pcache.drop(key)
             self._count_swap_verify_failed()
+            self._trace_swap_in(t0, key, joined, "verify_failed", 0)
             return None
         # unreserved allocation must never eat into admission promises:
         # draw only from `available` (free minus reserved), making room
@@ -1811,6 +1860,7 @@ class Engine:
                 tier.put(key, k_host, v_host, shards=rec.shards)
                 _logger.debug("swap-in of entry %d deferred: pool too "
                               "tight for %d pages", key, m)
+                self._trace_swap_in(t0, key, joined, "deferred", 0)
                 return None
         pages = [self.pool.alloc() for _ in range(m)]
         # one fixed-shape dispatch restores the whole entry: pad the
@@ -1830,6 +1880,7 @@ class Engine:
                                       jnp.asarray(v_blk),
                                       jnp.asarray(ids)))
         pcache.swap_in_complete(key, pages)
+        self._trace_swap_in(t0, key, joined, "restored", m)
         if self._registry is not None:
             self._registry.counter_inc("serving.swap.swapped_in_pages",
                                        m)
@@ -2330,6 +2381,12 @@ class Engine:
         self._emit_tp_gauges()
         self._emit_kv_gauges()
         self._emit_wq_gauges()
+
+    def set_tracer(self, tracer) -> None:
+        """Install a request tracer (``Scheduler(tracer=...)`` calls
+        this); the engine's swap-path spans then attribute to the
+        admitting request via the scheduler's thread-local binding."""
+        self._tracer = tracer
 
     def reset(self, clear_prefixes: bool = False) -> None:
         """Zero the serving-slot lengths (slot table wipe; K/V left in

@@ -65,9 +65,30 @@ import numpy as np
 from apex_tpu.log_util import get_logger
 
 __all__ = ["FaultSpec", "FaultPlan", "FaultPolicy", "InjectedFault",
-           "PoolAuditor", "PoolInvariantError"]
+           "PoolAuditor", "PoolInvariantError", "fault_kind"]
 
 _logger = get_logger("serving")
+
+
+def fault_kind(error: Optional[str]) -> str:
+    """Coarse classification of a quarantine error string — the
+    ``kind`` annotation the request tracer stamps on ``quarantine``
+    spans (and anything else that wants to bucket faults without
+    parsing free text): ``"nonfinite"`` for guard-flagged NaN/Inf
+    logits, ``"swap"`` for hierarchical-KV verification failures,
+    ``"injected"`` for :class:`InjectedFault` transients (the chaos
+    harness's signature), ``"exception"`` for every other transient.
+    Checked in that order: an injected *non-finite* fault surfaces
+    through the guard's error text and classifies as the numeric
+    fault it manifested as."""
+    low = (error or "").lower()
+    if "non-finite" in low or "nan" in low or "inf " in low:
+        return "nonfinite"
+    if "swap" in low or "checksum" in low or "crc" in low:
+        return "swap"
+    if "injectedfault" in low:
+        return "injected"
+    return "exception"
 
 # injection sites a FaultSpec(kind="exception") may name ("verify" is
 # the speculative draft-and-verify call; it only fires on schedulers

@@ -446,7 +446,14 @@ class SwapWorker:
     arena and the prefix index still reconcile); :meth:`stop` drains
     then shuts the thread down (idempotent — the engine registers it
     with ``weakref.finalize``). After stop, :meth:`submit` runs jobs
-    inline — the sync degradation, never a dropped swap."""
+    inline — the sync degradation, never a dropped swap.
+
+    Job closures MAY emit request-trace spans (:mod:`apex_tpu
+    .telemetry.tracing`): the engine captures the admitting request's
+    trace id at dispatch and the job's ``swap_out_store`` span lands
+    on this thread (``serving-swap-worker`` in the Chrome trace) —
+    the tracer is lock-protected and appends are token-invisible, so
+    the purity contract above is untouched."""
 
     _MAX_ERRORS = 64
 

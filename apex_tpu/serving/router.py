@@ -140,6 +140,15 @@ class Router:
         Optional per-replica scheduler fault plans (length N), passed
         through to each :class:`~apex_tpu.serving.Scheduler` — replica-
         tier chaos composes with router-tier deaths.
+    tracer:
+        Optional :class:`~apex_tpu.telemetry.Tracer`: request-level
+        lifecycle tracing. The router emits one ``route`` span per
+        submitted request (chosen replica, probed affinity length,
+        spill count) and hands each replica a ``for_replica(i)`` view
+        so every downstream span carries the replica index as its
+        Chrome ``pid``. ``None`` (default) is the zero-cost off
+        switch — no span objects exist and token streams are bitwise
+        unchanged.
     **scheduler_kw:
         Everything else a :class:`~apex_tpu.serving.Scheduler` takes
         (``max_queue`` — PER REPLICA — ``eos_id``, ``chunked``,
@@ -149,7 +158,8 @@ class Router:
 
     def __init__(self, engines: Sequence, *, registry=None,
                  route_policy: str = "affinity", seed: int = 0,
-                 fault_plan=None, replica_plans=None, **scheduler_kw):
+                 fault_plan=None, replica_plans=None, tracer=None,
+                 **scheduler_kw):
         engines = list(engines)
         if not engines:
             raise ValueError("Router needs at least one engine")
@@ -172,13 +182,21 @@ class Router:
         self.registry = registry
         self.route_policy = route_policy
         self.fault_plan = fault_plan
+        self.tracer = tracer
         self._rng = np.random.default_rng(seed)
+        # each replica gets a for_replica(i) view of the tracer, so
+        # every span its scheduler/engine/workers emit lands under
+        # Chrome process i without threading pid through call sites
         self.replicas: List[Scheduler] = [
             Scheduler(e, registry=registry,
                       fault_plan=replica_plans[i]
                       if replica_plans is not None else None,
+                      tracer=tracer.for_replica(i)
+                      if tracer is not None else None,
                       **scheduler_kw)
             for i, e in enumerate(engines)]
+        for i, s in enumerate(self.replicas):
+            s.replica_index = i     # stamps completion records
         self.alive: List[bool] = [True] * len(self.replicas)
         # affinity needs something to probe: with retention off the
         # caches stay empty, so the policy honestly degrades to pure
@@ -242,10 +260,19 @@ class Router:
         keys = None
         lens = {i: 0 for i in alive}
         if self.affinity_enabled:
-            keys = self._probe_keys(request)
-            for i in alive:
-                lens[i] = self.replicas[i].engine.prefix_cache.probe(
-                    request.prompt, keys=keys)
+            pc0 = self.replicas[alive[0]].engine.prefix_cache
+            if len(request.prompt) < pc0.block_len:
+                # a sub-block prompt can never match a cache entry:
+                # skip the hash walk AND the N probes ([] is exactly
+                # what block_keys returns for zero full blocks, so
+                # downstream consumers see identical values)
+                keys = []
+            else:
+                keys = self._probe_keys(request)
+                for i in alive:
+                    lens[i] = \
+                        self.replicas[i].engine.prefix_cache.probe(
+                            request.prompt, keys=keys)
         snaps = {i: self.replicas[i].load_snapshot() for i in alive}
         order = sorted(alive, key=lambda i: (
             -lens[i],
@@ -266,6 +293,7 @@ class Router:
         when EVERY live replica's queue is at capacity —
         ``retry_after_s`` is then the max of the replicas' measured
         hints (None when no replica has measured a decode step yet)."""
+        t_route = self.tracer.now() if self.tracer is not None else 0.0
         keys, order, lens = self._route_order(request)
         hints: List[Optional[float]] = []
         for n_spilled, i in enumerate(order):
@@ -292,6 +320,15 @@ class Router:
                 if n_spilled:
                     self.registry.counter_inc("serving.router.spills",
                                               n_spilled)
+            if self.tracer is not None:
+                # the routing decision, on the chosen replica's lane:
+                # probed affinity length, spill count, policy
+                self.tracer.event(request.uid, "route", t0=t_route,
+                                  dur=self.tracer.now() - t_route,
+                                  pid=i, replica=i,
+                                  policy=self.route_policy,
+                                  affinity_len=lens[i],
+                                  spills=n_spilled)
             return request
         hint = max((h for h in hints if h is not None), default=None)
         if self.registry is not None:

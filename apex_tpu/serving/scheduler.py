@@ -171,7 +171,7 @@ import numpy as np
 
 from apex_tpu.log_util import get_logger
 
-from .faults import FaultPolicy, PoolAuditor
+from .faults import FaultPolicy, PoolAuditor, fault_kind
 from .speculative import DraftWorker, draft_tokens
 
 __all__ = ["Request", "RequestStatus", "QueueFull", "Scheduler"]
@@ -324,7 +324,8 @@ class Scheduler:
                  pipeline_depth: int = 0,
                  fault_policy: Optional[FaultPolicy] = None,
                  fault_plan=None,
-                 auditor: Optional[PoolAuditor] = None):
+                 auditor: Optional[PoolAuditor] = None,
+                 tracer=None):
         if max_queue < 1:
             raise ValueError("max_queue must be >= 1")
         if chunk_budget < 1:
@@ -357,6 +358,18 @@ class Scheduler:
         self.speculative = bool(speculative)
         self.registry = registry if registry is not None \
             else getattr(engine, "_registry", None)
+        # request tracing (None = off, the zero-cost default: every
+        # hook below is an `is not None` guard around pure host-clock
+        # reads — no span objects exist, no tokens change, pinned by
+        # tests/L0/test_tracing.py). The tracer propagates to the
+        # engine so swap-path spans (which never see a Request) attach
+        # to the admitting request via the thread-local binding the
+        # admission path holds. ``replica_index`` stamps completion
+        # records and is rewritten by the Router (replica i).
+        self.tracer = tracer
+        self.replica_index = 0
+        if tracer is not None and hasattr(engine, "set_tracer"):
+            engine.set_tracer(tracer)
         # registry wiring: several engine-side metrics (the guard's
         # serving.faults.nonfinite above all) are emitted by the
         # ENGINE's registry — a scheduler-only registry would silently
@@ -474,6 +487,11 @@ class Scheduler:
         if request._t_submit is None:
             request._t_submit = now
         request._t_queued = now
+        if self.tracer is not None:
+            self.tracer.event(request.uid, "submit", t0=now,
+                              prompt_tokens=n,
+                              max_new_tokens=request.max_new_tokens,
+                              retry=request.retries)
         self._queue.append(request)
         if self.retain_prefixes and prefix_keys is not None:
             # the router's pre-probed hashes: admission consumes them
@@ -550,6 +568,19 @@ class Scheduler:
         self._presubmitted_keys.pop(request.uid, None)
         if request._t_submit is not None:
             request.latency_s = time.perf_counter() - request._t_submit
+        if self.tracer is not None:
+            # the trace's single TERMINAL span, spelled as three
+            # explicit literals (the span-name lint reads literals):
+            # sealing is first-wins, so a late double-finish is inert
+            tr = self.tracer
+            if status is RequestStatus.EXPIRED:
+                tr.end_trace(request.uid, "expired", reason=reason)
+            elif status is RequestStatus.FAILED:
+                tr.end_trace(request.uid, "failed", reason=reason,
+                             error=request.error)
+            else:
+                tr.end_trace(request.uid, "finish", reason=reason,
+                             output_tokens=len(request.output_tokens))
         if slot is not None:
             self._free_slot(slot)
         self.completed.append(request)
@@ -565,6 +596,8 @@ class Scheduler:
             # histograms — don't grow junk reservoirs per request)
             self.registry.record_step({
                 "uid": request.uid,
+                "trace_id": request.uid,
+                "replica": self.replica_index,
                 "status": request.status.value,
                 "finish_reason": reason,
                 "prompt_tokens": len(request.prompt),
@@ -597,9 +630,14 @@ class Scheduler:
         boundary."""
         request.retries += 1
         request.error = error
+        policy = self.fault_policy
+        if self.tracer is not None:
+            self.tracer.event(
+                request.uid, "quarantine", kind=fault_kind(error),
+                error=error, retry=request.retries,
+                requeued=request.retries <= policy.max_retries)
         if slot is not None:
             self._free_slot(slot)
-        policy = self.fault_policy
         if request.retries > policy.max_retries:
             _logger.warning(
                 "request %d FAILED after %d retries: %s", request.uid,
@@ -688,7 +726,25 @@ class Scheduler:
             r.status = RequestStatus.PREFILLING
             r._prefill_pos = 0
             if self.retain_prefixes:
-                self._consult_prefix_cache(r, slot)
+                if self.tracer is not None:
+                    # bind the trace to this thread so swap-in /
+                    # swap-out spans the prefix attach triggers inside
+                    # the engine attribute to the admitting request
+                    with self.tracer.bind(r.uid):
+                        self._consult_prefix_cache(r, slot)
+                else:
+                    self._consult_prefix_cache(r, slot)
+            if self.tracer is not None:
+                tr = self.tracer
+                t_adm = tr.now()
+                tr.event(r.uid, "queue_wait",
+                         t0=t_adm - r.queue_wait_s, dur=r.queue_wait_s)
+                tr.event(r.uid, "admit", t0=t_adm, slot=slot,
+                         reused_tokens=r.reused_tokens,
+                         pages=(self.engine.pages_required(
+                             len(r.prompt), r.max_new_tokens)
+                             if getattr(self.engine, "paged", False)
+                             else 0))
             self._running[slot] = r
             self._temps[slot] = r.temperature
 
@@ -783,6 +839,19 @@ class Scheduler:
                 if self.registry is not None:
                     self.registry.observe("serving.queue_wait_s",
                                           r.queue_wait_s)
+                if self.tracer is not None:
+                    tr = self.tracer
+                    t_adm = tr.now()
+                    tr.event(r.uid, "queue_wait",
+                             t0=t_adm - r.queue_wait_s,
+                             dur=r.queue_wait_s)
+                    tr.event(r.uid, "admit", t0=t_adm, slot=slot,
+                             reused_tokens=0,
+                             pages=(self.engine.pages_required(
+                                 len(r.prompt), r.max_new_tokens,
+                                 monolithic=True)
+                                 if getattr(self.engine, "paged",
+                                            False) else 0))
                 t0 = time.perf_counter()
                 try:
                     token = self.engine.prefill(
@@ -795,6 +864,11 @@ class Scheduler:
                     continue
                 r.prefill_s += time.perf_counter() - t0
                 r.chunks += 1
+                if self.tracer is not None:
+                    self.tracer.event(r.uid, "prefill_chunk", t0=t0,
+                                      dur=time.perf_counter() - t0,
+                                      lo=0, hi=len(r.prompt),
+                                      final=True)
                 if not self.engine.last_prefill_finite:
                     # non-finite prompt logits: the sampled token is
                     # garbage — quarantine instead of emitting it
@@ -871,6 +945,10 @@ class Scheduler:
             r._prefill_pos = hi
             r.chunks += 1
             ran += 1
+            if self.tracer is not None:
+                self.tracer.event(r.uid, "prefill_chunk", t0=t0,
+                                  dur=time.perf_counter() - t0,
+                                  lo=lo, hi=hi, final=final)
             # next tick resumes AFTER the last slot served, so slots
             # separated by gaps still ingest at the same rate (a +1
             # bump would serve the slot after a gap twice as often)
@@ -887,7 +965,14 @@ class Scheduler:
             if not final:
                 continue
             if self.retain_prefixes:
-                self._register_prefix(r, slot)
+                if self.tracer is not None:
+                    # registration can evict a prefix entry, which on a
+                    # hierarchical-KV engine dispatches a swap-out —
+                    # bind so those spans attribute to this request
+                    with self.tracer.bind(r.uid):
+                        self._register_prefix(r, slot)
+                else:
+                    self._register_prefix(r, slot)
             r.ttft_s = time.perf_counter() - r._t_submit
             if self.registry is not None:
                 self.registry.observe("serving.ttft_s", r.ttft_s)
@@ -989,6 +1074,7 @@ class Scheduler:
             pending.append((slot, r, draft, offset))
         if not pending:
             return verified, calls, emitted
+        t0v = self.tracer.now() if self.tracer is not None else 0.0
         try:
             if self.fault_plan is not None:
                 # the exception site raises INSTEAD of the call, so it
@@ -1036,6 +1122,8 @@ class Scheduler:
         toks = np.asarray(toks)
         n_acc = np.asarray(n_acc, np.int32)
         finite = eng.last_verify_finite_slots
+        durv = self.tracer.now() - t0v if self.tracer is not None \
+            else 0.0
         for slot, r, draft, offset in pending:
             if not finite[slot]:
                 # the in-program guard flagged this row's logits: every
@@ -1057,6 +1145,12 @@ class Scheduler:
                 self.registry.counter_inc("serving.spec.accepted", m)
                 self.registry.observe("serving.spec.acceptance_rate",
                                       m / len(draft))
+            if self.tracer is not None:
+                # one shared compiled call: every surviving row's span
+                # covers the same interval, annotated per-slot
+                self.tracer.event(r.uid, "verify", t0=t0v, dur=durv,
+                                  slot=slot, drafted=len(draft),
+                                  accepted=m)
             verified.add(slot)
             # emit the accepted prefix + bonus token through the SAME
             # per-token finish checks plain decode applies (EOS first,
@@ -1100,10 +1194,28 @@ class Scheduler:
         byte-identical either way (``draft_tokens`` is pure)."""
         cfg = self.engine.spec
         toks = list(r.prompt) + list(r.output_tokens)
-        fn = lambda toks=toks: draft_tokens(toks, cfg)  # noqa: E731
+        fn = self._draft_fn(r.uid, toks, cfg)
         if self._worker is None:
             return fn()
         return self._worker.take(self._draft_key(r), fn)
+
+    def _draft_fn(self, uid, toks, cfg):
+        """The draft job closure. With a tracer attached it self-times
+        and emits a ``draft`` span FROM INSIDE the closure, so the span
+        lands on whichever thread actually ran the computation (the
+        ``serving-draft-worker`` daemon in pipelined mode, the
+        heartbeat thread inline) — honest cross-thread attribution."""
+        tr = self.tracer
+        if tr is None:
+            return lambda: draft_tokens(toks, cfg)
+
+        def job():
+            t0 = tr.now()
+            d = draft_tokens(toks, cfg)
+            tr.event(uid, "draft", t0=t0, dur=tr.now() - t0,
+                     drafted=len(d))
+            return d
+        return job
 
     def _presubmit_draft(self, r: Request) -> None:
         """Queue the request's next draft on the worker thread (no-op
@@ -1116,9 +1228,8 @@ class Scheduler:
         if cfg is None:
             return
         toks = list(r.prompt) + list(r.output_tokens)
-        self._worker.submit(
-            self._draft_key(r),
-            lambda toks=toks: draft_tokens(toks, cfg))
+        self._worker.submit(self._draft_key(r),
+                            self._draft_fn(r.uid, toks, cfg))
 
     # ------------------------------------------------------------- stepping
     def step(self) -> bool:
@@ -1155,6 +1266,11 @@ class Scheduler:
                 self.fault_plan.maybe_corrupt_swap(tick, tier)
         compiled0 = getattr(self.engine, "compiled_programs", 0)
         dw0 = getattr(self.engine, "device_wait_s", 0.0)
+        # requests riding this beat, snapshotted BEFORE the body so
+        # finish/quarantine churn inside it cannot drop participants
+        # (None when tracing is off — no allocation on the hot path)
+        uids0 = [r.uid for r in self._running if r is not None] \
+            if self.tracer is not None else None
         try:
             if self.pipeline_depth > 0:
                 return self._step_body_pipelined(tick)
@@ -1164,6 +1280,16 @@ class Scheduler:
             dwait = max(0.0, getattr(self.engine, "device_wait_s", 0.0)
                         - dw0)
             host_s = max(elapsed - dwait, 0.0)
+            if self.tracer is not None and uids0:
+                # one heartbeat span per request that rode this beat,
+                # carrying the PR 11 host-think vs device-wait split —
+                # attribution rides the EXISTING accounting, no new
+                # forced reads
+                for uid in uids0:
+                    self.tracer.event(uid, "heartbeat", t0=t_tick,
+                                      dur=elapsed, tick=tick,
+                                      host_s=host_s,
+                                      device_wait_s=dwait)
             if self.registry is not None:
                 self.registry.observe("serving.heartbeat.host_s",
                                       host_s)

@@ -111,7 +111,15 @@ class DraftWorker:
     request that finished before its draft was needed) age out of a
     small ring so the worker cannot leak memory across a long serve.
     The thread is a daemon and :meth:`stop` is idempotent — the
-    scheduler registers it with ``weakref.finalize``."""
+    scheduler registers it with ``weakref.finalize``.
+
+    Job closures MAY emit request-trace spans (:mod:`apex_tpu
+    .telemetry.tracing`): with a tracer attached the scheduler's
+    draft closures self-time and emit their ``draft`` span from
+    whichever thread runs them, so drafting work shows up on this
+    thread's lane (``serving-draft-worker``) in the Chrome trace —
+    the tracer is lock-protected and appends are token-invisible, so
+    the purity contract above is untouched."""
 
     _MAX_UNCLAIMED = 256
 

@@ -23,9 +23,16 @@ Layers:
   for every ``apex_tpu.comm`` collective and the DDP grad allreduce;
   device latency joins in through the profiler
   (``summarize --trace``).
+- request tracing (:mod:`.tracing`) — :class:`Tracer`: span-based
+  per-request lifecycle traces for the serving stack (``submit`` →
+  ``route`` → ``admit`` → ``prefill_chunk``/``heartbeat`` → terminal),
+  Chrome-trace/Perfetto + JSONL exporters; attached via
+  ``Scheduler(tracer=)`` / ``Router(tracer=)``, zero-cost when off.
 - CLI (:mod:`.__main__`) — ``python -m apex_tpu.telemetry summarize
   run.jsonl [--trace DIR]``: per-metric count/mean/p50/p95/p99 plus the
-  device step-time breakdown joined from a ``pyprof.trace`` capture.
+  device step-time breakdown joined from a ``pyprof.trace`` capture;
+  ``python -m apex_tpu.telemetry trace spans.jsonl``: per-stage span
+  latency + critical-path breakdown of a request-trace file.
 
 Quick start::
 
@@ -56,11 +63,13 @@ from .emit import (account_collective, collective_bytes, emit_metrics,
                    global_norm)
 from .sinks import (JsonlSink, MemorySink, NullSink, Sink, StdoutSink,
                     make_sink)
+from .tracing import Span, Trace, Tracer
 
 __all__ = [
     "MetricsRegistry", "StepRecord", "StreamingHistogram",
     "Sink", "JsonlSink", "StdoutSink", "NullSink", "MemorySink",
     "make_sink",
+    "Span", "Trace", "Tracer",
     "emit_metrics", "account_collective", "collective_bytes", "global_norm",
     "enable", "enabled", "get_registry", "set_registry", "configure",
     "start_run", "from_env", "timed", "guard_bench_main",

@@ -2,12 +2,21 @@
 
     python -m apex_tpu.telemetry summarize run.jsonl [--tag T] [--json]
                                                       [--trace DIR]
+    python -m apex_tpu.telemetry trace spans.jsonl [--requests RUN]
+                                                    [--json]
 
-Renders per-metric count/mean/p50/p95/p99 aggregates of a telemetry
-JSONL run file; ``--trace`` additionally joins a ``pyprof.trace``
-capture into a device step-time breakdown (ms/step per HLO category,
-collective-op latency). ``--json`` emits the machine form instead of the
-tables.
+``summarize`` renders per-metric count/mean/p50/p95/p99 aggregates of a
+telemetry JSONL run file; ``--trace`` additionally joins a
+``pyprof.trace`` capture into a device step-time breakdown (ms/step per
+HLO category, collective-op latency).
+
+``trace`` summarizes a request-trace JSONL file (what
+:meth:`~apex_tpu.telemetry.Tracer.export_jsonl` wrote): per-stage span
+latency p50/p99, the critical-path breakdown, and — via ``--requests``
+(defaults to the same file, since one sink may carry both streams) —
+the join with ``serving.request`` completion records on ``trace_id``.
+
+``--json`` emits the machine form instead of the tables.
 """
 
 from __future__ import annotations
@@ -17,7 +26,8 @@ import json
 import sys
 
 from .summarize import (load_records, render_breakdown, render_summary,
-                        summarize_records, trace_breakdown)
+                        render_trace_summary, summarize_records,
+                        summarize_trace, trace_breakdown)
 
 
 def main(argv=None):
@@ -35,8 +45,20 @@ def main(argv=None):
                         "breakdown + collective latency")
     s.add_argument("--json", action="store_true",
                    help="machine-readable output instead of tables")
+    t = sub.add_parser("trace",
+                       help="summarize a serving request-trace JSONL "
+                            "file (Tracer.export_jsonl output)")
+    t.add_argument("run", help="JSONL file Tracer.export_jsonl wrote")
+    t.add_argument("--requests", default=None, metavar="RUN",
+                   help="JSONL with serving.request completion records "
+                        "to join on trace_id (default: the trace file "
+                        "itself)")
+    t.add_argument("--json", action="store_true",
+                   help="machine-readable output instead of tables")
     args = p.parse_args(argv)
 
+    if args.cmd == "trace":
+        return _main_trace(args)
     try:
         records = load_records(args.run)
     except OSError as e:
@@ -64,6 +86,29 @@ def main(argv=None):
         if breakdown is not None:
             print()
             print(render_breakdown(breakdown))
+    return 0
+
+
+def _main_trace(args):
+    try:
+        records = load_records(args.run)
+    except OSError as e:
+        raise SystemExit(str(e))
+    if not any(r.get("tag") == "serving.trace" for r in records):
+        raise SystemExit(f"no serving.trace records in {args.run!r} — "
+                         "is this a Tracer.export_jsonl file?")
+    if args.requests is None:
+        request_records = records
+    else:
+        try:
+            request_records = load_records(args.requests)
+        except OSError as e:
+            raise SystemExit(str(e))
+    summary = summarize_trace(records, request_records)
+    if args.json:
+        print(json.dumps(summary))
+    else:
+        print(render_trace_summary(summary))
     return 0
 
 

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import math
 import random
+import re
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -106,6 +107,65 @@ class StreamingHistogram:
             "min": self.min,
             "max": self.max,
         }
+
+
+# ---------------------------------------------------------- prometheus
+# Fixed histogram bucket ladder (seconds-flavored, matching the
+# prometheus_client defaults extended one decade down) — a FIXED ladder
+# keeps the exposition stable across runs, which the golden test pins.
+_PROM_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.075,
+                 0.1, 0.25, 0.5, 0.75, 1.0, 2.5, 5.0, 7.5, 10.0, 25.0,
+                 50.0, 100.0)
+
+#: ``serving.router.replica<i>.<gauge>`` → labeled series
+_PROM_REPLICA_RE = re.compile(r"^serving\.router\.replica(\d+)\.(.+)$")
+
+_PROM_BAD_CHARS = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str) -> str:
+    """A valid Prometheus metric name: dots and every other character
+    outside ``[a-zA-Z0-9_:]`` become ``_``; a leading digit gets a
+    ``_`` prefix (the text exposition format rejects it outright)."""
+    out = _PROM_BAD_CHARS.sub("_", name)
+    if out and out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def _prom_value(v: float) -> str:
+    """Prometheus sample value: integral floats render without the
+    trailing ``.0`` noise (counters read as counts), non-finite values
+    as the spec's ``+Inf``/``-Inf``/``NaN`` tokens."""
+    f = float(v)
+    if math.isnan(f):
+        return "NaN"
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _prom_split(name: str):
+    """``(prom_name, labels_dict)`` for a metric name: the per-replica
+    router gauge namespace collapses into one labeled series family
+    (``serving.router.replica3.queue_depth`` →
+    ``serving_router_replica_queue_depth{replica="3"}``); everything
+    else is label-less."""
+    m = _PROM_REPLICA_RE.match(name)
+    if m:
+        return (_prom_name(f"serving.router.replica.{m.group(2)}"),
+                {"replica": m.group(1)})
+    return _prom_name(name), {}
+
+
+def _prom_labels(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
 
 
 def _jsonable_scalar(v):
@@ -236,6 +296,69 @@ class MetricsRegistry:
         for s in sinks:
             s.emit(rec)
         return rec
+
+    def render_prometheus(self) -> str:
+        """The registry as Prometheus text exposition format (version
+        0.0.4), stdlib-only — the fleet snapshot a scrape endpoint or
+        a node-exporter textfile collector can serve directly.
+
+        - counters → ``# TYPE <name> counter`` samples, gauges →
+          ``gauge`` samples; metric names are sanitized
+          (``serving.ttft_s`` → ``serving_ttft_s``, anything outside
+          ``[a-zA-Z0-9_:]`` becomes ``_``, leading digits get a ``_``
+          prefix).
+        - the per-replica router gauges
+          (``serving.router.replica<i>.<gauge>``) collapse into ONE
+          labeled family per gauge:
+          ``serving_router_replica_<gauge>{replica="<i>"}`` — the
+          namespacing contract, machine-readable.
+        - histograms render as Prometheus histograms over a FIXED
+          bucket ladder (``_bucket{le=...}`` cumulative counts +
+          ``_sum`` / ``_count``). Bucket counts are exact while the
+          reservoir holds every observation and reservoir-estimated
+          (uniformly scaled) past that — ``_sum``/``_count`` stay
+          exact always.
+
+        Output is deterministically ordered (family name, then label
+        set), so goldens and scrape diffs are stable."""
+        with self._lock:
+            counters = dict(self.counters)
+            gauges = dict(self.gauges)
+            hists = {k: (h.count, h.total, list(h._sample))
+                     for k, h in self.histograms.items()}
+
+        def _families(series: Dict[str, float]):
+            fams: Dict[str, List] = {}
+            for name, value in series.items():
+                pname, labels = _prom_split(name)
+                fams.setdefault(pname, []).append((labels, value))
+            return fams
+
+        lines: List[str] = []
+        typed = [("counter", _families(counters)),
+                 ("gauge", _families(gauges))]
+        for kind, fams in typed:
+            for pname in sorted(fams):
+                lines.append(f"# TYPE {pname} {kind}")
+                for labels, value in sorted(
+                        fams[pname], key=lambda lv: sorted(
+                            lv[0].items())):
+                    lines.append(f"{pname}{_prom_labels(labels)} "
+                                 f"{_prom_value(value)}")
+        for name in sorted(hists):
+            count, total, sample = hists[name]
+            pname = _prom_name(name)
+            lines.append(f"# TYPE {pname} histogram")
+            scale = (count / len(sample)) if sample else 0.0
+            for le in _PROM_BUCKETS:
+                c = sum(1 for v in sample if v <= le)
+                lines.append(
+                    f'{pname}_bucket{{le="{_prom_value(le)}"}} '
+                    f"{int(round(c * scale))}")
+            lines.append(f'{pname}_bucket{{le="+Inf"}} {count}')
+            lines.append(f"{pname}_sum {_prom_value(total)}")
+            lines.append(f"{pname}_count {count}")
+        return "\n".join(lines) + "\n" if lines else ""
 
     def close(self) -> None:
         for s in self.sinks:

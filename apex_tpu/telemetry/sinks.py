@@ -4,7 +4,11 @@ streams each step record.
 The sink protocol is two methods: ``emit(record: dict)`` (called once per
 record, possibly from a runtime callback thread — implementations must be
 self-synchronizing or append-only) and ``close()``. Records are plain
-JSON-able dicts (see ``core.StepRecord``).
+JSON-able dicts (see ``core.StepRecord``). The serving tier's request
+tracer (:mod:`apex_tpu.telemetry.tracing`) rides the same protocol: its
+``export_jsonl`` streams one ``tag="serving.trace"`` record per span
+through any sink built here, so trace and metric streams can share one
+run file.
 
 Built-ins:
 

@@ -22,7 +22,8 @@ from typing import Any, Dict, List, Optional
 from .core import META_KEYS, StreamingHistogram
 
 __all__ = ["load_records", "summarize_records", "render_summary",
-           "trace_breakdown", "render_breakdown"]
+           "trace_breakdown", "render_breakdown",
+           "summarize_trace", "render_trace_summary"]
 
 #: hlo_category substrings that identify collective/communication ops
 COMM_CATEGORIES = ("all-reduce", "all-gather", "all-to-all",
@@ -112,6 +113,105 @@ def render_summary(summary: Dict[str, Any]) -> str:
         for name in sorted(summary["counters"]):
             v = summary["counters"][name]
             lines.append(f"{name[:48]:<48} {v:>14,.0f}")
+    return "\n".join(lines)
+
+
+def summarize_trace(records: List[Dict[str, Any]],
+                    request_records: Optional[List[Dict[str, Any]]] = None,
+                    ) -> Dict[str, Any]:
+    """Aggregate ``serving.trace`` span records (the JSONL
+    :meth:`~apex_tpu.telemetry.Tracer.export_jsonl` writes) behind
+    ``python -m apex_tpu.telemetry trace``.
+
+    Returns::
+
+        {"traces": n, "spans": {name: {count, mean, p50, p95, p99,
+                                       min, max}},       # span DURATIONS
+         "critical_path": {name: {"total_s", "per_request_s",
+                                  "pct"}},               # where time went
+         "requests": {...} | None}
+
+    The critical path charges each stage's summed span durations
+    against the fleet-wide total (heartbeat spans measure whole beats
+    a slot participated in, so stages legitimately overlap — ``pct``
+    reads as "fraction of summed stage time", not wall time).
+
+    ``request_records`` (optional) are ``serving.request`` completion
+    records (same JSONL or another run file): they join on their
+    ``trace_id`` field — the summary then reports how many traces
+    matched a completion record and the per-status request counts,
+    the cross-check that the trace stream and the metrics stream
+    describe the same requests."""
+    spans = [r for r in records if r.get("tag") == "serving.trace"]
+    hists: Dict[str, StreamingHistogram] = {}
+    totals: Dict[str, float] = {}
+    trace_ids = set()
+    for r in spans:
+        name = r.get("span")
+        if not isinstance(name, str):
+            continue
+        trace_ids.add(r.get("trace_id"))
+        dur = r.get("dur_s") or 0.0
+        h = hists.get(name)
+        if h is None:
+            h = hists[name] = StreamingHistogram()
+        h.observe(dur)
+        totals[name] = totals.get(name, 0.0) + float(dur)
+    n_traces = len(trace_ids)
+    grand = sum(totals.values()) or 1.0
+    critical = {
+        name: {"total_s": totals[name],
+               "per_request_s": totals[name] / max(n_traces, 1),
+               "pct": 100.0 * totals[name] / grand}
+        for name in sorted(totals, key=lambda k: -totals[k])}
+    joined = None
+    if request_records is not None:
+        reqs = [r for r in request_records
+                if r.get("tag") == "serving.request"]
+        matched = [r for r in reqs if r.get("trace_id") in trace_ids]
+        statuses: Dict[str, int] = {}
+        for r in matched:
+            s = str(r.get("status"))
+            statuses[s] = statuses.get(s, 0) + 1
+        joined = {"completion_records": len(reqs),
+                  "matched": len(matched),
+                  "unmatched_traces": n_traces - len({
+                      r.get("trace_id") for r in matched}),
+                  "statuses": statuses}
+    return {"traces": n_traces,
+            "spans": {k: hists[k].summary() for k in sorted(hists)},
+            "critical_path": critical,
+            "requests": joined}
+
+
+def render_trace_summary(summary: Dict[str, Any]) -> str:
+    """Aligned text tables of :func:`summarize_trace` output: the
+    per-stage latency distribution, then the critical-path breakdown,
+    then the completion-record join (when requested)."""
+    lines = [f"traces: {summary['traces']}", ""]
+    hdr = (f"{'span':<18} {'count':>7} {'mean':>12} {'p50':>12} "
+           f"{'p95':>12} {'p99':>12} {'max':>12}")
+    lines += [hdr, "-" * len(hdr)]
+    for name, s in summary["spans"].items():
+        if s.get("count", 0) == 0:
+            continue
+        lines.append(
+            f"{name[:18]:<18} {s['count']:>7} {s['mean']:>12.6g} "
+            f"{s['p50']:>12.6g} {s['p95']:>12.6g} {s['p99']:>12.6g} "
+            f"{s['max']:>12.6g}")
+    lines += ["", "critical path (summed stage time; stages overlap):"]
+    hdr = f"{'span':<18} {'total_s':>12} {'per_req_s':>12} {'%':>6}"
+    lines += [hdr, "-" * len(hdr)]
+    for name, c in summary["critical_path"].items():
+        lines.append(f"{name[:18]:<18} {c['total_s']:>12.6g} "
+                     f"{c['per_request_s']:>12.6g} {c['pct']:>6.1f}")
+    joined = summary.get("requests")
+    if joined is not None:
+        lines += ["", f"completion records: {joined['completion_records']}"
+                  f" ({joined['matched']} matched by trace_id, "
+                  f"{joined['unmatched_traces']} trace(s) unmatched)"]
+        for s in sorted(joined["statuses"]):
+            lines.append(f"  status {s}: {joined['statuses'][s]}")
     return "\n".join(lines)
 
 

@@ -2178,12 +2178,15 @@ def _router_waves(rng):
     return waves
 
 
-def _serve_router(engines, policy, seed):
+def _serve_router(engines, policy, seed, tracer=None):
     """WINDOWS measured windows (plus a discarded compile warmup) of
     the session-wave stream through one Router mode. Per-replica
     prefix accounting reads ``stats_since`` DELTAS over the measured
     windows — the cache counters survive the warm resets between
-    windows on purpose, so only a delta isolates the window."""
+    windows on purpose, so only a delta isolates the window.
+    ``tracer`` (the ``BENCH_SERVING_TRACE`` knob) attaches request
+    tracing to every window's router — token-bitwise invisible by the
+    tracer contract, so the measured stream is unchanged."""
     from apex_tpu import serving, telemetry
 
     reg = telemetry.MetricsRegistry()
@@ -2198,7 +2201,7 @@ def _serve_router(engines, policy, seed):
                                 route_policy=policy, seed=seed,
                                 max_queue=max(REQUESTS, 1),
                                 chunk_budget=CHUNK_BUDGET,
-                                retain_prefixes=True)
+                                retain_prefixes=True, tracer=tracer)
         waves = _router_waves(rng)
         base = [e.prefix_cache.stats() for e in engines]
         t0 = time.perf_counter()
@@ -2255,9 +2258,23 @@ def replica_router_stats():
         "affinity": (engines, "affinity"),
         "random": (engines, "random"),
     }
+    # BENCH_SERVING_TRACE=path (off by default): attach a request
+    # tracer to the affinity leg and write a Chrome-trace artifact
+    # (load at https://ui.perfetto.dev) — every request's life across
+    # router, replicas and worker threads, riding the measured stream
+    # (token-bitwise invisible by the tracer contract)
+    trace_path = os.environ.get("BENCH_SERVING_TRACE")
+    trace_spans = None
     rows, results = {}, {}
     for mode, (engs, policy) in modes.items():
-        res = _serve_router(engs, policy, seed=17)
+        tracer = None
+        if trace_path and mode == "affinity":
+            from apex_tpu.telemetry import Tracer
+
+            tracer = Tracer(max_traces=8192)
+        res = _serve_router(engs, policy, seed=17, tracer=tracer)
+        if tracer is not None:
+            trace_spans = tracer.export_chrome_trace(trace_path)
         results[mode] = res
         counters = res["snap"]["counters"]
         rows[mode] = {
@@ -2311,6 +2328,9 @@ def replica_router_stats():
         "compiled_programs": [e.compiled_programs for e in engines],
         "model": SIZE,
     }
+    if trace_path:
+        summary["trace_path"] = trace_path
+        summary["trace_spans"] = trace_spans
     return rows, summary
 
 

@@ -54,7 +54,8 @@ PAGES = {
               "apex_tpu.utils.schedule_report", "apex_tpu.utils.compat",
               "apex_tpu.pyprof"],
     "telemetry": ["apex_tpu.telemetry", "apex_tpu.telemetry.sinks",
-                  "apex_tpu.telemetry.summarize", "apex_tpu.log_util"],
+                  "apex_tpu.telemetry.summarize",
+                  "apex_tpu.telemetry.tracing", "apex_tpu.log_util"],
     "serving": ["apex_tpu.serving", "apex_tpu.serving.kv_cache",
                 "apex_tpu.serving.quant_common",
                 "apex_tpu.serving.kv_quant",

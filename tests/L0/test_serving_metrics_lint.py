@@ -73,6 +73,24 @@ device with ZERO token-level symptom — the exact foot-gun the async
 refactors exist to remove, invisible to every parity test because
 forcing changes no tokens. Functions are checked BY NAME per file, so
 a rename breaks the lint loudly instead of silently un-scoping it.
+
+This file also owns the **span-name lint** (the tracing tentpole's
+version of the metric-name loop): span names are stringly typed at
+their emit sites (``tracer.event(uid, "admit", ...)``), so a renamed
+span silently orphans its row in the ``### Span taxonomy`` table in
+``docs/serving.md`` — and a documented span nobody emits is a Perfetto
+lane a reader will wait for forever. The lint AST-scans
+``apex_tpu/serving/`` for calls to the three tracer recording methods
+(``.event`` / ``.event_current`` / ``.end_trace``) and extracts each
+call's first string-literal positional argument (the span name —
+trace ids are never literals), then pins that set EQUAL to the
+backticked first column of the taxonomy table. And the **tracer
+force-lint**: the tracer's recording methods run inside the
+dispatch-ahead regions' dynamic extent (the heartbeat/swap hooks call
+them between dispatch and reconcile), so they get the same
+force-early treatment as the regions themselves — no ``int()`` /
+``np.asarray`` / ``jax.device_get`` in any hot recording method (the
+exporters force freely; they run offline).
 """
 
 import ast
@@ -396,3 +414,139 @@ def test_pool_gathers_are_exactly_the_padded_allowlist():
         f"allowlisted pool-gather sites no longer found (moved or "
         f"renamed — re-review their padding and update the "
         f"allowlist): {sorted(stale)}")
+
+
+# ---------------------------------------------------- the span-name lint
+# The tracer's three recording methods. Any call of the shape
+# ``<anything>.event(...)`` / ``.event_current(...)`` / ``.end_trace(...)``
+# under apex_tpu/serving/ is a span emit site; the span name is the
+# call's first string-literal positional argument (``event`` and
+# ``end_trace`` take the trace id first, but a trace id is never a
+# string literal — it's ``request.uid`` — so "first str literal" is
+# position-agnostic across all three signatures).
+_SPAN_METHODS = {"event", "event_current", "end_trace"}
+TRACING_PY = os.path.join(ROOT, "apex_tpu", "telemetry", "tracing.py")
+
+
+def _spans_emitted():
+    """Every span-name literal passed to a tracer recording method
+    under apex_tpu/serving/, mapped to the files that emit it."""
+    refs = {}
+    for path in glob.glob(os.path.join(SRC_DIR, "**", "*.py"),
+                          recursive=True):
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=path)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not (isinstance(f, ast.Attribute)
+                    and f.attr in _SPAN_METHODS):
+                continue
+            lits = [a.value for a in node.args
+                    if isinstance(a, ast.Constant)
+                    and isinstance(a.value, str)]
+            if lits:
+                refs.setdefault(lits[0], []).append(
+                    os.path.relpath(path, ROOT))
+    return refs
+
+
+def _spans_documented():
+    """The backticked first column of every row of the
+    ``### Span taxonomy`` table in docs/serving.md."""
+    names = set()
+    in_section = False
+    with open(DOC) as f:
+        for line in f:
+            if line.startswith("#"):
+                in_section = line.strip() == "### Span taxonomy"
+                continue
+            if in_section and line.startswith("| `"):
+                names.add(line.split("`")[1])
+    return names
+
+
+def test_span_scan_surface_is_alive():
+    """The span lint must be looking at real emit sites and a real doc
+    table — and the tentpole's headline spans must come from the
+    layers that own them (terminal trio + quarantine from the
+    scheduler, routing from the router, the swap pair from the engine,
+    the draft span from the scheduler's worker closure)."""
+    emitted = _spans_emitted()
+    assert emitted, "no tracer recording calls found under " \
+        "apex_tpu/serving — span scan broken?"
+    sched = os.path.join("apex_tpu", "serving", "scheduler.py")
+    for name in ("submit", "queue_wait", "admit", "prefill_chunk",
+                 "heartbeat", "draft", "verify", "quarantine",
+                 "finish", "expired", "failed"):
+        assert sched in emitted.get(name, []), \
+            f"span {name!r} not emitted by the scheduler — request " \
+            "lifecycle tracing went dark"
+    assert os.path.join("apex_tpu", "serving", "router.py") \
+        in emitted.get("route", [])
+    engine_py = os.path.join("apex_tpu", "serving", "engine.py")
+    for name in ("swap_out", "swap_out_store", "swap_in"):
+        assert engine_py in emitted.get(name, []), \
+            f"span {name!r} not emitted by the engine — migration " \
+            "tracing went dark"
+    assert _spans_documented(), "docs/serving.md has no " \
+        "'### Span taxonomy' table — doc section missing/renamed?"
+
+
+def test_every_emitted_span_is_documented():
+    emitted = _spans_emitted()
+    documented = _spans_documented()
+    missing = {k: v for k, v in emitted.items() if k not in documented}
+    assert not missing, (
+        f"spans emitted in code but absent from docs/serving.md's "
+        f"span-taxonomy table (add a row): {missing}")
+
+
+def test_every_documented_span_is_emitted():
+    emitted = set(_spans_emitted())
+    stale = _spans_documented() - emitted
+    assert not stale, (
+        f"docs/serving.md's span-taxonomy table names spans no "
+        f"serving code emits (stale rows — delete them or wire the "
+        f"emitter): {stale}")
+
+
+# ------------------------------------------------ the tracer force-lint
+# The tracer's hot recording methods execute inside the serving hooks —
+# including the dispatch-ahead regions' dynamic extent (the heartbeat
+# span lands between a decode dispatch and its reconcile; the swap_out
+# span inside _dispatch_swap_out itself) — so they inherit the regions'
+# contract: never force a device value to host. Annotation values are
+# stored as passed (Python floats/ints from host bookkeeping); the
+# exporters (export_chrome_trace / export_jsonl) normalize with int()
+# at export time, offline, and are deliberately NOT in this list.
+_TRACER_HOT = ("now", "begin", "event", "event_current", "end_trace",
+               "current")
+
+
+def test_tracer_recording_methods_never_force_to_host():
+    """Every definition of a hot tracer recording method (Tracer AND
+    its _BoundTracer replica view both define them) must be free of
+    host-forcing calls — a single ``int()``/``np.asarray`` there would
+    stall every traced heartbeat on in-flight device work, silently
+    un-asyncing the PR 11/15 paths for traced runs only (the exact
+    divergence-under-observation a tracer must never introduce)."""
+    with open(TRACING_PY) as f:
+        tree = ast.parse(f.read(), filename=TRACING_PY)
+    found = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name in _TRACER_HOT:
+            found.setdefault(node.name, []).extend(_forcing_calls(node))
+    missing = set(_TRACER_HOT) - set(found)
+    assert not missing, (
+        f"hot tracer methods {sorted(missing)} not found in "
+        "apex_tpu/telemetry/tracing.py — renamed? update _TRACER_HOT "
+        "so the force lint keeps covering the recording path")
+    offenders = {name: calls for name, calls in found.items() if calls}
+    assert not offenders, (
+        f"host-forcing calls inside hot tracer recording methods "
+        f"(method -> [(call, line)]): {offenders} — these run inside "
+        "the dispatch-ahead regions' dynamic extent; move any "
+        "normalization to the exporters (offline).")

@@ -566,6 +566,99 @@ def test_summarize_cli_rejects_empty_file(tmp_path):
         main(["summarize", str(empty)])
 
 
+# ------------------------------------------------------------- prometheus
+
+def test_render_prometheus_golden():
+    """The exposition format is a wire contract: pin an exact golden
+    render — counter/gauge typing, name sanitization (dots → ``_``),
+    the per-replica router-gauge namespace collapsing into ONE labeled
+    family, the fixed histogram bucket ladder with exact cumulative
+    counts, and deterministic ordering (sorted families, sorted label
+    sets) so scrapes diff cleanly."""
+    reg = MetricsRegistry()
+    reg.counter_inc("serving.faults.nonfinite", 2)
+    reg.counter_inc("overflow_events")
+    reg.gauge_set("serving.kv.bytes_per_token", 512)
+    reg.gauge_set("serving.router.replica1.queue_depth", 1)
+    reg.gauge_set("serving.router.replica0.queue_depth", 3)
+    for v in (0.25, 0.75, 3.0):          # exact binary floats: sum == 4
+        reg.observe("serving.ttft_s", v)
+    golden = "\n".join([
+        "# TYPE overflow_events counter",
+        "overflow_events 1",
+        "# TYPE serving_faults_nonfinite counter",
+        "serving_faults_nonfinite 2",
+        "# TYPE serving_kv_bytes_per_token gauge",
+        "serving_kv_bytes_per_token 512",
+        "# TYPE serving_router_replica_queue_depth gauge",
+        'serving_router_replica_queue_depth{replica="0"} 3',
+        'serving_router_replica_queue_depth{replica="1"} 1',
+        "# TYPE serving_ttft_s histogram",
+        'serving_ttft_s_bucket{le="0.0005"} 0',
+        'serving_ttft_s_bucket{le="0.001"} 0',
+        'serving_ttft_s_bucket{le="0.0025"} 0',
+        'serving_ttft_s_bucket{le="0.005"} 0',
+        'serving_ttft_s_bucket{le="0.01"} 0',
+        'serving_ttft_s_bucket{le="0.025"} 0',
+        'serving_ttft_s_bucket{le="0.05"} 0',
+        'serving_ttft_s_bucket{le="0.075"} 0',
+        'serving_ttft_s_bucket{le="0.1"} 0',
+        'serving_ttft_s_bucket{le="0.25"} 1',
+        'serving_ttft_s_bucket{le="0.5"} 1',
+        'serving_ttft_s_bucket{le="0.75"} 2',
+        'serving_ttft_s_bucket{le="1"} 2',
+        'serving_ttft_s_bucket{le="2.5"} 2',
+        'serving_ttft_s_bucket{le="5"} 3',
+        'serving_ttft_s_bucket{le="7.5"} 3',
+        'serving_ttft_s_bucket{le="10"} 3',
+        'serving_ttft_s_bucket{le="25"} 3',
+        'serving_ttft_s_bucket{le="50"} 3',
+        'serving_ttft_s_bucket{le="100"} 3',
+        'serving_ttft_s_bucket{le="+Inf"} 3',
+        "serving_ttft_s_sum 4",
+        "serving_ttft_s_count 3",
+    ]) + "\n"
+    assert reg.render_prometheus() == golden
+    # identical state renders identically (scrape-diff stability)
+    assert reg.render_prometheus() == golden
+
+
+def test_render_prometheus_sanitizes_malformed_names():
+    """Anything outside ``[a-zA-Z0-9_:]`` becomes ``_`` and a leading
+    digit gets a ``_`` prefix — a malformed metric name must never
+    produce a line a Prometheus scraper rejects (one bad line fails
+    the WHOLE scrape)."""
+    import re
+
+    reg = MetricsRegistry()
+    reg.counter_inc("3bad.metric-name!x")
+    reg.gauge_set("weird metric/name", 1)
+    text = reg.render_prometheus()
+    assert "_3bad_metric_name_x 1" in text
+    assert "weird_metric_name 1" in text
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            continue
+        name = line.split("{")[0].split(" ")[0]
+        assert re.fullmatch(r"[a-zA-Z_:][a-zA-Z0-9_:]*", name), \
+            f"invalid prometheus metric name in exposition: {name!r}"
+
+
+def test_render_prometheus_reservoir_scaled_buckets_exact_sum_count():
+    """Past the reservoir, bucket counts are uniformly scaled estimates
+    but ``_sum``/``_count`` stay exact — with every observation equal,
+    the scaled buckets are exact too, pinning the scale arithmetic."""
+    reg = MetricsRegistry(reservoir_size=64)
+    for _ in range(10_000):
+        reg.observe("h", 0.5)
+    text = reg.render_prometheus()
+    assert 'h_bucket{le="0.25"} 0' in text
+    assert 'h_bucket{le="0.5"} 10000' in text
+    assert 'h_bucket{le="+Inf"} 10000' in text
+    assert "h_sum 5000" in text
+    assert "h_count 10000" in text
+
+
 # ------------------------------------------------------------ env opt-in
 
 def test_from_env_unset_is_noop(monkeypatch):
