@@ -260,6 +260,29 @@ class PendingDecode:
     reconciled: bool = False
 
 
+@dataclasses.dataclass
+class PendingPrefill:
+    """One dispatched-but-unread chunk-prefill step — the prefill-path
+    twin of :class:`PendingDecode`, held by the scheduler between
+    :meth:`Engine.prefill_chunk_dispatch` and
+    :meth:`Engine.prefill_chunk_reconcile` so chunk ``t+1`` can be
+    issued before chunk ``t``'s sampled token is forced to host.
+
+    ``token`` / ``finite`` are DEVICE scalars until reconcile; the
+    force-early lint covers the dispatch half by name, exactly like the
+    decode region. ``final`` and the timestamps are host bookkeeping so
+    reconcile can finish the chunk's counters identically to the
+    synchronous path."""
+
+    token: Any                  # scalar int32, ON DEVICE until reconcile
+    finite: Any                 # scalar bool, ON DEVICE until reconcile
+    slot: int
+    final: bool
+    t_dispatch: float
+    dispatch_s: float
+    reconciled: bool = False
+
+
 class Engine:
     """KV-cache inference engine over a ``TransformerLM``-shaped model.
 
@@ -678,6 +701,7 @@ class Engine:
         # pool's heads axis (each shard moves its own heads/tp slice —
         # zero collectives, pinned from compiled HLO).
         self.host_tier: Optional[HostTier] = None
+        self.host_tier_shared = False
         self.sync_swap = bool(sync_swap)
         self._swap_worker: Optional[SwapWorker] = None
         self.swap_verify_failed = 0
@@ -694,7 +718,18 @@ class Engine:
                     "cache, not a standalone store")
             self.host_tier = host_tier if isinstance(host_tier, HostTier) \
                 else HostTier(int(host_tier))
-            self.host_tier.on_evict = self._on_host_tier_evict
+            # externally-owned-arena mode (disaggregated serving): a
+            # pre-built HostTier(shared=True) is co-owned by N engines
+            # — register as ONE of its eviction listeners instead of
+            # claiming the exclusive hook, and scope the cross-tier
+            # audit to keys this engine's prefix index owns (the
+            # PoolAuditor consults host_tier_shared)
+            self.host_tier_shared = bool(
+                getattr(self.host_tier, "shared", False))
+            if self.host_tier_shared:
+                self.host_tier.add_on_evict(self._on_host_tier_evict)
+            else:
+                self.host_tier.on_evict = self._on_host_tier_evict
             self.prefix_cache.set_swap_hooks(
                 swap_out=self._dispatch_swap_out,
                 contains=self.host_tier.contains)
@@ -1373,7 +1408,29 @@ class Engine:
         :class:`~apex_tpu.serving.FaultPlan` makes the in-program
         finiteness guard fire for real). The guard's verdict lands in
         :attr:`last_chunk_finite` either way.
+
+        Internally this is :meth:`prefill_chunk_dispatch` followed
+        immediately by :meth:`prefill_chunk_reconcile` — the depth-0
+        composition IS the bitwise oracle the dispatch-ahead prefill
+        path (``pipeline_depth >= 1``) is pinned against.
         """
+        return self.prefill_chunk_reconcile(self.prefill_chunk_dispatch(
+            slot, chunk, offset, temperature, final=final,
+            fault_bias=fault_bias))
+
+    def prefill_chunk_dispatch(self, slot: int, chunk: Sequence[int],
+                               offset: int, temperature: float = 0.0,
+                               *, final: bool = True,
+                               fault_bias: float = 0.0) -> PendingPrefill:
+        """Dispatch one chunk-prefill step WITHOUT forcing its sampled
+        token to host — the prefill-path half of the dispatch-ahead
+        split (:class:`PendingDecode`'s twin). Validates, grows the
+        slot's page run, issues the compiled chunk program and updates
+        host-side ingestion length; the returned handle's ``token`` /
+        ``finite`` stay on device until :meth:`prefill_chunk_reconcile`.
+        The force-early lint covers this function by name: no
+        ``int()`` / ``np.asarray`` / ``jax.device_get`` may appear in
+        its body."""
         n = len(chunk)
         if not 0 < n <= self.chunk_len:
             raise ValueError(f"chunk length {n} not in (0, "
@@ -1395,7 +1452,7 @@ class Engine:
                 f"{offset + self.chunk_len}) exceeds max_len="
                 f"{self.max_len}")
         tokens = np.zeros((1, self.chunk_len), np.int32)
-        tokens[0, :n] = np.asarray(chunk, np.int32)
+        tokens[0, :n] = chunk       # host list -> int32, no device read
         t0 = time.perf_counter()
         if self.paged:
             if offset % self.page_len:
@@ -1426,19 +1483,34 @@ class Engine:
                     np.int32(slot), np.int32(offset), np.int32(n),
                     np.float32(temperature), np.float32(fault_bias),
                     self._next_key()))
+        return PendingPrefill(
+            token=token, finite=finite, slot=slot, final=final,
+            t_dispatch=t0, dispatch_s=time.perf_counter() - t0)
+
+    def prefill_chunk_reconcile(self, pending: PendingPrefill) -> int:
+        """Force a dispatched chunk's sampled token to host and finish
+        its accounting (finiteness verdict, ``device_wait_s``, the
+        ``serving.prefill_chunk_s`` / ``serving.prefill.chunks`` /
+        ``serving.tokens_generated`` counters) — the batched-readback
+        half of the dispatch-ahead prefill split. Returns the host
+        token; a throwaway unless the chunk was ``final``."""
+        if pending.reconciled:
+            raise RuntimeError("PendingPrefill already reconciled")
+        pending.reconciled = True
         tw = time.perf_counter()
-        token = int(token)                  # device sync
-        self.last_chunk_finite = bool(finite)
+        token = int(pending.token)          # device sync
+        self.last_chunk_finite = bool(pending.finite)
         self.device_wait_s += time.perf_counter() - tw
         if not self.last_chunk_finite:
             self._count_nonfinite(1)
         if self._registry is not None:
-            self._registry.observe("serving.prefill_chunk_s",
-                                   time.perf_counter() - t0)
+            self._registry.observe(
+                "serving.prefill_chunk_s",
+                pending.dispatch_s + time.perf_counter() - tw)
             self._registry.counter_inc("serving.prefill.chunks")
-            if final:
+            if pending.final:
                 self._registry.counter_inc("serving.tokens_generated")
-        if final:
+        if pending.final:
             self.tokens_generated += 1
         return token
 
@@ -1631,10 +1703,15 @@ class Engine:
         """The host arena evicted ``key``'s bytes under capacity
         pressure: the swapped index entry now has no backing anywhere —
         drop it (a dangling swapped entry would be the exact rot the
-        auditor's cross-tier walk flags)."""
-        self.prefix_cache.drop(key)
+        auditor's cross-tier walk flags). On a SHARED arena every
+        co-owning engine hears every eviction — the drop is a no-op
+        for keys this engine never indexed, and only the owner ticks
+        the eviction counter (N engines must not count one eviction N
+        times)."""
+        owned = self.prefix_cache.drop(key)
         if self._registry is not None:
-            self._registry.counter_inc("serving.swap.host_evictions")
+            if owned or not self.host_tier_shared:
+                self._registry.counter_inc("serving.swap.host_evictions")
             self._registry.gauge_set("serving.swap.host_bytes",
                                      float(self.host_tier.bytes_used))
 
@@ -1954,6 +2031,61 @@ class Engine:
         if outcome == "registered":
             self.pool.share(pages)
         return outcome
+
+    def export_handoff(self, slot: int, key: int,
+                       prompt: Sequence[int],
+                       keys: Optional[Sequence[int]] = None) -> int:
+        """Disaggregated-serving EXPORT: land ``slot``'s ingested
+        prefix of ``prompt`` in the host arena under the request's own
+        ``key`` (its uid — positive and globally unique, so records
+        from every engine sharing one arena coexist), ready for a
+        decode-role replica to restore. Two existing mechanisms back
+        to back, zero new compiled programs:
+
+        1. :meth:`PrefixCache.register_handoff` retains the prefix as
+           an ordinary paged entry on the slot's own pages (refcount
+           share, no copy) — capped at ``aligned(n - 1)`` blocks
+           exactly like every registration, because the final chunk
+           must run through the importer's chunk-prefill program to
+           sample the first token;
+        2. :meth:`PrefixCache.swap_out_key` migrates it straight to
+           the arena through the (async, per-shard-CRC'd, fixed-shape)
+           ``swap_out`` gather — the same dispatch the pressure path
+           uses, so ``serving.swap.*`` telemetry covers handoff bytes
+           and latency for free.
+
+        Returns the exported aligned length (the importer's exact
+        resume offset), or 0 when nothing could be exported — prompt
+        spans no full block, no tier, or the arena declined — in
+        which case the importer simply re-prefills cold (an entry the
+        arena declined stays RESIDENT here as an ordinary local
+        prefix). Counts ``serving.disagg.handoff_bytes``."""
+        self._require_paged("export_handoff")
+        if self.prefix_cache is None or self.host_tier is None:
+            return 0
+        n_blocks = (len(prompt) - 1) // self.chunk_len
+        if n_blocks == 0:
+            return 0
+        length = n_blocks * self.chunk_len
+        if int(self._host_len[slot]) < length:
+            raise RuntimeError(
+                f"slot {slot} has ingested {int(self._host_len[slot])}"
+                f" tokens of the {length}-token handoff prefix — "
+                "export runs at ingestion completion, not before")
+        n_pages = length // self.page_len
+        pages = tuple(int(p) for p in self._page_table[slot, :n_pages])
+        outcome = self.prefix_cache.register_handoff(
+            key, prompt[:length], pages=pages, keys=keys)
+        if outcome != "registered":
+            return 0
+        self.pool.share(pages)
+        if not self.prefix_cache.swap_out_key(key):
+            return 0
+        if self._registry is not None:
+            self._registry.counter_inc(
+                "serving.disagg.handoff_bytes",
+                self.host_tier.nbytes_of(key))
+        return length
 
     @property
     def pages_free(self) -> int:
@@ -2405,13 +2537,22 @@ class Engine:
                 # the pool (the on_evict hook). Swapped entries hold no
                 # pages — their host-side bytes are dropped with the
                 # arena below (warm resets keep BOTH tiers: a swapped
-                # prefix is warm state exactly like a resident one)
+                # prefix is warm state exactly like a resident one).
+                # A SHARED arena belongs to the whole fleet: discard
+                # only this engine's own swapped keys, never clear()
+                # the sibling engines' records out from under them.
+                own_swapped = self.prefix_cache.swapped_keys()
                 self.prefix_cache.clear()
                 if self.host_tier is not None:
-                    self.host_tier.clear()
+                    if self.host_tier_shared:
+                        for k in own_swapped:
+                            self.host_tier.discard(k)
+                    else:
+                        self.host_tier.clear()
                     if self._registry is not None:
-                        self._registry.gauge_set("serving.swap.host_bytes",
-                                                 0.0)
+                        self._registry.gauge_set(
+                            "serving.swap.host_bytes",
+                            float(self.host_tier.bytes_used))
             return
         lengths = self.cache.lengths
         if clear_prefixes:

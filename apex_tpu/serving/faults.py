@@ -144,6 +144,14 @@ class FaultSpec:
       still IN FLIGHT (the *swapping* state) is armed instead and rots
       the bytes the moment the worker stores them — the race resolves
       to the same verified miss.
+    - ``"handoff_corruption"`` — the disaggregated-serving fault, the
+      same arena bit-flip as ``swap_corruption`` but victimizing only
+      **handoff records** (arena keys >= 0 — request uids; ordinary
+      paged prefixes use negative synthetic keys), via
+      :meth:`FaultPlan.maybe_corrupt_handoff`. The decode-side import's
+      CRC fails and the request re-prefills on the decode replica
+      (``serving.disagg.reprefills``) — never a wrong token, with zero
+      retries charged to the request.
     """
 
     kind: str
@@ -156,7 +164,8 @@ class FaultSpec:
 
     def __post_init__(self):
         if self.kind not in ("nonfinite", "exception", "stall",
-                             "replica_death", "swap_corruption"):
+                             "replica_death", "swap_corruption",
+                             "handoff_corruption"):
             raise ValueError(f"unknown fault kind {self.kind!r}")
         if self.kind == "nonfinite" and self.slot < 0:
             raise ValueError("nonfinite faults need a victim slot")
@@ -185,6 +194,7 @@ class FaultPlan:
         self._stalls: Dict[int, FaultSpec] = {}
         self._deaths: Dict[int, List[FaultSpec]] = {}
         self._swap_corruptions: Dict[int, FaultSpec] = {}
+        self._handoff_corruptions: Dict[int, FaultSpec] = {}
         for s in self.specs:
             if s.kind == "nonfinite":
                 self._nonfinite.setdefault(int(s.tick), []).append(s)
@@ -194,6 +204,8 @@ class FaultPlan:
                 self._deaths.setdefault(int(s.tick), []).append(s)
             elif s.kind == "swap_corruption":
                 self._swap_corruptions[int(s.tick)] = s
+            elif s.kind == "handoff_corruption":
+                self._handoff_corruptions[int(s.tick)] = s
             else:
                 self._stalls[int(s.tick)] = s
         # raw injection counters (the chaos bench reads them)
@@ -202,6 +214,7 @@ class FaultPlan:
         self.injected_stalls = 0
         self.injected_replica_deaths = 0
         self.injected_swap_corruptions = 0
+        self.injected_handoff_corruptions = 0
 
     @classmethod
     def random(cls, seed: int, ticks: int, *, slots: int,
@@ -210,7 +223,8 @@ class FaultPlan:
                sites: Sequence[str] = ("chunk", "decode"),
                replica_death_rate: float = 0.0,
                replicas: int = 0,
-               swap_corruption_rate: float = 0.0) -> "FaultPlan":
+               swap_corruption_rate: float = 0.0,
+               handoff_corruption_rate: float = 0.0) -> "FaultPlan":
         """A seeded random schedule over ``ticks`` heartbeats: each
         tick independently draws a non-finite injection (uniform victim
         slot), a transient exception (site uniform over ``sites``),
@@ -225,7 +239,10 @@ class FaultPlan:
         ``swap_corruption_rate`` > 0 (hierarchical-KV engines only)
         draws a host-arena corruption per tick — same skipped-at-0
         contract, so every pre-host-tier seed also replays
-        bit-for-bit."""
+        bit-for-bit. ``handoff_corruption_rate`` > 0 (disaggregated
+        fleets only) draws a handoff-record corruption per tick — the
+        draw is again skipped entirely at the default 0, preserving
+        every pre-disaggregation seed."""
         for s in sites:
             if s not in _EXCEPTION_SITES:
                 raise ValueError(f"exception site {s!r} not in "
@@ -255,6 +272,10 @@ class FaultPlan:
             if swap_corruption_rate > 0 \
                     and rng.random() < swap_corruption_rate:
                 specs.append(FaultSpec(kind="swap_corruption", tick=t))
+            if handoff_corruption_rate > 0 \
+                    and rng.random() < handoff_corruption_rate:
+                specs.append(FaultSpec(kind="handoff_corruption",
+                                       tick=t))
         return cls(specs)
 
     # ------------------------------------------------------------ injection
@@ -345,6 +366,27 @@ class FaultPlan:
         self.injected_swap_corruptions += 1
         return True
 
+    def maybe_corrupt_handoff(self, tick: int, tier) -> bool:
+        """CONSUME the ``handoff_corruption`` scheduled for this
+        heartbeat, if any, by flipping one byte of a deterministically
+        chosen HANDOFF record in ``tier`` — victims are the uid-keyed
+        records only (arena keys >= 0; ordinary paged prefixes mint
+        negative synthetic keys), so the injection lands on the
+        cross-replica transfer path specifically. Rides the exact
+        ``swap_corruption`` plumbing: an arena with no handoff records
+        makes the injection a no-op (spec still consumed at its tick),
+        and a victim whose swap-out is still in flight is armed to rot
+        on store. Returns True when a byte actually flipped."""
+        spec = self._handoff_corruptions.pop(int(tick), None)
+        if spec is None:
+            return False
+        keys = sorted(k for k in tier.keys() if k >= 0)
+        if not keys:
+            return False
+        tier.corrupt_entry(keys[int(tick) % len(keys)])
+        self.injected_handoff_corruptions += 1
+        return True
+
     def maybe_stall(self, tick: int) -> float:
         """Sleep through the stall scheduled for this heartbeat (if
         any); returns the seconds slept (0.0 on stall-free ticks)."""
@@ -386,6 +428,8 @@ class FaultPlan:
             "injected_stalls": self.injected_stalls,
             "injected_replica_deaths": self.injected_replica_deaths,
             "injected_swap_corruptions": self.injected_swap_corruptions,
+            "injected_handoff_corruptions":
+                self.injected_handoff_corruptions,
         }
 
 
@@ -562,21 +606,31 @@ class PoolAuditor:
         # stored arrays and respects its capacity bound.
         tier = getattr(engine, "host_tier", None)
         if tier is not None:
-            swapped = set(pcache.swapped_keys()) if pcache is not None \
-                else set()
             tier_keys = set(tier.keys())
-            dangling_swap = sorted(swapped - tier_keys)
-            if dangling_swap:
-                problems.append(
-                    f"swapped prefix entries {dangling_swap} have no "
-                    f"host-tier backing — a hit would find nothing to "
-                    f"swap in (dangling swap state)")
-            orphaned = sorted(tier_keys - swapped)
-            if orphaned:
-                problems.append(
-                    f"host-tier entries {orphaned} back no swapped "
-                    f"prefix entry — unreachable host bytes (host-side "
-                    f"leak)")
+            if not getattr(engine, "host_tier_shared", False):
+                # the two set-inclusion directions are PER-ENGINE
+                # invariants only when the engine owns the tier: in a
+                # SHARED arena (disaggregated serving) other engines'
+                # records legitimately coexist, and a handoff record is
+                # momentarily ownerless between the exporter dropping
+                # its entry and the importer registering one — the
+                # disaggregation test asserts the FLEET-level union
+                # equality instead. The byte ledger and capacity bound
+                # below are tier-global and hold either way.
+                swapped = set(pcache.swapped_keys()) \
+                    if pcache is not None else set()
+                dangling_swap = sorted(swapped - tier_keys)
+                if dangling_swap:
+                    problems.append(
+                        f"swapped prefix entries {dangling_swap} have "
+                        f"no host-tier backing — a hit would find "
+                        f"nothing to swap in (dangling swap state)")
+                orphaned = sorted(tier_keys - swapped)
+                if orphaned:
+                    problems.append(
+                        f"host-tier entries {orphaned} back no swapped "
+                        f"prefix entry — unreachable host bytes "
+                        f"(host-side leak)")
             actual = sum(tier.nbytes_of(k) for k in tier_keys)
             if actual != tier.bytes_used:
                 problems.append(

@@ -147,15 +147,31 @@ class HostTier:
     (pending reservations included); ``on_evict(key)`` fires AFTER a
     capacity eviction removes an entry (the engine wires it to drop
     the matching swapped prefix-cache entry, so a prefix is never
-    indexed without backing bytes)."""
+    indexed without backing bytes).
+
+    ``shared=True`` marks the arena as EXTERNALLY OWNED by several
+    engines at once (the disaggregated-serving handoff bus): each
+    engine then registers its drop-hook through :meth:`add_on_evict`
+    instead of overwriting ``on_evict``, a capacity eviction notifies
+    every registered engine (each drops the key from its OWN prefix
+    index — :meth:`PrefixCache.drop` is a no-op for keys it never
+    held), and the engines scope their cross-tier audits to the keys
+    they own (an arena record owned by a sibling engine is not an
+    orphan). A shared arena also survives any single engine's
+    ``reset()`` — teardown belongs to whoever built it."""
 
     def __init__(self, capacity_bytes: int, *,
-                 on_evict: Optional[Callable[[int], None]] = None):
+                 on_evict: Optional[Callable[[int], None]] = None,
+                 shared: bool = False):
         capacity_bytes = int(capacity_bytes)
         if capacity_bytes < 1:
             raise ValueError("capacity_bytes must be >= 1")
         self.capacity_bytes = capacity_bytes
         self.on_evict = on_evict
+        self.shared = bool(shared)
+        # extra eviction listeners (shared-arena mode: one per engine);
+        # fired after on_evict, caller's thread only, like on_evict
+        self._evict_listeners: List[Callable[[int], None]] = []
         self._lock = threading.RLock()
         self._entries: Dict[int, HostTierRecord] = {}
         self._bytes_used = 0        # maintained incrementally: the
@@ -356,6 +372,16 @@ class HostTier:
                                 "miss", key)
             return rec
 
+    def add_on_evict(self, fn: Callable[[int], None]) -> None:
+        """Register an ADDITIONAL eviction listener (shared-arena
+        mode: every co-owning engine hooks its prefix-index drop here
+        — overwriting ``on_evict`` would silently orphan the other
+        engines' swapped entries). Listeners fire on the caller's
+        thread, after ``on_evict``, once per evicted key; double
+        registration is collapsed."""
+        if fn not in self._evict_listeners:
+            self._evict_listeners.append(fn)
+
     def _evict_lru(self) -> None:
         key, rec = min(self._entries.items(),
                        key=lambda kv: kv[1].last_used)
@@ -366,6 +392,8 @@ class HostTier:
                       key)
         if self.on_evict is not None:
             self.on_evict(key)
+        for fn in self._evict_listeners:
+            fn(key)
 
     # ------------------------------------------------------------ lifecycle
     def corrupt_entry(self, key: int, *, byte_index: int = 0) -> None:
@@ -386,6 +414,20 @@ class HostTier:
                 return
             flat = rec.k.reshape(-1).view(np.uint8)
             flat[int(byte_index) % flat.size] ^= 0xFF
+
+    def discard(self, key: int) -> bool:
+        """Drop ``key``'s record WITHOUT verifying or returning it (no
+        ``on_evict``, no counters): the shared-arena reset path — an
+        engine tearing down its own swapped entries must release their
+        reserved bytes without the checksum walk :meth:`take` pays,
+        and without clearing sibling engines' records the way
+        :meth:`clear` would. False when absent."""
+        with self._lock:
+            rec = self._entries.pop(int(key), None)
+            if rec is None:
+                return False
+            self._bytes_used -= rec.nbytes
+            return True
 
     def clear(self) -> None:
         """Drop every entry — pending ones included; a worker's late

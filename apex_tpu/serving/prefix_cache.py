@@ -89,6 +89,15 @@ __all__ = ["PrefixCache", "PrefixMatch"]
 
 _logger = get_logger("serving")
 
+# synthetic paged-entry keys: ONE process-wide negative counter, not a
+# per-cache one. With a per-cache counter two engines sharing one
+# HostTier arena (disaggregated serving) would both mint key -1, and a
+# put under a colliding key REPLACES — engine A's swapped entry would
+# silently come back backed by engine B's bytes, which pass the CRC
+# (they are B's honest bytes) while being the WRONG prefix's K/V. The
+# keys are opaque host bookkeeping, so global uniqueness costs nothing.
+_paged_key = itertools.count(-1, -1)
+
 
 def _roll(h: int, block: Tuple[int, ...]) -> int:
     """One step of the rolling block hash: fold the previous blocks'
@@ -160,8 +169,10 @@ class PrefixCache:
         self._index: Dict[int, Tuple[int, int]] = {}  # key -> (row, blocks)
         self._clock = itertools.count(1)
         # paged entries: synthetic negative keys (never collide with
-        # cache row ids) + the page-release hook eviction fires
-        self._paged_key = itertools.count(-1, -1)
+        # cache row ids, nor — being process-unique — with sibling
+        # caches sharing one host arena) + the page-release hook
+        # eviction fires
+        self._paged_key = _paged_key
         self._on_evict = on_evict
         # hierarchical-KV hooks (engine-wired via set_swap_hooks; both
         # None = no host tier, eviction destroys as always)
@@ -393,6 +404,84 @@ class PrefixCache:
                 self._index[key] = (row, i + 1)
         self.registrations += 1
         return "registered"
+
+    def register_handoff(self, key: int, prompt: Sequence[int], *,
+                         pages: Optional[Sequence[int]] = None,
+                         n_pages: int = 0,
+                         keys: Optional[Sequence[int]] = None) -> str:
+        """Register a disaggregated-serving HANDOFF prefix under an
+        EXTERNALLY supplied key (the request uid — positive, globally
+        unique, so records from N engines sharing one
+        :class:`~apex_tpu.serving.HostTier` arena can never collide
+        the way each cache's private negative synthetic keys would).
+        Two sides of the same handoff:
+
+        - **exporter** (prefill-role engine): pass ``pages`` — the
+          slot's page ids holding the ingested prefix. The entry is
+          registered RESIDENT exactly like an ordinary paged
+          registration (the caller bumps page refcounts on
+          ``"registered"``), ready for :meth:`swap_out_key` to land it
+          in the shared arena.
+        - **importer** (decode-role engine): pass ``n_pages`` with
+          ``pages=None`` — the entry is born directly in the
+          ``swapped`` state, backed by the arena record the exporter
+          already published; the ordinary admission match + swap-in
+          machinery then restores and shares it (or degrades to a
+          verified miss) with zero handoff-specific code.
+
+        Either way the entry is an ORDINARY swapped/resident prefix
+        afterwards: affinity probes see it, host-capacity eviction
+        drops it, ``drop``/``swap_in_complete`` treat it like any
+        other. An existing entry under ``key`` is replaced (uid keys
+        are single-writer by construction). Returns ``"registered"``
+        or ``"too_short"`` (no full block — nothing worth handing
+        off)."""
+        if (pages is not None) and n_pages:
+            raise ValueError("register_handoff takes pages (exporter) "
+                             "or n_pages (importer), not both")
+        key = int(key)
+        if key < 0:
+            raise ValueError("handoff keys are request uids (>= 0); "
+                             "negative keys are the cache's private "
+                             "synthetic namespace")
+        n_blocks = len(prompt) // self.block_len
+        if n_blocks == 0:
+            return "too_short"
+        length = n_blocks * self.block_len
+        if pages is not None and length % len(pages):
+            raise ValueError(
+                f"{len(pages)} pages cannot evenly hold a "
+                f"{length}-token prefix")
+        keys = self.block_keys(prompt, n_blocks) if keys is None \
+            else list(keys[:n_blocks])
+        self.drop(key)              # uid re-registration replaces
+        entry = _Entry(
+            row=key, tokens=tuple(int(t) for t in prompt[:length]),
+            n_blocks=n_blocks, last_used=next(self._clock),
+            pages=(tuple(int(p) for p in pages)
+                   if pages is not None else None),
+            swapped=pages is None,
+            swapped_pages=0 if pages is not None else int(n_pages))
+        self._entries[key] = entry
+        for i, k in enumerate(keys):
+            if k not in self._index:
+                self._index[k] = (key, i + 1)
+        self.registrations += 1
+        return "registered"
+
+    def swap_out_key(self, key: int) -> bool:
+        """Targeted resident→swapped migration of entry ``key`` (the
+        handoff export: the entry's bytes must land in the shared
+        arena NOW, not whenever LRU pressure would have picked it).
+        Same contract as the :meth:`evict_lru` swap path — the engine
+        hook snapshots the bytes before the device pages are released.
+        False when the key is unknown, already swapped, or the tier
+        declined (the caller hands off without a record and the
+        importer re-prefills)."""
+        entry = self._entries.get(int(key))
+        if entry is None or entry.swapped:
+            return False
+        return self._swap_out(entry)
 
     def _take_row(self) -> Optional[int]:
         """A free pool row, evicting the least-recently-used refcount-0

@@ -72,13 +72,41 @@ while per-replica prefix accounting uses
 :meth:`PrefixCache.stats_since` deltas, immune to the counters'
 cumulative-across-reset semantics.
 
+**Disaggregated serving** (``roles=[...]``): replica role is a
+first-class routing policy. A ``"prefill"`` replica ingests prompts
+through chunk prefill and — at ingestion completion — exports the
+finished block-aligned prefix into the fleet's SHARED
+:class:`~apex_tpu.serving.HostTier` arena (``shared=True``, one
+instance co-owned by every engine) via the async per-shard-CRC'd
+swap-out; it never decodes a token. The router collects the ready
+hand-over (:meth:`Scheduler.take_handoffs` — the record's swap-out has
+completed, so an importer can never race the CRC), transfers record
+ownership (the exporter's cache entry stands down, the arena record
+survives), registers the record as a born-swapped prefix on the best
+``"decode"``-capable replica and re-submits the request there
+(``_handoff=True``). The decode replica's ordinary admission path —
+prefix match, CRC-verified swap-in scatter, copy-on-write page share —
+resumes prefill at the exact committed offset and samples the first
+token bitwise-identically to a single-replica run: zero re-prefill on
+the happy path. A corrupt, evicted or failed record degrades per the
+hierarchical-KV contract to a VERIFIED MISS (the decode side
+re-prefills cold, counted as ``serving.disagg.reprefills``), never a
+wrong token. ``roles=None`` (every replica ``"both"``) is the
+verbatim default and leaves every code path above untouched. In a
+mixed fleet quarantine requeues also flow back through the router
+(:class:`Scheduler` ``on_requeue``), so a re-routed request re-probes
+the LIVE replicas and the arena at re-route time instead of being
+pinned to its first home.
+
 CPU-regime note (same shape as every serving PR): replicas on this
 box's CPU backend share cores, so N-replica tokens/s is NOT a scaling
 measurement here — the CPU-honest columns are prefix-affinity hit rate
 vs the random-routing control, bitwise parity across replica counts,
 and leak-free drains; the aggregate-throughput scaling claim is
 silicon's (``bench_serving.py --replica-router`` prints both with the
-caveat attached).
+caveat attached). For ``roles`` fleets the CPU-honest columns are
+decode-beat isolation (``serving.disagg.decode_isolation``) and the
+handoff byte/latency histograms — not tokens/s.
 """
 
 from __future__ import annotations
@@ -158,6 +186,7 @@ class Router:
 
     def __init__(self, engines: Sequence, *, registry=None,
                  route_policy: str = "affinity", seed: int = 0,
+                 roles: Optional[Sequence[str]] = None,
                  fault_plan=None, replica_plans=None, tracer=None,
                  **scheduler_kw):
         engines = list(engines)
@@ -166,6 +195,49 @@ class Router:
         if route_policy not in _ROUTE_POLICIES:
             raise ValueError(f"route_policy {route_policy!r} not in "
                              f"{_ROUTE_POLICIES}")
+        for fleet_kw in ("role", "on_requeue"):
+            if fleet_kw in scheduler_kw:
+                raise ValueError(
+                    f"{fleet_kw!r} is fleet policy — pass "
+                    "Router(roles=[...]) instead of a per-scheduler "
+                    "keyword")
+        self.roles: List[str] = [str(r) for r in roles] \
+            if roles is not None else ["both"] * len(engines)
+        if len(self.roles) != len(engines):
+            raise ValueError(
+                f"roles has {len(self.roles)} entries for "
+                f"{len(engines)} replicas")
+        self._mixed = any(r != "both" for r in self.roles)
+        self._tier = None
+        if self._mixed:
+            # a split fleet is only a fleet if BOTH halves exist: an
+            # all-prefill fleet can never emit a token, an all-decode
+            # fleet can never accept a prompt — both are configuration
+            # errors, not degraded modes
+            if not any(r in ("prefill", "both") for r in self.roles):
+                raise ValueError(
+                    f"roles {self.roles} has no prefill-capable "
+                    "replica ('prefill' or 'both'): nothing can "
+                    "ingest a prompt")
+            if not any(r in ("decode", "both") for r in self.roles):
+                raise ValueError(
+                    f"roles {self.roles} has no decode-capable "
+                    "replica ('decode' or 'both'): nothing can emit "
+                    "a token")
+            tiers = {id(getattr(e, "host_tier", None)) for e in engines}
+            tier0 = getattr(engines[0], "host_tier", None)
+            if tier0 is None or len(tiers) != 1:
+                raise ValueError(
+                    "a roles fleet hands K/V over through ONE shared "
+                    "host arena: build every engine with the same "
+                    "HostTier(shared=True) instance "
+                    "(host_tier=tier on each Engine)")
+            if not getattr(tier0, "shared", False):
+                raise ValueError(
+                    "the fleet's common HostTier must be built with "
+                    "shared=True: per-engine audits and resets must "
+                    "know the arena is co-owned")
+            self._tier = tier0
         geo0 = self._geometry(engines[0])
         for i, e in enumerate(engines[1:], 1):
             if self._geometry(e) != geo0:
@@ -189,6 +261,9 @@ class Router:
         # Chrome process i without threading pid through call sites
         self.replicas: List[Scheduler] = [
             Scheduler(e, registry=registry,
+                      role=self.roles[i],
+                      on_requeue=self._requeue if self._mixed
+                      else None,
                       fault_plan=replica_plans[i]
                       if replica_plans is not None else None,
                       tracer=tracer.for_replica(i)
@@ -222,6 +297,9 @@ class Router:
         # take yet (all queues full at drain time): re-routed at the
         # top of every step, ahead of new admissions
         self._overflow: collections.deque = collections.deque()
+        # ready hand-overs no decode-capable replica could queue yet
+        # (record ownership already transferred): retried every beat
+        self._handoff_overflow: collections.deque = collections.deque()
         self._tick = 0              # router step index (FaultPlan clock)
         self._closed = False
 
@@ -239,6 +317,23 @@ class Router:
                 "routing event")
         return idx
 
+    def _capable_indices(self, capability: Optional[str]) -> List[int]:
+        """Live replicas eligible for ``capability`` (``"prefill"`` /
+        ``"decode"`` / None for any). On the all-``"both"`` default
+        fleet this is exactly :meth:`_alive_indices` — role filtering
+        only exists once ``roles`` made the fleet mixed."""
+        idx = self._alive_indices()
+        if capability is None or not self._mixed:
+            return idx
+        want = ("prefill", "both") if capability == "prefill" \
+            else ("decode", "both")
+        idx = [i for i in idx if self.roles[i] in want]
+        if not idx:
+            raise RuntimeError(
+                f"no live {capability}-capable replica — the fleet "
+                "lost a whole role tier (outage, not a routing event)")
+        return idx
+
     def _probe_keys(self, request: Request):
         """The prompt's rolling block keys, computed ONCE per routed
         request (every replica's cache hashes identically — block_len
@@ -249,11 +344,13 @@ class Router:
         return pcache.block_keys(prompt,
                                  len(prompt) // pcache.block_len)
 
-    def _route_order(self, request: Request):
-        """``(keys, ordered_replicas, match_lens)``: live replicas
+    def _route_order(self, request: Request,
+                     capability: Optional[str] = None):
+        """``(keys, ordered_replicas, match_lens)``: live (and, in a
+        mixed-roles fleet, ``capability``-eligible) replicas
         best-first. Affinity ranks by probed prefix length, then load;
         least-loaded by load alone; random by a seeded shuffle."""
-        alive = self._alive_indices()
+        alive = self._capable_indices(capability)
         if self.route_policy == "random":
             order = [int(i) for i in self._rng.permutation(alive)]
             return None, order, {i: 0 for i in alive}
@@ -294,7 +391,10 @@ class Router:
         ``retry_after_s`` is then the max of the replicas' measured
         hints (None when no replica has measured a decode step yet)."""
         t_route = self.tracer.now() if self.tracer is not None else 0.0
-        keys, order, lens = self._route_order(request)
+        # a NEW prompt needs ingestion: in a mixed fleet only
+        # prefill-capable replicas are candidates (decode-role
+        # replicas serve router hand-overs, routed in step())
+        keys, order, lens = self._route_order(request, "prefill")
         hints: List[Optional[float]] = []
         for n_spilled, i in enumerate(order):
             try:
@@ -354,8 +454,105 @@ class Router:
         progress = self._drain_overflow()
         for i in self._alive_indices():
             progress = self.replicas[i].step() or progress
+        if self._mixed:
+            progress = self._collect_handoffs() or progress
         self._emit_gauges()
         return progress
+
+    # ------------------------------------------------------------ handoffs
+    def _requeue(self, request: Request) -> bool:
+        """Scheduler ``on_requeue`` seam (mixed-roles fleets): a
+        quarantined request re-routes through the router — re-probing
+        every LIVE replica's cache and load at re-route time — instead
+        of being pinned to the replica that faulted. False (the
+        replica keeps it queued locally) only when every eligible
+        queue is full."""
+        try:
+            self.submit(request)
+        except QueueFull:
+            return False
+        if self.registry is not None:
+            self.registry.counter_inc("serving.router.requeued")
+        return True
+
+    def _collect_handoffs(self) -> bool:
+        """Collect READY hand-overs from prefill-role replicas and
+        re-route each to a decode-capable replica. Ownership of the
+        arena record transfers here: the exporter's cache entry is
+        dropped (:meth:`PrefixCache.drop` on a swapped entry leaves
+        the arena bytes alone), then the record is re-registered as a
+        born-swapped prefix on the importer. A record the arena
+        evicted in flight degrades to a key-less handoff — the decode
+        side re-prefills cold (the verified-miss contract), the
+        request never faults."""
+        ready = list(self._handoff_overflow)
+        self._handoff_overflow.clear()
+        for i in self._alive_indices():
+            if self.roles[i] != "prefill":
+                continue
+            src_pc = self.replicas[i].engine.prefix_cache
+            for r, key, keys in self.replicas[i].take_handoffs():
+                if key is not None:
+                    src_pc.drop(key)
+                    if not self._tier.contains(key):
+                        key = None      # evicted mid-flight
+                ready.append((r, key, keys))
+        placed = False
+        for r, key, keys in ready:
+            placed = self._dispatch_handoff(r, key, keys) or placed
+        return placed
+
+    def _dispatch_handoff(self, r: Request, key: Optional[int],
+                          keys) -> bool:
+        """Home one hand-over on the best decode-capable replica:
+        queue the request (``_handoff=True`` — the decode-role submit
+        gate admits router hand-overs only), then register the arena
+        record as a born-swapped prefix under the request's uid and
+        note the pairing so admission resolves it (swap-in + COW share
+        on the happy path, counted re-prefill on a verified miss).
+        All queues full → the hand-over waits in the router's overflow
+        for the next beat, record intact."""
+        if key is not None and not self._tier.contains(key):
+            key = None                  # evicted while waiting
+        t_route = self.tracer.now() if self.tracer is not None else 0.0
+        _keys, order, lens = self._route_order(r, "decode")
+        for n_spilled, i in enumerate(order):
+            sched = self.replicas[i]
+            try:
+                sched.submit(r, prefix_keys=keys,
+                             count_rejection=False, _handoff=True)
+            except QueueFull:
+                continue
+            if key is not None:
+                eng = sched.engine
+                cap = ((len(r.prompt) - 1) // eng.chunk_len) \
+                    * eng.chunk_len
+                outcome = eng.prefix_cache.register_handoff(
+                    key, r.prompt[:cap], n_pages=cap // eng.page_len,
+                    keys=keys)
+                if outcome == "registered":
+                    sched.note_handoff(r.uid, key)
+                else:
+                    # unreachable for an aligned >=1-block prefix;
+                    # never strand arena bytes on a defensive edge
+                    self._tier.discard(key)
+            self.placements.pop(r.uid, None)
+            self.placements[r.uid] = i
+            while len(self.placements) > _PLACEMENTS_CAP:
+                self.placements.pop(next(iter(self.placements)))
+            if self.registry is not None and n_spilled:
+                self.registry.counter_inc("serving.router.spills",
+                                          n_spilled)
+            if self.tracer is not None:
+                self.tracer.event(r.uid, "route", t0=t_route,
+                                  dur=self.tracer.now() - t_route,
+                                  pid=i, replica=i,
+                                  policy=self.route_policy,
+                                  affinity_len=lens[i],
+                                  spills=n_spilled, handoff=True)
+            return True
+        self._handoff_overflow.append((r, key, keys))
+        return False
 
     def _drain_overflow(self) -> bool:
         """Re-route requests stranded by a replica death; those the
@@ -438,6 +635,20 @@ class Router:
             return
         self.registry.gauge_set("serving.router.replicas_alive",
                                 float(sum(self.alive)))
+        if self._mixed:
+            # the tentpole's CPU-measurable claim: the fraction of
+            # decode-role heartbeats that ran NO chunk prefill. On a
+            # "both" fleet long prompts steal every replica's beats;
+            # here only verified-miss re-prefills and the resumed
+            # final chunk may dent it
+            bt = bp = 0
+            for i, role in enumerate(self.roles):
+                if role == "decode":
+                    bt += self.replicas[i].beats_total
+                    bp += self.replicas[i].beats_with_prefill
+            if bt:
+                self.registry.gauge_set(
+                    "serving.disagg.decode_isolation", 1.0 - bp / bt)
         for i, sched in enumerate(self.replicas):
             if not self.alive[i]:
                 continue
@@ -462,7 +673,7 @@ class Router:
         """Requests the fleet still owes: overflow awaiting a home plus
         every live replica's queued/running/in-flight count (a drained
         dead replica reads zero by construction)."""
-        return len(self._overflow) + sum(
+        return len(self._overflow) + len(self._handoff_overflow) + sum(
             s.pending for i, s in enumerate(self.replicas)
             if self.alive[i])
 

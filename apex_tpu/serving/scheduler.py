@@ -322,6 +322,8 @@ class Scheduler:
                  retain_prefixes: bool = False,
                  speculative: bool = False,
                  pipeline_depth: int = 0,
+                 role: str = "both",
+                 on_requeue=None,
                  fault_policy: Optional[FaultPolicy] = None,
                  fault_plan=None,
                  auditor: Optional[PoolAuditor] = None,
@@ -348,6 +350,22 @@ class Scheduler:
                 raise ValueError(
                     "retain_prefixes requires an engine built with "
                     "prefix_pool > 0 (no pool rows to retain into)")
+        if role not in ("prefill", "decode", "both"):
+            raise ValueError(
+                f"role must be 'prefill', 'decode' or 'both', got "
+                f"{role!r}")
+        if role != "both":
+            if not retain_prefixes:
+                raise ValueError(
+                    f"role={role!r} requires retain_prefixes=True: the "
+                    "KV handoff travels as an ordinary swapped prefix, "
+                    "so both sides need the prefix-cache machinery")
+            if not getattr(engine, "paged", False) \
+                    or getattr(engine, "host_tier", None) is None:
+                raise ValueError(
+                    f"role={role!r} requires a paged engine with a "
+                    "host_tier: the handoff's KV travels through the "
+                    "(shared) host arena's swap programs")
         self.engine = engine
         self.max_queue = int(max_queue)
         self.default_timeout_s = default_timeout_s
@@ -356,6 +374,16 @@ class Scheduler:
         self.chunk_budget = int(chunk_budget)
         self.retain_prefixes = bool(retain_prefixes)
         self.speculative = bool(speculative)
+        # disaggregated serving (role != "both"): "prefill" replicas
+        # ingest prompts and export the finished prefix to the shared
+        # host arena instead of ever decoding; "decode" replicas accept
+        # only router hand-overs (plus their verified-miss re-prefills)
+        self.role = str(role)
+        # re-probe-at-requeue seam: when set, a quarantine offers the
+        # requeued request back to the router (which re-probes LIVE
+        # replicas and the arena) instead of this replica's own queue;
+        # returns True when the router took it
+        self.on_requeue = on_requeue
         self.registry = registry if registry is not None \
             else getattr(engine, "_registry", None)
         # request tracing (None = off, the zero-cost default: every
@@ -434,11 +462,30 @@ class Scheduler:
         # uid -> rolling block keys handed in at submit (the router's
         # pre-probed hashes); consumed at admission, dropped at finish
         self._presubmitted_keys: Dict[int, list] = {}
+        # prefill-role: finished prompt ingestions awaiting collection
+        # by the router as (request, arena key or None, block keys) —
+        # ready once the record's async swap-out completes
+        self._handoffs: List[tuple] = []
+        # decode-role: uid -> arena key for routed handoffs awaiting
+        # admission (resolved — imported or verified-miss re-prefilled —
+        # by _consult_prefix_cache)
+        self._handoff_uids: Dict[int, int] = {}
+        # dispatch-ahead chunk prefill (pipeline_depth >= 1): per-slot
+        # dispatched-but-unreconciled PendingPrefill handle as
+        # (pending, uid, lo, hi, t_dispatch); depth 0 never populates it
+        self._pending_prefill: List[Optional[tuple]] = \
+            [None] * engine.slots
+        # decode-beat isolation accounting: beats taken vs beats that
+        # ran any chunk-prefill work (the router aggregates these into
+        # the serving.disagg.decode_isolation gauge)
+        self.beats_total = 0
+        self.beats_with_prefill = 0
 
     # ------------------------------------------------------------ ingestion
     def submit(self, request: Request,
                prefix_keys: Optional[Sequence[int]] = None,
-               count_rejection: bool = True) -> Request:
+               count_rejection: bool = True,
+               _handoff: bool = False) -> Request:
         """Queue ``request``; raises :class:`QueueFull` at capacity and
         ``ValueError`` for prompts the engine can never serve.
 
@@ -469,6 +516,11 @@ class Scheduler:
                 "program cannot admit it")
         if request.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if self.role == "decode" and not _handoff:
+            raise ValueError(
+                "role='decode' replica serves router hand-overs only — "
+                "submit to a prefill-capable replica (the Router's "
+                "role policy routes new prompts there)")
         # paged note: no page-demand check is needed here — a request's
         # worst case is capped at ceil(max_len / page_len) pages, which
         # the Engine constructor guarantees every pool can hold, so the
@@ -537,6 +589,12 @@ class Scheduler:
         self._running[slot] = None
         self._temps[slot] = 0.0
         self._slot_hash_keys[slot] = None
+        if self._pending_prefill[slot] is not None:
+            # a dispatched-ahead prefill chunk nobody will read: the
+            # same speculated-finality rollback as the decode pipeline
+            self._pending_prefill[slot] = None
+            if self.registry is not None:
+                self.registry.counter_inc("serving.heartbeat.discarded")
         if self._pipeline:
             # invalidate the slot's in-flight dispatch-ahead steps NOW
             # (speculated-finality rollback): a uid check at reconcile
@@ -566,6 +624,16 @@ class Scheduler:
                 else RequestStatus.FINISHED
         request.status = status
         self._presubmitted_keys.pop(request.uid, None)
+        if self._handoff_uids:
+            hkey = self._handoff_uids.pop(request.uid, None)
+            if hkey is not None:
+                # the request died (expired/failed) before admission
+                # could import its handoff: release the orphaned cache
+                # entry and its arena record
+                if self.engine.prefix_cache.drop(hkey):
+                    tier = getattr(self.engine, "host_tier", None)
+                    if tier is not None:
+                        tier.discard(hkey)
         if request._t_submit is not None:
             request.latency_s = time.perf_counter() - request._t_submit
         if self.tracer is not None:
@@ -646,11 +714,20 @@ class Scheduler:
             return
         now = self._reset_transient(request)
         request._not_before = now + policy.backoff_s(request.retries)
-        self._queue.append(request)
+        # re-probe on requeue: offer the request back to the router
+        # first (it re-probes LIVE replicas and the arena at re-route
+        # time, so the retry can home onto a prefix or handoff that
+        # registered after the original submit); the local queue is the
+        # fallback when no router is wired or it declined
+        rerouted = self.on_requeue is not None \
+            and bool(self.on_requeue(request))
+        if not rerouted:
+            self._queue.append(request)
         if self.registry is not None:
             self.registry.counter_inc("serving.faults.requeued")
-        _logger.info("request %d requeued (retry %d/%d): %s",
-                     request.uid, request.retries, policy.max_retries,
+        _logger.info("request %d requeued%s (retry %d/%d): %s",
+                     request.uid, " via router" if rerouted else "",
+                     request.retries, policy.max_retries,
                      error)
 
     def _reset_transient(self, request: Request) -> float:
@@ -816,6 +893,32 @@ class Scheduler:
                     m.length // self.engine.chunk_len)
             self.registry.gauge_set("serving.prefix.hit_rate",
                                     pcache.hit_rate)
+        if not self._handoff_uids:
+            return
+        hkey = self._handoff_uids.pop(r.uid, None)
+        if hkey is None:
+            return
+        imported = m is not None and getattr(m, "row", None) == hkey
+        if not imported:
+            # the handoff record went missing, corrupt or evicted (or
+            # the swap-in failed its CRC — the engine dropped that
+            # entry itself): VERIFIED MISS. Release any dangling entry
+            # plus its arena record, then re-prefill — nothing was
+            # attached, so never a wrong token. When an ordinary local
+            # prefix matched instead (m covers the same tokens), the
+            # unused handoff record is released the same way but no
+            # re-prefill is charged.
+            if pcache.drop(hkey):
+                tier = getattr(self.engine, "host_tier", None)
+                if tier is not None:
+                    tier.discard(hkey)
+            if m is None and self.registry is not None:
+                self.registry.counter_inc("serving.disagg.reprefills")
+        if self.tracer is not None:
+            self.tracer.event(r.uid, "handoff_import",
+                              imported=imported,
+                              reused_tokens=0 if m is None
+                              else m.length)
 
     def _admit_monolithic(self) -> None:
         """Legacy admit (``chunked=False``): whole-prompt prefill at
@@ -921,12 +1024,36 @@ class Scheduler:
             if ran >= self.chunk_budget:
                 break
             slot = (start + i) % slots
+            if self._pending_prefill[slot] is not None:
+                # dispatch-ahead prefill: retire the slot's in-flight
+                # chunk FIRST (its readback was deferred one visit so
+                # the device executed it under this beat's host work),
+                # then dispatch the next — reconcile-then-dispatch
+                # keeps at most one chunk per slot in flight
+                self._reconcile_prefill(slot)
             r = self._running[slot]
             if r is None or r.status != "prefilling":
                 continue
+            if self.role == "prefill":
+                cap = ((len(r.prompt) - 1) // self.engine.chunk_len) \
+                    * self.engine.chunk_len
+                if r._prefill_pos >= cap:
+                    # ingestion complete (every full chunk; the final
+                    # partial chunk belongs to the importer, whose
+                    # chunk-prefill program samples the first token):
+                    # export to the arena and free the slot
+                    self._export_handoff(r, slot)
+                    ran += 1
+                    self._pf_rr = (slot + 1) % slots
+                    continue
             lo = r._prefill_pos
             hi = min(lo + self.engine.chunk_len, len(r.prompt))
             final = hi == len(r.prompt)
+            if self.pipeline_depth > 0:
+                self._dispatch_prefill(slot, r, lo, hi, final, tick)
+                ran += 1
+                self._pf_rr = (slot + 1) % slots
+                continue
             t0 = time.perf_counter()
             try:
                 if self.fault_plan is not None:
@@ -964,31 +1091,173 @@ class Scheduler:
                 continue
             if not final:
                 continue
-            if self.retain_prefixes:
-                if self.tracer is not None:
-                    # registration can evict a prefix entry, which on a
-                    # hierarchical-KV engine dispatches a swap-out —
-                    # bind so those spans attribute to this request
-                    with self.tracer.bind(r.uid):
-                        self._register_prefix(r, slot)
-                else:
-                    self._register_prefix(r, slot)
-            r.ttft_s = time.perf_counter() - r._t_submit
-            if self.registry is not None:
-                self.registry.observe("serving.ttft_s", r.ttft_s)
-            r.output_tokens.append(token)
-            if self.eos_id is not None and token == self.eos_id:
-                self._finish(r, "eos", slot)
-            elif r.max_new_tokens <= 1:
-                self._finish(r, "max_new_tokens", slot)
-            elif len(r.prompt) >= self.engine.max_len:
-                # cache already full: a decode step would overwrite the
-                # last prompt position's K/V and emit a corrupted token
-                self._finish(r, "max_len", slot)
-            else:
-                r.status = RequestStatus.RUNNING
-                self._last_tokens[slot] = token
+            self._complete_prompt(r, slot, token)
         return ran
+
+    def _complete_prompt(self, r: Request, slot: int,
+                         token: int) -> None:
+        """Prompt-ingestion completion (shared by the sync and
+        dispatch-ahead prefill paths): register the prefix, mark the
+        TTFT, and emit the first token through the same finish checks
+        as every other token."""
+        if self.retain_prefixes:
+            if self.tracer is not None:
+                # registration can evict a prefix entry, which on a
+                # hierarchical-KV engine dispatches a swap-out — bind
+                # so those spans attribute to this request
+                with self.tracer.bind(r.uid):
+                    self._register_prefix(r, slot)
+            else:
+                self._register_prefix(r, slot)
+        r.ttft_s = time.perf_counter() - r._t_submit
+        if self.registry is not None:
+            self.registry.observe("serving.ttft_s", r.ttft_s)
+        r.output_tokens.append(token)
+        if self.eos_id is not None and token == self.eos_id:
+            self._finish(r, "eos", slot)
+        elif r.max_new_tokens <= 1:
+            self._finish(r, "max_new_tokens", slot)
+        elif len(r.prompt) >= self.engine.max_len:
+            # cache already full: a decode step would overwrite the
+            # last prompt position's K/V and emit a corrupted token
+            self._finish(r, "max_len", slot)
+        else:
+            r.status = RequestStatus.RUNNING
+            self._last_tokens[slot] = token
+
+    def _dispatch_prefill(self, slot: int, r: Request, lo: int,
+                          hi: int, final: bool, tick: int) -> None:
+        """DISPATCH-AHEAD REGION (prefill path): issue chunk
+        ``[lo, hi)`` for ``slot`` without forcing its sampled token to
+        host — the chunk executes on the device while the beat's
+        remaining host work runs; :meth:`_reconcile_prefill` retires it
+        at the slot's next visit. Nothing in this function may force a
+        device value (no ``int()`` / ``np.asarray`` /
+        ``jax.device_get`` — statically linted BY NAME in
+        ``tests/L0/test_serving_metrics_lint.py``)."""
+        t0 = time.perf_counter()
+        try:
+            if self.fault_plan is not None:
+                self.fault_plan.maybe_raise("chunk", tick)
+            pending = self.engine.prefill_chunk_dispatch(
+                slot, list(r.prompt[lo:hi]), lo, r.temperature,
+                final=final)
+        except Exception as e:  # noqa: BLE001 — containment edge
+            r.prefill_s += time.perf_counter() - t0
+            self._count_transient()
+            self._quarantine(r, slot, f"{type(e).__name__}: {e}")
+            return
+        r.prefill_s += time.perf_counter() - t0
+        r._prefill_pos = hi
+        self._pending_prefill[slot] = (pending, r.uid, lo, hi, t0)
+
+    def _reconcile_prefill(self, slot: int) -> None:
+        """Retire ``slot``'s dispatched-ahead prefill chunk: force its
+        token, finish the chunk's accounting, and — when it was the
+        prompt's final chunk — run the same completion path as the
+        sync beat. A slot that churned while the chunk was in flight
+        had its handle dropped by ``_free_slot`` already; the uid
+        re-check here is belt-and-braces."""
+        entry = self._pending_prefill[slot]
+        if entry is None:
+            return
+        self._pending_prefill[slot] = None
+        pending, uid, lo, hi, t0 = entry
+        r = self._running[slot]
+        if r is None or r.uid != uid or r.status != "prefilling":
+            if self.registry is not None:
+                self.registry.counter_inc("serving.heartbeat.discarded")
+            return
+        tr0 = time.perf_counter()
+        try:
+            token = self.engine.prefill_chunk_reconcile(pending)
+        except Exception as e:  # noqa: BLE001 — containment edge
+            # async backends can surface a dispatched chunk's failure
+            # at its deferred force rather than at dispatch
+            r.prefill_s += time.perf_counter() - tr0
+            self._count_transient()
+            self._quarantine(r, slot, f"{type(e).__name__}: {e}")
+            return
+        r.prefill_s += time.perf_counter() - tr0
+        r.chunks += 1
+        final = hi == len(r.prompt)
+        if self.tracer is not None:
+            self.tracer.event(r.uid, "prefill_chunk", t0=t0,
+                              dur=time.perf_counter() - t0,
+                              lo=lo, hi=hi, final=final)
+        if not self.engine.last_chunk_finite:
+            # same contract as the sync beat: non-finite logits at the
+            # sampled row make the slot's K/V suspect end-to-end
+            self._quarantine(r, slot, "non-finite chunk-prefill logits")
+            return
+        if final:
+            self._complete_prompt(r, slot, token)
+
+    # ------------------------------------------------- disaggregation
+    def _export_handoff(self, r: Request, slot: int) -> None:
+        """Prefill-role hand-over, at prompt-ingestion completion: land
+        the slot's finished prefix in the (shared) host arena under the
+        request's uid via the async CRC'd swap-out
+        (:meth:`Engine.export_handoff`), roll the request back to a
+        servable queued state and free the slot. The router collects
+        ``(request, key, block keys)`` from :meth:`take_handoffs` once
+        the record's swap-out completes and re-routes to a
+        decode-capable replica. A failed export degrades to a key-less
+        handoff — the decode side re-prefills cold, never a fault of
+        the request (the PR 13 verified-miss contract)."""
+        keys = self._slot_hash_keys[slot]
+        t0 = time.perf_counter()
+        exported = 0
+        try:
+            if self.tracer is not None:
+                with self.tracer.bind(r.uid):
+                    exported = self.engine.export_handoff(
+                        slot, r.uid, r.prompt, keys=keys)
+            else:
+                exported = self.engine.export_handoff(
+                    slot, r.uid, r.prompt, keys=keys)
+        except Exception as e:  # noqa: BLE001 — containment edge
+            self._count_transient()
+            _logger.warning(
+                "handoff export for request %d failed (%s: %s) — the "
+                "decode side will re-prefill", r.uid,
+                type(e).__name__, e)
+        if self.tracer is not None:
+            self.tracer.event(r.uid, "handoff_export", t0=t0,
+                              dur=time.perf_counter() - t0, slot=slot,
+                              exported_tokens=exported)
+        self._reset_transient(r)
+        r._not_before = None
+        self._free_slot(slot)
+        self._handoffs.append((r, r.uid if exported else None, keys))
+        if self.registry is not None:
+            self.registry.counter_inc("serving.disagg.handoffs")
+
+    def take_handoffs(self) -> List[tuple]:
+        """Pop every ``(request, arena_key_or_None, block_keys)``
+        hand-over whose arena record is READY — its async swap-out has
+        left the worker's pending set, so an importer's ``take`` can
+        never race the CRC completion — or which never got a record
+        (the cold handoff: short prompt, declined arena, failed
+        export). Still-in-flight records stay for a later call."""
+        if not self._handoffs:
+            return []
+        tier = getattr(self.engine, "host_tier", None)
+        pending = set(tier.pending_keys()) if tier is not None \
+            else set()
+        ready = [h for h in self._handoffs
+                 if h[1] is None or h[1] not in pending]
+        if ready:
+            self._handoffs = [h for h in self._handoffs
+                              if h[1] is not None and h[1] in pending]
+        return ready
+
+    def note_handoff(self, uid: int, key: int) -> None:
+        """Router seam (decode side): record that ``uid`` arrives with
+        an arena handoff record under ``key``. Admission resolves it —
+        zero re-prefill on the happy path, the VERIFIED-MISS re-prefill
+        otherwise — and the resolution is counted and traced there."""
+        self._handoff_uids[int(uid)] = int(key)
 
     def _register_prefix(self, r: Request, slot: int) -> None:
         """Write path, at prompt-ingestion completion: retain the
@@ -1264,6 +1533,12 @@ class Scheduler:
                 # kind): the NEXT swap-in of the victim entry must
                 # fail its checksum and degrade to a verified miss
                 self.fault_plan.maybe_corrupt_swap(tick, tier)
+                # injected handoff bit rot (the handoff_corruption
+                # kind): victimizes uid-keyed handoff records only, so
+                # the next IMPORT's CRC fails and degrades to the
+                # verified-miss re-prefill on the decode side — never
+                # a wrong token
+                self.fault_plan.maybe_corrupt_handoff(tick, tier)
         compiled0 = getattr(self.engine, "compiled_programs", 0)
         dw0 = getattr(self.engine, "device_wait_s", 0.0)
         # requests riding this beat, snapshotted BEFORE the body so
@@ -1347,6 +1622,14 @@ class Scheduler:
             if not more:
                 break
             chunks += more
+        self.beats_total += 1
+        if chunks:
+            self.beats_with_prefill += 1
+        if self.role == "prefill":
+            # prefill replicas never decode: the beat is expire →
+            # admit → ingest → export; finished ingestions sit in
+            # _handoffs until the router collects them
+            return chunks > 0
         spec_slots: set = set()
         spec_calls = spec_emitted = 0
         if self.speculative:
@@ -1477,6 +1760,14 @@ class Scheduler:
             if not more:
                 break
             chunks += more
+        self.beats_total += 1
+        if chunks:
+            self.beats_with_prefill += 1
+        if self.role == "prefill":
+            # prefill replicas never decode (dispatch-ahead applies to
+            # their CHUNKS instead — _prefill_tick's reconcile-then-
+            # dispatch split keeps one chunk per slot in flight)
+            return chunks > 0
         spec_slots: set = set()
         spec_calls = spec_emitted = 0
         reconciled = 0
@@ -1704,7 +1995,8 @@ class Scheduler:
         even in-flight device work, so the LAST request's EOS cannot
         strand its speculated successors un-discarded)."""
         n = len(self._queue) + sum(r is not None
-                                   for r in self._running)
+                                   for r in self._running) \
+            + len(self._handoffs)
         if self._pipeline:
             n += 1
         return n
@@ -1766,6 +2058,27 @@ class Scheduler:
         # _free_slot above; drop the empty records (their device work
         # is never reconciled — the dead engine's results are garbage)
         self._pipeline.clear()
+        # uncollected handoffs: nobody will ever import them — release
+        # each one's cache entry and arena record (complete() tolerates
+        # a record discarded mid-flight) and requeue the request
+        tier = getattr(self.engine, "host_tier", None)
+        for r, key, _keys in self._handoffs:
+            if key is not None:
+                if self.engine.prefix_cache.drop(key) \
+                        and tier is not None:
+                    tier.discard(key)
+            self._reset_transient(r)
+            r._not_before = None
+            drained.append(r)
+        self._handoffs = []
+        # decode-side mirror: noted-but-not-yet-admitted imports also
+        # orphan their entry + record when this replica drains (the
+        # router re-routes the request through a fresh prefill)
+        for key in self._handoff_uids.values():
+            if self.engine.prefix_cache.drop(key) \
+                    and tier is not None:
+                tier.discard(key)
+        self._handoff_uids.clear()
         while self._queue:
             r = self._queue.popleft()
             self._reset_transient(r)

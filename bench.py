@@ -71,7 +71,14 @@ it; BENCH_SERVING_REPLICAS sizes the fleet): the prefix-aware
 least-loaded router at 1 vs N replicas — aggregate tokens/s, p99
 TTFT, prefix hit rate affinity vs a random-routing control,
 ``token_mismatched_requests`` (expected 0, bitwise) — via
-``bench_serving.replica_router_stats``.
+``bench_serving.replica_router_stats``, and a nested
+``disaggregated`` sub-object (BENCH_SERVING_DISAGG=0 to drop it):
+the prefill/decode role-split leg — one fleet over one shared host
+arena, colocated vs ``Router(roles=[...])`` with CRC'd KV handoff
+(bystander TTFT p50/p99 both modes, the decode-replica
+heartbeat-tail isolation, handoff traffic + export/import p50/p99,
+zero re-prefills, zero leaked arena bytes, bitwise exactness) — via
+``bench_serving.disagg_stats``.
 Failure-isolated at every layer: a broken serving stack puts
 {"error": ...} there, never kills the ResNet row.
 """
@@ -233,6 +240,16 @@ _SERVING_ROUTER_SMOKE = {
     "WINDOWS": 1, "PREFIX_POOL": 4,
 }
 
+# The disaggregated sub-leg's smoke geometry (the bystander/heavyweight
+# stream is served TWICE — colocated, then role-split with KV handoff —
+# so it is sized small; every third request is a heavyweight).
+# BENCH_SERVING_REPLICAS et al. still win, env-beats-smoke.
+_SERVING_DISAGG_SMOKE = {
+    "SIZE": "tiny", "VOCAB": 512, "SLOTS": 2, "MAX_LEN": 128,
+    "PREFILL_LEN": 48, "CHUNK_LEN": 8, "SHORT_LEN": 6, "REQUESTS": 6,
+    "NEW_TOKENS": 8, "WINDOWS": 1, "PREFIX_POOL": 4,
+}
+
 
 def _serving_leg() -> dict:
     """The serving trajectory row (ROADMAP: bench_serving.py had no
@@ -261,6 +278,7 @@ def _serving_leg() -> dict:
         out["quantized_weights"] = _serving_wquant_leg()
         out["async_heartbeat"] = _serving_async_leg()
         out["replica_router"] = _serving_router_leg()
+        out["disaggregated"] = _serving_disagg_leg()
         out["host_tier"] = _serving_host_tier_leg()
         return out
     except KeyboardInterrupt:
@@ -498,6 +516,44 @@ def _serving_router_leg() -> dict:
             "reused_tokens_per_request_random",
             "affinity_beats_random", "spills",
             "token_mismatched_requests", "compiled_programs", "model")}
+    except KeyboardInterrupt:
+        raise
+    except BaseException as e:  # noqa: BLE001 — the row must not die here
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def _serving_disagg_leg() -> dict:
+    """The disaggregated-serving trajectory sub-row: smoke-sized
+    prefill/decode role-split summary (one fleet over one shared host
+    arena, colocated vs role-split with KV handoff — bystander TTFT
+    p50/p99 both modes, the decode-replica heartbeat-tail isolation,
+    handoff traffic with export/import p50/p99, zero re-prefills /
+    zero leaked arena bytes, bitwise exactness) from
+    ``bench_serving.disagg_stats``. BENCH_SERVING_DISAGG=0 drops it;
+    failure-isolated like its siblings — a broken handoff layer
+    yields {"error": ...} here, never a lost serving (or ResNet)
+    row."""
+    if _env_int("BENCH_SERVING_DISAGG", "1") == 0:
+        return {"skipped": True}
+    try:
+        import bench_serving
+
+        bench_serving._load_env(smoke=dict(_SERVING_DISAGG_SMOKE))
+        _, summary = bench_serving.disagg_stats()
+        return {k: summary[k] for k in (
+            "value", "unit", "replicas", "decode_replicas",
+            "colocated_tokens_per_s",
+            "ttft_bystander_p50_ms", "ttft_bystander_p50_ms_colocated",
+            "ttft_bystander_p99_ms", "ttft_bystander_p99_ms_colocated",
+            "decode_heartbeat_host_p99_ms",
+            "decode_heartbeat_host_p99_ms_colocated",
+            "decode_beat_tail_improved", "decode_host_p99_isolation_x",
+            "decode_isolation", "handoffs", "handoff_bytes",
+            "reprefills", "zero_reprefills_clean",
+            "handoff_export_p50_ms", "handoff_export_p99_ms",
+            "handoff_import_p50_ms", "handoff_import_p99_ms",
+            "arena_bytes_after_drain", "token_mismatched_requests",
+            "model")}
     except KeyboardInterrupt:
         raise
     except BaseException as e:  # noqa: BLE001 — the row must not die here

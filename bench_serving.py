@@ -263,6 +263,43 @@ parity and the leak-free drain are the CPU-honest columns; aggregate
 scaling vs replica count is the silicon claim. Defaults to a smoke
 geometry; env knobs resize it (env-beats-smoke).
 
+``--disaggregated`` runs the prefill/decode role-split leg: one fleet
+of ``BENCH_SERVING_REPLICAS + 1`` identically-built engines over ONE
+shared ``HostTier(shared=True)`` arena serves the SAME interleaved
+stream twice — every third request a heavyweight (a
+``BENCH_SERVING_PREFILL``-token prompt, a few new tokens: pure
+ingestion pressure), the rest SHORT bystanders (a one-chunk prompt,
+``BENCH_SERVING_NEW_TOKENS`` decode budget) — first colocated (all
+roles ``"both"``: every replica interleaves heavyweight chunk
+prefills with bystander decodes), then role-split
+(``Router(roles=["prefill", "decode", ...])``: heavyweights ingest on
+the prefill replica and the CRC'd aligned handoff moves the prefix
+through the arena to a decode replica, zero re-prefill on the happy
+path). One row per mode plus a final line whose payoff fields are
+**bystander TTFT p50/p99** colocated vs split (the head-of-line
+claim one fleet-tier up from ``--mixed-prompts``), the
+**decode-replica heartbeat** ``serving.heartbeat.host_s`` p50/p99
+both modes (read from PER-REPLICA scheduler registries so the
+prefill replica's chunky beats cannot pollute the decode reading —
+the isolation delta), ``decode_isolation`` (the fraction of
+decode-capable replicas' beats that carried NO chunk-prefill work,
+from the same scheduler beat counters behind the
+``serving.disagg.decode_isolation`` gauge), the handoff traffic
+columns (``handoffs`` / ``handoff_bytes`` / ``reprefills`` — the
+last expected 0 outside chaos — and handoff export/import p50/p99
+from the ``serving.swap.out_s``/``in_s`` histograms),
+``arena_bytes_after_drain`` (expected 0 — no leaked handoff
+records), and ``token_mismatched_requests`` vs the colocated run
+(greedy; expected **0 bitwise** on every backend — the role split
+changes WHERE a prompt ingests, never what any program computes).
+CPU regime note: both modes share this box's cores, so split-fleet
+tokens/s is NOT a throughput claim here — bystander TTFT, the
+decode-beat isolation columns, bitwise parity and the leak-free
+drain are the CPU-honest columns; aggregate disaggregated throughput
+is the silicon claim. Defaults to a smoke geometry; env knobs resize
+it (env-beats-smoke), and ``BENCH_SERVING_TRACE`` attaches request
+tracing to the split leg (handoff export/import spans included).
+
 Wrapped in ``guard_bench_main`` — EVERY outcome (backend init failure,
 OOM, bad env) still ends in a parseable JSON line.
 """
@@ -289,6 +326,7 @@ WQUANT_METRIC = "serving_quantized_weights_tokens_per_sec"
 ASYNC_METRIC = "serving_async_heartbeat_tokens_per_sec"
 ROUTER_METRIC = "serving_replica_router_tokens_per_sec"
 HOST_METRIC = "serving_host_tier_tokens_per_sec"
+DISAGG_METRIC = "serving_disagg_tokens_per_sec"
 
 # Literal defaults at import time; the BENCH_SERVING_* env overrides are
 # parsed by _load_env() INSIDE each guarded main, so a malformed value
@@ -377,6 +415,18 @@ ROUTER_SMOKE = {"SIZE": "tiny", "VOCAB": 512, "SLOTS": 2,
                 "MAX_LEN": 128, "PREFILL_LEN": 48, "CHUNK_LEN": 8,
                 "REQUESTS": 6, "NEW_TOKENS": 8, "WINDOWS": 1,
                 "PREFIX_POOL": 4}
+# --disaggregated leg: the SAME interleaved bystander/heavyweight
+# stream served by one fleet of REPLICAS+1 engines over one shared
+# host arena, colocated (all "both") then role-split (1 prefill +
+# REPLICAS decode with KV handoff) — two serves per window, so it is
+# sized small. SHORT_LEN bounds the bystander prompts (they must fit
+# one chunk so a bystander's cost is pure decode); PREFILL_LEN is the
+# heavyweight prompt (several chunks, so its ingestion visibly hogs a
+# colocated replica's beats); HOST_TIER_MIB bounds the handoff arena.
+DISAGG_SMOKE = {"SIZE": "tiny", "VOCAB": 512, "SLOTS": 2,
+                "MAX_LEN": 128, "PREFILL_LEN": 48, "CHUNK_LEN": 8,
+                "SHORT_LEN": 6, "REQUESTS": 9, "NEW_TOKENS": 10,
+                "WINDOWS": 1, "PREFIX_POOL": 4}
 # --host-tier leg: distinct shared-prefix templates the stream cycles
 # through (the pool is sized for ~half of them, so revisits land on
 # evicted — with the tier, SWAPPED — prefixes), the host arena bound
@@ -2346,6 +2396,278 @@ def main_router():
     print(json.dumps(summary))
 
 
+def _disagg_requests(rng):
+    """REQUESTS arrivals, bystanders interleaved with heavyweights:
+    every THIRD request is a heavyweight (a near-PREFILL_LEN prompt,
+    a few new tokens — pure ingestion pressure), the rest are SHORT
+    bystanders (a one-chunk prompt, the full NEW_TOKENS decode
+    budget). Returns ``(requests, bystander_mask)`` — the mask is
+    what splits the TTFT histograms by class."""
+    from apex_tpu.serving import Request
+
+    chunk = CHUNK_LEN or 8
+    reqs, bystander = [], []
+    for i in range(REQUESTS):
+        heavy = i % 3 == 2
+        if heavy:
+            lo = max(chunk + 1, PREFILL_LEN - chunk)
+            n = int(rng.integers(lo, PREFILL_LEN + 1))
+            budget = max(1, NEW_TOKENS // 4)
+        else:
+            n = int(rng.integers(1, max(2, min(SHORT_LEN, chunk)) + 1))
+            budget = NEW_TOKENS
+        reqs.append(Request(
+            prompt=rng.integers(1, VOCAB, size=n).tolist(),
+            max_new_tokens=max(1, min(budget, MAX_LEN - n))))
+        bystander.append(not heavy)
+    return reqs, bystander
+
+
+def _host_beat_ms(rep_reg, pct):
+    """A percentile of ONE replica's ``serving.heartbeat.host_s``
+    histogram in ms — the per-replica registry is what keeps the
+    prefill replica's chunky beats out of a decode replica's
+    reading."""
+    snap = rep_reg.snapshot()
+    h = snap["histograms"].get("serving.heartbeat.host_s", {})
+    return h.get(pct, 0.0) * 1e3
+
+
+def _serve_disagg(engines, roles, seed, tier, tracer=None):
+    """WINDOWS measured windows (plus a discarded compile warmup) of
+    the bystander/heavyweight stream through one Router role layout
+    over the SHARED arena ``tier``. Fleet-level metrics (router
+    counters, engine-side swap histograms, the disagg gauges) land in
+    one shared registry; each measured window ALSO re-points the
+    SCHEDULER-side registry per replica — heartbeat host_s and the
+    scheduler-emitted disagg counters split by replica, which is the
+    only honest way to read a decode replica's beat profile out of a
+    mixed fleet (the fleet histogram would pool the prefill replica's
+    chunk-prefill beats into it)."""
+    from apex_tpu import serving, telemetry
+
+    reg = telemetry.MetricsRegistry()
+    rep_regs = [telemetry.MetricsRegistry() for _ in engines]
+    decode_idx = [i for i, role in enumerate(roles)
+                  if role != "prefill"]
+    rng = np.random.default_rng(seed)
+    rates, all_reqs, by_ttfts, heavy_ttfts = [], [], [], []
+    beats_total = beats_prefill = 0
+    for w in range(WINDOWS + 1):
+        for e in engines:
+            e.reset(clear_prefixes=True)
+            e.set_registry(reg if w else None)
+        assert tier.bytes_used == 0     # windows start arena-clean
+        router = serving.Router(engines, registry=reg if w else None,
+                                roles=list(roles), seed=seed,
+                                max_queue=max(REQUESTS, 1),
+                                chunk_budget=CHUNK_BUDGET,
+                                retain_prefixes=True, tracer=tracer)
+        if w:
+            for s, rr in zip(router.replicas, rep_regs):
+                s.registry = rr
+        reqs, bystander = _disagg_requests(rng)
+        t0 = time.perf_counter()
+        tok0 = sum(e.tokens_generated for e in engines)
+        router.run(reqs)
+        dt = time.perf_counter() - t0
+        router.close()
+        assert all(r.status == "finished" for r in reqs)
+        if w > 0:
+            rates.append(
+                (sum(e.tokens_generated for e in engines) - tok0) / dt)
+            all_reqs.extend(reqs)
+            for r, is_by in zip(reqs, bystander):
+                if r.ttft_s is None:
+                    continue
+                (by_ttfts if is_by else heavy_ttfts).append(r.ttft_s)
+            for i in decode_idx:
+                beats_total += router.replicas[i].beats_total
+                beats_prefill += router.replicas[i].beats_with_prefill
+    for e in engines:
+        e.set_registry(None)
+    return {
+        "rate": _median(rates),
+        "reqs": all_reqs,
+        "bystander_ttfts": by_ttfts,
+        "heavy_ttfts": heavy_ttfts,
+        "beats_total": beats_total,
+        "beats_with_prefill": beats_prefill,
+        "decode_idx": decode_idx,
+        "snap": reg.snapshot(),
+        "rep_regs": rep_regs,
+    }
+
+
+def disagg_stats():
+    """The --disaggregated measurement, reusable by bench.py's serving
+    trajectory leg: the SAME seeded bystander/heavyweight stream
+    served by ONE fleet of REPLICAS+1 engines over one shared
+    ``HostTier(shared=True)`` arena, colocated (all ``"both"``) then
+    role-split (1 prefill + REPLICAS decode, KV handoff through the
+    arena). Headline fields: bystander TTFT p50/p99 both modes (the
+    head-of-line claim), decode-replica heartbeat host_s p50/p99 both
+    modes from per-replica registries (the isolation delta),
+    ``decode_isolation`` both modes, the handoff traffic columns with
+    export/import p50/p99 from the swap histograms,
+    ``arena_bytes_after_drain`` (expected 0), and
+    ``token_mismatched_requests`` vs colocated (expected 0,
+    bitwise)."""
+    from apex_tpu import serving
+
+    n = max(1, REPLICAS) + 1
+    tier = serving.HostTier(HOST_TIER_MIB << 20, shared=True)
+    engines = [_build_engine(prefix_pool=PREFIX_POOL, host_tier=tier)
+               for _ in range(n)]
+    modes = {
+        "colocated": ["both"] * n,
+        "disaggregated": ["prefill"] + ["decode"] * (n - 1),
+    }
+    # BENCH_SERVING_TRACE=path (off by default): attach a request
+    # tracer to the split leg and write a Chrome-trace artifact — the
+    # handoff_export / handoff_import spans ride every hand-over, so
+    # the artifact shows a request's life across BOTH role tiers
+    trace_path = os.environ.get("BENCH_SERVING_TRACE")
+    trace_spans = None
+    rows, results = {}, {}
+    for mode, roles in modes.items():
+        tracer = None
+        if trace_path and mode == "disaggregated":
+            from apex_tpu.telemetry import Tracer
+
+            tracer = Tracer(max_traces=8192)
+        res = _serve_disagg(engines, roles, seed=23, tier=tier,
+                            tracer=tracer)
+        if tracer is not None:
+            trace_spans = tracer.export_chrome_trace(trace_path)
+        results[mode] = res
+        # leak check: with every request drained and the prefix pools
+        # cleared, a nonzero arena is an orphaned handoff record
+        for e in engines:
+            e.reset(clear_prefixes=True)
+        counters = res["snap"]["counters"]
+        hist = res["snap"]["histograms"]
+        by, heavy = res["bystander_ttfts"], res["heavy_ttfts"]
+        bt, bp = res["beats_total"], res["beats_with_prefill"]
+        rep = res["rep_regs"]
+
+        def _swap_ms(name, pct):
+            return round(hist.get(name, {}).get(pct, 0.0) * 1e3, 4)
+
+        def _sched_counter(name):
+            return int(sum(r.snapshot()["counters"].get(name, 0)
+                           for r in rep))
+
+        host_p50 = [_host_beat_ms(rep[i], "p50")
+                    for i in res["decode_idx"]]
+        host_p99 = [_host_beat_ms(rep[i], "p99")
+                    for i in res["decode_idx"]]
+        rows[mode] = {
+            "metric": f"{DISAGG_METRIC}.{mode}",
+            "value": round(res["rate"], 2),
+            "unit": "tokens/s",
+            "roles": list(roles),
+            "ttft_bystander_p50_ms": round(float(
+                np.percentile(by, 50)) * 1e3, 3) if by else 0.0,
+            "ttft_bystander_p99_ms": round(float(
+                np.percentile(by, 99)) * 1e3, 3) if by else 0.0,
+            "ttft_heavy_p99_ms": round(float(
+                np.percentile(heavy, 99)) * 1e3, 3) if heavy else 0.0,
+            # decode-capable replicas only, per-replica registries:
+            # median-of-p50s / worst p99 across the decode tier
+            "decode_heartbeat_host_p50_ms": round(
+                _median(host_p50), 4) if host_p50 else 0.0,
+            "decode_heartbeat_host_p99_ms": round(
+                max(host_p99), 4) if host_p99 else 0.0,
+            "decode_isolation": round(1.0 - bp / bt, 4) if bt else 0.0,
+            "handoffs": _sched_counter("serving.disagg.handoffs"),
+            "reprefills": _sched_counter("serving.disagg.reprefills"),
+            "handoff_bytes": int(counters.get(
+                "serving.disagg.handoff_bytes", 0)),
+            "swap_out_p50_ms": _swap_ms("serving.swap.out_s", "p50"),
+            "swap_out_p99_ms": _swap_ms("serving.swap.out_s", "p99"),
+            "swap_in_p50_ms": _swap_ms("serving.swap.in_s", "p50"),
+            "swap_in_p99_ms": _swap_ms("serving.swap.in_s", "p99"),
+            "swap_verify_failed": int(counters.get(
+                "serving.swap.verify_failed", 0)),
+            "spills": int(counters.get("serving.router.spills", 0)),
+            "arena_bytes_after_drain": int(tier.bytes_used),
+            "compiled_programs": [e.compiled_programs for e in engines],
+        }
+    ref = [list(r.output_tokens) for r in results["colocated"]["reqs"]]
+    split = [list(r.output_tokens)
+             for r in results["disaggregated"]["reqs"]]
+    mism = sum(a != b for a, b in zip(split, ref))
+    col, dis = rows["colocated"], rows["disaggregated"]
+    summary = {
+        "metric": DISAGG_METRIC,
+        "value": dis["value"],
+        "unit": "tokens/s",
+        "replicas": n,
+        "decode_replicas": n - 1,
+        "colocated_tokens_per_s": col["value"],
+        "ttft_bystander_p50_ms": dis["ttft_bystander_p50_ms"],
+        "ttft_bystander_p50_ms_colocated":
+            col["ttft_bystander_p50_ms"],
+        "ttft_bystander_p99_ms": dis["ttft_bystander_p99_ms"],
+        "ttft_bystander_p99_ms_colocated":
+            col["ttft_bystander_p99_ms"],
+        "decode_heartbeat_host_p50_ms":
+            dis["decode_heartbeat_host_p50_ms"],
+        "decode_heartbeat_host_p50_ms_colocated":
+            col["decode_heartbeat_host_p50_ms"],
+        "decode_heartbeat_host_p99_ms":
+            dis["decode_heartbeat_host_p99_ms"],
+        "decode_heartbeat_host_p99_ms_colocated":
+            col["decode_heartbeat_host_p99_ms"],
+        "decode_isolation": dis["decode_isolation"],
+        "decode_isolation_colocated": col["decode_isolation"],
+        # the structural isolation claim: a decode replica's beat TAIL
+        # is heavy-prompt ingestion chunks in the colocated fleet and
+        # decode-only work in the split fleet (bystander single-chunk
+        # prefills ride the decode tier in BOTH, so the p50s match —
+        # the p99 is where the heavyweights were)
+        "decode_beat_tail_improved": dis["decode_heartbeat_host_p99_ms"]
+        < col["decode_heartbeat_host_p99_ms"],
+        "decode_host_p99_isolation_x": round(
+            col["decode_heartbeat_host_p99_ms"]
+            / dis["decode_heartbeat_host_p99_ms"], 3)
+        if dis["decode_heartbeat_host_p99_ms"] else 0.0,
+        "handoffs": dis["handoffs"],
+        "handoff_bytes": dis["handoff_bytes"],
+        "reprefills": dis["reprefills"],
+        "zero_reprefills_clean": dis["reprefills"] == 0,
+        "handoff_export_p50_ms": dis["swap_out_p50_ms"],
+        "handoff_export_p99_ms": dis["swap_out_p99_ms"],
+        "handoff_import_p50_ms": dis["swap_in_p50_ms"],
+        "handoff_import_p99_ms": dis["swap_in_p99_ms"],
+        "swap_verify_failed": dis["swap_verify_failed"],
+        "arena_bytes_after_drain": dis["arena_bytes_after_drain"],
+        "token_exact_vs_colocated": mism == 0,
+        "token_mismatched_requests": mism,
+        "windows": WINDOWS,
+        "requests_per_window": REQUESTS,
+        "compiled_programs": [e.compiled_programs for e in engines],
+        "model": SIZE,
+    }
+    if trace_path:
+        summary["trace_path"] = trace_path
+        summary["trace_spans"] = trace_spans
+    return rows, summary
+
+
+def main_disagg():
+    import jax
+
+    _load_env(smoke=dict(DISAGG_SMOKE))
+
+    rows, summary = disagg_stats()
+    for mode in ("colocated", "disaggregated"):
+        print(json.dumps(rows[mode]))
+    summary["backend"] = jax.default_backend()
+    print(json.dumps(summary))
+
+
 if __name__ == "__main__":
     from apex_tpu.telemetry import guard_bench_main
 
@@ -2369,6 +2691,8 @@ if __name__ == "__main__":
         guard_bench_main(main_async, ASYNC_METRIC)
     elif "--replica-router" in sys.argv[1:]:
         guard_bench_main(main_router, ROUTER_METRIC)
+    elif "--disaggregated" in sys.argv[1:]:
+        guard_bench_main(main_disagg, DISAGG_METRIC)
     elif "--host-tier" in sys.argv[1:]:
         guard_bench_main(main_host_tier, HOST_METRIC)
     else:

@@ -115,7 +115,8 @@ DOC = os.path.join(ROOT, "docs", "serving.md")
 # "serving.router.replica", which is exactly the namespacing contract
 # the docs must name.
 _PAT = re.compile(
-    r"serving\.(?:faults|watchdog|spec|tp|kv|wq|heartbeat|router|swap)"
+    r"serving\.(?:faults|watchdog|spec|tp|kv|wq|heartbeat|router|swap"
+    r"|disagg)"
     r"\.[a-z0-9_]+")
 
 
@@ -222,6 +223,17 @@ def test_scan_surface_is_alive():
         assert router_py in emitted.get(name, []), \
             f"{name} not emitted by the router — replica-routing " \
             "telemetry went dark"
+    # the disaggregated-serving family: each metric from the layer
+    # that owns it — export count + verified-miss re-prefills
+    # (scheduler), export bytes (engine), decode-beat isolation
+    # (router)
+    for name, owner in (("serving.disagg.handoffs", sched),
+                        ("serving.disagg.handoff_bytes", engine_py),
+                        ("serving.disagg.reprefills", sched),
+                        ("serving.disagg.decode_isolation", router_py)):
+        assert owner in emitted.get(name, []), \
+            f"{name} not emitted by {os.path.basename(owner)} — " \
+            "disaggregated-serving telemetry went dark"
     assert _documented(), "docs/serving.md names no fault/watchdog/" \
         "spec metrics — doc section missing?"
 
@@ -253,8 +265,9 @@ def test_every_documented_fault_metric_is_emitted():
 # checked by NAME so a rename breaks the lint loudly instead of
 # silently un-scoping it.
 _DISPATCH_REGION = {
-    "scheduler.py": ("_dispatch_decode", "_pipeline_last_tokens"),
-    "engine.py": ("_dispatch_swap_out",),
+    "scheduler.py": ("_dispatch_decode", "_pipeline_last_tokens",
+                     "_dispatch_prefill"),
+    "engine.py": ("_dispatch_swap_out", "prefill_chunk_dispatch"),
 }
 
 # Call shapes that force a device array to host. ``jnp.*`` stays legal
@@ -479,7 +492,10 @@ def test_span_scan_surface_is_alive():
     sched = os.path.join("apex_tpu", "serving", "scheduler.py")
     for name in ("submit", "queue_wait", "admit", "prefill_chunk",
                  "heartbeat", "draft", "verify", "quarantine",
-                 "finish", "expired", "failed"):
+                 "finish", "expired", "failed",
+                 # the disaggregated handoff pair: export at prompt-
+                 # ingestion completion, import resolution at admission
+                 "handoff_export", "handoff_import"):
         assert sched in emitted.get(name, []), \
             f"span {name!r} not emitted by the scheduler — request " \
             "lifecycle tracing went dark"
