@@ -123,6 +123,24 @@ generation requests from a fixed set of compiled programs:
   survivors with zero leaked pages — un-faulted requests stay bitwise.
   Zero compiled programs added; ``serving.router.*`` telemetry.
 
+- :class:`FleetController` (:mod:`.fleet` / :mod:`.fleet_worker`) —
+  the Router's fleet, OUT-OF-PROCESS: each replica is a separate OS
+  process (``python -m apex_tpu.serving.fleet_worker``) owning its
+  own JAX runtime, engine and telemetry registry, behind a
+  length-prefixed stdlib AF_UNIX transport. The controller reuses the
+  Router's exact decision core (:mod:`.routing_policy` — shared pure
+  functions, so in-process and process fleets route identically and
+  the parity pin is bitwise) over serialized probes and
+  :func:`snapshot_to_wire` load snapshots; requests and disagg arena
+  records cross as versioned wire forms (:func:`request_to_wire`,
+  :func:`record_to_wire` — handoffs travel BY VALUE and re-verify by
+  CRC on the importing arena). Health heartbeats with a missed-beat
+  death detector (the ``worker_hang`` fault kind), ROLLING restart
+  (drain → respawn → rejoin warm), and elastic
+  ``add_replica``/``remove_replica``/``set_role`` under live traffic.
+  ``serving.fleet.*`` telemetry; per-worker registries merge into one
+  fleet view.
+
 Quick start::
 
     from apex_tpu import serving
@@ -140,24 +158,31 @@ Exercised end-to-end by ``bench_serving.py`` and
 ``examples/lm/main_amp.py --generate``.
 """
 
-from . import sharding
+from . import routing_policy, sharding
 from .engine import Engine, PendingDecode, sample_tokens
 from .faults import (FaultPlan, FaultPolicy, FaultSpec, InjectedFault,
                      PoolAuditor, PoolInvariantError, fault_kind)
-from .host_tier import HostTier, SwapWorker
+from .fleet import FleetController, WorkerDied
+from .host_tier import (HostTier, SwapWorker, record_from_wire,
+                        record_to_wire)
 from .kv_cache import KVCache, PagedKVCache, PagePool
 from .kv_quant import KVQuantConfig
 from .prefix_cache import PrefixCache, PrefixMatch
 from .router import Router
-from .scheduler import QueueFull, Request, RequestStatus, Scheduler
+from .scheduler import (QueueFull, Request, RequestStatus, Scheduler,
+                        request_from_wire, request_to_wire,
+                        snapshot_from_wire, snapshot_to_wire)
 from .speculative import DraftWorker, SpecConfig, draft_tokens
 from .weight_quant import WeightQuantConfig
 
 __all__ = ["DraftWorker", "Engine", "FaultPlan", "FaultPolicy",
-           "FaultSpec", "HostTier", "InjectedFault", "KVCache",
-           "KVQuantConfig", "PagedKVCache", "PagePool", "PendingDecode",
-           "PoolAuditor", "PoolInvariantError", "PrefixCache",
-           "PrefixMatch", "QueueFull", "Request", "RequestStatus",
-           "Router", "Scheduler", "SpecConfig", "SwapWorker",
-           "WeightQuantConfig", "draft_tokens", "fault_kind",
-           "sample_tokens", "sharding"]
+           "FaultSpec", "FleetController", "HostTier", "InjectedFault",
+           "KVCache", "KVQuantConfig", "PagedKVCache", "PagePool",
+           "PendingDecode", "PoolAuditor", "PoolInvariantError",
+           "PrefixCache", "PrefixMatch", "QueueFull", "Request",
+           "RequestStatus", "Router", "Scheduler", "SpecConfig",
+           "SwapWorker", "WeightQuantConfig", "WorkerDied",
+           "draft_tokens", "fault_kind", "record_from_wire",
+           "record_to_wire", "request_from_wire", "request_to_wire",
+           "routing_policy", "sample_tokens", "sharding",
+           "snapshot_from_wire", "snapshot_to_wire"]

@@ -152,6 +152,13 @@ class FaultSpec:
       CRC fails and the request re-prefills on the decode replica
       (``serving.disagg.reprefills``) — never a wrong token, with zero
       retries charged to the request.
+    - ``"worker_hang"`` — the PROCESS-fleet fault: worker ``replica``
+      stops answering its transport at controller tick ``tick``
+      (alive but unresponsive — the failure mode a hard kill can't
+      exercise). Consumed by :meth:`FaultPlan.take_worker_hangs` from
+      the :class:`~apex_tpu.serving.FleetController`'s step loop; the
+      heartbeat's missed-beat detector must declare the worker dead
+      and re-route its requests, exactly as if the process had died.
     """
 
     kind: str
@@ -165,7 +172,7 @@ class FaultSpec:
     def __post_init__(self):
         if self.kind not in ("nonfinite", "exception", "stall",
                              "replica_death", "swap_corruption",
-                             "handoff_corruption"):
+                             "handoff_corruption", "worker_hang"):
             raise ValueError(f"unknown fault kind {self.kind!r}")
         if self.kind == "nonfinite" and self.slot < 0:
             raise ValueError("nonfinite faults need a victim slot")
@@ -176,6 +183,9 @@ class FaultSpec:
             raise ValueError("stall faults need stall_s > 0")
         if self.kind == "replica_death" and self.replica < 0:
             raise ValueError("replica_death faults need a victim "
+                             "replica index")
+        if self.kind == "worker_hang" and self.replica < 0:
+            raise ValueError("worker_hang faults need a victim "
                              "replica index")
 
 
@@ -195,6 +205,7 @@ class FaultPlan:
         self._deaths: Dict[int, List[FaultSpec]] = {}
         self._swap_corruptions: Dict[int, FaultSpec] = {}
         self._handoff_corruptions: Dict[int, FaultSpec] = {}
+        self._hangs: Dict[int, List[FaultSpec]] = {}
         for s in self.specs:
             if s.kind == "nonfinite":
                 self._nonfinite.setdefault(int(s.tick), []).append(s)
@@ -202,6 +213,8 @@ class FaultPlan:
                 self._exceptions[(s.site, int(s.tick))] = s
             elif s.kind == "replica_death":
                 self._deaths.setdefault(int(s.tick), []).append(s)
+            elif s.kind == "worker_hang":
+                self._hangs.setdefault(int(s.tick), []).append(s)
             elif s.kind == "swap_corruption":
                 self._swap_corruptions[int(s.tick)] = s
             elif s.kind == "handoff_corruption":
@@ -215,6 +228,7 @@ class FaultPlan:
         self.injected_replica_deaths = 0
         self.injected_swap_corruptions = 0
         self.injected_handoff_corruptions = 0
+        self.injected_worker_hangs = 0
 
     @classmethod
     def random(cls, seed: int, ticks: int, *, slots: int,
@@ -224,7 +238,8 @@ class FaultPlan:
                replica_death_rate: float = 0.0,
                replicas: int = 0,
                swap_corruption_rate: float = 0.0,
-               handoff_corruption_rate: float = 0.0) -> "FaultPlan":
+               handoff_corruption_rate: float = 0.0,
+               worker_hang_rate: float = 0.0) -> "FaultPlan":
         """A seeded random schedule over ``ticks`` heartbeats: each
         tick independently draws a non-finite injection (uniform victim
         slot), a transient exception (site uniform over ``sites``),
@@ -242,13 +257,20 @@ class FaultPlan:
         bit-for-bit. ``handoff_corruption_rate`` > 0 (disaggregated
         fleets only) draws a handoff-record corruption per tick — the
         draw is again skipped entirely at the default 0, preserving
-        every pre-disaggregation seed."""
+        every pre-disaggregation seed. ``worker_hang_rate`` > 0
+        (process-fleet plans only; requires ``replicas`` >= 1) draws a
+        worker hang with a uniform victim — drawn LAST in the per-tick
+        order and skipped entirely at the default 0, so every
+        pre-fleet seed replays bit-for-bit."""
         for s in sites:
             if s not in _EXCEPTION_SITES:
                 raise ValueError(f"exception site {s!r} not in "
                                  f"{_EXCEPTION_SITES}")
         if replica_death_rate > 0 and replicas < 1:
             raise ValueError("replica_death_rate > 0 needs replicas "
+                             ">= 1 to draw victims from")
+        if worker_hang_rate > 0 and replicas < 1:
+            raise ValueError("worker_hang_rate > 0 needs replicas "
                              ">= 1 to draw victims from")
         rng = np.random.default_rng(seed)
         specs: List[FaultSpec] = []
@@ -276,6 +298,11 @@ class FaultPlan:
                     and rng.random() < handoff_corruption_rate:
                 specs.append(FaultSpec(kind="handoff_corruption",
                                        tick=t))
+            if worker_hang_rate > 0 \
+                    and rng.random() < worker_hang_rate:
+                specs.append(FaultSpec(
+                    kind="worker_hang", tick=t,
+                    replica=int(rng.integers(0, replicas))))
         return cls(specs)
 
     # ------------------------------------------------------------ injection
@@ -343,6 +370,19 @@ class FaultPlan:
         if not specs:
             return []
         self.injected_replica_deaths += len(specs)
+        return [s.replica for s in specs]
+
+    def take_worker_hangs(self, tick: int) -> List[int]:
+        """CONSUME the worker hangs scheduled for this CONTROLLER
+        tick, returning the victim replica indices (empty on
+        hang-free ticks). Called by the
+        :class:`~apex_tpu.serving.FleetController` once per step — a
+        hung worker stays alive but stops answering its transport, so
+        only the missed-beat heartbeat detector can catch it."""
+        specs = self._hangs.pop(int(tick), None)
+        if not specs:
+            return []
+        self.injected_worker_hangs += len(specs)
         return [s.replica for s in specs]
 
     def maybe_corrupt_swap(self, tick: int, tier) -> bool:
@@ -430,6 +470,7 @@ class FaultPlan:
             "injected_swap_corruptions": self.injected_swap_corruptions,
             "injected_handoff_corruptions":
                 self.injected_handoff_corruptions,
+            "injected_worker_hangs": self.injected_worker_hangs,
         }
 
 

@@ -74,7 +74,8 @@ import numpy as np
 
 from apex_tpu.log_util import get_logger
 
-__all__ = ["HostTier", "HostTierRecord", "SwapWorker"]
+__all__ = ["HostTier", "HostTierRecord", "SwapWorker",
+           "record_from_wire", "record_to_wire"]
 
 _logger = get_logger("serving")
 
@@ -139,6 +140,65 @@ class HostTierRecord:
     # computing the CRCs, so the next take fails verification exactly
     # as a post-completion corruption would
     corrupt_on_complete: bool = False
+
+
+# --------------------------------------------------------------- wire forms
+#
+# The disaggregated handoff's arena record, addressable ACROSS
+# processes: a prefill-role fleet worker exports the finished prefix's
+# record as a versioned dict (raw bytes + dtype/shape + the swap-out
+# CRCs), the controller ships it over the fleet transport, and the
+# decode-role worker imports it into its OWN arena. The CRCs travel
+# with the bytes and are re-verified by the importing side's ordinary
+# :meth:`HostTier.take` at swap-in — so corruption anywhere along the
+# journey degrades to the same VERIFIED MISS a local corruption would,
+# never a wrong token. Versioned like the scheduler wire forms: a
+# mismatched build fails loudly, never deserializes garbage.
+
+RECORD_WIRE_VERSION = 1
+
+
+def record_to_wire(key: int, record: HostTierRecord) -> dict:
+    """``record`` (resident — a pending record has no bytes to ship)
+    as its versioned dict wire form under arena key ``key``."""
+    if record.pending or record.k is None or record.v is None:
+        raise ValueError(
+            f"arena record {key} is still pending — an in-flight "
+            "swap-out has no bytes to put on the wire")
+    return {
+        "v": RECORD_WIRE_VERSION,
+        "key": int(key),
+        "nbytes": int(record.nbytes),
+        "crc": [int(c) for c in record.crc],
+        "shards": int(record.shards),
+        "k_bytes": record.k.tobytes(),
+        "k_dtype": str(record.k.dtype),
+        "k_shape": [int(d) for d in record.k.shape],
+        "v_bytes": record.v.tobytes(),
+        "v_dtype": str(record.v.dtype),
+        "v_shape": [int(d) for d in record.v.shape],
+    }
+
+
+def record_from_wire(wire: dict) -> Tuple[int, HostTierRecord]:
+    """``(key, record)`` from a record wire form — the arrays rebuilt
+    as owned, writable host copies (the arena must own mutable bytes).
+    Loud ``ValueError`` on an unknown version, ``KeyError`` on a
+    missing field."""
+    v = wire.get("v")
+    if v != RECORD_WIRE_VERSION:
+        raise ValueError(
+            f"unknown arena-record wire version {v!r} (this build "
+            f"speaks {RECORD_WIRE_VERSION}) — controller and workers "
+            "must run the same tree")
+    k = np.frombuffer(wire["k_bytes"], dtype=wire["k_dtype"]) \
+        .reshape(wire["k_shape"]).copy()
+    vv = np.frombuffer(wire["v_bytes"], dtype=wire["v_dtype"]) \
+        .reshape(wire["v_shape"]).copy()
+    return int(wire["key"]), HostTierRecord(
+        k=k, v=vv, nbytes=int(wire["nbytes"]),
+        crc=tuple(int(c) for c in wire["crc"]),
+        shards=int(wire["shards"]))
 
 
 class HostTier:
@@ -371,6 +431,55 @@ class HostTier:
                                 "checksum — degrading to a verified "
                                 "miss", key)
             return rec
+
+    def export_record(self, key: int) -> Optional[dict]:
+        """POP ``key``'s resident record and return its wire form —
+        the cross-process half of a disaggregated handoff (ownership
+        transfers to the wire: the exporting arena releases the bytes
+        NOW, the importing arena adopts them). None when the key is
+        absent (evicted since the handoff was collected) or still
+        pending (bytes in flight) — both degrade to the key-less
+        handoff, i.e. a decode-side re-prefill, per the verified-miss
+        contract. No checksum walk here: the swap-out CRCs travel and
+        the importer's :meth:`take` re-verifies at swap-in."""
+        with self._lock:
+            rec = self._entries.get(int(key))
+            if rec is None or rec.pending:
+                return None
+            wire = record_to_wire(int(key), rec)
+            del self._entries[int(key)]
+            self._bytes_used -= rec.nbytes
+            return wire
+
+    def import_record(self, wire: dict) -> Optional[int]:
+        """Adopt a wire-form record into THIS arena under its
+        original key (handoff keys are request uids — positive, so
+        they can never collide with a local engine's negative
+        synthetic prefix keys). Same admission rules as a local put:
+        an over-capacity record is declined (returns None — the
+        caller degrades to a key-less handoff), otherwise LRU
+        eviction makes room and the key is returned. Counted as a
+        ``put`` — the record enters the arena exactly as a completed
+        swap-out would."""
+        key, rec = record_from_wire(wire)
+        with self._lock:
+            if rec.nbytes > self.capacity_bytes:
+                self.declined += 1
+                _logger.debug(
+                    "host tier declined imported %d-byte record %d "
+                    "(capacity %d)", rec.nbytes, key,
+                    self.capacity_bytes)
+                return None
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes_used -= old.nbytes
+            while self._bytes_used + rec.nbytes > self.capacity_bytes:
+                self._evict_lru()
+            rec.last_used = next(self._clock)
+            self._entries[key] = rec
+            self._bytes_used += rec.nbytes
+            self.puts += 1
+            return key
 
     def add_on_evict(self, fn: Callable[[int], None]) -> None:
         """Register an ADDITIONAL eviction listener (shared-arena

@@ -119,18 +119,20 @@ import numpy as np
 
 from apex_tpu.log_util import get_logger
 
+from .routing_policy import (ROUTE_POLICIES, fleet_retry_hint,
+                             note_placement, random_order,
+                             rank_replicas)
 from .scheduler import QueueFull, Request, Scheduler
 
 __all__ = ["Router"]
 
 _logger = get_logger("serving")
 
-_ROUTE_POLICIES = ("affinity", "least_loaded", "random")
-
-# Router.placements entries kept (insertion order; re-placement
-# refreshes). Far above any live-request census — the cap only sheds
-# long-finished uids.
-_PLACEMENTS_CAP = 65536
+# The decision core lives in routing_policy (shared with the
+# process-level FleetController — both fronts provably rank, spill
+# and hint through the SAME functions); these aliases keep the
+# router's historical names importable.
+_ROUTE_POLICIES = ROUTE_POLICIES
 
 
 class Router:
@@ -352,8 +354,8 @@ class Router:
         least-loaded by load alone; random by a seeded shuffle."""
         alive = self._capable_indices(capability)
         if self.route_policy == "random":
-            order = [int(i) for i in self._rng.permutation(alive)]
-            return None, order, {i: 0 for i in alive}
+            return None, random_order(alive, self._rng), \
+                {i: 0 for i in alive}
         keys = None
         lens = {i: 0 for i in alive}
         if self.affinity_enabled:
@@ -371,18 +373,7 @@ class Router:
                         self.replicas[i].engine.prefix_cache.probe(
                             request.prompt, keys=keys)
         snaps = {i: self.replicas[i].load_snapshot() for i in alive}
-        order = sorted(alive, key=lambda i: (
-            -lens[i],
-            -snaps[i]["slots_free"],
-            snaps[i]["queue_depth"],
-            -(snaps[i]["pages_free"] or 0),
-            # hierarchical-KV tie-break: of two replicas equal on
-            # slots/queue/pages, prefer the one with more host-arena
-            # headroom — landing work on a replica whose swap arena is
-            # nearly full accelerates its swapped-prefix shedding
-            -(snaps[i]["host_bytes_free"] or 0),
-            i))
-        return keys, order, lens
+        return keys, rank_replicas(alive, lens, snaps), lens
 
     def submit(self, request: Request) -> Request:
         """Route ``request`` to the best live replica (see module
@@ -406,12 +397,7 @@ class Router:
             except QueueFull as e:
                 hints.append(e.retry_after_s)
                 continue
-            # pop-then-set refreshes insertion order, so the cap
-            # always sheds the LONGEST-finished uid first
-            self.placements.pop(request.uid, None)
-            self.placements[request.uid] = i
-            while len(self.placements) > _PLACEMENTS_CAP:
-                self.placements.pop(next(iter(self.placements)))
+            note_placement(self.placements, request.uid, i)
             if self.registry is not None:
                 self.registry.counter_inc("serving.router.routed")
                 if lens[i] > 0:
@@ -430,7 +416,7 @@ class Router:
                                   affinity_len=lens[i],
                                   spills=n_spilled)
             return request
-        hint = max((h for h in hints if h is not None), default=None)
+        hint = fleet_retry_hint(hints)
         if self.registry is not None:
             # ONE caller-visible rejection (the per-replica probes
             # above were suppressed — spills are not rejections)
@@ -536,10 +522,7 @@ class Router:
                     # unreachable for an aligned >=1-block prefix;
                     # never strand arena bytes on a defensive edge
                     self._tier.discard(key)
-            self.placements.pop(r.uid, None)
-            self.placements[r.uid] = i
-            while len(self.placements) > _PLACEMENTS_CAP:
-                self.placements.pop(next(iter(self.placements)))
+            note_placement(self.placements, r.uid, i)
             if self.registry is not None and n_spilled:
                 self.registry.counter_inc("serving.router.spills",
                                           n_spilled)

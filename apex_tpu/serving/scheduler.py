@@ -174,7 +174,9 @@ from apex_tpu.log_util import get_logger
 from .faults import FaultPolicy, PoolAuditor, fault_kind
 from .speculative import DraftWorker, draft_tokens
 
-__all__ = ["Request", "RequestStatus", "QueueFull", "Scheduler"]
+__all__ = ["Request", "RequestStatus", "QueueFull", "Scheduler",
+           "request_from_wire", "request_to_wire",
+           "snapshot_from_wire", "snapshot_to_wire"]
 
 _logger = get_logger("serving")
 
@@ -283,6 +285,115 @@ class Request:
     _prefill_pos: int = dataclasses.field(default=0, repr=False)
     _not_before: Optional[float] = dataclasses.field(default=None,
                                                      repr=False)
+
+
+# --------------------------------------------------------------- wire forms
+#
+# The process-level fleet ships requests and load snapshots between a
+# controller and its worker processes as VERSIONED plain dicts —
+# explicit serialize/deserialize pairs, not implicit pickling of live
+# objects, so the wire contract is inspectable, testable without a
+# socket, and LOUD when a version mismatch crosses the boundary (a
+# controller and worker built from different trees must fail with a
+# ValueError, never deserialize garbage silently). The private
+# ``Request`` clock fields (``_t_submit`` etc.) deliberately do NOT
+# cross: ``time.perf_counter`` bases are per-process, so a shipped
+# clock would be meaningless on arrival — each side stamps its own.
+
+REQUEST_WIRE_VERSION = 1
+SNAPSHOT_WIRE_VERSION = 1
+
+#: The load-snapshot key set — part of the versioned wire contract
+#: (routing_policy ranks on these fields, so both fronts must see the
+#: same ones; bump SNAPSHOT_WIRE_VERSION when this tuple changes).
+_SNAPSHOT_KEYS = ("queue_depth", "queue_free", "slots", "slots_busy",
+                  "slots_free", "inflight_steps", "pages_free",
+                  "host_bytes_free")
+
+
+def request_to_wire(request: Request) -> dict:
+    """``request`` as its versioned dict wire form: every public
+    field, plain Python scalars only (token ids coerced through
+    ``int`` so numpy scalars never leak into a frame). The private
+    per-process clocks stay home (see the wire-forms note above)."""
+    return {
+        "v": REQUEST_WIRE_VERSION,
+        "prompt": [int(t) for t in request.prompt],
+        "max_new_tokens": int(request.max_new_tokens),
+        "temperature": float(request.temperature),
+        "timeout_s": request.timeout_s,
+        "uid": int(request.uid),
+        "output_tokens": [int(t) for t in request.output_tokens],
+        "status": request.status.value,
+        "finish_reason": request.finish_reason,
+        "ttft_s": request.ttft_s,
+        "queue_wait_s": request.queue_wait_s,
+        "prefill_s": float(request.prefill_s),
+        "chunks": int(request.chunks),
+        "reused_tokens": int(request.reused_tokens),
+        "spec_drafted": int(request.spec_drafted),
+        "spec_accepted": int(request.spec_accepted),
+        "latency_s": request.latency_s,
+        "retries": int(request.retries),
+        "error": request.error,
+    }
+
+
+def request_from_wire(wire: dict) -> Request:
+    """The :class:`Request` a wire dict describes. Raises
+    ``ValueError`` on an unknown wire version (the loud cross-build
+    guard) and ``KeyError`` on a missing field — a truncated frame
+    must never deserialize into a plausible half-request."""
+    v = wire.get("v")
+    if v != REQUEST_WIRE_VERSION:
+        raise ValueError(
+            f"unknown Request wire version {v!r} (this build speaks "
+            f"{REQUEST_WIRE_VERSION}) — controller and workers must "
+            "run the same tree")
+    return Request(
+        prompt=list(wire["prompt"]),
+        max_new_tokens=wire["max_new_tokens"],
+        temperature=wire["temperature"],
+        timeout_s=wire["timeout_s"],
+        uid=wire["uid"],
+        output_tokens=list(wire["output_tokens"]),
+        status=RequestStatus(wire["status"]),
+        finish_reason=wire["finish_reason"],
+        ttft_s=wire["ttft_s"],
+        queue_wait_s=wire["queue_wait_s"],
+        prefill_s=wire["prefill_s"],
+        chunks=wire["chunks"],
+        reused_tokens=wire["reused_tokens"],
+        spec_drafted=wire["spec_drafted"],
+        spec_accepted=wire["spec_accepted"],
+        latency_s=wire["latency_s"],
+        retries=wire["retries"],
+        error=wire["error"],
+    )
+
+
+def snapshot_to_wire(snapshot: dict) -> dict:
+    """A :meth:`Scheduler.load_snapshot` dict as its versioned wire
+    form (the fixed key set, loud on a missing key)."""
+    out = {"v": SNAPSHOT_WIRE_VERSION}
+    for k in _SNAPSHOT_KEYS:
+        out[k] = snapshot[k]
+    return out
+
+
+def snapshot_from_wire(wire: dict) -> dict:
+    """The plain load-snapshot dict a wire form describes — exactly
+    the shape :meth:`Scheduler.load_snapshot` returns, so
+    ``routing_policy.rank_replicas`` consumes local and remote
+    snapshots interchangeably. Loud ``ValueError`` on an unknown
+    version, ``KeyError`` on a missing load key."""
+    v = wire.get("v")
+    if v != SNAPSHOT_WIRE_VERSION:
+        raise ValueError(
+            f"unknown load-snapshot wire version {v!r} (this build "
+            f"speaks {SNAPSHOT_WIRE_VERSION}) — controller and "
+            "workers must run the same tree")
+    return {k: wire[k] for k in _SNAPSHOT_KEYS}
 
 
 @dataclasses.dataclass

@@ -78,7 +78,15 @@ arena, colocated vs ``Router(roles=[...])`` with CRC'd KV handoff
 (bystander TTFT p50/p99 both modes, the decode-replica
 heartbeat-tail isolation, handoff traffic + export/import p50/p99,
 zero re-prefills, zero leaked arena bytes, bitwise exactness) — via
-``bench_serving.disagg_stats``.
+``bench_serving.disagg_stats``, and a nested ``process_fleet``
+sub-object (BENCH_SERVING_FLEET=0 to drop it;
+BENCH_SERVING_REPLICAS sizes the fleet): the out-of-process worker
+fleet — 1 worker vs N separate OS processes behind the stdlib
+transport (aggregate tokens/s + ``scaling_x``, an honest CPU-box
+scaling column since workers share no GIL, p99 TTFT, prefix hit
+rate, rolling-restart wall time + per-worker p50/max, health
+counters, bitwise exactness vs the 1-worker fleet) — via
+``bench_serving.process_fleet_stats``.
 Failure-isolated at every layer: a broken serving stack puts
 {"error": ...} there, never kills the ResNet row.
 """
@@ -250,6 +258,18 @@ _SERVING_DISAGG_SMOKE = {
     "NEW_TOKENS": 8, "WINDOWS": 1, "PREFIX_POOL": 4,
 }
 
+# The process-fleet sub-leg's smoke geometry (the session stream is
+# served through TWO fleets — 1 worker, then N — and every worker
+# spawn pays interpreter + jax import + compile, so it is sized
+# small; the stream matches the router sub-leg's so the thread-vs-
+# process rows are comparable). BENCH_SERVING_REPLICAS et al. still
+# win, env-beats-smoke.
+_SERVING_FLEET_SMOKE = {
+    "SIZE": "tiny", "VOCAB": 512, "SLOTS": 2, "MAX_LEN": 128,
+    "PREFILL_LEN": 48, "CHUNK_LEN": 8, "REQUESTS": 4, "NEW_TOKENS": 8,
+    "WINDOWS": 1, "PREFIX_POOL": 4,
+}
+
 
 def _serving_leg() -> dict:
     """The serving trajectory row (ROADMAP: bench_serving.py had no
@@ -279,6 +299,7 @@ def _serving_leg() -> dict:
         out["async_heartbeat"] = _serving_async_leg()
         out["replica_router"] = _serving_router_leg()
         out["disaggregated"] = _serving_disagg_leg()
+        out["process_fleet"] = _serving_process_fleet_leg()
         out["host_tier"] = _serving_host_tier_leg()
         return out
     except KeyboardInterrupt:
@@ -554,6 +575,38 @@ def _serving_disagg_leg() -> dict:
             "handoff_import_p50_ms", "handoff_import_p99_ms",
             "arena_bytes_after_drain", "token_mismatched_requests",
             "model")}
+    except KeyboardInterrupt:
+        raise
+    except BaseException as e:  # noqa: BLE001 — the row must not die here
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def _serving_process_fleet_leg() -> dict:
+    """The out-of-process fleet trajectory sub-row: smoke-sized
+    process-fleet summary (1 worker vs BENCH_SERVING_REPLICAS
+    separate OS processes behind the stdlib transport — aggregate
+    tokens/s + scaling_x, the serving bench's one CPU-honest scaling
+    column, p99 TTFT, prefix hit rate, rolling-restart timing, health
+    counters, bitwise exactness) from
+    ``bench_serving.process_fleet_stats``. BENCH_SERVING_FLEET=0
+    drops it; failure-isolated like its siblings — a broken fleet
+    (or a box that cannot spawn workers) yields {"error": ...} here,
+    never a lost serving (or ResNet) row."""
+    if _env_int("BENCH_SERVING_FLEET", "1") == 0:
+        return {"skipped": True}
+    try:
+        import bench_serving
+
+        bench_serving._load_env(smoke=dict(_SERVING_FLEET_SMOKE))
+        _, summary = bench_serving.process_fleet_stats()
+        return {k: summary[k] for k in (
+            "value", "unit", "workers", "baseline_tokens_per_s",
+            "scaling_x", "scaling_honest_on_cpu", "ttft_p99_ms",
+            "ttft_p99_ms_one_worker", "prefix_hit_rate",
+            "reused_tokens_per_request", "affinity_hits", "spills",
+            "worker_deaths", "hangs_detected", "restarts",
+            "restart_wall_s", "restart_p50_s", "restart_max_s",
+            "token_mismatched_requests", "model")}
     except KeyboardInterrupt:
         raise
     except BaseException as e:  # noqa: BLE001 — the row must not die here

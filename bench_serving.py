@@ -300,6 +300,38 @@ is the silicon claim. Defaults to a smoke geometry; env knobs resize
 it (env-beats-smoke), and ``BENCH_SERVING_TRACE`` attaches request
 tracing to the split leg (handoff export/import spans included).
 
+``--process-fleet`` runs the out-of-process fleet leg: the SAME
+multi-turn session-wave stream as ``--replica-router``, served through
+``serving.FleetController`` twice — a ONE-worker fleet (the baseline:
+transport cost included, so the scaling ratio is fleet-vs-fleet, not
+fleet-vs-thread) and a ``BENCH_SERVING_REPLICAS``-worker fleet with
+prefix-affinity routing, every worker a separate OS process
+(``python -m apex_tpu.serving.fleet_worker``) owning its own
+interpreter, JAX runtime and engine built deterministically from a
+shipped spec. One row per mode plus a final line whose payoff fields
+are aggregate tokens/s 1 vs N and ``scaling_x`` — on this CPU box an
+HONEST column for the first time in the serving bench (the thread
+fleets above share one GIL and one runtime; these workers do not, so
+"add a worker" is allowed to mean "go faster" here), p99 TTFT both,
+prefix hit rate + reused tokens (``prefix_stats`` RPC deltas over the
+measured windows), the fleet health counters (``worker_deaths`` and
+``hangs_detected``, both expected 0 outside chaos), the
+rolling-restart columns (total wall time plus per-worker
+``serving.fleet.restart_s`` p50/max for a drain → close → respawn →
+rejoin pass over the live fleet, with a post-restart wave set proving
+the respawned workers serve), and ``token_mismatched_requests`` vs
+the 1-worker run — expected 0 **bitwise** (identically-spec'd
+workers: the process boundary changes WHERE a request decodes, never
+what). The fleet spawns ONCE per mode — a worker spawn pays
+interpreter + jax import + compile, so windows after the warmup serve
+warm; greedy outputs are reuse-invariant by the verified-prefix
+contract, so warm serving moves no token. Transport overhead note:
+every routed request pays an N-probe fan-out and every token batch a
+step RPC (microseconds each on AF_UNIX); ``worker<i>/...``-namespaced
+histograms in the merged snapshot carry the per-process view.
+Defaults to the router leg's smoke geometry; env knobs resize it
+(env-beats-smoke).
+
 Wrapped in ``guard_bench_main`` — EVERY outcome (backend init failure,
 OOM, bad env) still ends in a parseable JSON line.
 """
@@ -327,6 +359,7 @@ ASYNC_METRIC = "serving_async_heartbeat_tokens_per_sec"
 ROUTER_METRIC = "serving_replica_router_tokens_per_sec"
 HOST_METRIC = "serving_host_tier_tokens_per_sec"
 DISAGG_METRIC = "serving_disagg_tokens_per_sec"
+FLEET_METRIC = "serving_process_fleet_tokens_per_sec"
 
 # Literal defaults at import time; the BENCH_SERVING_* env overrides are
 # parsed by _load_env() INSIDE each guarded main, so a malformed value
@@ -427,6 +460,15 @@ DISAGG_SMOKE = {"SIZE": "tiny", "VOCAB": 512, "SLOTS": 2,
                 "MAX_LEN": 128, "PREFILL_LEN": 48, "CHUNK_LEN": 8,
                 "SHORT_LEN": 6, "REQUESTS": 9, "NEW_TOKENS": 10,
                 "WINDOWS": 1, "PREFIX_POOL": 4}
+# --process-fleet leg: the router leg's session-wave geometry over
+# OUT-OF-PROCESS workers (each spawn pays interpreter + jax import +
+# compile, and the leg serves two fleets — 1 worker then REPLICAS —
+# so it is sized small; the stream itself matches ROUTER_SMOKE so the
+# two legs' rows are comparable)
+FLEET_SMOKE = {"SIZE": "tiny", "VOCAB": 512, "SLOTS": 2,
+               "MAX_LEN": 128, "PREFILL_LEN": 48, "CHUNK_LEN": 8,
+               "REQUESTS": 6, "NEW_TOKENS": 8, "WINDOWS": 1,
+               "PREFIX_POOL": 4}
 # --host-tier leg: distinct shared-prefix templates the stream cycles
 # through (the pool is sized for ~half of them, so revisits land on
 # evicted — with the tier, SWAPPED — prefixes), the host arena bound
@@ -2396,6 +2438,185 @@ def main_router():
     print(json.dumps(summary))
 
 
+def _fleet_spec():
+    """One worker's plain-dict engine spec — the only engine
+    description that can cross a process boundary
+    (``fleet_worker.build_engine_from_spec`` rebuilds it
+    deterministically inside each worker, so every worker holds
+    bitwise-identical weights)."""
+    engine = {"slots": SLOTS, "max_len": MAX_LEN,
+              "prefill_len": PREFILL_LEN, "prefix_pool": PREFIX_POOL,
+              "top_k": TOP_K}
+    if CHUNK_LEN:
+        engine["chunk_len"] = CHUNK_LEN
+    return {"model": {"preset": SIZE, "vocab_size": VOCAB,
+                      "max_seq_len": MAX_LEN},
+            "init_seed": 0,
+            "engine": engine}
+
+
+def _serve_fleet(n, seed):
+    """WINDOWS measured windows (plus a spawn/compile warmup window)
+    of the session-wave stream through one ``FleetController`` of
+    ``n`` worker PROCESSES, then (fleets of 2+) a rolling restart
+    with a post-restart wave set. The fleet spawns ONCE — a worker
+    spawn pays interpreter + jax import + compile, far too much per
+    window — so post-warmup windows serve warm caches; that moves no
+    token (greedy outputs are reuse-invariant by the verified-prefix
+    contract) and the per-window hit accounting stays a
+    ``prefix_stats`` delta, immune to the warmth."""
+    from apex_tpu import serving, telemetry
+
+    reg = telemetry.MetricsRegistry()
+    rng = np.random.default_rng(seed)
+    fc = serving.FleetController(
+        [_fleet_spec() for _ in range(n)], registry=reg,
+        route_policy="affinity", seed=seed,
+        max_queue=max(REQUESTS, 1), chunk_budget=CHUNK_BUDGET,
+        retain_prefixes=True)
+    rates, all_reqs, ttfts = [], [], []
+    hits = misses = reused = 0
+    restart_wall_s = None
+    try:
+        for w in range(WINDOWS + 1):
+            waves = _router_waves(rng)
+            base = [fc.prefix_stats(i) for i in range(n)]
+            t0 = time.perf_counter()
+            for wave in waves:
+                fc.run(wave)
+            dt = time.perf_counter() - t0
+            reqs = [r for wave in waves for r in wave]
+            assert all(r.status == "finished" for r in reqs)
+            if w > 0:
+                rates.append(
+                    sum(len(r.output_tokens) for r in reqs) / dt)
+                for i, b in enumerate(base):
+                    s = fc.prefix_stats(i)
+                    hits += s["hits"] - b["hits"]
+                    misses += s["misses"] - b["misses"]
+                    reused += s["tokens_reused"] - b["tokens_reused"]
+                all_reqs.extend(reqs)
+                ttfts.extend(r.ttft_s for r in reqs
+                             if r.ttft_s is not None)
+        if n > 1:
+            # drain -> close -> respawn -> rejoin, one live worker at
+            # a time; the post-restart wave set proves the respawned
+            # workers serve (and re-warm as re-routed traffic lands)
+            t0 = time.perf_counter()
+            fc.rolling_restart()
+            restart_wall_s = time.perf_counter() - t0
+            for wave in _router_waves(rng):
+                fc.run(wave)
+                assert all(r.status == "finished" for r in wave)
+        snap = fc.metrics_snapshot()
+    finally:
+        fc.close()
+    consulted = hits + misses
+    return {
+        "rate": _median(rates),
+        "hit_rate": hits / consulted if consulted else 0.0,
+        "reused_per_request": reused / len(all_reqs) if all_reqs
+        else 0.0,
+        "ttft_p99_ms": float(np.percentile(ttfts, 99) * 1e3)
+        if ttfts else 0.0,
+        "reqs": all_reqs,
+        "restart_wall_s": restart_wall_s,
+        "snap": snap,
+    }
+
+
+def process_fleet_stats():
+    """The --process-fleet measurement, reusable by bench.py's serving
+    leg: the SAME seeded session-wave stream served through a
+    1-worker process fleet (the baseline — transport cost included,
+    so ``scaling_x`` is fleet-vs-fleet) and a REPLICAS-worker fleet
+    with affinity routing. Headline fields: aggregate tokens/s 1 vs N
+    and ``scaling_x`` (an honest CPU-box column — no shared GIL or
+    runtime across workers), p99 TTFT both, prefix hit rate, the
+    rolling-restart columns, the health counters (expected 0 outside
+    chaos), and ``token_mismatched_requests`` vs the 1-worker run
+    (expected 0, bitwise)."""
+    n = max(1, REPLICAS)
+    rows, results = {}, {}
+    for mode, k in (("one_worker", 1), ("fleet", n)):
+        res = _serve_fleet(k, seed=17)
+        results[mode] = res
+        counters = res["snap"]["counters"]
+        rows[mode] = {
+            "metric": f"{FLEET_METRIC}.{mode}",
+            "value": round(res["rate"], 2),
+            "unit": "tokens/s",
+            "workers": k,
+            "route_policy": "affinity",
+            "prefix_hit_rate": round(res["hit_rate"], 4),
+            "reused_tokens_per_request": round(
+                res["reused_per_request"], 2),
+            "ttft_p99_ms": round(res["ttft_p99_ms"], 3),
+            "routed": int(counters.get("serving.fleet.routed", 0)),
+            "affinity_hits": int(counters.get(
+                "serving.fleet.affinity_hits", 0)),
+            "spills": int(counters.get("serving.fleet.spills", 0)),
+        }
+    ref = [list(r.output_tokens)
+           for r in results["one_worker"]["reqs"]]
+    mism = sum(a != b for a, b in
+               zip([list(r.output_tokens)
+                    for r in results["fleet"]["reqs"]], ref))
+    fleet, one = rows["fleet"], rows["one_worker"]
+    snap = results["fleet"]["snap"]
+    restart_h = snap["histograms"].get("serving.fleet.restart_s", {})
+    summary = {
+        "metric": FLEET_METRIC,
+        "value": fleet["value"],
+        "unit": "tokens/s",
+        "workers": n,
+        "baseline_tokens_per_s": one["value"],
+        "scaling_x": round(fleet["value"] / one["value"], 3)
+        if one["value"] else 0.0,
+        # out-of-process workers share no GIL and no runtime: unlike
+        # every thread-fleet leg above, this ratio is a real CPU-box
+        # measurement, not a silicon-only claim
+        "scaling_honest_on_cpu": True,
+        "ttft_p99_ms": fleet["ttft_p99_ms"],
+        "ttft_p99_ms_one_worker": one["ttft_p99_ms"],
+        "prefix_hit_rate": fleet["prefix_hit_rate"],
+        "reused_tokens_per_request": fleet[
+            "reused_tokens_per_request"],
+        "affinity_hits": fleet["affinity_hits"],
+        "spills": fleet["spills"],
+        "worker_deaths": int(snap["counters"].get(
+            "serving.fleet.worker_deaths", 0)),
+        "hangs_detected": int(snap["counters"].get(
+            "serving.fleet.hangs_detected", 0)),
+        "restarts": int(snap["counters"].get(
+            "serving.fleet.restarts", 0)),
+        "restart_wall_s": round(
+            results["fleet"]["restart_wall_s"], 3)
+        if results["fleet"]["restart_wall_s"] is not None else None,
+        "restart_p50_s": round(restart_h.get("p50", 0.0), 3),
+        "restart_max_s": round(restart_h.get("max", 0.0), 3),
+        "token_exact_vs_one_worker": mism == 0,
+        "token_mismatched_requests": mism,
+        "windows": WINDOWS,
+        "sessions_per_window": REQUESTS,
+        "turns": 2,
+        "model": SIZE,
+    }
+    return rows, summary
+
+
+def main_fleet():
+    import jax
+
+    _load_env(smoke=dict(FLEET_SMOKE))
+
+    rows, summary = process_fleet_stats()
+    for mode in ("one_worker", "fleet"):
+        print(json.dumps(rows[mode]))
+    summary["backend"] = jax.default_backend()
+    print(json.dumps(summary))
+
+
 def _disagg_requests(rng):
     """REQUESTS arrivals, bystanders interleaved with heavyweights:
     every THIRD request is a heavyweight (a near-PREFILL_LEN prompt,
@@ -2693,6 +2914,8 @@ if __name__ == "__main__":
         guard_bench_main(main_router, ROUTER_METRIC)
     elif "--disaggregated" in sys.argv[1:]:
         guard_bench_main(main_disagg, DISAGG_METRIC)
+    elif "--process-fleet" in sys.argv[1:]:
+        guard_bench_main(main_fleet, FLEET_METRIC)
     elif "--host-tier" in sys.argv[1:]:
         guard_bench_main(main_host_tier, HOST_METRIC)
     else:

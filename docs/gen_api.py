@@ -67,6 +67,9 @@ PAGES = {
                 "apex_tpu.serving.speculative",
                 "apex_tpu.serving.scheduler",
                 "apex_tpu.serving.router",
+                "apex_tpu.serving.routing_policy",
+                "apex_tpu.serving.fleet",
+                "apex_tpu.serving.fleet_worker",
                 "apex_tpu.serving.faults"],
     "contrib": [
         "apex_tpu.contrib.bottleneck", "apex_tpu.contrib.clip_grad",

@@ -1,0 +1,523 @@
+"""Process-level fleet: the :class:`~apex_tpu.serving.FleetController`
+contract pins.
+
+The headline guarantees, per ISSUE 18's acceptance criteria:
+
+- **Parity**: a greedy session stream served through the process fleet
+  (one OS process per replica, stdlib transport) is BITWISE identical
+  to the in-process :class:`~apex_tpu.serving.Router` over engines
+  built from the same specs — the shared :mod:`routing_policy` core
+  plus versioned wire forms change WHERE a request decodes, never what
+  it decodes.
+- **Wire forms**: requests, load snapshots and disagg arena records
+  round-trip through versioned dicts; an unknown version fails LOUDLY
+  (a controller and worker from different trees must never
+  deserialize garbage), a missing field raises, private clocks never
+  cross (perf_counter bases are per-process).
+- **Chaos**: a ``replica_death`` at the fleet tier kills a REAL
+  process (SIGKILL, no goodbye); every victim request reaches a typed
+  terminal state on the survivors with no retry charged, the
+  survivor's pool audits with zero leaked pages, and close() leaves
+  zero orphan processes and zero leaked threads. The new
+  ``worker_hang`` kind makes a worker stop answering its transport —
+  only the missed-beat detector can catch that (an alive-but-hung
+  process never EOFs).
+- **Rolling restart**: drain → kill → respawn → rejoin, one worker at
+  a time, under live traffic; drained requests re-route with no retry
+  charged and post-restart multi-turn traffic re-registers prefixes
+  warm (hit rate > 0 after every process was recycled).
+- **Elastic scale**: ``add_replica`` / ``remove_replica`` /
+  ``set_role`` under live traffic, including the disaggregated
+  fleet's role refit — handoff records travel BY VALUE and re-verify
+  by CRC on the importing arena.
+"""
+
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from apex_tpu.serving import (FaultPlan, FaultSpec, FleetController,
+                              QueueFull, Request, RequestStatus,
+                              Router, record_from_wire, record_to_wire,
+                              request_from_wire, request_to_wire,
+                              snapshot_from_wire, snapshot_to_wire)
+from apex_tpu.serving.fleet import (MAX_FRAME_BYTES, recv_frame,
+                                    send_frame)
+from apex_tpu.serving.fleet_worker import build_engine_from_spec
+from apex_tpu.serving.host_tier import HostTierRecord
+from apex_tpu.serving.routing_policy import (fleet_retry_hint,
+                                             note_placement,
+                                             rank_replicas)
+
+pytestmark = [pytest.mark.serving, pytest.mark.chaos,
+              pytest.mark.fleet]
+
+VOCAB = 64
+CHUNK = 8
+
+#: One spec builds bitwise-identical engines in ANY process on the
+#: same backend (params from init_seed via PRNGKey) — the parity
+#: test's whole premise.
+SPEC = {
+    "model": {"vocab_size": VOCAB, "hidden": 32, "num_layers": 2,
+              "num_heads": 4, "max_seq_len": 64},
+    "init_seed": 0,
+    "engine": {"slots": 2, "max_len": 64, "prefill_len": 24,
+               "chunk_len": CHUNK, "prefix_pool": 4, "seed": 5,
+               "policy": "O0"},
+}
+
+#: The disagg variant: a per-worker host arena for by-value handoffs.
+SPEC_TIER = {**SPEC, "engine": {**SPEC["engine"],
+                                "host_tier_bytes": 1 << 22}}
+
+
+def _session_waves(turns=2, sessions=3):
+    """Multi-turn sessions (turn t+1 extends turn t) — the affinity
+    workload, same construction as test_router's."""
+    rng = np.random.default_rng(7)
+    base = rng.integers(1, VOCAB, size=CHUNK).tolist()
+    prompts = []
+    for s in range(sessions):
+        srng = np.random.default_rng(100 + s)
+        p = base + srng.integers(1, VOCAB, size=CHUNK).tolist()
+        turns_s = [list(p)]
+        for _ in range(turns - 1):
+            p = p + srng.integers(1, VOCAB, size=4).tolist()
+            turns_s.append(list(p))
+        prompts.append(turns_s)
+    return [[list(prompts[s][t]) for s in range(sessions)]
+            for t in range(turns)]
+
+
+def _assert_no_orphans(fc):
+    """Every process the controller EVER spawned is gone — the
+    no-orphan pin (kill(pid, 0) on a reaped pid raises)."""
+    for p in fc._procs:
+        assert p.poll() is not None, f"worker pid {p.pid} still runs"
+        try:
+            os.kill(p.pid, 0)
+            # poll() reaped it, so a living pid here is a RE-USED pid
+            # from some other process — not ours; nothing to assert
+        except ProcessLookupError:
+            pass
+
+
+# ----------------------------------------------------------- wire forms
+def test_request_wire_roundtrip():
+    r = Request(prompt=[1, 2, 3], max_new_tokens=5, temperature=0.5,
+                timeout_s=2.0)
+    r.output_tokens = [7, 8]
+    r.status = RequestStatus.RUNNING
+    r.ttft_s = 0.25
+    r.chunks = 3
+    r.reused_tokens = 16
+    r.retries = 1
+    r._t_submit = 123.0             # private clock: must NOT cross
+    wire = request_to_wire(r)
+    back = request_from_wire(wire)
+    assert back.uid == r.uid
+    assert back.prompt == [1, 2, 3]
+    assert back.max_new_tokens == 5
+    assert back.temperature == 0.5
+    assert back.timeout_s == 2.0
+    assert back.output_tokens == [7, 8]
+    assert back.status is RequestStatus.RUNNING
+    assert back.ttft_s == 0.25 and back.chunks == 3
+    assert back.reused_tokens == 16 and back.retries == 1
+    assert back._t_submit is None, \
+        "per-process perf_counter clocks must never cross the wire"
+
+
+def test_request_wire_versioned_and_loud():
+    wire = request_to_wire(Request(prompt=[1], max_new_tokens=1))
+    bad = dict(wire)
+    bad["v"] = 999
+    with pytest.raises(ValueError, match="version"):
+        request_from_wire(bad)
+    missing = dict(wire)
+    del missing["prompt"]
+    with pytest.raises(KeyError):
+        request_from_wire(missing)
+
+
+def test_snapshot_wire_roundtrip_and_version():
+    snap = {"queue_depth": 3, "queue_free": 5, "slots": 2,
+            "slots_busy": 1, "slots_free": 1, "inflight_steps": 0,
+            "pages_free": 40, "host_bytes_free": None}
+    wire = snapshot_to_wire(snap)
+    assert snapshot_from_wire(wire) == snap
+    bad = dict(wire)
+    bad["v"] = 999
+    with pytest.raises(ValueError, match="version"):
+        snapshot_from_wire(bad)
+
+
+def test_record_wire_roundtrip_and_version():
+    k = np.arange(2 * 1 * 4 * 8 * 4, dtype=np.float32) \
+        .reshape(2, 1, 4, 8, 4)
+    v = k + 1
+    rec = HostTierRecord(k=k, v=v, nbytes=k.nbytes + v.nbytes,
+                         crc=(123,), shards=1)
+    wire = record_to_wire(77, rec)
+    key, back = record_from_wire(wire)
+    assert key == 77
+    np.testing.assert_array_equal(back.k, k)
+    np.testing.assert_array_equal(back.v, v)
+    assert back.crc == (123,) and back.nbytes == rec.nbytes
+    assert back.k.flags.owndata or back.k.base is None or \
+        back.k.flags.writeable    # owned copy, not a frombuffer view
+    bad = dict(wire)
+    bad["v"] = 999
+    with pytest.raises(ValueError, match="version"):
+        record_from_wire(bad)
+    with pytest.raises(ValueError, match="pending"):
+        record_to_wire(1, HostTierRecord(k=None, v=None, nbytes=0,
+                                         crc=(), pending=True))
+
+
+# ------------------------------------------------------- routing policy
+def test_rank_replicas_order():
+    snaps = {
+        0: {"slots_free": 1, "queue_depth": 2, "pages_free": 10,
+            "host_bytes_free": None},
+        1: {"slots_free": 2, "queue_depth": 0, "pages_free": 5,
+            "host_bytes_free": None},
+        2: {"slots_free": 2, "queue_depth": 0, "pages_free": 5,
+            "host_bytes_free": 100},
+    }
+    # no affinity: free slots first, then queue, pages, host headroom
+    assert rank_replicas([0, 1, 2], {0: 0, 1: 0, 2: 0},
+                         snaps) == [2, 1, 0]
+    # affinity dominates load entirely
+    assert rank_replicas([0, 1, 2], {0: 8, 1: 0, 2: 0},
+                         snaps) == [0, 2, 1]
+
+
+def test_fleet_retry_hint_and_placement_cap():
+    assert fleet_retry_hint([None, 0.5, 0.2]) == 0.5
+    assert fleet_retry_hint([None, None]) is None
+    placements = {}
+    for uid in range(5):
+        note_placement(placements, uid, uid % 2, cap=3)
+    assert len(placements) == 3
+    assert list(placements) == [2, 3, 4]    # oldest shed first
+    note_placement(placements, 2, 1, cap=3)
+    assert list(placements) == [3, 4, 2]    # re-place refreshes order
+
+
+# --------------------------------------------------------- frame codec
+def test_frame_codec_roundtrip_and_eof():
+    a, b = socket.socketpair()
+    try:
+        msg = {"op": "x", "blob": b"\x00" * 4096, "n": [1, 2, 3]}
+        send_frame(a, msg)
+        assert recv_frame(b) == msg
+        # peer closing mid-frame is EOFError (the death signal), not
+        # a hang and not a half-parsed pickle
+        a.sendall(b"\x00\x00\x10\x00partial")
+        a.close()
+        with pytest.raises(EOFError):
+            recv_frame(b)
+    finally:
+        b.close()
+    with pytest.raises(ValueError, match="transport bound"):
+        send_frame(None, {"blob": b"\x00" * (MAX_FRAME_BYTES + 1)})
+
+
+# ------------------------------------------------- worker_hang faults
+def test_worker_hang_spec_validation():
+    with pytest.raises(ValueError, match="victim replica"):
+        FaultSpec(kind="worker_hang", tick=0)
+    s = FaultSpec(kind="worker_hang", tick=3, replica=1)
+    plan = FaultPlan([s])
+    assert plan.take_worker_hangs(3) == [1]
+    assert plan.take_worker_hangs(3) == []      # consume-once
+    assert plan.stats()["injected_worker_hangs"] == 1
+
+
+def test_worker_hang_rate_replays_pre_fleet_seeds():
+    """The rate-0 draw is SKIPPED, so plans seeded before the fleet
+    existed replay bit-for-bit; at rate > 0 the hang draw comes LAST,
+    so every pre-existing fault in the schedule is unchanged."""
+    kw = dict(slots=2, nonfinite_rate=0.1, replica_death_rate=0.05,
+              replicas=2)
+    base = FaultPlan.random(11, 40, **kw)
+    same = FaultPlan.random(11, 40, worker_hang_rate=0.0, **kw)
+    assert [repr(s) for s in base.specs] == \
+        [repr(s) for s in same.specs]
+    with_hangs = FaultPlan.random(11, 40, worker_hang_rate=0.3, **kw)
+    hangs = [s for s in with_hangs.specs if s.kind == "worker_hang"]
+    others = [s for s in with_hangs.specs if s.kind != "worker_hang"]
+    assert hangs, "rate 0.3 over 40 ticks drew no hang?"
+    assert all(0 <= s.replica < 2 for s in hangs)
+    # drawn LAST within each tick: everything tick 0 drew BEFORE the
+    # first hang draw is bit-identical to the hang-free plan (later
+    # ticks legitimately shift — the hang draw consumes the stream)
+    t0_base = [repr(s) for s in base.specs if s.tick == 0]
+    t0_hang = [repr(s) for s in others if s.tick == 0]
+    assert t0_hang == t0_base
+
+
+# ------------------------------------------- the process fleet, live
+def test_fleet_lifecycle_end_to_end():
+    """The tentpole pins, chained on ONE fleet (spawning processes is
+    the expensive part): bitwise parity vs the in-process Router →
+    warm rolling restart → chaos process-kill with terminal-on-
+    survivors + zero-leak audit → respawn → idempotent close with
+    zero orphan processes and zero leaked threads."""
+    waves = _session_waves(turns=2, sessions=3)
+    threads_before = threading.active_count()
+
+    # the in-process oracle: engines built from the SAME specs by the
+    # same function the workers run
+    engines = [build_engine_from_spec(SPEC) for _ in range(2)]
+    router = Router(engines, seed=0, retain_prefixes=True,
+                    max_queue=32)
+    oracle = []
+    for wave in waves:
+        rs = [Request(prompt=list(p), max_new_tokens=4) for p in wave]
+        router.run(rs)
+        oracle.append([list(r.output_tokens) for r in rs])
+    router.close()
+    for e in engines:
+        e.reset(clear_prefixes=True)
+
+    fc = FleetController([SPEC, SPEC], seed=0, retain_prefixes=True,
+                         max_queue=32)
+    try:
+        # --- bitwise parity across the process boundary
+        fleet_tokens = []
+        for wave in waves:
+            rs = [Request(prompt=list(p), max_new_tokens=4)
+                  for p in wave]
+            fc.run(rs)
+            assert all(r.status is RequestStatus.FINISHED for r in rs)
+            fleet_tokens.append([list(r.output_tokens) for r in rs])
+        assert fleet_tokens == oracle, \
+            "process fleet diverged bitwise from the in-process Router"
+
+        # --- rolling restart: every process recycled, fleet keeps
+        # serving, and follow-up turns re-register prefixes warm
+        fc.rolling_restart()
+        assert all(w.alive for w in fc.workers)
+        last = [Request(prompt=waves[-1][s] + [9, 9, 9, 9],
+                        max_new_tokens=4) for s in range(3)]
+        fc.run(last)
+        # a repeat turn over the same (prefill_len-capped) history:
+        # its block-aligned prefix was just re-registered above
+        again = [Request(prompt=list(r.prompt), max_new_tokens=4)
+                 for r in last]
+        fc.run(again)
+        hits = sum(fc.prefix_stats(i).get("hits", 0) for i in (0, 1))
+        assert hits > 0, \
+            "no prefix hits after the rolling restart — the fleet " \
+            "rejoined cold and never re-warmed"
+
+        # --- chaos: a replica_death at the fleet tier kills a REAL
+        # process; victims re-route and finish on the survivor
+        plan = FaultPlan([FaultSpec(kind="replica_death",
+                                    tick=fc._tick + 1, replica=0)])
+        fc.fault_plan = plan
+        rng = np.random.default_rng(5)
+        chaos = [Request(prompt=list(rng.integers(1, VOCAB, size=9)),
+                         max_new_tokens=5) for _ in range(4)]
+        fc.run(chaos)
+        assert plan.stats()["injected_replica_deaths"] == 1
+        assert not fc.workers[0].alive
+        assert fc.workers[0].proc.poll() is not None, \
+            "chaos replica_death must kill the actual OS process"
+        assert all(r.status is RequestStatus.FINISHED for r in chaos)
+        assert all(r.retries == 0 for r in chaos), \
+            "a worker death is never the request's fault"
+        # the survivor's pool audits leak-free (runs the worker's own
+        # PoolAuditor + clearing reset over the RPC)
+        assert fc.audit_worker(1)["pages_in_use"] == 0
+
+        # --- revive the dead slot and serve through it again
+        fc.respawn_worker(0)
+        assert fc.workers[0].alive
+        post = [Request(prompt=list(rng.integers(1, VOCAB, size=7)),
+                        max_new_tokens=3) for _ in range(2)]
+        fc.run(post)
+        assert all(r.status is RequestStatus.FINISHED for r in post)
+    finally:
+        fc.close()
+        fc.close()                  # idempotent
+
+    _assert_no_orphans(fc)
+    time.sleep(0.1)
+    assert threading.active_count() <= threads_before, \
+        "fleet close leaked controller-side threads"
+
+
+@pytest.mark.slow
+def test_worker_dies_during_drain():
+    """A worker whose process vanishes MID-drain (the rolling
+    restart's worst moment) degrades to the hard-death path: its
+    requests re-route from the controller's canonical copies, the
+    restart completes, the fleet serves on."""
+    fc = FleetController([SPEC, SPEC], seed=0, retain_prefixes=True,
+                         max_queue=32)
+    try:
+        rng = np.random.default_rng(2)
+        fc.run([Request(prompt=list(rng.integers(1, VOCAB, size=8)),
+                        max_new_tokens=3)])
+        # murder worker 0 behind the controller's back, then ask for a
+        # rolling restart: the drain RPC meets a corpse
+        fc.workers[0].proc.kill()
+        fc.workers[0].proc.wait(timeout=30)
+        fc.rolling_restart()
+        assert all(w.alive for w in fc.workers)
+        reqs = [Request(prompt=list(rng.integers(1, VOCAB, size=8)),
+                        max_new_tokens=3) for _ in range(3)]
+        fc.run(reqs)
+        assert all(r.status is RequestStatus.FINISHED for r in reqs)
+    finally:
+        fc.close()
+    _assert_no_orphans(fc)
+
+
+@pytest.mark.slow
+def test_hang_detector_and_fleet_queue_full():
+    """A hung worker (alive process, silent transport) is caught ONLY
+    by the missed-beat detector: suspect after one missed ping, dead
+    after ``max_missed_beats``, its requests re-routing onto the
+    survivors. Plus the fleet-level backpressure pin: QueueFull
+    surfaces only when every live worker is saturated, carrying the
+    max-of-hints retry_after_s."""
+    from apex_tpu import telemetry
+    reg = telemetry.MetricsRegistry()
+    fc = FleetController([SPEC, SPEC], seed=0, retain_prefixes=True,
+                         max_queue=1, registry=reg,
+                         ping_timeout_s=0.5, max_missed_beats=2)
+    try:
+        rng = np.random.default_rng(3)
+        fc.run([Request(prompt=list(rng.integers(1, VOCAB, size=8)),
+                        max_new_tokens=3)])
+        # saturate: 2 workers x (2 slots + 1 queue) admit 6; the 7th+
+        # must raise fleet-level QueueFull
+        burst = [Request(prompt=list(rng.integers(1, VOCAB, size=8)),
+                         max_new_tokens=4) for _ in range(8)]
+        saw_queue_full = False
+        for r in burst:
+            while True:
+                try:
+                    fc.submit(r)
+                    break
+                except QueueFull:
+                    saw_queue_full = True
+                    if not fc.step():
+                        time.sleep(0.002)
+        while fc.pending:
+            if not fc.step():
+                time.sleep(0.002)
+        assert saw_queue_full, \
+            "8 requests through 6 seats never saw backpressure"
+        assert all(r.status is RequestStatus.FINISHED for r in burst)
+
+        # now hang worker 1 via the fault plan and let the missed-beat
+        # detector declare it
+        fc.fault_plan = FaultPlan([FaultSpec(
+            kind="worker_hang", tick=fc._tick + 1, replica=1)])
+        reqs = [Request(prompt=list(rng.integers(1, VOCAB, size=8)),
+                        max_new_tokens=4) for _ in range(3)]
+        fc.run(reqs)
+        assert not fc.workers[1].alive
+        assert all(r.status is RequestStatus.FINISHED for r in reqs)
+        snap = reg.snapshot()
+        assert snap["counters"].get(
+            "serving.fleet.hangs_detected") == 1.0
+        assert snap["counters"].get(
+            "serving.fleet.worker_deaths") == 1.0
+    finally:
+        fc.close()
+    _assert_no_orphans(fc)
+
+
+@pytest.mark.slow
+def test_elastic_scale_and_role_refit():
+    """Elasticity under live traffic: a disaggregated fleet serves
+    through by-value KV handoffs, grows a decode worker, refits it to
+    prefill when the mix moves, and shrinks again — every phase
+    serving to completion, no orphans at close."""
+    fc = FleetController([SPEC_TIER, SPEC_TIER], seed=0,
+                         retain_prefixes=True, max_queue=32,
+                         roles=["prefill", "decode"])
+    try:
+        rng = np.random.default_rng(4)
+
+        def _burst(n=3):
+            rs = [Request(prompt=list(rng.integers(1, VOCAB, size=16)),
+                          max_new_tokens=4) for _ in range(n)]
+            fc.run(rs)
+            assert all(r.status is RequestStatus.FINISHED for r in rs)
+            return rs
+
+        _burst()
+        snap = fc.metrics_snapshot()
+        assert snap["counters"].get("serving.disagg.handoffs", 0) >= 3
+        assert snap["counters"].get(
+            "serving.swap.hit_after_swap", 0) >= 1, \
+            "no handoff record survived the by-value transfer"
+
+        # grow: a third worker, decode role
+        idx = fc.add_replica(SPEC_TIER, role="decode")
+        assert idx == 2 and fc.workers[2].alive
+        _burst()
+
+        # refit: the new worker becomes prefill-capable
+        fc.set_role(2, "prefill")
+        assert fc.workers[2].role == "prefill"
+        _burst()
+
+        # shrink back down; the remaining mix must still be a fleet
+        fc.remove_replica(2)
+        assert not fc.workers[2].alive
+        _burst()
+
+        # losing a whole role tier is refused loudly
+        with pytest.raises((RuntimeError, ValueError),
+                           match="last one alive|decode-capable"):
+            fc.remove_replica(1)
+            fc.remove_replica(0)
+    finally:
+        fc.close()
+    _assert_no_orphans(fc)
+
+
+@pytest.mark.slow
+def test_respawn_while_saturated():
+    """A worker killed while the fleet is saturated re-routes its
+    load into overflow; respawning it under that pressure drains the
+    overflow onto the revived capacity and every request finishes."""
+    fc = FleetController([SPEC, SPEC], seed=0, retain_prefixes=True,
+                         max_queue=2)
+    try:
+        rng = np.random.default_rng(6)
+        reqs = [Request(prompt=list(rng.integers(1, VOCAB, size=8)),
+                        max_new_tokens=6) for _ in range(6)]
+        for r in reqs:
+            while True:
+                try:
+                    fc.submit(r)
+                    break
+                except QueueFull:
+                    if not fc.step():
+                        time.sleep(0.002)
+        fc.kill_worker(0)
+        fc.respawn_worker(0)
+        while fc.pending:
+            if not fc.step():
+                time.sleep(0.002)
+        assert all(r.status is RequestStatus.FINISHED for r in reqs)
+        assert all(r.retries == 0 for r in reqs)
+        assert fc.audit_worker(0)["pages_in_use"] == 0
+        assert fc.audit_worker(1)["pages_in_use"] == 0
+    finally:
+        fc.close()
+    _assert_no_orphans(fc)

@@ -116,7 +116,7 @@ DOC = os.path.join(ROOT, "docs", "serving.md")
 # the docs must name.
 _PAT = re.compile(
     r"serving\.(?:faults|watchdog|spec|tp|kv|wq|heartbeat|router|swap"
-    r"|disagg)"
+    r"|disagg|fleet)"
     r"\.[a-z0-9_]+")
 
 
@@ -234,6 +234,22 @@ def test_scan_surface_is_alive():
         assert owner in emitted.get(name, []), \
             f"{name} not emitted by {os.path.basename(owner)} — " \
             "disaggregated-serving telemetry went dark"
+    # the process-fleet family: routing outcomes mirror the router's
+    # (same dashboard shape, one process per replica), plus the
+    # health-detector and restart instrumentation that only exist
+    # out-of-process — heartbeat latency, missed-beat hang
+    # declarations, and the rolling-restart duration histogram
+    fleet_py = os.path.join("apex_tpu", "serving", "fleet.py")
+    for name in ("serving.fleet.routed", "serving.fleet.affinity_hits",
+                 "serving.fleet.spills", "serving.fleet.worker_deaths",
+                 "serving.fleet.requeued", "serving.fleet.restarts",
+                 "serving.fleet.hangs_detected",
+                 "serving.fleet.workers_alive",
+                 "serving.fleet.heartbeat_s",
+                 "serving.fleet.restart_s"):
+        assert fleet_py in emitted.get(name, []), \
+            f"{name} not emitted by the fleet controller — " \
+            "process-fleet telemetry went dark"
     assert _documented(), "docs/serving.md names no fault/watchdog/" \
         "spec metrics — doc section missing?"
 
