@@ -141,6 +141,22 @@ generation requests from a fixed set of compiled programs:
   ``serving.fleet.*`` telemetry; per-worker registries merge into one
   fleet view.
 
+- :class:`SLOConfig` / :class:`TenantLedger` (:mod:`.slo`) —
+  SLO-aware preemptive scheduling (``Scheduler(slo=SLOConfig(...))``):
+  priority classes (``Request.slo_class`` / ``priority``), preempt-
+  lowest under admission pressure — the victim's committed pages
+  migrate device→host through the existing async swap path (or stay
+  resident as a retained prefix) and the request resumes later via
+  swap-in + COW prefix-share at the committed offset, BITWISE
+  identical to its uninterrupted greedy run; queue-aging starvation
+  bounds, per-tenant slot quotas + weighted-fair token accounting
+  (one shared ledger across the in-process Router's replicas),
+  deadline-aware admission (:class:`DeadlineUnmeetable` with an
+  honest EMA-derived ``retry_after_s``), and SLO-aware fleet routing
+  (``preemptible_pages`` headroom in :mod:`.routing_policy`, ranked
+  identically by Router and FleetController). ``slo=None`` stays the
+  verbatim FIFO baseline — zero new compiled programs either way.
+
 Quick start::
 
     from apex_tpu import serving
@@ -169,19 +185,24 @@ from .kv_cache import KVCache, PagedKVCache, PagePool
 from .kv_quant import KVQuantConfig
 from .prefix_cache import PrefixCache, PrefixMatch
 from .router import Router
-from .scheduler import (QueueFull, Request, RequestStatus, Scheduler,
+from .scheduler import (DeadlineUnmeetable, QueueFull, Request,
+                        RequestStatus, Scheduler,
                         request_from_wire, request_to_wire,
                         snapshot_from_wire, snapshot_to_wire)
+from .slo import SLOConfig, TenantLedger
 from .speculative import DraftWorker, SpecConfig, draft_tokens
 from .weight_quant import WeightQuantConfig
 
-__all__ = ["DraftWorker", "Engine", "FaultPlan", "FaultPolicy",
+__all__ = ["DeadlineUnmeetable", "DraftWorker", "Engine", "FaultPlan",
+           "FaultPolicy",
            "FaultSpec", "FleetController", "HostTier", "InjectedFault",
            "KVCache", "KVQuantConfig", "PagedKVCache", "PagePool",
            "PendingDecode", "PoolAuditor", "PoolInvariantError",
            "PrefixCache", "PrefixMatch", "QueueFull", "Request",
-           "RequestStatus", "Router", "Scheduler", "SpecConfig",
-           "SwapWorker", "WeightQuantConfig", "WorkerDied",
+           "RequestStatus", "Router", "SLOConfig", "Scheduler",
+           "SpecConfig",
+           "SwapWorker", "TenantLedger", "WeightQuantConfig",
+           "WorkerDied",
            "draft_tokens", "fault_kind", "record_from_wire",
            "record_to_wire", "request_from_wire", "request_to_wire",
            "routing_policy", "sample_tokens", "sharding",

@@ -2095,6 +2095,14 @@ class Engine:
         fragmentation walk :meth:`pool_stats` pays."""
         return self.pool.free_pages if self.paged else 0
 
+    def slot_pages(self, slot: int) -> int:
+        """Pages currently held by ``slot`` (0 on the contiguous
+        layout) — host bookkeeping only. The scheduler sums this over
+        low-priority running slots for ``preemptible_pages``, the
+        "reclaimable by preemption" headroom gauge in
+        :meth:`Scheduler.load_snapshot`."""
+        return int(self._n_pages[slot]) if self.paged else 0
+
     def pool_stats(self) -> dict:
         """Paged-pool telemetry snapshot: allocator counters plus the
         per-slot fragmentation view (allocated-but-invalid positions

@@ -268,10 +268,15 @@ def _kill_procs(procs: List[subprocess.Popen]) -> None:
 #: Scheduler keywords a fleet init may ship to workers — everything a
 #: plain value can express. Callables and live objects (fault_policy,
 #: auditor, tracer, on_requeue) cannot cross a process boundary and
-#: are rejected loudly at construction.
+#: are rejected loudly at construction. ``slo`` rides along because
+#: SLOConfig is a frozen picklable dataclass; ``tenant_ledger`` is
+#: deliberately ABSENT — a TenantLedger is process-local shared state
+#: (and refuses to pickle), so each worker process builds its own from
+#: the shipped config's tenant_weights (per-process fairness scope,
+#: documented in docs/serving.md "Overload & SLO").
 _WIRE_SCHED_KW = ("max_queue", "default_timeout_s", "eos_id",
                   "chunked", "chunk_budget", "retain_prefixes",
-                  "speculative", "pipeline_depth")
+                  "speculative", "pipeline_depth", "slo")
 
 
 class FleetController:
@@ -349,6 +354,10 @@ class FleetController:
         self.tracer = tracer
         self._rng = np.random.default_rng(seed)
         self._sched_kw = dict(scheduler_kw)
+        # routing reads only STATIC priority arithmetic from the
+        # config (base_priority — no clock), so controller and workers
+        # rank identically from the same shipped SLOConfig
+        self._slo = self._sched_kw.get("slo")
         self._specs = specs
         self._python = python or sys.executable
         self.ping_timeout_s = float(ping_timeout_s)
@@ -556,7 +565,10 @@ class FleetController:
         if not cand:
             raise RuntimeError("no live workers — the fleet is an "
                                "outage, not a routing event")
-        return keys, rank_replicas(cand, lens, snaps), lens
+        pri = self._slo.base_priority(request) \
+            if self._slo is not None else 0
+        return keys, rank_replicas(cand, lens, snaps,
+                                   priority=pri), lens
 
     def _poll(self, indices: Sequence[int]) -> Dict[int, dict]:
         """Load snapshots (wire → plain dict) for ``indices``; dead

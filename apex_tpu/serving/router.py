@@ -123,6 +123,7 @@ from .routing_policy import (ROUTE_POLICIES, fleet_retry_hint,
                              note_placement, random_order,
                              rank_replicas)
 from .scheduler import QueueFull, Request, Scheduler
+from .slo import TenantLedger
 
 __all__ = ["Router"]
 
@@ -258,6 +259,18 @@ class Router:
         self.fault_plan = fault_plan
         self.tracer = tracer
         self._rng = np.random.default_rng(seed)
+        # one SLO policy governs the whole in-process fleet: routing
+        # reads base_priority from it (SLO-aware rank order), and all
+        # replicas share ONE TenantLedger so weighted-fair accounting
+        # is fleet-wide, not per-replica (the process fleet can't share
+        # a lock across processes — its workers each build their own;
+        # see docs/serving.md "Overload & SLO")
+        self._slo = scheduler_kw.get("slo")
+        if self._slo is not None \
+                and scheduler_kw.get("tenant_ledger") is None:
+            scheduler_kw = dict(scheduler_kw)
+            scheduler_kw["tenant_ledger"] = TenantLedger(
+                self._slo.tenant_weights)
         # each replica gets a for_replica(i) view of the tracer, so
         # every span its scheduler/engine/workers emit lands under
         # Chrome process i without threading pid through call sites
@@ -373,7 +386,12 @@ class Router:
                         self.replicas[i].engine.prefix_cache.probe(
                             request.prompt, keys=keys)
         snaps = {i: self.replicas[i].load_snapshot() for i in alive}
-        return keys, rank_replicas(alive, lens, snaps), lens
+        # static base priority only (no aging clock): deterministic
+        # arithmetic both routing fronts reproduce identically
+        pri = self._slo.base_priority(request) \
+            if self._slo is not None else 0
+        return keys, rank_replicas(alive, lens, snaps,
+                                   priority=pri), lens
 
     def submit(self, request: Request) -> Request:
         """Route ``request`` to the best live replica (see module

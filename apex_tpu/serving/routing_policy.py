@@ -49,7 +49,8 @@ PLACEMENTS_CAP = 65536
 
 def rank_replicas(candidates: Sequence[int],
                   match_lens: Mapping[int, int],
-                  snapshots: Mapping[int, Mapping]) -> List[int]:
+                  snapshots: Mapping[int, Mapping],
+                  priority: int = 0) -> List[int]:
     """The candidate replicas best-first: longest probed prefix match,
     then free slots (desc), queue depth (asc), free pool pages (desc),
     host-arena headroom (desc), index (the deterministic last resort).
@@ -57,12 +58,24 @@ def rank_replicas(candidates: Sequence[int],
     wire form: the key set is part of the snapshot's versioned wire
     contract, so both fronts rank on identical fields. ``pages_free``
     / ``host_bytes_free`` may be None (unpaged / no host tier) and
-    rank as 0 — absent capacity is not headroom."""
+    rank as 0 — absent capacity is not headroom.
+
+    ``priority`` is the routed request's STATIC base priority
+    (``SLOConfig.base_priority`` — deterministic arithmetic, no clock,
+    so both fronts compute the identical value). For a prioritized
+    request (> 0) the pages tie-break counts ``preemptible_pages`` —
+    pages a replica could reclaim by preempting lower-priority work —
+    as free: a prioritized arrival ranks a preemption-rich replica as
+    having that headroom NOW. Priority-0 requests (and snapshots
+    predating the field — ``.get`` tolerates both wire v1 and literal
+    test dicts) rank exactly as before."""
     return sorted(candidates, key=lambda i: (
         -match_lens[i],
         -snapshots[i]["slots_free"],
         snapshots[i]["queue_depth"],
-        -(snapshots[i]["pages_free"] or 0),
+        -((snapshots[i]["pages_free"] or 0)
+          + ((snapshots[i].get("preemptible_pages") or 0)
+             if priority > 0 else 0)),
         # hierarchical-KV tie-break: of two replicas equal on
         # slots/queue/pages, prefer the one with more host-arena
         # headroom — landing work on a replica whose swap arena is

@@ -172,9 +172,11 @@ import numpy as np
 from apex_tpu.log_util import get_logger
 
 from .faults import FaultPolicy, PoolAuditor, fault_kind
+from .slo import SLOConfig, TenantLedger
 from .speculative import DraftWorker, draft_tokens
 
-__all__ = ["Request", "RequestStatus", "QueueFull", "Scheduler",
+__all__ = ["Request", "RequestStatus", "QueueFull",
+           "DeadlineUnmeetable", "Scheduler",
            "request_from_wire", "request_to_wire",
            "snapshot_from_wire", "snapshot_to_wire"]
 
@@ -202,6 +204,11 @@ class RequestStatus(str, enum.Enum):
     QUEUED = "queued"
     PREFILLING = "prefilling"
     RUNNING = "running"
+    # transient, SLO scheduling only: evicted from its slot mid-decode
+    # to make room for a higher-priority arrival — committed K/V
+    # migrated to the host tier (or retained resident), the request
+    # waits in the queue and resumes via swap-in + COW prefix share
+    PREEMPTED = "preempted"
     FINISHED = "finished"
     EXPIRED = "expired"
     FAILED = "failed"
@@ -227,6 +234,19 @@ class QueueFull(RuntimeError):
                  retry_after_s: Optional[float] = None):
         super().__init__(message)
         self.retry_after_s = retry_after_s
+
+
+class DeadlineUnmeetable(QueueFull):
+    """Raised by :meth:`Scheduler.submit` under deadline-aware
+    admission (``SLOConfig.deadline_admission``) when the request's
+    ``deadline_s`` cannot be met at the measured decode-step EMA —
+    accepting it would only burn capacity on work destined to miss.
+    A :class:`QueueFull` subclass, so every existing backpressure
+    handler (the router's spill, ``run()``'s absorb loop) treats it as
+    the shed-or-retry signal it is; ``retry_after_s`` is the EMA ×
+    queue-position estimate of when the queue ahead will have
+    drained."""
+
 
 
 @dataclasses.dataclass
@@ -259,6 +279,18 @@ class Request:
     temperature: float = 0.0
     timeout_s: Optional[float] = None
     uid: int = dataclasses.field(default_factory=lambda: next(_uid))
+    # SLO inputs (all inert when the scheduler runs without an
+    # SLOConfig — the FIFO path never reads them): ``slo_class`` names
+    # a class in SLOConfig.classes (its base priority); ``priority``
+    # adds on top (the whole priority for class-less requests);
+    # ``deadline_s`` is a completion deadline RELATIVE to submit
+    # (deadline-aware admission + the deadline_missed verdict);
+    # ``tenant`` joins the weighted-fair ledger and the per-tenant
+    # concurrency quota
+    priority: int = 0
+    slo_class: Optional[str] = None
+    deadline_s: Optional[float] = None
+    tenant: Optional[str] = None
 
     # filled in by the scheduler
     output_tokens: List[int] = dataclasses.field(default_factory=list)
@@ -274,6 +306,12 @@ class Request:
     latency_s: Optional[float] = None
     retries: int = 0
     error: Optional[str] = None
+    # SLO outputs: times this request was preempted (cumulative —
+    # preemption is not a fault, ``retries`` never moves), and the
+    # finish-time deadline verdict (latency_s > deadline_s; always
+    # False without a deadline)
+    preemptions: int = 0
+    deadline_missed: bool = False
     _t_submit: Optional[float] = dataclasses.field(default=None,
                                                    repr=False)
     # the CURRENT queueing episode's start (reset when a quarantine
@@ -284,6 +322,23 @@ class Request:
                                                    repr=False)
     _prefill_pos: int = dataclasses.field(default=0, repr=False)
     _not_before: Optional[float] = dataclasses.field(default=None,
+                                                     repr=False)
+    # preempt/resume state: the token stream the NEXT admission must
+    # ingest — prompt + committed outputs for a preempted request
+    # (resume re-samples the last committed position, which IS the
+    # next token), None otherwise (admission ingests the prompt).
+    # Cleared by _reset_transient: a quarantine rolls outputs back, so
+    # a stale ingest stream here would replay them as prompt and shift
+    # the output stream — the exact wrong-token bug the
+    # quarantined-while-preempted chaos test pins
+    _ingest_tokens: Optional[List[int]] = dataclasses.field(
+        default=None, repr=False)
+    # effective priority PINNED at admission (base + the aging boost
+    # earned while queued): the victim-selection comparison reads this
+    # for running requests, so an aged-up admission keeps its boost
+    # and cannot be instantly re-preempted by a fresh arrival of the
+    # same base class
+    _eff_priority: Optional[int] = dataclasses.field(default=None,
                                                      repr=False)
 
 
@@ -300,15 +355,25 @@ class Request:
 # cross: ``time.perf_counter`` bases are per-process, so a shipped
 # clock would be meaningless on arrival — each side stamps its own.
 
-REQUEST_WIRE_VERSION = 1
-SNAPSHOT_WIRE_VERSION = 1
+REQUEST_WIRE_VERSION = 2    # v2: SLO fields (priority/slo_class/
+#                             deadline_s/tenant in; preemptions/
+#                             deadline_missed out)
+SNAPSHOT_WIRE_VERSION = 2   # v2: oldest_deadline_s/preemptible_pages
 
 #: The load-snapshot key set — part of the versioned wire contract
 #: (routing_policy ranks on these fields, so both fronts must see the
 #: same ones; bump SNAPSHOT_WIRE_VERSION when this tuple changes).
+#: v2 adds ``oldest_deadline_s`` (tightest remaining deadline across
+#: queued+running, RELATIVE seconds — perf_counter bases never cross a
+#: process boundary — None when nothing carries one) and
+#: ``preemptible_pages`` (pages held by running requests strictly
+#: below the SLO config's top class — the headroom a top-priority
+#: arrival could reclaim; None when SLO scheduling is off or the
+#: engine is not paged).
 _SNAPSHOT_KEYS = ("queue_depth", "queue_free", "slots", "slots_busy",
                   "slots_free", "inflight_steps", "pages_free",
-                  "host_bytes_free")
+                  "host_bytes_free", "oldest_deadline_s",
+                  "preemptible_pages")
 
 
 def request_to_wire(request: Request) -> dict:
@@ -323,6 +388,10 @@ def request_to_wire(request: Request) -> dict:
         "temperature": float(request.temperature),
         "timeout_s": request.timeout_s,
         "uid": int(request.uid),
+        "priority": int(request.priority),
+        "slo_class": request.slo_class,
+        "deadline_s": request.deadline_s,
+        "tenant": request.tenant,
         "output_tokens": [int(t) for t in request.output_tokens],
         "status": request.status.value,
         "finish_reason": request.finish_reason,
@@ -336,6 +405,8 @@ def request_to_wire(request: Request) -> dict:
         "latency_s": request.latency_s,
         "retries": int(request.retries),
         "error": request.error,
+        "preemptions": int(request.preemptions),
+        "deadline_missed": bool(request.deadline_missed),
     }
 
 
@@ -356,6 +427,10 @@ def request_from_wire(wire: dict) -> Request:
         temperature=wire["temperature"],
         timeout_s=wire["timeout_s"],
         uid=wire["uid"],
+        priority=wire["priority"],
+        slo_class=wire["slo_class"],
+        deadline_s=wire["deadline_s"],
+        tenant=wire["tenant"],
         output_tokens=list(wire["output_tokens"]),
         status=RequestStatus(wire["status"]),
         finish_reason=wire["finish_reason"],
@@ -369,6 +444,8 @@ def request_from_wire(wire: dict) -> Request:
         latency_s=wire["latency_s"],
         retries=wire["retries"],
         error=wire["error"],
+        preemptions=wire["preemptions"],
+        deadline_missed=wire["deadline_missed"],
     )
 
 
@@ -438,7 +515,9 @@ class Scheduler:
                  fault_policy: Optional[FaultPolicy] = None,
                  fault_plan=None,
                  auditor: Optional[PoolAuditor] = None,
-                 tracer=None):
+                 tracer=None,
+                 slo: Optional[SLOConfig] = None,
+                 tenant_ledger: Optional[TenantLedger] = None):
         if max_queue < 1:
             raise ValueError("max_queue must be >= 1")
         if chunk_budget < 1:
@@ -461,6 +540,21 @@ class Scheduler:
                 raise ValueError(
                     "retain_prefixes requires an engine built with "
                     "prefix_pool > 0 (no pool rows to retain into)")
+        if slo is not None:
+            if not chunked:
+                raise ValueError(
+                    "slo scheduling requires chunked=True: resume "
+                    "re-ingests mid-stream at the committed offset, "
+                    "which the monolithic program cannot do")
+            if slo.preempt:
+                if not retain_prefixes \
+                        or not getattr(engine, "paged", False):
+                    raise ValueError(
+                        "slo.preempt requires a paged engine with "
+                        "retain_prefixes=True: a preempted request's "
+                        "committed K/V survives as a prefix-cache "
+                        "entry (host-tier swap or resident COW share) "
+                        "and resume is an ordinary prefix attach")
         if role not in ("prefill", "decode", "both"):
             raise ValueError(
                 f"role must be 'prefill', 'decode' or 'both', got "
@@ -490,6 +584,24 @@ class Scheduler:
         # host arena instead of ever decoding; "decode" replicas accept
         # only router hand-overs (plus their verified-miss re-prefills)
         self.role = str(role)
+        # SLO scheduling: None keeps the verbatim FIFO admission path
+        # (the baseline every SLO claim is benchmarked against — zero
+        # new compiled programs, pinned); a config switches admission
+        # to priority order with optional preemption, deadline
+        # admission and tenant fairness. The ledger is process-local
+        # shared state: the Router passes ONE across its replicas so
+        # fairness spans the process; each fleet worker builds its own
+        self.slo = slo
+        if tenant_ledger is not None:
+            self.tenants: Optional[TenantLedger] = tenant_ledger
+        elif slo is not None:
+            self.tenants = TenantLedger(slo.tenant_weights)
+        else:
+            self.tenants = None
+        # uids preempted since their last admission: the resume marker
+        # _consult_prefix_cache reads (and clears) to count/trace the
+        # resume rather than a disagg handoff import
+        self._preempted_uids: set = set()
         # re-probe-at-requeue seam: when set, a quarantine offers the
         # requeued request back to the router (which re-probes LIVE
         # replicas and the arena) instead of this replica's own queue;
@@ -627,11 +739,42 @@ class Scheduler:
                 "program cannot admit it")
         if request.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if self.slo is not None:
+            # validates slo_class loudly (unknown names raise here, at
+            # the door, instead of silently scheduling as priority 0)
+            self.slo.base_priority(request)
         if self.role == "decode" and not _handoff:
             raise ValueError(
                 "role='decode' replica serves router hand-overs only — "
                 "submit to a prefill-capable replica (the Router's "
                 "role policy routes new prompts there)")
+        # deadline-aware admission: once any decode throughput has
+        # been measured, estimate this request's completion as EMA ×
+        # (queue positions ahead + its own chunk count + its token
+        # budget) — one heartbeat is at least one EMA'd step. An
+        # estimate past the deadline is rejected NOW with an honest
+        # retry hint (EMA × queue depth: when the queue ahead has
+        # drained, the estimate shrinks below the deadline) instead of
+        # admitting work destined to miss. Deliberately conservative
+        # in neither direction: no prefix-hit discount (unknowable
+        # pre-admission), no slot-parallelism credit.
+        if self.slo is not None and self.slo.deadline_admission \
+                and request.deadline_s is not None \
+                and self._step_s_ema is not None:
+            est = self._step_s_ema * (
+                len(self._queue) + self.engine.chunks_for(n)
+                + request.max_new_tokens)
+            if est > request.deadline_s:
+                if self.registry is not None:
+                    self.registry.counter_inc(
+                        "serving.slo.deadline_rejected")
+                hint = round(self._step_s_ema
+                             * max(1, len(self._queue)), 6)
+                raise DeadlineUnmeetable(
+                    f"deadline_s={request.deadline_s:.3f} unmeetable: "
+                    f"estimated completion ~{est:.3f}s at the current "
+                    f"decode rate (retry_after_s~{hint:.3f})",
+                    retry_after_s=hint)
         # paged note: no page-demand check is needed here — a request's
         # worst case is capped at ceil(max_len / page_len) pages, which
         # the Engine constructor guarantees every pool can hold, so the
@@ -735,6 +878,7 @@ class Scheduler:
                 else RequestStatus.FINISHED
         request.status = status
         self._presubmitted_keys.pop(request.uid, None)
+        self._preempted_uids.discard(request.uid)
         if self._handoff_uids:
             hkey = self._handoff_uids.pop(request.uid, None)
             if hkey is not None:
@@ -747,6 +891,15 @@ class Scheduler:
                         tier.discard(hkey)
         if request._t_submit is not None:
             request.latency_s = time.perf_counter() - request._t_submit
+        if request.deadline_s is not None \
+                and request.latency_s is not None:
+            request.deadline_missed = \
+                request.latency_s > request.deadline_s
+        if self.tenants is not None and request.tenant is not None:
+            # finish-time charge: only work actually delivered moves
+            # the weighted-fair ledger
+            self.tenants.charge(request.tenant,
+                                len(request.output_tokens))
         if self.tracer is not None:
             # the trace's single TERMINAL span, spelled as three
             # explicit literals (the span-name lint reads literals):
@@ -791,7 +944,34 @@ class Scheduler:
                 "prefill_s": request.prefill_s,
                 "ttft_s": request.ttft_s,
                 "latency_s": request.latency_s,
+                "slo_class": request.slo_class,
+                "priority": request.priority,
+                "tenant": request.tenant,
+                "preemptions": request.preemptions,
+                "deadline_missed": request.deadline_missed,
             }, tag="serving.request", observe=False)
+            if self.slo is not None:
+                # per-class SLO telemetry: one namespaced family per
+                # class (the emitted⇔documented lint reduces the
+                # f-string to its serving.slo.class literal)
+                cls = request.slo_class if request.slo_class \
+                    is not None else "none"
+                self.registry.counter_inc(
+                    f"serving.slo.class.{cls}.completed")
+                if request.ttft_s is not None:
+                    self.registry.observe(
+                        f"serving.slo.class.{cls}.ttft_s",
+                        request.ttft_s)
+                if request.deadline_missed:
+                    self.registry.counter_inc(
+                        "serving.slo.deadline_missed")
+                    self.registry.counter_inc(
+                        f"serving.slo.class.{cls}.deadline_missed")
+                if request.tenant is not None \
+                        and self.tenants is not None:
+                    self.registry.counter_inc(
+                        f"serving.slo.tenant.{request.tenant}.tokens",
+                        len(request.output_tokens))
         if self.auditor is not None:
             # finish events move refcounts (page release, reservation
             # return): reconcile on the policy's sampling cadence
@@ -853,6 +1033,17 @@ class Scheduler:
         request._prefill_pos = 0
         request.reused_tokens = 0
         request.ttft_s = None
+        # BUGFIX guard for the quarantined-while-preempted path: the
+        # outputs just rolled back, so the preempt-time ingest stream
+        # (prompt + those outputs) is now a lie — replaying it would
+        # emit the request's tokens shifted by the replayed outputs, a
+        # silent wrong-token stream. Clearing it degrades the resume
+        # to the verified-miss contract: the next admission ingests
+        # the PROMPT (any surviving prefix entry still prefix-matches
+        # it token-verified; a corrupt swap record fails its CRC and
+        # re-prefills cold), never a wrong token.
+        request._ingest_tokens = None
+        request._eff_priority = None
         request.status = RequestStatus.QUEUED
         now = time.perf_counter()
         request._t_queued = now     # a fresh queueing episode begins
@@ -891,6 +1082,8 @@ class Scheduler:
     def _admit(self) -> None:
         if not self.chunked:
             return self._admit_monolithic()
+        if self.slo is not None:
+            return self._admit_slo()
         for slot in range(self.engine.slots):
             if self._running[slot] is not None or not self._queue:
                 continue
@@ -903,38 +1096,253 @@ class Scheduler:
                 # starve it); finishing requests release pages, so the
                 # next beat retries
                 break
-            r = self._queue[idx]
-            del self._queue[idx]
-            # admission ends the queue wait; prefill compute is paid one
-            # chunk per heartbeat from here (_prefill_tick)
-            r.queue_wait_s = time.perf_counter() - r._t_queued
-            if self.registry is not None:
-                self.registry.observe("serving.queue_wait_s",
-                                      r.queue_wait_s)
-            r.status = RequestStatus.PREFILLING
-            r._prefill_pos = 0
-            if self.retain_prefixes:
-                if self.tracer is not None:
-                    # bind the trace to this thread so swap-in /
-                    # swap-out spans the prefix attach triggers inside
-                    # the engine attribute to the admitting request
-                    with self.tracer.bind(r.uid):
-                        self._consult_prefix_cache(r, slot)
-                else:
-                    self._consult_prefix_cache(r, slot)
+            self._admit_one(slot, idx)
+
+    def _admit_one(self, slot: int, idx: int) -> None:
+        """Admit queue position ``idx`` into free ``slot`` (pages
+        already reserved): the shared tail of the FIFO and SLO
+        admission loops — bitwise the pre-SLO admission body, so the
+        ``slo=None`` trace path is verbatim the old one."""
+        r = self._queue[idx]
+        del self._queue[idx]
+        # admission ends the queue wait; prefill compute is paid one
+        # chunk per heartbeat from here (_prefill_tick)
+        r.queue_wait_s = time.perf_counter() - r._t_queued
+        if self.registry is not None:
+            self.registry.observe("serving.queue_wait_s",
+                                  r.queue_wait_s)
+        r.status = RequestStatus.PREFILLING
+        r._prefill_pos = 0
+        if self.retain_prefixes:
             if self.tracer is not None:
-                tr = self.tracer
-                t_adm = tr.now()
-                tr.event(r.uid, "queue_wait",
-                         t0=t_adm - r.queue_wait_s, dur=r.queue_wait_s)
-                tr.event(r.uid, "admit", t0=t_adm, slot=slot,
-                         reused_tokens=r.reused_tokens,
-                         pages=(self.engine.pages_required(
-                             len(r.prompt), r.max_new_tokens)
-                             if getattr(self.engine, "paged", False)
-                             else 0))
-            self._running[slot] = r
-            self._temps[slot] = r.temperature
+                # bind the trace to this thread so swap-in /
+                # swap-out spans the prefix attach triggers inside
+                # the engine attribute to the admitting request
+                with self.tracer.bind(r.uid):
+                    self._consult_prefix_cache(r, slot)
+            else:
+                self._consult_prefix_cache(r, slot)
+        if self.tracer is not None:
+            tr = self.tracer
+            t_adm = tr.now()
+            tr.event(r.uid, "queue_wait",
+                     t0=t_adm - r.queue_wait_s, dur=r.queue_wait_s)
+            tr.event(r.uid, "admit", t0=t_adm, slot=slot,
+                     reused_tokens=r.reused_tokens,
+                     pages=(self.engine.pages_required(
+                         len(r.prompt), r.max_new_tokens)
+                         if getattr(self.engine, "paged", False)
+                         else 0))
+        self._running[slot] = r
+        self._temps[slot] = r.temperature
+
+    # -------------------------------------------- SLO admission + preemption
+    def _admit_slo(self) -> None:
+        """Priority-order admission (``slo`` set): repeatedly pick the
+        most important eligible queued request — highest effective
+        priority (base + queue-aging boost), then earliest deadline,
+        then the tenant owed the most weighted service, then FIFO —
+        and place it in a free slot. When no slot (or no page
+        reservation) can be found and ``slo.preempt`` is on, the
+        lowest-priority running request STRICTLY below the candidate
+        preempts to the host tier instead of the candidate queueing
+        behind it. The loop guard bounds pathological ladders (every
+        iteration admits, preempts or returns)."""
+        guard = 4 * (self.engine.slots + len(self._queue) + 2)
+        while self._queue and guard > 0:
+            guard -= 1
+            now = time.perf_counter()
+            idx = self._eligible_index_slo(now)
+            if idx is None:
+                return          # backing off / quota-blocked across the board
+            cand = self._queue[idx]
+            slot = next((s for s in range(self.engine.slots)
+                         if self._running[s] is None), None)
+            if slot is None:
+                if not self._try_preempt(cand, now):
+                    return
+                continue        # a slot just freed: re-scan (the
+                #                 candidate set may have re-ranked)
+            if not self._reserve_pages(slot, cand):
+                # pool exhausted: preempting releases the victim's
+                # pages (swap-out frees them at dispatch; a resident
+                # retention frees them through try_reserve_slot's LRU
+                # valve on the retry)
+                if not self._try_preempt(cand, now):
+                    return
+                continue
+            # pin the admission-time effective priority: the aging
+            # boost earned while queued persists while running, so an
+            # aged-up admission cannot be instantly re-preempted by
+            # the next fresh arrival of a nominally higher class
+            cand._eff_priority = self.slo.effective_priority(cand, now)
+            self._admit_one(slot, idx)
+
+    def _eligible_index_slo(self, now: float) -> Optional[int]:
+        """The SLO analogue of :meth:`_eligible_index`: the queue
+        index of the most important request whose retry backoff has
+        elapsed and whose tenant is under its concurrency quota.
+        Order: effective priority desc, remaining deadline asc
+        (deadline-less last), tenant virtual service asc (owed more =
+        first), queue position asc (FIFO among true ties)."""
+        best = best_key = None
+        for i, r in enumerate(self._queue):
+            if r._not_before is not None and r._not_before > now:
+                continue
+            if self._tenant_blocked(r):
+                continue
+            pri = self.slo.effective_priority(r, now)
+            if r.deadline_s is not None and r._t_submit is not None:
+                remaining = r._t_submit + r.deadline_s - now
+            else:
+                remaining = float("inf")
+            served = 0.0
+            if self.tenants is not None and r.tenant is not None:
+                served = self.tenants.virtual_served(r.tenant)
+            key = (-pri, remaining, served, i)
+            if best_key is None or key < best_key:
+                best, best_key = i, key
+        return best
+
+    def _tenant_blocked(self, r: Request) -> bool:
+        """Per-tenant concurrency quota (``slo.tenant_max_share``):
+        True while the tenant already occupies its share of slots —
+        the request stays QUEUED (not an error) and the block lifts as
+        the tenant's running requests finish. At least one slot is
+        always allowed, so a quota never starves a tenant outright."""
+        share = self.slo.tenant_max_share
+        if share is None or r.tenant is None:
+            return False
+        cap = max(1, int(share * self.engine.slots))
+        held = sum(1 for q in self._running
+                   if q is not None and q.tenant == r.tenant)
+        return held >= cap
+
+    def _try_preempt(self, cand: Request, now: float) -> bool:
+        """Preempt the lowest-priority running request strictly below
+        ``cand``'s effective priority (ties broken toward the newest
+        submit — least sunk wait). False when preemption is off or no
+        strictly-lower victim exists (equal priority never preempts:
+        that would thrash between peers)."""
+        if not self.slo.preempt:
+            return False
+        pri = self.slo.effective_priority(cand, now)
+        victim = None
+        victim_key = None
+        for slot, r in enumerate(self._running):
+            if r is None or r.status != "running":
+                # only RUNNING requests preempt: a prefilling slot has
+                # no committed output state worth migrating yet, and
+                # its chunk loop holds engine state this path must not
+                # yank mid-ingest
+                continue
+            if self.slo.max_preemptions is not None \
+                    and r.preemptions >= self.slo.max_preemptions:
+                continue
+            if len(r.prompt) + len(r.output_tokens) \
+                    > self.engine.prefill_len:
+                # resume replays prompt + committed outputs through
+                # the fixed-shape prefill window — a decode that has
+                # grown past prefill_len can no longer be re-ingested
+                # exactly, so the slot is not preemptible
+                continue
+            vpri = r._eff_priority if r._eff_priority is not None \
+                else self.slo.base_priority(r)
+            if vpri >= pri:
+                continue
+            key = (vpri, -(r._t_submit or 0.0), -r.uid)
+            if victim_key is None or key < victim_key:
+                victim, victim_key = slot, key
+        if victim is None:
+            return False
+        self._preempt(victim)
+        return True
+
+    def _preempt(self, slot: int) -> None:
+        """Preempt-to-host: migrate ``slot``'s committed K/V out and
+        requeue its request in the PREEMPTED state. The committed
+        stream is ``prompt + outputs`` — its last token is pending
+        (decode writes a token's K/V one step after sampling it), so
+        the aligned export cap ``aligned(len(seq) - 1)`` is exactly
+        the prefix the slot has ingested. With a host tier the pages
+        ride :meth:`Engine.export_handoff` (async CRC'd swap-out under
+        the request's uid — the disagg machinery, one tier up);
+        without one the prefix is retained RESIDENT (COW share, freed
+        by LRU pressure if the pool needs it). Either way resume is an
+        ordinary admission: prefix match at the committed offset, the
+        final chunk re-samples the pending position, and a greedy
+        stream continues bitwise. A failed/declined export degrades to
+        a cold resume (re-ingest from the prompt) — never a wrong
+        token, per the PR 13 verified-miss contract."""
+        r = self._running[slot]
+        seq = [int(t) for t in r.prompt] + [int(t)
+                                            for t in r.output_tokens]
+        committed = len(seq) - 1
+        cap = (committed // self.engine.chunk_len) \
+            * self.engine.chunk_len
+        tier = getattr(self.engine, "host_tier", None)
+        pcache = self.engine.prefix_cache
+        # second-cycle hygiene: a prior resume's import may have left
+        # this uid's entry (and arena bytes) behind — drop both before
+        # re-exporting under the same single-writer key
+        if pcache.drop(r.uid) and tier is not None:
+            tier.discard(r.uid)
+        t0 = time.perf_counter()
+        exported = 0
+        try:
+            if self.tracer is not None:
+                with self.tracer.bind(r.uid):
+                    exported = self._preempt_export(slot, r, seq, cap,
+                                                    tier)
+            else:
+                exported = self._preempt_export(slot, r, seq, cap,
+                                                tier)
+        except Exception as e:  # noqa: BLE001 — containment edge
+            self._count_transient()
+            _logger.warning(
+                "preempt export for request %d failed (%s: %s) — it "
+                "will resume cold", r.uid, type(e).__name__, e)
+        if exported and tier is not None:
+            # resume resolves the record through the handoff seam:
+            # _finish/drain release it if the request dies queued, so
+            # a preempted request can never leak an arena record
+            self._handoff_uids[r.uid] = r.uid
+        r.status = RequestStatus.PREEMPTED
+        r.preemptions += 1
+        r._ingest_tokens = seq
+        r._prefill_pos = 0
+        r._not_before = None
+        self._preempted_uids.add(r.uid)
+        if self.tracer is not None:
+            self.tracer.event(r.uid, "preempt", t0=t0,
+                              dur=time.perf_counter() - t0, slot=slot,
+                              committed=committed, exported=exported)
+        # free AFTER the export: the entry holds its own page
+        # refcounts (or the arena holds the bytes), so the slot's
+        # release destroys nothing the resume needs
+        self._free_slot(slot)
+        self._queue.append(r)
+        if self.registry is not None:
+            self.registry.counter_inc("serving.preempt.preemptions")
+        if self.auditor is not None:
+            self.auditor.maybe_audit(self.engine)
+
+    def _preempt_export(self, slot: int, r: Request, seq, cap: int,
+                        tier) -> int:
+        """The export half of a preemption: through the host arena
+        when a tier is wired (the importer-side CRC makes corruption a
+        VERIFIED miss), else a resident retention. ``keys=None``
+        everywhere — the slot's stashed hash keys cover the PROMPT's
+        blocks only, and ``seq`` extends past them."""
+        if tier is not None:
+            return self.engine.export_handoff(slot, r.uid, seq,
+                                              keys=None)
+        if cap <= 0:
+            return 0
+        outcome = self.engine.retain_prefix(slot, seq[:cap], keys=None)
+        # "duplicate" is a warm resume too: the exact prefix is
+        # already retained (refreshed), so the match will find it
+        return cap if outcome in ("registered", "duplicate") else 0
 
     def _reserve_pages(self, slot: int, r: Request,
                        monolithic: bool = False) -> bool:
@@ -952,18 +1360,39 @@ class Scheduler:
             self.registry.counter_inc("serving.pool.admit_blocked")
         return ok
 
+    def _ingest(self, r: Request) -> Sequence[int]:
+        """The token stream admission ingests for ``r``: its prompt,
+        or — resuming a preemption — prompt + committed outputs (the
+        final chunk re-samples the last committed position, which IS
+        the next output token, so a greedy resume continues
+        bitwise)."""
+        return r._ingest_tokens if r._ingest_tokens is not None \
+            else r.prompt
+
     def _consult_prefix_cache(self, r: Request, slot: int) -> None:
         """Admission-time read path: attach the longest cached
-        block-aligned prefix of ``r.prompt`` to ``slot`` — paged: share
-        the donor's pages into the slot's table (copy-on-write, zero
-        data movement, no pin needed: page refcounts outlive the
-        entry); contiguous: one compiled row-copy with the donor entry
-        pinned for the slot's lifetime. Chunk prefill then resumes at
-        the matched offset. A miss changes nothing — the request
-        prefills cold from offset 0."""
+        block-aligned prefix of ``r``'s ingest stream to ``slot`` —
+        paged: share the donor's pages into the slot's table
+        (copy-on-write, zero data movement, no pin needed: page
+        refcounts outlive the entry); contiguous: one compiled
+        row-copy with the donor entry pinned for the slot's lifetime.
+        Chunk prefill then resumes at the matched offset. A miss
+        changes nothing — the request prefills cold from offset 0.
+        For a PREEMPTED request the stream is prompt + committed
+        outputs, so the match lands exactly at the preempt-time export
+        cap (warm resume) or degrades to the verified-miss cold
+        re-ingest — and the resolution is counted and traced as a
+        resume, not a disagg import."""
         pcache = self.engine.prefix_cache
+        resume = r.uid in self._preempted_uids
+        if resume:
+            self._preempted_uids.discard(r.uid)
         keys = self._presubmitted_keys.pop(r.uid, None)
-        if keys is None and self._worker is not None:
+        if resume:
+            # any presubmitted/worker hash covers the PROMPT's blocks
+            # only — stale for the resumed stream; recompute inline
+            keys = None
+        elif keys is None and self._worker is not None:
             prompt = tuple(r.prompt)
             n_blocks = len(prompt) // pcache.block_len
             keys = self._worker.take(
@@ -972,7 +1401,8 @@ class Scheduler:
         if keys is not None:
             # registration after ingestion reuses the same keys
             self._slot_hash_keys[slot] = keys
-        m = pcache.match(r.prompt, keys=keys)
+        seq = self._ingest(r)
+        m = pcache.match(seq, keys=keys)
         if m is not None:
             if getattr(self.engine, "paged", False):
                 if not self.engine.attach_prefix(slot, m):
@@ -1004,32 +1434,47 @@ class Scheduler:
                     m.length // self.engine.chunk_len)
             self.registry.gauge_set("serving.prefix.hit_rate",
                                     pcache.hit_rate)
-        if not self._handoff_uids:
-            return
-        hkey = self._handoff_uids.pop(r.uid, None)
-        if hkey is None:
-            return
-        imported = m is not None and getattr(m, "row", None) == hkey
-        if not imported:
-            # the handoff record went missing, corrupt or evicted (or
-            # the swap-in failed its CRC — the engine dropped that
-            # entry itself): VERIFIED MISS. Release any dangling entry
-            # plus its arena record, then re-prefill — nothing was
-            # attached, so never a wrong token. When an ordinary local
-            # prefix matched instead (m covers the same tokens), the
-            # unused handoff record is released the same way but no
-            # re-prefill is charged.
-            if pcache.drop(hkey):
-                tier = getattr(self.engine, "host_tier", None)
-                if tier is not None:
-                    tier.discard(hkey)
-            if m is None and self.registry is not None:
-                self.registry.counter_inc("serving.disagg.reprefills")
-        if self.tracer is not None:
-            self.tracer.event(r.uid, "handoff_import",
-                              imported=imported,
-                              reused_tokens=0 if m is None
-                              else m.length)
+        hkey = self._handoff_uids.pop(r.uid, None) \
+            if self._handoff_uids else None
+        if hkey is not None:
+            imported = m is not None \
+                and getattr(m, "row", None) == hkey
+            if not imported:
+                # the handoff record went missing, corrupt or evicted
+                # (or the swap-in failed its CRC — the engine dropped
+                # that entry itself): VERIFIED MISS. Release any
+                # dangling entry plus its arena record, then
+                # re-prefill — nothing was attached, so never a wrong
+                # token. When an ordinary local prefix matched instead
+                # (m covers the same tokens), the unused handoff
+                # record is released the same way but no re-prefill is
+                # charged.
+                if pcache.drop(hkey):
+                    tier = getattr(self.engine, "host_tier", None)
+                    if tier is not None:
+                        tier.discard(hkey)
+                if m is None and not resume \
+                        and self.registry is not None:
+                    self.registry.counter_inc(
+                        "serving.disagg.reprefills")
+            if self.tracer is not None and not resume:
+                self.tracer.event(r.uid, "handoff_import",
+                                  imported=imported,
+                                  reused_tokens=0 if m is None
+                                  else m.length)
+        if resume:
+            # the resume resolution, whichever path backed it: warm
+            # (swap-in + COW at the committed offset — m.length
+            # tokens) or the verified-miss cold re-ingest
+            if self.registry is not None:
+                self.registry.counter_inc("serving.preempt.resumes")
+                if m is None:
+                    self.registry.counter_inc(
+                        "serving.preempt.resume_reprefills")
+            if self.tracer is not None:
+                self.tracer.event(r.uid, "resume", slot=slot,
+                                  resumed_tokens=0 if m is None
+                                  else m.length, cold=m is None)
 
     def _admit_monolithic(self) -> None:
         """Legacy admit (``chunked=False``): whole-prompt prefill at
@@ -1157,9 +1602,10 @@ class Scheduler:
                     ran += 1
                     self._pf_rr = (slot + 1) % slots
                     continue
+            seq = self._ingest(r)
             lo = r._prefill_pos
-            hi = min(lo + self.engine.chunk_len, len(r.prompt))
-            final = hi == len(r.prompt)
+            hi = min(lo + self.engine.chunk_len, len(seq))
+            final = hi == len(seq)
             if self.pipeline_depth > 0:
                 self._dispatch_prefill(slot, r, lo, hi, final, tick)
                 ran += 1
@@ -1170,7 +1616,7 @@ class Scheduler:
                 if self.fault_plan is not None:
                     self.fault_plan.maybe_raise("chunk", tick)
                 token = self.engine.prefill_chunk(
-                    slot, list(r.prompt[lo:hi]), lo, r.temperature,
+                    slot, list(seq[lo:hi]), lo, r.temperature,
                     final=final)
             except Exception as e:  # noqa: BLE001 — containment edge
                 r.prefill_s += time.perf_counter() - t0
@@ -1207,10 +1653,13 @@ class Scheduler:
 
     def _complete_prompt(self, r: Request, slot: int,
                          token: int) -> None:
-        """Prompt-ingestion completion (shared by the sync and
-        dispatch-ahead prefill paths): register the prefix, mark the
-        TTFT, and emit the first token through the same finish checks
-        as every other token."""
+        """Ingestion completion (shared by the sync and dispatch-ahead
+        prefill paths): register the prefix, mark the TTFT, and emit
+        the sampled token through the same finish checks as every
+        other token. For a fresh request the token is the FIRST output
+        (the checks below reduce verbatim to the pre-SLO forms); for a
+        resumed one it is the next output after the committed stream —
+        TTFT was already paid and is never overwritten."""
         if self.retain_prefixes:
             if self.tracer is not None:
                 # registration can evict a prefix entry, which on a
@@ -1220,17 +1669,18 @@ class Scheduler:
                     self._register_prefix(r, slot)
             else:
                 self._register_prefix(r, slot)
-        r.ttft_s = time.perf_counter() - r._t_submit
-        if self.registry is not None:
-            self.registry.observe("serving.ttft_s", r.ttft_s)
+        if r.ttft_s is None:
+            r.ttft_s = time.perf_counter() - r._t_submit
+            if self.registry is not None:
+                self.registry.observe("serving.ttft_s", r.ttft_s)
         r.output_tokens.append(token)
         if self.eos_id is not None and token == self.eos_id:
             self._finish(r, "eos", slot)
-        elif r.max_new_tokens <= 1:
+        elif len(r.output_tokens) >= r.max_new_tokens:
             self._finish(r, "max_new_tokens", slot)
-        elif len(r.prompt) >= self.engine.max_len:
+        elif len(self._ingest(r)) >= self.engine.max_len:
             # cache already full: a decode step would overwrite the
-            # last prompt position's K/V and emit a corrupted token
+            # last ingested position's K/V and emit a corrupted token
             self._finish(r, "max_len", slot)
         else:
             r.status = RequestStatus.RUNNING
@@ -1251,7 +1701,7 @@ class Scheduler:
             if self.fault_plan is not None:
                 self.fault_plan.maybe_raise("chunk", tick)
             pending = self.engine.prefill_chunk_dispatch(
-                slot, list(r.prompt[lo:hi]), lo, r.temperature,
+                slot, list(self._ingest(r)[lo:hi]), lo, r.temperature,
                 final=final)
         except Exception as e:  # noqa: BLE001 — containment edge
             r.prefill_s += time.perf_counter() - t0
@@ -1291,7 +1741,7 @@ class Scheduler:
             return
         r.prefill_s += time.perf_counter() - tr0
         r.chunks += 1
-        final = hi == len(r.prompt)
+        final = hi == len(self._ingest(r))
         if self.tracer is not None:
             self.tracer.event(r.uid, "prefill_chunk", t0=t0,
                               dur=time.perf_counter() - t0,
@@ -1382,12 +1832,17 @@ class Scheduler:
         pcache = self.engine.prefix_cache
         before = pcache.evictions
         keys = self._slot_hash_keys[slot]
+        # the ingest stream, not r.prompt: a resumed request ingested
+        # prompt+committed-outputs, and that is the prefix now resident
+        # in the slot (keys are None on resume — stored hashes covered
+        # the prompt only — so the cache re-hashes inline)
+        seq = self._ingest(r)
         if getattr(self.engine, "paged", False):
-            outcome = self.engine.retain_prefix(slot, r.prompt,
+            outcome = self.engine.retain_prefix(slot, seq,
                                                 keys=keys)
         else:
             outcome = pcache.register(
-                r.prompt,
+                seq,
                 lambda row, length: self.engine.store_prefix(row, slot,
                                                              length),
                 keys=keys)
@@ -2126,9 +2581,53 @@ class Scheduler:
         host tier — when present it is the swap arena's remaining
         headroom, so the router's least-loaded tie-break sees arena
         pressure (a replica about to shed swapped prefixes), not just
-        device pages."""
+        device pages.
+
+        Two SLO-aware fields (both None when ``slo`` is off, so the
+        pre-SLO snapshot shape is a strict subset):
+
+        - ``oldest_deadline_s``: seconds until the TIGHTEST live
+          deadline (queued or running), negative once blown, None when
+          no live request carries one. Reported RELATIVE because
+          ``perf_counter`` bases do not cross processes — the fleet
+          controller compares urgency, not wall clocks.
+        - ``preemptible_pages``: pages held by RUNNING requests whose
+          effective priority is strictly below the config's top class
+          AND whose committed stream still fits the prefill re-ingest
+          window (a decode past ``prefill_len`` is no longer exactly
+          resumable, so it is never a victim) — the headroom a
+          top-class arrival could reclaim by preemption. None on a
+          contiguous engine (no pages to count).
+        """
         busy = sum(r is not None for r in self._running)
         tier = getattr(self.engine, "host_tier", None)
+        oldest = None
+        preemptible = None
+        if self.slo is not None:
+            now = time.perf_counter()
+            live = [r for r in self._running if r is not None]
+            live.extend(self._queue)
+            for r in live:
+                if r.deadline_s is None or r._t_submit is None:
+                    continue
+                rem = r._t_submit + r.deadline_s - now
+                if oldest is None or rem < oldest:
+                    oldest = rem
+            if getattr(self.engine, "paged", False):
+                top = self.slo.top_priority
+                preemptible = 0
+                for slot, r in enumerate(self._running):
+                    if r is None or r.status != RequestStatus.RUNNING:
+                        continue
+                    if len(r.prompt) + len(r.output_tokens) \
+                            > self.engine.prefill_len:
+                        # mirrors _try_preempt: past the re-ingest
+                        # window the slot is not exactly resumable
+                        continue
+                    pri = r._eff_priority if r._eff_priority is not None \
+                        else self.slo.base_priority(r)
+                    if pri < top:
+                        preemptible += self.engine.slot_pages(slot)
         return {
             "queue_depth": len(self._queue),
             "queue_free": self.max_queue - len(self._queue),
@@ -2140,6 +2639,8 @@ class Scheduler:
             if getattr(self.engine, "paged", False) else None,
             "host_bytes_free": None if tier is None
             else tier.capacity_bytes - tier.bytes_used,
+            "oldest_deadline_s": oldest,
+            "preemptible_pages": preemptible,
         }
 
     def drain_requests(self) -> List[Request]:

@@ -1,0 +1,173 @@
+"""SLO policy core — priority classes, deadline bookkeeping, tenant
+fairness.
+
+This module is the PURE half of SLO-aware scheduling: it defines what
+"more important" means (:class:`SLOConfig` — class→priority mapping,
+queue-aging boost, preemption/deadline-admission switches) and who has
+been served how much (:class:`TenantLedger` — weighted-fair virtual
+service accounting). The :class:`~apex_tpu.serving.Scheduler` consumes
+both; the :class:`~apex_tpu.serving.Router` and
+:class:`~apex_tpu.serving.FleetController` pass the config through to
+every replica so one policy governs the whole fleet.
+
+Deliberately imports NOTHING from the rest of the serving package (the
+scheduler imports *this* module), so:
+
+- :class:`SLOConfig` is a plain picklable dataclass — it rides the
+  process fleet's pickle frames to worker processes unchanged, and the
+  priority arithmetic is deterministic, so a controller and its
+  workers rank identically from the same config.
+- :class:`TenantLedger` is the opposite by design: it holds a lock and
+  live counters (process-LOCAL shared state), refuses to pickle
+  loudly, and never crosses a process boundary — the in-process Router
+  shares ONE ledger across its replicas; each fleet worker process
+  builds its own (per-process fairness, an honest scope documented in
+  docs/serving.md).
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, Mapping, Optional
+
+__all__ = ["SLOConfig", "TenantLedger"]
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOConfig:
+    """The scheduling policy knob set (frozen: one immutable value is
+    shared by the scheduler, router and fleet — nobody mutates policy
+    mid-serve).
+
+    - ``classes`` maps SLO class name → integer base priority (higher
+      = more important). A request names its class via
+      ``Request.slo_class``; its own ``Request.priority`` ADDS to the
+      class base (a within-class tie-break, and the whole priority for
+      class-less requests).
+    - ``aging_s``: queue-aging period — every ``aging_s`` seconds a
+      QUEUED request waits, its effective priority rises by 1, which
+      bounds starvation under a sustained high-priority flood (the
+      boost earned in the queue is PINNED at admission, so an aged-up
+      request cannot be instantly re-preempted by the next fresh
+      high-priority arrival). None disables aging.
+    - ``preempt``: under admission pressure, preempt the
+      lowest-priority RUNNING request (strictly below the candidate's
+      effective priority) instead of queueing the candidate behind it.
+    - ``deadline_admission``: reject a submit whose ``deadline_s``
+      cannot be met at the measured decode-step EMA
+      (:class:`~apex_tpu.serving.DeadlineUnmeetable`, with an honest
+      ``retry_after_s``) instead of accepting work destined to miss.
+    - ``max_preemptions``: per-request cap on how many times one
+      request may be preempted (None = unbounded); a capped request
+      becomes un-preemptible, which bounds churn on pathological
+      priority ladders.
+    - ``tenant_weights``: tenant → weight for the weighted-fair
+      ledger (unlisted tenants weigh 1.0).
+    - ``tenant_max_share``: cap on the fraction of slots one tenant
+      may occupy concurrently (None = no quota). At least one slot is
+      always allowed, so a quota can never starve a tenant outright.
+    """
+
+    classes: Mapping[str, int] = dataclasses.field(
+        default_factory=lambda: {"batch": 0, "interactive": 10})
+    aging_s: Optional[float] = None
+    preempt: bool = True
+    deadline_admission: bool = True
+    max_preemptions: Optional[int] = None
+    tenant_weights: Mapping[str, float] = dataclasses.field(
+        default_factory=dict)
+    tenant_max_share: Optional[float] = None
+
+    def base_priority(self, request) -> int:
+        """``request``'s static priority: its class's base (when it
+        names one) plus its own ``priority`` field. Raises
+        ``ValueError`` for an unknown class name — submit validates
+        with this, so typos fail loudly at the door, not silently as
+        priority 0."""
+        cls = getattr(request, "slo_class", None)
+        base = 0
+        if cls is not None:
+            if cls not in self.classes:
+                raise ValueError(
+                    f"unknown slo_class {cls!r} — this SLOConfig "
+                    f"defines {sorted(self.classes)}")
+            base = int(self.classes[cls])
+        return base + int(getattr(request, "priority", 0))
+
+    def effective_priority(self, request, now: float) -> int:
+        """Base priority plus the queue-aging boost: +1 per full
+        ``aging_s`` elapsed since the ORIGINAL submit (retries and
+        preemptions never reset that clock, so every pass through the
+        queue keeps the age already earned)."""
+        pri = self.base_priority(request)
+        t0 = getattr(request, "_t_submit", None)
+        if self.aging_s is not None and self.aging_s > 0 \
+                and t0 is not None and now > t0:
+            pri += int((now - t0) / self.aging_s)
+        return pri
+
+    @property
+    def top_priority(self) -> int:
+        """The highest class base priority — the reference level for
+        "preemptible headroom": pages held by running requests
+        strictly below this could be reclaimed for a top-class
+        arrival, which is what ``load_snapshot()['preemptible_pages']``
+        reports and ``routing_policy.rank_replicas`` folds in for
+        prioritized requests."""
+        return max(self.classes.values(), default=0)
+
+
+class TenantLedger:
+    """Weighted-fair service accounting, thread-safe and deliberately
+    process-local (see the module docstring). Each finished request
+    charges its tenant ``tokens / weight`` of VIRTUAL service; the
+    scheduler admits, among equal-priority candidates, the tenant with
+    the LEAST virtual service first — classic weighted fair queueing,
+    where a weight-2 tenant sustains twice the token rate of a
+    weight-1 tenant before losing ties."""
+
+    def __init__(self, weights: Optional[Mapping[str, float]] = None):
+        self._lock = threading.Lock()
+        self.weights: Dict[str, float] = dict(weights or {})
+        self._virtual: Dict[str, float] = {}
+        self._tokens: Dict[str, int] = {}
+
+    def weight(self, tenant: str) -> float:
+        w = float(self.weights.get(tenant, 1.0))
+        return w if w > 0 else 1.0
+
+    def charge(self, tenant: str, tokens: int) -> None:
+        """Record ``tokens`` served for ``tenant`` (finish-time, so
+        abandoned work is never charged)."""
+        with self._lock:
+            self._virtual[tenant] = self._virtual.get(tenant, 0.0) \
+                + tokens / self.weight(tenant)
+            self._tokens[tenant] = self._tokens.get(tenant, 0) \
+                + int(tokens)
+
+    def virtual_served(self, tenant: str) -> float:
+        """``tenant``'s weighted virtual service so far (0.0 for a
+        tenant never charged) — the admission tie-break key: lower
+        means owed more."""
+        with self._lock:
+            return self._virtual.get(tenant, 0.0)
+
+    def tokens_served(self, tenant: str) -> int:
+        with self._lock:
+            return self._tokens.get(tenant, 0)
+
+    def snapshot(self) -> Dict[str, dict]:
+        """Per-tenant ``{tokens, virtual, weight}`` view (telemetry /
+        tests)."""
+        with self._lock:
+            return {t: {"tokens": self._tokens.get(t, 0),
+                        "virtual": v,
+                        "weight": self.weight(t)}
+                    for t, v in self._virtual.items()}
+
+    def __reduce__(self):
+        raise TypeError(
+            "TenantLedger is process-local shared state (a lock and "
+            "live counters) — it never crosses the fleet's pickle "
+            "frames; each worker process builds its own from the "
+            "SLOConfig's tenant_weights")

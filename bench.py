@@ -78,7 +78,14 @@ arena, colocated vs ``Router(roles=[...])`` with CRC'd KV handoff
 (bystander TTFT p50/p99 both modes, the decode-replica
 heartbeat-tail isolation, handoff traffic + export/import p50/p99,
 zero re-prefills, zero leaked arena bytes, bitwise exactness) — via
-``bench_serving.disagg_stats``, and a nested ``process_fleet``
+``bench_serving.disagg_stats``, and a nested ``overload``
+sub-object (BENCH_SERVING_OVERLOAD=0 to drop it): the SLO-aware
+preemptive-scheduling leg — the same seeded mixed-class stream at
+>1x slot capacity served FIFO vs SLO-aware on identical geometry
+(interactive TTFT p50/p99 both modes, per-class deadline-miss rate
+against one FIFO-calibrated threshold, met-deadline goodput,
+preempt/resume churn, bitwise exactness vs the FIFO serve) — via
+``bench_serving.overload_stats``, and a nested ``process_fleet``
 sub-object (BENCH_SERVING_FLEET=0 to drop it;
 BENCH_SERVING_REPLICAS sizes the fleet): the out-of-process worker
 fleet — 1 worker vs N separate OS processes behind the stdlib
@@ -258,6 +265,19 @@ _SERVING_DISAGG_SMOKE = {
     "NEW_TOKENS": 8, "WINDOWS": 1, "PREFIX_POOL": 4,
 }
 
+# The overload sub-leg's smoke geometry (the mixed-class stream is
+# served TWICE on one engine — FIFO, then SLO-aware with preemption —
+# at >1x slot capacity; every third request is interactive). The
+# interactive deadline is calibrated at BENCH_SERVING_OVERLOAD_DL_PCT
+# percent of the measured FIFO window wall and judged identically in
+# both modes. BENCH_SERVING_REQUESTS et al. still win,
+# env-beats-smoke.
+_SERVING_OVERLOAD_SMOKE = {
+    "SIZE": "tiny", "VOCAB": 512, "SLOTS": 2, "MAX_LEN": 128,
+    "PREFILL_LEN": 48, "CHUNK_LEN": 8, "SHORT_LEN": 6, "REQUESTS": 12,
+    "NEW_TOKENS": 10, "WINDOWS": 1, "PREFIX_POOL": 4,
+}
+
 # The process-fleet sub-leg's smoke geometry (the session stream is
 # served through TWO fleets — 1 worker, then N — and every worker
 # spawn pays interpreter + jax import + compile, so it is sized
@@ -299,6 +319,7 @@ def _serving_leg() -> dict:
         out["async_heartbeat"] = _serving_async_leg()
         out["replica_router"] = _serving_router_leg()
         out["disaggregated"] = _serving_disagg_leg()
+        out["overload"] = _serving_overload_leg()
         out["process_fleet"] = _serving_process_fleet_leg()
         out["host_tier"] = _serving_host_tier_leg()
         return out
@@ -575,6 +596,42 @@ def _serving_disagg_leg() -> dict:
             "handoff_import_p50_ms", "handoff_import_p99_ms",
             "arena_bytes_after_drain", "token_mismatched_requests",
             "model")}
+    except KeyboardInterrupt:
+        raise
+    except BaseException as e:  # noqa: BLE001 — the row must not die here
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def _serving_overload_leg() -> dict:
+    """The SLO-scheduling trajectory sub-row: smoke-sized
+    overload summary (the same seeded mixed-class stream at >1x slot
+    capacity served FIFO vs SLO-aware on identical geometry —
+    interactive TTFT p50/p99 both modes, per-class deadline-miss rate
+    against one FIFO-calibrated threshold, goodput of met-deadline
+    tokens, preempt/resume churn, bitwise exactness vs the FIFO
+    serve) from ``bench_serving.overload_stats``.
+    BENCH_SERVING_OVERLOAD=0 drops it; failure-isolated like its
+    siblings — a broken SLO layer yields {"error": ...} here, never a
+    lost serving (or ResNet) row."""
+    if _env_int("BENCH_SERVING_OVERLOAD", "1") == 0:
+        return {"skipped": True}
+    try:
+        import bench_serving
+
+        bench_serving._load_env(smoke=dict(_SERVING_OVERLOAD_SMOKE))
+        _, summary = bench_serving.overload_stats()
+        return {k: summary[k] for k in (
+            "value", "unit", "goodput_fifo",
+            "tokens_per_s", "tokens_per_s_fifo",
+            "ttft_interactive_p50_ms", "ttft_interactive_p50_ms_fifo",
+            "ttft_interactive_p99_ms", "ttft_interactive_p99_ms_fifo",
+            "deadline_miss_rate_interactive",
+            "deadline_miss_rate_interactive_fifo",
+            "ttft_p99_improved", "miss_rate_improved",
+            "preemptions", "resumes", "resume_reprefills",
+            "deadline_rejected", "token_exact_vs_fifo",
+            "token_mismatched_requests", "deadline_pct_of_fifo_wall",
+            "overload_factor", "model")}
     except KeyboardInterrupt:
         raise
     except BaseException as e:  # noqa: BLE001 — the row must not die here

@@ -66,6 +66,7 @@ PAGES = {
                 "apex_tpu.serving.host_tier",
                 "apex_tpu.serving.speculative",
                 "apex_tpu.serving.scheduler",
+                "apex_tpu.serving.slo",
                 "apex_tpu.serving.router",
                 "apex_tpu.serving.routing_policy",
                 "apex_tpu.serving.fleet",

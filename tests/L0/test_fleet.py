@@ -110,13 +110,16 @@ def _assert_no_orphans(fc):
 # ----------------------------------------------------------- wire forms
 def test_request_wire_roundtrip():
     r = Request(prompt=[1, 2, 3], max_new_tokens=5, temperature=0.5,
-                timeout_s=2.0)
+                timeout_s=2.0, priority=7, slo_class="interactive",
+                deadline_s=1.5, tenant="acme")
     r.output_tokens = [7, 8]
     r.status = RequestStatus.RUNNING
     r.ttft_s = 0.25
     r.chunks = 3
     r.reused_tokens = 16
     r.retries = 1
+    r.preemptions = 2
+    r.deadline_missed = True
     r._t_submit = 123.0             # private clock: must NOT cross
     wire = request_to_wire(r)
     back = request_from_wire(wire)
@@ -129,6 +132,10 @@ def test_request_wire_roundtrip():
     assert back.status is RequestStatus.RUNNING
     assert back.ttft_s == 0.25 and back.chunks == 3
     assert back.reused_tokens == 16 and back.retries == 1
+    # the v2 SLO fields: identity in, verdicts out
+    assert back.priority == 7 and back.slo_class == "interactive"
+    assert back.deadline_s == 1.5 and back.tenant == "acme"
+    assert back.preemptions == 2 and back.deadline_missed is True
     assert back._t_submit is None, \
         "per-process perf_counter clocks must never cross the wire"
 
@@ -148,9 +155,16 @@ def test_request_wire_versioned_and_loud():
 def test_snapshot_wire_roundtrip_and_version():
     snap = {"queue_depth": 3, "queue_free": 5, "slots": 2,
             "slots_busy": 1, "slots_free": 1, "inflight_steps": 0,
-            "pages_free": 40, "host_bytes_free": None}
+            "pages_free": 40, "host_bytes_free": None,
+            "oldest_deadline_s": -0.25, "preemptible_pages": 12}
     wire = snapshot_to_wire(snap)
     assert snapshot_from_wire(wire) == snap
+    # the v2 SLO fields are part of the fixed key set: a v1-shaped
+    # snapshot (no SLO columns) must fail loudly, not rank on garbage
+    with pytest.raises(KeyError):
+        snapshot_to_wire({k: snap[k] for k in snap
+                          if k not in ("oldest_deadline_s",
+                                       "preemptible_pages")})
     bad = dict(wire)
     bad["v"] = 999
     with pytest.raises(ValueError, match="version"):
@@ -353,6 +367,71 @@ def test_fleet_lifecycle_end_to_end():
     time.sleep(0.1)
     assert threading.active_count() <= threads_before, \
         "fleet close leaked controller-side threads"
+
+
+@pytest.mark.slow
+def test_fleet_parity_mixed_class_stream():
+    """The bitwise-parity pin extended to a MIXED-CLASS stream
+    (ISSUE 19): both fronts inherit the same SLO-aware rank order
+    from the one ``routing_policy`` core — the fold uses the
+    request's STATIC base priority plus the v2 snapshot's
+    ``preemptible_pages``, no clocks — so the process fleet places
+    and serves a priority-laden tenant-tagged stream exactly like
+    the in-process Router, and the completion records carry the
+    same SLO verdict fields back over the wire."""
+    from apex_tpu.serving import SLOConfig
+
+    slo = SLOConfig(classes={"batch": 0, "interactive": 10},
+                    tenant_weights={"t0": 1.0, "t1": 2.0})
+    waves = _session_waves(turns=2, sessions=4)
+
+    def _requests(wave):
+        return [Request(prompt=list(p), max_new_tokens=4,
+                        slo_class="interactive" if s % 2 else "batch",
+                        tenant=f"t{s % 2}")
+                for s, p in enumerate(wave)]
+
+    engines = [build_engine_from_spec(SPEC) for _ in range(2)]
+    router = Router(engines, seed=0, retain_prefixes=True,
+                    max_queue=32, slo=slo)
+    oracle = []
+    for wave in waves:
+        rs = _requests(wave)
+        router.run(rs)
+        oracle.append([list(r.output_tokens) for r in rs])
+    router.close()
+    for e in engines:
+        e.reset(clear_prefixes=True)
+
+    fc = FleetController([SPEC, SPEC], seed=0, retain_prefixes=True,
+                         max_queue=32, slo=slo)
+    try:
+        fleet_tokens = []
+        done = []
+        for wave in waves:
+            rs = _requests(wave)
+            fc.run(rs)
+            assert all(r.status is RequestStatus.FINISHED for r in rs)
+            fleet_tokens.append([list(r.output_tokens) for r in rs])
+            done.extend(rs)
+        assert fleet_tokens == oracle, \
+            "mixed-class stream diverged bitwise between the " \
+            "process fleet and the in-process Router"
+        # the SLO identity survives the wire round-trip on results
+        assert all(r.slo_class in ("batch", "interactive")
+                   for r in done)
+        assert all(r.tenant in ("t0", "t1") for r in done)
+        # the v2 snapshot columns actually cross the worker wire:
+        # an SLO-configured idle worker reports both (preemptible 0,
+        # no live deadline — but present, not dropped by an old form)
+        snaps = fc._poll(range(2))
+        for snap in snaps.values():
+            assert "oldest_deadline_s" in snap
+            assert "preemptible_pages" in snap
+            assert snap["preemptible_pages"] == 0
+    finally:
+        fc.close()
+    _assert_no_orphans(fc)
 
 
 @pytest.mark.slow

@@ -116,7 +116,7 @@ DOC = os.path.join(ROOT, "docs", "serving.md")
 # the docs must name.
 _PAT = re.compile(
     r"serving\.(?:faults|watchdog|spec|tp|kv|wq|heartbeat|router|swap"
-    r"|disagg|fleet)"
+    r"|disagg|fleet|slo|preempt)"
     r"\.[a-z0-9_]+")
 
 
@@ -250,6 +250,20 @@ def test_scan_surface_is_alive():
         assert fleet_py in emitted.get(name, []), \
             f"{name} not emitted by the fleet controller — " \
             "process-fleet telemetry went dark"
+    # the SLO/preemption family: preempt/resume churn counters and the
+    # per-class namespaces (f-string families — the literal the regex
+    # extracts from f"serving.slo.class.{cls}.ttft_s" is
+    # "serving.slo.class", the namespacing contract the docs name) are
+    # all scheduler-emitted — any going dark hides overload shaping
+    for name in ("serving.preempt.preemptions",
+                 "serving.preempt.resumes",
+                 "serving.preempt.resume_reprefills",
+                 "serving.slo.deadline_missed",
+                 "serving.slo.deadline_rejected",
+                 "serving.slo.class", "serving.slo.tenant"):
+        assert sched in emitted.get(name, []), \
+            f"{name} not emitted by the scheduler — SLO/preemption " \
+            "telemetry went dark"
     assert _documented(), "docs/serving.md names no fault/watchdog/" \
         "spec metrics — doc section missing?"
 
@@ -511,7 +525,10 @@ def test_span_scan_surface_is_alive():
                  "finish", "expired", "failed",
                  # the disaggregated handoff pair: export at prompt-
                  # ingestion completion, import resolution at admission
-                 "handoff_export", "handoff_import"):
+                 "handoff_export", "handoff_import",
+                 # the SLO pair: committed-state export at preemption,
+                 # warm (or verified-cold) re-attach at re-admission
+                 "preempt", "resume"):
         assert sched in emitted.get(name, []), \
             f"span {name!r} not emitted by the scheduler — request " \
             "lifecycle tracing went dark"
