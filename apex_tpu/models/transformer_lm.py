@@ -33,6 +33,31 @@ from apex_tpu.normalization import FusedLayerNorm
 __all__ = ["TransformerLM", "TransformerBlock", "create_lm"]
 
 
+def _lora_term(x, pair, alpha, adapter_ids, out_dtype):
+    """The gathered multi-tenant LoRA epilogue term for one GEMM site:
+    ``(x @ A[ids]) @ B[ids] * alpha[ids]`` — the serving engine's
+    stacked-adapter residual (:mod:`apex_tpu.serving.lora`).
+
+    ``pair`` is the site's arena slice ``(A [rows, in, rank],
+    B [rows, rank, out])`` and ``adapter_ids [B]`` names each batch
+    row's arena row — a TRACED operand, so heterogeneous adapters ride
+    one compiled program and the adapter id is data, never a trace
+    key. Math runs in fp32 (the epilogue-accumulator convention every
+    fused tier here shares) and the result is cast to the base GEMM's
+    output dtype. Arena row 0 is all-zero with ``alpha[0] == 0``: a
+    base (adapter-free) row's term is exactly ``+0.0`` per element,
+    which fp32/bf16 addition leaves value-identical — the
+    ``fault_bias`` pin, reapplied."""
+    a, b = pair
+    ids = jnp.asarray(adapter_ids, jnp.int32)
+    h = jnp.einsum("bsh,bhr->bsr", jnp.asarray(x, jnp.float32),
+                   jnp.asarray(a, jnp.float32)[ids])
+    t = jnp.einsum("bsr,bro->bso", h,
+                   jnp.asarray(b, jnp.float32)[ids])
+    t = t * jnp.asarray(alpha, jnp.float32)[ids][:, None, None]
+    return jnp.asarray(t, out_dtype)
+
+
 def _dense_factory(weight_quant: bool, dense_dtype, param_dtype):
     """The one Dense-site constructor both block modules share: plain
     ``nn.Dense`` on the default path (kept verbatim — the bitwise
@@ -142,7 +167,7 @@ class SelfAttention(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool, cache=None, positions=None,
                  return_kv: bool = False, unaligned_append: bool = False,
-                 kv_scales=None):
+                 kv_scales=None, lora=None, adapter_ids=None):
         # dtype=None → O1 engine: GEMMs are FP16_FUNCS 'linear'
         from apex_tpu.amp.autocast import resolve_dtype
         dense_dtype = resolve_dtype(self.dtype, "linear", jnp.float32)
@@ -157,6 +182,12 @@ class SelfAttention(nn.Module):
         # sharder hands it the matching qkv/proj kernel slices
         heads = self.num_heads // self.tp_size
         qkv = _dense(3 * heads * d, "qkv")(x)
+        if lora is not None:
+            # column-parallel site: x and A replicated, B output-split
+            # (the arena stores qkv's B head-group-permuted, so this
+            # shard's slice lands on its own columns)
+            qkv = qkv + _lora_term(x, lora["qkv"], lora["alpha"],
+                                   adapter_ids, qkv.dtype)
         # one transpose to [3, B, h, S, d], then three views — no
         # throwaway generator re-indexing qkv[:, :, i] three times
         qkv = qkv.reshape(B, S, 3, heads, d).transpose(2, 0, 3, 1, 4)
@@ -306,7 +337,14 @@ class SelfAttention(nn.Module):
                 q = jnp.asarray(q, jnp.float32)
             out = flash_attention(q, k, v, causal=True)  # [B, h, S, d]
             out = jnp.moveaxis(out, 1, 2).reshape(B, S, heads * d)
-        out = _dense(self.hidden, "proj")(out)
+        ctx_in = out
+        out = _dense(self.hidden, "proj")(ctx_in)
+        if lora is not None:
+            # row-parallel site: A input-split to match the local
+            # heads' context, B replicated — the term is a partial sum
+            # the psum below restores, zero new collectives
+            out = out + _lora_term(ctx_in, lora["proj"], lora["alpha"],
+                                   adapter_ids, out.dtype)
         if self.tp_size > 1:
             # row-parallel reduce: each shard's proj saw only its heads'
             # context, so the outputs are partial sums; the Dense added
@@ -347,7 +385,7 @@ class TransformerBlock(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool, cache=None, positions=None,
                  return_kv: bool = False, unaligned_append: bool = False,
-                 kv_scales=None):
+                 kv_scales=None, lora=None, adapter_ids=None):
         # FusedLayerNorm resolves 'layer_norm' (FP32) itself from the raw
         # self.dtype; the Dense sites resolve 'linear' (FP16) here
         from apex_tpu.amp.autocast import resolve_dtype
@@ -369,7 +407,9 @@ class TransformerBlock(nn.Module):
                                               return_kv=return_kv,
                                               unaligned_append=
                                               unaligned_append,
-                                              kv_scales=kv_scales)
+                                              kv_scales=kv_scales,
+                                              lora=lora,
+                                              adapter_ids=adapter_ids)
         if cache is not None or return_kv:
             attn_out, aux = attn_out
         x = x + attn_out
@@ -379,7 +419,13 @@ class TransformerBlock(nn.Module):
         # shard's inner/tp slice), row-parallel down-projection psummed
         # below — the MLP half of the Megatron split
         inner = self.mlp_ratio * self.hidden // self.tp_size
-        h = _dense(inner, "mlp_in")(h)
+        mlp_in_x = h
+        h = _dense(inner, "mlp_in")(mlp_in_x)
+        if lora is not None:
+            # column-parallel site: B output-split (contiguous — the
+            # mlp_in kernel's own split), A replicated
+            h = h + _lora_term(mlp_in_x, lora["mlp_in"], lora["alpha"],
+                               adapter_ids, h.dtype)
         # tanh-approximation GELU (GPT-2's own formulation) on the fp32
         # accumulator. tanh fuses into the GEMM epilogue on TPU; exact
         # erf priced at +250 us per MLP f+b at the gpt2 shape on v5e
@@ -387,7 +433,13 @@ class TransformerBlock(nn.Module):
         # fused_dense API keeps exact erf; the models use the variant
         # their original papers trained with.
         h = nn.gelu(jnp.asarray(h, jnp.float32), approximate=True)
-        h = _dense(self.hidden, "mlp_out")(jnp.asarray(h, dense_dtype))
+        mlp_out_x = jnp.asarray(h, dense_dtype)
+        h = _dense(self.hidden, "mlp_out")(mlp_out_x)
+        if lora is not None:
+            # row-parallel site: A input-split to match this shard's
+            # inner slice, B replicated — psummed below
+            h = h + _lora_term(mlp_out_x, lora["mlp_out"],
+                               lora["alpha"], adapter_ids, h.dtype)
         if self.tp_size > 1:
             # row-parallel reduce (the block's second TP all-reduce);
             # mlp_out's bias is 1/tp-scaled per shard, restored here
@@ -469,7 +521,7 @@ class TransformerLM(nn.Module):
     def __call__(self, tokens, *, train: bool = True,
                  features_only: bool = False, cache=None, positions=None,
                  return_kv: bool = False, unaligned_append: bool = False,
-                 kv_scales=None):
+                 kv_scales=None, lora=None, adapter_ids=None):
         from apex_tpu.amp.autocast import resolve_dtype
         dense_dtype = resolve_dtype(self.dtype, "linear", jnp.float32)
         if self.inference_dtype is not None and not train:
@@ -526,6 +578,18 @@ class TransformerLM(nn.Module):
             # write and read
             layer_scales = None if kv_scales is None else \
                 (kv_scales[0][i], kv_scales[1][i])
+            # multi-tenant LoRA: this layer's slice of the stacked
+            # adapter arena ([layers, rows, ...] engine arrays sliced
+            # at i; alpha is layer-free) — serving modes only, like
+            # kv_scales
+            layer_lora = None if lora is None else {
+                "qkv": (lora["qkv_a"][i], lora["qkv_b"][i]),
+                "proj": (lora["proj_a"][i], lora["proj_b"][i]),
+                "mlp_in": (lora["mlp_in_a"][i], lora["mlp_in_b"][i]),
+                "mlp_out": (lora["mlp_out_a"][i],
+                            lora["mlp_out_b"][i]),
+                "alpha": lora["alpha"],
+            }
             if cache is not None:
                 # 2-tuple: per-slot rows [layers, B, h, L, d]; 3-tuple:
                 # paged pools [layers, P, h, page_len, d] + one shared
@@ -536,12 +600,16 @@ class TransformerLM(nn.Module):
                 x, (lk, lv) = block(x, train, cache=layer_cache,
                                     positions=positions,
                                     unaligned_append=unaligned_append,
-                                    kv_scales=layer_scales)
+                                    kv_scales=layer_scales,
+                                    lora=layer_lora,
+                                    adapter_ids=adapter_ids)
                 kv_out[0].append(lk)
                 kv_out[1].append(lv)
             elif return_kv:
                 x, (lk, lv) = block(x, train, return_kv=True,
-                                    kv_scales=layer_scales)
+                                    kv_scales=layer_scales,
+                                    lora=layer_lora,
+                                    adapter_ids=adapter_ids)
                 kv_out[0].append(lk)
                 kv_out[1].append(lv)
             else:

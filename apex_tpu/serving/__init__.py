@@ -141,6 +141,25 @@ generation requests from a fixed set of compiled programs:
   ``serving.fleet.*`` telemetry; per-worker registries merge into one
   fleet view.
 
+- :class:`LoRAConfig` / :class:`LoRAManager` (:mod:`.lora`) —
+  multi-tenant LoRA serving (``Engine(lora=LoRAConfig(...))``):
+  thousands of fine-tunes batched on ONE base engine. Each adapter is
+  a per-site low-rank pair folded into the four serving GEMMs as an
+  epilogue term (``acc + (x @ A) @ B · α``) gathered from a stacked
+  device arena by a TRACED per-slot adapter-index operand — adapter
+  identity is data, not a trace key, so a heterogeneous-adapter batch
+  decodes in one compiled invocation and the program-count pins do
+  not move. Adapters hot-load/evict through a bounded HostTier-style
+  host store (LRU, refcount pinning while any slot is bound, CRC
+  verification on swap-in — a corrupt record fails LOUDLY, never
+  decodes wrong tokens); ``Request.adapter`` routes with
+  resident-adapter affinity next to prefix affinity on both routing
+  fronts; under a mesh the arena shards on the PR-9 rule table's
+  axes (A column-split, B row-split) so the existing per-block psums
+  restore the sum — zero new collectives. ``lora=None`` (and a
+  LoRA engine with no adapter bound) stays the BITWISE base engine
+  on the same executables.
+
 - :class:`SLOConfig` / :class:`TenantLedger` (:mod:`.slo`) —
   SLO-aware preemptive scheduling (``Scheduler(slo=SLOConfig(...))``):
   priority classes (``Request.slo_class`` / ``priority``), preempt-
@@ -183,6 +202,7 @@ from .host_tier import (HostTier, SwapWorker, record_from_wire,
                         record_to_wire)
 from .kv_cache import KVCache, PagedKVCache, PagePool
 from .kv_quant import KVQuantConfig
+from .lora import LoRAConfig, LoRAManager
 from .prefix_cache import PrefixCache, PrefixMatch
 from .router import Router
 from .scheduler import (DeadlineUnmeetable, QueueFull, Request,
@@ -196,7 +216,8 @@ from .weight_quant import WeightQuantConfig
 __all__ = ["DeadlineUnmeetable", "DraftWorker", "Engine", "FaultPlan",
            "FaultPolicy",
            "FaultSpec", "FleetController", "HostTier", "InjectedFault",
-           "KVCache", "KVQuantConfig", "PagedKVCache", "PagePool",
+           "KVCache", "KVQuantConfig", "LoRAConfig", "LoRAManager",
+           "PagedKVCache", "PagePool",
            "PendingDecode", "PoolAuditor", "PoolInvariantError",
            "PrefixCache", "PrefixMatch", "QueueFull", "Request",
            "RequestStatus", "Router", "SLOConfig", "Scheduler",

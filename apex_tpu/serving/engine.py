@@ -454,7 +454,7 @@ class Engine:
                  spec: Optional[SpecConfig] = None, mesh=None,
                  kv_quant: Optional[KVQuantConfig] = None,
                  weight_quant: Optional[WeightQuantConfig] = None,
-                 host_tier=None, sync_swap: bool = False):
+                 host_tier=None, sync_swap: bool = False, lora=None):
         from apex_tpu.amp.policy import resolve_policy
 
         if policy is None:
@@ -751,6 +751,28 @@ class Engine:
                 # stop the thread when the engine is collected (the
                 # finalizer closes over the WORKER, not self — no cycle)
                 weakref.finalize(self, self._swap_worker.stop)
+        # multi-tenant LoRA tier (:mod:`apex_tpu.serving.lora`): a
+        # stacked per-site adapter arena gathered in the GEMM epilogue
+        # by a TRACED per-slot adapter-index operand — heterogeneous
+        # adapters decode in one batch, the adapter id is data (never
+        # a trace key), so the program-count pins above cannot move.
+        # _slot_adapter[slot] names the arena row each slot gathers;
+        # row 0 is the all-zero adapter (+0.0 epilogue — the
+        # fault_bias value-identity pin), so base requests on a
+        # LoRA-enabled engine stay bitwise the base engine.
+        self.lora = None
+        self._slot_adapter = np.zeros(self.slots, np.int32)
+        if lora is not None:
+            from .lora import LoRAConfig, LoRAManager
+            if not isinstance(lora, LoRAConfig):
+                raise TypeError(f"lora must be a LoRAConfig, got "
+                                f"{type(lora).__name__}")
+            self.lora = LoRAManager(
+                lora, hidden=hidden, num_heads=heads,
+                num_layers=layers,
+                mlp_ratio=int(getattr(model, "mlp_ratio", 4)),
+                tp=self.tp, mesh=mesh,
+                tp_axis=self._tp_axis or "tp", registry=registry)
         self._registry = registry
         # request tracer (None = off): installed by the scheduler via
         # set_tracer. The engine's only spans are the hierarchical-KV
@@ -845,6 +867,7 @@ class Engine:
         self._emit_tp_gauges()
         self._emit_kv_gauges()
         self._emit_wq_gauges()
+        self._emit_lora_gauges()
 
     # --------------------------------------------------- tensor parallelism
     def _tp_wrap(self, fn, n_extra_out: int):
@@ -864,9 +887,17 @@ class Engine:
         cspec = self._cache_spec_tree()
 
         def wrapped(params, cache, *rest):
+            extra = (P(),) * len(rest)
+            if self.lora is not None:
+                # the two trailing LoRA operands: the stacked arena
+                # (split per its own spec tree — the PR 9 rule-table
+                # split restated per stacked array) and the adapter-id
+                # vector (replicated host data)
+                extra = (P(),) * (len(rest) - 2) \
+                    + (self.lora.spec_tree(), P())
             return shard_map(
                 fn, mesh=self.mesh,
-                in_specs=(self._pspec, cspec) + (P(),) * len(rest),
+                in_specs=(self._pspec, cspec) + extra,
                 out_specs=(cspec,) + (P(),) * n_extra_out,
                 check_vma=False)(params, cache, *rest)
 
@@ -1008,6 +1039,90 @@ class Engine:
         self._registry.gauge_set("serving.wq.quant_scale_absmax",
                                  quant_scale_absmax(self.params))
 
+    def _emit_lora_gauges(self) -> None:
+        """The ``serving.lora.*`` gauge snapshot of a LoRA-enabled
+        engine (host-store bytes at rest + device-resident adapter
+        count — the :class:`~apex_tpu.serving.lora.LoRAManager` owns
+        the names and the counters). LoRA-less engines emit nothing —
+        the family is the tier's liveness signal, like ``serving.wq``.
+        """
+        if self._registry is None or self.lora is None:
+            return
+        self.lora.set_registry(self._registry)
+
+    # ------------------------------------------------------- multi-tenant LoRA
+    def _lora_args(self, slot: Optional[int] = None):
+        """The two trailing operands every compiled program takes on a
+        LoRA-enabled engine: the stacked device arena (a pytree of
+        traced arrays) and the per-row adapter-index vector — the full
+        ``[slots]`` binding for decode/verify, the one ``[1]`` slot's
+        for chunk/prefill. Empty on a LoRA-less engine, which keeps
+        today's traces verbatim."""
+        if self.lora is None:
+            return ()
+        ids = self._slot_adapter if slot is None \
+            else self._slot_adapter[slot:slot + 1]
+        return (self.lora.arena, jnp.asarray(ids))
+
+    def lora_register(self, name: str, sites, *,
+                      alpha: float = 1.0) -> None:
+        """Admit adapter ``name`` (per-site stacked A/B matrices) into
+        the LoRA host store — see :meth:`~apex_tpu.serving.lora
+        .LoRAManager.register`. Loud on a LoRA-less engine."""
+        if self.lora is None:
+            raise ValueError("engine has no LoRA tier — construct "
+                             "with Engine(lora=LoRAConfig(...))")
+        self.lora.register(name, sites, alpha=alpha)
+
+    def lora_bind(self, slot: int, name: str) -> bool:
+        """Bind serving slot ``slot`` to adapter ``name``: acquire a
+        (refcount-pinned) arena row — a hit when resident, a
+        CRC-verified swap-in when cold — and point the slot's traced
+        adapter index at it. False when the arena is full of bound
+        adapters (graceful degradation: the caller holds the request
+        queued); ``KeyError`` when the adapter is unknown or its
+        record failed the swap-in checksum (the loud-reload path)."""
+        if self.lora is None:
+            raise ValueError("engine has no LoRA tier")
+        row = self.lora.acquire(name)
+        if row is None:
+            return False
+        self._slot_adapter[slot] = row
+        return True
+
+    def lora_unbind(self, slot: int) -> None:
+        """Release slot ``slot``'s adapter binding (no-op when the
+        slot holds the zero adapter, or the tier is off). The adapter
+        stays arena-resident at refcount 0 — the next bind is a hit."""
+        if self.lora is None:
+            return
+        row = int(self._slot_adapter[slot])
+        if row:
+            self._slot_adapter[slot] = 0
+            self.lora.release(row)
+
+    def lora_audit(self) -> dict:
+        """Cross-check the LoRA tier's refcounts against the LIVE slot
+        bindings (every bound arena row's refcount must equal the
+        number of slots pointing at it) plus the manager's own byte
+        ledger and row<->record reconciliation. Raises on any drift;
+        returns the reconciled stats."""
+        if self.lora is None:
+            raise ValueError("engine has no LoRA tier")
+        bound: dict = {}
+        for slot in range(self.slots):
+            row = int(self._slot_adapter[slot])
+            if row:
+                bound[row] = bound.get(row, 0) + 1
+        return self.lora.audit(bound)
+
+    def resident_adapters(self):
+        """Device-resident adapter names (the scheduler's snapshot
+        column — adapter affinity routes on membership here); None on
+        a LoRA-less engine."""
+        return None if self.lora is None \
+            else self.lora.resident_names()
+
     @property
     def compiled_programs(self) -> int:
         """Distinct XLA executables traced so far (the compile-count
@@ -1061,12 +1176,21 @@ class Engine:
         return (quantize(k_new, cache.k_scale[:, None, :, None, None]),
                 quantize(v_new, cache.v_scale[:, None, :, None, None]))
 
+    @staticmethod
+    def _lora_kw(lora, adapter_ids):
+        """The model-apply kwargs for the two optional trailing LoRA
+        operands — EMPTY when the tier is off, so a LoRA-less engine's
+        traces stay verbatim (the bitwise baseline)."""
+        return {} if lora is None else {"lora": lora,
+                                        "adapter_ids": adapter_ids}
+
     def _prefill_impl(self, params, cache, tokens, length, slot,
-                      temperature, key):
+                      temperature, key, lora=None, adapter_ids=None):
         self.prefill_traces += 1    # python body runs at trace time only
         logits, (k_new, v_new) = self._model.apply(
             {"params": params}, tokens, train=False, return_kv=True,
-            kv_scales=self._kv_scales_of(cache))
+            kv_scales=self._kv_scales_of(cache),
+            **self._lora_kw(lora, adapter_ids))
         k_new, v_new = self._quantize_prefill_kv(cache, k_new, v_new)
         cache = cache.insert(slot, k_new, v_new, length)
         last = jax.lax.dynamic_index_in_dim(logits[0], length - 1,
@@ -1078,14 +1202,16 @@ class Engine:
         return cache, token, finite
 
     def _chunk_impl(self, params, cache, tokens, slot, offset, n_valid,
-                    temperature, fault_bias, key):
+                    temperature, fault_bias, key, lora=None,
+                    adapter_ids=None):
         self.chunk_traces += 1      # python body runs at trace time only
         k_slot, v_slot = cache.slot_view(slot)
         offset = jnp.asarray(offset, jnp.int32)
         logits, (k2, v2) = self._model.apply(
             {"params": params}, tokens, train=False,
             cache=(k_slot, v_slot), positions=offset[None],
-            kv_scales=self._kv_scales_of(cache))
+            kv_scales=self._kv_scales_of(cache),
+            **self._lora_kw(lora, adapter_ids))
         cache = cache.write_slot(slot, k2, v2, offset + n_valid)
         # sample at the last VALID row: the request's first token when
         # this is the prompt's final chunk, discarded by the host
@@ -1099,7 +1225,8 @@ class Engine:
         return cache, token, finite
 
     def _decode_impl(self, params, cache, last_tokens, active,
-                     temperature, fault_bias, key):
+                     temperature, fault_bias, key, lora=None,
+                     adapter_ids=None):
         self.decode_traces += 1     # python body runs at trace time only
         # prefix-pool rows sit past the serving slots in the same
         # arrays: slice them out (static) so the decode batch stays
@@ -1111,7 +1238,8 @@ class Engine:
         logits, (k2, v2) = self._model.apply(
             {"params": params}, last_tokens[:, None], train=False,
             cache=cache.front_view(self.slots), positions=positions,
-            kv_scales=self._kv_scales_of(cache))
+            kv_scales=self._kv_scales_of(cache),
+            **self._lora_kw(lora, adapter_ids))
         rows = jnp.asarray(logits[:, 0, :], jnp.float32) \
             + fault_bias[:, None]
         finite = jnp.all(jnp.isfinite(rows), axis=-1)         # [slots]
@@ -1142,7 +1270,8 @@ class Engine:
             axis=1).astype(jnp.int32)
         return greedy, n_accepted
 
-    def _verify_impl(self, params, cache, tokens, n_drafted, fault_bias):
+    def _verify_impl(self, params, cache, tokens, n_drafted, fault_bias,
+                     lora=None, adapter_ids=None):
         self.verify_traces += 1     # python body runs at trace time only
         K = tokens.shape[1] - 1
         # per-row offsets ARE the committed device lengths on the
@@ -1153,7 +1282,8 @@ class Engine:
         logits, (k2, v2) = self._model.apply(
             {"params": params}, tokens, train=False,
             cache=cache.front_view(self.slots), positions=offsets,
-            kv_scales=self._kv_scales_of(cache))
+            kv_scales=self._kv_scales_of(cache),
+            **self._lora_kw(lora, adapter_ids))
         rows = jnp.asarray(logits, jnp.float32) \
             + fault_bias[:, None, None]
         finite = jnp.all(jnp.isfinite(rows), axis=(1, 2))     # [slots]
@@ -1186,11 +1316,13 @@ class Engine:
 
     # -------------------------------------------- compiled bodies (paged)
     def _paged_prefill_impl(self, params, cache, tokens, pt_row, length,
-                            temperature, key):
+                            temperature, key, lora=None,
+                            adapter_ids=None):
         self.prefill_traces += 1    # python body runs at trace time only
         logits, (k_new, v_new) = self._model.apply(
             {"params": params}, tokens, train=False, return_kv=True,
-            kv_scales=self._kv_scales_of(cache))
+            kv_scales=self._kv_scales_of(cache),
+            **self._lora_kw(lora, adapter_ids))
         k_new, v_new = self._quantize_prefill_kv(cache, k_new, v_new)
         # scatter the padded [0, prefill_len) window into the slot's
         # pages: m whole pages, ids from the (traced) page-table row
@@ -1221,13 +1353,15 @@ class Engine:
         return cache, token, finite
 
     def _paged_chunk_impl(self, params, cache, tokens, pt_row, offset,
-                          n_valid, temperature, fault_bias, key):
+                          n_valid, temperature, fault_bias, key,
+                          lora=None, adapter_ids=None):
         self.chunk_traces += 1      # python body runs at trace time only
         offset = jnp.asarray(offset, jnp.int32)
         logits, (k2, v2) = self._model.apply(
             {"params": params}, tokens, train=False,
             cache=(cache.k, cache.v, pt_row), positions=offset[None],
-            kv_scales=self._kv_scales_of(cache))
+            kv_scales=self._kv_scales_of(cache),
+            **self._lora_kw(lora, adapter_ids))
         cache = cache.replace(k=k2, v=v2)
         # sample at the last VALID row (see _chunk_impl)
         last = jax.lax.dynamic_index_in_dim(logits[0], n_valid - 1,
@@ -1240,7 +1374,8 @@ class Engine:
         return cache, token, finite
 
     def _paged_decode_impl(self, params, cache, last_tokens, page_table,
-                           lengths, temperature, fault_bias, key):
+                           lengths, temperature, fault_bias, key,
+                           lora=None, adapter_ids=None):
         self.decode_traces += 1     # python body runs at trace time only
         # lengths are HOST state in the paged layout (the allocator owns
         # them); the program is a pure function of the operands. Length
@@ -1251,7 +1386,8 @@ class Engine:
         logits, (k2, v2) = self._model.apply(
             {"params": params}, last_tokens[:, None], train=False,
             cache=(cache.k, cache.v, page_table), positions=positions,
-            kv_scales=self._kv_scales_of(cache))
+            kv_scales=self._kv_scales_of(cache),
+            **self._lora_kw(lora, adapter_ids))
         rows = self._gather_logits(jnp.asarray(logits[:, 0, :],
                                                jnp.float32)) \
             + fault_bias[:, None]
@@ -1260,7 +1396,8 @@ class Engine:
         return cache.replace(k=k2, v=v2), tokens, finite
 
     def _paged_verify_impl(self, params, cache, tokens, page_table,
-                           lengths, n_drafted, fault_bias):
+                           lengths, n_drafted, fault_bias, lora=None,
+                           adapter_ids=None):
         self.verify_traces += 1     # python body runs at trace time only
         # unaligned_append: every row's [K+1] draft block lands at an
         # arbitrary mid-generation offset — per-position page scatters
@@ -1275,7 +1412,8 @@ class Engine:
             {"params": params}, tokens, train=False,
             cache=(cache.k, cache.v, page_table), positions=lengths,
             unaligned_append=True,
-            kv_scales=self._kv_scales_of(cache))
+            kv_scales=self._kv_scales_of(cache),
+            **self._lora_kw(lora, adapter_ids))
         cache = cache.replace(k=k2, v=v2)
         rows = self._gather_logits(jnp.asarray(logits, jnp.float32)) \
             + fault_bias[:, None, None]
@@ -1366,7 +1504,7 @@ class Engine:
                         self.params, self.cache, jnp.asarray(tokens),
                         jnp.asarray(self._page_table[slot:slot + 1]),
                         np.int32(n), np.float32(temperature),
-                        self._next_key())))
+                        self._next_key(), *self._lora_args(slot))))
             self._host_len[slot] = n
         else:
             self.cache, token, finite = self._runtime_call(
@@ -1374,7 +1512,8 @@ class Engine:
                     lambda: self._jit_prefill(
                         self.params, self.cache, jnp.asarray(tokens),
                         np.int32(n), np.int32(slot),
-                        np.float32(temperature), self._next_key())))
+                        np.float32(temperature), self._next_key(),
+                        *self._lora_args(slot))))
         tw = time.perf_counter()
         token = int(token)                  # device sync
         self.last_prefill_finite = bool(finite)
@@ -1474,7 +1613,7 @@ class Engine:
                     jnp.asarray(self._page_table[slot:slot + 1]),
                     np.int32(offset), np.int32(n),
                     np.float32(temperature), np.float32(fault_bias),
-                    self._next_key()))
+                    self._next_key(), *self._lora_args(slot)))
             self._host_len[slot] = offset + n
         else:
             self.cache, token, finite = self._runtime_call(
@@ -1482,7 +1621,7 @@ class Engine:
                     self.params, self.cache, jnp.asarray(tokens),
                     np.int32(slot), np.int32(offset), np.int32(n),
                     np.float32(temperature), np.float32(fault_bias),
-                    self._next_key()))
+                    self._next_key(), *self._lora_args(slot)))
         return PendingPrefill(
             token=token, finite=finite, slot=slot, final=final,
             t_dispatch=t0, dispatch_s=time.perf_counter() - t0)
@@ -2188,7 +2327,8 @@ class Engine:
                     jnp.asarray(self._page_table),
                     jnp.asarray(self._host_len),
                     jnp.asarray(temperatures, jnp.float32),
-                    jnp.asarray(fault_bias), self._next_key()))
+                    jnp.asarray(fault_bias), self._next_key(),
+                    *self._lora_args()))
             grow = act & (self._host_len < self.max_len)
             self._host_len[grow] += 1
         else:
@@ -2198,7 +2338,8 @@ class Engine:
                     jnp.asarray(last_tokens, jnp.int32),
                     jnp.asarray(act),
                     jnp.asarray(temperatures, jnp.float32),
-                    jnp.asarray(fault_bias), self._next_key()))
+                    jnp.asarray(fault_bias), self._next_key(),
+                    *self._lora_args()))
         return PendingDecode(tokens=tokens, finite=finite, active=act,
                              t_dispatch=t0)
 
@@ -2391,12 +2532,14 @@ class Engine:
                     self.params, self.cache, jnp.asarray(tokens),
                     jnp.asarray(vt.astype(np.int32)),
                     jnp.asarray(vlen.astype(np.int32)),
-                    jnp.asarray(n_drafted), jnp.asarray(fault_bias)))
+                    jnp.asarray(n_drafted), jnp.asarray(fault_bias),
+                    *self._lora_args()))
         else:
             self.cache, out, n_accepted, finite = self._runtime_call(
                 lambda: self._jit_verify(
                     self.params, self.cache, jnp.asarray(tokens),
-                    jnp.asarray(n_drafted), jnp.asarray(fault_bias)))
+                    jnp.asarray(n_drafted), jnp.asarray(fault_bias),
+                    *self._lora_args()))
         tw = time.perf_counter()
         # ONE batched readback per verify dispatch (tokens, acceptance,
         # verdicts) — the host never int()s a device element per slot
@@ -2521,6 +2664,9 @@ class Engine:
         self._emit_tp_gauges()
         self._emit_kv_gauges()
         self._emit_wq_gauges()
+        if self.lora is not None:
+            self.lora.set_registry(registry)
+        self._emit_lora_gauges()
 
     def set_tracer(self, tracer) -> None:
         """Install a request tracer (``Scheduler(tracer=...)`` calls
@@ -2537,6 +2683,11 @@ class Engine:
         them too. On the paged path the wipe also returns every slot's
         pages to the pool (retained prefixes keep theirs via their own
         refcounts)."""
+        if self.lora is not None:
+            # a slot wipe drops every live adapter binding; residency
+            # (the arena rows) survives — warm state, like prefixes
+            self._slot_adapter[:] = 0
+            self.lora.release_all()
         if self.paged:
             for s in range(self.slots):
                 self.release_slot(s)

@@ -312,6 +312,12 @@ class FleetController:
     rpc_timeout_s:
         The working-RPC bound (init/submit/step/drain) — generous,
         because a worker's first step may be compiling.
+    transport:
+        ``None`` / ``("unix",)`` for the default AF_UNIX socket in a
+        private temp dir; ``("tcp", host, port)`` for an AF_INET
+        listener (``port=0`` picks a free port). Same frame codec,
+        same RPC surface — the loopback TCP fleet is bitwise the
+        AF_UNIX one.
     **scheduler_kw:
         Plain-value :class:`~apex_tpu.serving.Scheduler` keywords
         (:data:`_WIRE_SCHED_KW`), shipped to and applied by every
@@ -327,6 +333,7 @@ class FleetController:
                  rpc_timeout_s: float = 600.0,
                  spawn_timeout_s: float = 180.0,
                  python: Optional[str] = None,
+                 transport: Optional[Sequence] = None,
                  **scheduler_kw):
         specs = [dict(s) for s in specs]
         if not specs:
@@ -379,10 +386,32 @@ class FleetController:
         self._hasher: Optional[PrefixCache] = None
 
         self._dir = tempfile.mkdtemp(prefix="apex-fleet-")
-        self._sock_path = os.path.join(self._dir, "fleet.sock")
-        self._listener = socket.socket(socket.AF_UNIX,
-                                       socket.SOCK_STREAM)
-        self._listener.bind(self._sock_path)
+        # transport: None / ("unix",) binds the default AF_UNIX path;
+        # ("tcp", host, port) binds an AF_INET listener (port 0 asks
+        # the OS for a free one — the bound port is re-read from
+        # getsockname, so tests never race for a fixed port). The
+        # frame codec is address-family-agnostic; workers get the
+        # address as a "tcp:host:port" --socket argument.
+        if transport is None or tuple(transport) == ("unix",):
+            self._sock_path = os.path.join(self._dir, "fleet.sock")
+            self._listener = socket.socket(socket.AF_UNIX,
+                                           socket.SOCK_STREAM)
+            self._listener.bind(self._sock_path)
+            self._worker_addr = self._sock_path
+        elif transport[0] == "tcp":
+            kind, host, port = transport
+            self._sock_path = None
+            self._listener = socket.socket(socket.AF_INET,
+                                           socket.SOCK_STREAM)
+            self._listener.setsockopt(socket.SOL_SOCKET,
+                                      socket.SO_REUSEADDR, 1)
+            self._listener.bind((str(host), int(port)))
+            bound_port = self._listener.getsockname()[1]
+            self._worker_addr = f"tcp:{host}:{bound_port}"
+        else:
+            raise ValueError(
+                f"unknown transport spec {transport!r} — expected "
+                "None, ('unix',) or ('tcp', host, port)")
         self._listener.listen(64)
         # every Popen ever spawned (respawns included): the finalizer
         # and close() reap them ALL — no worker outlives the fleet
@@ -418,7 +447,7 @@ class FleetController:
             os.pathsep + prev if prev else "")
         proc = subprocess.Popen(
             [self._python, "-m", "apex_tpu.serving.fleet_worker",
-             "--socket", self._sock_path, "--replica", str(index)],
+             "--socket", self._worker_addr, "--replica", str(index)],
             env=env)
         self._procs.append(proc)
         return proc
@@ -433,6 +462,9 @@ class FleetController:
             while len(conns) < n:
                 conn, _ = self._listener.accept()
                 conn.settimeout(self.spawn_timeout_s)
+                if conn.family == socket.AF_INET:
+                    conn.setsockopt(socket.IPPROTO_TCP,
+                                    socket.TCP_NODELAY, 1)
                 hello = recv_frame(conn)
                 if hello.get("op") != "hello":
                     conn.close()
@@ -567,8 +599,15 @@ class FleetController:
                                "outage, not a routing event")
         pri = self._slo.base_priority(request) \
             if self._slo is not None else 0
-        return keys, rank_replicas(cand, lens, snaps,
-                                   priority=pri), lens
+        # LoRA adapter affinity — the Router's rule verbatim, read
+        # from the snapshot wire form's resident_adapters column
+        hits = None
+        if request.adapter is not None:
+            hits = {i: int(request.adapter
+                           in (snaps[i].get("resident_adapters") or ()))
+                    for i in cand}
+        return keys, rank_replicas(cand, lens, snaps, priority=pri,
+                                   adapter_hits=hits), lens
 
     def _poll(self, indices: Sequence[int]) -> Dict[int, dict]:
         """Load snapshots (wire → plain dict) for ``indices``; dead
@@ -584,6 +623,22 @@ class FleetController:
                 continue
             snaps[i] = snapshot_from_wire(reply["snapshot"])
         return snaps
+
+    def lora_register(self, name: str, sites, *,
+                      alpha: float = 1.0) -> None:
+        """Broadcast adapter ``name`` into every LIVE worker's LoRA
+        host store (by value — ``{site: (A, B)}`` numpy pairs cross
+        the frame codec like disagg arena records). Any worker's
+        rejection (no LoRA tier, bad geometry, store full of pinned
+        records) propagates loudly: the fleet routes any adapter
+        request to any worker, so registration must be all-or-error,
+        never a partial fleet that serves some replicas and fails
+        others."""
+        for i in self._alive_indices():
+            self.workers[i].rpc("lora_register",
+                                timeout=self.rpc_timeout_s,
+                                name=str(name), sites=sites,
+                                alpha=float(alpha))
 
     def submit(self, request: Request) -> Request:
         """Route ``request`` to the best live worker — the Router's

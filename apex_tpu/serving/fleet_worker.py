@@ -52,6 +52,7 @@ def build_engine_from_spec(spec: dict):
                     "chunk_len": 8, "prefix_pool": 4, "seed": 5,
                     "policy": "O0",     # resolved by name per process
                     # optional: paged, page_len, num_pages, top_k,
+                    # "lora": {"rank": 4, ...} → per-worker LoRAConfig,
                     "host_tier_bytes": 1 << 20}}  # → per-worker HostTier
 
     Imports live inside the function: the controller imports this
@@ -80,6 +81,12 @@ def build_engine_from_spec(spec: dict):
     tier_bytes = eng_kw.pop("host_tier_bytes", None)
     if tier_bytes is not None:
         eng_kw["host_tier"] = int(tier_bytes)
+    lora_kw = eng_kw.pop("lora", None)
+    if lora_kw is not None:
+        # the LoRA tier crosses the process boundary as a plain dict
+        # of LoRAConfig fields — resolved per process, like policy
+        from apex_tpu.serving.lora import LoRAConfig
+        eng_kw["lora"] = LoRAConfig(**dict(lora_kw))
     return Engine(m, params, policy=policy, **eng_kw)
 
 
@@ -198,6 +205,14 @@ def _handle(state: _WorkerState, msg: dict) -> Optional[dict]:
     if op == "take_handoffs":
         return {"handoffs": _export_handoffs(state)}
 
+    if op == "lora_register":
+        # adapter payloads cross as plain {site: (A, B)} numpy pairs —
+        # the same by-value discipline as disagg arena records; the
+        # engine CRCs them at rest like any local registration
+        state.engine.lora_register(msg["name"], msg["sites"],
+                                   alpha=float(msg.get("alpha", 1.0)))
+        return {"ok": True}
+
     if op == "prefix_stats":
         pc = getattr(state.engine, "prefix_cache", None)
         return {"stats": pc.stats() if pc is not None else {}}
@@ -295,14 +310,23 @@ def main(argv: Optional[List[str]] = None) -> int:
         description="apex_tpu fleet worker (spawned by "
                     "FleetController — not a user entry point)")
     ap.add_argument("--socket", required=True,
-                    help="controller's AF_UNIX socket path")
+                    help="controller's transport address: an AF_UNIX "
+                         "socket path, or tcp:host:port")
     ap.add_argument("--replica", required=True, type=int,
                     help="this worker's fleet index")
     args = ap.parse_args(argv)
 
     state = _WorkerState(args.replica)
-    conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-    conn.connect(args.socket)
+    if args.socket.startswith("tcp:"):
+        _, host, port = args.socket.split(":", 2)
+        conn = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        conn.connect((host, int(port)))
+        # the RPC frames are small and strictly request-response:
+        # never let Nagle hold a reply back
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    else:
+        conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        conn.connect(args.socket)
     import os
     send_frame(conn, {"op": "hello", "replica": state.replica,
                       "pid": os.getpid()})

@@ -1,0 +1,542 @@
+"""Multi-tenant LoRA serving: a stacked adapter arena gathered in the
+GEMM epilogue.
+
+The apex surface this repo reproduces keeps auxiliary math in a
+kernel's *epilogue* instead of multiplying executables — int8 dequant
+(PR 14) is a per-channel scale on the accumulator, not a second weight
+matrix. Multi-tenant fine-tuning gets the same treatment: a LoRA
+adapter is the low-rank residual ``y += (x @ A) @ B * alpha``, and the
+whole fleet of adapters lives in ONE stacked **device arena** per GEMM
+site — ``A`` stacked ``[layers, rows, in, rank]``, ``B`` stacked
+``[layers, rows, rank, out]`` — indexed by a **traced per-slot
+adapter-index operand**. One compiled decode/chunk/verify invocation
+gathers each batch row's ``[rank]`` slices (``A[ids]``/``B[ids]`` —
+the Punica/S-LoRA gathered-BGMV shape), so heterogeneous adapters
+decode in one batch and the adapter id is *data*, never a trace key:
+ZERO new compiled programs per adapter, and the engine's program-count
+pins do not move.
+
+Arena row 0 is the **zero adapter**: all-zero A/B, ``alpha[0] == 0``.
+A slot with no adapter binds row 0, its epilogue term is exactly
+``+0.0`` on every element, and fp32 addition of +0.0 is
+value-identical — the same pin that keeps the chaos tier's
+``fault_bias`` operand honest. That is why ``adapter=None`` requests
+on a LoRA-enabled engine are BITWISE the base engine.
+
+Above the device arena sits a :class:`~apex_tpu.serving.host_tier
+.HostTier`-style bounded **host store**: every registered adapter's
+pristine fp32 A/B matrices at rest under one CRC32, LRU-evicted under
+byte pressure — except that residency and live slot bindings
+*refcount-pin* a record (an adapter a running request gathers from can
+never be evicted out from under it). Swap-in (host → device row)
+re-verifies the CRC; a mismatch drops the record and raises loudly —
+the scheduler fails the request with a re-register hint, NEVER serves
+wrong tokens. A full arena with every row pinned degrades gracefully:
+:meth:`LoRAManager.acquire` returns None and the scheduler simply
+holds the request in queue until a binding releases.
+
+**Tensor parallelism** rides the PR 9 rule table unchanged. At the
+column-parallel sites (qkv, mlp_in) ``x`` and ``A`` stay replicated
+and ``B`` splits on its OUTPUT axis — each shard's epilogue term lands
+exactly on its local slice of the base GEMM's output (the qkv arena
+pre-applies the same head-group column permutation
+:func:`~apex_tpu.serving.sharding._group_qkv_kernel` applies to the
+base kernel, so the contiguous shard slice is the right one). At the
+row-parallel sites (proj, mlp_out) ``A`` splits on its INPUT axis —
+matching the shard-local activations — and ``B`` stays replicated, so
+the term is a partial sum the EXISTING post-proj/post-mlp psums
+restore: zero new collectives.
+
+Telemetry (all five lint-pinned to docs/serving.md):
+``serving.lora.loads`` (host→device swap-ins, CRC-verified),
+``serving.lora.hits`` (acquire satisfied by an already-resident row),
+``serving.lora.evictions`` (host or device rows evicted),
+``serving.lora.arena_bytes`` (host-store bytes at rest, gauge),
+``serving.lora.active_adapters`` (device-resident adapters, gauge).
+
+No ``decode.*`` tuned keys are introduced here: the epilogue runs
+inside the existing GEMM programs and inherits their knobs — pinned by
+the tuned-keys lint.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from apex_tpu.log_util import get_logger
+
+__all__ = ["LoRAConfig", "LoRAManager", "SITES", "lora_spec_tree"]
+
+_logger = get_logger("serving")
+
+#: The four GEMM sites an adapter may patch, in canonical (CRC) order.
+#: in/out dims as multiples of the model hidden size H:
+#: qkv H->3H (column-parallel), proj H->H (row-parallel),
+#: mlp_in H->ratio*H (column-parallel), mlp_out ratio*H->H
+#: (row-parallel).
+SITES = ("qkv", "proj", "mlp_in", "mlp_out")
+
+
+@dataclasses.dataclass(frozen=True)
+class LoRAConfig:
+    """Geometry of the LoRA tier: one fixed ``rank`` for every
+    adapter (the arena is a dense stack — rows must agree), the number
+    of device-resident ``arena_slots`` (+1 hidden zero row), and the
+    bounded host store's byte capacity."""
+
+    rank: int = 8
+    arena_slots: int = 4
+    host_bytes: int = 64 << 20
+
+    def __post_init__(self):
+        if int(self.rank) < 1:
+            raise ValueError("rank must be >= 1")
+        if int(self.arena_slots) < 1:
+            raise ValueError("arena_slots must be >= 1")
+        if int(self.host_bytes) < 1:
+            raise ValueError("host_bytes must be >= 1")
+
+
+def lora_spec_tree(axis: str):
+    """The shard_map in_specs pytree for the arena operand under a 1-D
+    ``axis`` mesh — the PR 9 split restated per stacked array (leading
+    axes are [layers, rows, ...]):
+
+    - ``qkv_b`` / ``mlp_in_b``: OUTPUT-axis split (column-parallel B —
+      the local slice of the local base output);
+    - ``proj_a`` / ``mlp_out_a``: INPUT-axis split (row-parallel A —
+      matching the shard-local activations; the existing psum restores
+      the sum);
+    - everything else (replicated A, replicated B, ``alpha``): ``P()``.
+    """
+    from jax.sharding import PartitionSpec as P
+    return {
+        "qkv_a": P(), "qkv_b": P(None, None, None, axis),
+        "proj_a": P(None, None, axis, None), "proj_b": P(),
+        "mlp_in_a": P(), "mlp_in_b": P(None, None, None, axis),
+        "mlp_out_a": P(None, None, axis, None), "mlp_out_b": P(),
+        "alpha": P(),
+    }
+
+
+def _group_qkv_cols(b: np.ndarray, tp: int) -> np.ndarray:
+    """Permute a stacked qkv-site B ``[layers, rank, 3*H]`` from the
+    natural ``(3, heads, d)`` column layout to the head-grouped
+    ``(tp, 3, heads/tp, d)`` layout — the same permutation
+    :func:`~apex_tpu.serving.sharding._group_qkv_kernel` applies to
+    the base qkv kernel, so a contiguous output-axis shard slice of
+    the arena lines up with the shard's local qkv output. Identity at
+    ``tp == 1``."""
+    if tp <= 1:
+        return b
+    L, r, out = b.shape
+    x = b.reshape(L, r, 3, tp, out // (3 * tp))
+    x = np.moveaxis(x, 2, 3)                    # [L, r, tp, 3, hl*d]
+    return np.ascontiguousarray(x.reshape(L, r, out))
+
+
+def _adapter_crc(sites: Dict[str, Tuple[np.ndarray, np.ndarray]]) -> int:
+    """One CRC32 chained over every site's A then B in canonical
+    order — strong enough that a corrupt swap-in can only read as a
+    loud reload, never as silently-wrong epilogue math."""
+    crc = 0
+    for site in SITES:
+        a, b = sites[site]
+        crc = zlib.crc32(np.ascontiguousarray(b),
+                         zlib.crc32(np.ascontiguousarray(a), crc))
+    return crc
+
+
+@dataclasses.dataclass
+class _AdapterRecord:
+    """One registered adapter at rest in the host store."""
+
+    name: str
+    sites: Dict[str, Tuple[np.ndarray, np.ndarray]]
+    alpha: float
+    nbytes: int
+    crc: int
+    last_used: int = 0
+    row: int = 0            # device arena row while resident; 0 = cold
+    refcount: int = 0       # live slot bindings
+
+
+class LoRAManager:
+    """The LoRA tier: bounded host store + stacked device arena + the
+    traced gather operand (see module docstring). Owned by the engine
+    (``Engine(lora=LoRAConfig(...))``), driven by the scheduler through
+    ``engine.lora_bind/lora_unbind``; single-threaded like the engine
+    itself (the scheduler thread is the only caller).
+
+    ``hidden``/``num_heads``/``num_layers``/``mlp_ratio`` fix the site
+    shapes; ``tp``/``mesh`` fix the arena's device sharding (a 1-D
+    ``tp`` mesh splits exactly the axes :func:`lora_spec_tree` names).
+    """
+
+    def __init__(self, config: LoRAConfig, *, hidden: int,
+                 num_heads: int, num_layers: int, mlp_ratio: int = 4,
+                 tp: int = 1, mesh=None, tp_axis: str = "tp",
+                 registry=None):
+        if not isinstance(config, LoRAConfig):
+            raise TypeError(f"config must be a LoRAConfig, got "
+                            f"{type(config).__name__}")
+        self.config = config
+        self.hidden = int(hidden)
+        self.num_heads = int(num_heads)
+        self.num_layers = int(num_layers)
+        self.mlp_ratio = int(mlp_ratio)
+        self.tp = max(int(tp), 1)
+        self._mesh = mesh
+        self._tp_axis = tp_axis
+        self._registry = registry
+        r, H = config.rank, self.hidden
+        #: full (unsharded) per-layer site shapes: site -> (in, out)
+        self.site_dims: Dict[str, Tuple[int, int]] = {
+            "qkv": (H, 3 * H), "proj": (H, H),
+            "mlp_in": (H, self.mlp_ratio * H),
+            "mlp_out": (self.mlp_ratio * H, H),
+        }
+        self.rows = int(config.arena_slots) + 1   # +1: the zero row
+        L, cap = self.num_layers, self.rows
+        #: host mirror of the device arena (row 0 stays all-zero)
+        self._mirror: Dict[str, np.ndarray] = {}
+        for site in SITES:
+            din, dout = self.site_dims[site]
+            self._mirror[f"{site}_a"] = np.zeros((L, cap, din, r),
+                                                 np.float32)
+            self._mirror[f"{site}_b"] = np.zeros((L, cap, r, dout),
+                                                 np.float32)
+        self._mirror["alpha"] = np.zeros((cap,), np.float32)
+        #: the traced arena operand — jnp leaves, re-placed on every
+        #: hot-load (same shapes/dtypes, so never a retrace)
+        self.arena = {k: self._place(k, v)
+                      for k, v in self._mirror.items()}
+        #: device row -> resident adapter name (index 0 unused)
+        self._row_names: List[Optional[str]] = [None] * cap
+        self._adapters: Dict[str, _AdapterRecord] = {}
+        self._bytes_used = 0
+        self._clock = itertools.count(1)
+        # raw counters (mirrored into serving.lora.* when a registry
+        # is attached; the class stays importable bare, HostTier-style)
+        self.loads = 0
+        self.hits = 0
+        self.evictions = 0
+        self.corruptions_detected = 0
+
+    # ------------------------------------------------------------ device side
+    def _place(self, key: str, host: np.ndarray):
+        """Device-place one arena leaf — under a mesh, with the
+        :func:`lora_spec_tree` sharding so the jitted programs never
+        reshard it."""
+        import jax
+        if self._mesh is None:
+            return jax.numpy.asarray(host)
+        from jax.sharding import NamedSharding
+        spec = lora_spec_tree(self._tp_axis)[key]
+        return jax.device_put(host, NamedSharding(self._mesh, spec))
+
+    @property
+    def arena_nbytes(self) -> int:
+        """Device arena bytes (all rows, zero row included)."""
+        return sum(v.nbytes for v in self._mirror.values())
+
+    def spec_tree(self):
+        """shard_map in_specs for the arena operand (mesh engines)."""
+        return lora_spec_tree(self._tp_axis)
+
+    # -------------------------------------------------------------- host side
+    @property
+    def bytes_used(self) -> int:
+        """Host-store bytes at rest (the bounded capacity's ledger —
+        :meth:`audit` re-derives it from the records and raises on
+        drift)."""
+        return self._bytes_used
+
+    def keys(self) -> List[str]:
+        """Registered adapter names (the chaos harness's corruption
+        target list — the :meth:`HostTier.keys` protocol)."""
+        return list(self._adapters)
+
+    def resident_names(self) -> List[str]:
+        """Device-resident adapter names, row order — the scheduler's
+        ``resident_adapters`` snapshot column (adapter affinity ranks
+        replicas by membership here)."""
+        return [n for n in self._row_names if n is not None]
+
+    def contains(self, name: str) -> bool:
+        return name in self._adapters
+
+    def _site_shapes(self, site: str) -> Tuple[Tuple[int, ...],
+                                               Tuple[int, ...]]:
+        din, dout = self.site_dims[site]
+        r = self.config.rank
+        return ((self.num_layers, din, r), (self.num_layers, r, dout))
+
+    def register(self, name: str,
+                 sites: Dict[str, Tuple[np.ndarray, np.ndarray]], *,
+                 alpha: float = 1.0) -> None:
+        """Admit adapter ``name`` into the host store: fp32-normalise
+        each site's stacked ``(A [layers, in, rank], B [layers, rank,
+        out])`` pair, CRC the lot, LRU-evict unpinned records under
+        byte pressure. Loud ``ValueError`` when the adapter alone
+        exceeds the store or every resident byte is pinned; loud on a
+        shape mismatch (the arena is a dense stack — geometry must
+        agree). Re-registering a live name replaces it only when
+        unpinned."""
+        name = str(name)
+        norm: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+        for site in SITES:
+            if site not in sites:
+                raise ValueError(f"adapter {name!r} is missing site "
+                                 f"{site!r} (all of {SITES} required)")
+            a, b = sites[site]
+            a = np.ascontiguousarray(np.asarray(a, np.float32))
+            b = np.ascontiguousarray(np.asarray(b, np.float32))
+            want_a, want_b = self._site_shapes(site)
+            if a.shape != want_a or b.shape != want_b:
+                raise ValueError(
+                    f"adapter {name!r} site {site!r} shapes "
+                    f"{a.shape}/{b.shape} do not match the arena's "
+                    f"{want_a}/{want_b} (rank={self.config.rank})")
+            norm[site] = (a, b)
+        old = self._adapters.get(name)
+        if old is not None:
+            if old.refcount or old.row:
+                raise ValueError(
+                    f"adapter {name!r} is pinned (resident or bound) "
+                    "— evict its bindings before re-registering")
+            self._drop(old)
+        nbytes = sum(a.nbytes + b.nbytes for a, b in norm.values())
+        if nbytes > self.config.host_bytes:
+            raise ValueError(
+                f"adapter {name!r} ({nbytes} bytes) exceeds the host "
+                f"store ({self.config.host_bytes} bytes)")
+        while self._bytes_used + nbytes > self.config.host_bytes:
+            if not self._evict_host_lru():
+                raise ValueError(
+                    f"host store full registering {name!r}: every "
+                    f"resident adapter is pinned by a live binding")
+        self._adapters[name] = _AdapterRecord(
+            name=name, sites=norm, alpha=float(alpha), nbytes=nbytes,
+            crc=_adapter_crc(norm), last_used=next(self._clock))
+        self._bytes_used += nbytes
+        self._emit_gauges()
+
+    def _drop(self, rec: _AdapterRecord) -> None:
+        """Remove ``rec`` from the store (and its arena row name, if
+        resident) — accounting only, no counters."""
+        if rec.row:
+            self._row_names[rec.row] = None
+            rec.row = 0
+        del self._adapters[rec.name]
+        self._bytes_used -= rec.nbytes
+
+    def _evict_host_lru(self) -> bool:
+        """Evict the least-recently-used UNPINNED record from the host
+        store (a resident-but-unbound adapter loses its row too).
+        False when everything is pinned."""
+        victims = [r for r in self._adapters.values()
+                   if r.refcount == 0]
+        if not victims:
+            return False
+        victim = min(victims, key=lambda r: r.last_used)
+        self._drop(victim)
+        self.evictions += 1
+        if self._registry is not None:
+            self._registry.counter_inc("serving.lora.evictions")
+        _logger.debug("lora host store evicted adapter %r "
+                      "(capacity pressure)", victim.name)
+        self._emit_gauges()
+        return True
+
+    # ------------------------------------------------------------ swap in/out
+    def acquire(self, name: str) -> Optional[int]:
+        """Pin adapter ``name`` for one slot binding and return its
+        arena row. Already-resident → a hit (refcount++). Cold → swap
+        in: CRC-verify the host bytes (a mismatch DROPS the record and
+        raises ``KeyError`` with a re-register hint — the loud-reload
+        contract), claim a free row or evict the LRU unbound resident,
+        write the row. Returns None — pinning nothing — when every row
+        holds a bound adapter (pool-full graceful degradation: the
+        caller holds the request queued)."""
+        rec = self._adapters.get(str(name))
+        if rec is None:
+            raise KeyError(f"adapter {name!r} is not registered")
+        rec.last_used = next(self._clock)
+        if rec.row:
+            rec.refcount += 1
+            self.hits += 1
+            if self._registry is not None:
+                self._registry.counter_inc("serving.lora.hits")
+            return rec.row
+        if _adapter_crc(rec.sites) != rec.crc:
+            self.corruptions_detected += 1
+            self._drop(rec)
+            self._emit_gauges()
+            _logger.warning(
+                "lora adapter %r failed its swap-in checksum — record "
+                "dropped; re-register to reload", name)
+            raise KeyError(
+                f"adapter {name!r} failed its swap-in checksum — the "
+                "record was dropped; re-register it to reload")
+        row = self._claim_row()
+        if row is None:
+            return None
+        self._write_row(row, rec)
+        rec.row, rec.refcount = row, rec.refcount + 1
+        self._row_names[row] = rec.name
+        self.loads += 1
+        if self._registry is not None:
+            self._registry.counter_inc("serving.lora.loads")
+        self._emit_gauges()
+        return row
+
+    def release(self, row: int) -> None:
+        """Drop one slot binding on arena row ``row``. The adapter
+        STAYS resident at refcount 0 (that is the cache — the next
+        acquire is a hit); only a later swap-in or host eviction
+        reclaims the row."""
+        row = int(row)
+        name = self._row_names[row] if 0 < row < self.rows else None
+        if name is None:
+            raise ValueError(f"arena row {row} holds no adapter")
+        rec = self._adapters[name]
+        if rec.refcount <= 0:
+            raise ValueError(f"adapter {name!r} released below zero")
+        rec.refcount -= 1
+
+    def release_all(self) -> None:
+        """Zero every binding (engine reset) — residency survives."""
+        for rec in self._adapters.values():
+            rec.refcount = 0
+
+    def _claim_row(self) -> Optional[int]:
+        """A free arena row, evicting the LRU resident-but-unbound
+        adapter if none is free; None when every row is bound."""
+        for row in range(1, self.rows):
+            if self._row_names[row] is None:
+                return row
+        victims = [self._adapters[n] for n in self._row_names[1:]
+                   if n is not None
+                   and self._adapters[n].refcount == 0]
+        if not victims:
+            return None
+        victim = min(victims, key=lambda r: r.last_used)
+        row = victim.row
+        self._row_names[row] = None
+        victim.row = 0
+        self.evictions += 1
+        if self._registry is not None:
+            self._registry.counter_inc("serving.lora.evictions")
+        _logger.debug("lora arena evicted adapter %r from row %d",
+                      victim.name, row)
+        return row
+
+    def _write_row(self, row: int, rec: _AdapterRecord) -> None:
+        """Write ``rec``'s site matrices into arena row ``row`` (host
+        mirror + device re-place — eager data movement, no counted
+        program bodies, so the engine's program-count pins cannot
+        move). The qkv B block is stored head-group-permuted so a
+        contiguous tp shard slice is the correct one."""
+        for site in SITES:
+            a, b = rec.sites[site]
+            if site == "qkv":
+                b = _group_qkv_cols(b, self.tp)
+            self._mirror[f"{site}_a"][:, row] = a
+            self._mirror[f"{site}_b"][:, row] = b
+        self._mirror["alpha"][row] = rec.alpha
+        for site in SITES:
+            for half in ("a", "b"):
+                key = f"{site}_{half}"
+                self.arena[key] = self._place(key, self._mirror[key])
+        self.arena["alpha"] = self._place("alpha",
+                                          self._mirror["alpha"])
+
+    # ----------------------------------------------------------- chaos / audit
+    def corrupt_entry(self, name: str, *, byte_index: int = 0) -> None:
+        """CHAOS/DEBUG ONLY: flip one byte of the stored first-site A
+        block so the next cold :meth:`acquire` fails its checksum —
+        the ``swap_corruption`` injection primitive for adapter
+        records (the :meth:`HostTier.corrupt_entry` protocol). Raises
+        KeyError when absent."""
+        rec = self._adapters[str(name)]
+        flat = rec.sites[SITES[0]][0].reshape(-1).view(np.uint8)
+        flat[int(byte_index) % flat.size] ^= 0xFF
+
+    def audit(self, bound_rows: Optional[Dict[int, int]] = None) -> dict:
+        """The arena's refcount audit: re-derive the host-store byte
+        ledger from the records, cross-check row<->record residency
+        both ways, and — when the engine passes its live slot bindings
+        as ``bound_rows`` (row -> binding count) — demand the
+        refcounts match exactly. Raises ``RuntimeError`` on any drift;
+        returns the reconciled stats dict."""
+        derived = sum(r.nbytes for r in self._adapters.values())
+        if derived != self._bytes_used:
+            raise RuntimeError(
+                f"lora host-store byte ledger drifted: derived "
+                f"{derived}, ledger {self._bytes_used}")
+        for row, name in enumerate(self._row_names):
+            if name is None:
+                continue
+            rec = self._adapters.get(name)
+            if rec is None or rec.row != row:
+                raise RuntimeError(
+                    f"lora arena row {row} names {name!r} but the "
+                    "record disagrees")
+        for rec in self._adapters.values():
+            if rec.row and self._row_names[rec.row] != rec.name:
+                raise RuntimeError(
+                    f"adapter {rec.name!r} claims row {rec.row} but "
+                    "the row disagrees")
+            if rec.refcount and not rec.row:
+                raise RuntimeError(
+                    f"adapter {rec.name!r} has {rec.refcount} "
+                    "bindings but no arena row")
+        if bound_rows is not None:
+            for rec in self._adapters.values():
+                want = int(bound_rows.get(rec.row, 0)) if rec.row \
+                    else 0
+                if rec.refcount != want:
+                    raise RuntimeError(
+                        f"adapter {rec.name!r} refcount "
+                        f"{rec.refcount} != {want} live slot "
+                        "bindings")
+            extra = set(bound_rows) - {r.row for r in
+                                       self._adapters.values() if r.row}
+            if extra:
+                raise RuntimeError(
+                    f"slots bound to arena rows {sorted(extra)} that "
+                    "hold no adapter")
+        return self.stats()
+
+    def stats(self) -> dict:
+        return {
+            "adapters": len(self._adapters),
+            "resident": len(self.resident_names()),
+            "bytes_used": self._bytes_used,
+            "host_bytes": self.config.host_bytes,
+            "arena_nbytes": self.arena_nbytes,
+            "loads": self.loads,
+            "hits": self.hits,
+            "evictions": self.evictions,
+            "corruptions_detected": self.corruptions_detected,
+        }
+
+    def _emit_gauges(self) -> None:
+        if self._registry is None:
+            return
+        self._registry.gauge_set("serving.lora.arena_bytes",
+                                 float(self._bytes_used))
+        self._registry.gauge_set("serving.lora.active_adapters",
+                                 float(len(self.resident_names())))
+
+    def set_registry(self, registry) -> None:
+        """(Re)attach a metrics registry (the engine's
+        ``set_registry`` pass-through) and refresh the gauges."""
+        self._registry = registry
+        self._emit_gauges()

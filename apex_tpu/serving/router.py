@@ -390,8 +390,16 @@ class Router:
         # arithmetic both routing fronts reproduce identically
         pri = self._slo.base_priority(request) \
             if self._slo is not None else 0
-        return keys, rank_replicas(alive, lens, snaps,
-                                   priority=pri), lens
+        # LoRA adapter affinity: a replica whose device arena already
+        # holds the request's adapter serves a bind as a hit, not a
+        # swap-in — ranked right after the prefix match
+        hits = None
+        if request.adapter is not None:
+            hits = {i: int(request.adapter
+                           in (snaps[i].get("resident_adapters") or ()))
+                    for i in alive}
+        return keys, rank_replicas(alive, lens, snaps, priority=pri,
+                                   adapter_hits=hits), lens
 
     def submit(self, request: Request) -> Request:
         """Route ``request`` to the best live replica (see module

@@ -50,10 +50,13 @@ PLACEMENTS_CAP = 65536
 def rank_replicas(candidates: Sequence[int],
                   match_lens: Mapping[int, int],
                   snapshots: Mapping[int, Mapping],
-                  priority: int = 0) -> List[int]:
+                  priority: int = 0,
+                  adapter_hits: Optional[Mapping[int, int]] = None,
+                  ) -> List[int]:
     """The candidate replicas best-first: longest probed prefix match,
-    then free slots (desc), queue depth (asc), free pool pages (desc),
-    host-arena headroom (desc), index (the deterministic last resort).
+    then resident-adapter hit (desc — see below), then free slots
+    (desc), queue depth (asc), free pool pages (desc), host-arena
+    headroom (desc), index (the deterministic last resort).
     ``snapshots[i]`` is a :meth:`Scheduler.load_snapshot` dict — or its
     wire form: the key set is part of the snapshot's versioned wire
     contract, so both fronts rank on identical fields. ``pages_free``
@@ -68,9 +71,19 @@ def rank_replicas(candidates: Sequence[int],
     as free: a prioritized arrival ranks a preemption-rich replica as
     having that headroom NOW. Priority-0 requests (and snapshots
     predating the field — ``.get`` tolerates both wire v1 and literal
-    test dicts) rank exactly as before."""
+    test dicts) rank exactly as before.
+
+    ``adapter_hits`` is the LoRA-affinity signal: ``adapter_hits[i]``
+    is 1 when the routed request's adapter is resident in replica
+    ``i``'s device arena (its snapshot's ``resident_adapters``
+    membership — a bind there is a hit, elsewhere a swap-in), 0
+    otherwise. Ranked right after the prefix match and before free
+    slots: re-homing a resident adapter costs a full arena row
+    re-place, more than a slot's worth of queueing. None (base-model
+    requests, LoRA-less fleets) ranks exactly as before."""
     return sorted(candidates, key=lambda i: (
         -match_lens[i],
+        -(adapter_hits[i] if adapter_hits is not None else 0),
         -snapshots[i]["slots_free"],
         snapshots[i]["queue_depth"],
         -((snapshots[i]["pages_free"] or 0)

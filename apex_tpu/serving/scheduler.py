@@ -291,6 +291,14 @@ class Request:
     slo_class: Optional[str] = None
     deadline_s: Optional[float] = None
     tenant: Optional[str] = None
+    # multi-tenant LoRA: the adapter this request decodes under (a
+    # name previously registered with the engine's adapter arena), or
+    # None for the base model. Admission binds the adapter to the slot
+    # (refcount-pinning it resident) before pages are reserved;
+    # ``_free_slot`` is the single unbind point. An unknown name fails
+    # the request loudly at admission — never a silent base-model
+    # fallback
+    adapter: Optional[str] = None
 
     # filled in by the scheduler
     output_tokens: List[int] = dataclasses.field(default_factory=list)
@@ -355,10 +363,11 @@ class Request:
 # cross: ``time.perf_counter`` bases are per-process, so a shipped
 # clock would be meaningless on arrival — each side stamps its own.
 
-REQUEST_WIRE_VERSION = 2    # v2: SLO fields (priority/slo_class/
+REQUEST_WIRE_VERSION = 3    # v2: SLO fields (priority/slo_class/
 #                             deadline_s/tenant in; preemptions/
-#                             deadline_missed out)
-SNAPSHOT_WIRE_VERSION = 2   # v2: oldest_deadline_s/preemptible_pages
+#                             deadline_missed out); v3: adapter
+SNAPSHOT_WIRE_VERSION = 3   # v2: oldest_deadline_s/preemptible_pages;
+#                             v3: resident_adapters
 
 #: The load-snapshot key set — part of the versioned wire contract
 #: (routing_policy ranks on these fields, so both fronts must see the
@@ -369,11 +378,14 @@ SNAPSHOT_WIRE_VERSION = 2   # v2: oldest_deadline_s/preemptible_pages
 #: ``preemptible_pages`` (pages held by running requests strictly
 #: below the SLO config's top class — the headroom a top-priority
 #: arrival could reclaim; None when SLO scheduling is off or the
-#: engine is not paged).
+#: engine is not paged). v3 adds ``resident_adapters`` (the adapter
+#: names currently resident in the engine's LoRA arena — the
+#: adapter-affinity signal, ranked by routing_policy right after the
+#: prefix-affinity match; None when LoRA serving is off).
 _SNAPSHOT_KEYS = ("queue_depth", "queue_free", "slots", "slots_busy",
                   "slots_free", "inflight_steps", "pages_free",
                   "host_bytes_free", "oldest_deadline_s",
-                  "preemptible_pages")
+                  "preemptible_pages", "resident_adapters")
 
 
 def request_to_wire(request: Request) -> dict:
@@ -392,6 +404,7 @@ def request_to_wire(request: Request) -> dict:
         "slo_class": request.slo_class,
         "deadline_s": request.deadline_s,
         "tenant": request.tenant,
+        "adapter": request.adapter,
         "output_tokens": [int(t) for t in request.output_tokens],
         "status": request.status.value,
         "finish_reason": request.finish_reason,
@@ -431,6 +444,7 @@ def request_from_wire(wire: dict) -> Request:
         slo_class=wire["slo_class"],
         deadline_s=wire["deadline_s"],
         tenant=wire["tenant"],
+        adapter=wire["adapter"],
         output_tokens=list(wire["output_tokens"]),
         status=RequestStatus(wire["status"]),
         finish_reason=wire["finish_reason"],
@@ -739,6 +753,12 @@ class Scheduler:
                 "program cannot admit it")
         if request.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if request.adapter is not None \
+                and getattr(self.engine, "lora", None) is None:
+            raise ValueError(
+                f"request names adapter {request.adapter!r} but the "
+                "engine was built without lora=LoRAConfig(...) — "
+                "LoRA serving is off")
         if self.slo is not None:
             # validates slo_class loudly (unknown names raise here, at
             # the door, instead of silently scheduling as priority 0)
@@ -866,6 +886,12 @@ class Scheduler:
             # the slot no longer reads from its donor prefix: unpin
             self.engine.prefix_cache.release(self._slot_prefix[slot])
             self._slot_prefix[slot] = None
+        if getattr(self.engine, "lora", None) is not None:
+            # the single LoRA unbind point: drops the slot's adapter
+            # refcount (the adapter STAYS resident for affinity — only
+            # arena pressure evicts it). Not in Engine.release_slot,
+            # which cold-start prefill calls mid-request
+            self.engine.lora_unbind(slot)
         if getattr(self.engine, "paged", False):
             self.engine.release_slot(slot)
 
@@ -1090,13 +1116,45 @@ class Scheduler:
             idx = self._eligible_index(time.perf_counter())
             if idx is None:
                 break               # everything queued is backing off
+            gate = self._lora_gate(slot, idx)
+            if gate == "failed":
+                continue            # the queue changed: re-scan
+            if gate == "blocked":
+                break               # every arena row pinned: FIFO
+                #                     holds until a finish unbinds one
             if not self._reserve_pages(slot, self._queue[idx]):
                 # pool exhausted for the first eligible request: stop
                 # admitting (FIFO — later, smaller requests must not
                 # starve it); finishing requests release pages, so the
                 # next beat retries
+                if getattr(self.engine, "lora", None) is not None:
+                    self.engine.lora_unbind(slot)
                 break
             self._admit_one(slot, idx)
+
+    def _lora_gate(self, slot: int, idx: int) -> str:
+        """Admission-time LoRA bind for queue position ``idx`` into
+        ``slot`` — runs BEFORE the page reservation so a blocked bind
+        never strands reserved pages. Returns ``"ok"`` (bound, or a
+        base-model request — nothing to do), ``"blocked"`` (the
+        adapter is absent and every arena row is pinned by a running
+        slot: the caller stops admitting; finishes unbind rows and the
+        next beat retries) or ``"failed"`` (the adapter is unknown to
+        the arena or failed its swap-in checksum: the request fails
+        LOUDLY here — removed from the queue, FAILED, error recorded —
+        never a silent base-model fallback)."""
+        r = self._queue[idx]
+        if r.adapter is None \
+                or getattr(self.engine, "lora", None) is None:
+            return "ok"
+        try:
+            bound = self.engine.lora_bind(slot, r.adapter)
+        except KeyError as e:
+            del self._queue[idx]
+            r.error = str(e.args[0]) if e.args else str(e)
+            self._finish(r, "fault", status=RequestStatus.FAILED)
+            return "failed"
+        return "ok" if bound else "blocked"
 
     def _admit_one(self, slot: int, idx: int) -> None:
         """Admit queue position ``idx`` into free ``slot`` (pages
@@ -1163,11 +1221,19 @@ class Scheduler:
                     return
                 continue        # a slot just freed: re-scan (the
                 #                 candidate set may have re-ranked)
+            gate = self._lora_gate(slot, idx)
+            if gate == "failed":
+                continue        # the queue changed: re-rank
+            if gate == "blocked":
+                return          # every arena row pinned: admission
+                #                 holds until a finish unbinds one
             if not self._reserve_pages(slot, cand):
                 # pool exhausted: preempting releases the victim's
                 # pages (swap-out frees them at dispatch; a resident
                 # retention frees them through try_reserve_slot's LRU
                 # valve on the retry)
+                if getattr(self.engine, "lora", None) is not None:
+                    self.engine.lora_unbind(slot)
                 if not self._try_preempt(cand, now):
                     return
                 continue
@@ -1489,8 +1555,15 @@ class Scheduler:
                 idx = self._eligible_index(time.perf_counter())
                 if idx is None:
                     return          # everything queued is backing off
+                gate = self._lora_gate(slot, idx)
+                if gate == "failed":
+                    continue        # the queue changed: re-scan
+                if gate == "blocked":
+                    return          # arena rows all pinned: keep FIFO
                 if not self._reserve_pages(slot, self._queue[idx],
                                            monolithic=True):
+                    if getattr(self.engine, "lora", None) is not None:
+                        self.engine.lora_unbind(slot)
                     return          # pool exhausted: keep FIFO, retry later
                 r = self._queue[idx]
                 del self._queue[idx]
@@ -2641,6 +2714,11 @@ class Scheduler:
             else tier.capacity_bytes - tier.bytes_used,
             "oldest_deadline_s": oldest,
             "preemptible_pages": preemptible,
+            # adapter affinity: the names resident in the device
+            # arena (a bind is a hit, not a swap-in), None when LoRA
+            # serving is off
+            "resident_adapters": self.engine.resident_adapters()
+            if getattr(self.engine, "lora", None) is not None else None,
         }
 
     def drain_requests(self) -> List[Request]:

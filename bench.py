@@ -85,7 +85,13 @@ preemptive-scheduling leg — the same seeded mixed-class stream at
 (interactive TTFT p50/p99 both modes, per-class deadline-miss rate
 against one FIFO-calibrated threshold, met-deadline goodput,
 preempt/resume churn, bitwise exactness vs the FIFO serve) — via
-``bench_serving.overload_stats``, and a nested ``process_fleet``
+``bench_serving.overload_stats``, and a nested ``lora`` sub-object
+(BENCH_SERVING_LORA=0 to drop it): the multi-tenant adapter leg —
+the mixed-tenant stream heterogeneously batched vs per-adapter
+sequential at identical geometry (tokens/s + speedup, adapter churn
++ warm-bind rate, zero recompiles for N adapters, bitwise
+exactness between batch compositions) — via
+``bench_serving.lora_stats``, and a nested ``process_fleet``
 sub-object (BENCH_SERVING_FLEET=0 to drop it;
 BENCH_SERVING_REPLICAS sizes the fleet): the out-of-process worker
 fleet — 1 worker vs N separate OS processes behind the stdlib
@@ -290,6 +296,15 @@ _SERVING_FLEET_SMOKE = {
     "WINDOWS": 1, "PREFIX_POOL": 4,
 }
 
+# The multi-tenant LoRA sub-leg's smoke geometry (the mixed-tenant
+# stream is served TWICE — heterogeneously batched, then per-adapter
+# sequential — on identically-built engines, so it is sized small).
+# BENCH_SERVING_LORA_ADAPTERS et al. still win, env-beats-smoke.
+_SERVING_LORA_SMOKE = {
+    "SIZE": "tiny", "VOCAB": 512, "SLOTS": 4, "MAX_LEN": 128,
+    "PREFILL_LEN": 32, "REQUESTS": 8, "NEW_TOKENS": 12, "WINDOWS": 1,
+}
+
 
 def _serving_leg() -> dict:
     """The serving trajectory row (ROADMAP: bench_serving.py had no
@@ -320,6 +335,7 @@ def _serving_leg() -> dict:
         out["replica_router"] = _serving_router_leg()
         out["disaggregated"] = _serving_disagg_leg()
         out["overload"] = _serving_overload_leg()
+        out["lora"] = _serving_lora_leg()
         out["process_fleet"] = _serving_process_fleet_leg()
         out["host_tier"] = _serving_host_tier_leg()
         return out
@@ -632,6 +648,36 @@ def _serving_overload_leg() -> dict:
             "deadline_rejected", "token_exact_vs_fifo",
             "token_mismatched_requests", "deadline_pct_of_fifo_wall",
             "overload_factor", "model")}
+    except KeyboardInterrupt:
+        raise
+    except BaseException as e:  # noqa: BLE001 — the row must not die here
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def _serving_lora_leg() -> dict:
+    """The multi-tenant LoRA trajectory sub-row: smoke-sized adapter
+    summary (the mixed-tenant stream heterogeneously batched vs
+    per-adapter sequential at identical geometry — tokens/s both
+    modes + speedup_x, adapter churn + warm-bind rate, arena/host
+    occupancy, zero recompiles after warmup, bitwise exactness
+    between batch compositions) from ``bench_serving.lora_stats``.
+    BENCH_SERVING_LORA=0 drops it; failure-isolated like its
+    siblings — a broken adapter tier yields {"error": ...} here,
+    never a lost serving (or ResNet) row."""
+    if _env_int("BENCH_SERVING_LORA", "1") == 0:
+        return {"skipped": True}
+    try:
+        import bench_serving
+
+        bench_serving._load_env(smoke=dict(_SERVING_LORA_SMOKE))
+        _, summary = bench_serving.lora_stats()
+        return {k: summary[k] for k in (
+            "value", "unit", "baseline_tokens_per_s", "speedup_x",
+            "token_mismatched_requests", "adapters", "rank",
+            "arena_slots", "lora_hits", "lora_loads",
+            "lora_evictions", "warm_bind_rate", "arena_bytes",
+            "active_adapters", "compiled_programs",
+            "recompiles_after_warmup", "model")}
     except KeyboardInterrupt:
         raise
     except BaseException as e:  # noqa: BLE001 — the row must not die here

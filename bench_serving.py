@@ -332,6 +332,32 @@ histograms in the merged snapshot carry the per-process view.
 Defaults to the router leg's smoke geometry; env knobs resize it
 (env-beats-smoke).
 
+``--lora`` runs the multi-tenant adapter leg: a seeded stream cycling
+through ``BENCH_SERVING_LORA_ADAPTERS`` registered LoRA adapters plus
+the base model, served twice at IDENTICAL engine geometry — **mixed**
+(one ``Engine(lora=LoRAConfig(...))`` scheduler run, every slot
+wearing its own adapter inside one heterogeneous batch) and
+**sequential** (the naive baseline: the SAME request set partitioned
+by adapter and each group drained alone — what an
+engine-per-adapter deployment degenerates to at batch level). One row
+per mode plus a final line whose payoff fields are mixed vs
+sequential tokens/s (+ ``speedup_x`` — batch-level parallelism the
+sequential baseline forfeits), the ``serving.lora.*`` churn columns
+(``lora_hits`` / ``lora_loads`` / ``lora_evictions`` over the
+measured windows and ``warm_bind_rate`` — the adapter-affinity
+payoff reading), ``arena_bytes`` / ``active_adapters`` (the host
+store and device arena occupancy), ``recompiles_after_warmup``
+(expected **0**: admitting N adapters compiles NOTHING — the traced
+adapter-index operand is the whole point), and
+``token_mismatched_requests`` mixed vs sequential — expected 0
+**bitwise** (per-slot adapter isolation: a slot's tokens depend only
+on ITS adapter row, never on its batch neighbours'). CPU regime
+note: the skinny epilogue GEMMs cost relatively more here than their
+``rank/hidden`` silicon share, so judge tokens/s deltas on TPU rows
+— the compile-count, churn and bitwise columns are the CPU-honest
+claims. Defaults to a smoke geometry; env knobs resize it
+(env-beats-smoke).
+
 Wrapped in ``guard_bench_main`` — EVERY outcome (backend init failure,
 OOM, bad env) still ends in a parseable JSON line.
 """
@@ -361,6 +387,7 @@ HOST_METRIC = "serving_host_tier_tokens_per_sec"
 DISAGG_METRIC = "serving_disagg_tokens_per_sec"
 FLEET_METRIC = "serving_process_fleet_tokens_per_sec"
 OVERLOAD_METRIC = "serving_overload_goodput_tokens_per_sec"
+LORA_METRIC = "serving_multi_tenant_lora_tokens_per_sec"
 
 # Literal defaults at import time; the BENCH_SERVING_* env overrides are
 # parsed by _load_env() INSIDE each guarded main, so a malformed value
@@ -514,6 +541,21 @@ HOST_SMOKE = {"SIZE": "tiny", "VOCAB": 512, "SLOTS": 2, "MAX_LEN": 512,
               "NEW_TOKENS": 6, "WINDOWS": 3, "SHARED_PREFIX": 96,
               "PREFIX_POOL": 4}
 
+# --lora leg: distinct registered adapters the stream cycles through
+# (every (N+1)th request serves the BASE model — row 0, the zero
+# adapter), the adapter rank, and the device-arena rows (0 -> one row
+# per adapter: the warm-arena reading; set it BELOW the adapter count
+# to measure eviction churn instead). The leg serves the SAME seeded
+# stream twice on identically-built engines — mixed (one
+# heterogeneous batch) then sequential (per-adapter groups drained
+# alone) — so it is sized small.
+LORA_ADAPTERS = 3
+LORA_RANK = 4
+LORA_ARENA = 0
+LORA_SMOKE = {"SIZE": "tiny", "VOCAB": 512, "SLOTS": 4, "MAX_LEN": 128,
+              "PREFILL_LEN": 32, "REQUESTS": 8, "NEW_TOKENS": 12,
+              "WINDOWS": 1}
+
 _ENV_KNOBS = {
     "VOCAB": "BENCH_SERVING_VOCAB", "SLOTS": "BENCH_SERVING_SLOTS",
     "MAX_LEN": "BENCH_SERVING_MAX_LEN",
@@ -538,6 +580,9 @@ _ENV_KNOBS = {
     "HOST_TIER_MIB": "BENCH_SERVING_HOST_TIER_MIB",
     "HOST_TIER_TP": "BENCH_SERVING_HOST_TIER_TP",
     "OVERLOAD_DEADLINE_PCT": "BENCH_SERVING_OVERLOAD_DL_PCT",
+    "LORA_ADAPTERS": "BENCH_SERVING_LORA_ADAPTERS",
+    "LORA_RANK": "BENCH_SERVING_LORA_RANK",
+    "LORA_ARENA": "BENCH_SERVING_LORA_ARENA",
 }
 
 
@@ -3129,6 +3174,184 @@ def main_overload():
     print(json.dumps(summary))
 
 
+def _lora_adapter_sites(seed: int):
+    """Seeded per-site stacked A/B matrices matching the leg model's
+    projection geometry (the register-time shape contract): A is
+    ``[layers, d_in, rank]``, B ``[layers, rank, d_out]`` per GEMM
+    site. Scaled small so adapted logits stay near the base model's —
+    the realistic fine-tune regime, and the one where a sign error in
+    the epilogue would still flip greedy tokens loudly."""
+    from apex_tpu.models.transformer_lm import create_lm
+
+    model = create_lm(SIZE, vocab_size=VOCAB, max_seq_len=MAX_LEN)
+    h, layers = model.hidden, model.num_layers
+    inner = model.mlp_ratio * h
+    rng = np.random.default_rng(seed)
+    dims = {"qkv": (h, 3 * h), "proj": (h, h),
+            "mlp_in": (h, inner), "mlp_out": (inner, h)}
+    return {site: (0.05 * rng.standard_normal(
+                       (layers, d_in, LORA_RANK)).astype(np.float32),
+                   0.05 * rng.standard_normal(
+                       (layers, LORA_RANK, d_out)).astype(np.float32))
+            for site, (d_in, d_out) in dims.items()}
+
+
+def _lora_requests(rng, names):
+    """The mixed-tenant stream: adapter assignment cycles through the
+    base model (``adapter=None``) plus every registered adapter, so a
+    full batch is maximally heterogeneous."""
+    from apex_tpu.serving import Request
+
+    cycle = [None] + list(names)
+    reqs = []
+    for i in range(REQUESTS):
+        n = int(rng.integers(1, PREFILL_LEN + 1))
+        budget = max(1, min(NEW_TOKENS, MAX_LEN - n))
+        reqs.append(Request(
+            prompt=rng.integers(1, VOCAB, size=n).tolist(),
+            max_new_tokens=budget, adapter=cycle[i % len(cycle)]))
+    return reqs
+
+
+def _serve_lora(mixed: bool, names):
+    """WINDOWS measured windows (plus compile warmup) of the mixed-
+    tenant stream on a fresh LoRA engine. ``mixed`` drains the whole
+    window in ONE scheduler run (heterogeneous batches); the baseline
+    partitions the SAME request list by adapter and drains each group
+    alone — identical requests, identical geometry, only batch
+    composition differs. Returns the rate, the measured requests (in
+    stream order — the bitwise-compare key), the engine, the
+    ``serving.lora.*`` counter deltas past warmup, and the number of
+    programs compiled AFTER warmup (the zero-recompile claim)."""
+    from apex_tpu import serving
+    from apex_tpu.serving import LoRAConfig
+
+    arena = LORA_ARENA or len(names)
+    engine = _build_engine(lora=LoRAConfig(
+        rank=LORA_RANK, arena_slots=arena, host_bytes=64 << 20))
+    for i, name in enumerate(names):
+        engine.lora_register(name, _lora_adapter_sites(100 + i),
+                             alpha=0.5)
+    rng = np.random.default_rng(11)
+    rates, all_reqs = [], []
+    warm_stats, warm_programs = {}, 0
+    for w in range(WINDOWS + 1):
+        engine.reset()          # adapter residency survives (warm arena)
+        if w == 1:
+            warm_stats = dict(engine.lora.stats())
+            warm_programs = engine.compiled_programs
+        reqs = _lora_requests(rng, names)
+        if mixed:
+            groups = [reqs]
+        else:
+            groups = [[r for r in reqs if r.adapter == a]
+                      for a in [None] + list(names)]
+            groups = [g for g in groups if g]
+        t0 = time.perf_counter()
+        tok0 = engine.tokens_generated
+        for grp in groups:
+            sched = serving.Scheduler(engine,
+                                      max_queue=max(REQUESTS, 1),
+                                      chunk_budget=CHUNK_BUDGET)
+            done = sched.run(list(grp))
+            assert len(done) == len(grp)
+        dt = time.perf_counter() - t0
+        toks = engine.tokens_generated - tok0
+        if w > 0:
+            rates.append(toks / dt)
+            all_reqs.extend(reqs)
+    end = engine.lora.stats()
+    delta = {k: end[k] - warm_stats.get(k, 0)
+             for k in ("hits", "loads", "evictions")}
+    return (_median(rates), all_reqs, engine, delta,
+            engine.compiled_programs - warm_programs)
+
+
+def lora_stats():
+    """The --lora measurement, reusable by bench.py's serving
+    trajectory leg: the mixed-tenant stream served heterogeneously
+    batched vs per-adapter sequential at identical geometry. Headline
+    fields: tokens/s both modes + ``speedup_x``, the adapter churn
+    columns (``warm_bind_rate`` is the affinity-routing payoff
+    reading), arena/host-store occupancy, ``recompiles_after_warmup``
+    (expected 0 — N adapters, zero new programs), and
+    ``token_mismatched_requests`` (expected 0 — per-slot isolation is
+    bitwise, so batch composition moves no token)."""
+    names = [f"tenant-{i}" for i in range(LORA_ADAPTERS)]
+    rows, outputs = {}, {}
+    for mode in ("mixed", "sequential"):
+        rate, reqs, engine, churn, recompiles = _serve_lora(
+            mode == "mixed", names)
+        ttfts = [r.ttft_s for r in reqs if r.ttft_s]
+        binds = churn["hits"] + churn["loads"]
+        stats = engine.lora.stats()
+        rows[mode] = {
+            "metric": f"{LORA_METRIC}.{mode}",
+            "value": round(rate, 2),
+            "unit": "tokens/s",
+            "ttft_p50_ms": round(
+                float(np.percentile(ttfts, 50)) * 1e3, 3)
+            if ttfts else 0.0,
+            "ttft_p99_ms": round(
+                float(np.percentile(ttfts, 99)) * 1e3, 3)
+            if ttfts else 0.0,
+            "lora_hits": churn["hits"],
+            "lora_loads": churn["loads"],
+            "lora_evictions": churn["evictions"],
+            "warm_bind_rate": round(churn["hits"] / binds, 4)
+            if binds else 0.0,
+            "arena_bytes": stats["bytes_used"],
+            "active_adapters": stats["resident"],
+            "compiled_programs": engine.compiled_programs,
+            "recompiles_after_warmup": recompiles,
+        }
+        outputs[mode] = [list(r.output_tokens) for r in reqs]
+    mismatched = sum(a != b for a, b in zip(outputs["mixed"],
+                                            outputs["sequential"]))
+    mx, sq = rows["mixed"], rows["sequential"]
+    summary = {
+        "metric": LORA_METRIC,
+        "value": mx["value"],
+        "unit": "tokens/s",
+        "baseline_tokens_per_s": sq["value"],
+        "speedup_x": round(mx["value"] / sq["value"], 3)
+        if sq["value"] else 0.0,
+        "token_mismatched_requests": mismatched,
+        "adapters": LORA_ADAPTERS,
+        "rank": LORA_RANK,
+        "arena_slots": LORA_ARENA or LORA_ADAPTERS,
+        "lora_hits": mx["lora_hits"],
+        "lora_loads": mx["lora_loads"],
+        "lora_evictions": mx["lora_evictions"],
+        "warm_bind_rate": mx["warm_bind_rate"],
+        "arena_bytes": mx["arena_bytes"],
+        "active_adapters": mx["active_adapters"],
+        "compiled_programs": mx["compiled_programs"],
+        "recompiles_after_warmup": mx["recompiles_after_warmup"],
+        "ttft_p50_ms": mx["ttft_p50_ms"],
+        "ttft_p99_ms": mx["ttft_p99_ms"],
+        "ttft_p50_ms_sequential": sq["ttft_p50_ms"],
+        "ttft_p99_ms_sequential": sq["ttft_p99_ms"],
+        "windows": WINDOWS,
+        "requests_per_window": REQUESTS,
+        "slots": SLOTS,
+        "model": SIZE,
+    }
+    return rows, summary
+
+
+def main_lora():
+    import jax
+
+    _load_env(smoke=dict(LORA_SMOKE))
+
+    rows, summary = lora_stats()
+    for mode in ("mixed", "sequential"):
+        print(json.dumps(rows[mode]))
+    summary["backend"] = jax.default_backend()
+    print(json.dumps(summary))
+
+
 if __name__ == "__main__":
     from apex_tpu.telemetry import guard_bench_main
 
@@ -3160,5 +3383,7 @@ if __name__ == "__main__":
         guard_bench_main(main_host_tier, HOST_METRIC)
     elif "--overload" in sys.argv[1:]:
         guard_bench_main(main_overload, OVERLOAD_METRIC)
+    elif "--lora" in sys.argv[1:]:
+        guard_bench_main(main_lora, LORA_METRIC)
     else:
         guard_bench_main(main, METRIC)

@@ -71,7 +71,8 @@ PAGES = {
                 "apex_tpu.serving.routing_policy",
                 "apex_tpu.serving.fleet",
                 "apex_tpu.serving.fleet_worker",
-                "apex_tpu.serving.faults"],
+                "apex_tpu.serving.faults",
+                "apex_tpu.serving.lora"],
     "contrib": [
         "apex_tpu.contrib.bottleneck", "apex_tpu.contrib.clip_grad",
         "apex_tpu.contrib.conv_bias_relu", "apex_tpu.contrib.cudnn_gbn",

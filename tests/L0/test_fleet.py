@@ -156,15 +156,20 @@ def test_snapshot_wire_roundtrip_and_version():
     snap = {"queue_depth": 3, "queue_free": 5, "slots": 2,
             "slots_busy": 1, "slots_free": 1, "inflight_steps": 0,
             "pages_free": 40, "host_bytes_free": None,
-            "oldest_deadline_s": -0.25, "preemptible_pages": 12}
+            "oldest_deadline_s": -0.25, "preemptible_pages": 12,
+            "resident_adapters": ["a1", "b2"]}
     wire = snapshot_to_wire(snap)
     assert snapshot_from_wire(wire) == snap
-    # the v2 SLO fields are part of the fixed key set: a v1-shaped
-    # snapshot (no SLO columns) must fail loudly, not rank on garbage
+    # the v2 SLO fields (and the v3 adapter column) are part of the
+    # fixed key set: an older-shaped snapshot must fail loudly, not
+    # rank on garbage
     with pytest.raises(KeyError):
         snapshot_to_wire({k: snap[k] for k in snap
                           if k not in ("oldest_deadline_s",
                                        "preemptible_pages")})
+    with pytest.raises(KeyError):
+        snapshot_to_wire({k: snap[k] for k in snap
+                          if k != "resident_adapters"})
     bad = dict(wire)
     bad["v"] = 999
     with pytest.raises(ValueError, match="version"):
@@ -599,4 +604,86 @@ def test_respawn_while_saturated():
         assert fc.audit_worker(1)["pages_in_use"] == 0
     finally:
         fc.close()
+    _assert_no_orphans(fc)
+
+
+# ----------------------------------------------------------- TCP transport
+#: The LoRA fleet spec: every worker builds a per-process adapter
+#: arena; adapters are then broadcast by value over the transport.
+SPEC_LORA = {**SPEC, "engine": {**SPEC["engine"],
+                                "lora": {"rank": 4, "arena_slots": 2,
+                                         "host_bytes": 1 << 22}}}
+
+
+def _mk_adapter_sites(seed, rank=4, scale=0.5):
+    """Stacked per-site (A, B) pairs matching SPEC's model geometry
+    (hidden=32, layers=2) — deterministic, so any process holds
+    bitwise-identical adapters."""
+    rng = np.random.default_rng(seed)
+    hd, layers = 32, 2
+    dims = {"qkv": (hd, 3 * hd), "proj": (hd, hd),
+            "mlp_in": (hd, 4 * hd), "mlp_out": (4 * hd, hd)}
+    return {s: (rng.normal(size=(layers, di, rank))
+                .astype(np.float32) * scale,
+                rng.normal(size=(layers, rank, do))
+                .astype(np.float32) * scale)
+            for s, (di, do) in dims.items()}
+
+
+def test_fleet_transport_spec_validation():
+    with pytest.raises(ValueError, match="transport"):
+        FleetController([SPEC], transport=("carrier-pigeon",))
+
+
+def test_fleet_tcp_loopback_lifecycle():
+    """The TCP transport pin: a loopback AF_INET fleet (port 0 — the
+    OS picks, getsockname reports) runs the full lifecycle — spawn,
+    fleet-wide by-value adapter registration, a mixed base+adapter
+    stream BITWISE the in-process Router oracle, resident_adapters
+    visible through the snapshot wire, zero-leak worker audits,
+    idempotent close, zero orphan processes. The frame codec and RPC
+    surface are address-family-agnostic; only the listener and the
+    worker's --socket arg change."""
+    rng = np.random.default_rng(13)
+    jobs = [(rng.integers(1, VOCAB, size=10).tolist(), ad)
+            for ad in (None, "a1", "a1", None)]
+
+    engines = [build_engine_from_spec(SPEC_LORA) for _ in range(2)]
+    for e in engines:
+        e.lora_register("a1", _mk_adapter_sites(1), alpha=0.7)
+    router = Router(engines, seed=0, max_queue=32)
+    rs = [Request(prompt=list(p), max_new_tokens=4, adapter=ad)
+          for p, ad in jobs]
+    router.run(rs)
+    oracle = [list(r.output_tokens) for r in rs]
+    router.close()
+
+    fc = FleetController([SPEC_LORA, SPEC_LORA], seed=0, max_queue=32,
+                         transport=("tcp", "127.0.0.1", 0))
+    try:
+        assert fc._worker_addr.startswith("tcp:127.0.0.1:")
+        assert int(fc._worker_addr.rsplit(":", 1)[1]) > 0
+        fc.lora_register("a1", _mk_adapter_sites(1), alpha=0.7)
+        rs = [Request(prompt=list(p), max_new_tokens=4, adapter=ad)
+              for p, ad in jobs]
+        fc.run(rs)
+        assert all(r.status is RequestStatus.FINISHED for r in rs)
+        assert [list(r.output_tokens) for r in rs] == oracle, \
+            "TCP fleet diverged bitwise from the in-process Router"
+        snaps = fc._poll([0, 1])
+        assert any("a1" in (s.get("resident_adapters") or [])
+                   for s in snaps.values()), \
+            "no worker reports the adapter resident over the wire"
+        # an unknown adapter is a LOUD worker-side failure, even
+        # across the transport — never a silent base-model decode
+        bad = Request(prompt=jobs[0][0], max_new_tokens=2,
+                      adapter="nope")
+        fc.run([bad])
+        assert bad.status is RequestStatus.FAILED
+        assert "nope" in (bad.error or "")
+        assert fc.audit_worker(0)["pages_in_use"] == 0
+        assert fc.audit_worker(1)["pages_in_use"] == 0
+    finally:
+        fc.close()
+    fc.close()                              # idempotent
     _assert_no_orphans(fc)

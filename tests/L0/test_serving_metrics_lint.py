@@ -43,6 +43,13 @@ the family's absence on an engine is the signal the tier is OFF — both
 gauges going dark would make a quantized fleet indistinguishable from
 a bf16 one on every dashboard.
 
+The ``serving.lora.*`` family joined with the multi-tenant LoRA
+tentpole: ``loads`` vs ``hits`` is the adapter-affinity routing
+claim's measurement basis (a dark ``hits`` reads as "every request
+pays a host→device swap-in"), ``evictions`` going dark hides arena
+thrash under adapter churn, and ``arena_bytes`` /
+``active_adapters`` are the host store's capacity claim.
+
 This file also owns the **eager-gather shape lint** (the PR 13 gotcha,
 generalized): an eager ``pool[:, idx_list]`` fancy-index gather over
 the device KV pool compiles ONE executable PER INDEX-COUNT — a serving
@@ -116,7 +123,7 @@ DOC = os.path.join(ROOT, "docs", "serving.md")
 # the docs must name.
 _PAT = re.compile(
     r"serving\.(?:faults|watchdog|spec|tp|kv|wq|heartbeat|router|swap"
-    r"|disagg|fleet|slo|preempt)"
+    r"|disagg|fleet|slo|preempt|lora)"
     r"\.[a-z0-9_]+")
 
 
@@ -264,6 +271,20 @@ def test_scan_surface_is_alive():
         assert sched in emitted.get(name, []), \
             f"{name} not emitted by the scheduler — SLO/preemption " \
             "telemetry went dark"
+    # the multi-tenant LoRA family: arena churn counters (load-from-
+    # host, warm-row hits, LRU evictions) and the residency gauges —
+    # all emitted by the host-store/arena layer itself; any going dark
+    # makes a thousand-adapter fleet indistinguishable from a base-only
+    # one, and ``loads`` vs ``hits`` is the affinity routing claim's
+    # entire measurement basis
+    lora_py = os.path.join("apex_tpu", "serving", "lora.py")
+    for name in ("serving.lora.loads", "serving.lora.hits",
+                 "serving.lora.evictions",
+                 "serving.lora.arena_bytes",
+                 "serving.lora.active_adapters"):
+        assert lora_py in emitted.get(name, []), \
+            f"{name} not emitted by the LoRA tier — multi-tenant " \
+            "adapter telemetry went dark"
     assert _documented(), "docs/serving.md names no fault/watchdog/" \
         "spec metrics — doc section missing?"
 

@@ -245,6 +245,31 @@ def test_host_tier_owes_the_tables_no_new_keys():
                         "host_tier.py") in scanned
 
 
+def test_lora_tier_owes_the_tables_no_new_keys():
+    """The multi-tenant LoRA satellite, in the copy/verify/host-tier
+    pattern: the adapter epilogue is two skinny GEMMs fused onto the
+    EXISTING projection matmuls (``acc + (x @ A) @ B * alpha`` — rank
+    is 4–64, far below any block-tiling threshold; no new grid, block
+    shape or Pallas kernel) and the arena swap path is pure data
+    movement (one ``.at[row].set`` per site), so the tier introduces
+    NO new ``decode.*`` tuned key. Any ``decode.lora_*`` /
+    ``decode.adapter_*`` row would be a dead sweep, named loudly here;
+    and the lint's scan must cover serving/lora.py so any key a future
+    dedicated grouped-LoRA kernel DOES reference gets the
+    existence/staleness treatment automatically."""
+    table = _table_keys()
+    stale_lora = {k for k in table
+                  if k.startswith(("decode.lora_", "decode.adapter_"))}
+    assert not stale_lora, (
+        f"tuned tables carry LoRA keys but the adapter epilogue rides "
+        f"the existing projection GEMMs' knobs: {stale_lora}")
+    scanned = {os.path.relpath(p, ROOT)
+               for d in SCAN_DIRS
+               for p in glob.glob(os.path.join(d, "**", "*.py"),
+                                  recursive=True)}
+    assert os.path.join("apex_tpu", "serving", "lora.py") in scanned
+
+
 def test_sharded_serving_owes_the_tables_no_new_keys():
     """The tensor-parallel satellite, in the copy/verify pattern: the
     sharded programs run the EXISTING paged kernels over fewer heads
